@@ -1,0 +1,26 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A ground-up re-design of Pilosa (reference: /root/reference, Go) for TPU
+hardware: host-side storage keeps the reference's roaring snapshot+op-log file
+format, while the compute hot path (container intersect/union/andnot/popcount,
+TopN) runs as XLA/Pallas kernels over dense packed words held in HBM, and the
+per-slice map-reduce is a `shard_map` over a `jax.sharding.Mesh` with ICI
+collectives for the reductions.
+
+Layer map (mirrors SURVEY.md §1):
+    cli/        command-line verbs (server, import, export, backup, ...)
+    server/     HTTP API + server runtime
+    pql/        query language lexer/parser/AST
+    executor    per-call dispatch + cluster map-reduce
+    cluster/    topology, jump-hash sharding, broadcast, node-to-node client
+    models/     holder → index → frame → view schema hierarchy
+    storage/    fragment (snapshot+oplog), roaring bitmaps, caches, attrs
+    ops/        device kernel layer: packed bitmaps + XLA/Pallas kernels
+    parallel/   mesh construction, shard_map slice executor, HBM residency
+    utils/      time quantum engine, stats, config, iterators
+"""
+
+__version__ = "0.1.0"
+
+# SliceWidth is the number of columns in a slice (reference: fragment.go:47).
+SLICE_WIDTH = 1 << 20

@@ -1,0 +1,132 @@
+// Host-side native bit kernels for pilosa_tpu.
+//
+// The reference's only native component is roaring/assembly_amd64.s — POPCNT
+// loops fused with AND/OR/XOR/ANDNOT over u64 slices, plus sorted-array set
+// ops in Go. On TPU the hot path moves to XLA/Pallas (pilosa_tpu/ops/); this
+// library is the CPU-side equivalent for storage maintenance, import packing,
+// and the no-TPU fallback, so none of those paths are Python-loop-bound.
+//
+// Built as a plain shared library (extern "C"), loaded via ctypes
+// (pilosa_tpu/storage/native.py). g++ -O3 -march=native autovectorizes the
+// popcount loops with __builtin_popcountll.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ---- fused popcount + bitwise op over u64 words ----------------------------
+
+uint64_t popcnt_and(const uint64_t* a, const uint64_t* b, int64_t n) {
+    uint64_t total = 0;
+    for (int64_t i = 0; i < n; i++) total += __builtin_popcountll(a[i] & b[i]);
+    return total;
+}
+
+uint64_t popcnt_or(const uint64_t* a, const uint64_t* b, int64_t n) {
+    uint64_t total = 0;
+    for (int64_t i = 0; i < n; i++) total += __builtin_popcountll(a[i] | b[i]);
+    return total;
+}
+
+uint64_t popcnt_xor(const uint64_t* a, const uint64_t* b, int64_t n) {
+    uint64_t total = 0;
+    for (int64_t i = 0; i < n; i++) total += __builtin_popcountll(a[i] ^ b[i]);
+    return total;
+}
+
+uint64_t popcnt_andnot(const uint64_t* a, const uint64_t* b, int64_t n) {
+    uint64_t total = 0;
+    for (int64_t i = 0; i < n; i++) total += __builtin_popcountll(a[i] & ~b[i]);
+    return total;
+}
+
+uint64_t popcnt(const uint64_t* a, int64_t n) {
+    uint64_t total = 0;
+    for (int64_t i = 0; i < n; i++) total += __builtin_popcountll(a[i]);
+    return total;
+}
+
+// ---- sorted u32 array set ops ----------------------------------------------
+// Standard two-pointer merges; out must have room for the worst case
+// (min(na,nb) for intersect, na+nb for union, na for difference).
+
+int64_t intersect_sorted_u32(const uint32_t* a, int64_t na,
+                             const uint32_t* b, int64_t nb, uint32_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) i++;
+        else if (a[i] > b[j]) j++;
+        else { out[k++] = a[i]; i++; j++; }
+    }
+    return k;
+}
+
+int64_t intersection_count_sorted_u32(const uint32_t* a, int64_t na,
+                                      const uint32_t* b, int64_t nb) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) i++;
+        else if (a[i] > b[j]) j++;
+        else { k++; i++; j++; }
+    }
+    return k;
+}
+
+int64_t union_sorted_u32(const uint32_t* a, int64_t na,
+                         const uint32_t* b, int64_t nb, uint32_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) out[k++] = a[i++];
+        else if (a[i] > b[j]) out[k++] = b[j++];
+        else { out[k++] = a[i]; i++; j++; }
+    }
+    while (i < na) out[k++] = a[i++];
+    while (j < nb) out[k++] = b[j++];
+    return k;
+}
+
+int64_t difference_sorted_u32(const uint32_t* a, int64_t na,
+                              const uint32_t* b, int64_t nb, uint32_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) out[k++] = a[i++];
+        else if (a[i] > b[j]) j++;
+        else { i++; j++; }
+    }
+    while (i < na) out[k++] = a[i++];
+    return k;
+}
+
+// ---- packing: u64 bit positions -> dense u32 word matrix -------------------
+// Scatter set-bit positions into a row-major uint32 word buffer of
+// words_per_row words per row: pos -> words[row * words_per_row + col/32].
+// Positions are fragment-local: pos = row * slice_width + col.
+
+void pack_positions_u32(const uint64_t* positions, int64_t n,
+                        uint64_t slice_width, int64_t words_per_row,
+                        uint32_t* words) {
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t pos = positions[i];
+        uint64_t row = pos / slice_width;
+        uint64_t col = pos % slice_width;
+        words[row * words_per_row + (col >> 5)] |= (1u << (col & 31));
+    }
+}
+
+// Unpack one row of u32 words into sorted column ids; returns count.
+int64_t unpack_words_u32(const uint32_t* words, int64_t n_words,
+                         uint64_t* out) {
+    int64_t k = 0;
+    for (int64_t i = 0; i < n_words; i++) {
+        uint32_t w = words[i];
+        while (w) {
+            int bit = __builtin_ctz(w);
+            out[k++] = (uint64_t)i * 32 + bit;
+            w &= w - 1;
+        }
+    }
+    return k;
+}
+
+}  // extern "C"

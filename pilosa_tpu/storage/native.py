@@ -1,0 +1,209 @@
+"""ctypes loader for the native host bit kernels (pilosa_tpu/native/bitops.cpp)
+with pure-numpy fallbacks.
+
+Mirrors the reference's build-tag dispatch between assembly and generic Go
+popcount (roaring/assembly_asm.go / assembly_generic.go): the native library
+is built on first use with g++ and cached next to the source; if the toolchain
+is unavailable every entry point falls back to vectorized numpy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "bitops.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libbitops.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_load_failed = False
+
+
+def _load():
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lib_lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if (not os.path.exists(_SO)
+                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+                subprocess.run(
+                    ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                     "-o", _SO + ".tmp", _SRC],
+                    check=True, capture_output=True)
+                os.replace(_SO + ".tmp", _SO)
+            lib = ctypes.CDLL(_SO)
+            _declare(lib)
+            _lib = lib
+        except Exception:
+            _load_failed = True
+        return _lib
+
+
+def _declare(lib):
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    i64 = ctypes.c_int64
+    for name in ("popcnt_and", "popcnt_or", "popcnt_xor", "popcnt_andnot"):
+        fn = getattr(lib, name)
+        fn.argtypes = [u64p, u64p, i64]
+        fn.restype = ctypes.c_uint64
+    lib.popcnt.argtypes = [u64p, i64]
+    lib.popcnt.restype = ctypes.c_uint64
+    lib.intersect_sorted_u32.argtypes = [u32p, i64, u32p, i64, u32p]
+    lib.intersect_sorted_u32.restype = i64
+    lib.intersection_count_sorted_u32.argtypes = [u32p, i64, u32p, i64]
+    lib.intersection_count_sorted_u32.restype = i64
+    lib.union_sorted_u32.argtypes = [u32p, i64, u32p, i64, u32p]
+    lib.union_sorted_u32.restype = i64
+    lib.difference_sorted_u32.argtypes = [u32p, i64, u32p, i64, u32p]
+    lib.difference_sorted_u32.restype = i64
+    lib.pack_positions_u32.argtypes = [u64p, i64, ctypes.c_uint64, i64, u32p]
+    lib.pack_positions_u32.restype = None
+    lib.unpack_words_u32.argtypes = [u32p, i64, u64p]
+    lib.unpack_words_u32.restype = i64
+
+
+def _u64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64))
+
+
+def _u32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
+
+def _contig(a: np.ndarray, dtype) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=dtype)
+
+
+# ---- public API -------------------------------------------------------------
+
+
+def popcnt_and(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _load()
+    if lib is not None:
+        a, b = _contig(a, np.uint64), _contig(b, np.uint64)
+        return int(lib.popcnt_and(_u64p(a), _u64p(b), len(a)))
+    return int(np.bitwise_count(a & b).sum())
+
+
+def popcnt_or(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _load()
+    if lib is not None:
+        a, b = _contig(a, np.uint64), _contig(b, np.uint64)
+        return int(lib.popcnt_or(_u64p(a), _u64p(b), len(a)))
+    return int(np.bitwise_count(a | b).sum())
+
+
+def popcnt_xor(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _load()
+    if lib is not None:
+        a, b = _contig(a, np.uint64), _contig(b, np.uint64)
+        return int(lib.popcnt_xor(_u64p(a), _u64p(b), len(a)))
+    return int(np.bitwise_count(a ^ b).sum())
+
+
+def popcnt_andnot(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _load()
+    if lib is not None:
+        a, b = _contig(a, np.uint64), _contig(b, np.uint64)
+        return int(lib.popcnt_andnot(_u64p(a), _u64p(b), len(a)))
+    return int(np.bitwise_count(a & ~b).sum())
+
+
+def popcnt(a: np.ndarray) -> int:
+    lib = _load()
+    if lib is not None:
+        a = _contig(a, np.uint64)
+        return int(lib.popcnt(_u64p(a), len(a)))
+    return int(np.bitwise_count(a).sum())
+
+
+def intersect_sorted_u32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is not None:
+        a, b = _contig(a, np.uint32), _contig(b, np.uint32)
+        out = np.empty(min(len(a), len(b)), dtype=np.uint32)
+        n = lib.intersect_sorted_u32(_u32p(a), len(a), _u32p(b), len(b),
+                                     _u32p(out))
+        return out[:n]
+    return np.intersect1d(a, b, assume_unique=True).astype(np.uint32)
+
+
+def intersection_count_sorted_u32(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _load()
+    if lib is not None:
+        a, b = _contig(a, np.uint32), _contig(b, np.uint32)
+        return int(lib.intersection_count_sorted_u32(_u32p(a), len(a),
+                                                     _u32p(b), len(b)))
+    return len(np.intersect1d(a, b, assume_unique=True))
+
+
+def union_sorted_u32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is not None:
+        a, b = _contig(a, np.uint32), _contig(b, np.uint32)
+        out = np.empty(len(a) + len(b), dtype=np.uint32)
+        n = lib.union_sorted_u32(_u32p(a), len(a), _u32p(b), len(b), _u32p(out))
+        return out[:n]
+    return np.union1d(a, b).astype(np.uint32)
+
+
+def difference_sorted_u32(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    lib = _load()
+    if lib is not None:
+        a, b = _contig(a, np.uint32), _contig(b, np.uint32)
+        out = np.empty(len(a), dtype=np.uint32)
+        n = lib.difference_sorted_u32(_u32p(a), len(a), _u32p(b), len(b),
+                                      _u32p(out))
+        return out[:n]
+    return np.setdiff1d(a, b, assume_unique=True).astype(np.uint32)
+
+
+def pack_positions(positions: np.ndarray, slice_width: int,
+                   words_per_row: int, words: np.ndarray) -> None:
+    """Scatter u64 bit positions into a row-major u32 word matrix in place."""
+    if words.dtype != np.uint32 or not words.flags.c_contiguous:
+        # In-place scatter needs the real buffer: reshape(-1) of a
+        # non-contiguous view would silently mutate a copy.
+        raise ValueError("pack_positions: words must be C-contiguous uint32")
+    lib = _load()
+    if lib is not None:
+        positions = _contig(positions, np.uint64)
+        lib.pack_positions_u32(_u64p(positions), len(positions),
+                               slice_width, words_per_row,
+                               _u32p(words.reshape(-1)))
+        return
+    pos = positions.astype(np.uint64)
+    rows = (pos // np.uint64(slice_width)).astype(np.int64)
+    cols = pos % np.uint64(slice_width)
+    flat = rows * words_per_row + (cols >> np.uint64(5)).astype(np.int64)
+    np.bitwise_or.at(words.reshape(-1), flat,
+                     (np.uint32(1) << (cols & np.uint64(31)).astype(np.uint32)))
+
+
+def unpack_words(words: np.ndarray) -> np.ndarray:
+    """Expand a u32 word vector into sorted u64 bit positions."""
+    lib = _load()
+    if lib is not None:
+        words = _contig(words, np.uint32)
+        total = int(np.bitwise_count(words).sum())
+        out = np.empty(total, dtype=np.uint64)
+        n = lib.unpack_words_u32(_u32p(words), len(words), _u64p(out))
+        return out[:n]
+    bits = ((words[:, None] >> np.arange(32, dtype=np.uint32)) &
+            np.uint32(1)).astype(bool)
+    w, b = np.nonzero(bits)
+    return w.astype(np.uint64) * np.uint64(32) + b.astype(np.uint64)
+
+
+def available() -> bool:
+    return _load() is not None

@@ -1,0 +1,786 @@
+"""Roaring bitmap engine — host-side storage layer, numpy-vectorized.
+
+File-format compatible with the reference implementation
+(/root/reference/roaring/roaring.go) so fragment data files interchange:
+
+    snapshot  := cookie(u32 LE = 12346) keyN(u32 LE)
+                 { key(u64 LE) n-1(u32 LE) } * keyN          # container headers
+                 { offset(u32 LE) } * keyN                    # container offsets
+                 container blocks                             # see below
+    container := array  : n * u32 LE   (low-16-bit values widened to u32)
+               | bitmap : 1024 * u64 LE
+    op-log    := { typ(u8: 0=add 1=remove) value(u64 LE) fnv1a32(u32 LE) } *
+                 appended after the snapshot body, replayed on load.
+
+(Reference format sections: roaring.go:475-614 for snapshot,
+roaring.go:1560-1626 for the op-log.)
+
+Design departure from the reference: containers are numpy arrays, not
+pointer-chased structs — an array container is a sorted ``np.uint32`` vector
+(values < 2^16), a bitmap container is an ``np.uint64[1024]`` word vector.
+All set algebra is vectorized (numpy or the optional C++ kernel lib in
+``pilosa_tpu.native``); the same dense-word orientation is what packs straight
+onto the TPU (see pilosa_tpu.ops.packed).
+"""
+
+from __future__ import annotations
+
+import bisect
+import io
+from typing import Callable, Iterator as TIterator, Optional
+
+import numpy as np
+
+from . import native
+
+# --- constants (match reference wire format) ---------------------------------
+
+COOKIE = 12346               # roaring.go:30
+HEADER_SIZE = 8              # roaring.go:33
+BITMAP_N = 1024              # u64 words per bitmap container (roaring.go:36)
+ARRAY_MAX_SIZE = 4096        # roaring.go:833
+OP_SIZE = 13                 # 1 + 8 + 4 (roaring.go:1626)
+
+OP_ADD = 0
+OP_REMOVE = 1
+
+_EMPTY_U32 = np.empty(0, dtype=np.uint32)
+_EMPTY_U64 = np.empty(0, dtype=np.uint64)
+
+# FNV-1a 32-bit (op-log checksums).
+_FNV_OFFSET = np.uint32(2166136261)
+_FNV_PRIME = np.uint32(16777619)
+
+
+def fnv1a32(data: bytes) -> int:
+    h = int(_FNV_OFFSET)
+    for b in data:
+        h = ((h ^ b) * int(_FNV_PRIME)) & 0xFFFFFFFF
+    return h
+
+
+def highbits(v: int) -> int:
+    return v >> 16
+
+
+def lowbits(v: int) -> int:
+    return v & 0xFFFF
+
+
+# --- container ---------------------------------------------------------------
+
+
+class Container:
+    """One 2^16-value container: sorted u32 array or 1024-word u64 bitmap.
+
+    ``mapped`` marks data backed by an external (mmap'd) buffer; any mutation
+    first copies (copy-on-write), mirroring the reference's ``mapped`` flag
+    (roaring.go:536-614) and BitmapSegment.writable (bitmap.go:384-392).
+    """
+
+    __slots__ = ("array", "bitmap", "n", "mapped")
+
+    def __init__(self):
+        self.array: Optional[np.ndarray] = _EMPTY_U32  # sorted u32, or None
+        self.bitmap: Optional[np.ndarray] = None       # u64[1024], or None
+        self.n: int = 0
+        self.mapped: bool = False
+
+    # -- representation management
+
+    def is_array(self) -> bool:
+        return self.bitmap is None
+
+    def _unmap(self) -> None:
+        if self.mapped:
+            if self.array is not None:
+                self.array = self.array.copy()
+            if self.bitmap is not None:
+                self.bitmap = self.bitmap.copy()
+            self.mapped = False
+
+    def _to_bitmap(self) -> None:
+        """array → bitmap conversion (roaring.go:951-976)."""
+        if self.bitmap is not None:
+            return
+        self.bitmap = self.as_words()
+        self.array = None
+        self.mapped = False
+
+    def _to_array(self) -> None:
+        """bitmap → array conversion (roaring.go:1023-1048)."""
+        if self.bitmap is None:
+            return
+        self.array = bitmap_words_to_values(self.bitmap)
+        self.bitmap = None
+        self.mapped = False
+
+    def _maybe_convert(self) -> None:
+        # Invariant (required by the file format, where n<=4096 ⇒ array
+        # block): array containers hold at most ARRAY_MAX_SIZE values, bitmap
+        # containers strictly more. Matches reference arrayAdd/bitmapRemove
+        # boundaries (roaring.go:951-953,1023-1025).
+        if self.bitmap is None:
+            if self.n > ARRAY_MAX_SIZE:
+                self._to_bitmap()
+        else:
+            if self.n <= ARRAY_MAX_SIZE:
+                self._to_array()
+
+    # -- point ops
+
+    def add(self, v: int) -> bool:
+        if self.bitmap is None:
+            a = self.array
+            i = int(np.searchsorted(a, v))
+            if i < len(a) and a[i] == v:
+                return False
+            self._unmap()
+            self.array = np.insert(self.array, i, np.uint32(v))
+            self.n += 1
+            self._maybe_convert()
+            return True
+        w, b = v >> 6, np.uint64(1) << np.uint64(v & 63)
+        if self.bitmap[w] & b:
+            return False
+        self._unmap()
+        self.bitmap[w] |= b
+        self.n += 1
+        return True
+
+    def remove(self, v: int) -> bool:
+        if self.bitmap is None:
+            a = self.array
+            i = int(np.searchsorted(a, v))
+            if i >= len(a) or a[i] != v:
+                return False
+            self._unmap()
+            self.array = np.delete(self.array, i)
+            self.n -= 1
+            return True
+        w, b = v >> 6, np.uint64(1) << np.uint64(v & 63)
+        if not (self.bitmap[w] & b):
+            return False
+        self._unmap()
+        self.bitmap[w] &= ~b
+        self.n -= 1
+        self._maybe_convert()
+        return True
+
+    def contains(self, v: int) -> bool:
+        if self.bitmap is None:
+            a = self.array
+            i = int(np.searchsorted(a, v))
+            return i < len(a) and a[i] == v
+        return bool((self.bitmap[v >> 6] >> np.uint64(v & 63)) & np.uint64(1))
+
+    # -- bulk access
+
+    def values(self) -> np.ndarray:
+        """All set low-16-bit values, sorted, as u32."""
+        if self.bitmap is None:
+            return self.array
+        return bitmap_words_to_values(self.bitmap)
+
+    def as_words(self) -> np.ndarray:
+        """Dense u64[1024] word view (built on demand for array containers)."""
+        if self.bitmap is not None:
+            return self.bitmap
+        words = np.zeros(BITMAP_N, dtype=np.uint64)
+        a = self.array
+        if a is not None and len(a):
+            np.bitwise_or.at(words, a >> np.uint32(6),
+                             np.uint64(1) << (a.astype(np.uint64) & np.uint64(63)))
+        return words
+
+    def count_range(self, start: int, end: int) -> int:
+        """Number of set values in [start, end) within this container."""
+        start, end = max(start, 0), min(end, 1 << 16)
+        if start >= end:
+            return 0
+        if self.bitmap is None:
+            a = self.array
+            return int(np.searchsorted(a, end) - np.searchsorted(a, start))
+        # Whole-word popcount with masked edge words — O(words), no
+        # cardinality-proportional allocation.
+        w0, w1 = start >> 6, (end - 1) >> 6
+        words = self.bitmap[w0:w1 + 1].copy()
+        words[0] &= ~np.uint64(0) << np.uint64(start & 63)
+        last_bits = ((end - 1) & 63) + 1
+        if last_bits < 64:
+            words[-1] &= ~(~np.uint64(0) << np.uint64(last_bits))
+        return int(np.bitwise_count(words).sum())
+
+    def size_bytes(self) -> int:
+        """Serialized size (roaring.go container size())."""
+        return self.n * 4 if self.bitmap is None else BITMAP_N * 8
+
+    def check(self) -> None:
+        """Internal consistency (roaring.go:653-674 spirit)."""
+        if self.bitmap is None:
+            a = self.array
+            if a is None:
+                raise ValueError("container: nil array")
+            if len(a) != self.n:
+                raise ValueError(f"container: array len {len(a)} != n {self.n}")
+            if len(a) > 1 and not np.all(a[1:] > a[:-1]):
+                raise ValueError("container: array not strictly sorted")
+            if len(a) and int(a[-1]) > 0xFFFF:
+                raise ValueError("container: array value out of range")
+        else:
+            got = int(np.bitwise_count(self.bitmap).sum())
+            if got != self.n:
+                raise ValueError(f"container: bitmap count {got} != n {self.n}")
+
+    @staticmethod
+    def from_array(a: np.ndarray, mapped: bool = False) -> "Container":
+        c = Container()
+        c.array = a
+        c.n = len(a)
+        c.mapped = mapped
+        return c
+
+    @staticmethod
+    def from_bitmap(words: np.ndarray, n: Optional[int] = None,
+                    mapped: bool = False) -> "Container":
+        c = Container()
+        c.array = None
+        c.bitmap = words
+        c.n = int(np.bitwise_count(words).sum()) if n is None else n
+        c.mapped = mapped
+        return c
+
+
+def bitmap_words_to_values(words: np.ndarray) -> np.ndarray:
+    """Expand u64 words → sorted u32 value vector (vectorized)."""
+    nz = np.flatnonzero(words)
+    if not len(nz):
+        return _EMPTY_U32
+    # Expand each non-zero word into its set bit positions.
+    w = words[nz]
+    bits = ((w[:, None] >> np.arange(64, dtype=np.uint64)) &
+            np.uint64(1)).astype(bool)
+    word_idx, bit_idx = np.nonzero(bits)
+    return (nz[word_idx].astype(np.uint32) * np.uint32(64)
+            + bit_idx.astype(np.uint32))
+
+
+# --- container set algebra (vectorized; native C++ when available) -----------
+
+
+def _intersect(a: Container, b: Container) -> Container:
+    if a.is_array() and b.is_array():
+        out = native.intersect_sorted_u32(a.array, b.array)
+        return Container.from_array(out)
+    if a.is_array() != b.is_array():
+        arr, bmp = (a, b) if a.is_array() else (b, a)
+        av = arr.array
+        hit = (bmp.bitmap[av >> np.uint32(6)] >>
+               (av.astype(np.uint64) & np.uint64(63))) & np.uint64(1)
+        return Container.from_array(av[hit.astype(bool)])
+    words = a.bitmap & b.bitmap
+    c = Container.from_bitmap(words)
+    c._maybe_convert()
+    return c
+
+
+def _intersection_count(a: Container, b: Container) -> int:
+    if a.is_array() and b.is_array():
+        return native.intersection_count_sorted_u32(a.array, b.array)
+    if a.is_array() != b.is_array():
+        arr, bmp = (a, b) if a.is_array() else (b, a)
+        av = arr.array
+        hit = (bmp.bitmap[av >> np.uint32(6)] >>
+               (av.astype(np.uint64) & np.uint64(63))) & np.uint64(1)
+        return int(hit.sum())
+    return native.popcnt_and(a.bitmap, b.bitmap)
+
+
+def _union(a: Container, b: Container) -> Container:
+    if a.is_array() and b.is_array():
+        out = np.union1d(a.array, b.array).astype(np.uint32)
+        c = Container.from_array(out)
+        c._maybe_convert()
+        return c
+    words = a.as_words() | b.as_words()
+    c = Container.from_bitmap(words)
+    c._maybe_convert()
+    return c
+
+
+def _difference(a: Container, b: Container) -> Container:
+    if a.is_array():
+        av = a.array
+        if b.is_array():
+            keep = ~np.isin(av, b.array, assume_unique=True)
+        else:
+            keep = ~((b.bitmap[av >> np.uint32(6)] >>
+                      (av.astype(np.uint64) & np.uint64(63))) &
+                     np.uint64(1)).astype(bool)
+        return Container.from_array(av[keep])
+    words = a.bitmap & ~b.as_words()
+    c = Container.from_bitmap(words)
+    c._maybe_convert()
+    return c
+
+
+def _xor(a: Container, b: Container) -> Container:
+    if a.is_array() and b.is_array():
+        out = np.setxor1d(a.array, b.array, assume_unique=True).astype(np.uint32)
+        c = Container.from_array(out)
+        c._maybe_convert()
+        return c
+    words = a.as_words() ^ b.as_words()
+    c = Container.from_bitmap(words)
+    c._maybe_convert()
+    return c
+
+
+# --- op-log ------------------------------------------------------------------
+
+
+class Op:
+    """One op-log record (roaring.go:1560-1626)."""
+
+    __slots__ = ("typ", "value")
+
+    def __init__(self, typ: int, value: int):
+        self.typ = typ
+        self.value = value
+
+    def marshal(self) -> bytes:
+        body = bytes([self.typ]) + int(self.value).to_bytes(8, "little")
+        return body + fnv1a32(body).to_bytes(4, "little")
+
+    @staticmethod
+    def unmarshal(buf: memoryview) -> "Op":
+        if len(buf) < OP_SIZE:
+            raise ValueError(f"op data out of bounds: len={len(buf)}")
+        body = bytes(buf[:9])
+        chk = int.from_bytes(buf[9:13], "little")
+        want = fnv1a32(body)
+        if chk != want:
+            raise ValueError(f"checksum mismatch: exp={want:08x}, got={chk:08x}")
+        return Op(body[0], int.from_bytes(body[1:9], "little"))
+
+    def apply(self, b: "Bitmap") -> bool:
+        if self.typ == OP_ADD:
+            return b._add(self.value)
+        if self.typ == OP_REMOVE:
+            return b._remove(self.value)
+        raise ValueError(f"invalid op type: {self.typ}")
+
+
+# --- bitmap ------------------------------------------------------------------
+
+
+class Bitmap:
+    """Two-level roaring bitmap: sorted high-48-bit keys → containers.
+
+    ``op_writer`` (a binary file-like) mirrors the reference's OpWriter hook
+    (roaring.go:51,616-628): when set, every add/remove appends an op record.
+    """
+
+    def __init__(self, *values: int):
+        self.keys: list[int] = []
+        self.containers: list[Container] = []
+        self.op_writer = None
+        self.op_n = 0  # ops appended/replayed since last snapshot
+        for v in values:
+            self._add(v)
+
+    # -- container lookup
+
+    def _index(self, key: int) -> int:
+        """Bisect keys; returns insertion point."""
+        return bisect.bisect_left(self.keys, key)
+
+    def container(self, key: int) -> Optional[Container]:
+        i = self._index(key)
+        if i < len(self.keys) and self.keys[i] == key:
+            return self.containers[i]
+        return None
+
+    def _container_or_create(self, key: int) -> Container:
+        i = self._index(key)
+        if i < len(self.keys) and self.keys[i] == key:
+            return self.containers[i]
+        c = Container()
+        self.keys.insert(i, key)
+        self.containers.insert(i, c)
+        return c
+
+    # -- point ops (public ops write to the op-log; _ops do not)
+
+    def add(self, v: int) -> bool:
+        changed = self._add(v)
+        if changed:
+            self._write_op(Op(OP_ADD, v))
+        return changed
+
+    def _add(self, v: int) -> bool:
+        return self._container_or_create(highbits(v)).add(lowbits(v))
+
+    def remove(self, v: int) -> bool:
+        changed = self._remove(v)
+        if changed:
+            self._write_op(Op(OP_REMOVE, v))
+        return changed
+
+    def _remove(self, v: int) -> bool:
+        c = self.container(highbits(v))
+        return c.remove(lowbits(v)) if c is not None else False
+
+    def contains(self, v: int) -> bool:
+        c = self.container(highbits(v))
+        return c.contains(lowbits(v)) if c is not None else False
+
+    def _write_op(self, op: Op) -> None:
+        if self.op_writer is not None:
+            self.op_writer.write(op.marshal())
+            self.op_n += 1
+
+    # -- bulk ops
+
+    def add_many(self, values: np.ndarray) -> int:
+        """Vectorized bulk add of a u64 value vector. Returns #newly set.
+
+        The import hot path (reference: fragment.go:924-989 detaches the op
+        writer and bulk-adds); callers snapshot afterwards.
+        """
+        values = np.asarray(values, dtype=np.uint64)
+        if not len(values):
+            return 0
+        values = np.unique(values)  # sorts
+        highs = (values >> np.uint64(16)).astype(np.uint64)
+        lows = (values & np.uint64(0xFFFF)).astype(np.uint32)
+        bounds = np.flatnonzero(np.diff(highs)) + 1
+        starts = np.concatenate(([0], bounds))
+        ends = np.concatenate((bounds, [len(values)]))
+        added = 0
+        for s, e in zip(starts, ends):
+            key = int(highs[s])
+            chunk = lows[s:e]
+            c = self._container_or_create(key)
+            before = c.n
+            if c.n == 0:
+                c.array, c.bitmap, c.n = chunk, None, len(chunk)
+                c.mapped = False
+            elif c.is_array():
+                merged = np.union1d(c.array, chunk).astype(np.uint32)
+                c._unmap()
+                c.array, c.n = merged, len(merged)
+            else:
+                # OR-scatter straight into the word vector: O(chunk + words),
+                # no representation churn for the dense-import hot path.
+                c._unmap()
+                np.bitwise_or.at(
+                    c.bitmap, chunk >> np.uint32(6),
+                    np.uint64(1) << (chunk.astype(np.uint64) & np.uint64(63)))
+                c.n = int(np.bitwise_count(c.bitmap).sum())
+            c._maybe_convert()
+            added += c.n - before
+        return added
+
+    @staticmethod
+    def from_sorted(values: np.ndarray) -> "Bitmap":
+        b = Bitmap()
+        b.add_many(values)
+        return b
+
+    def values(self) -> np.ndarray:
+        """All set positions as a sorted u64 vector."""
+        parts = []
+        for key, c in zip(self.keys, self.containers):
+            if c.n:
+                parts.append(np.uint64(key << 16) +
+                             c.values().astype(np.uint64))
+        if not parts:
+            return _EMPTY_U64
+        return np.concatenate(parts)
+
+    # -- counts / ranges
+
+    def count(self) -> int:
+        return sum(c.n for c in self.containers)
+
+    def count_range(self, start: int, end: int) -> int:
+        """Set bits in [start, end)."""
+        if start >= end:
+            return 0
+        total = 0
+        hi0, hi1 = highbits(start), highbits(end - 1)
+        i = self._index(hi0)
+        while i < len(self.keys) and self.keys[i] <= hi1:
+            key, c = self.keys[i], self.containers[i]
+            lo = lowbits(start) if key == hi0 else 0
+            hi = lowbits(end - 1) + 1 if key == hi1 else 1 << 16
+            total += c.count_range(lo, hi)
+            i += 1
+        return total
+
+    def slice_range(self, start: int, end: int) -> np.ndarray:
+        """Sorted u64 vector of set positions in [start, end)."""
+        if start >= end:
+            return _EMPTY_U64
+        parts = []
+        hi0, hi1 = highbits(start), highbits(end - 1)
+        i = self._index(hi0)
+        while i < len(self.keys) and self.keys[i] <= hi1:
+            key, c = self.keys[i], self.containers[i]
+            vals = c.values().astype(np.uint64) + np.uint64(key << 16)
+            if key == hi0 or key == hi1:
+                vals = vals[(vals >= start) & (vals < end)]
+            if len(vals):
+                parts.append(vals)
+            i += 1
+        if not parts:
+            return _EMPTY_U64
+        return np.concatenate(parts)
+
+    def offset_range(self, offset: int, start: int, end: int) -> "Bitmap":
+        """New bitmap of bits in [start,end) rebased to ``offset``
+        (reference: roaring.go:253-285 — the Fragment.row() primitive).
+
+        offset/start/end must be container-aligned (multiples of 2^16).
+        Containers are shared (not copied) and marked mapped for
+        copy-on-write, so this is O(containers in range).
+        """
+        for x, nm in ((offset, "offset"), (start, "start"), (end, "end")):
+            if x & 0xFFFF:
+                raise ValueError(f"{nm} must be multiple of 2^16")
+        off_hi, hi0, hi1 = highbits(offset), highbits(start), highbits(end)
+        out = Bitmap()
+        i = self._index(hi0)
+        while i < len(self.keys) and self.keys[i] < hi1:
+            c = self.containers[i]
+            if c.n:
+                out.keys.append(off_hi + (self.keys[i] - hi0))
+                c.mapped = True  # force copy-on-write in both holders
+                out.containers.append(_shared_view(c))
+            i += 1
+        return out
+
+    # -- set algebra
+
+    def _binary_op(self, other: "Bitmap",
+                   containers_fn: Callable, union_keys: bool) -> "Bitmap":
+        out = Bitmap()
+        i = j = 0
+        ak, bk = self.keys, other.keys
+        while i < len(ak) or j < len(bk):
+            if j >= len(bk) or (i < len(ak) and ak[i] < bk[j]):
+                if union_keys:
+                    r = containers_fn(self.containers[i], None)
+                    if r is not None and r.n:
+                        out.keys.append(ak[i])
+                        out.containers.append(r)
+                i += 1
+            elif i >= len(ak) or (j < len(bk) and bk[j] < ak[i]):
+                if union_keys:
+                    r = containers_fn(None, other.containers[j])
+                    if r is not None and r.n:
+                        out.keys.append(bk[j])
+                        out.containers.append(r)
+                j += 1
+            else:
+                r = containers_fn(self.containers[i], other.containers[j])
+                if r is not None and r.n:
+                    out.keys.append(ak[i])
+                    out.containers.append(r)
+                i += 1
+                j += 1
+        return out
+
+    def intersect(self, other: "Bitmap") -> "Bitmap":
+        return self._binary_op(other, lambda a, b: _intersect(a, b),
+                               union_keys=False)
+
+    def intersection_count(self, other: "Bitmap") -> int:
+        total = 0
+        i = j = 0
+        while i < len(self.keys) and j < len(other.keys):
+            if self.keys[i] < other.keys[j]:
+                i += 1
+            elif self.keys[i] > other.keys[j]:
+                j += 1
+            else:
+                total += _intersection_count(self.containers[i],
+                                             other.containers[j])
+                i += 1
+                j += 1
+        return total
+
+    def union(self, other: "Bitmap") -> "Bitmap":
+        def f(a, b):
+            if a is None:
+                return _shared_copy(b)
+            if b is None:
+                return _shared_copy(a)
+            return _union(a, b)
+        return self._binary_op(other, f, union_keys=True)
+
+    def difference(self, other: "Bitmap") -> "Bitmap":
+        def f(a, b):
+            if a is None:
+                return None
+            if b is None:
+                return _shared_copy(a)
+            return _difference(a, b)
+        return self._binary_op(other, f, union_keys=True)
+
+    def xor(self, other: "Bitmap") -> "Bitmap":
+        def f(a, b):
+            if a is None:
+                return _shared_copy(b)
+            if b is None:
+                return _shared_copy(a)
+            return _xor(a, b)
+        return self._binary_op(other, f, union_keys=True)
+
+    # -- iteration
+
+    def __iter__(self) -> TIterator[int]:
+        for key, c in zip(self.keys, self.containers):
+            base = key << 16
+            for v in c.values():
+                yield base + int(v)
+
+    def iterator_from(self, seek: int) -> TIterator[int]:
+        """Iterate values >= seek."""
+        hi = highbits(seek)
+        i = self._index(hi)
+        for k in range(i, len(self.keys)):
+            key, c = self.keys[k], self.containers[k]
+            base = key << 16
+            vals = c.values()
+            if key == hi:
+                vals = vals[vals >= lowbits(seek)]
+            for v in vals:
+                yield base + int(v)
+
+    def unmap(self) -> None:
+        """Copy all mapped container data out of the backing buffer.
+
+        Required before closing the mmap a bitmap was loaded from: numpy
+        views pin the buffer (mmap.close() raises BufferError otherwise).
+        The fragment snapshot path (rewrite file → remap, reference
+        fragment.go:1017-1057) calls this before releasing the old map.
+        """
+        for c in self.containers:
+            c._unmap()
+
+    # -- integrity
+
+    def check(self) -> None:
+        if len(self.keys) != len(self.containers):
+            raise ValueError("bitmap: keys/containers length mismatch")
+        for k in range(1, len(self.keys)):
+            if self.keys[k] <= self.keys[k - 1]:
+                raise ValueError("bitmap: keys out of order")
+        for c in self.containers:
+            c.check()
+
+    # -- serialization (reference-compatible; roaring.go:475-614)
+
+    def write_to(self, w) -> int:
+        # Normalize representation so the n<=4096⇒array load rule holds even
+        # for bitmaps produced by set algebra.
+        for c in self.containers:
+            c._maybe_convert()
+        live = [(k, c) for k, c in zip(self.keys, self.containers) if c.n > 0]
+        n_cont = len(live)
+        header = bytearray(HEADER_SIZE + n_cont * 12 + n_cont * 4)
+        header[0:4] = COOKIE.to_bytes(4, "little")
+        header[4:8] = n_cont.to_bytes(4, "little")
+        pos = HEADER_SIZE
+        for key, c in live:
+            header[pos:pos + 8] = int(key).to_bytes(8, "little")
+            header[pos + 8:pos + 12] = (c.n - 1).to_bytes(4, "little")
+            pos += 12
+        offset = len(header)
+        for key, c in live:
+            header[pos:pos + 4] = offset.to_bytes(4, "little")
+            pos += 4
+            offset += c.size_bytes()
+        written = 0
+        w.write(bytes(header))
+        written += len(header)
+        for _, c in live:
+            if c.is_array():
+                blob = np.ascontiguousarray(c.array, dtype="<u4").tobytes()
+            else:
+                blob = np.ascontiguousarray(c.bitmap, dtype="<u8").tobytes()
+            w.write(blob)
+            written += len(blob)
+        return written
+
+    def marshal(self) -> bytes:
+        buf = io.BytesIO()
+        self.write_to(buf)
+        return buf.getvalue()
+
+    @staticmethod
+    def unmarshal(data, mapped: bool = False) -> "Bitmap":
+        """Decode a snapshot (+trailing op-log) from a bytes-like buffer.
+
+        With ``mapped=True`` container data are zero-copy views into ``data``
+        (e.g. an mmap); they are copy-on-write on first mutation.
+        """
+        buf = memoryview(data)
+        if len(buf) < HEADER_SIZE:
+            raise ValueError("data too small")
+        if int.from_bytes(buf[0:4], "little") != COOKIE:
+            raise ValueError("invalid roaring file")
+        key_n = int.from_bytes(buf[4:8], "little")
+        if HEADER_SIZE + key_n * 16 > len(buf):
+            raise ValueError(
+                f"header out of bounds: keyN={key_n}, len={len(buf)}")
+        b = Bitmap()
+        hdr = HEADER_SIZE
+        ns = []
+        for i in range(key_n):
+            b.keys.append(int.from_bytes(buf[hdr:hdr + 8], "little"))
+            ns.append(int.from_bytes(buf[hdr + 8:hdr + 12], "little") + 1)
+            hdr += 12
+        ops_offset = HEADER_SIZE + key_n * 12
+        for i in range(key_n):
+            off = int.from_bytes(buf[ops_offset:ops_offset + 4], "little")
+            ops_offset += 4
+            if off >= len(buf):
+                raise ValueError(
+                    f"offset out of bounds: off={off}, len={len(buf)}")
+            n = ns[i]
+            if n <= ARRAY_MAX_SIZE:
+                arr = np.frombuffer(buf, dtype="<u4", count=n, offset=off)
+                c = Container.from_array(arr if mapped else arr.copy(),
+                                         mapped=mapped)
+                end = off + n * 4
+            else:
+                words = np.frombuffer(buf, dtype="<u8", count=BITMAP_N,
+                                      offset=off)
+                c = Container.from_bitmap(words if mapped else words.copy(),
+                                          n=n, mapped=mapped)
+                end = off + BITMAP_N * 8
+            b.containers.append(c)
+        # Trailing op-log (bytes after the last container block).
+        ops_end = max(ops_offset, end if key_n else HEADER_SIZE)
+        rest = buf[ops_end:]
+        while len(rest):
+            op = Op.unmarshal(rest)
+            op.apply(b)
+            b.op_n += 1
+            rest = rest[OP_SIZE:]
+        return b
+
+
+def _shared_view(c: Container) -> Container:
+    """A container sharing c's data, mapped (copy-on-write)."""
+    out = Container()
+    out.array, out.bitmap, out.n, out.mapped = c.array, c.bitmap, c.n, True
+    return out
+
+
+def _shared_copy(c: Container) -> Container:
+    c.mapped = True
+    return _shared_view(c)
