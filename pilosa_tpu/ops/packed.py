@@ -1,0 +1,93 @@
+"""Packed dense bitmap layout — the device-side data representation.
+
+The reference's compute walks compressed roaring containers with scalar/SIMD
+loops (roaring.go:1192-1558 + assembly_amd64.s). TPUs want dense, regular,
+vectorized data: here a fragment's rows live in HBM as a row-major
+``uint32[n_rows, 32768]`` matrix — 2^20 columns / 32 bits per word — and all
+set algebra is elementwise ops over whole rows (pilosa_tpu.ops.kernels).
+
+u32 is the natural TPU word (native lane type; XLA has no u64 popcount
+advantage), and the layout lines up with the storage format for free: a
+roaring bitmap container is 1024 little-endian u64 words covering a 2^16
+position range, which reinterpret as exactly the 2048 little-endian u32
+device words of that range — so packing a dense container is a memcpy, no
+bit manipulation.
+
+Column ids are u64 host-side (positions up to 2^64); the device only ever
+sees word indices within a slice, which fit comfortably in i32.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import SLICE_WIDTH
+from ..storage.roaring import Bitmap
+
+WORD_BITS = 32
+# u32 words per slice row: 2^20 / 32 = 32768 (a multiple of the 128-lane
+# TPU tile, so rows map onto the VPU with no padding).
+WORDS_PER_SLICE = SLICE_WIDTH // WORD_BITS
+# u32 words per roaring container range (2^16 positions / 32).
+_WORDS_PER_CONTAINER = (1 << 16) // WORD_BITS
+
+
+def pack_bitmap(b: Bitmap, n_words: int, out: np.ndarray | None = None,
+                base_word: int = 0) -> np.ndarray:
+    """Pack a roaring bitmap into a dense u32 word vector.
+
+    ``b``'s positions are interpreted relative to ``base_word * 32``; words
+    outside [0, n_words) are ignored. Dense containers blit via u64→u32
+    reinterpretation; array containers scatter.
+    """
+    if out is None:
+        out = np.zeros(n_words, dtype=np.uint32)
+    for key, c in zip(b.keys, b.containers):
+        if c.n == 0:
+            continue
+        word0 = key * _WORDS_PER_CONTAINER - base_word
+        if word0 >= n_words or word0 + _WORDS_PER_CONTAINER <= 0:
+            continue
+        if not c.is_array():
+            dst0, dst1 = max(word0, 0), min(word0 + _WORDS_PER_CONTAINER,
+                                            n_words)
+            src = c.bitmap.view("<u4")[dst0 - word0:dst1 - word0]
+            out[dst0:dst1] |= src
+        else:
+            a = c.array
+            widx = word0 + (a >> np.uint32(5)).astype(np.int64)
+            keep = (widx >= 0) & (widx < n_words)
+            np.bitwise_or.at(out, widx[keep],
+                             np.uint32(1) << (a[keep] & np.uint32(31)))
+    return out
+
+
+def pack_rows(storage: Bitmap, row_ids) -> np.ndarray:
+    """Pack rows of a fragment-local storage bitmap into u32[n, 32768].
+
+    ``storage`` holds positions ``pos = row * SLICE_WIDTH + col`` (the
+    fragment bit layout, reference fragment.go:1511-1514); row ``r`` of the
+    result is the dense words of columns [0, 2^20) of that row.
+    """
+    row_ids = list(row_ids)
+    out = np.zeros((len(row_ids), WORDS_PER_SLICE), dtype=np.uint32)
+    for i, row in enumerate(row_ids):
+        row_bm = storage.offset_range(0, row * SLICE_WIDTH,
+                                      (row + 1) * SLICE_WIDTH)
+        pack_bitmap(row_bm, WORDS_PER_SLICE, out=out[i])
+    return out
+
+
+def unpack_words(words: np.ndarray) -> np.ndarray:
+    """Dense u32 word vector → sorted u64 bit positions (host)."""
+    from ..storage import native
+    return native.unpack_words(np.ascontiguousarray(words))
+
+
+def unpack_to_bitmap(words: np.ndarray, base_word: int = 0) -> Bitmap:
+    """Dense u32 word vector → roaring bitmap with positions offset by
+    ``base_word * 32``."""
+    pos = unpack_words(words)
+    if base_word:
+        pos = pos + np.uint64(base_word * WORD_BITS)
+    return Bitmap.from_sorted(pos)

@@ -10,6 +10,7 @@ is unavailable every entry point falls back to vectorized numpy.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -18,11 +19,22 @@ import numpy as np
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "native")
 _SRC = os.path.join(_NATIVE_DIR, "bitops.cpp")
-_SO = os.path.join(_NATIVE_DIR, "libbitops.so")
 
 _lib = None
 _lib_lock = threading.Lock()
 _load_failed = False
+
+
+def _so_path() -> str:
+    # Cache keyed by source content hash in a per-machine dir: the binary is
+    # -march=native, so a committed or stale .so from another host could
+    # SIGILL. Never ship the artifact, always rebuild per (machine, source).
+    with open(_SRC, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.environ.get("PILOSA_TPU_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "pilosa_tpu")
+    os.makedirs(cache, exist_ok=True)
+    return os.path.join(cache, f"libbitops-{digest}.so")
 
 
 def _load():
@@ -33,14 +45,14 @@ def _load():
         if _lib is not None or _load_failed:
             return _lib
         try:
-            if (not os.path.exists(_SO)
-                    or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            so = _so_path()
+            if not os.path.exists(so):
                 subprocess.run(
                     ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-                     "-o", _SO + ".tmp", _SRC],
+                     "-o", so + ".tmp", _SRC],
                     check=True, capture_output=True)
-                os.replace(_SO + ".tmp", _SO)
-            lib = ctypes.CDLL(_SO)
+                os.replace(so + ".tmp", so)
+            lib = ctypes.CDLL(so)
             _declare(lib)
             _lib = lib
         except Exception:
@@ -175,6 +187,16 @@ def pack_positions(positions: np.ndarray, slice_width: int,
         # In-place scatter needs the real buffer: reshape(-1) of a
         # non-contiguous view would silently mutate a copy.
         raise ValueError("pack_positions: words must be C-contiguous uint32")
+    if len(positions):
+        # The native scatter is unchecked C; validate here so corrupt input
+        # raises instead of corrupting the heap.
+        n_rows = words.size // words_per_row
+        pos = np.asarray(positions, dtype=np.uint64)
+        if int(pos.max()) >= n_rows * slice_width:
+            raise ValueError("pack_positions: position out of range")
+        if np.any((pos % np.uint64(slice_width)) >>
+                  np.uint64(5) >= words_per_row):
+            raise ValueError("pack_positions: column exceeds words_per_row")
     lib = _load()
     if lib is not None:
         positions = _contig(positions, np.uint64)

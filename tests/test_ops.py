@@ -1,0 +1,144 @@
+"""Device kernel layer tests: pack/unpack round-trips and parity between the
+host roaring engine (semantics reference) and the XLA/Pallas kernels."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.ops import kernels, packed, pallas_kernels
+from pilosa_tpu.storage.roaring import Bitmap
+
+
+def rand_bitmap(rng, n, hi):
+    return Bitmap.from_sorted(
+        rng.choice(hi, size=n, replace=False).astype(np.uint64))
+
+
+class TestPacking:
+    def test_pack_dense_container_is_view_equal(self):
+        # A dense container must blit: positions 0..65535 → all-ones words.
+        b = Bitmap.from_sorted(np.arange(1 << 16, dtype=np.uint64))
+        words = packed.pack_bitmap(b, packed.WORDS_PER_SLICE)
+        assert np.all(words[:2048] == 0xFFFFFFFF)
+        assert np.all(words[2048:] == 0)
+
+    def test_pack_unpack_roundtrip(self):
+        rng = np.random.default_rng(1)
+        b = rand_bitmap(rng, 10000, SLICE_WIDTH)
+        words = packed.pack_bitmap(b, packed.WORDS_PER_SLICE)
+        back = packed.unpack_to_bitmap(words)
+        assert np.array_equal(back.values(), b.values())
+
+    def test_pack_rows_layout(self):
+        # storage positions pos = row*SLICE_WIDTH + col (fragment layout)
+        storage = Bitmap(0, 31, 32, SLICE_WIDTH + 5, 3 * SLICE_WIDTH - 1)
+        m = packed.pack_rows(storage, [0, 1, 2])
+        assert m.shape == (3, packed.WORDS_PER_SLICE)
+        assert m[0, 0] == (1 | (1 << 31))
+        assert m[0, 1] == 1
+        assert m[1, 0] == (1 << 5)
+        assert m[2, -1] == (1 << 31)
+
+    def test_pack_base_word_window(self):
+        b = Bitmap(0, 100 * 32, 100 * 32 + 7)
+        words = packed.pack_bitmap(b, 8, base_word=100)
+        assert words[0] == (1 | (1 << 7))
+        assert np.all(words[1:] == 0)
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("op,ref", [
+        ("and", lambda a, b: a.intersect(b)),
+        ("or", lambda a, b: a.union(b)),
+        ("andnot", lambda a, b: a.difference(b)),
+        ("xor", lambda a, b: a.xor(b)),
+    ])
+    def test_set_op_matches_roaring(self, op, ref):
+        rng = np.random.default_rng(kernels.OPS.index(op))
+        a, b = (rand_bitmap(rng, 5000, SLICE_WIDTH) for _ in range(2))
+        aw = packed.pack_bitmap(a, packed.WORDS_PER_SLICE)
+        bw = packed.pack_bitmap(b, packed.WORDS_PER_SLICE)
+        got = np.asarray(kernels.set_op(op, aw, bw))
+        want = packed.pack_bitmap(ref(a, b), packed.WORDS_PER_SLICE)
+        assert np.array_equal(got, want)
+        # counts agree with the host engine too
+        count = int(np.asarray(kernels.op_count_rows(op, aw, bw)))
+        assert count == ref(a, b).count()
+
+    def test_intersection_count_parity(self):
+        rng = np.random.default_rng(9)
+        a, b = (rand_bitmap(rng, 20000, SLICE_WIDTH) for _ in range(2))
+        aw = packed.pack_bitmap(a, packed.WORDS_PER_SLICE)
+        bw = packed.pack_bitmap(b, packed.WORDS_PER_SLICE)
+        assert int(np.asarray(kernels.op_count_rows("and", aw, bw))) \
+            == a.intersection_count(b)
+
+    def test_row_block_and_topk(self):
+        rng = np.random.default_rng(3)
+        n_rows = 50
+        storage = Bitmap.from_sorted(np.sort(rng.choice(
+            n_rows * SLICE_WIDTH, size=100000, replace=False)
+            .astype(np.uint64)))
+        rows = packed.pack_rows(storage, range(n_rows))
+        other = rand_bitmap(rng, 30000, SLICE_WIDTH)
+        ow = packed.pack_bitmap(other, packed.WORDS_PER_SLICE)
+        counts = np.asarray(kernels.row_block_op_count("and", rows, ow))
+        # parity vs host roaring per row
+        for r in range(0, n_rows, 7):
+            row_bm = storage.offset_range(0, r * SLICE_WIDTH,
+                                          (r + 1) * SLICE_WIDTH)
+            assert counts[r] == row_bm.intersection_count(other)
+        vals, idx = kernels.top_k_rows(
+            np.asarray(counts, dtype=np.int32), 5)
+        order = np.argsort(-counts, kind="stable")
+        assert list(np.asarray(vals)) == list(counts[order[:5]])
+
+    def test_popcount_rows(self):
+        rng = np.random.default_rng(4)
+        b = rand_bitmap(rng, 12345, SLICE_WIDTH)
+        w = packed.pack_bitmap(b, packed.WORDS_PER_SLICE)
+        assert int(np.asarray(kernels.popcount_rows(w))) == b.count()
+        m = np.stack([w, np.zeros_like(w)])
+        assert list(np.asarray(kernels.popcount_rows(m))) == [b.count(), 0]
+
+    def test_union_rows_fold(self):
+        rng = np.random.default_rng(5)
+        bms = [rand_bitmap(rng, 1000, SLICE_WIDTH) for _ in range(4)]
+        rows = np.stack([packed.pack_bitmap(b, packed.WORDS_PER_SLICE)
+                         for b in bms])
+        got = np.asarray(kernels.union_rows(rows))
+        want = bms[0]
+        for b in bms[1:]:
+            want = want.union(b)
+        assert np.array_equal(got, packed.pack_bitmap(
+            want, packed.WORDS_PER_SLICE))
+
+
+class TestPallas:
+    """Pallas kernels run in interpret mode off-TPU; parity vs XLA path."""
+
+    @pytest.mark.parametrize("op", kernels.OPS)
+    def test_pallas_count_parity(self, op):
+        rng = np.random.default_rng(11)
+        a = rng.integers(0, 1 << 32, (17, 5000), dtype=np.uint32)
+        b = rng.integers(0, 1 << 32, (17, 5000), dtype=np.uint32)
+        got = np.asarray(pallas_kernels.op_count_rows_pallas(
+            op, a, b, interpret=True))
+        want = np.asarray(kernels.op_count_rows(op, a, b))
+        assert np.array_equal(got, want)
+
+    def test_pallas_1d(self):
+        rng = np.random.default_rng(12)
+        a = rng.integers(0, 1 << 32, 4096, dtype=np.uint32)
+        b = rng.integers(0, 1 << 32, 4096, dtype=np.uint32)
+        got = int(np.asarray(pallas_kernels.op_count_rows_pallas(
+            "and", a, b, interpret=True)))
+        assert got == int(np.bitwise_count(a & b).sum())
+
+
+class TestCountTotal:
+    def test_no_int32_overflow(self):
+        # >2^31 total bits must not wrap (code-review regression).
+        a = np.full((70000 // 8, 8 * 1024), 0xFFFFFFFF, dtype=np.uint32)
+        total = kernels.op_count_total("or", a, a)
+        assert total == a.size * 32
