@@ -1,0 +1,84 @@
+"""HBM working-set manager: device residency for hot fragment rows.
+
+The reference mutates mmap'd bitmaps in place; device arrays are immutable
+and HBM is smaller than the on-disk index, so device copies are an explicit
+cache: rows are packed (pilosa_tpu.ops.packed) and pinned on device on first
+use, invalidated by writes, and evicted LRU under a row budget. The rank
+cache already identifies the hot rows (TopN candidates), so the TopN row
+*block* — a stacked u32 matrix — is cached as a unit keyed by (row ids,
+write generation).
+
+One manager exists per fragment (pilosa_tpu.storage.fragment.Fragment).
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .. import SLICE_WIDTH
+from ..ops import packed
+
+# Default HBM budget per fragment, in rows (256 rows × 128 KB = 32 MB).
+DEFAULT_MAX_ROWS = 256
+
+
+class DeviceRowCache:
+    def __init__(self, max_rows: int = DEFAULT_MAX_ROWS):
+        self.max_rows = max_rows
+        self._rows: OrderedDict[int, jax.Array] = OrderedDict()
+        # Write generation: bumped on every invalidation so cached row
+        # blocks (keyed by ids+generation) go stale automatically.
+        self.generation = 0
+        self._block_key: Optional[tuple] = None
+        self._block: Optional[jax.Array] = None
+
+    # -- single rows
+
+    def row_words(self, storage, row_id: int) -> jax.Array:
+        """Device words for one row; packs and pins on miss.
+
+        ``storage`` is the fragment-local roaring bitmap
+        (pos = row*SLICE_WIDTH + col).
+        """
+        arr = self._rows.get(row_id)
+        if arr is not None:
+            self._rows.move_to_end(row_id)
+            return arr
+        row_bm = storage.offset_range(0, row_id * SLICE_WIDTH,
+                                      (row_id + 1) * SLICE_WIDTH)
+        words = packed.pack_bitmap(row_bm, packed.WORDS_PER_SLICE)
+        arr = jax.device_put(words)
+        self._rows[row_id] = arr
+        while len(self._rows) > self.max_rows:
+            self._rows.popitem(last=False)
+        return arr
+
+    def invalidate_row(self, row_id: int) -> None:
+        self._rows.pop(row_id, None)
+        self.generation += 1
+
+    def invalidate_all(self) -> None:
+        self._rows.clear()
+        self._block_key = None
+        self._block = None
+        self.generation += 1
+
+    # -- row blocks (TopN candidates)
+
+    def block(self, storage, row_ids: tuple[int, ...]) -> jax.Array:
+        """Stacked u32[n, 32768] device matrix for the given rows, cached by
+        (ids, generation)."""
+        key = (row_ids, self.generation)
+        if self._block_key == key:
+            return self._block
+        matrix = packed.pack_rows(storage, row_ids)
+        self._block = jax.device_put(matrix)
+        self._block_key = key
+        return self._block
+
+    def resident_rows(self) -> list[int]:
+        return list(self._rows)

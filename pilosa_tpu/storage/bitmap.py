@@ -1,0 +1,182 @@
+"""Query-result Bitmap: a cluster-wide bitmap as sorted per-slice segments.
+
+Reference: bitmap.go. A query result spans many slices; each segment wraps a
+roaring bitmap of absolute column positions for one slice and stays sharded —
+ops zip two segment lists by slice, and the final bit-list is only
+materialized on demand (JSON encoding or .bits()).
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Iterable, Optional
+
+import numpy as np
+
+from .. import SLICE_WIDTH
+from . import roaring
+
+
+class BitmapSegment:
+    """One slice's worth of a result bitmap (reference bitmap.go:296-392).
+
+    ``writable=False`` marks data shared with mmap'd storage; mutation
+    copies first (the roaring containers carry their own mapped flags, so
+    this is enforced at container granularity).
+    """
+
+    __slots__ = ("data", "slice", "writable", "_n")
+
+    def __init__(self, data: roaring.Bitmap, slice: int, writable: bool):
+        self.data = data
+        self.slice = slice
+        self.writable = writable
+        self._n: Optional[int] = None
+
+    def count(self) -> int:
+        if self._n is None:
+            self._n = self.data.count()
+        return self._n
+
+    def set_bit(self, col: int) -> bool:
+        changed = self.data.add(col)
+        if changed and self._n is not None:
+            self._n += 1
+        return changed
+
+    def clear_bit(self, col: int) -> bool:
+        changed = self.data.remove(col)
+        if changed and self._n is not None:
+            self._n -= 1
+        return changed
+
+    def _binary(self, other: "BitmapSegment", fn) -> "BitmapSegment":
+        return BitmapSegment(fn(self.data, other.data), self.slice, True)
+
+    def intersect(self, o):
+        return self._binary(o, lambda a, b: a.intersect(b))
+
+    def union(self, o):
+        return self._binary(o, lambda a, b: a.union(b))
+
+    def difference(self, o):
+        return self._binary(o, lambda a, b: a.difference(b))
+
+    def intersection_count(self, o) -> int:
+        return self.data.intersection_count(o.data)
+
+
+def _zip_segments(a: list[BitmapSegment], b: list[BitmapSegment]):
+    """Merge-iterate two slice-sorted segment lists
+    (reference bitmap.go:394-437)."""
+    i = j = 0
+    while i < len(a) or j < len(b):
+        if j >= len(b) or (i < len(a) and a[i].slice < b[j].slice):
+            yield a[i], None
+            i += 1
+        elif i >= len(a) or b[j].slice < a[i].slice:
+            yield None, b[j]
+            j += 1
+        else:
+            yield a[i], b[j]
+            i += 1
+            j += 1
+
+
+class Bitmap:
+    """Segmented result bitmap with attached row attributes."""
+
+    def __init__(self, *bits: int):
+        self.segments: list[BitmapSegment] = []
+        self.attrs: dict = {}
+        for v in bits:
+            self.set_bit(v)
+
+    # -- segment management
+
+    def _segment(self, slice: int, create: bool) -> Optional[BitmapSegment]:
+        i = bisect.bisect_left([s.slice for s in self.segments], slice)
+        if i < len(self.segments) and self.segments[i].slice == slice:
+            return self.segments[i]
+        if not create:
+            return None
+        seg = BitmapSegment(roaring.Bitmap(), slice, True)
+        self.segments.insert(i, seg)
+        return seg
+
+    def add_segment(self, data: roaring.Bitmap, slice: int,
+                    writable: bool = False) -> None:
+        i = bisect.bisect_left([s.slice for s in self.segments], slice)
+        self.segments.insert(i, BitmapSegment(data, slice, writable))
+
+    # -- point ops
+
+    def set_bit(self, col: int) -> bool:
+        return self._segment(col // SLICE_WIDTH, True).set_bit(col)
+
+    def clear_bit(self, col: int) -> bool:
+        seg = self._segment(col // SLICE_WIDTH, False)
+        return seg.clear_bit(col) if seg else False
+
+    # -- set algebra (zip by slice)
+
+    def intersect(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap()
+        for s0, s1 in _zip_segments(self.segments, other.segments):
+            if s0 is not None and s1 is not None:
+                out.segments.append(s0.intersect(s1))
+        return out
+
+    def union(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap()
+        for s0, s1 in _zip_segments(self.segments, other.segments):
+            if s0 is None:
+                out.segments.append(s1)
+            elif s1 is None:
+                out.segments.append(s0)
+            else:
+                out.segments.append(s0.union(s1))
+        return out
+
+    def difference(self, other: "Bitmap") -> "Bitmap":
+        out = Bitmap()
+        for s0, s1 in _zip_segments(self.segments, other.segments):
+            if s0 is None:
+                continue
+            out.segments.append(s0 if s1 is None else s0.difference(s1))
+        return out
+
+    def intersection_count(self, other: "Bitmap") -> int:
+        n = 0
+        for s0, s1 in _zip_segments(self.segments, other.segments):
+            if s0 is not None and s1 is not None:
+                n += s0.intersection_count(s1)
+        return n
+
+    def merge(self, other: "Bitmap") -> None:
+        """In-place union used by the map-reduce bitmap reducer."""
+        merged = self.union(other)
+        self.segments = merged.segments
+
+    # -- access
+
+    def count(self) -> int:
+        return sum(s.count() for s in self.segments)
+
+    def bits(self) -> np.ndarray:
+        """All absolute column positions, sorted, u64."""
+        parts = [s.data.values() for s in self.segments]
+        parts = [p for p in parts if len(p)]
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    def to_json(self) -> dict:
+        return {"attrs": self.attrs, "bits": [int(b) for b in self.bits()]}
+
+
+def union_all(bitmaps: Iterable[Bitmap]) -> Bitmap:
+    out = Bitmap()
+    for b in bitmaps:
+        out.merge(b)
+    return out

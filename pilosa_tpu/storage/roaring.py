@@ -385,7 +385,8 @@ class Bitmap:
         self.keys: list[int] = []
         self.containers: list[Container] = []
         self.op_writer = None
-        self.op_n = 0  # ops appended/replayed since last snapshot
+        self.op_n = 0      # ops appended/replayed since last snapshot
+        self.torn_bytes = 0  # dangling tail bytes found during unmarshal
         for v in values:
             self._add(v)
 
@@ -503,6 +504,16 @@ class Bitmap:
 
     def count(self) -> int:
         return sum(c.n for c in self.containers)
+
+    def max(self) -> int:
+        """Largest set position, or 0 if empty (reference roaring.go Max)."""
+        for key, c in zip(reversed(self.keys), reversed(self.containers)):
+            if c.n:
+                if c.is_array():
+                    return (key << 16) + int(c.array[-1])
+                w = int(np.flatnonzero(c.bitmap)[-1])
+                return (key << 16) + w * 64 + int(c.bitmap[w]).bit_length() - 1
+        return 0
 
     def count_range(self, start: int, end: int) -> int:
         """Set bits in [start, end)."""
@@ -721,11 +732,18 @@ class Bitmap:
         return buf.getvalue()
 
     @staticmethod
-    def unmarshal(data, mapped: bool = False) -> "Bitmap":
+    def unmarshal(data, mapped: bool = False,
+                  tolerate_torn_tail: bool = False) -> "Bitmap":
         """Decode a snapshot (+trailing op-log) from a bytes-like buffer.
 
         With ``mapped=True`` container data are zero-copy views into ``data``
         (e.g. an mmap); they are copy-on-write on first mutation.
+
+        With ``tolerate_torn_tail=True``, a trailing partial op record
+        (< 13 bytes — the signature of a crash mid-append) stops parsing
+        instead of raising; the number of dangling bytes is reported in
+        ``.torn_bytes`` so the caller can truncate the file. A bad checksum
+        on a *complete* record is still corruption and still raises.
         """
         buf = memoryview(data)
         if len(buf) < HEADER_SIZE:
@@ -767,6 +785,9 @@ class Bitmap:
         ops_end = max(ops_offset, end if key_n else HEADER_SIZE)
         rest = buf[ops_end:]
         while len(rest):
+            if tolerate_torn_tail and len(rest) < OP_SIZE:
+                b.torn_bytes = len(rest)
+                break
             op = Op.unmarshal(rest)
             op.apply(b)
             b.op_n += 1

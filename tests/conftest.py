@@ -2,13 +2,21 @@
 
 Tests run on CPU with a virtual 8-device mesh so multi-chip sharding
 (`shard_map` over a `jax.sharding.Mesh`) compiles and executes without TPU
-hardware. Must run before jax is imported anywhere.
+hardware, and so the suite never touches the shared TPU tunnel.
+
+The environment's axon PJRT plugin (sitecustomize) force-selects the axon
+platform via jax.config at register time — which overrides JAX_PLATFORMS —
+so we must override back through jax.config, before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
