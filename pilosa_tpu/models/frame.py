@@ -1,0 +1,254 @@
+"""Frame: a named row-space within an index.
+
+Reference: frame.go. Holds a views map (standard / inverse / time views), a
+row attribute store, and options (rowLabel, inverseEnabled, cacheType,
+cacheSize, timeQuantum) persisted as a protobuf ``.meta`` file
+(frame.go:280-336). SetBit fans out to the standard view plus one view per
+time-quantum unit (frame.go:446-485); the inverse view stores the transpose
+(row/col swapped) so columns are row-addressable.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import os
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..errors import PilosaError
+from ..proto import internal_pb2 as pb
+from ..storage import cache as cache_mod
+from ..storage.attrs import AttrStore
+from ..utils import timequantum as tq
+from ..utils.stats import NOP
+from .view import (VIEW_INVERSE, VIEW_STANDARD, View, is_inverse_view,
+                   is_valid_view)
+
+DEFAULT_ROW_LABEL = "rowID"
+
+
+@dataclass
+class FrameOptions:
+    row_label: str = DEFAULT_ROW_LABEL
+    inverse_enabled: bool = False
+    cache_type: str = cache_mod.DEFAULT_CACHE_TYPE
+    cache_size: int = cache_mod.DEFAULT_CACHE_SIZE
+    time_quantum: str = ""
+
+    def encode(self) -> pb.FrameMeta:
+        return pb.FrameMeta(RowLabel=self.row_label,
+                            InverseEnabled=self.inverse_enabled,
+                            CacheType=self.cache_type,
+                            CacheSize=self.cache_size,
+                            TimeQuantum=self.time_quantum)
+
+    @staticmethod
+    def decode(meta: pb.FrameMeta) -> "FrameOptions":
+        return FrameOptions(row_label=meta.RowLabel or DEFAULT_ROW_LABEL,
+                            inverse_enabled=meta.InverseEnabled,
+                            cache_type=meta.CacheType
+                            or cache_mod.DEFAULT_CACHE_TYPE,
+                            cache_size=meta.CacheSize
+                            or cache_mod.DEFAULT_CACHE_SIZE,
+                            time_quantum=meta.TimeQuantum)
+
+
+class Frame:
+    def __init__(self, path: str, index: str, name: str,
+                 options: Optional[FrameOptions] = None,
+                 on_create_slice=None, stats=NOP):
+        self.path = path
+        self.index = index
+        self.name = name
+        self.options = options or FrameOptions()
+        self.views: dict[str, View] = {}
+        self.row_attr_store = AttrStore(os.path.join(path, "attrs"))
+        self.on_create_slice = on_create_slice
+        self.stats = stats
+        self._mu = threading.RLock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @property
+    def meta_path(self) -> str:
+        return os.path.join(self.path, ".meta")
+
+    def views_path(self) -> str:
+        return os.path.join(self.path, "views")
+
+    def open(self) -> None:
+        with self._mu:
+            os.makedirs(self.views_path(), exist_ok=True)
+            self._load_meta()
+            self._save_meta()
+            self.row_attr_store.open()
+            for entry in sorted(os.listdir(self.views_path())):
+                if not is_valid_view(entry):
+                    continue
+                view = self._new_view(entry)
+                view.open()
+                self.views[entry] = view
+
+    def close(self) -> None:
+        with self._mu:
+            for v in self.views.values():
+                v.close()
+            self.views.clear()
+            self.row_attr_store.close()
+
+    def _load_meta(self) -> None:
+        try:
+            with open(self.meta_path, "rb") as f:
+                self.options = FrameOptions.decode(
+                    pb.FrameMeta.FromString(f.read()))
+        except FileNotFoundError:
+            pass
+
+    def _save_meta(self) -> None:
+        blob = self.options.encode().SerializeToString()
+        tmp = self.meta_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, self.meta_path)
+
+    # -- options accessors ---------------------------------------------------
+
+    @property
+    def row_label(self) -> str:
+        return self.options.row_label
+
+    @property
+    def inverse_enabled(self) -> bool:
+        return self.options.inverse_enabled
+
+    def time_quantum(self) -> str:
+        return self.options.time_quantum
+
+    def set_time_quantum(self, q: str) -> None:
+        with self._mu:
+            self.options.time_quantum = tq.parse_time_quantum(q)
+            self._save_meta()
+
+    # -- views ---------------------------------------------------------------
+
+    def _new_view(self, name: str) -> View:
+        return View(os.path.join(self.views_path(), name), self.index,
+                    self.name, name, cache_type=self.options.cache_type,
+                    cache_size=self.options.cache_size,
+                    row_attr_store=self.row_attr_store,
+                    on_create_slice=self._announce_slice(name),
+                    stats=self.stats.with_tags(f"view:{name}"))
+
+    def _announce_slice(self, view_name: str):
+        if self.on_create_slice is None:
+            return None
+        inverse = is_inverse_view(view_name)
+
+        def announce(slice: int):
+            self.on_create_slice(slice, inverse)
+        return announce
+
+    def view(self, name: str) -> Optional[View]:
+        return self.views.get(name)
+
+    def create_view_if_not_exists(self, name: str) -> View:
+        with self._mu:
+            if not self.inverse_enabled and is_inverse_view(name):
+                raise PilosaError("inverse views not enabled for frame")
+            v = self.views.get(name)
+            if v is None:
+                v = self._new_view(name)
+                v.open()
+                self.views[name] = v
+            return v
+
+    def max_slice(self) -> int:
+        v = self.views.get(VIEW_STANDARD)
+        return v.max_slice() if v else 0
+
+    def max_inverse_slice(self) -> int:
+        v = self.views.get(VIEW_INVERSE)
+        return v.max_slice() if v else 0
+
+    # -- bit ops (frame.go:446-527) ------------------------------------------
+
+    def set_bit(self, view_name: str, row_id: int, col_id: int,
+                t: Optional[dt.datetime] = None) -> bool:
+        return self._mutate(view_name, row_id, col_id, t, set=True)
+
+    def clear_bit(self, view_name: str, row_id: int, col_id: int,
+                  t: Optional[dt.datetime] = None) -> bool:
+        return self._mutate(view_name, row_id, col_id, t, set=False)
+
+    def _mutate(self, view_name: str, row_id: int, col_id: int,
+                t: Optional[dt.datetime], set: bool) -> bool:
+        if not is_valid_view(view_name):
+            raise PilosaError(f"invalid view: {view_name!r}")
+        changed = False
+        view = self.create_view_if_not_exists(view_name)
+        op = view.set_bit if set else view.clear_bit
+        if op(row_id, col_id):
+            changed = True
+        if t is None:
+            return changed
+        for subname in tq.views_by_time(view_name, t, self.time_quantum()):
+            sub = self.create_view_if_not_exists(subname)
+            op = sub.set_bit if set else sub.clear_bit
+            if op(row_id, col_id):
+                changed = True
+        return changed
+
+    # -- bulk import (frame.go:530-606) --------------------------------------
+
+    def import_bits(self, row_ids, column_ids, timestamps=None) -> None:
+        """Group bits by (view, slice) — including time views and the
+        inverse transpose — then bulk-import each fragment."""
+        from .. import SLICE_WIDTH
+        rows = np.asarray(row_ids, dtype=np.uint64)
+        cols = np.asarray(column_ids, dtype=np.uint64)
+        if len(rows) != len(cols):
+            raise ValueError("row/column length mismatch")
+        if timestamps is None:
+            timestamps = [None] * len(rows)
+        else:
+            timestamps = list(timestamps)
+        if len(timestamps) != len(rows):
+            raise ValueError("timestamp length mismatch")
+
+        q = self.time_quantum()
+        # data[(view, slice)] = ([rows], [cols])
+        data: dict[tuple[str, int], tuple[list, list]] = {}
+
+        def put(view_name, rid, cid):
+            slice = cid // SLICE_WIDTH
+            key = (view_name, slice)
+            if key not in data:
+                data[key] = ([], [])
+            data[key][0].append(rid)
+            data[key][1].append(cid)
+
+        for rid, cid, ts in zip(rows.tolist(), cols.tolist(), timestamps):
+            if ts is None:
+                standard = [VIEW_STANDARD]
+            else:
+                standard = tq.views_by_time(VIEW_STANDARD, ts, q)
+                standard.append(VIEW_STANDARD)
+            for vn in standard:
+                put(vn, rid, cid)
+            if self.inverse_enabled:
+                if ts is None:
+                    inverse = [VIEW_INVERSE]
+                else:
+                    inverse = tq.views_by_time(VIEW_INVERSE, ts, q)
+                    inverse.append(VIEW_INVERSE)
+                for vn in inverse:
+                    put(vn, cid, rid)  # transpose
+
+        for (view_name, slice), (rids, cids) in sorted(data.items()):
+            view = self.create_view_if_not_exists(view_name)
+            frag = view.create_fragment_if_not_exists(slice)
+            frag.import_bits(np.array(rids, dtype=np.uint64),
+                             np.array(cids, dtype=np.uint64))

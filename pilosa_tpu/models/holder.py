@@ -1,0 +1,150 @@
+"""Holder: root container for all indexes under one data directory.
+
+Reference: holder.go. Scans the data dir on open, exposes
+Index/Frame/View/Fragment navigation (holder.go:177-322), the schema
+summary (holder.go:154-171), and cache flushing (the server runtime runs
+the 1-minute flush loop; holder.go:324-358).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Optional
+
+from ..errors import IndexExistsError, validate_name
+from ..utils.stats import NOP
+from .index import Index, IndexOptions
+
+
+class Holder:
+    def __init__(self, path: str, on_create_slice=None, stats=NOP):
+        self.path = path
+        self.indexes: dict[str, Index] = {}
+        self.on_create_slice = on_create_slice  # fn(index, slice, inverse)
+        self.stats = stats
+        self._mu = threading.RLock()
+
+    # -- lifecycle
+
+    def open(self) -> None:
+        with self._mu:
+            os.makedirs(self.path, exist_ok=True)
+            for entry in sorted(os.listdir(self.path)):
+                full = os.path.join(self.path, entry)
+                if not os.path.isdir(full):
+                    continue
+                try:
+                    validate_name(entry)
+                except Exception:
+                    continue
+                idx = self._new_index(entry, IndexOptions())
+                idx.open()
+                self.indexes[entry] = idx
+            self.stats.gauge("indexN", len(self.indexes))
+
+    def close(self) -> None:
+        with self._mu:
+            for idx in self.indexes.values():
+                idx.close()
+            self.indexes.clear()
+
+    # -- index CRUD
+
+    def index_path(self, name: str) -> str:
+        return os.path.join(self.path, name)
+
+    def _new_index(self, name: str, options: IndexOptions) -> Index:
+        announce = None
+        if self.on_create_slice is not None:
+            holder = self
+
+            def announce(slice, inverse, _name=name):
+                holder.on_create_slice(_name, slice, inverse)
+        return Index(self.index_path(name), name, options=options,
+                     on_create_slice=announce,
+                     stats=self.stats.with_tags(f"index:{name}"))
+
+    def index(self, name: str) -> Optional[Index]:
+        return self.indexes.get(name)
+
+    def create_index(self, name: str,
+                     options: Optional[IndexOptions] = None) -> Index:
+        with self._mu:
+            if name in self.indexes:
+                raise IndexExistsError(name)
+            return self._create_index(name, options)
+
+    def create_index_if_not_exists(self, name: str,
+                                   options: Optional[IndexOptions] = None
+                                   ) -> Index:
+        with self._mu:
+            idx = self.indexes.get(name)
+            if idx is not None:
+                return idx
+            return self._create_index(name, options)
+
+    def _create_index(self, name: str, options) -> Index:
+        validate_name(name)
+        idx = self._new_index(name, options or IndexOptions())
+        idx.open()
+        self.indexes[name] = idx
+        self.stats.count("indexN", 1)
+        return idx
+
+    def delete_index(self, name: str) -> None:
+        with self._mu:
+            idx = self.indexes.pop(name, None)
+            if idx is not None:
+                idx.close()
+            shutil.rmtree(self.index_path(name), ignore_errors=True)
+
+    # -- navigation (holder.go:177-322)
+
+    def frame(self, index: str, name: str):
+        idx = self.index(index)
+        return idx.frame(name) if idx else None
+
+    def view(self, index: str, frame: str, name: str):
+        f = self.frame(index, frame)
+        return f.view(name) if f else None
+
+    def fragment(self, index: str, frame: str, view: str, slice: int):
+        v = self.view(index, frame, view)
+        return v.fragment(slice) if v else None
+
+    # -- schema (holder.go:154-171)
+
+    def schema(self) -> list[dict]:
+        with self._mu:
+            out = []
+            for name in sorted(self.indexes):
+                idx = self.indexes[name]
+                frames = []
+                for fname in sorted(idx.frames):
+                    frame = idx.frames[fname]
+                    frames.append({
+                        "name": fname,
+                        "views": [{"name": vn}
+                                  for vn in sorted(frame.views)],
+                    })
+                out.append({"name": name, "frames": frames})
+            return out
+
+    def max_slices(self) -> dict[str, int]:
+        return {name: idx.max_slice()
+                for name, idx in self.indexes.items()}
+
+    def max_inverse_slices(self) -> dict[str, int]:
+        return {name: idx.max_inverse_slice()
+                for name, idx in self.indexes.items()}
+
+    def flush_caches(self) -> None:
+        """Flush all fragment TopN caches (holder.go:324-358)."""
+        with self._mu:
+            for idx in self.indexes.values():
+                for frame in idx.frames.values():
+                    for view in frame.views.values():
+                        for frag in view.fragments.values():
+                            frag.flush_cache()

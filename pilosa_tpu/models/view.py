@@ -1,0 +1,120 @@
+"""View: container of fragments by slice for one orientation/time granularity.
+
+Reference: view.go. Names: ``standard``, ``inverse``, plus time-suffixed
+variants (``standard_2017``, ``standard_201701``, ...). Directory layout
+``<frame>/views/<name>/fragments/<slice>`` (view.go:186-189). Creating a
+fragment for a new max slice notifies the cluster via the on_create_slice
+hook (view.go:219-254 broadcasts CreateSliceMessage).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+from .. import SLICE_WIDTH
+from ..storage import cache as cache_mod
+from ..storage.fragment import Fragment
+from ..utils.stats import NOP
+
+VIEW_STANDARD = "standard"
+VIEW_INVERSE = "inverse"
+
+
+def is_inverse_view(name: str) -> bool:
+    return name.startswith(VIEW_INVERSE)
+
+
+def is_valid_view(name: str) -> bool:
+    return name.startswith(VIEW_STANDARD) or name.startswith(VIEW_INVERSE)
+
+
+class View:
+    def __init__(self, path: str, index: str, frame: str, name: str,
+                 cache_type: str = cache_mod.DEFAULT_CACHE_TYPE,
+                 cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
+                 row_attr_store=None,
+                 on_create_slice: Optional[Callable[[int], None]] = None,
+                 stats=NOP):
+        self.path = path
+        self.index = index
+        self.frame = frame
+        self.name = name
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.row_attr_store = row_attr_store
+        self.on_create_slice = on_create_slice
+        self.stats = stats
+        self.fragments: dict[int, Fragment] = {}
+        self._max_slice = 0
+        self._mu = threading.RLock()
+
+    # -- lifecycle
+
+    @property
+    def fragments_path(self) -> str:
+        return os.path.join(self.path, "fragments")
+
+    def fragment_path(self, slice: int) -> str:
+        return os.path.join(self.fragments_path, str(slice))
+
+    def open(self) -> None:
+        with self._mu:
+            os.makedirs(self.fragments_path, exist_ok=True)
+            for entry in sorted(os.listdir(self.fragments_path)):
+                if not entry.isdigit():
+                    continue
+                slice = int(entry)
+                frag = self._new_fragment(slice)
+                frag.open()
+                self.fragments[slice] = frag
+            self._max_slice = max(self.fragments, default=0)
+
+    def close(self) -> None:
+        with self._mu:
+            for frag in self.fragments.values():
+                frag.close()
+            self.fragments.clear()
+
+    def _new_fragment(self, slice: int) -> Fragment:
+        return Fragment(self.fragment_path(slice), self.index, self.frame,
+                        self.name, slice, cache_type=self.cache_type,
+                        cache_size=self.cache_size,
+                        row_attr_store=self.row_attr_store,
+                        stats=self.stats.with_tags(f"slice:{slice}"))
+
+    # -- fragments
+
+    def fragment(self, slice: int) -> Optional[Fragment]:
+        return self.fragments.get(slice)
+
+    def create_fragment_if_not_exists(self, slice: int) -> Fragment:
+        with self._mu:
+            frag = self.fragments.get(slice)
+            if frag is not None:
+                return frag
+            frag = self._new_fragment(slice)
+            frag.open()
+            # Announce only when the max slice grows (view.go:232-246).
+            if slice > self._max_slice:
+                self._max_slice = slice
+                if self.on_create_slice is not None:
+                    self.on_create_slice(slice)
+            self.fragments[slice] = frag
+            self.stats.count("maxSlice", 1)
+            return frag
+
+    def max_slice(self) -> int:
+        with self._mu:
+            return max(self._max_slice, max(self.fragments, default=0))
+
+    # -- bit ops (route column → slice; view.go:265-283)
+
+    def set_bit(self, row_id: int, column_id: int) -> bool:
+        frag = self.create_fragment_if_not_exists(column_id // SLICE_WIDTH)
+        return frag.set_bit(row_id, column_id)
+
+    def clear_bit(self, row_id: int, column_id: int) -> bool:
+        frag = self.create_fragment_if_not_exists(column_id // SLICE_WIDTH)
+        return frag.clear_bit(row_id, column_id)

@@ -1,0 +1,118 @@
+"""PQL AST: Query = list of Calls; Call = name + args + children.
+
+Reference: pql/ast.go. ``Call.__str__`` produces the canonical
+re-serialization (sorted arg keys, Go-style literal formatting) that is the
+wire form used to forward queries to peer nodes (executor.go:1004), so its
+output must round-trip through the parser.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+from typing import Any, Optional
+
+from ..errors import TIME_FORMAT
+
+
+def _fmt_value(v: Any) -> str:
+    if isinstance(v, str):
+        return _quote(v)
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, dt.datetime):
+        return f'"{v.strftime(TIME_FORMAT)}"'
+    if isinstance(v, (list, tuple)):
+        return "[" + ",".join(_fmt_value(x) for x in v) + "]"
+    return str(v)
+
+
+def _quote(s: str) -> str:
+    out = s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    return f'"{out}"'
+
+
+class Call:
+    def __init__(self, name: str = "",
+                 args: Optional[dict[str, Any]] = None,
+                 children: Optional[list["Call"]] = None):
+        self.name = name
+        self.args: dict[str, Any] = args or {}
+        self.children: list[Call] = children or []
+
+    # -- arg helpers (ast.go:52-89)
+
+    def uint_arg(self, key: str) -> tuple[int, bool]:
+        """(value, found); raises on a non-integer value."""
+        if key not in self.args:
+            return 0, False
+        v = self.args[key]
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise ValueError(
+                f"could not convert {v!r} to uint in Call.uint_arg")
+        return v & 0xFFFFFFFFFFFFFFFF, True
+
+    def uint_slice_arg(self, key: str) -> tuple[list[int], bool]:
+        if key not in self.args:
+            return [], False
+        v = self.args[key]
+        if not isinstance(v, (list, tuple)) or not all(
+                isinstance(x, int) and not isinstance(x, bool) for x in v):
+            raise ValueError(
+                f"unexpected type in Call.uint_slice_arg: {v!r}")
+        return [x & 0xFFFFFFFFFFFFFFFF for x in v], True
+
+    def keys(self) -> list[str]:
+        return sorted(self.args)
+
+    def clone(self) -> "Call":
+        return Call(self.name, dict(self.args),
+                    [c.clone() for c in self.children])
+
+    # -- inverse detection (ast.go:174-195)
+
+    def supports_inverse(self) -> bool:
+        return self.name == "Bitmap"
+
+    def is_inverse(self, row_label: str, column_label: str) -> bool:
+        if not self.supports_inverse():
+            return False
+        try:
+            _, row_ok = self.uint_arg(row_label)
+            _, col_ok = self.uint_arg(column_label)
+        except ValueError:
+            return False
+        return not row_ok and col_ok
+
+    # -- canonical serialization (ast.go:121-171)
+
+    def __str__(self) -> str:
+        parts = [c.__str__() for c in self.children]
+        parts += [f"{k}={_fmt_value(self.args[k])}" for k in self.keys()]
+        return f"{self.name or '!UNNAMED'}({', '.join(parts)})"
+
+    def __repr__(self):
+        return f"Call({self.__str__()})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Call) and self.name == other.name
+                and self.args == other.args
+                and self.children == other.children)
+
+
+class Query:
+    def __init__(self, calls: Optional[list[Call]] = None):
+        self.calls: list[Call] = calls or []
+
+    def write_calls(self) -> list[Call]:
+        """Calls that mutate state (ast.go WriteCalls)."""
+        return [c for c in self.calls
+                if c.name in ("SetBit", "ClearBit", "SetRowAttrs",
+                              "SetColumnAttrs")]
+
+    def __str__(self) -> str:
+        return "\n".join(str(c) for c in self.calls)
+
+    def __eq__(self, other):
+        return isinstance(other, Query) and self.calls == other.calls

@@ -1,0 +1,326 @@
+"""PQL lexer + recursive-descent parser.
+
+Reference: pql/scanner.go (token rules) and pql/parser.go (grammar):
+
+    query    := call*
+    call     := IDENT '(' children? args? ')'
+    children := call (',' call)*         # children come before args
+    args     := key '=' value (',' ...)  # keys unique
+    value    := IDENT(true|false|null|other) | STRING | INTEGER | FLOAT | list
+    list     := '[' value (',' value)* ']'
+
+Token rules match the reference scanner exactly: idents start with a letter
+and continue with [A-Za-z0-9_\\-.]; numbers allow one leading '-' and one
+'.'; strings are single- or double-quoted with \\n, \\\\, \\", \\' escapes.
+"""
+
+from __future__ import annotations
+
+from ..errors import PilosaError
+from .ast import Call, Query
+
+EOF = "EOF"
+WS = "WS"
+IDENT = "IDENT"
+STRING = "STRING"
+BADSTRING = "BADSTRING"
+INTEGER = "INTEGER"
+FLOAT = "FLOAT"
+EQ = "EQ"
+COMMA = "COMMA"
+LPAREN = "LPAREN"
+RPAREN = "RPAREN"
+LBRACK = "LBRACK"
+RBRACK = "RBRACK"
+ILLEGAL = "ILLEGAL"
+
+
+class ParseError(PilosaError):
+    def __init__(self, pos, message):
+        self.pos = pos
+        super().__init__(f"{message} occurred at line {pos[0]}, char {pos[1]}")
+
+
+def _is_letter(ch):
+    return "a" <= ch <= "z" or "A" <= ch <= "Z"
+
+
+def _is_digit(ch):
+    return "0" <= ch <= "9"
+
+
+def _is_ident_char(ch):
+    return _is_letter(ch) or _is_digit(ch) or ch in "_-."
+
+
+class Scanner:
+    def __init__(self, text: str):
+        self._s = text
+        self._i = 0
+        self._line = 0
+        self._char = 0
+
+    def _read(self) -> str:
+        if self._i >= len(self._s):
+            self._i += 1
+            return ""
+        ch = self._s[self._i]
+        self._i += 1
+        if ch == "\n":
+            self._line += 1
+            self._char = 0
+        else:
+            self._char += 1
+        return ch
+
+    def _unread(self):
+        self._i -= 1
+        if 0 <= self._i < len(self._s) and self._s[self._i] == "\n":
+            self._line -= 1
+        else:
+            self._char -= 1
+
+    def scan(self):
+        pos = (self._line, self._char)
+        ch = self._read()
+        if ch == "":
+            return EOF, pos, ""
+        if ch.isspace():
+            self._unread()
+            return self._scan_whitespace()
+        if _is_letter(ch):
+            self._unread()
+            return self._scan_ident()
+        if _is_digit(ch) or ch == "-":
+            self._unread()
+            return self._scan_number()
+        if ch in "\"'":
+            self._unread()
+            return self._scan_string()
+        simple = {"=": EQ, ",": COMMA, "(": LPAREN, ")": RPAREN,
+                  "[": LBRACK, "]": RBRACK}
+        return simple.get(ch, ILLEGAL), pos, ch
+
+    def _scan_whitespace(self):
+        pos = (self._line, self._char)
+        buf = []
+        while True:
+            ch = self._read()
+            if ch == "" or not ch.isspace():
+                if ch != "":
+                    self._unread()
+                break
+            buf.append(ch)
+        return WS, pos, "".join(buf)
+
+    def _scan_ident(self):
+        pos = (self._line, self._char)
+        buf = []
+        while True:
+            ch = self._read()
+            if ch == "" or not _is_ident_char(ch):
+                if ch != "":
+                    self._unread()
+                break
+            buf.append(ch)
+        return IDENT, pos, "".join(buf)
+
+    def _scan_number(self):
+        pos = (self._line, self._char)
+        tok = INTEGER
+        buf = []
+        first = True
+        seen_dot = False
+        while True:
+            ch = self._read()
+            if not (_is_digit(ch) or (first and ch == "-")
+                    or (not seen_dot and ch == ".")):
+                if ch != "":
+                    self._unread()
+                break
+            if ch == ".":
+                seen_dot = True
+                tok = FLOAT
+            buf.append(ch)
+            first = False
+        return tok, pos, "".join(buf)
+
+    def _scan_string(self):
+        pos = (self._line, self._char)
+        ending = self._read()
+        buf = []
+        while True:
+            ch = self._read()
+            if ch == ending:
+                break
+            if ch in ("\n", ""):
+                return BADSTRING, pos, "".join(buf)
+            if ch == "\\":
+                nxt = self._read()
+                if nxt == "n":
+                    buf.append("\n")
+                elif nxt in ("\\", '"', "'"):
+                    buf.append(nxt)
+                else:
+                    return BADSTRING, pos, "".join(buf)
+            else:
+                buf.append(ch)
+        return STRING, pos, "".join(buf)
+
+
+class Parser:
+    """Recursive-descent parser with an unread token buffer
+    (reference scanner.go:216-263 uses an 8-token ring; a list works)."""
+
+    def __init__(self, text: str):
+        self._scanner = Scanner(text)
+        self._buf: list[tuple] = []   # pushback stack of (tok, pos, lit)
+        self._history: list[tuple] = []
+
+    # -- token stream helpers
+
+    def _scan(self):
+        if self._buf:
+            item = self._buf.pop()
+        else:
+            item = self._scanner.scan()
+        self._history.append(item)
+        return item
+
+    def _unscan(self, n: int = 1):
+        for _ in range(n):
+            self._buf.append(self._history.pop())
+
+    def _scan_skip_ws(self):
+        while True:
+            item = self._scan()
+            if item[0] != WS:
+                return item
+
+    def _unscan_skip_ws(self, n: int = 1):
+        """Unscan n non-WS tokens (plus any WS between them)."""
+        count = 0
+        while count < n:
+            if not self._history:
+                return
+            tok = self._history[-1][0]
+            self._unscan()
+            if tok != WS:
+                count += 1
+
+    # -- grammar
+
+    def parse(self) -> Query:
+        query = Query()
+        while True:
+            tok, pos, lit = self._scan_skip_ws()
+            if tok == EOF:
+                return query
+            if tok != IDENT:
+                raise ParseError(pos, f"expected identifier, found {lit!r}")
+            self._unscan()
+            query.calls.append(self._parse_call())
+
+    def _parse_call(self) -> Call:
+        call = Call()
+        tok, pos, lit = self._scan_skip_ws()
+        if tok != IDENT:
+            raise ParseError(pos, f"expected identifier, found {lit!r}")
+        call.name = lit
+        tok, pos, lit = self._scan_skip_ws()
+        if tok != LPAREN:
+            raise ParseError(pos, f"expected left paren, found {lit!r}")
+        call.children = self._parse_children()
+        call.args = self._parse_args()
+        tok, pos, lit = self._scan_skip_ws()
+        if tok != RPAREN:
+            raise ParseError(pos, f"expected right paren, found {lit!r}")
+        return call
+
+    def _parse_children(self) -> list[Call]:
+        children = []
+        while True:
+            tok, _, _ = self._scan_skip_ws()
+            if tok != IDENT:
+                self._unscan_skip_ws(1)
+                return children
+            tok2, _, _ = self._scan()
+            if tok2 != LPAREN:
+                self._unscan()            # the non-LPAREN token
+                self._unscan_skip_ws(1)   # the IDENT
+                return children
+            self._unscan(2)
+            children.append(self._parse_call())
+            tok, pos, lit = self._scan_skip_ws()
+            if tok == RPAREN:
+                self._unscan()
+                return children
+            if tok != COMMA:
+                raise ParseError(
+                    pos, f"expected comma or right paren, found {lit!r}")
+
+    def _parse_args(self) -> dict:
+        args: dict = {}
+        while True:
+            tok, pos, lit = self._scan_skip_ws()
+            if tok == RPAREN:
+                self._unscan()
+                return args
+            if tok != IDENT:
+                raise ParseError(pos, f"expected argument key, found {lit!r}")
+            key = lit
+            tok, pos, lit = self._scan_skip_ws()
+            if tok != EQ:
+                raise ParseError(pos, f"expected equals sign, found {lit!r}")
+            value = self._parse_value()
+            if key in args:
+                raise ParseError(pos, f"argument key already used: {key}")
+            args[key] = value
+            tok, pos, lit = self._scan_skip_ws()
+            if tok == RPAREN:
+                self._unscan()
+                return args
+            if tok != COMMA:
+                raise ParseError(
+                    pos, f"expected comma or right paren, found {lit!r}")
+
+    def _parse_value(self, in_list: bool = False):
+        tok, pos, lit = self._scan_skip_ws()
+        if tok == IDENT:
+            if lit == "true":
+                return True
+            if lit == "false":
+                return False
+            if lit == "null" and not in_list:
+                return None
+            return lit
+        if tok == STRING:
+            return lit
+        if tok == INTEGER:
+            try:
+                return int(lit)
+            except ValueError:
+                raise ParseError(pos, f"invalid integer: {lit!r}")
+        if tok == FLOAT and not in_list:
+            try:
+                return float(lit)
+            except ValueError:
+                raise ParseError(pos, f"invalid float: {lit!r}")
+        if tok == LBRACK and not in_list:
+            return self._parse_list()
+        kind = "list" if in_list else "argument"
+        raise ParseError(pos, f"invalid {kind} value: {lit!r}")
+
+    def _parse_list(self) -> list:
+        values = []
+        while True:
+            values.append(self._parse_value(in_list=True))
+            tok, pos, lit = self._scan_skip_ws()
+            if tok == RBRACK:
+                return values
+            if tok != COMMA:
+                raise ParseError(pos, f"expected comma, found {lit!r}")
+
+
+def parse(text: str) -> Query:
+    return Parser(text).parse()

@@ -1,0 +1,219 @@
+"""Schema hierarchy + attr store tests (reference index_test.go,
+frame_test.go, view_test.go, holder_test.go, attr_test.go)."""
+
+import datetime as dt
+import os
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.errors import (FrameExistsError, IndexExistsError,
+                               PilosaError)
+from pilosa_tpu.models.frame import Frame, FrameOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.index import Index, IndexOptions
+from pilosa_tpu.models.view import VIEW_INVERSE, VIEW_STANDARD
+from pilosa_tpu.storage.attrs import AttrStore, diff_blocks
+
+
+class TestAttrStore:
+    @pytest.fixture
+    def store(self, tmp_path):
+        s = AttrStore(str(tmp_path / "attrs"))
+        s.open()
+        yield s
+        s.close()
+
+    def test_set_get_merge(self, store):
+        store.set_attrs(1, {"a": "x", "n": 5})
+        store.set_attrs(1, {"b": True, "f": 1.5})
+        assert store.attrs(1) == {"a": "x", "n": 5, "b": True, "f": 1.5}
+        store.set_attrs(1, {"a": None})      # delete key
+        assert store.attrs(1) == {"n": 5, "b": True, "f": 1.5}
+        assert store.attrs(999) == {}
+
+    def test_persistence(self, tmp_path):
+        s = AttrStore(str(tmp_path / "a"))
+        s.open()
+        s.set_attrs(7, {"k": "v"})
+        s.close()
+        s2 = AttrStore(str(tmp_path / "a"))
+        s2.open()
+        assert s2.attrs(7) == {"k": "v"}
+        s2.close()
+
+    def test_bulk_and_blocks(self, store):
+        store.set_bulk_attrs({1: {"x": 1}, 150: {"y": 2}, 101: {"z": 3}})
+        blocks = store.blocks()
+        assert [b[0] for b in blocks] == [0, 1]
+        assert store.block_data(1) == {150: {"y": 2}, 101: {"z": 3}}
+
+    def test_blocks_diff(self, store):
+        store.set_attrs(1, {"a": 1})
+        store.set_attrs(100, {"b": 2})
+        other = AttrStore(store.path + "2")
+        other.open()
+        other.set_attrs(1, {"a": 1})
+        try:
+            ids = diff_blocks(store.blocks(), other.blocks())
+            assert ids == [1]  # block 0 same, block 1 missing in other
+        finally:
+            other.close()
+
+
+class TestFrame:
+    @pytest.fixture
+    def frame(self, tmp_path):
+        f = Frame(str(tmp_path / "i" / "f"), "i", "f")
+        f.open()
+        yield f
+        f.close()
+
+    def test_set_get_bit(self, frame):
+        assert frame.set_bit(VIEW_STANDARD, 3, 10)
+        v = frame.view(VIEW_STANDARD)
+        assert v.fragment(0).row(3).count() == 1
+        assert frame.clear_bit(VIEW_STANDARD, 3, 10)
+
+    def test_meta_persists(self, tmp_path):
+        opts = FrameOptions(row_label="rl", inverse_enabled=True,
+                            cache_type="ranked", cache_size=123,
+                            time_quantum="YM")
+        f = Frame(str(tmp_path / "i" / "f"), "i", "f", options=opts)
+        f.open()
+        f.close()
+        f2 = Frame(str(tmp_path / "i" / "f"), "i", "f")
+        f2.open()
+        try:
+            assert f2.options == opts
+        finally:
+            f2.close()
+
+    def test_time_views_fan_out(self, tmp_path):
+        f = Frame(str(tmp_path / "i" / "f"), "i", "f",
+                  options=FrameOptions(time_quantum="YMDH"))
+        f.open()
+        try:
+            t = dt.datetime(2017, 1, 2, 3)
+            f.set_bit(VIEW_STANDARD, 1, 2, t)
+            names = set(f.views)
+            assert names == {"standard", "standard_2017", "standard_201701",
+                             "standard_20170102", "standard_2017010203"}
+            for n in names:
+                assert f.view(n).fragment(0).row(1).count() == 1
+        finally:
+            f.close()
+
+    def test_inverse_requires_flag(self, frame):
+        with pytest.raises(PilosaError):
+            frame.set_bit(VIEW_INVERSE, 1, 2)
+
+    def test_import_with_inverse_and_time(self, tmp_path):
+        f = Frame(str(tmp_path / "i" / "f"), "i", "f",
+                  options=FrameOptions(inverse_enabled=True,
+                                       time_quantum="Y"))
+        f.open()
+        try:
+            t = dt.datetime(2018, 6, 1)
+            f.import_bits([5], [9], [t])
+            assert f.view("standard").fragment(0).row(5).count() == 1
+            assert f.view("standard_2018").fragment(0).row(5).count() == 1
+            # inverse transposed: row 9, col 5
+            assert list(map(int, f.view("inverse").fragment(0)
+                            .row(9).bits())) == [5]
+        finally:
+            f.close()
+
+    def test_max_slice(self, frame):
+        frame.set_bit(VIEW_STANDARD, 0, 3 * SLICE_WIDTH + 1)
+        assert frame.max_slice() == 3
+
+
+class TestIndex:
+    def test_create_frame_defaults_quantum(self, tmp_path):
+        idx = Index(str(tmp_path / "i"), "i",
+                    options=IndexOptions(time_quantum="YM"))
+        idx.open()
+        try:
+            f = idx.create_frame("f")
+            assert f.time_quantum() == "YM"
+            with pytest.raises(FrameExistsError):
+                idx.create_frame("f")
+        finally:
+            idx.close()
+
+    def test_invalid_names(self, tmp_path):
+        with pytest.raises(PilosaError):
+            Index(str(tmp_path / "X"), "UPPER")
+        idx = Index(str(tmp_path / "i"), "i")
+        idx.open()
+        try:
+            with pytest.raises(PilosaError):
+                idx.create_frame("Bad Name")
+        finally:
+            idx.close()
+
+    def test_remote_max_slice(self, tmp_path):
+        idx = Index(str(tmp_path / "i"), "i")
+        idx.open()
+        try:
+            assert idx.max_slice() == 0
+            idx.set_remote_max_slice(7)
+            assert idx.max_slice() == 7
+        finally:
+            idx.close()
+
+
+class TestHolder:
+    def test_open_scans_and_navigates(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        idx = h.create_index("myidx")
+        f = idx.create_frame("myframe")
+        f.set_bit(VIEW_STANDARD, 1, 2)
+        h.flush_caches()
+        h.close()
+
+        h2 = Holder(str(tmp_path / "data"))
+        h2.open()
+        try:
+            frag = h2.fragment("myidx", "myframe", VIEW_STANDARD, 0)
+            assert frag is not None
+            assert frag.row(1).count() == 1
+            assert h2.schema() == [{
+                "name": "myidx",
+                "frames": [{"name": "myframe",
+                            "views": [{"name": "standard"}]}],
+            }]
+            assert h2.max_slices() == {"myidx": 0}
+        finally:
+            h2.close()
+
+    def test_index_exists(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        try:
+            h.create_index("a")
+            with pytest.raises(IndexExistsError):
+                h.create_index("a")
+            h.delete_index("a")
+            assert h.index("a") is None
+            assert not os.path.exists(h.index_path("a"))
+        finally:
+            h.close()
+
+    def test_create_slice_announcements(self, tmp_path):
+        events = []
+        h = Holder(str(tmp_path / "data"),
+                   on_create_slice=lambda i, s, inv: events.append(
+                       (i, s, inv)))
+        h.open()
+        try:
+            idx = h.create_index("i")
+            f = idx.create_frame("f")
+            f.set_bit(VIEW_STANDARD, 0, 1)              # slice 0: no announce
+            f.set_bit(VIEW_STANDARD, 0, SLICE_WIDTH)    # slice 1: announce
+            assert events == [("i", 1, False)]
+        finally:
+            h.close()

@@ -1,0 +1,123 @@
+"""PQL parser tests (reference pql/parser_test.go cases) plus canonical
+String() round-trip, which the executor relies on for query forwarding."""
+
+import pytest
+
+from pilosa_tpu.pql import parser as pql
+from pilosa_tpu.pql.ast import Call, Query
+
+
+def parse1(s):
+    q = pql.parse(s)
+    assert len(q.calls) == 1
+    return q.calls[0]
+
+
+class TestParser:
+    def test_empty(self):
+        assert pql.parse("").calls == []
+
+    def test_simple_call(self):
+        c = parse1("Bitmap(rowID=1, frame='f')")
+        assert c.name == "Bitmap"
+        assert c.args == {"rowID": 1, "frame": "f"}
+
+    def test_children_before_args(self):
+        c = parse1('Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))')
+        assert c.name == "Count"
+        inner = c.children[0]
+        assert inner.name == "Intersect"
+        assert [ch.args["rowID"] for ch in inner.children] == [1, 2]
+
+    def test_children_and_args(self):
+        c = parse1('TopN(Bitmap(rowID=1), frame="f", n=5)')
+        assert len(c.children) == 1
+        assert c.args == {"frame": "f", "n": 5}
+
+    def test_value_kinds(self):
+        c = parse1('X(a=1, b=-2, c=3.5, d=true, e=false, f=null, '
+                   'g="str", h=bareword, i=[1,2,"x"])')
+        assert c.args == {"a": 1, "b": -2, "c": 3.5, "d": True, "e": False,
+                          "f": None, "g": "str", "h": "bareword",
+                          "i": [1, 2, "x"]}
+
+    def test_ident_with_special_chars(self):
+        c = parse1("Range(frame=f, start=x2010-01)")
+        assert c.args["start"] == "x2010-01"
+
+    def test_string_escapes(self):
+        c = parse1(r'X(a="q\"uote", b=\'sin\ngle\')'.replace("\\'", "'"))
+        assert c.args["a"] == 'q"uote'
+
+    def test_duplicate_key_rejected(self):
+        with pytest.raises(pql.ParseError, match="already used"):
+            pql.parse("X(a=1, a=2)")
+
+    def test_errors(self):
+        for bad in ["X(", "X)", "X(a=)", "X(a", "X(1)", "X(a=1 b=2)"]:
+            with pytest.raises(pql.ParseError):
+                pql.parse(bad)
+
+    def test_multiple_calls(self):
+        q = pql.parse('SetBit(id=1, frame="f", col=2)\n'
+                      'Count(Bitmap(id=1, frame="f"))')
+        assert [c.name for c in q.calls] == ["SetBit", "Count"]
+        assert [c.name for c in q.write_calls()] == ["SetBit"]
+
+
+class TestCanonicalString:
+    @pytest.mark.parametrize("src", [
+        'Bitmap(frame="f", rowID=1)',
+        'Count(Intersect(Bitmap(rowID=1), Bitmap(rowID=2)))',
+        'TopN(Bitmap(rowID=1), field="x", filters=[1,2,"a",true], n=5)',
+        'SetBit(col=3, frame="f", row=1)',
+        'X(neg=-5, pi=3.5, t=true)',
+    ])
+    def test_roundtrip(self, src):
+        q = pql.parse(src)
+        assert str(pql.parse(str(q))) == str(q)
+
+    def test_sorted_keys(self):
+        c = parse1("X(b=2, a=1)")
+        assert str(c) == "X(a=1, b=2)"
+
+    def test_child_and_args_order(self):
+        c = parse1('TopN(Bitmap(rowID=1), n=2, frame="f")')
+        assert str(c) == 'TopN(Bitmap(rowID=1), frame="f", n=2)'
+
+
+class TestCallHelpers:
+    def test_uint_arg(self):
+        c = Call("X", {"n": 5, "s": "x"})
+        assert c.uint_arg("n") == (5, True)
+        assert c.uint_arg("missing") == (0, False)
+        with pytest.raises(ValueError):
+            c.uint_arg("s")
+
+    def test_uint_slice_arg(self):
+        c = Call("X", {"ids": [1, 2, 3]})
+        assert c.uint_slice_arg("ids") == ([1, 2, 3], True)
+        assert c.uint_slice_arg("nope") == ([], False)
+
+    def test_is_inverse(self):
+        c = Call("Bitmap", {"columnID": 3})
+        assert c.is_inverse("rowID", "columnID")
+        c2 = Call("Bitmap", {"rowID": 3})
+        assert not c2.is_inverse("rowID", "columnID")
+        assert not Call("Range", {"columnID": 3}).is_inverse(
+            "rowID", "columnID")
+
+    def test_clone_independent(self):
+        c = parse1("TopN(Bitmap(rowID=1), n=5)")
+        d = c.clone()
+        d.args["n"] = 9
+        d.children[0].args["rowID"] = 2
+        assert c.args["n"] == 5
+        assert c.children[0].args["rowID"] == 1
+
+
+class TestReviewRegressions:
+    def test_malformed_numbers_raise_parse_error(self):
+        for bad in ["f(x=-)", "f(x=-.)", "f(x=[1,-])"]:
+            with pytest.raises(pql.ParseError):
+                pql.parse(bad)
