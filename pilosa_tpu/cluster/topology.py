@@ -1,0 +1,140 @@
+"""Cluster topology: nodes, partitioning, and fragment placement.
+
+Reference: cluster.go. A slice maps to one of PARTITION_N partitions via
+FNV-1a of (index name, big-endian slice id) (cluster.go:198-207); a
+partition maps to its primary owner via jump consistent hash, and to
+REPLICA_N consecutive ring successors (cluster.go:220-240).
+
+The placement function is pure and deterministic — every node computes the
+same owner set with no coordination, which is exactly the property we need
+for the TPU build too: the host-side coordinator uses it to route slices to
+hosts, and within a host the same modular arithmetic lays slices onto the
+device-mesh axis (pilosa_tpu.parallel.mesh).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Optional
+
+DEFAULT_PARTITION_N = 16
+DEFAULT_REPLICA_N = 1
+
+NODE_STATE_UP = "UP"
+NODE_STATE_DOWN = "DOWN"
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_U64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a_64(data: bytes) -> int:
+    h = _FNV_OFFSET
+    for b in data:
+        h ^= b
+        h = (h * _FNV_PRIME) & _U64
+    return h
+
+
+def jump_hash(key: int, n: int) -> int:
+    """Jump consistent hash: key → bucket in [0, n)
+    (cluster.go:266-277, Lamping & Veach)."""
+    b, j = -1, 0
+    key &= _U64
+    while j < n:
+        b = j
+        key = (key * 2862933555777941757 + 1) & _U64
+        j = int(float(b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+    return b
+
+
+@dataclass
+class Node:
+    """One cluster member (cluster.go:39-56)."""
+    host: str
+    internal_host: str = ""
+    state: str = NODE_STATE_UP
+
+    def set_state(self, s: str) -> None:
+        self.state = s
+
+
+def filter_host(nodes: list[Node], host: str) -> list[Node]:
+    return [n for n in nodes if n.host != host]
+
+
+def hosts_of(nodes: list[Node]) -> list[str]:
+    return [n.host for n in nodes]
+
+
+@dataclass
+class Cluster:
+    """Node list + placement math (cluster.go:120-264)."""
+    nodes: list[Node] = field(default_factory=list)
+    partition_n: int = DEFAULT_PARTITION_N
+    replica_n: int = DEFAULT_REPLICA_N
+    node_set: Optional[object] = None  # membership backend (broadcast.py)
+    hasher: object = None              # override for tests
+
+    def node_by_host(self, host: str) -> Optional[Node]:
+        for n in self.nodes:
+            if n.host == host:
+                return n
+        return None
+
+    def _hash(self, key: int, n: int) -> int:
+        if self.hasher is not None:
+            return self.hasher(key, n)
+        return jump_hash(key, n)
+
+    def partition(self, index: str, slice: int) -> int:
+        """Slice → partition by FNV-1a(index ∥ BE64(slice)) mod partition_n
+        (cluster.go:198-207)."""
+        h = fnv1a_64(index.encode() + struct.pack(">Q", slice))
+        return h % self.partition_n
+
+    def partition_nodes(self, partition_id: int) -> list[Node]:
+        """Primary owner by jump hash + replica_n ring successors
+        (cluster.go:220-240)."""
+        if not self.nodes:
+            return []
+        replica_n = min(self.replica_n, len(self.nodes)) or 1
+        i = self._hash(partition_id, len(self.nodes))
+        return [self.nodes[(i + k) % len(self.nodes)]
+                for k in range(replica_n)]
+
+    def fragment_nodes(self, index: str, slice: int) -> list[Node]:
+        return self.partition_nodes(self.partition(index, slice))
+
+    def owns_fragment(self, host: str, index: str, slice: int) -> bool:
+        return any(n.host == host
+                   for n in self.fragment_nodes(index, slice))
+
+    def owns_slices(self, index: str, max_slice: int, host: str
+                    ) -> list[int]:
+        """Slices whose PRIMARY owner is host (cluster.go:243-254)."""
+        out = []
+        for s in range(max_slice + 1):
+            p = self.partition(index, s)
+            if self.nodes[self._hash(p, len(self.nodes))].host == host:
+                out.append(s)
+        return out
+
+    def node_set_hosts(self) -> list[str]:
+        if self.node_set is None:
+            return []
+        return [n.host for n in self.node_set.nodes()]
+
+    def node_states(self) -> dict[str, str]:
+        """UP/DOWN per node, by NodeSet membership (cluster.go:157-169)."""
+        h = {n.host: NODE_STATE_DOWN for n in self.nodes}
+        for host in self.node_set_hosts():
+            if host in h:
+                h[host] = NODE_STATE_UP
+        return h
+
+
+def new_cluster(hosts: list[str], replica_n: int = DEFAULT_REPLICA_N
+                ) -> Cluster:
+    return Cluster(nodes=[Node(h) for h in hosts], replica_n=replica_n)

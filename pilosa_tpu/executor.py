@@ -1,0 +1,605 @@
+"""Executor: per-call PQL dispatch + cluster map-reduce over slices.
+
+Reference: executor.go. Each call type maps/reduces over the slice axis:
+Count sums per-slice counts, TopN merges per-slice pair lists (then
+re-queries exact counts for the candidate ids, executor.go:273-310), bitmap
+expressions fold per slice and the result segments stay sharded. Writes
+route to every replica owner of the target slice and forward to remote
+owners unless the query already carries the Remote flag
+(executor.go:664-797).
+
+Map-reduce (executor.go:1103-1236): slices group by owning node
+(jump-hash placement, cluster.topology), one worker per node; a failed
+node is filtered out and its slices re-mapped onto remaining replicas
+until none are left. Local legs fan out slice-parallel.
+
+TPU-first departure: the per-slice hot work (row materialization, set
+algebra, counts) already runs through the device kernel layer inside
+Fragment; the executor's local fan-out additionally batches whole-index
+Count/TopN onto the device mesh via pilosa_tpu.parallel.mesh when the
+expression shape allows it — same reduction tree, but the slice axis is a
+mesh axis and the reduce is an XLA psum instead of a Python loop.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import threading
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from .cluster.topology import Cluster, Node, new_cluster
+from .errors import (TIME_FORMAT, FrameNotFoundError, IndexNotFoundError,
+                     PilosaError, QueryRequiredError, SliceUnavailableError)
+from .models.view import VIEW_INVERSE, VIEW_STANDARD
+from .pql.ast import Call, Query
+from .pql.parser import parse as parse_pql
+from .storage.bitmap import Bitmap
+from .storage.cache import Pair, pairs_add, pairs_sort
+from .storage.fragment import TopOptions
+from .utils import timequantum as tq
+
+# Frame used when a call does not specify one (executor.go:35).
+DEFAULT_FRAME = "general"
+
+# Lowest count used in a TopN when no threshold is given (executor.go:39).
+MIN_THRESHOLD = 1
+
+_WRITE_CALLS = ("SetBit", "ClearBit", "SetRowAttrs", "SetColumnAttrs")
+
+
+@dataclass
+class ExecOptions:
+    """Remote=True marks a query forwarded by another node: process only
+    local slices and don't re-forward (executor.go:1290-1292)."""
+    remote: bool = False
+
+
+def _needs_slices(calls: list[Call]) -> bool:
+    # executor.go:1273-1289
+    if not calls:
+        return False
+    return any(c.name not in _WRITE_CALLS for c in calls)
+
+
+def _has_only_set_row_attrs(calls: list[Call]) -> bool:
+    return bool(calls) and all(c.name == "SetRowAttrs" for c in calls)
+
+
+def _parse_timestamp(c: Call, key: str = "timestamp"
+                     ) -> Optional[dt.datetime]:
+    v = c.args.get(key)
+    if v is None:
+        return None
+    if isinstance(v, dt.datetime):
+        return v
+    try:
+        return dt.datetime.strptime(v, TIME_FORMAT)
+    except (TypeError, ValueError):
+        raise PilosaError(f"invalid date: {v}")
+
+
+class Executor:
+    """Executes PQL queries against a Holder, fanning out across a Cluster.
+
+    ``client`` is the node-to-node transport (cluster.client.Client); any
+    object with ``execute_query(node, index, query, slices, remote)`` works
+    — tests inject scripted fakes exactly like the reference's mock
+    executor seam (handler.go:60-62).
+    """
+
+    def __init__(self, holder, host: str = "",
+                 cluster: Optional[Cluster] = None, client=None,
+                 max_workers: int = 16):
+        self.holder = holder
+        self.host = host
+        self.cluster = cluster or new_cluster([host])
+        self.client = client
+        self.max_workers = max_workers
+
+    # -- entry point (executor.go:62-143) ------------------------------------
+
+    def execute(self, index: str, query, slices: Optional[list[int]] = None,
+                opt: Optional[ExecOptions] = None) -> list:
+        if not index:
+            raise PilosaError("index required")
+        if isinstance(query, str):
+            query = parse_pql(query)
+        if not isinstance(query, Query):
+            raise QueryRequiredError("query required")
+        opt = opt or ExecOptions()
+
+        needs = _needs_slices(query.calls)
+        inverse_slices: list[int] = []
+        column_label = "columnID"
+        if not slices and needs:
+            idx = self.holder.index(index)
+            if idx is None:
+                raise IndexNotFoundError(index)
+            slices = list(range(idx.max_slice() + 1))
+            inverse_slices = list(range(idx.max_inverse_slice() + 1))
+            column_label = idx.column_label
+        slices = slices or []
+
+        if _has_only_set_row_attrs(query.calls):
+            return self._execute_bulk_set_row_attrs(index, query.calls, opt)
+
+        results = []
+        for call in query.calls:
+            call_slices = slices
+            if call.supports_inverse() and needs:
+                frame_name = call.args.get("frame") or DEFAULT_FRAME
+                frame = self.holder.frame(index, frame_name)
+                if frame is None:
+                    raise FrameNotFoundError(frame_name)
+                if call.is_inverse(frame.row_label, column_label):
+                    call_slices = inverse_slices
+            results.append(self._execute_call(index, call, call_slices, opt))
+        return results
+
+    def _execute_call(self, index: str, c: Call, slices: list[int],
+                      opt: ExecOptions):
+        # executor.go:146-170
+        if c.name == "ClearBit":
+            return self._execute_clear_bit(index, c, opt)
+        if c.name == "Count":
+            return self._execute_count(index, c, slices, opt)
+        if c.name == "SetBit":
+            return self._execute_set_bit(index, c, opt)
+        if c.name == "SetRowAttrs":
+            self._execute_set_row_attrs(index, c, opt)
+            return None
+        if c.name == "SetColumnAttrs":
+            self._execute_set_column_attrs(index, c, opt)
+            return None
+        if c.name == "TopN":
+            return self._execute_top_n(index, c, slices, opt)
+        return self._execute_bitmap_call(index, c, slices, opt)
+
+    # -- bitmap expressions (executor.go:192-570) ----------------------------
+
+    def _execute_bitmap_call(self, index: str, c: Call, slices: list[int],
+                             opt: ExecOptions) -> Bitmap:
+        def map_fn(slice):
+            return self._bitmap_call_slice(index, c, slice)
+
+        def reduce_fn(prev, v):
+            if prev is None:
+                prev = Bitmap()
+            prev.merge(v)
+            return prev
+
+        bm = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        if bm is None:
+            bm = Bitmap()
+        if c.name == "Bitmap":
+            self._attach_bitmap_attrs(index, c, bm)
+        return bm
+
+    def _attach_bitmap_attrs(self, index: str, c: Call, bm: Bitmap) -> None:
+        # executor.go:215-249: column attrs if the column label was used,
+        # row attrs otherwise.
+        idx = self.holder.index(index)
+        if idx is None:
+            return
+        column_id, col_ok = c.uint_arg(idx.column_label)
+        if col_ok:
+            bm.attrs = idx.column_attr_store.attrs(column_id)
+            return
+        frame = idx.frame(c.args.get("frame") or DEFAULT_FRAME)
+        if frame is not None:
+            row_id, ok = c.uint_arg(frame.row_label)
+            if ok:
+                bm.attrs = frame.row_attr_store.attrs(row_id)
+
+    def _bitmap_call_slice(self, index: str, c: Call, slice: int) -> Bitmap:
+        # executor.go:253-268
+        if c.name == "Bitmap":
+            return self._bitmap_slice(index, c, slice)
+        if c.name == "Difference":
+            return self._fold_slice(index, c, slice, "difference",
+                                    require_children=True)
+        if c.name == "Intersect":
+            return self._fold_slice(index, c, slice, "intersect",
+                                    require_children=True)
+        if c.name == "Range":
+            return self._range_slice(index, c, slice)
+        if c.name == "Union":
+            return self._fold_slice(index, c, slice, "union",
+                                    require_children=False)
+        raise PilosaError(f"unknown call: {c.name}")
+
+    def _fold_slice(self, index: str, c: Call, slice: int, op: str,
+                    require_children: bool) -> Bitmap:
+        if require_children and not c.children:
+            raise PilosaError(f"empty {c.name} query is currently"
+                              " not supported")
+        out = Bitmap()
+        for i, child in enumerate(c.children):
+            bm = self._bitmap_call_slice(index, child, slice)
+            out = bm if i == 0 else getattr(out, op)(bm)
+        return out
+
+    def _bitmap_slice(self, index: str, c: Call, slice: int) -> Bitmap:
+        # executor.go:420-465: row id → standard view, column id → inverse.
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        frame_name = c.args.get("frame") or DEFAULT_FRAME
+        frame = idx.frame(frame_name)
+        if frame is None:
+            raise FrameNotFoundError(frame_name)
+        row_id, row_ok = c.uint_arg(frame.row_label)
+        col_id, col_ok = c.uint_arg(idx.column_label)
+        if row_ok and col_ok:
+            raise PilosaError(
+                f"Bitmap() cannot specify both {frame.row_label} and"
+                f" {idx.column_label} values")
+        if not row_ok and not col_ok:
+            raise PilosaError(
+                f"Bitmap() must specify either {frame.row_label} or"
+                f" {idx.column_label} values")
+        view, id = VIEW_STANDARD, row_id
+        if col_ok:
+            view, id = VIEW_INVERSE, col_id
+            if not frame.inverse_enabled:
+                raise PilosaError("Bitmap() cannot retrieve columns unless"
+                                  " inverse storage enabled")
+        frag = self.holder.fragment(index, frame_name, view, slice)
+        if frag is None:
+            return Bitmap()
+        return frag.row(id)
+
+    def _range_slice(self, index: str, c: Call, slice: int) -> Bitmap:
+        # executor.go:490-546: union the minimal time-view cover.
+        frame_name = c.args.get("frame") or DEFAULT_FRAME
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            raise FrameNotFoundError(frame_name)
+        row_id, _ = c.uint_arg(frame.row_label)
+        start = c.args.get("start")
+        if start is None:
+            raise PilosaError("Range() start time required")
+        end = c.args.get("end")
+        if end is None:
+            raise PilosaError("Range() end time required")
+        try:
+            start_t = dt.datetime.strptime(start, TIME_FORMAT)
+            end_t = dt.datetime.strptime(end, TIME_FORMAT)
+        except (TypeError, ValueError):
+            raise PilosaError("cannot parse Range() time")
+        q = frame.time_quantum()
+        if not q:
+            return Bitmap()
+        bm = Bitmap()
+        for view in tq.views_by_time_range(VIEW_STANDARD, start_t, end_t, q):
+            frag = self.holder.fragment(index, frame_name, view, slice)
+            if frag is None:
+                continue
+            bm = bm.union(frag.row(row_id))
+        return bm
+
+    # -- Count (executor.go:568-597) -----------------------------------------
+
+    def _execute_count(self, index: str, c: Call, slices: list[int],
+                       opt: ExecOptions) -> int:
+        if len(c.children) == 0:
+            raise PilosaError("Count() requires an input bitmap")
+        if len(c.children) > 1:
+            raise PilosaError("Count() only accepts a single bitmap input")
+
+        def map_fn(slice):
+            return self._bitmap_call_slice(index, c.children[0],
+                                           slice).count()
+
+        result = self._map_reduce(index, slices, c, opt, map_fn,
+                                  lambda prev, v: (prev or 0) + v)
+        return result or 0
+
+    # -- TopN (executor.go:271-396) ------------------------------------------
+
+    def _execute_top_n(self, index: str, c: Call, slices: list[int],
+                       opt: ExecOptions) -> list[Pair]:
+        row_ids, _ = c.uint_slice_arg("ids")
+        n, _ = c.uint_arg("n")
+
+        pairs = self._top_n_slices(index, c, slices, opt)
+        # Only the originating node refetches exact counts for candidates.
+        if not pairs or row_ids or opt.remote:
+            return pairs
+        other = c.clone()
+        other.args["ids"] = sorted({p.id for p in pairs})
+        trimmed = self._top_n_slices(index, other, slices, opt)
+        if n and n < len(trimmed):
+            trimmed = trimmed[:n]
+        return trimmed
+
+    def _top_n_slices(self, index: str, c: Call, slices: list[int],
+                      opt: ExecOptions) -> list[Pair]:
+        def map_fn(slice):
+            return self._top_n_slice(index, c, slice)
+
+        def reduce_fn(prev, v):
+            return pairs_add(prev or [], v)
+
+        pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        return pairs_sort(pairs or [])
+
+    def _top_n_slice(self, index: str, c: Call, slice: int) -> list[Pair]:
+        # executor.go:325-396
+        frame_name = c.args.get("frame") or DEFAULT_FRAME
+        n, _ = c.uint_arg("n")
+        field = c.args.get("field", "")
+        row_ids, _ = c.uint_slice_arg("ids")
+        min_threshold, _ = c.uint_arg("threshold")
+        filters = c.args.get("filters") or []
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+
+        src = None
+        if len(c.children) == 1:
+            src = self._bitmap_call_slice(index, c.children[0], slice)
+        elif len(c.children) > 1:
+            raise PilosaError("TopN() can only have one input bitmap")
+
+        frag = self.holder.fragment(index, frame_name, VIEW_STANDARD, slice)
+        if frag is None:
+            return []
+        if min_threshold <= 0:
+            min_threshold = MIN_THRESHOLD
+        if tanimoto > 100:
+            raise PilosaError("Tanimoto Threshold is from 1 to 100 only")
+        return frag.top(TopOptions(
+            n=n, src=src, row_ids=row_ids, filter_field=field,
+            filter_values=filters, min_threshold=min_threshold,
+            tanimoto_threshold=tanimoto))
+
+    # -- writes (executor.go:600-797) ----------------------------------------
+
+    def _execute_set_bit(self, index: str, c: Call, opt: ExecOptions
+                         ) -> bool:
+        return self._execute_mutate_bit(index, c, opt, set=True)
+
+    def _execute_clear_bit(self, index: str, c: Call, opt: ExecOptions
+                           ) -> bool:
+        return self._execute_mutate_bit(index, c, opt, set=False)
+
+    def _execute_mutate_bit(self, index: str, c: Call, opt: ExecOptions,
+                            set: bool) -> bool:
+        name = "SetBit" if set else "ClearBit"
+        view = c.args.get("view", "")
+        frame_name = c.args.get("frame")
+        if not frame_name:
+            raise PilosaError(f"{name}() frame required")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        frame = idx.frame(frame_name)
+        if frame is None:
+            raise FrameNotFoundError(frame_name)
+
+        row_id, ok = c.uint_arg(frame.row_label)
+        if not ok:
+            raise PilosaError(
+                f"{name}() row field '{frame.row_label}' required")
+        col_id, ok = c.uint_arg(idx.column_label)
+        if not ok:
+            raise PilosaError(
+                f"{name}() column field '{idx.column_label}' required")
+        timestamp = _parse_timestamp(c) if set else None
+
+        if view == VIEW_STANDARD:
+            return self._mutate_bit_view(index, c, frame, view, col_id,
+                                         row_id, timestamp, opt, set)
+        if view == VIEW_INVERSE:
+            return self._mutate_bit_view(index, c, frame, view, row_id,
+                                         col_id, timestamp, opt, set)
+        if view == "":
+            ret = self._mutate_bit_view(index, c, frame, VIEW_STANDARD,
+                                        col_id, row_id, timestamp, opt, set)
+            if frame.inverse_enabled:
+                if self._mutate_bit_view(index, c, frame, VIEW_INVERSE,
+                                         row_id, col_id, timestamp, opt,
+                                         set):
+                    ret = True
+            return ret
+        raise PilosaError(f"invalid view: {view}")
+
+    def _mutate_bit_view(self, index: str, c: Call, frame, view: str,
+                         col_id: int, row_id: int,
+                         timestamp: Optional[dt.datetime], opt: ExecOptions,
+                         set: bool) -> bool:
+        # Route to every replica owner of the slice (executor.go:664-691,
+        # 768-797). In the view axis convention, col_id is the id that
+        # chooses the slice (for inverse views that is the original row id).
+        from . import SLICE_WIDTH
+        slice = col_id // SLICE_WIDTH
+        ret = False
+        for node in self.cluster.fragment_nodes(index, slice):
+            if node.host == self.host:
+                op = frame.set_bit if set else frame.clear_bit
+                if op(view, row_id, col_id, timestamp):
+                    ret = True
+                continue
+            if opt.remote:
+                continue
+            res = self._exec_remote(node, index, Query([c]), None, opt)
+            if res and res[0]:
+                ret = True
+        return ret
+
+    # -- attributes (executor.go:800-988) ------------------------------------
+
+    def _execute_set_row_attrs(self, index: str, c: Call,
+                               opt: ExecOptions) -> None:
+        frame_name = c.args.get("frame")
+        if not frame_name:
+            raise PilosaError("SetRowAttrs() frame required")
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            raise FrameNotFoundError(frame_name)
+        row_id, ok = c.uint_arg(frame.row_label)
+        if not ok:
+            raise PilosaError(
+                f"SetRowAttrs() row field '{frame.row_label}' required")
+        attrs = dict(c.args)
+        attrs.pop("frame", None)
+        attrs.pop(frame.row_label, None)
+        frame.row_attr_store.set_attrs(row_id, attrs)
+        self._broadcast_call(index, [c], opt)
+
+    def _execute_bulk_set_row_attrs(self, index: str, calls: list[Call],
+                                    opt: ExecOptions) -> list:
+        # executor.go:857-941: group attrs by frame/row, bulk insert.
+        by_frame: dict[str, dict[int, dict]] = {}
+        for c in calls:
+            frame_name = c.args.get("frame")
+            if not frame_name:
+                raise PilosaError("SetRowAttrs() frame required")
+            frame = self.holder.frame(index, frame_name)
+            if frame is None:
+                raise FrameNotFoundError(frame_name)
+            row_id, ok = c.uint_arg(frame.row_label)
+            if not ok:
+                raise PilosaError(
+                    f"SetRowAttrs row field '{frame.row_label}' required")
+            attrs = dict(c.args)
+            attrs.pop("frame", None)
+            attrs.pop(frame.row_label, None)
+            by_frame.setdefault(frame_name, {}).setdefault(
+                row_id, {}).update(attrs)
+        for frame_name, rows in by_frame.items():
+            self.holder.frame(index, frame_name).row_attr_store \
+                .set_bulk_attrs(rows)
+        self._broadcast_call(index, calls, opt)
+        return [None] * len(calls)
+
+    def _execute_set_column_attrs(self, index: str, c: Call,
+                                  opt: ExecOptions) -> None:
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        id, ok = c.uint_arg("id")
+        col_name = "id"
+        if not ok:
+            id, ok = c.uint_arg(idx.column_label)
+            if not ok:
+                raise PilosaError("SetColumnAttrs() id required")
+            col_name = idx.column_label
+        attrs = dict(c.args)
+        attrs.pop(col_name, None)
+        idx.column_attr_store.set_attrs(id, attrs)
+        self._broadcast_call(index, [c], opt)
+
+    def _broadcast_call(self, index: str, calls: list[Call],
+                        opt: ExecOptions) -> None:
+        """Forward attribute writes to every other node in parallel
+        (executor.go:836-854)."""
+        if opt.remote:
+            return
+        others = [n for n in self.cluster.nodes if n.host != self.host]
+        if not others:
+            return
+        errs = []
+        threads = []
+        q = Query(list(calls))
+
+        def run(node):
+            try:
+                self._exec_remote(node, index, q, None, opt)
+            except Exception as e:  # noqa: BLE001 - collected and re-raised
+                errs.append(e)
+
+        for node in others:
+            t = threading.Thread(target=run, args=(node,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    # -- remote execution (executor.go:1000-1083) ----------------------------
+
+    def _exec_remote(self, node: Node, index: str, query: Query,
+                     slices: Optional[list[int]], opt: ExecOptions) -> list:
+        if self.client is None:
+            raise SliceUnavailableError(
+                f"no client to reach remote node {node.host}")
+        return self.client.execute_query(node, index, str(query), slices,
+                                         remote=True)
+
+    # -- map-reduce core (executor.go:1087-1236) -----------------------------
+
+    def _slices_by_node(self, nodes: list[Node], index: str,
+                        slices: list[int]) -> list[tuple[Node, list[int]]]:
+        m: dict[int, tuple[Node, list[int]]] = {}
+        for slice in slices:
+            for node in self.cluster.fragment_nodes(index, slice):
+                if any(n is node for n in nodes):
+                    m.setdefault(id(node), (node, []))[1].append(slice)
+                    break
+            else:
+                raise SliceUnavailableError(str(slice))
+        return list(m.values())
+
+    def _map_reduce(self, index: str, slices: list[int], c: Call,
+                    opt: ExecOptions, map_fn: Callable,
+                    reduce_fn: Callable):
+        if not slices:
+            return None
+        if opt.remote:
+            nodes = [self.cluster.node_by_host(self.host)]
+        else:
+            nodes = list(self.cluster.nodes)
+
+        result = None
+        processed = 0
+        with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            futures: dict = {}
+
+            def submit(nodes, slices):
+                for node, node_slices in self._slices_by_node(
+                        nodes, index, slices):
+                    fut = pool.submit(self._mapper_node, node, index, c,
+                                      node_slices, opt, map_fn, reduce_fn)
+                    futures[fut] = (node, node_slices)
+
+            submit(nodes, slices)
+            while processed < len(slices):
+                done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
+                for fut in done:
+                    node, node_slices = futures.pop(fut)
+                    try:
+                        r = fut.result()
+                    except Exception as e:  # noqa: BLE001 - retry replicas
+                        # Filter the failed node; re-map its slices onto
+                        # surviving replicas (executor.go:1137-1151).
+                        nodes = [n for n in nodes if n is not node]
+                        try:
+                            submit(nodes, node_slices)
+                        except SliceUnavailableError:
+                            raise e
+                        continue
+                    result = reduce_fn(result, r)
+                    processed += len(node_slices)
+        return result
+
+    def _mapper_node(self, node: Node, index: str, c: Call,
+                     slices: list[int], opt: ExecOptions, map_fn, reduce_fn):
+        if node.host == self.host:
+            return self._mapper_local(slices, map_fn, reduce_fn)
+        results = self._exec_remote(node, index, Query([c]), slices, opt)
+        return results[0] if results else None
+
+    def _mapper_local(self, slices: list[int], map_fn, reduce_fn):
+        # Goroutine-per-slice equivalent (executor.go:1201-1236); the numpy
+        # and device work inside map_fn releases the GIL.
+        if len(slices) == 1:
+            return reduce_fn(None, map_fn(slices[0]))
+        result = None
+        with ThreadPoolExecutor(
+                max_workers=min(len(slices), self.max_workers)) as pool:
+            for r in pool.map(map_fn, slices):
+                result = reduce_fn(result, r)
+        return result

@@ -414,6 +414,12 @@ class Fragment:
             out.reverse()
             return out
 
+    def recalculate_cache(self) -> None:
+        """Rebuild the rank cache regardless of the invalidate rate limit
+        (reference fragment.go:1059-1063)."""
+        with self._mu:
+            self.cache.recalculate()
+
     # -- block checksums / anti-entropy --------------------------------------
 
     def checksum(self) -> bytes:

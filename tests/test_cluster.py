@@ -1,0 +1,87 @@
+"""Cluster topology tests (reference cluster_test.go)."""
+
+from pilosa_tpu.cluster.topology import (Cluster, Node, fnv1a_64, jump_hash,
+                                         new_cluster)
+
+
+class TestJumpHash:
+    def test_range_and_determinism(self):
+        for n in (1, 3, 16, 1024):
+            buckets = [jump_hash(k, n) for k in range(200)]
+            assert all(0 <= b < n for b in buckets)
+            assert buckets == [jump_hash(k, n) for k in range(200)]
+
+    def test_monotone_consistency(self):
+        # Jump hash guarantee: growing n only moves keys INTO the new
+        # bucket, never between existing buckets.
+        for k in range(500):
+            a, b = jump_hash(k, 7), jump_hash(k, 8)
+            assert a == b or b == 7
+
+    def test_distribution(self):
+        n = 8
+        counts = [0] * n
+        for k in range(8000):
+            counts[jump_hash(k, n)] += 1
+        assert min(counts) > 600  # roughly uniform (expected 1000)
+
+
+class TestFNV:
+    def test_known_vectors(self):
+        # Standard FNV-1a 64 test vectors.
+        assert fnv1a_64(b"") == 0xCBF29CE484222325
+        assert fnv1a_64(b"a") == 0xAF63DC4C8601EC8C
+        assert fnv1a_64(b"foobar") == 0x85944171F73967E8
+
+
+class TestCluster:
+    def test_partition_stable_and_in_range(self):
+        c = new_cluster(["host0", "host1", "host2"])
+        for s in range(100):
+            p = c.partition("i", s)
+            assert 0 <= p < c.partition_n
+            assert p == c.partition("i", s)
+        # Different index names partition differently somewhere.
+        assert any(c.partition("i", s) != c.partition("j", s)
+                   for s in range(100))
+
+    def test_fragment_nodes_replicas(self):
+        c = new_cluster(["host0", "host1", "host2"], replica_n=2)
+        owners = c.fragment_nodes("i", 0)
+        assert len(owners) == 2
+        assert len({n.host for n in owners}) == 2
+        # Replicas are ring successors (cluster.go:220-240).
+        i0 = c.nodes.index(owners[0])
+        assert owners[1] is c.nodes[(i0 + 1) % 3]
+
+    def test_replica_capped_by_cluster_size(self):
+        c = new_cluster(["a", "b"], replica_n=5)
+        assert len(c.fragment_nodes("i", 3)) == 2
+
+    def test_owns_fragment_and_slices(self):
+        c = new_cluster(["host0", "host1", "host2"])
+        for s in range(50):
+            owners = {n.host for n in c.fragment_nodes("i", s)}
+            for h in ("host0", "host1", "host2"):
+                assert c.owns_fragment(h, "i", s) == (h in owners)
+        all_owned = sorted(
+            s for h in ("host0", "host1", "host2")
+            for s in c.owns_slices("i", 49, h))
+        assert all_owned == list(range(50))  # exact partition of slices
+
+    def test_single_node_owns_everything(self):
+        c = new_cluster(["only"])
+        for s in range(20):
+            assert c.owns_fragment("only", "i", s)
+
+    def test_node_states(self):
+        class StaticSet:
+            def __init__(self, nodes):
+                self._nodes = nodes
+
+            def nodes(self):
+                return self._nodes
+
+        c = Cluster(nodes=[Node("a"), Node("b")],
+                    node_set=StaticSet([Node("a")]))
+        assert c.node_states() == {"a": "UP", "b": "DOWN"}

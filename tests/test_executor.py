@@ -1,0 +1,380 @@
+"""Executor tests (reference executor_test.go).
+
+Multi-node behavior is tested the same way the reference does: a real
+local Executor plus a cluster whose other node is reached through a
+scripted fake client asserting the forwarded query and returning canned
+results (executor_test.go:473-692).
+"""
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.cluster.topology import new_cluster
+from pilosa_tpu.errors import PilosaError
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.models.frame import FrameOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.storage.bitmap import Bitmap
+from pilosa_tpu.storage.cache import Pair
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+@pytest.fixture
+def executor(holder):
+    return Executor(holder, host="local")
+
+
+def must_set(holder, index, frame, row, col, view="standard"):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_frame_if_not_exists(frame)
+    f.set_bit(view, row, col)
+
+
+class TestBitmapCalls:
+    def test_bitmap(self, holder, executor):
+        must_set(holder, "i", "general", 10, 3)
+        must_set(holder, "i", "general", 10, SLICE_WIDTH + 1)
+        res = executor.execute("i", "Bitmap(rowID=10, frame=general)")
+        assert list(res[0].bits()) == [3, SLICE_WIDTH + 1]
+
+    def test_bitmap_attaches_row_attrs(self, holder, executor):
+        must_set(holder, "i", "general", 10, 3)
+        holder.frame("i", "general").row_attr_store.set_attrs(
+            10, {"category": "x"})
+        res = executor.execute("i", "Bitmap(rowID=10, frame=general)")
+        assert res[0].attrs == {"category": "x"}
+
+    def test_inverse_bitmap(self, holder, executor):
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists(
+            "f", FrameOptions(inverse_enabled=True))
+        f.set_bit("standard", 5, 100)
+        f.set_bit("inverse", 100, 5)
+        res = executor.execute("i", "Bitmap(columnID=100, frame=f)")
+        assert list(res[0].bits()) == [5]
+
+    def test_inverse_requires_flag(self, holder, executor):
+        must_set(holder, "i", "f", 1, 2)
+        with pytest.raises(PilosaError, match="inverse"):
+            executor.execute("i", "Bitmap(columnID=2, frame=f)")
+
+    def test_intersect(self, holder, executor):
+        for col in (3, 5, SLICE_WIDTH + 2):
+            must_set(holder, "i", "general", 1, col)
+        for col in (5, SLICE_WIDTH + 2, SLICE_WIDTH + 9):
+            must_set(holder, "i", "general", 2, col)
+        res = executor.execute(
+            "i", "Intersect(Bitmap(rowID=1), Bitmap(rowID=2))")
+        assert list(res[0].bits()) == [5, SLICE_WIDTH + 2]
+
+    def test_union(self, holder, executor):
+        must_set(holder, "i", "general", 1, 3)
+        must_set(holder, "i", "general", 2, 5)
+        res = executor.execute("i", "Union(Bitmap(rowID=1), Bitmap(rowID=2))")
+        assert list(res[0].bits()) == [3, 5]
+
+    def test_difference(self, holder, executor):
+        for col in (1, 2, 3):
+            must_set(holder, "i", "general", 1, col)
+        must_set(holder, "i", "general", 2, 2)
+        res = executor.execute(
+            "i", "Difference(Bitmap(rowID=1), Bitmap(rowID=2))")
+        assert list(res[0].bits()) == [1, 3]
+
+    def test_empty_intersect_errors(self, holder, executor):
+        must_set(holder, "i", "general", 1, 1)
+        with pytest.raises(PilosaError, match="empty Intersect"):
+            executor.execute("i", "Intersect()")
+
+    def test_count(self, holder, executor):
+        must_set(holder, "i", "general", 10, 3)
+        must_set(holder, "i", "general", 10, SLICE_WIDTH + 1)
+        must_set(holder, "i", "general", 10, SLICE_WIDTH + 2)
+        res = executor.execute("i", "Count(Bitmap(rowID=10))")
+        assert res[0] == 3
+
+
+class TestSetBit:
+    def test_set_and_clear(self, holder, executor):
+        holder.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "f")
+        res = executor.execute("i", "SetBit(rowID=11, frame=f, columnID=2)")
+        assert res[0] is True
+        res = executor.execute("i", "SetBit(rowID=11, frame=f, columnID=2)")
+        assert res[0] is False  # no change
+        assert executor.execute("i", "Count(Bitmap(rowID=11, frame=f))") \
+            == [1]
+        assert executor.execute(
+            "i", "ClearBit(rowID=11, frame=f, columnID=2)") == [True]
+        assert executor.execute(
+            "i", "ClearBit(rowID=11, frame=f, columnID=2)") == [False]
+
+    def test_set_with_timestamp_creates_time_views(self, holder, executor):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists("f", FrameOptions(time_quantum="Y"))
+        executor.execute(
+            "i",
+            'SetBit(rowID=1, frame=f, columnID=2,'
+            ' timestamp="2017-03-04T10:30")')
+        assert set(holder.frame("i", "f").views) == {"standard",
+                                                     "standard_2017"}
+
+    def test_set_inverse_pair(self, holder, executor):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists(
+            "f", FrameOptions(inverse_enabled=True))
+        executor.execute("i", "SetBit(rowID=3, frame=f, columnID=9)")
+        # Inverse view holds the transpose.
+        res = executor.execute("i", "Bitmap(columnID=9, frame=f)")
+        assert list(res[0].bits()) == [3]
+
+    def test_missing_frame_errors(self, holder, executor):
+        holder.create_index_if_not_exists("i")
+        with pytest.raises(PilosaError):
+            executor.execute("i", "SetBit(rowID=1, frame=nope, columnID=2)")
+
+
+class TestRange:
+    def test_range_unions_time_views(self, holder, executor):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists("f", FrameOptions(time_quantum="YMDH"))
+        q = ('SetBit(rowID=1, frame=f, columnID={col},'
+             ' timestamp="{ts}")')
+        executor.execute("i", q.format(col=1, ts="2017-01-01T00:00"))
+        executor.execute("i", q.format(col=2, ts="2017-01-02T00:00"))
+        executor.execute("i", q.format(col=3, ts="2017-02-01T00:00"))
+        res = executor.execute(
+            "i", 'Range(rowID=1, frame=f, start="2017-01-01T00:00",'
+                 ' end="2017-01-31T00:00")')
+        assert list(res[0].bits()) == [1, 2]
+
+    def test_range_no_quantum_empty(self, holder, executor):
+        must_set(holder, "i", "f", 1, 2)
+        res = executor.execute(
+            "i", 'Range(rowID=1, frame=f, start="2017-01-01T00:00",'
+                 ' end="2017-01-31T00:00")')
+        assert res[0].count() == 0
+
+
+class TestTopN:
+    def test_top_n(self, holder, executor):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists(
+            "f", FrameOptions(cache_type="ranked"))
+        f = holder.frame("i", "f")
+        for col in range(5):
+            f.set_bit("standard", 0, col)
+        for col in range(3):
+            f.set_bit("standard", 10, col)
+        for col in range(4):
+            f.set_bit("standard", 2, SLICE_WIDTH + col)
+        for frag in f.view("standard").fragments.values():
+            frag.recalculate_cache()
+        res = executor.execute("i", "TopN(frame=f, n=2)")
+        assert res[0] == [Pair(0, 5), Pair(2, 4)]
+
+    def test_top_n_with_src(self, holder, executor):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists(
+            "f", FrameOptions(cache_type="ranked"))
+        f = holder.frame("i", "f")
+        for col in (1, 2, 3, 4):
+            f.set_bit("standard", 0, col)
+        for col in (1, 2):
+            f.set_bit("standard", 5, col)
+        f.set_bit("standard", 7, 1)
+        f.view("standard").fragment(0).recalculate_cache()
+        # src = row 0's bits; ranked intersection counts.
+        res = executor.execute(
+            "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=2)")
+        assert res[0] == [Pair(0, 4), Pair(5, 2)]
+
+    def test_top_n_ids(self, holder, executor):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists(
+            "f", FrameOptions(cache_type="ranked"))
+        f = holder.frame("i", "f")
+        for col in range(5):
+            f.set_bit("standard", 0, col)
+        for col in range(3):
+            f.set_bit("standard", 1, col)
+        res = executor.execute("i", "TopN(frame=f, ids=[1])")
+        assert res[0] == [Pair(1, 3)]
+
+
+class TestAttrs:
+    def test_set_row_attrs(self, holder, executor):
+        must_set(holder, "i", "f", 10, 1)
+        executor.execute("i", 'SetRowAttrs(rowID=10, frame=f, foo="bar")')
+        assert holder.frame("i", "f").row_attr_store.attrs(10) == \
+            {"foo": "bar"}
+
+    def test_bulk_set_row_attrs(self, holder, executor):
+        must_set(holder, "i", "f", 1, 1)
+        res = executor.execute(
+            "i",
+            'SetRowAttrs(rowID=1, frame=f, a=1)\n'
+            'SetRowAttrs(rowID=2, frame=f, b=true)')
+        assert res == [None, None]
+        store = holder.frame("i", "f").row_attr_store
+        assert store.attrs(1) == {"a": 1}
+        assert store.attrs(2) == {"b": True}
+
+    def test_set_column_attrs(self, holder, executor):
+        must_set(holder, "i", "f", 1, 10)
+        executor.execute("i", 'SetColumnAttrs(columnID=10, foo="baz")')
+        assert holder.index("i").column_attr_store.attrs(10) == \
+            {"foo": "baz"}
+
+
+class FakeClient:
+    """Scripted remote transport (reference executor_test.go mock server)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.calls = []
+
+    def execute_query(self, node, index, query, slices, remote):
+        self.calls.append((node.host, index, query, slices, remote))
+        return self.fn(node, index, query, slices)
+
+
+class TestDistributed:
+    def _two_node(self, holder, fn, replica_n=1):
+        cluster = new_cluster(["local", "remotehost"], replica_n=replica_n)
+        client = FakeClient(fn)
+        e = Executor(holder, host="local", cluster=cluster, client=client)
+        return e, client, cluster
+
+    def test_remote_count_merges(self, holder):
+        must_set(holder, "i", "general", 10, 3)  # slice 0 data
+
+        def fn(node, index, query, slices):
+            assert query == "Count(Bitmap(frame=\"general\", rowID=10))"
+            return [7]
+
+        e, client, cluster = self._two_node(holder, fn)
+        # Force 3 slices; remote node owns some of them.
+        holder.index("i").set_remote_max_slice(2)
+        res = e.execute("i", "Count(Bitmap(rowID=10, frame=general))")
+        slice0_local = cluster.fragment_nodes("i", 0)[0].host == "local"
+        remote_slices = [s for s in range(3)
+                         if cluster.fragment_nodes("i", s)[0].host
+                         == "remotehost"]
+        # All remote slices arrive grouped into ONE exec call.
+        assert len(client.calls) == (1 if remote_slices else 0)
+        expected = (1 if slice0_local else 0) + \
+            (7 if remote_slices else 0)
+        assert res[0] == expected
+
+    def test_remote_bitmap_merges(self, holder):
+        must_set(holder, "i", "general", 10, 3)
+        holder.index("i").set_remote_max_slice(2)
+
+        def fn(node, index, query, slices):
+            bm = Bitmap()
+            for s in slices:
+                bm.set_bit(s * SLICE_WIDTH + 42)
+            return [bm]
+
+        e, client, cluster = self._two_node(holder, fn)
+        res = e.execute("i", "Bitmap(rowID=10, frame=general)")
+        bits = set(res[0].bits())
+        if cluster.fragment_nodes("i", 0)[0].host == "local":
+            assert 3 in bits
+        for host, index, query, slices, remote in client.calls:
+            assert remote is True
+            for s in slices:
+                assert s * SLICE_WIDTH + 42 in bits
+
+    def test_remote_topn_two_phase(self, holder):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists(
+            "f", FrameOptions(cache_type="ranked"))
+        f = holder.frame("i", "f")
+        for col in range(4):
+            f.set_bit("standard", 0, col)
+        f.view("standard").fragment(0).recalculate_cache()
+        idx.set_remote_max_slice(2)
+
+        def fn(node, index, query, slices):
+            if "ids=" in query:
+                return [[Pair(0, 1), Pair(30, 5)]]  # exact-count phase
+            return [[Pair(30, 5)]]
+
+        e, client, cluster = self._two_node(holder, fn)
+        res = e.execute("i", "TopN(frame=f, n=2)")
+        has_remote = any(cluster.fragment_nodes("i", s)[0].host
+                         == "remotehost" for s in range(3))
+        slice0_local = cluster.fragment_nodes("i", 0)[0].host == "local"
+        assert has_remote  # 3 slices over 2 nodes: some leg is remote
+        # Second phase re-queried with the candidate ids.
+        assert any("ids=" in c[2] for c in client.calls)
+        if slice0_local:
+            # local Pair(0,4) + remote phase-2 Pair(0,1) merge to 5.
+            assert res[0] == [Pair(0, 5), Pair(30, 5)]
+        else:
+            # local fragment not owned → only remote results survive.
+            assert res[0] == [Pair(30, 5), Pair(0, 1)]
+
+    def test_setbit_forwards_to_owner(self, holder):
+        holder.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "f")
+
+        def fn(node, index, query, slices):
+            assert query.startswith("SetBit(")
+            return [True]
+
+        e, client, cluster = self._two_node(holder, fn, replica_n=2)
+        res = e.execute("i", "SetBit(rowID=1, frame=f, columnID=3)")
+        assert res[0] is True
+        # replica_n=2 on 2 nodes → both own slice 0; remote got the call.
+        assert len(client.calls) == 1
+        # Local write also landed.
+        assert holder.fragment("i", "f", "standard", 0).row(1).count() == 1
+
+    def test_remote_flag_stops_forwarding(self, holder):
+        holder.create_index_if_not_exists("i").create_frame_if_not_exists(
+            "f")
+
+        def fn(node, index, query, slices):
+            raise AssertionError("must not forward when remote=True")
+
+        e, client, cluster = self._two_node(holder, fn, replica_n=2)
+        res = e.execute("i", "SetBit(rowID=1, frame=f, columnID=3)",
+                        opt=ExecOptions(remote=True))
+        assert res[0] is True
+        assert client.calls == []
+
+    def test_failed_node_retries_on_replica(self, holder):
+        must_set(holder, "i", "general", 10, 3)
+        holder.index("i").set_remote_max_slice(2)
+        attempts = []
+
+        def fn(node, index, query, slices):
+            attempts.append(list(slices))
+            raise ConnectionError("node down")
+
+        # replica_n=2 on 2 nodes → every slice is owned by both; when the
+        # remote leg fails its slices re-map onto the local node.
+        e, client, cluster = self._two_node(holder, fn, replica_n=2)
+        res = e.execute("i", "Count(Bitmap(rowID=10, frame=general))")
+        assert res[0] == 1  # all slices eventually served locally
+
+    def test_attr_write_broadcasts(self, holder):
+        must_set(holder, "i", "f", 10, 1)
+
+        def fn(node, index, query, slices):
+            assert query == 'SetRowAttrs(foo="bar", frame="f", rowID=10)'
+            return [None]
+
+        e, client, cluster = self._two_node(holder, fn)
+        e.execute("i", 'SetRowAttrs(rowID=10, frame=f, foo="bar")')
+        assert len(client.calls) == 1  # forwarded to the one other node
