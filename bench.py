@@ -1,0 +1,84 @@
+"""Benchmark of record: single-fragment Intersect+Count on 1 B-bit rows.
+
+Metric (BASELINE.md): Intersect+Count ops/sec on two 2^30-bit packed rows.
+The device op is the fused XLA kernel ``sum(popcount(a & b))``
+(pilosa_tpu.ops.kernels.op_count_total) — the TPU replacement for the
+reference's amd64 POPCNT assembly loop (roaring/assembly_amd64.s:60-77,
+`popcntAndSliceAsm`). The baseline denominator is measured on this
+machine: the same algorithm through our C++ host kernel
+(pilosa_tpu/native/bitops.cpp, `popcnt_and`), which is the faithful
+stand-in for the reference's native path (no Go toolchain in this image —
+BASELINE.md records that denominators must be measured, not quoted).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: PILOSA_BENCH_BITS (default 2^30), PILOSA_BENCH_ITERS (20).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> None:
+    import jax
+
+    from pilosa_tpu.ops.kernels import op_count_total
+    from pilosa_tpu.storage import native
+
+    bits = int(os.environ.get("PILOSA_BENCH_BITS", str(1 << 30)))
+    iters = int(os.environ.get("PILOSA_BENCH_ITERS", "20"))
+    n_words = bits // 32
+
+    rng = np.random.default_rng(42)
+    a = rng.integers(0, 2**32, size=n_words, dtype=np.uint32)
+    b = rng.integers(0, 2**32, size=n_words, dtype=np.uint32)
+
+    # --- device path (TPU if available, else whatever jax defaults to)
+    from pilosa_tpu.ops.kernels import _op_count_total_parts
+    da, db = jax.device_put(a), jax.device_put(b)
+    want = op_count_total("and", da, db)  # warmup: compile + one run
+    # Dispatch asynchronously and sync once: measures sustained kernel
+    # throughput rather than per-call host↔device round-trip latency.
+    t0 = time.perf_counter()
+    outs = [_op_count_total_parts("and", da, db) for _ in range(iters)]
+    jax.block_until_ready(outs)
+    device_s = (time.perf_counter() - t0) / iters
+    hi, lo = outs[-1]
+    got = (int(hi) << 16) + int(lo)
+    assert got == want
+
+    # --- host-native baseline (C++ popcount kernel, same data)
+    base_iters = max(1, min(iters, 5))
+    native_ok = native.available()
+    if native_ok:
+        ref = native.popcnt_and(a, b)
+        assert ref == want, (ref, want)
+        t0 = time.perf_counter()
+        for _ in range(base_iters):
+            native.popcnt_and(a, b)
+        host_s = (time.perf_counter() - t0) / base_iters
+    else:  # pure-numpy fallback baseline
+        t0 = time.perf_counter()
+        for _ in range(base_iters):
+            int(np.unpackbits(np.bitwise_and(a, b).view(np.uint8)).sum())
+        host_s = (time.perf_counter() - t0) / base_iters
+
+    ops_per_sec = 1.0 / device_s
+    print(json.dumps({
+        "metric": f"intersect_count_{bits // (1 << 20)}Mbit_rows",
+        "value": round(ops_per_sec, 3),
+        "unit": "ops/sec",
+        "vs_baseline": round(host_s / device_s, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

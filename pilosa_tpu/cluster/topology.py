@@ -114,6 +114,8 @@ class Cluster:
     def owns_slices(self, index: str, max_slice: int, host: str
                     ) -> list[int]:
         """Slices whose PRIMARY owner is host (cluster.go:243-254)."""
+        if not self.nodes:
+            return []
         out = []
         for s in range(max_slice + 1):
             p = self.partition(index, s)

@@ -113,6 +113,11 @@ class Executor:
         needs = _needs_slices(query.calls)
         inverse_slices: list[int] = []
         column_label = "columnID"
+        # Inverse-slice substitution happens only when WE computed the
+        # slice lists. A forwarded (remote) query arrives with the exact
+        # slice ids the coordinator already selected — replacing them
+        # would wrongly empty inverse legs.
+        computed_slices = not slices
         if not slices and needs:
             idx = self.holder.index(index)
             if idx is None:
@@ -128,7 +133,7 @@ class Executor:
         results = []
         for call in query.calls:
             call_slices = slices
-            if call.supports_inverse() and needs:
+            if call.supports_inverse() and needs and computed_slices:
                 frame_name = call.args.get("frame") or DEFAULT_FRAME
                 frame = self.holder.frame(index, frame_name)
                 if frame is None:
@@ -257,7 +262,10 @@ class Executor:
         frame = self.holder.frame(index, frame_name)
         if frame is None:
             raise FrameNotFoundError(frame_name)
-        row_id, _ = c.uint_arg(frame.row_label)
+        row_id, ok = c.uint_arg(frame.row_label)
+        if not ok:
+            raise PilosaError(
+                f"Range() row field '{frame.row_label}' required")
         start = c.args.get("start")
         if start is None:
             raise PilosaError("Range() start time required")
@@ -430,8 +438,10 @@ class Executor:
 
     # -- attributes (executor.go:800-988) ------------------------------------
 
-    def _execute_set_row_attrs(self, index: str, c: Call,
-                               opt: ExecOptions) -> None:
+    def _row_attrs_of(self, index: str, c: Call) -> tuple[str, object,
+                                                          int, dict]:
+        """Resolve a SetRowAttrs call → (frame_name, frame, row_id,
+        attrs-minus-reserved-keys)."""
         frame_name = c.args.get("frame")
         if not frame_name:
             raise PilosaError("SetRowAttrs() frame required")
@@ -445,6 +455,11 @@ class Executor:
         attrs = dict(c.args)
         attrs.pop("frame", None)
         attrs.pop(frame.row_label, None)
+        return frame_name, frame, row_id, attrs
+
+    def _execute_set_row_attrs(self, index: str, c: Call,
+                               opt: ExecOptions) -> None:
+        _, frame, row_id, attrs = self._row_attrs_of(index, c)
         frame.row_attr_store.set_attrs(row_id, attrs)
         self._broadcast_call(index, [c], opt)
 
@@ -453,19 +468,7 @@ class Executor:
         # executor.go:857-941: group attrs by frame/row, bulk insert.
         by_frame: dict[str, dict[int, dict]] = {}
         for c in calls:
-            frame_name = c.args.get("frame")
-            if not frame_name:
-                raise PilosaError("SetRowAttrs() frame required")
-            frame = self.holder.frame(index, frame_name)
-            if frame is None:
-                raise FrameNotFoundError(frame_name)
-            row_id, ok = c.uint_arg(frame.row_label)
-            if not ok:
-                raise PilosaError(
-                    f"SetRowAttrs row field '{frame.row_label}' required")
-            attrs = dict(c.args)
-            attrs.pop("frame", None)
-            attrs.pop(frame.row_label, None)
+            frame_name, _, row_id, attrs = self._row_attrs_of(index, c)
             by_frame.setdefault(frame_name, {}).setdefault(
                 row_id, {}).update(attrs)
         for frame_name, rows in by_frame.items():

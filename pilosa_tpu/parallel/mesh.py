@@ -1,0 +1,174 @@
+"""Device-mesh slice executor: mapReduce as SPMD collectives.
+
+The reference fans a query out goroutine-per-slice and per-node, then
+reduces associatively — sum for Count, pair-merge for TopN
+(executor.go:1103-1236). On TPU the slice axis IS a mesh axis: packed
+slice blocks are sharded over devices with `jax.sharding`, the per-slice
+map is the sharded computation inside `shard_map`, and the reduce is an
+XLA collective riding ICI — `psum` for Count, `psum` of per-row counts +
+`top_k` for TopN — instead of an HTTP/gossip merge.
+
+Axis conventions:
+- ``slices``: the column-slice axis (data-parallel; the reference's unit
+  of placement, cluster.go:198-240). Count/TopN reduce over it.
+- ``rows``: candidate-row axis for TopN blocks (tensor-parallel
+  analogue); per-row counts are psum'd over ``slices``, gathered over
+  ``rows`` for the final top-k.
+
+All entry points compile once per (mesh, shape, op) and cache.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..ops.kernels import _BITWISE
+
+AXIS_SLICES = "slices"
+AXIS_ROWS = "rows"
+
+
+def make_mesh(n_devices: int | None = None, rows: int = 1) -> Mesh:
+    """A (rows × slices) device mesh. ``rows=1`` gives the common 1-D
+    slice mesh; TopN row-sharding uses rows>1."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    if n % rows:
+        raise ValueError("n_devices must be divisible by rows")
+    grid = np.array(devs[:n]).reshape(rows, n // rows)
+    return Mesh(grid, (AXIS_ROWS, AXIS_SLICES))
+
+
+def _slice_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(AXIS_SLICES))
+
+
+def shard_slices(mesh: Mesh, arr: np.ndarray) -> jax.Array:
+    """Place ``[n_slices, ...]`` on the mesh, sharded over the slice axis.
+    n_slices must divide evenly (pad with zero slices host-side)."""
+    return jax.device_put(arr, _slice_sharding(mesh))
+
+
+def pad_to_multiple(arr: np.ndarray, n: int) -> np.ndarray:
+    """Pad axis 0 with zero slices to a multiple of n (zero slices are
+    identity for every count/TopN reduction)."""
+    rem = arr.shape[0] % n
+    if rem == 0:
+        return arr
+    pad = [(0, n - rem)] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+@functools.lru_cache(maxsize=None)
+def _count_fn(mesh: Mesh, op: str):
+    """[S, W] × [S, W] → scalar total count, psum over the slice axis.
+
+    Per-shard totals are split into 16-bit halves (int64 is off by
+    default; a 1 B-column slab overflows int32) and recombined host-side.
+    """
+    bitwise = _BITWISE[op]
+
+    def per_shard(a, b):  # a, b: [S/n, W]
+        pc = jax.lax.population_count(bitwise(a, b)).astype(jnp.int32)
+        row = jnp.sum(pc, axis=-1).ravel()  # ≤ 2^15 counts of ≤ 2^20 each
+        hi = jax.lax.psum(jnp.sum(row >> 16), AXIS_SLICES)
+        lo = jax.lax.psum(jnp.sum(row & 0xFFFF), AXIS_SLICES)
+        return hi, lo
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(AXIS_SLICES), P(AXIS_SLICES)),
+        out_specs=(P(), P())))
+
+
+def count_op(mesh: Mesh, op: str, a: jax.Array, b: jax.Array) -> int:
+    """Count(op(a, b)) over slice-sharded packed blocks — the mesh form of
+    the executor's Count mapReduce (executor.go:568-597)."""
+    hi, lo = _count_fn(mesh, op)(a, b)
+    return (int(hi) << 16) + int(lo)
+
+
+@functools.lru_cache(maxsize=None)
+def _topn_fn(mesh: Mesh, op: str, k: int):
+    """rows [S, R, W] × src [S, W] → (top-k counts, top-k row indices).
+
+    Per-slice intersection counts for ALL candidate rows in one fused
+    pass (the vectorized replacement for the reference's sequential
+    threshold loop, fragment.go:560-614), psum'd over the slice axis,
+    gathered over the row axis, then a single device top_k.
+    """
+    bitwise = _BITWISE[op]
+
+    def per_shard(rows, src):  # rows: [S/n, R/m, W], src: [S/n, W]
+        words = bitwise(rows, src[:, None, :])
+        pc = jax.lax.population_count(words).astype(jnp.int32)
+        counts = jnp.sum(pc, axis=(0, 2))              # [R/m]
+        counts = jax.lax.psum(counts, AXIS_SLICES)     # slice reduce (ICI)
+        counts = jax.lax.all_gather(counts, AXIS_ROWS,
+                                    tiled=True)        # [R]
+        vals, idx = jax.lax.top_k(counts, k)
+        return vals, idx
+
+    # check_vma off: the all_gather over ``rows`` makes counts replicated,
+    # but the varying-axis inference can't prove it.
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(AXIS_SLICES, AXIS_ROWS), P(AXIS_SLICES)),
+        out_specs=(P(), P()), check_vma=False))
+
+
+def topn_counts(mesh: Mesh, op: str, rows: jax.Array, src: jax.Array,
+                k: int) -> tuple[np.ndarray, np.ndarray]:
+    """(counts, row_indices) of the k candidate rows with the largest
+    ``count(op(row, src))`` across all slices."""
+    vals, idx = _topn_fn(mesh, op, k)(rows, src)
+    return np.asarray(vals), np.asarray(idx)
+
+
+@functools.lru_cache(maxsize=None)
+def _query_step_fn(mesh: Mesh, k: int):
+    """The flagship distributed query step, jitted over the full mesh.
+
+    One fused SPMD program: Count(Intersect) + Count(Union) over a
+    slice-sharded pair of bitmap slabs, plus TopN(k) of a row-sharded
+    candidate block against the intersection — i.e. configs 4 and 5 of
+    BASELINE.md in a single compiled step. Collectives: psum over
+    ``slices``, all_gather over ``rows``.
+    """
+
+    def per_shard(a, b, rows):
+        # a, b: [S/n, W]; rows: [S/n, R/m, W]
+        inter = jnp.bitwise_and(a, b)
+        union = jnp.bitwise_or(a, b)
+        pc_i = jnp.sum(jax.lax.population_count(inter).astype(jnp.int32))
+        pc_u = jnp.sum(jax.lax.population_count(union).astype(jnp.int32))
+        n_inter = jax.lax.psum(pc_i, AXIS_SLICES)
+        n_union = jax.lax.psum(pc_u, AXIS_SLICES)
+        words = jnp.bitwise_and(rows, inter[:, None, :])
+        counts = jnp.sum(jax.lax.population_count(words).astype(jnp.int32),
+                         axis=(0, 2))
+        counts = jax.lax.psum(counts, AXIS_SLICES)
+        counts = jax.lax.all_gather(counts, AXIS_ROWS, tiled=True)
+        top_vals, top_ids = jax.lax.top_k(counts, k)
+        return n_inter, n_union, top_vals, top_ids
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(AXIS_SLICES), P(AXIS_SLICES),
+                  P(AXIS_SLICES, AXIS_ROWS)),
+        out_specs=(P(), P(), P(), P()), check_vma=False))
+
+
+def query_step(mesh: Mesh, a: jax.Array, b: jax.Array, rows: jax.Array,
+               k: int):
+    """Run the fused distributed query step; see _query_step_fn."""
+    n_i, n_u, vals, ids = _query_step_fn(mesh, k)(a, b, rows)
+    return int(n_i), int(n_u), np.asarray(vals), np.asarray(ids)

@@ -69,6 +69,11 @@ class TestCluster:
             for s in c.owns_slices("i", 49, h))
         assert all_owned == list(range(50))  # exact partition of slices
 
+    def test_empty_cluster_owns_nothing(self):
+        c = Cluster(nodes=[])
+        assert c.owns_slices("i", 10, "h") == []
+        assert c.fragment_nodes("i", 0) == []
+
     def test_single_node_owns_everything(self):
         c = new_cluster(["only"])
         for s in range(20):

@@ -60,6 +60,18 @@ class TestBitmapCalls:
         res = executor.execute("i", "Bitmap(columnID=100, frame=f)")
         assert list(res[0].bits()) == [5]
 
+    def test_inverse_bitmap_remote_leg_keeps_slices(self, holder, executor):
+        # A forwarded inverse query arrives with explicit slice ids; they
+        # must not be replaced by the (empty) locally-computed inverse
+        # list.
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists(
+            "f", FrameOptions(inverse_enabled=True))
+        f.set_bit("inverse", 100, 5)
+        res = executor.execute("i", "Bitmap(columnID=100, frame=f)",
+                               slices=[0], opt=ExecOptions(remote=True))
+        assert list(res[0].bits()) == [5]
+
     def test_inverse_requires_flag(self, holder, executor):
         must_set(holder, "i", "f", 1, 2)
         with pytest.raises(PilosaError, match="inverse"):
@@ -154,6 +166,14 @@ class TestRange:
             "i", 'Range(rowID=1, frame=f, start="2017-01-01T00:00",'
                  ' end="2017-01-31T00:00")')
         assert list(res[0].bits()) == [1, 2]
+
+    def test_range_requires_row_field(self, holder, executor):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists("f", FrameOptions(time_quantum="Y"))
+        with pytest.raises(PilosaError, match="row field"):
+            executor.execute(
+                "i", 'Range(frame=f, start="2017-01-01T00:00",'
+                     ' end="2017-01-31T00:00")')
 
     def test_range_no_quantum_empty(self, holder, executor):
         must_set(holder, "i", "f", 1, 2)
