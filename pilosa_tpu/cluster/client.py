@@ -1,0 +1,286 @@
+"""Node-to-node / external HTTP client: protobuf-over-HTTP data plane.
+
+Reference: client.go. Used for remote query legs (executor.go:1001-1083),
+slice-grouped bulk imports (client.go:304-389), anti-entropy block sync
+(client.go:798-886), attr diffs (client.go:889-974), and backup/restore
+streaming (client.go:463-674).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import urllib.error
+import urllib.request
+from typing import Optional
+
+import numpy as np
+
+from .. import SLICE_WIDTH
+from ..errors import FragmentNotFoundError, PilosaError
+from ..pql import parser as pql
+from ..proto import internal_pb2 as pb
+from .topology import Node
+
+_PROTOBUF = "application/x-protobuf"
+
+
+class ClientError(PilosaError):
+    pass
+
+
+def _host_of(node) -> str:
+    return node.host if isinstance(node, Node) else str(node)
+
+
+class Bit:
+    """One (row, column, timestamp) triple for import
+    (client.go:977-1005)."""
+
+    __slots__ = ("row_id", "column_id", "timestamp")
+
+    def __init__(self, row_id: int, column_id: int, timestamp: int = 0):
+        self.row_id = row_id
+        self.column_id = column_id
+        self.timestamp = timestamp  # ns since epoch, 0 = none
+
+
+def group_by_slice(bits: list[Bit]) -> dict[int, list[Bit]]:
+    """Group bits by the slice their column falls in
+    (client.go:1027-1040)."""
+    m: dict[int, list[Bit]] = {}
+    for b in bits:
+        m.setdefault(b.column_id // SLICE_WIDTH, []).append(b)
+    return m
+
+
+class Client:
+    """HTTP client against one host (plus owner discovery for imports)."""
+
+    def __init__(self, host: str, timeout: float = 30.0):
+        if not host:
+            raise ClientError("host required")
+        self.host = host
+        self.timeout = timeout
+
+    # -- low-level -----------------------------------------------------------
+
+    def _do(self, method: str, path: str, body: Optional[bytes] = None,
+            headers: Optional[dict] = None, host: Optional[str] = None
+            ) -> tuple[int, bytes]:
+        url = f"http://{host or self.host}{path}"
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers or {})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def _ok(self, status: int, body: bytes, what: str) -> bytes:
+        if status != 200:
+            raise ClientError(
+                f"{what}: invalid status: code={status},"
+                f" err={body.decode(errors='replace').strip()}")
+        return body
+
+    # -- queries (client.go:216-269) -----------------------------------------
+
+    def execute_query(self, node, index: str, query: str,
+                      slices: Optional[list[int]] = None,
+                      remote: bool = True,
+                      column_attrs: bool = False) -> list:
+        from ..server import codec
+        body = codec.encode_query_request(query, slices,
+                                          column_attrs=column_attrs,
+                                          remote=remote)
+        status, raw = self._do(
+            "POST", f"/index/{index}/query", body,
+            {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF},
+            host=_host_of(node) if node is not None else None)
+        self._ok(status, raw, "execute query")
+        resp = pb.QueryResponse.FromString(raw)
+        if resp.Err:
+            raise ClientError(resp.Err)
+        call_names = [c.name for c in pql.parse(query).calls]
+        return codec.decode_query_results(resp, call_names)
+
+    # -- schema / slices (client.go:63-136) ----------------------------------
+
+    def schema(self) -> list[dict]:
+        status, raw = self._do("GET", "/schema")
+        return json.loads(self._ok(status, raw, "schema"))["indexes"]
+
+    def max_slices(self, inverse: bool = False) -> dict[str, int]:
+        path = "/slices/max" + ("?inverse=true" if inverse else "")
+        status, raw = self._do("GET", path)
+        return json.loads(self._ok(status, raw, "max slices"))["maxSlices"]
+
+    def frame_views(self, index: str, frame: str) -> list[str]:
+        status, raw = self._do("GET",
+                               f"/index/{index}/frame/{frame}/views")
+        return json.loads(self._ok(status, raw, "frame views"))\
+            .get("views", [])
+
+    def create_index(self, index: str, options: Optional[dict] = None
+                     ) -> None:
+        body = json.dumps({"options": options or {}}).encode()
+        status, raw = self._do("POST", f"/index/{index}", body)
+        if status not in (200, 409):
+            self._ok(status, raw, "create index")
+
+    def create_frame(self, index: str, frame: str,
+                     options: Optional[dict] = None) -> None:
+        body = json.dumps({"options": options or {}}).encode()
+        status, raw = self._do("POST", f"/index/{index}/frame/{frame}",
+                               body)
+        if status not in (200, 409):
+            self._ok(status, raw, "create frame")
+
+    # -- import (client.go:304-389) ------------------------------------------
+
+    def fragment_nodes(self, index: str, slice: int) -> list[dict]:
+        status, raw = self._do(
+            "GET", f"/fragment/nodes?index={index}&slice={slice}")
+        return json.loads(self._ok(status, raw, "fragment nodes"))
+
+    def import_bits(self, index: str, frame: str, bits: list[Bit]) -> None:
+        """Group by slice, then POST each group to EVERY owner node."""
+        for slice, group in sorted(group_by_slice(bits).items()):
+            self._import_slice(index, frame, slice, group)
+
+    def _import_slice(self, index: str, frame: str, slice: int,
+                      bits: list[Bit]) -> None:
+        req = pb.ImportRequest(
+            Index=index, Frame=frame, Slice=slice,
+            RowIDs=[b.row_id for b in bits],
+            ColumnIDs=[b.column_id for b in bits],
+            Timestamps=[b.timestamp for b in bits])
+        body = req.SerializeToString()
+        nodes = self.fragment_nodes(index, slice)
+        if not nodes:
+            raise ClientError(f"no owner for slice {slice}")
+        for node in nodes:
+            status, raw = self._do(
+                "POST", "/import", body,
+                {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF},
+                host=node["host"])
+            self._ok(status, raw, f"import slice {slice}")
+            resp = pb.ImportResponse.FromString(raw)
+            if resp.Err:
+                raise ClientError(resp.Err)
+
+    def import_arrays(self, index: str, frame: str, row_ids, column_ids,
+                      timestamps=None) -> None:
+        rows = np.asarray(row_ids, dtype=np.uint64)
+        cols = np.asarray(column_ids, dtype=np.uint64)
+        ts = (np.zeros(len(rows), dtype=np.int64) if timestamps is None
+              else np.asarray(timestamps, dtype=np.int64))
+        bits = [Bit(int(r), int(c), int(t))
+                for r, c, t in zip(rows, cols, ts)]
+        self.import_bits(index, frame, bits)
+
+    # -- export (client.go:392-460) ------------------------------------------
+
+    def export_csv(self, index: str, frame: str, view: str, slice: int
+                   ) -> str:
+        """CSV of (row,column) for one slice, trying each owner until one
+        succeeds (client.go:407-418)."""
+        nodes = self.fragment_nodes(index, slice)
+        random.shuffle(nodes)
+        last_err = None
+        for node in nodes:
+            status, raw = self._do(
+                "GET",
+                f"/export?index={index}&frame={frame}&view={view}"
+                f"&slice={slice}", headers={"Accept": "text/csv"},
+                host=node["host"])
+            if status == 200:
+                return raw.decode()
+            last_err = ClientError(f"export: status={status}")
+        raise last_err or ClientError("no nodes")
+
+    # -- anti-entropy (client.go:798-974) ------------------------------------
+
+    def fragment_blocks(self, index: str, frame: str, view: str,
+                        slice: int, host: Optional[str] = None
+                        ) -> list[tuple[int, bytes]]:
+        from ..server import codec
+        status, raw = self._do(
+            "GET", f"/fragment/blocks?index={index}&frame={frame}"
+                   f"&view={view}&slice={slice}", host=host)
+        if status == 404:
+            raise FragmentNotFoundError()
+        return codec.blocks_from_json(
+            json.loads(self._ok(status, raw, "fragment blocks"))
+            .get("blocks") or [])
+
+    def block_data(self, index: str, frame: str, view: str, slice: int,
+                   block: int, host: Optional[str] = None
+                   ) -> tuple[np.ndarray, np.ndarray]:
+        req = pb.BlockDataRequest(Index=index, Frame=frame, View=view,
+                                  Slice=slice, Block=block)
+        status, raw = self._do(
+            "GET", "/fragment/block/data", req.SerializeToString(),
+            {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF}, host=host)
+        self._ok(status, raw, "block data")
+        resp = pb.BlockDataResponse.FromString(raw)
+        return (np.array(resp.RowIDs, dtype=np.uint64),
+                np.array(resp.ColumnIDs, dtype=np.uint64))
+
+    def column_attr_diff(self, index: str, blocks: list[tuple[int, bytes]],
+                         host: Optional[str] = None) -> dict[int, dict]:
+        return self._attr_diff(f"/index/{index}/attr/diff", blocks, host)
+
+    def row_attr_diff(self, index: str, frame: str,
+                      blocks: list[tuple[int, bytes]],
+                      host: Optional[str] = None) -> dict[int, dict]:
+        return self._attr_diff(f"/index/{index}/frame/{frame}/attr/diff",
+                               blocks, host)
+
+    def _attr_diff(self, path: str, blocks, host) -> dict[int, dict]:
+        from ..server import codec
+        body = json.dumps({"blocks": codec.blocks_to_json(blocks)}).encode()
+        status, raw = self._do("POST", path, body, host=host)
+        if status == 404:
+            raise FragmentNotFoundError()
+        attrs = json.loads(self._ok(status, raw, "attr diff"))["attrs"]
+        return {int(k): v for k, v in attrs.items()}
+
+    # -- backup / restore (client.go:463-674) --------------------------------
+
+    def backup_slice(self, index: str, frame: str, view: str, slice: int
+                     ) -> Optional[bytes]:
+        """Fragment tar stream from any owner; None if the slice doesn't
+        exist yet (client.go:541-551)."""
+        nodes = self.fragment_nodes(index, slice)
+        random.shuffle(nodes)
+        last_err: Optional[Exception] = None
+        for node in nodes:
+            status, raw = self._do(
+                "GET", f"/fragment/data?index={index}&frame={frame}"
+                       f"&view={view}&slice={slice}", host=node["host"])
+            if status == 200:
+                return raw
+            if status == 404:
+                return None
+            last_err = ClientError(f"backup slice: status={status}")
+        if last_err:
+            raise last_err
+        return None
+
+    def restore_slice(self, index: str, frame: str, view: str, slice: int,
+                      data: bytes) -> None:
+        status, raw = self._do(
+            "POST", f"/fragment/data?index={index}&frame={frame}"
+                    f"&view={view}&slice={slice}", data,
+            {"Content-Type": "application/octet-stream"})
+        self._ok(status, raw, "restore slice")
+
+    def restore_frame(self, host: str, index: str, frame: str) -> None:
+        """Ask this node to pull a frame from a remote cluster host
+        (client.go:677-695 → POST /index/{i}/frame/{f}/restore)."""
+        status, raw = self._do(
+            "POST", f"/index/{index}/frame/{frame}/restore?host={host}",
+            b"")
+        self._ok(status, raw, "restore frame")

@@ -91,7 +91,14 @@ def _count_fn(mesh: Mesh, op: str):
 
 def count_op(mesh: Mesh, op: str, a: jax.Array, b: jax.Array) -> int:
     """Count(op(a, b)) over slice-sharded packed blocks — the mesh form of
-    the executor's Count mapReduce (executor.go:568-597)."""
+    the executor's Count mapReduce (executor.go:568-597).
+
+    Limited to 2^15 total slice-rows: the psum'd 16-bit lo half overflows
+    int32 past that (same bound as kernels.op_count_total) — callers
+    chunk the slice axis above it.
+    """
+    if a.ndim > 1 and a.shape[0] > (1 << 15):
+        raise ValueError("count_op: more than 2^15 slice-rows per call")
     hi, lo = _count_fn(mesh, op)(a, b)
     return (int(hi) << 16) + int(lo)
 

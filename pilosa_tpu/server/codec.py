@@ -1,0 +1,161 @@
+"""Wire codecs shared by the HTTP handler and the node-to-node client.
+
+Converts between runtime objects (storage.Bitmap, cache.Pair, attr dicts)
+and the protobuf wire types (proto/internal.proto, field-number-compatible
+with the reference's internal/public.proto) plus the reference's JSON
+shapes (handler.go:1307-1397, bitmap.go:220-233, cache.go:292-293).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+from ..proto import internal_pb2 as pb
+from ..storage.attrs import (ATTR_TYPE_BOOL, ATTR_TYPE_FLOAT, ATTR_TYPE_INT,
+                             ATTR_TYPE_STRING)
+from ..storage.bitmap import Bitmap
+from ..storage.cache import Pair
+
+
+# -- attrs --------------------------------------------------------------------
+
+def encode_attr(key: str, v) -> pb.Attr:
+    a = pb.Attr(Key=key)
+    if isinstance(v, bool):
+        a.Type, a.BoolValue = ATTR_TYPE_BOOL, v
+    elif isinstance(v, str):
+        a.Type, a.StringValue = ATTR_TYPE_STRING, v
+    elif isinstance(v, int):
+        a.Type, a.IntValue = ATTR_TYPE_INT, v
+    elif isinstance(v, float):
+        a.Type, a.FloatValue = ATTR_TYPE_FLOAT, v
+    return a
+
+
+def encode_attr_list(m: dict) -> list[pb.Attr]:
+    return [encode_attr(k, m[k]) for k in sorted(m)]
+
+
+def decode_attr_list(attrs) -> dict:
+    m = {}
+    for a in attrs:
+        if a.Type == ATTR_TYPE_STRING:
+            m[a.Key] = a.StringValue
+        elif a.Type == ATTR_TYPE_INT:
+            m[a.Key] = a.IntValue
+        elif a.Type == ATTR_TYPE_BOOL:
+            m[a.Key] = a.BoolValue
+        elif a.Type == ATTR_TYPE_FLOAT:
+            m[a.Key] = a.FloatValue
+    return m
+
+
+# -- bitmap / pairs -----------------------------------------------------------
+
+def encode_bitmap(bm: Bitmap) -> pb.Bitmap:
+    return pb.Bitmap(Bits=[int(b) for b in bm.bits()],
+                     Attrs=encode_attr_list(bm.attrs))
+
+
+def decode_bitmap(msg: pb.Bitmap) -> Bitmap:
+    bm = Bitmap()
+    for bit in msg.Bits:
+        bm.set_bit(bit)
+    bm.attrs = decode_attr_list(msg.Attrs)
+    return bm
+
+
+def encode_pairs(pairs: list[Pair]) -> list[pb.Pair]:
+    return [pb.Pair(Key=p.id, Count=p.count) for p in pairs]
+
+
+def decode_pairs(msgs) -> list[Pair]:
+    return [Pair(m.Key, m.Count) for m in msgs]
+
+
+# -- query request / response -------------------------------------------------
+
+def encode_query_request(query: str, slices: Optional[list[int]] = None,
+                         column_attrs: bool = False, remote: bool = False
+                         ) -> bytes:
+    return pb.QueryRequest(Query=query, Slices=slices or [],
+                           ColumnAttrs=column_attrs,
+                           Remote=remote).SerializeToString()
+
+
+def encode_query_result(result) -> pb.QueryResult:
+    out = pb.QueryResult()
+    if isinstance(result, Bitmap):
+        out.Bitmap.CopyFrom(encode_bitmap(result))
+    elif isinstance(result, list):
+        out.Pairs.extend(encode_pairs(result))
+    elif isinstance(result, bool):
+        out.Changed = result
+    elif isinstance(result, int):
+        out.N = result
+    return out
+
+
+def encode_query_response(results: list, column_attr_sets=None,
+                          err: str = "") -> pb.QueryResponse:
+    resp = pb.QueryResponse(Err=err)
+    for r in results:
+        resp.Results.append(encode_query_result(r))
+    for id, attrs in (column_attr_sets or []):
+        resp.ColumnAttrSets.append(
+            pb.ColumnAttrSet(ID=id, Attrs=encode_attr_list(attrs)))
+    return resp
+
+
+def decode_query_results(resp: pb.QueryResponse, call_names: list[str]
+                         ) -> list:
+    """Decode per-call results by call name (executor.go:1058-1080)."""
+    out = []
+    for name, res in zip(call_names, resp.Results):
+        if name == "TopN":
+            out.append(decode_pairs(res.Pairs))
+        elif name == "Count":
+            out.append(int(res.N))
+        elif name in ("SetBit", "ClearBit"):
+            out.append(bool(res.Changed))
+        elif name in ("SetRowAttrs", "SetColumnAttrs"):
+            out.append(None)
+        else:
+            out.append(decode_bitmap(res.Bitmap))
+    return out
+
+
+# -- JSON shapes --------------------------------------------------------------
+
+def result_to_json(result):
+    if isinstance(result, Bitmap):
+        return result.to_json()
+    if isinstance(result, list):  # pairs
+        return [{"id": p.id, "count": p.count} for p in result]
+    return result  # int, bool, or None
+
+
+def query_response_json(results: list, column_attr_sets=None,
+                        err: str = "") -> dict:
+    out = {}
+    if results:
+        out["results"] = [result_to_json(r) for r in results]
+    if column_attr_sets:
+        out["columnAttrs"] = [
+            {"id": id, **({"attrs": attrs} if attrs else {})}
+            for id, attrs in column_attr_sets]
+    if err:
+        out["error"] = err
+    return out
+
+
+def blocks_to_json(blocks: list[tuple[int, bytes]]) -> list[dict]:
+    """FragmentBlock JSON: checksum bytes base64 like Go's []byte
+    (fragment.go:1270-1273)."""
+    return [{"id": bid, "checksum": base64.b64encode(chk).decode()}
+            for bid, chk in blocks]
+
+
+def blocks_from_json(objs: list[dict]) -> list[tuple[int, bytes]]:
+    return [(o["id"], base64.b64decode(o["checksum"])) for o in objs]

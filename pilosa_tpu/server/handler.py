@@ -1,0 +1,578 @@
+"""HTTP API: the reference's full route table as a WSGI application.
+
+Reference: handler.go (route table at handler.go:82-120). Content
+negotiation between JSON and ``application/x-protobuf`` mirrors
+handler.go:811-893; strict unknown-key validation of index/frame options
+mirrors handler.go:299-351,577-610.
+
+WSGI keeps the handler framework-free: tests call the app in-process
+(no sockets), and server.py serves it with the stdlib threading WSGI
+server — the Python analogue of the reference's net/http.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import re
+from typing import Callable, Optional
+from urllib.parse import parse_qs
+
+from .. import __version__
+from ..cluster.broadcast import NOP_BROADCASTER, unmarshal_message
+from ..errors import (FrameExistsError, IndexExistsError, PilosaError,
+                      validate_label)
+from ..models.frame import FrameOptions
+from ..models.index import IndexOptions
+from ..pql import parser as pql
+from ..proto import internal_pb2 as pb
+from ..storage.attrs import diff_blocks
+from ..storage.bitmap import Bitmap
+from ..utils import timequantum as tq
+from . import codec
+
+_PROTOBUF = "application/x-protobuf"
+
+# JSON keys accepted in POST /index and POST /frame options
+# (handler.go:299-351 validates against the Go struct tags).
+_VALID_INDEX_OPTIONS = {"columnLabel", "timeQuantum"}
+_VALID_FRAME_OPTIONS = {"rowLabel", "inverseEnabled", "cacheType",
+                        "cacheSize", "timeQuantum"}
+
+
+class HTTPError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+class Request:
+    """Decoded WSGI request."""
+
+    def __init__(self, environ: dict, vars: dict[str, str]):
+        self.environ = environ
+        self.vars = vars
+        self.query = {k: v[0] for k, v in
+                      parse_qs(environ.get("QUERY_STRING", "")).items()}
+
+    @property
+    def content_type(self) -> str:
+        return self.environ.get("CONTENT_TYPE", "")
+
+    @property
+    def accept(self) -> str:
+        return self.environ.get("HTTP_ACCEPT", "")
+
+    def body(self) -> bytes:
+        # Missing/invalid Content-Length reads as empty — an unbounded
+        # read() on the live socket would block the worker thread.
+        try:
+            length = int(self.environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            length = 0
+        stream = self.environ.get("wsgi.input")
+        if stream is None or length <= 0:
+            return b""
+        return stream.read(length)
+
+    def json(self) -> dict:
+        raw = self.body()
+        if not raw:
+            return {}
+        try:
+            return json.loads(raw)
+        except ValueError as e:
+            raise HTTPError(400, f"invalid JSON: {e}")
+
+    def uint_param(self, name: str) -> int:
+        v = self.query.get(name)
+        if v is None or not v.isdigit():
+            raise HTTPError(400, f"{name} required")
+        return int(v)
+
+
+class Response:
+    def __init__(self, status: int = 200, body: bytes = b"",
+                 content_type: str = "application/json"):
+        self.status = status
+        self.body = body
+        self.content_type = content_type
+
+    @staticmethod
+    def json(obj, status: int = 200) -> "Response":
+        return Response(status, (json.dumps(obj) + "\n").encode())
+
+    @staticmethod
+    def proto(msg, status: int = 200) -> "Response":
+        return Response(status, msg.SerializeToString(), _PROTOBUF)
+
+
+_STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
+                405: "Method Not Allowed", 406: "Not Acceptable",
+                409: "Conflict", 412: "Precondition Failed",
+                415: "Unsupported Media Type",
+                500: "Internal Server Error"}
+
+
+class Handler:
+    """Router + handlers. Executor is any object with
+    ``execute(index, query, slices, opt)`` — the mock seam used by the
+    handler tests, mirroring the reference's Handler.Executor interface
+    (handler.go:60-62)."""
+
+    def __init__(self, holder, executor, cluster=None, host: str = "",
+                 broadcaster=NOP_BROADCASTER, broadcast_handler=None,
+                 status_handler=None, stats=None, client_factory=None):
+        self.holder = holder
+        self.executor = executor
+        self.cluster = cluster
+        self.host = host
+        self.broadcaster = broadcaster
+        self.broadcast_handler = broadcast_handler
+        self.status_handler = status_handler
+        self.stats = stats
+        # client_factory(host) -> cluster.client.Client; injected to keep
+        # handler importable without the client (and mockable in tests).
+        self.client_factory = client_factory
+        self.version = __version__
+        self._routes: list[tuple[str, re.Pattern, Callable]] = []
+        self._add_routes()
+
+    # -- routing -------------------------------------------------------------
+
+    def _route(self, method: str, pattern: str, fn: Callable) -> None:
+        # {name} segments become named groups matching one path segment.
+        regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
+        self._routes.append((method, re.compile(f"^{regex}$"), fn))
+
+    def _add_routes(self) -> None:
+        # Route table (reference handler.go:82-120).
+        r = self._route
+        r("GET", "/", self._handle_webui)
+        r("GET", "/index", self._handle_get_schema)
+        r("GET", "/index/{index}", self._handle_get_index)
+        r("POST", "/index/{index}", self._handle_post_index)
+        r("DELETE", "/index/{index}", self._handle_delete_index)
+        r("POST", "/index/{index}/attr/diff", self._handle_index_attr_diff)
+        r("POST", "/index/{index}/frame/{frame}", self._handle_post_frame)
+        r("DELETE", "/index/{index}/frame/{frame}",
+          self._handle_delete_frame)
+        r("POST", "/index/{index}/query", self._handle_post_query)
+        r("POST", "/index/{index}/frame/{frame}/attr/diff",
+          self._handle_frame_attr_diff)
+        r("POST", "/index/{index}/frame/{frame}/restore",
+          self._handle_post_frame_restore)
+        r("PATCH", "/index/{index}/frame/{frame}/time-quantum",
+          self._handle_patch_frame_time_quantum)
+        r("GET", "/index/{index}/frame/{frame}/views",
+          self._handle_get_frame_views)
+        r("PATCH", "/index/{index}/time-quantum",
+          self._handle_patch_index_time_quantum)
+        r("GET", "/debug/vars", self._handle_expvar)
+        r("GET", "/export", self._handle_get_export)
+        r("GET", "/fragment/block/data", self._handle_fragment_block_data)
+        r("GET", "/fragment/blocks", self._handle_fragment_blocks)
+        r("GET", "/fragment/data", self._handle_get_fragment_data)
+        r("POST", "/fragment/data", self._handle_post_fragment_data)
+        r("GET", "/fragment/nodes", self._handle_fragment_nodes)
+        r("POST", "/import", self._handle_post_import)
+        r("GET", "/hosts", self._handle_get_hosts)
+        r("GET", "/schema", self._handle_get_schema)
+        r("GET", "/slices/max", self._handle_slice_max)
+        r("GET", "/status", self._handle_get_status)
+        r("GET", "/version", self._handle_get_version)
+        r("POST", "/messages", self._handle_post_message)
+
+    def __call__(self, environ, start_response):
+        method = environ.get("REQUEST_METHOD", "GET")
+        path = environ.get("PATH_INFO", "/")
+        matched_path = False
+        for m, regex, fn in self._routes:
+            match = regex.match(path)
+            if match is None:
+                continue
+            matched_path = True
+            if m != method:
+                continue
+            try:
+                resp = fn(Request(environ, match.groupdict()))
+            except HTTPError as e:
+                resp = Response(e.status, (e.message + "\n").encode(),
+                                "text/plain; charset=utf-8")
+            except PilosaError as e:
+                resp = Response(400, (str(e) + "\n").encode(),
+                                "text/plain; charset=utf-8")
+            except Exception as e:  # noqa: BLE001 - surface as 500
+                resp = Response(500, (str(e) + "\n").encode(),
+                                "text/plain; charset=utf-8")
+            break
+        else:
+            status = 405 if matched_path else 404
+            resp = Response(status,
+                            (_STATUS_TEXT[status] + "\n").encode(),
+                            "text/plain; charset=utf-8")
+        start_response(
+            f"{resp.status} {_STATUS_TEXT.get(resp.status, 'Unknown')}",
+            [("Content-Type", resp.content_type),
+             ("Content-Length", str(len(resp.body)))])
+        return [resp.body]
+
+    # -- meta ----------------------------------------------------------------
+
+    def _handle_webui(self, req: Request) -> Response:
+        return Response(200, b"<html><body><h1>pilosa-tpu</h1>"
+                             b"<p>POST PQL to /index/{index}/query</p>"
+                             b"</body></html>", "text/html; charset=utf-8")
+
+    def _handle_get_version(self, req: Request) -> Response:
+        return Response.json({"version": self.version})
+
+    def _handle_get_hosts(self, req: Request) -> Response:
+        nodes = self.cluster.nodes if self.cluster else []
+        return Response.json([{"host": n.host,
+                               "internalHost": n.internal_host}
+                              for n in nodes])
+
+    def _handle_get_status(self, req: Request) -> Response:
+        if self.status_handler is not None:
+            return Response.json(
+                {"status": self.status_handler.cluster_status()})
+        states = self.cluster.node_states() if self.cluster else {}
+        return Response.json({"status": {"Nodes": [
+            {"Host": h, "State": s} for h, s in sorted(states.items())]}})
+
+    def _handle_expvar(self, req: Request) -> Response:
+        snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") \
+            else {}
+        return Response.json(snap)
+
+    def _handle_get_schema(self, req: Request) -> Response:
+        return Response.json({"indexes": self.holder.schema()})
+
+    def _handle_slice_max(self, req: Request) -> Response:
+        inverse = req.query.get("inverse") == "true"
+        ms = (self.holder.max_inverse_slices() if inverse
+              else self.holder.max_slices())
+        if _PROTOBUF in req.accept:
+            return Response.proto(pb.MaxSlicesResponse(MaxSlices=ms))
+        return Response.json({"maxSlices": ms})
+
+    # -- index CRUD ----------------------------------------------------------
+
+    def _handle_get_index(self, req: Request) -> Response:
+        idx = self.holder.index(req.vars["index"])
+        if idx is None:
+            raise HTTPError(404, "index not found")
+        return Response.json({"index": {"name": idx.name}})
+
+    @staticmethod
+    def _validate_options(body: dict, valid: set[str]) -> dict:
+        # handler.go:299-351: any unknown key is an error.
+        for k in body:
+            if k != "options":
+                raise HTTPError(400, f"Unknown key: {k}")
+        options = body.get("options", {})
+        if not isinstance(options, dict):
+            raise HTTPError(400, "options is not map")
+        for k in options:
+            if k not in valid:
+                raise HTTPError(400, f"Unknown key: {k}:{options[k]}")
+        return options
+
+    def _handle_post_index(self, req: Request) -> Response:
+        name = req.vars["index"]
+        opts = self._validate_options(req.json(), _VALID_INDEX_OPTIONS)
+        options = IndexOptions(
+            column_label=opts.get("columnLabel", "columnID"),
+            time_quantum=tq.parse_time_quantum(opts.get("timeQuantum", "")))
+        validate_label(options.column_label)
+        try:
+            self.holder.create_index(name, options)
+        except IndexExistsError as e:
+            raise HTTPError(409, str(e))
+        self.broadcaster.send_sync(pb.CreateIndexMessage(
+            Index=name, Meta=options.encode()))
+        return Response.json({})
+
+    def _handle_delete_index(self, req: Request) -> Response:
+        name = req.vars["index"]
+        self.holder.delete_index(name)
+        self.broadcaster.send_sync(pb.DeleteIndexMessage(Index=name))
+        return Response.json({})
+
+    def _handle_patch_index_time_quantum(self, req: Request) -> Response:
+        q = tq.parse_time_quantum(req.json().get("timeQuantum", ""))
+        idx = self.holder.index(req.vars["index"])
+        if idx is None:
+            raise HTTPError(404, "index not found")
+        idx.set_time_quantum(q)
+        return Response.json({})
+
+    # -- frame CRUD ----------------------------------------------------------
+
+    def _handle_post_frame(self, req: Request) -> Response:
+        index_name, frame_name = req.vars["index"], req.vars["frame"]
+        opts = self._validate_options(req.json(), _VALID_FRAME_OPTIONS)
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise HTTPError(404, "index not found")
+        options = FrameOptions(
+            row_label=opts.get("rowLabel", "rowID"),
+            inverse_enabled=bool(opts.get("inverseEnabled", False)),
+            cache_type=opts.get("cacheType", "lru"),
+            cache_size=int(opts.get("cacheSize", 50000)),
+            time_quantum=tq.parse_time_quantum(opts.get("timeQuantum", "")))
+        try:
+            idx.create_frame(frame_name, options)
+        except FrameExistsError as e:
+            raise HTTPError(409, str(e))
+        self.broadcaster.send_sync(pb.CreateFrameMessage(
+            Index=index_name, Frame=frame_name, Meta=options.encode()))
+        return Response.json({})
+
+    def _handle_delete_frame(self, req: Request) -> Response:
+        index_name, frame_name = req.vars["index"], req.vars["frame"]
+        idx = self.holder.index(index_name)
+        if idx is None:
+            return Response.json({})
+        idx.delete_frame(frame_name)
+        self.broadcaster.send_sync(pb.DeleteFrameMessage(
+            Index=index_name, Frame=frame_name))
+        return Response.json({})
+
+    def _handle_patch_frame_time_quantum(self, req: Request) -> Response:
+        q = tq.parse_time_quantum(req.json().get("timeQuantum", ""))
+        frame = self.holder.frame(req.vars["index"], req.vars["frame"])
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        frame.set_time_quantum(q)
+        return Response.json({})
+
+    def _handle_get_frame_views(self, req: Request) -> Response:
+        frame = self.holder.frame(req.vars["index"], req.vars["frame"])
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        return Response.json({"views": sorted(frame.views)})
+
+    # -- query ---------------------------------------------------------------
+
+    def _handle_post_query(self, req: Request) -> Response:
+        index_name = req.vars["index"]
+        proto_out = _PROTOBUF in req.accept
+
+        def error_resp(status, msg):
+            if proto_out:
+                return Response.proto(pb.QueryResponse(Err=msg), status)
+            return Response.json({"error": msg}, status)
+
+        # Read request (handler.go:811-870).
+        if req.content_type == _PROTOBUF:
+            preq = pb.QueryRequest.FromString(req.body())
+            query_str = preq.Query
+            slices = list(preq.Slices)
+            column_attrs = preq.ColumnAttrs
+            remote = preq.Remote
+        else:
+            query_str = req.body().decode()
+            try:
+                slices = [int(s)
+                          for s in req.query.get("slices", "").split(",")
+                          if s != ""]
+            except ValueError:
+                return error_resp(400, "invalid slice argument")
+            column_attrs = req.query.get("columnAttrs") == "true"
+            remote = False
+
+        try:
+            query = pql.parse(query_str)
+        except PilosaError as e:
+            return error_resp(400, str(e))
+
+        from ..executor import ExecOptions
+        try:
+            results = self.executor.execute(
+                index_name, query, slices or None,
+                ExecOptions(remote=remote))
+        except PilosaError as e:
+            return error_resp(400, str(e))
+        except Exception as e:  # noqa: BLE001 - surfaced in response
+            return error_resp(500, str(e))
+
+        # Optional column-attribute join (handler.go:208-227).
+        attr_sets = []
+        if column_attrs:
+            idx = self.holder.index(index_name)
+            ids = sorted({int(b) for r in results
+                          if isinstance(r, Bitmap) for b in r.bits()})
+            for id in ids:
+                attrs = idx.column_attr_store.attrs(id)
+                if attrs:
+                    attr_sets.append((id, attrs))
+
+        if proto_out:
+            return Response.proto(
+                codec.encode_query_response(results, attr_sets))
+        return Response.json(
+            codec.query_response_json(results, attr_sets))
+
+    # -- attr diff (anti-entropy) --------------------------------------------
+
+    def _attr_diff(self, store, req: Request) -> Response:
+        body = req.json()
+        blocks = codec.blocks_from_json(body.get("blocks", []))
+        attrs = {}
+        for block_id in diff_blocks(store.blocks(), blocks):
+            for id, m in store.block_data(block_id).items():
+                attrs[str(id)] = m
+        return Response.json({"attrs": attrs})
+
+    def _handle_index_attr_diff(self, req: Request) -> Response:
+        idx = self.holder.index(req.vars["index"])
+        if idx is None:
+            raise HTTPError(404, "index not found")
+        return self._attr_diff(idx.column_attr_store, req)
+
+    def _handle_frame_attr_diff(self, req: Request) -> Response:
+        frame = self.holder.frame(req.vars["index"], req.vars["frame"])
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        return self._attr_diff(frame.row_attr_store, req)
+
+    # -- import / export -----------------------------------------------------
+
+    def _handle_post_import(self, req: Request) -> Response:
+        # Protobuf-only endpoint (handler.go:896-906).
+        if req.content_type != _PROTOBUF:
+            raise HTTPError(415, "Unsupported media type")
+        if req.accept != _PROTOBUF:
+            raise HTTPError(406, "Not acceptable")
+        ireq = pb.ImportRequest.FromString(req.body())
+        if self.cluster is not None and not self.cluster.owns_fragment(
+                self.host, ireq.Index, ireq.Slice):
+            raise HTTPError(412, f"host does not own slice"
+                                 f" {self.host}-{ireq.Index}"
+                                 f" slice:{ireq.Slice}")
+        idx = self.holder.index(ireq.Index)
+        if idx is None:
+            raise HTTPError(404, "index not found")
+        frame = idx.frame(ireq.Frame)
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        import datetime as dt
+        timestamps = [
+            dt.datetime.fromtimestamp(ts / 1e9, dt.timezone.utc)
+            .replace(tzinfo=None) if ts else None
+            for ts in ireq.Timestamps] if ireq.Timestamps else None
+        frame.import_bits(list(ireq.RowIDs), list(ireq.ColumnIDs),
+                          timestamps)
+        return Response.proto(pb.ImportResponse())
+
+    def _handle_get_export(self, req: Request) -> Response:
+        if req.accept != "text/csv":
+            raise HTTPError(406, "Not acceptable")
+        slice = req.uint_param("slice")
+        index = req.query.get("index", "")
+        if self.cluster is not None and not self.cluster.owns_fragment(
+                self.host, index, slice):
+            raise HTTPError(412, f"host does not own slice {self.host}"
+                                 f"-{index} slice:{slice}")
+        frag = self.holder.fragment(index, req.query.get("frame", ""),
+                                    req.query.get("view", ""), slice)
+        if frag is None:
+            return Response(200, b"", "text/csv")
+        buf = io.StringIO()
+        for row_id, col_id in frag.for_each_bit():
+            buf.write(f"{row_id},{col_id}\r\n")
+        return Response(200, buf.getvalue().encode(), "text/csv")
+
+    # -- fragment endpoints --------------------------------------------------
+
+    def _fragment_from_query(self, req: Request):
+        slice = req.uint_param("slice")
+        return self.holder.fragment(req.query.get("index", ""),
+                                    req.query.get("frame", ""),
+                                    req.query.get("view", ""), slice)
+
+    def _handle_fragment_nodes(self, req: Request) -> Response:
+        slice = req.uint_param("slice")
+        index = req.query.get("index", "")
+        nodes = (self.cluster.fragment_nodes(index, slice)
+                 if self.cluster else [])
+        return Response.json([{"host": n.host,
+                               "internalHost": n.internal_host}
+                              for n in nodes])
+
+    def _handle_fragment_blocks(self, req: Request) -> Response:
+        frag = self._fragment_from_query(req)
+        if frag is None:
+            raise HTTPError(404, "fragment not found")
+        return Response.json({"blocks": codec.blocks_to_json(frag.blocks())})
+
+    def _handle_fragment_block_data(self, req: Request) -> Response:
+        breq = pb.BlockDataRequest.FromString(req.body())
+        frag = self.holder.fragment(breq.Index, breq.Frame, breq.View,
+                                    breq.Slice)
+        if frag is None:
+            raise HTTPError(404, "fragment not found")
+        ps = frag.block_data(breq.Block)
+        return Response.proto(pb.BlockDataResponse(
+            RowIDs=[int(r) for r in ps.row_ids],
+            ColumnIDs=[int(c) for c in ps.column_ids]))
+
+    def _handle_get_fragment_data(self, req: Request) -> Response:
+        frag = self._fragment_from_query(req)
+        if frag is None:
+            raise HTTPError(404, "fragment not found")
+        buf = io.BytesIO()
+        frag.write_to(buf)
+        return Response(200, buf.getvalue(), "application/octet-stream")
+
+    def _handle_post_fragment_data(self, req: Request) -> Response:
+        slice = req.uint_param("slice")
+        frame = self.holder.frame(req.query.get("index", ""),
+                                  req.query.get("frame", ""))
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        view = frame.create_view_if_not_exists(req.query.get("view", ""))
+        frag = view.create_fragment_if_not_exists(slice)
+        frag.read_from(io.BytesIO(req.body()))
+        return Response.json({})
+
+    def _handle_post_frame_restore(self, req: Request) -> Response:
+        # Pull every owned slice of a frame from a remote cluster
+        # (handler.go:1180-1266).
+        index_name, frame_name = req.vars["index"], req.vars["frame"]
+        host = req.query.get("host")
+        if not host:
+            raise HTTPError(400, "host required")
+        if self.client_factory is None:
+            raise HTTPError(500, "no client factory configured")
+        client = self.client_factory(host)
+        max_slices = client.max_slices()
+        frame = self.holder.frame(index_name, frame_name)
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        views = client.frame_views(index_name, frame_name)
+        for slice in range(max_slices.get(index_name, 0) + 1):
+            if self.cluster is not None and not self.cluster.owns_fragment(
+                    self.host, index_name, slice):
+                continue
+            for view_name in views:
+                view = frame.create_view_if_not_exists(view_name)
+                frag = view.create_fragment_if_not_exists(slice)
+                rd = client.backup_slice(index_name, frame_name, view_name,
+                                         slice)
+                if rd is None:
+                    continue
+                frag.read_from(io.BytesIO(rd))
+        return Response.json({})
+
+    # -- broadcast ingest ----------------------------------------------------
+
+    def _handle_post_message(self, req: Request) -> Response:
+        if self.broadcast_handler is None:
+            raise HTTPError(404, "no broadcast handler")
+        self.broadcast_handler.receive_message(
+            unmarshal_message(req.body()))
+        return Response.json({})

@@ -1,0 +1,247 @@
+"""Server runtime: the composition root.
+
+Reference: server.go. Binds the listener (``:0`` supported), opens the
+Holder, wires Cluster/Broadcaster/Executor/Handler, serves HTTP on a
+threading WSGI server, and runs the background loops: anti-entropy
+(server.go:182-214), max-slice polling of peers (server.go:216-252), and
+the 1-minute cache flush (holder.go:324-358). ``receive_message`` applies
+the five schema-mutation broadcasts (server.go:255-300).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from socketserver import ThreadingMixIn
+from typing import Optional
+from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
+
+from ..cluster.broadcast import NOP_BROADCASTER, StaticNodeSet
+from ..cluster.client import Client
+from ..cluster.topology import Cluster, Node
+from ..executor import Executor
+from ..models.frame import FrameOptions
+from ..models.holder import Holder
+from ..models.index import IndexOptions
+from ..proto import internal_pb2 as pb
+from ..utils.stats import NOP
+from .handler import Handler
+
+DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0   # seconds (server.go:37)
+DEFAULT_POLLING_INTERVAL = 60.0         # max-slice poll (server.go:33)
+CACHE_FLUSH_INTERVAL = 60.0             # holder.go:31
+
+
+class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class _QuietHandler(WSGIRequestHandler):
+    def log_message(self, format, *args):  # noqa: A002 - WSGI signature
+        pass
+
+
+class Server:
+    """One pilosa-tpu node."""
+
+    def __init__(self, data_dir: str, host: str = "localhost:10101",
+                 cluster: Optional[Cluster] = None, broadcaster=None,
+                 broadcast_receiver=None, stats=NOP,
+                 anti_entropy_interval: float
+                 = DEFAULT_ANTI_ENTROPY_INTERVAL,
+                 polling_interval: float = DEFAULT_POLLING_INTERVAL):
+        self.data_dir = data_dir
+        self.host = host
+        self.cluster = cluster or Cluster(
+            nodes=[Node(host)], node_set=StaticNodeSet([Node(host)]))
+        self.broadcaster = broadcaster or NOP_BROADCASTER
+        self.broadcast_receiver = broadcast_receiver
+        self.stats = stats
+        self.anti_entropy_interval = anti_entropy_interval
+        self.polling_interval = polling_interval
+
+        self.holder = Holder(data_dir, on_create_slice=self._on_create_slice,
+                             stats=stats)
+        self.executor: Optional[Executor] = None
+        self.handler: Optional[Handler] = None
+
+        self._httpd = None
+        self._threads: list[threading.Thread] = []
+        self._closing = threading.Event()
+
+    # -- lifecycle (server.go:89-180) ----------------------------------------
+
+    def open(self) -> None:
+        bind_host, _, port_s = self.host.rpartition(":")
+        bind_host = bind_host or "localhost"
+        port = int(port_s or 10101)
+
+        self.holder.open()
+
+        client = _RoutingClient(self)
+        self.executor = Executor(self.holder, host=self.host,
+                                 cluster=self.cluster, client=client)
+        self.handler = Handler(
+            self.holder, self.executor, cluster=self.cluster,
+            host=self.host, broadcaster=self.broadcaster,
+            broadcast_handler=self, status_handler=self,
+            stats=self.stats, client_factory=Client)
+
+        self._httpd = make_server(bind_host, port, self.handler,
+                                  server_class=_ThreadingWSGIServer,
+                                  handler_class=_QuietHandler)
+        # Re-resolve the port for ":0" binds (server.go:98-106).
+        actual_port = self._httpd.server_address[1]
+        if actual_port != port:
+            new_host = f"{bind_host}:{actual_port}"
+            for n in self.cluster.nodes:
+                if n.host == self.host:
+                    n.host = new_host
+            self.host = new_host
+            self.executor.host = new_host
+            self.handler.host = new_host
+
+        if self.cluster.node_set is not None:
+            self.cluster.node_set.open()
+        if self.broadcast_receiver is not None:
+            self.broadcast_receiver.start(self)
+
+        self._spawn(self._serve, "http")
+        self._spawn(self._monitor_cache_flush, "cache-flush")
+        if self.polling_interval > 0:
+            self._spawn(self._monitor_max_slices, "max-slices")
+        if self.anti_entropy_interval > 0:
+            self._spawn(self._monitor_anti_entropy, "anti-entropy")
+
+    def close(self) -> None:
+        self._closing.set()
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self.cluster.node_set is not None:
+            self.cluster.node_set.close()
+        self.holder.close()
+
+    def _spawn(self, fn, name: str) -> None:
+        t = threading.Thread(target=fn, name=f"pilosa-{name}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _serve(self) -> None:
+        self._httpd.serve_forever(poll_interval=0.2)
+
+    # -- slice announcements (view.go:236-246) -------------------------------
+
+    def _on_create_slice(self, index: str, slice: int,
+                         inverse: bool) -> None:
+        try:
+            self.broadcaster.send_async(pb.CreateSliceMessage(
+                Index=index, Slice=slice, IsInverse=inverse))
+        except Exception:  # noqa: BLE001 - announcements are best-effort
+            pass
+
+    # -- background loops ----------------------------------------------------
+
+    def _loop(self, interval: float, fn) -> None:
+        while not self._closing.wait(interval):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - loops must survive errors
+                pass
+
+    def _monitor_cache_flush(self) -> None:
+        self._loop(CACHE_FLUSH_INTERVAL, self.holder.flush_caches)
+
+    def _monitor_max_slices(self) -> None:
+        # Poll peers' /slices/max and adopt larger values
+        # (server.go:216-252).
+        self._loop(self.polling_interval, self.poll_max_slices)
+
+    def poll_max_slices(self) -> None:
+        for node in self.cluster.nodes:
+            if node.host == self.host:
+                continue
+            client = Client(node.host)
+            for name, value in client.max_slices().items():
+                idx = self.holder.index(name)
+                if idx is not None:
+                    idx.set_remote_max_slice(value)
+            for name, value in client.max_slices(inverse=True).items():
+                idx = self.holder.index(name)
+                if idx is not None:
+                    idx.set_remote_max_inverse_slice(value)
+
+    def _monitor_anti_entropy(self) -> None:
+        from .syncer import HolderSyncer
+        self._loop(self.anti_entropy_interval,
+                   lambda: HolderSyncer(self.holder, self.host,
+                                        self.cluster).sync_holder())
+
+    # -- BroadcastHandler (server.go:255-300) --------------------------------
+
+    def receive_message(self, m) -> None:
+        if isinstance(m, pb.CreateSliceMessage):
+            idx = self.holder.index(m.Index)
+            if idx is None:
+                return
+            if m.IsInverse:
+                idx.set_remote_max_inverse_slice(m.Slice)
+            else:
+                idx.set_remote_max_slice(m.Slice)
+        elif isinstance(m, pb.CreateIndexMessage):
+            self.holder.create_index_if_not_exists(
+                m.Index, IndexOptions.decode(m.Meta))
+        elif isinstance(m, pb.DeleteIndexMessage):
+            self.holder.delete_index(m.Index)
+        elif isinstance(m, pb.CreateFrameMessage):
+            idx = self.holder.index(m.Index)
+            if idx is not None:
+                idx.create_frame_if_not_exists(
+                    m.Frame, FrameOptions.decode(m.Meta))
+        elif isinstance(m, pb.DeleteFrameMessage):
+            idx = self.holder.index(m.Index)
+            if idx is not None:
+                idx.delete_frame(m.Frame)
+        else:
+            raise ValueError(f"unexpected message: {m!r}")
+
+    # -- StatusHandler (server.go:306-440) -----------------------------------
+
+    def local_status(self) -> dict:
+        indexes = []
+        for name in sorted(self.holder.indexes):
+            idx = self.holder.indexes[name]
+            indexes.append({
+                "name": name,
+                "maxSlice": idx.max_slice(),
+                "frames": [{"name": fn} for fn in sorted(idx.frames)],
+            })
+        return {"host": self.host, "state": "OK", "indexes": indexes}
+
+    def cluster_status(self) -> dict:
+        return {"nodes": [
+            self.local_status() if n.host == self.host
+            else {"host": n.host,
+                  "state": self.cluster.node_states().get(n.host, "DOWN")}
+            for n in self.cluster.nodes]}
+
+    def handle_remote_status(self, status: dict) -> None:
+        """Merge a peer's schema into ours (server.go:344-387)."""
+        for idx_info in status.get("indexes", []):
+            idx = self.holder.create_index_if_not_exists(idx_info["name"])
+            idx.set_remote_max_slice(idx_info.get("maxSlice", 0))
+            for frame_info in idx_info.get("frames", []):
+                idx.create_frame_if_not_exists(frame_info["name"])
+
+
+class _RoutingClient:
+    """Executor transport that routes to whatever node is asked for
+    (the executor passes the target node per call)."""
+
+    def __init__(self, server: Server):
+        self.server = server
+
+    def execute_query(self, node, index, query, slices, remote):
+        return Client(node.host).execute_query(node, index, query, slices,
+                                               remote=remote)

@@ -38,6 +38,7 @@ from typing import Optional
 import numpy as np
 
 from .. import SLICE_WIDTH
+from ..errors import PilosaError
 from ..parallel.residency import DeviceRowCache
 from ..proto import internal_pb2 as pb
 from . import cache as cache_mod
@@ -74,6 +75,24 @@ class PairSet:
     def empty() -> "PairSet":
         z = np.empty(0, dtype=np.uint64)
         return PairSet(z, z)
+
+
+class _CappedReader:
+    """File-like reader limited to the first n bytes — the WAL may grow
+    after the size is captured, and tar headers carry a fixed size."""
+
+    def __init__(self, f, n: int):
+        self.f = f
+        self.remaining = n
+
+    def read(self, size: int = -1) -> bytes:
+        if self.remaining <= 0:
+            return b""
+        if size < 0 or size > self.remaining:
+            size = self.remaining
+        out = self.f.read(size)
+        self.remaining -= len(out)
+        return out
 
 
 class Fragment:
@@ -526,6 +545,72 @@ class Fragment:
         for pos in self.storage.values():
             pos = int(pos)
             yield pos // SLICE_WIDTH, base + pos % SLICE_WIDTH
+
+    # -- backup / restore (reference fragment.go:1096-1266) ------------------
+
+    def write_to(self, w) -> None:
+        """Stream the fragment as a tar archive of 'data' (snapshot+WAL
+        file bytes) and 'cache' (the TopN id sidecar). The data file is
+        streamed from disk, not buffered — a full slice is 128 MB+.
+        Size is captured under lock; the format is append-only so copying
+        up to that size outside the lock is safe (fragment.go:1113-1155).
+        """
+        import tarfile
+        self.flush_cache()
+        with self._mu:
+            data_size = os.path.getsize(self.path)
+        tw = tarfile.open(fileobj=w, mode="w|")
+        info = tarfile.TarInfo("data")
+        info.size = data_size
+        info.mode = 0o600
+        with open(self.path, "rb") as f:
+            tw.addfile(info, _CappedReader(f, data_size))
+        try:
+            with open(self.cache_path, "rb") as f:
+                cache_size = os.fstat(f.fileno()).st_size
+                cinfo = tarfile.TarInfo("cache")
+                cinfo.size = cache_size
+                cinfo.mode = 0o600
+                tw.addfile(cinfo, f)
+        except FileNotFoundError:
+            cinfo = tarfile.TarInfo("cache")
+            cinfo.size = 0
+            cinfo.mode = 0o600
+            tw.addfile(cinfo)
+        tw.close()
+
+    def read_from(self, r) -> None:
+        """Restore from a write_to tar stream: replace the data file,
+        reopen storage, reload the cache. Entries stream to disk in
+        chunks."""
+        import shutil
+        import tarfile
+        tr = tarfile.open(fileobj=r, mode="r|")
+        import io
+        with self._mu:
+            for info in tr:
+                src = tr.extractfile(info) or io.BytesIO()
+                if info.name == "data":
+                    self._close_storage()
+                    tmp = self.path + ".restoring"
+                    with open(tmp, "wb") as f:
+                        shutil.copyfileobj(src, f)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(tmp, self.path)
+                    self._open_storage()
+                    self.row_cache.clear()
+                    self.device.invalidate_all()
+                    self.checksums.clear()
+                elif info.name == "cache":
+                    with open(self.cache_path, "wb") as f:
+                        shutil.copyfileobj(src, f)
+                    self.cache = cache_mod.new_cache(self.cache_type,
+                                                     self.cache_size)
+                    self._open_cache()
+                else:
+                    raise PilosaError(f"invalid fragment archive file:"
+                                      f" {info.name!r}")
 
     # -- cache persistence ---------------------------------------------------
 

@@ -1,0 +1,302 @@
+"""HTTP handler tests, in-process WSGI with a real or mock executor
+(reference handler_test.go: mock Executor seam at handler.go:60-62)."""
+
+import io
+import json
+
+import pytest
+
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.proto import internal_pb2 as pb
+from pilosa_tpu.server.handler import Handler
+from pilosa_tpu.storage.bitmap import Bitmap
+from pilosa_tpu.storage.cache import Pair
+
+_PROTOBUF = "application/x-protobuf"
+
+
+def call(app, method, path, body=b"", content_type="", accept=""):
+    """Invoke a WSGI app in-process; returns (status_int, headers, body)."""
+    if "?" in path:
+        path, _, qs = path.partition("?")
+    else:
+        qs = ""
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "QUERY_STRING": qs,
+        "CONTENT_LENGTH": str(len(body)),
+        "wsgi.input": io.BytesIO(body),
+    }
+    if content_type:
+        environ["CONTENT_TYPE"] = content_type
+    if accept:
+        environ["HTTP_ACCEPT"] = accept
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = int(status.split()[0])
+        out["headers"] = dict(headers)
+
+    chunks = app(environ, start_response)
+    return out["status"], out["headers"], b"".join(chunks)
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+@pytest.fixture
+def handler(holder):
+    return Handler(holder, Executor(holder, host="local"), host="local")
+
+
+class MockExecutor:
+    def __init__(self, fn):
+        self.fn = fn
+
+    def execute(self, index, query, slices, opt):
+        return self.fn(index, query, slices, opt)
+
+
+class TestMeta:
+    def test_version(self, handler):
+        status, _, body = call(handler, "GET", "/version")
+        assert status == 200
+        assert "version" in json.loads(body)
+
+    def test_404(self, handler):
+        status, _, _ = call(handler, "GET", "/nope")
+        assert status == 404
+
+    def test_method_not_allowed(self, handler):
+        status, _, _ = call(handler, "GET", "/index/i/query")
+        assert status == 405
+
+    def test_schema(self, holder, handler):
+        holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        status, _, body = call(handler, "GET", "/schema")
+        assert status == 200
+        schema = json.loads(body)["indexes"]
+        assert schema[0]["name"] == "i"
+        assert schema[0]["frames"][0]["name"] == "f"
+
+    def test_slice_max(self, holder, handler):
+        holder.create_index_if_not_exists("i")
+        status, _, body = call(handler, "GET", "/slices/max")
+        assert json.loads(body) == {"maxSlices": {"i": 0}}
+        # protobuf negotiation
+        status, _, body = call(handler, "GET", "/slices/max",
+                               accept=_PROTOBUF)
+        assert pb.MaxSlicesResponse.FromString(body).MaxSlices["i"] == 0
+
+
+class TestIndexCRUD:
+    def test_create_get_delete(self, handler):
+        status, _, _ = call(handler, "POST", "/index/idx",
+                            json.dumps({}).encode())
+        assert status == 200
+        status, _, body = call(handler, "GET", "/index/idx")
+        assert json.loads(body) == {"index": {"name": "idx"}}
+        status, _, _ = call(handler, "POST", "/index/idx", b"{}")
+        assert status == 409  # conflict
+        status, _, _ = call(handler, "DELETE", "/index/idx")
+        assert status == 200
+        status, _, _ = call(handler, "GET", "/index/idx")
+        assert status == 404
+
+    def test_unknown_option_key_rejected(self, handler):
+        body = json.dumps({"options": {"bogus": 1}}).encode()
+        status, _, resp = call(handler, "POST", "/index/idx", body)
+        assert status == 400
+        assert b"Unknown key" in resp
+        body = json.dumps({"bogus": {}}).encode()
+        assert call(handler, "POST", "/index/idx", body)[0] == 400
+
+    def test_create_with_options(self, holder, handler):
+        body = json.dumps(
+            {"options": {"columnLabel": "cid", "timeQuantum": "YM"}}
+        ).encode()
+        assert call(handler, "POST", "/index/idx", body)[0] == 200
+        idx = holder.index("idx")
+        assert idx.column_label == "cid"
+        assert idx.time_quantum() == "YM"
+
+    def test_time_quantum_patch(self, holder, handler):
+        holder.create_index_if_not_exists("i")
+        body = json.dumps({"timeQuantum": "YMD"}).encode()
+        assert call(handler, "PATCH", "/index/i/time-quantum",
+                    body)[0] == 200
+        assert holder.index("i").time_quantum() == "YMD"
+
+
+class TestFrameCRUD:
+    def test_create_delete(self, holder, handler):
+        holder.create_index_if_not_exists("i")
+        body = json.dumps({"options": {"rowLabel": "rl",
+                                       "inverseEnabled": True,
+                                       "cacheType": "ranked"}}).encode()
+        assert call(handler, "POST", "/index/i/frame/f", body)[0] == 200
+        f = holder.frame("i", "f")
+        assert f.row_label == "rl" and f.inverse_enabled
+        assert call(handler, "POST", "/index/i/frame/f", b"{}")[0] == 409
+        assert call(handler, "DELETE", "/index/i/frame/f")[0] == 200
+        assert holder.frame("i", "f") is None
+
+    def test_views(self, holder, handler):
+        holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f").set_bit("standard", 1, 2)
+        status, _, body = call(handler, "GET", "/index/i/frame/f/views")
+        assert json.loads(body) == {"views": ["standard"]}
+
+
+class TestQuery:
+    def test_json_query_roundtrip(self, holder, handler):
+        holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        status, _, body = call(
+            handler, "POST", "/index/i/query",
+            b'SetBit(frame="f", rowID=1, columnID=2)')
+        assert status == 200
+        assert json.loads(body) == {"results": [True]}
+        status, _, body = call(handler, "POST", "/index/i/query",
+                               b"Bitmap(frame=\"f\", rowID=1)")
+        assert json.loads(body) == {
+            "results": [{"attrs": {}, "bits": [2]}]}
+        status, _, body = call(handler, "POST", "/index/i/query",
+                               b"Count(Bitmap(frame=\"f\", rowID=1))")
+        assert json.loads(body) == {"results": [1]}
+
+    def test_parse_error_400(self, holder, handler):
+        holder.create_index_if_not_exists("i")
+        status, _, body = call(handler, "POST", "/index/i/query", b"((")
+        assert status == 400
+        assert "error" in json.loads(body)
+
+    def test_protobuf_query(self, holder, handler):
+        holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f").set_bit("standard", 7, 9)
+        req = pb.QueryRequest(Query='Bitmap(frame="f", rowID=7)')
+        status, _, body = call(handler, "POST", "/index/i/query",
+                               req.SerializeToString(),
+                               content_type=_PROTOBUF, accept=_PROTOBUF)
+        assert status == 200
+        resp = pb.QueryResponse.FromString(body)
+        assert list(resp.Results[0].Bitmap.Bits) == [9]
+
+    def test_mock_executor_seam(self, holder):
+        seen = {}
+
+        def fn(index, query, slices, opt):
+            seen["args"] = (index, [c.name for c in query.calls], slices,
+                            opt.remote)
+            return [[Pair(5, 10)]]
+
+        h = Handler(holder, MockExecutor(fn), host="local")
+        req = pb.QueryRequest(Query="TopN(frame=\"f\", n=2)",
+                              Slices=[0, 1], Remote=True)
+        status, _, body = call(h, "POST", "/index/i/query",
+                               req.SerializeToString(),
+                               content_type=_PROTOBUF, accept=_PROTOBUF)
+        assert status == 200
+        assert seen["args"] == ("i", ["TopN"], [0, 1], True)
+        resp = pb.QueryResponse.FromString(body)
+        assert resp.Results[0].Pairs[0].Key == 5
+
+    def test_column_attrs_join(self, holder, handler):
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists("f").set_bit("standard", 1, 3)
+        idx.column_attr_store.set_attrs(3, {"name": "three"})
+        status, _, body = call(
+            handler, "POST", "/index/i/query?columnAttrs=true",
+            b"Bitmap(frame=\"f\", rowID=1)")
+        out = json.loads(body)
+        assert out["columnAttrs"] == [{"id": 3,
+                                       "attrs": {"name": "three"}}]
+
+
+class TestImportExport:
+    def test_import_requires_protobuf(self, handler):
+        assert call(handler, "POST", "/import", b"x")[0] == 415
+
+    def test_import_and_export(self, holder, handler):
+        holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        req = pb.ImportRequest(Index="i", Frame="f", Slice=0,
+                               RowIDs=[1, 1, 2], ColumnIDs=[3, 4, 5])
+        status, _, _ = call(handler, "POST", "/import",
+                            req.SerializeToString(),
+                            content_type=_PROTOBUF, accept=_PROTOBUF)
+        assert status == 200
+        status, _, body = call(
+            handler, "GET",
+            "/export?index=i&frame=f&view=standard&slice=0",
+            accept="text/csv")
+        assert status == 200
+        assert body.decode().splitlines() == ["1,3", "1,4", "2,5"]
+
+
+class TestFragmentEndpoints:
+    def _setup(self, holder):
+        f = holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        f.set_bit("standard", 1, 2)
+        f.set_bit("standard", 250, 9)
+        return f
+
+    def test_blocks(self, holder, handler):
+        self._setup(holder)
+        status, _, body = call(
+            handler, "GET",
+            "/fragment/blocks?index=i&frame=f&view=standard&slice=0")
+        blocks = json.loads(body)["blocks"]
+        assert [b["id"] for b in blocks] == [0, 2]
+
+    def test_block_data(self, holder, handler):
+        self._setup(holder)
+        req = pb.BlockDataRequest(Index="i", Frame="f", View="standard",
+                                  Slice=0, Block=2)
+        status, _, body = call(handler, "GET", "/fragment/block/data",
+                               req.SerializeToString(),
+                               content_type=_PROTOBUF)
+        resp = pb.BlockDataResponse.FromString(body)
+        assert list(resp.RowIDs) == [250]
+        assert list(resp.ColumnIDs) == [9]
+
+    def test_backup_restore_roundtrip(self, holder, handler, tmp_path):
+        self._setup(holder)
+        status, _, tarball = call(
+            handler, "GET",
+            "/fragment/data?index=i&frame=f&view=standard&slice=0")
+        assert status == 200
+
+        h2 = Holder(str(tmp_path / "data2"))
+        h2.open()
+        try:
+            h2.create_index_if_not_exists("i").create_frame_if_not_exists(
+                "f")
+            handler2 = Handler(h2, Executor(h2, host="x"), host="x")
+            status, _, _ = call(
+                handler2, "POST",
+                "/fragment/data?index=i&frame=f&view=standard&slice=0",
+                tarball)
+            assert status == 200
+            frag = h2.fragment("i", "f", "standard", 0)
+            assert frag.row(1).count() == 1
+            assert frag.row(250).count() == 1
+        finally:
+            h2.close()
+
+    def test_attr_diff(self, holder, handler):
+        idx = holder.create_index_if_not_exists("i")
+        idx.column_attr_store.set_attrs(5, {"x": 1})
+        status, _, body = call(handler, "POST", "/index/i/attr/diff",
+                               json.dumps({"blocks": []}).encode())
+        assert status == 200
+        assert json.loads(body)["attrs"] == {"5": {"x": 1}}
