@@ -1,0 +1,12 @@
+"""CLI entry point: ``python -m pilosa_tpu.cli <verb>``.
+
+Reference: cmd/ (cobra wiring) + ctl/ (command logic). Verbs: server,
+import, export, backup, restore, sort, check, inspect, bench, config.
+"""
+
+import sys
+
+from .commands import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,320 @@
+"""Command logic for the pilosa-tpu CLI.
+
+Reference: ctl/ — one Command per verb: server (ctl: server/server.go),
+import (ctl/import.go), export (ctl/export.go), backup/restore
+(ctl/backup.go, ctl/restore.go), sort (ctl/sort.go), check
+(ctl/check.go), inspect (ctl/inspect.go), bench (ctl/bench.go), config
+(ctl/config.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import datetime as dt
+import io
+import mmap
+import random
+import sys
+import time
+from typing import Optional
+
+from ..errors import TIME_FORMAT, PilosaError
+
+IMPORT_BUFFER_SIZE = 10_000_000  # bits per import batch (ctl/import.go:58)
+
+
+def _parse_csv_bits(stream, stderr):
+    """CSV rows → Bit triples, streamed (ctl/import.go:119-180)."""
+    from ..cluster.client import Bit
+    for rnum, record in enumerate(csv.reader(stream), 1):
+        if not record or record[0] == "":
+            continue
+        if len(record) < 2:
+            raise PilosaError(
+                f"bad column count on row {rnum}: col={len(record)}")
+        try:
+            row_id = int(record[0])
+        except ValueError:
+            raise PilosaError(
+                f"invalid row id on row {rnum}: {record[0]!r}")
+        try:
+            col_id = int(record[1])
+        except ValueError:
+            raise PilosaError(
+                f"invalid column id on row {rnum}: {record[1]!r}")
+        ts = 0
+        if len(record) > 2 and record[2]:
+            try:
+                t = dt.datetime.strptime(record[2], TIME_FORMAT)
+            except ValueError:
+                raise PilosaError(
+                    f"invalid timestamp on row {rnum}: {record[2]!r}")
+            ts = int(t.replace(tzinfo=dt.timezone.utc).timestamp() * 1e9)
+        yield Bit(row_id, col_id, ts)
+
+
+def cmd_server(args, stdout, stderr) -> int:
+    from ..cluster.broadcast import HTTPBroadcaster
+    from ..cluster.topology import Cluster, Node
+    from ..server.server import Server
+    from ..utils import config as config_mod
+
+    cfg = config_mod.load(args.config or "")
+    if args.data_dir:
+        cfg.data_dir = args.data_dir
+    if args.bind:
+        cfg.host = args.bind
+
+    cluster = None
+    if cfg.cluster.hosts:
+        nodes = []
+        internal = cfg.cluster.internal_hosts or [""] * len(
+            cfg.cluster.hosts)
+        for h, ih in zip(cfg.cluster.hosts, internal):
+            nodes.append(Node(h, internal_host=ih))
+        cluster = Cluster(nodes=nodes, replica_n=cfg.cluster.replica_n)
+
+    import os
+    server = Server(os.path.expanduser(cfg.data_dir), host=cfg.host,
+                    cluster=cluster,
+                    anti_entropy_interval=cfg.anti_entropy_interval,
+                    polling_interval=cfg.cluster.polling_interval)
+    server.open()
+    if cfg.cluster.type == "http":
+        server.broadcaster = HTTPBroadcaster(server)
+        server.handler.broadcaster = server.broadcaster
+    print(f"pilosa-tpu serving at http://{server.host} "
+          f"(data: {cfg.data_dir})", file=stdout, flush=True)
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        print("shutting down", file=stderr)
+        server.close()
+    return 0
+
+
+def cmd_import(args, stdout, stderr) -> int:
+    from ..cluster.client import Client
+    client = Client(args.host)
+
+    def import_stream(stream):
+        # Flush every IMPORT_BUFFER_SIZE bits so memory stays flat on
+        # multi-GB files (ctl/import.go:166-171).
+        buf = []
+        for bit in _parse_csv_bits(stream, stderr):
+            buf.append(bit)
+            if len(buf) >= IMPORT_BUFFER_SIZE:
+                print(f"importing {len(buf)} bits", file=stderr)
+                client.import_bits(args.index, args.frame, buf)
+                buf = []
+        if buf:
+            print(f"importing {len(buf)} bits", file=stderr)
+            client.import_bits(args.index, args.frame, buf)
+
+    for path in args.paths:
+        print(f"parsing: {path}", file=stderr)
+        if path == "-":
+            import_stream(sys.stdin)
+        else:
+            with open(path, newline="") as f:
+                import_stream(f)
+    return 0
+
+
+def cmd_export(args, stdout, stderr) -> int:
+    from ..cluster.client import Client
+    client = Client(args.host)
+    max_slice = client.max_slices().get(args.index, 0)
+    for slice in range(max_slice + 1):
+        stdout.write(client.export_csv(args.index, args.frame,
+                                       args.view, slice))
+    return 0
+
+
+def cmd_backup(args, stdout, stderr) -> int:
+    from ..cluster.client import Client
+    client = Client(args.host)
+    with open(args.output, "wb") as f:
+        client.backup_to(f, args.index, args.frame, args.view)
+    return 0
+
+
+def cmd_restore(args, stdout, stderr) -> int:
+    from ..cluster.client import Client
+    client = Client(args.host)
+    with open(args.input, "rb") as f:
+        client.restore_from(f, args.index, args.frame, args.view)
+    return 0
+
+
+def cmd_sort(args, stdout, stderr) -> int:
+    # Sort CSV rows by fragment bit position (ctl/sort.go:49-106).
+    from .. import SLICE_WIDTH
+    with open(args.path, newline="") as f:
+        bits = list(_parse_csv_bits(f, stderr))
+    bits.sort(key=lambda b: (b.column_id // SLICE_WIDTH,
+                             b.row_id * SLICE_WIDTH
+                             + b.column_id % SLICE_WIDTH))
+    for b in bits:
+        if b.timestamp:
+            t = dt.datetime.fromtimestamp(
+                b.timestamp / 1e9, dt.timezone.utc)
+            stdout.write(f"{b.row_id},{b.column_id},"
+                         f"{t.strftime(TIME_FORMAT)}\n")
+        else:
+            stdout.write(f"{b.row_id},{b.column_id}\n")
+    return 0
+
+
+def _mmap_bitmap(path: str):
+    from ..storage import roaring
+    with open(path, "rb") as f:
+        mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+    return roaring.Bitmap.unmarshal(mm, mapped=True), mm
+
+
+def cmd_check(args, stdout, stderr) -> int:
+    # Offline consistency check of fragment files (ctl/check.go:46-113).
+    from ..proto import internal_pb2 as pb
+    rc = 0
+    for path in args.paths:
+        if path.endswith(".cache"):
+            try:
+                with open(path, "rb") as f:
+                    pb.Cache.FromString(f.read())
+                print(f"{path}: ok", file=stdout)
+            except Exception as e:  # noqa: BLE001 - reported per file
+                print(f"{path}: {e}", file=stdout)
+                rc = 1
+            continue
+        if path.endswith(".snapshotting"):
+            print(f"{path}: snapshot file found (incomplete snapshot)",
+                  file=stdout)
+            continue
+        try:
+            bm, mm = _mmap_bitmap(path)
+            bm.check()
+            bm.unmap()
+            print(f"{path}: ok", file=stdout)
+        except Exception as e:  # noqa: BLE001 - reported per file
+            print(f"{path}: {e}", file=stdout)
+            rc = 1
+    return rc
+
+
+def cmd_inspect(args, stdout, stderr) -> int:
+    # Container stats dump (ctl/inspect.go:48-105).
+    bm, mm = _mmap_bitmap(args.path)
+    print("== Bitmap Info ==", file=stdout)
+    print(f"Containers: {len(bm.containers)}", file=stdout)
+    print(f"Operations: {bm.op_n}", file=stdout)
+    print("", file=stdout)
+    print("== Containers ==", file=stdout)
+    print(f"{'KEY':>12} {'TYPE':>6} {'N':>8}", file=stdout)
+    for key, c in zip(bm.keys, bm.containers):
+        typ = "array" if c.is_array() else "bitmap"
+        print(f"{int(key):>12} {typ:>6} {c.n:>8}", file=stdout)
+    bm.unmap()
+    return 0
+
+
+def cmd_bench(args, stdout, stderr) -> int:
+    # Random SetBit throughput through the full HTTP stack
+    # (ctl/bench.go:53-102).
+    from ..cluster.client import Client
+    if args.op != "set-bit":
+        print(f"unknown bench op: {args.op!r}", file=stderr)
+        return 1
+    client = Client(args.host)
+    max_row_id, max_column_id = 1000, 100000
+    rng = random.Random(0)
+    start = time.perf_counter()
+    for _ in range(args.n):
+        row = rng.randrange(max_row_id)
+        col = rng.randrange(max_column_id)
+        client.execute_query(
+            None, args.index,
+            f'SetBit(rowID={row}, frame="{args.frame}", columnID={col})',
+            remote=False)
+    elapsed = time.perf_counter() - start
+    print(f"Executed {args.n} operations in {elapsed:.3f}s "
+          f"({args.n / elapsed:0.3f} op/sec)", file=stdout)
+    return 0
+
+
+def cmd_config(args, stdout, stderr) -> int:
+    from ..utils.config import Config
+    stdout.write(Config().to_toml())
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pilosa-tpu",
+        description="TPU-native distributed bitmap index")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    s = sub.add_parser("server", help="run a pilosa-tpu node")
+    s.add_argument("-d", "--data-dir", default="")
+    s.add_argument("-b", "--bind", default="",
+                   help="host:port to listen on (default localhost:10101)")
+    s.add_argument("-c", "--config", default="", help="TOML config file")
+    s.set_defaults(fn=cmd_server)
+
+    def client_cmd(name, help, fn, **extra):
+        c = sub.add_parser(name, help=help)
+        c.add_argument("--host", default="localhost:10101")
+        c.add_argument("-i", "--index", required=extra.get("index", True))
+        c.add_argument("-f", "--frame", required=extra.get("frame", True))
+        c.set_defaults(fn=fn)
+        return c
+
+    c = client_cmd("import", "bulk-import CSV bits", cmd_import)
+    c.add_argument("paths", nargs="+", help="CSV files ('-' for stdin)")
+
+    c = client_cmd("export", "export frame as CSV", cmd_export)
+    c.add_argument("--view", default="standard")
+
+    c = client_cmd("backup", "backup a frame view to a tar file",
+                   cmd_backup)
+    c.add_argument("--view", default="standard")
+    c.add_argument("-o", "--output", required=True)
+
+    c = client_cmd("restore", "restore a frame view from a tar file",
+                   cmd_restore)
+    c.add_argument("--view", default="standard")
+    c.add_argument("input")
+
+    c = sub.add_parser("sort", help="sort CSV by fragment position")
+    c.add_argument("path")
+    c.set_defaults(fn=cmd_sort)
+
+    c = sub.add_parser("check", help="consistency-check fragment files")
+    c.add_argument("paths", nargs="+")
+    c.set_defaults(fn=cmd_check)
+
+    c = sub.add_parser("inspect", help="dump container stats of a file")
+    c.add_argument("path")
+    c.set_defaults(fn=cmd_inspect)
+
+    c = client_cmd("bench", "run benchmarks against a server", cmd_bench)
+    c.add_argument("--op", default="", help="benchmark operation"
+                                            " (set-bit)")
+    c.add_argument("-n", type=int, default=0, help="operation count")
+
+    c = sub.add_parser("config", help="print default configuration")
+    c.set_defaults(fn=cmd_config)
+    return p
+
+
+def main(argv: Optional[list[str]] = None, stdout=None, stderr=None) -> int:
+    stdout = stdout or sys.stdout
+    stderr = stderr or sys.stderr
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args, stdout, stderr)
+    except PilosaError as e:
+        print(f"error: {e}", file=stderr)
+        return 1

@@ -76,6 +76,11 @@ class Client:
                 return resp.status, resp.read()
         except urllib.error.HTTPError as e:
             return e.code, e.read()
+        except (urllib.error.URLError, OSError) as e:
+            # Unreachable host → ClientError so failover loops can catch
+            # and try the next owner.
+            raise ClientError(f"{method} http://{host or self.host}"
+                              f"{path}: {e}")
 
     def _ok(self, status: int, body: bytes, what: str) -> bytes:
         if status != 200:
@@ -190,11 +195,15 @@ class Client:
         random.shuffle(nodes)
         last_err = None
         for node in nodes:
-            status, raw = self._do(
-                "GET",
-                f"/export?index={index}&frame={frame}&view={view}"
-                f"&slice={slice}", headers={"Accept": "text/csv"},
-                host=node["host"])
+            try:
+                status, raw = self._do(
+                    "GET",
+                    f"/export?index={index}&frame={frame}&view={view}"
+                    f"&slice={slice}", headers={"Accept": "text/csv"},
+                    host=node["host"])
+            except ClientError as e:
+                last_err = e
+                continue
             if status == 200:
                 return raw.decode()
             last_err = ClientError(f"export: status={status}")
@@ -257,9 +266,14 @@ class Client:
         random.shuffle(nodes)
         last_err: Optional[Exception] = None
         for node in nodes:
-            status, raw = self._do(
-                "GET", f"/fragment/data?index={index}&frame={frame}"
-                       f"&view={view}&slice={slice}", host=node["host"])
+            try:
+                status, raw = self._do(
+                    "GET", f"/fragment/data?index={index}&frame={frame}"
+                           f"&view={view}&slice={slice}",
+                    host=node["host"])
+            except ClientError as e:
+                last_err = e
+                continue
             if status == 200:
                 return raw
             if status == 404:
@@ -276,6 +290,41 @@ class Client:
                     f"&view={view}&slice={slice}", data,
             {"Content-Type": "application/octet-stream"})
         self._ok(status, raw, "restore slice")
+
+    def backup_to(self, w, index: str, frame: str, view: str) -> None:
+        """Stream every slice of (index, frame, view) into a tar whose
+        entries are named by slice id (client.go:463-529)."""
+        import tarfile
+        tw = tarfile.open(fileobj=w, mode="w|")
+        max_slice = self.max_slices().get(index, 0)
+        for slice in range(max_slice + 1):
+            data = self.backup_slice(index, frame, view, slice)
+            if data is None:
+                continue
+            info = tarfile.TarInfo(str(slice))
+            info.size = len(data)
+            info.mode = 0o666
+            import io as _io
+            tw.addfile(info, _io.BytesIO(data))
+        tw.close()
+
+    def restore_from(self, r, index: str, frame: str, view: str) -> None:
+        """Restore a backup_to tar: push each slice entry to every owner
+        (client.go:583-674)."""
+        import tarfile
+        tr = tarfile.open(fileobj=r, mode="r|")
+        for info in tr:
+            if not info.name.isdigit():
+                raise ClientError(f"invalid backup entry: {info.name}")
+            slice = int(info.name)
+            data = tr.extractfile(info).read()
+            for node in self.fragment_nodes(index, slice):
+                status, raw = self._do(
+                    "POST", f"/fragment/data?index={index}&frame={frame}"
+                            f"&view={view}&slice={slice}", data,
+                    {"Content-Type": "application/octet-stream"},
+                    host=node["host"])
+                self._ok(status, raw, f"restore slice {slice}")
 
     def restore_frame(self, host: str, index: str, frame: str) -> None:
         """Ask this node to pull a frame from a remote cluster host

@@ -93,8 +93,10 @@ class Request:
 
 
 class Response:
-    def __init__(self, status: int = 200, body: bytes = b"",
+    def __init__(self, status: int = 200, body=b"",
                  content_type: str = "application/json"):
+        # body: bytes, or a readable file object (streamed in chunks —
+        # used for fragment backups, which can be 128 MB+).
         self.status = status
         self.body = body
         self.content_type = content_type
@@ -106,6 +108,17 @@ class Response:
     @staticmethod
     def proto(msg, status: int = 200) -> "Response":
         return Response(status, msg.SerializeToString(), _PROTOBUF)
+
+
+def _stream_chunks(f, chunk_size: int = 1 << 20):
+    try:
+        while True:
+            chunk = f.read(chunk_size)
+            if not chunk:
+                return
+            yield chunk
+    finally:
+        f.close()
 
 
 _STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
@@ -212,11 +225,17 @@ class Handler:
             resp = Response(status,
                             (_STATUS_TEXT[status] + "\n").encode(),
                             "text/plain; charset=utf-8")
-        start_response(
-            f"{resp.status} {_STATUS_TEXT.get(resp.status, 'Unknown')}",
-            [("Content-Type", resp.content_type),
-             ("Content-Length", str(len(resp.body)))])
-        return [resp.body]
+        status_line = (
+            f"{resp.status} {_STATUS_TEXT.get(resp.status, 'Unknown')}")
+        if isinstance(resp.body, bytes):
+            start_response(status_line,
+                           [("Content-Type", resp.content_type),
+                            ("Content-Length", str(len(resp.body)))])
+            return [resp.body]
+        # Streamed file-object body.
+        start_response(status_line,
+                       [("Content-Type", resp.content_type)])
+        return _stream_chunks(resp.body)
 
     # -- meta ----------------------------------------------------------------
 
@@ -524,9 +543,13 @@ class Handler:
         frag = self._fragment_from_query(req)
         if frag is None:
             raise HTTPError(404, "fragment not found")
-        buf = io.BytesIO()
-        frag.write_to(buf)
-        return Response(200, buf.getvalue(), "application/octet-stream")
+        # Spool to disk above 8 MB so concurrent 128 MB+ backups don't
+        # each hold the whole archive in memory.
+        import tempfile
+        spool = tempfile.SpooledTemporaryFile(max_size=8 << 20)
+        frag.write_to(spool)
+        spool.seek(0)
+        return Response(200, spool, "application/octet-stream")
 
     def _handle_post_fragment_data(self, req: Request) -> Response:
         slice = req.uint_param("slice")

@@ -19,6 +19,7 @@ from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 from ..cluster.broadcast import NOP_BROADCASTER, StaticNodeSet
 from ..cluster.client import Client
 from ..cluster.topology import Cluster, Node
+from ..errors import PilosaError
 from ..executor import Executor
 from ..models.frame import FrameOptions
 from ..models.holder import Holder
@@ -73,9 +74,15 @@ class Server:
     # -- lifecycle (server.go:89-180) ----------------------------------------
 
     def open(self) -> None:
-        bind_host, _, port_s = self.host.rpartition(":")
+        bind_host, sep, port_s = self.host.rpartition(":")
+        if not sep:  # bare hostname, no port
+            bind_host, port_s = self.host, ""
         bind_host = bind_host or "localhost"
-        port = int(port_s or 10101)
+        try:
+            port = int(port_s) if port_s else 10101
+        except ValueError:
+            raise PilosaError(f"invalid host: {self.host!r}"
+                              " (expected host:port)")
 
         self.holder.open()
 

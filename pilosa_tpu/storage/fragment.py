@@ -557,13 +557,18 @@ class Fragment:
         """
         import tarfile
         self.flush_cache()
-        with self._mu:
-            data_size = os.path.getsize(self.path)
+        # Open the fd FIRST, then size it under lock: a concurrent
+        # snapshot() os.replace()s the path, but this fd pins the old
+        # inode, which only ever grows by appended ops — so copying
+        # exactly fstat-size bytes from it is a consistent snapshot+WAL
+        # prefix (same trick as fragment.go:1113-1151).
         tw = tarfile.open(fileobj=w, mode="w|")
-        info = tarfile.TarInfo("data")
-        info.size = data_size
-        info.mode = 0o600
         with open(self.path, "rb") as f:
+            with self._mu:
+                data_size = os.fstat(f.fileno()).st_size
+            info = tarfile.TarInfo("data")
+            info.size = data_size
+            info.mode = 0o600
             tw.addfile(info, _CappedReader(f, data_size))
         try:
             with open(self.cache_path, "rb") as f:

@@ -1,0 +1,111 @@
+"""Configuration: TOML file + PILOSA_* environment + flags.
+
+Reference: config.go (schema at config.go:34-57, defaults :59-71) and
+cmd/root.go:99-153 (viper merge priority: flags > env > file). The same
+priority holds here: load() starts from defaults, overlays the TOML
+file, then ``PILOSA_*`` environment variables, and the CLI overlays
+explicit flags last.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import tomllib
+from dataclasses import dataclass, field
+
+DEFAULT_HOST = "localhost"
+DEFAULT_PORT = "10101"
+DEFAULT_CLUSTER_TYPE = "static"
+DEFAULT_REPLICA_N = 1
+DEFAULT_POLLING_INTERVAL = 60.0
+DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0
+
+
+def parse_duration(v) -> float:
+    """Go-style duration string ("10m", "1h30m", "45s") → seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    units = {"ns": 1e-9, "us": 1e-6, "ms": 1e-3, "s": 1.0, "m": 60.0,
+             "h": 3600.0}
+    total = 0.0
+    matched = False
+    for num, unit in re.findall(r"([0-9.]+)(ns|us|ms|s|m|h)", str(v)):
+        total += float(num) * units[unit]
+        matched = True
+    if not matched:
+        raise ValueError(f"invalid duration: {v!r}")
+    return total
+
+
+@dataclass
+class ClusterConfig:
+    replica_n: int = DEFAULT_REPLICA_N
+    type: str = DEFAULT_CLUSTER_TYPE          # static | http
+    hosts: list[str] = field(default_factory=list)
+    internal_hosts: list[str] = field(default_factory=list)
+    polling_interval: float = DEFAULT_POLLING_INTERVAL
+
+
+@dataclass
+class Config:
+    data_dir: str = "~/.pilosa"
+    host: str = f"{DEFAULT_HOST}:{DEFAULT_PORT}"
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
+    log_path: str = ""
+
+    def to_toml(self) -> str:
+        hosts = ", ".join(f'"{h}"' for h in self.cluster.hosts)
+        internal = ", ".join(f'"{h}"' for h in self.cluster.internal_hosts)
+        return f"""data-dir = "{self.data_dir}"
+host = "{self.host}"
+log-path = "{self.log_path}"
+
+[cluster]
+replicas = {self.cluster.replica_n}
+type = "{self.cluster.type}"
+hosts = [{hosts}]
+internal-hosts = [{internal}]
+polling-interval = "{int(self.cluster.polling_interval)}s"
+
+[anti-entropy]
+interval = "{int(self.anti_entropy_interval)}s"
+"""
+
+
+def load(path: str = "", env: dict | None = None) -> Config:
+    """Defaults ← TOML file ← PILOSA_* env (cmd/root.go:99-153)."""
+    cfg = Config()
+    if path:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        cfg.data_dir = data.get("data-dir", cfg.data_dir)
+        cfg.host = data.get("host", cfg.host)
+        cfg.log_path = data.get("log-path", cfg.log_path)
+        cl = data.get("cluster", {})
+        cfg.cluster.replica_n = int(cl.get("replicas",
+                                           cfg.cluster.replica_n))
+        cfg.cluster.type = cl.get("type", cfg.cluster.type)
+        cfg.cluster.hosts = list(cl.get("hosts", cfg.cluster.hosts))
+        cfg.cluster.internal_hosts = list(
+            cl.get("internal-hosts", cfg.cluster.internal_hosts))
+        if "polling-interval" in cl:
+            cfg.cluster.polling_interval = parse_duration(
+                cl["polling-interval"])
+        ae = data.get("anti-entropy", {})
+        if "interval" in ae:
+            cfg.anti_entropy_interval = parse_duration(ae["interval"])
+    env = os.environ if env is None else env
+    if env.get("PILOSA_DATA_DIR"):
+        cfg.data_dir = env["PILOSA_DATA_DIR"]
+    if env.get("PILOSA_HOST"):
+        cfg.host = env["PILOSA_HOST"]
+    if env.get("PILOSA_CLUSTER_TYPE"):
+        cfg.cluster.type = env["PILOSA_CLUSTER_TYPE"]
+    if env.get("PILOSA_CLUSTER_HOSTS"):
+        cfg.cluster.hosts = [h.strip() for h in
+                             env["PILOSA_CLUSTER_HOSTS"].split(",") if h]
+    if env.get("PILOSA_CLUSTER_REPLICAS"):
+        cfg.cluster.replica_n = int(env["PILOSA_CLUSTER_REPLICAS"])
+    return cfg
