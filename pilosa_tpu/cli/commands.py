@@ -76,10 +76,25 @@ def cmd_server(args, stdout, stderr) -> int:
         cluster = Cluster(nodes=nodes, replica_n=cfg.cluster.replica_n)
 
     import os
+    broadcast_receiver = None
+    gossip_set = None
+    if cfg.cluster.type == "gossip":
+        from ..cluster.gossip import GossipNodeSet
+        bind_host = cfg.host.rpartition(":")[0] or "localhost"
+        gossip_set = GossipNodeSet(
+            cfg.host, gossip_host=f"{bind_host}:{cfg.cluster.internal_port}",
+            seeds=[cfg.cluster.gossip_seed] if cfg.cluster.gossip_seed
+            else [])
+        if cluster is None:
+            cluster = Cluster(nodes=[Node(cfg.host)])
+        cluster.node_set = gossip_set
+        broadcast_receiver = gossip_set
     server = Server(os.path.expanduser(cfg.data_dir), host=cfg.host,
-                    cluster=cluster,
+                    cluster=cluster, broadcast_receiver=broadcast_receiver,
                     anti_entropy_interval=cfg.anti_entropy_interval,
                     polling_interval=cfg.cluster.polling_interval)
+    if gossip_set is not None:
+        server.broadcaster = gossip_set
     server.open()
     if cfg.cluster.type == "http":
         server.broadcaster = HTTPBroadcaster(server)

@@ -1,0 +1,505 @@
+"""Gossip membership backend: SWIM-lite memberlist equivalent.
+
+Reference: gossip/gossip.go. There, ``GossipNodeSet`` is simultaneously a
+NodeSet, Broadcaster, BroadcastReceiver, and memberlist.Delegate
+(gossip.go:31-45): sync sends go direct-TCP to every member
+(gossip.go:124-149), async sends ride a retransmit-limited gossip queue
+(gossip.go:152-164), and full-state push/pull anti-entropy exchanges a
+protobuf ``NodeStatus`` carrying schema + owned slices (gossip.go:193-222,
+status built at server.go:306-323).
+
+hashicorp/memberlist is Go-only, so this module implements the same
+behavior directly on sockets — a deliberately small SWIM variant:
+
+- **UDP** carries probes (ping/ack), piggybacked membership updates
+  (alive/dead rumors with incarnation numbers), and piggybacked broadcast
+  envelopes with a retransmit budget of ``retransmit_mult*ceil(log2(n+1))``
+  (memberlist's TransmitLimitedQueue policy).
+- **TCP** carries sync broadcasts (one frame per connection) and the
+  push/pull full-state exchange used for join and periodic anti-entropy.
+- Failure detection: a member that misses ``suspect_after`` consecutive
+  probes is declared dead and the rumor gossips; a node hearing it is dead
+  refutes with a higher incarnation (SWIM's refutation rule).
+
+Membership stays a host-side CPU concern in the TPU build — it is
+metadata over DCN; only bitmap reductions ride ICI (parallel.mesh).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import math
+import random
+import socket
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .broadcast import marshal_message, unmarshal_message
+from .topology import Node
+
+DEFAULT_GOSSIP_PORT = 14000      # reference internal/gossip port default
+
+STATE_ALIVE = "alive"
+STATE_DEAD = "dead"
+
+
+@dataclass
+class Member:
+    name: str                    # cluster identity: the node's HTTP host
+    addr: str                    # gossip "host:port"
+    incarnation: int = 0
+    state: str = STATE_ALIVE
+    fails: int = field(default=0, compare=False)
+
+    def to_wire(self) -> dict:
+        return {"name": self.name, "addr": self.addr,
+                "inc": self.incarnation, "state": self.state}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "Member":
+        return cls(d["name"], d["addr"], int(d["inc"]), d["state"])
+
+
+def _split_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "localhost", int(port)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("short frame header")
+        hdr += chunk
+    (n,) = struct.unpack(">I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(65536, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("short frame body")
+        buf += chunk
+    return buf
+
+
+def _send_frame(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(struct.pack(">I", len(data)) + data)
+
+
+class GossipNodeSet:
+    """NodeSet + Broadcaster + BroadcastReceiver over SWIM-lite gossip.
+
+    Mirrors gossip.go:31-243. ``host`` is the node's HTTP host (its
+    cluster identity, like memberlist's node Name); ``gossip_host`` is the
+    UDP/TCP bind for the membership protocol; ``seeds`` are peers'
+    gossip addresses contacted on open (gossip.go:63-86 join).
+    """
+
+    def __init__(self, host: str, gossip_host: str = "",
+                 seeds: Optional[list[str]] = None,
+                 probe_interval: float = 1.0, probe_timeout: float = 0.5,
+                 push_pull_interval: float = 15.0, suspect_after: int = 3,
+                 retransmit_mult: int = 3):
+        self.host = host
+        self.gossip_host = gossip_host or f"localhost:{DEFAULT_GOSSIP_PORT}"
+        self.seeds = list(seeds or [])
+        self.probe_interval = probe_interval
+        self.probe_timeout = probe_timeout
+        self.push_pull_interval = push_pull_interval
+        self.suspect_after = suspect_after
+        self.retransmit_mult = retransmit_mult
+
+        self._handler = None          # server: BroadcastHandler+StatusHandler
+        self._mu = threading.Lock()
+        self._members: dict[str, Member] = {}   # keyed by name
+        # Gossip queue entries: [msg-id, b64-envelope, remaining-transmits].
+        self._queue: list[list] = []
+        self._seen: dict[str, None] = {}  # bounded FIFO of delivered ids
+        self._bcast_n = 0
+        self._seq = 0
+        self._acks: dict[int, threading.Event] = {}
+        self._udp: Optional[socket.socket] = None
+        self._tcp: Optional[socket.socket] = None
+        self._closing = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    # -- BroadcastReceiver (broadcast.go:100-107) ----------------------------
+
+    def start(self, handler) -> None:
+        """Attach the server (BroadcastHandler + StatusHandler)."""
+        self._handler = handler
+
+    # -- NodeSet (broadcast.go:26-33) ----------------------------------------
+
+    def open(self) -> None:
+        bind_host, port = _split_addr(self.gossip_host)
+        if port == 0:
+            # ":0" support — pick a port the kernel grants in BOTH spaces
+            # (a free UDP port may be TCP-taken; retry on EADDRINUSE).
+            for _ in range(16):
+                udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                udp.bind((bind_host, 0))
+                actual = udp.getsockname()[1]
+                tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                try:
+                    tcp.bind((bind_host, actual))
+                except OSError:
+                    udp.close()
+                    tcp.close()
+                    continue
+                self._udp, self._tcp, port = udp, tcp, actual
+                break
+            else:
+                raise OSError("no port bindable for both UDP and TCP")
+        else:
+            self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._udp.bind((bind_host, port))
+            self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._tcp.bind((bind_host, port))
+        self._tcp.listen(16)
+        # Advertise a peer-reachable address: a wildcard bind is useless
+        # to remote nodes, so fall back to this host's primary IP.
+        adv_host = bind_host
+        if adv_host in ("", "0.0.0.0", "::"):
+            adv_host = _primary_ip()
+        self.gossip_host = f"{adv_host}:{port}"
+        with self._mu:
+            self._members[self.host] = Member(self.host, self.gossip_host)
+
+        for name, target in (("udp", self._udp_loop),
+                             ("tcp", self._tcp_loop),
+                             ("probe", self._probe_loop),
+                             ("pushpull", self._push_pull_loop)):
+            t = threading.Thread(target=target, name=f"gossip-{name}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+        for seed in self.seeds:
+            if seed and seed != self.gossip_host:
+                try:
+                    self._push_pull(seed)
+                except OSError:
+                    pass  # seed down; periodic push/pull will retry
+
+    def close(self) -> None:
+        self._closing.set()
+        for s in (self._udp, self._tcp):
+            if s is not None:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def nodes(self) -> list[Node]:
+        with self._mu:
+            return [Node(m.name) for m in
+                    sorted(self._members.values(), key=lambda m: m.name)
+                    if m.state == STATE_ALIVE]
+
+    def join(self, nodes) -> None:  # parity with StaticNodeSet
+        for n in nodes:
+            addr = getattr(n, "internal_host", "") or ""
+            if addr:
+                try:
+                    self._push_pull(addr)
+                except OSError:
+                    pass
+
+    # -- Broadcaster (gossip.go:124-164) -------------------------------------
+
+    def send_sync(self, m) -> None:
+        """Direct TCP frame to every alive member (gossip.go:124-149)."""
+        data = marshal_message(m)
+        errs: list[Exception] = []
+        threads = []
+
+        def send(addr: str) -> None:
+            try:
+                self._tcp_request(addr, {"t": "bcast",
+                                         "data": _b64(data)})
+            except Exception as e:  # noqa: BLE001 - collected below
+                errs.append(e)
+
+        for mem in self._alive_peers():
+            t = threading.Thread(target=send, args=(mem.addr,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def send_async(self, m) -> None:
+        """Queue for piggybacked gossip (TransmitLimitedQueue,
+        gossip.go:152-164)."""
+        data = marshal_message(m)
+        with self._mu:
+            self._bcast_n += 1
+            msg_id = f"{self.host}#{self._bcast_n}"
+            n = max(1, len(self._members))
+            budget = self.retransmit_mult * max(
+                1, math.ceil(math.log2(n + 1)))
+            self._queue.append([msg_id, _b64(data), budget])
+            self._mark_seen(msg_id)  # don't deliver our own rumor locally
+
+    # -- membership internals ------------------------------------------------
+
+    def _alive_peers(self) -> list[Member]:
+        with self._mu:
+            return [m for m in self._members.values()
+                    if m.state == STATE_ALIVE and m.name != self.host]
+
+    def _merge_member(self, w: Member) -> None:
+        """SWIM merge rule: higher incarnation wins; on a tie, dead beats
+        alive. A dead rumor about *ourselves* is refuted by re-announcing
+        alive with a bumped incarnation."""
+        deliver_update = False
+        with self._mu:
+            cur = self._members.get(w.name)
+            if w.name == self.host:
+                me = self._members[self.host]
+                if w.state == STATE_DEAD and w.incarnation >= me.incarnation:
+                    me.incarnation = w.incarnation + 1  # refute
+                    deliver_update = True
+            elif cur is None:
+                self._members[w.name] = Member(w.name, w.addr,
+                                               w.incarnation, w.state)
+                deliver_update = True
+            elif (w.incarnation > cur.incarnation
+                  or (w.incarnation == cur.incarnation
+                      and w.state == STATE_DEAD
+                      and cur.state != STATE_DEAD)):
+                cur.incarnation = w.incarnation
+                cur.state = w.state
+                cur.addr = w.addr
+                cur.fails = 0
+                deliver_update = True
+        if deliver_update:
+            self._gossip_update(self._member_snapshot(w.name))
+
+    def _member_snapshot(self, name: str) -> Member:
+        with self._mu:
+            m = self._members[name]
+            return Member(m.name, m.addr, m.incarnation, m.state)
+
+    def _gossip_update(self, m: Member) -> None:
+        """Spread a membership rumor to a few random peers immediately."""
+        pkt = self._packet("update", updates=[m.to_wire()])
+        peers = self._alive_peers()
+        for peer in random.sample(peers, min(3, len(peers))):
+            self._udp_send(peer.addr, pkt)
+
+    # -- packet plumbing -----------------------------------------------------
+
+    def _mark_seen(self, msg_id: str) -> None:
+        """Bounded FIFO dedup of delivered gossip message ids (must hold
+        self._mu)."""
+        self._seen[msg_id] = None
+        while len(self._seen) > 4096:
+            self._seen.pop(next(iter(self._seen)))
+
+    def _packet(self, typ: str, **kw) -> dict:
+        """Every UDP packet piggybacks membership + queued broadcasts."""
+        with self._mu:
+            updates = [m.to_wire() for m in self._members.values()]
+            bcasts = []
+            for entry in self._queue:
+                bcasts.append({"id": entry[0], "data": entry[1]})
+                entry[2] -= 1
+            self._queue = [e for e in self._queue if e[2] > 0]
+        return {"t": typ, "from": self.host,
+                "updates": updates, "bcasts": bcasts, **kw}
+
+    def _udp_send(self, addr: str, pkt: dict) -> None:
+        try:
+            self._udp.sendto(json.dumps(pkt).encode(), _split_addr(addr))
+        except OSError:
+            pass
+
+    def _udp_loop(self) -> None:
+        while not self._closing.is_set():
+            try:
+                buf, src = self._udp.recvfrom(65536)
+            except OSError:
+                return
+            try:
+                pkt = json.loads(buf.decode())
+                self._absorb(pkt)
+                if pkt.get("t") == "ping":
+                    self._udp_send("%s:%d" % src,
+                                   self._packet("ack", seq=pkt.get("seq", 0)))
+                elif pkt.get("t") == "ack":
+                    ev = self._acks.get(pkt.get("seq", -1))
+                    if ev is not None:
+                        ev.set()
+            except Exception:  # noqa: BLE001 - a bad packet must not kill IO
+                continue
+
+    def _absorb(self, pkt: dict) -> None:
+        for w in pkt.get("updates", []):
+            try:
+                self._merge_member(Member.from_wire(w))
+            except (KeyError, ValueError):
+                continue
+        for b in pkt.get("bcasts", []):
+            try:
+                msg_id, data = b["id"], base64.b64decode(b["data"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            self._deliver_gossip(msg_id, data)
+
+    def _deliver_gossip(self, msg_id: str, data: bytes) -> None:
+        """Deliver a gossiped envelope once per message id, then keep the
+        rumor spreading with a fresh retransmit budget."""
+        with self._mu:
+            if msg_id in self._seen:
+                return
+            self._mark_seen(msg_id)
+            n = max(1, len(self._members))
+            budget = self.retransmit_mult * max(
+                1, math.ceil(math.log2(n + 1)))
+            self._queue.append([msg_id, _b64(data), budget])
+        self._handle_envelope(data)
+
+    def _handle_envelope(self, data: bytes) -> None:
+        if self._handler is not None:
+            try:
+                self._handler.receive_message(unmarshal_message(data))
+            except Exception:  # noqa: BLE001 - bad envelope must not kill IO
+                pass
+
+    # -- TCP: sync bcast + push/pull (gossip.go:124-149,193-222) -------------
+
+    def _tcp_loop(self) -> None:
+        while not self._closing.is_set():
+            try:
+                conn, _ = self._tcp.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._tcp_serve, args=(conn,),
+                             daemon=True).start()
+
+    def _tcp_serve(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(10.0)
+                req = json.loads(_recv_frame(conn).decode())
+                if req.get("t") == "bcast":
+                    # Sync sends are point-to-point: deliver directly,
+                    # no gossip relay and no dedup (gossip.go:124-149).
+                    self._handle_envelope(base64.b64decode(req["data"]))
+                    _send_frame(conn, b'{"t":"ok"}')
+                elif req.get("t") == "pushpull":
+                    self._absorb_state(req)
+                    _send_frame(conn,
+                                json.dumps(self._local_state()).encode())
+        except (OSError, ValueError, ConnectionError, KeyError):
+            pass
+
+    def _tcp_request(self, addr: str, req: dict,
+                     timeout: float = 10.0) -> dict:
+        with socket.create_connection(_split_addr(addr),
+                                      timeout=timeout) as conn:
+            _send_frame(conn, json.dumps(req).encode())
+            return json.loads(_recv_frame(conn).decode())
+
+    def _local_state(self) -> dict:
+        """Full state for push/pull: membership + NodeStatus
+        (gossip.go:193-205, LocalState)."""
+        with self._mu:
+            members = [m.to_wire() for m in self._members.values()]
+        status = None
+        if self._handler is not None and hasattr(self._handler,
+                                                 "local_status"):
+            try:
+                status = self._handler.local_status()
+            except Exception:  # noqa: BLE001 - status is best-effort
+                status = None
+        return {"t": "pushpull", "members": members, "status": status}
+
+    def _absorb_state(self, state: dict) -> None:
+        """MergeRemoteState (gossip.go:208-222)."""
+        for w in state.get("members", []):
+            try:
+                self._merge_member(Member.from_wire(w))
+            except (KeyError, ValueError):
+                continue
+        status = state.get("status")
+        if status and self._handler is not None and hasattr(
+                self._handler, "handle_remote_status"):
+            try:
+                self._handler.handle_remote_status(status)
+            except Exception:  # noqa: BLE001 - merge is best-effort
+                pass
+
+    def _push_pull(self, addr: str) -> None:
+        resp = self._tcp_request(addr, self._local_state())
+        self._absorb_state(resp)
+
+    def _push_pull_loop(self) -> None:
+        while not self._closing.wait(self.push_pull_interval):
+            peers = self._alive_peers()
+            if not peers:
+                continue
+            try:
+                self._push_pull(random.choice(peers).addr)
+            except OSError:
+                pass
+
+    # -- failure detection (SWIM probe) --------------------------------------
+
+    def _probe_loop(self) -> None:
+        while not self._closing.wait(self.probe_interval):
+            peers = self._alive_peers()
+            if not peers:
+                continue
+            self._probe(random.choice(peers))
+
+    def _probe(self, peer: Member) -> None:
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+            ev = self._acks[seq] = threading.Event()
+        self._udp_send(peer.addr, self._packet("ping", seq=seq))
+        ok = ev.wait(self.probe_timeout)
+        self._acks.pop(seq, None)
+        dead = None
+        with self._mu:
+            cur = self._members.get(peer.name)
+            if cur is None or cur.state != STATE_ALIVE:
+                return
+            if ok:
+                cur.fails = 0
+                return
+            cur.fails += 1
+            if cur.fails >= self.suspect_after:
+                cur.state = STATE_DEAD
+                dead = Member(cur.name, cur.addr, cur.incarnation,
+                              STATE_DEAD)
+        if dead is not None:
+            self._gossip_update(dead)
+
+
+def _b64(data: bytes) -> str:
+    return base64.b64encode(data).decode()
+
+
+def _primary_ip() -> str:
+    """Best-effort primary interface IP for advertising a wildcard bind.
+    The connect() on a UDP socket sends no packets; it only resolves the
+    route."""
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return "127.0.0.1"

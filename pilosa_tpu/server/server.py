@@ -105,14 +105,21 @@ class Server:
             for n in self.cluster.nodes:
                 if n.host == self.host:
                     n.host = new_host
+            # The membership backend's identity is the HTTP host; keep it
+            # in step so gossip members map back to reachable hosts.
+            ns = self.cluster.node_set
+            if ns is not None and getattr(ns, "host", None) == self.host:
+                ns.host = new_host
             self.host = new_host
             self.executor.host = new_host
             self.handler.host = new_host
 
-        if self.cluster.node_set is not None:
-            self.cluster.node_set.open()
+        # Receiver first, then membership open — the gossip join's
+        # push/pull needs the status handler attached (server.go:118,123).
         if self.broadcast_receiver is not None:
             self.broadcast_receiver.start(self)
+        if self.cluster.node_set is not None:
+            self.cluster.node_set.open()
 
         self._spawn(self._serve, "http")
         self._spawn(self._monitor_cache_flush, "cache-flush")

@@ -20,6 +20,7 @@ DEFAULT_CLUSTER_TYPE = "static"
 DEFAULT_REPLICA_N = 1
 DEFAULT_POLLING_INTERVAL = 60.0
 DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0
+DEFAULT_INTERNAL_PORT = "14000"   # gossip port (config.go:25-31)
 
 
 def parse_duration(v) -> float:
@@ -41,10 +42,12 @@ def parse_duration(v) -> float:
 @dataclass
 class ClusterConfig:
     replica_n: int = DEFAULT_REPLICA_N
-    type: str = DEFAULT_CLUSTER_TYPE          # static | http
+    type: str = DEFAULT_CLUSTER_TYPE          # static | http | gossip
     hosts: list[str] = field(default_factory=list)
     internal_hosts: list[str] = field(default_factory=list)
     polling_interval: float = DEFAULT_POLLING_INTERVAL
+    internal_port: str = DEFAULT_INTERNAL_PORT  # gossip bind port
+    gossip_seed: str = ""                       # seed "host:port" to join
 
 
 @dataclass
@@ -68,6 +71,8 @@ type = "{self.cluster.type}"
 hosts = [{hosts}]
 internal-hosts = [{internal}]
 polling-interval = "{int(self.cluster.polling_interval)}s"
+internal-port = "{self.cluster.internal_port}"
+gossip-seed = "{self.cluster.gossip_seed}"
 
 [anti-entropy]
 interval = "{int(self.anti_entropy_interval)}s"
@@ -93,6 +98,10 @@ def load(path: str = "", env: dict | None = None) -> Config:
         if "polling-interval" in cl:
             cfg.cluster.polling_interval = parse_duration(
                 cl["polling-interval"])
+        cfg.cluster.internal_port = str(cl.get("internal-port",
+                                               cfg.cluster.internal_port))
+        cfg.cluster.gossip_seed = cl.get("gossip-seed",
+                                         cfg.cluster.gossip_seed)
         ae = data.get("anti-entropy", {})
         if "interval" in ae:
             cfg.anti_entropy_interval = parse_duration(ae["interval"])
@@ -108,4 +117,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
                              env["PILOSA_CLUSTER_HOSTS"].split(",") if h]
     if env.get("PILOSA_CLUSTER_REPLICAS"):
         cfg.cluster.replica_n = int(env["PILOSA_CLUSTER_REPLICAS"])
+    if env.get("PILOSA_CLUSTER_INTERNAL_PORT"):
+        cfg.cluster.internal_port = env["PILOSA_CLUSTER_INTERNAL_PORT"]
+    if env.get("PILOSA_CLUSTER_GOSSIP_SEED"):
+        cfg.cluster.gossip_seed = env["PILOSA_CLUSTER_GOSSIP_SEED"]
     return cfg

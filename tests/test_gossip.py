@@ -1,0 +1,156 @@
+"""Gossip membership backend tests.
+
+Models the reference's gossip integration (gossip/gossip.go): join via
+seed push/pull, sync broadcast over TCP, async broadcast via piggybacked
+gossip, full-state status merge, and SWIM failure detection.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from pilosa_tpu.cluster.gossip import GossipNodeSet
+from pilosa_tpu.proto import internal_pb2 as pb
+
+
+class RecordingHandler:
+    """BroadcastHandler + StatusHandler double."""
+
+    def __init__(self, host: str):
+        self.host = host
+        self.messages = []
+        self.remote_statuses = []
+
+    def receive_message(self, m) -> None:
+        self.messages.append(m)
+
+    def local_status(self) -> dict:
+        return {"host": self.host, "indexes": [{"name": "i0",
+                                                "maxSlice": 3,
+                                                "frames": []}]}
+
+    def handle_remote_status(self, status: dict) -> None:
+        self.remote_statuses.append(status)
+
+
+def make_node(host: str, seeds=None, **kw) -> tuple[GossipNodeSet,
+                                                    RecordingHandler]:
+    ns = GossipNodeSet(host, gossip_host="127.0.0.1:0", seeds=seeds or [],
+                       probe_interval=0.1, probe_timeout=0.2,
+                       push_pull_interval=0.3, **kw)
+    h = RecordingHandler(host)
+    ns.start(h)
+    ns.open()
+    return ns, h
+
+
+def wait_until(cond, timeout: float = 5.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture
+def pair():
+    a, ha = make_node("hostA:10101")
+    b, hb = make_node("hostB:10101", seeds=[a.gossip_host])
+    yield (a, ha, b, hb)
+    a.close()
+    b.close()
+
+
+def test_join_via_seed(pair):
+    a, _, b, _ = pair
+    assert wait_until(lambda: len(a.nodes()) == 2)
+    assert wait_until(lambda: len(b.nodes()) == 2)
+    assert [n.host for n in a.nodes()] == ["hostA:10101", "hostB:10101"]
+
+
+def test_push_pull_merges_status(pair):
+    a, ha, b, hb = pair
+    # The join push/pull already exchanged NodeStatus both ways.
+    assert wait_until(lambda: any(
+        s.get("host") == "hostB:10101" for s in ha.remote_statuses))
+    assert wait_until(lambda: any(
+        s.get("host") == "hostA:10101" for s in hb.remote_statuses))
+
+
+def test_send_sync_delivers_to_peers(pair):
+    a, _, b, hb = pair
+    assert wait_until(lambda: len(a.nodes()) == 2)
+    a.send_sync(pb.CreateIndexMessage(Index="syncidx"))
+    assert wait_until(lambda: any(
+        isinstance(m, pb.CreateIndexMessage) and m.Index == "syncidx"
+        for m in hb.messages))
+
+
+def test_send_async_gossips(pair):
+    a, _, b, hb = pair
+    assert wait_until(lambda: len(a.nodes()) == 2)
+    a.send_async(pb.CreateSliceMessage(Index="gossipidx", Slice=7))
+    # Rides piggyback on the periodic probe pings.
+    assert wait_until(lambda: any(
+        isinstance(m, pb.CreateSliceMessage) and m.Index == "gossipidx"
+        and m.Slice == 7 for m in hb.messages))
+
+
+def test_gossip_rumor_delivered_once_per_send(pair):
+    # One async send is delivered exactly once despite riding many
+    # piggyback rounds; a REPEATED send of identical bytes (e.g. create →
+    # delete → create again) is a new rumor and must be delivered again.
+    a, _, b, hb = pair
+    assert wait_until(lambda: len(a.nodes()) == 2)
+
+    def dups():
+        return [m for m in hb.messages if getattr(m, "Index", "") == "dup"]
+
+    a.send_async(pb.CreateIndexMessage(Index="dup"))
+    assert wait_until(lambda: len(dups()) == 1)
+    time.sleep(0.5)
+    assert len(dups()) == 1
+
+    a.send_async(pb.CreateIndexMessage(Index="dup"))  # same envelope bytes
+    assert wait_until(lambda: len(dups()) == 2)
+    time.sleep(0.5)
+    assert len(dups()) == 2
+
+
+def test_three_node_transitive_membership():
+    a, _ = make_node("hostA:10101")
+    b, _ = make_node("hostB:10101", seeds=[a.gossip_host])
+    c, _ = make_node("hostC:10101", seeds=[a.gossip_host])
+    try:
+        # C learns about B (and vice versa) through A's state.
+        assert wait_until(lambda: len(a.nodes()) == 3)
+        assert wait_until(lambda: len(b.nodes()) == 3)
+        assert wait_until(lambda: len(c.nodes()) == 3)
+    finally:
+        a.close()
+        b.close()
+        c.close()
+
+
+def test_failure_detection_marks_dead():
+    a, _ = make_node("hostA:10101", suspect_after=2)
+    b, _ = make_node("hostB:10101", seeds=[a.gossip_host], suspect_after=2)
+    try:
+        assert wait_until(lambda: len(a.nodes()) == 2)
+        b.close()
+        assert wait_until(
+            lambda: [n.host for n in a.nodes()] == ["hostA:10101"],
+            timeout=10.0)
+    finally:
+        a.close()
+
+
+def test_nodes_excludes_nothing_when_alone():
+    a, _ = make_node("solo:10101")
+    try:
+        assert [n.host for n in a.nodes()] == ["solo:10101"]
+    finally:
+        a.close()
