@@ -240,9 +240,9 @@ class Handler:
     # -- meta ----------------------------------------------------------------
 
     def _handle_webui(self, req: Request) -> Response:
-        return Response(200, b"<html><body><h1>pilosa-tpu</h1>"
-                             b"<p>POST PQL to /index/{index}/query</p>"
-                             b"</body></html>", "text/html; charset=utf-8")
+        # Embedded console (reference webui/ + statik, handler.go:132-145).
+        from .webui import page_bytes
+        return Response(200, page_bytes(), "text/html; charset=utf-8")
 
     def _handle_get_version(self, req: Request) -> Response:
         return Response.json({"version": self.version})

@@ -74,6 +74,17 @@ class TestMeta:
         status, _, _ = call(handler, "GET", "/nope")
         assert status == 404
 
+    def test_webui_console(self, handler):
+        status, headers, body = call(handler, "GET", "/")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/html")
+        page = body.decode()
+        # The console drives the same public API surface as the
+        # reference's webui (query POST, /schema, /status, /version).
+        for needle in ("/index/", "/query", "/schema", "/status",
+                       "/version", "textarea"):
+            assert needle in page, needle
+
     def test_method_not_allowed(self, handler):
         status, _, _ = call(handler, "GET", "/index/i/query")
         assert status == 405

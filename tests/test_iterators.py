@@ -1,0 +1,106 @@
+"""Generic iterator tests, mirroring the reference's iterator semantics
+(iterator.go): seek-to-next-pair, one-deep unread, limit EOF, and the
+roaring position adaptor."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.storage.iterators import (
+    SLICE_WIDTH,
+    BufIterator,
+    LimitIterator,
+    RoaringIterator,
+    SliceIterator,
+    pairs,
+)
+from pilosa_tpu.storage.roaring import Bitmap
+
+
+def make_slice_iter():
+    return SliceIterator(np.array([1, 1, 2, 5], dtype=np.uint64),
+                         np.array([3, 9, 0, 7], dtype=np.uint64))
+
+
+def test_slice_iterator_drains_in_order():
+    assert pairs(make_slice_iter()) == [(1, 3), (1, 9), (2, 0), (5, 7)]
+
+
+def test_slice_iterator_length_mismatch():
+    with pytest.raises(ValueError):
+        SliceIterator([1], [2, 3])
+
+
+def test_slice_iterator_seek_exact_and_next_pair():
+    itr = make_slice_iter()
+    itr.seek(1, 9)                       # exact pair
+    assert itr.next() == (1, 9, False)
+    itr.seek(1, 10)                      # between pairs → next greater
+    assert itr.next() == (2, 0, False)
+    itr.seek(9, 0)                       # beyond all → EOF
+    assert itr.next() == (0, 0, True)
+
+
+def test_buf_iterator_unread_and_peek():
+    itr = BufIterator(make_slice_iter())
+    assert itr.next() == (1, 3, False)
+    itr.unread()
+    assert itr.next() == (1, 3, False)   # replays the buffered pair
+    assert itr.peek() == (1, 9, False)   # peek does not consume
+    assert itr.next() == (1, 9, False)
+
+
+def test_buf_iterator_double_unread_errors():
+    itr = BufIterator(make_slice_iter())
+    itr.next()
+    itr.unread()
+    with pytest.raises(RuntimeError):
+        itr.unread()
+
+
+def test_buf_iterator_seek_clears_buffer():
+    itr = BufIterator(make_slice_iter())
+    itr.next()
+    itr.unread()
+    itr.seek(2, 0)
+    assert itr.next() == (2, 0, False)
+
+
+def test_limit_iterator_eof_past_max_pair():
+    itr = LimitIterator(make_slice_iter(), 2, 0)
+    assert pairs(itr) == [(1, 3), (1, 9), (2, 0)]
+    assert itr.next() == (0, 0, True)    # stays EOF (iterator.go:105-108)
+
+
+def test_limit_iterator_row_boundary():
+    itr = LimitIterator(make_slice_iter(), 1, 1 << 62)
+    assert pairs(itr) == [(1, 3), (1, 9)]
+
+
+def test_roaring_iterator_position_mapping():
+    bm = Bitmap()
+    positions = [5, SLICE_WIDTH + 7, 3 * SLICE_WIDTH]
+    for p in positions:
+        bm.add(p)
+    assert pairs(RoaringIterator(bm)) == [(0, 5), (1, 7), (3, 0)]
+
+
+def test_roaring_iterator_seek():
+    bm = Bitmap()
+    for p in (5, SLICE_WIDTH + 7, 3 * SLICE_WIDTH):
+        bm.add(p)
+    itr = RoaringIterator(bm)
+    itr.seek(1, 0)
+    assert itr.next() == (1, 7, False)
+    itr.seek(1, 8)                       # past row 1's only bit
+    assert itr.next() == (3, 0, False)
+
+
+def test_composition_buf_over_limit_over_roaring():
+    bm = Bitmap()
+    for p in (1, 2, SLICE_WIDTH + 1, 2 * SLICE_WIDTH + 9):
+        bm.add(p)
+    itr = BufIterator(LimitIterator(RoaringIterator(bm), 1, 1 << 62))
+    assert itr.peek() == (0, 1, False)
+    assert pairs(itr) == [(0, 1), (0, 2), (1, 1)]

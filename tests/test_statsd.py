@@ -1,0 +1,72 @@
+"""StatsD/dogstatsd backend tests: wire format, tag hierarchy, and the
+fire-and-forget failure mode (reference datadog/datadog.go)."""
+
+from __future__ import annotations
+
+import socket
+
+import pytest
+
+from pilosa_tpu.utils.statsd import StatsDStatsClient
+
+
+@pytest.fixture
+def agent():
+    """A local UDP 'agent' capturing datagrams."""
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(2.0)
+    yield sock
+    sock.close()
+
+
+def recv(sock) -> str:
+    return sock.recvfrom(65536)[0].decode()
+
+
+def make_client(agent) -> StatsDStatsClient:
+    host, port = agent.getsockname()
+    return StatsDStatsClient(f"{host}:{port}")
+
+
+def test_count_wire_format(agent):
+    make_client(agent).count("setBit", 3)
+    assert recv(agent) == "pilosa.setBit:3|c"
+
+
+def test_gauge_and_histogram(agent):
+    c = make_client(agent)
+    c.gauge("maxSlice", 42)
+    assert recv(agent) == "pilosa.maxSlice:42|g"
+    c.histogram("snapshotDurationSeconds", 1.5)
+    assert recv(agent) == "pilosa.snapshotDurationSeconds:1.5|h"
+
+
+def test_set_and_timing_ns_to_ms(agent):
+    c = make_client(agent)
+    c.set("indexes", "i0")
+    assert recv(agent) == "pilosa.indexes:i0|s"
+    c.timing("importDuration", 2_500_000)     # 2.5e6 ns == 2.5 ms
+    assert recv(agent) == "pilosa.importDuration:2.5|ms"
+
+
+def test_with_tags_appends_datadog_tags(agent):
+    c = make_client(agent).with_tags("index:i0")
+    c.count("setBit")
+    assert recv(agent) == "pilosa.setBit:1|c|#index:i0"
+
+
+def test_with_tags_hierarchical_merge_sorted_deduped(agent):
+    c = make_client(agent).with_tags("index:i0")
+    child = c.with_tags("frame:f0", "index:i0")
+    child.count("clearBit", 2)
+    assert recv(agent) == "pilosa.clearBit:2|c|#frame:f0,index:i0"
+    # Parent unchanged by the child's tags.
+    c.count("clearBit")
+    assert recv(agent) == "pilosa.clearBit:1|c|#index:i0"
+
+
+def test_agent_down_drops_silently():
+    c = StatsDStatsClient("127.0.0.1:1")   # nothing listens on port 1
+    c.count("whatever")                     # must not raise or block
+    c.close()
