@@ -99,6 +99,13 @@ def cmd_server(args, stdout, stderr) -> int:
     if cfg.cluster.type == "http":
         server.broadcaster = HTTPBroadcaster(server)
         server.handler.broadcaster = server.broadcaster
+
+    profiler = None
+    if getattr(args, "profile_cpu", ""):
+        from ..utils.profiling import CPUProfiler
+        profiler = CPUProfiler(args.profile_cpu,
+                               duration=args.profile_cpu_time)
+        profiler.start()
     print(f"pilosa-tpu serving at http://{server.host} "
           f"(data: {cfg.data_dir})", file=stdout, flush=True)
     try:
@@ -106,6 +113,8 @@ def cmd_server(args, stdout, stderr) -> int:
             time.sleep(1)
     except KeyboardInterrupt:
         print("shutting down", file=stderr)
+        if profiler is not None:
+            profiler.stop()
         server.close()
     return 0
 
@@ -276,6 +285,14 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("-b", "--bind", default="",
                    help="host:port to listen on (default localhost:10101)")
     s.add_argument("-c", "--config", default="", help="TOML config file")
+    # Profiling flags (reference cmd/server.go:47-62,99-100).
+    s.add_argument("--profile.cpu", dest="profile_cpu", default="",
+                   metavar="PATH",
+                   help="write a sampled CPU profile to PATH")
+    from ..utils.config import parse_duration
+    s.add_argument("--profile.cpu-time", dest="profile_cpu_time",
+                   type=parse_duration, default=30.0, metavar="DUR",
+                   help="duration of the CPU profile (default 30s)")
     s.set_defaults(fn=cmd_server)
 
     def client_cmd(name, help, fn, **extra):

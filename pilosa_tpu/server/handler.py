@@ -183,6 +183,10 @@ class Handler:
         r("PATCH", "/index/{index}/time-quantum",
           self._handle_patch_index_time_quantum)
         r("GET", "/debug/vars", self._handle_expvar)
+        r("GET", "/debug/pprof", self._handle_pprof_index)
+        r("GET", "/debug/pprof/", self._handle_pprof_index)
+        r("GET", "/debug/pprof/profile", self._handle_pprof_profile)
+        r("GET", "/debug/pprof/threads", self._handle_pprof_threads)
         r("GET", "/export", self._handle_get_export)
         r("GET", "/fragment/block/data", self._handle_fragment_block_data)
         r("GET", "/fragment/blocks", self._handle_fragment_blocks)
@@ -265,6 +269,29 @@ class Handler:
         snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") \
             else {}
         return Response.json(snap)
+
+    # -- profiling (reference handler.go:30,99 mounts net/http/pprof) --------
+
+    def _handle_pprof_index(self, req: Request) -> Response:
+        return Response(
+            200, b"profile: sampled CPU profile (?seconds=N, default 5)\n"
+                 b"threads: stack dump of all live threads\n",
+            "text/plain; charset=utf-8")
+
+    def _handle_pprof_profile(self, req: Request) -> Response:
+        from ..utils.profiling import sample_profile
+        try:
+            seconds = float(req.query.get("seconds", "5"))
+        except ValueError:
+            raise HTTPError(400, "invalid seconds")
+        seconds = min(max(seconds, 0.1), 120.0)
+        return Response(200, sample_profile(seconds).encode(),
+                        "text/plain; charset=utf-8")
+
+    def _handle_pprof_threads(self, req: Request) -> Response:
+        from ..utils.profiling import thread_dump
+        return Response(200, thread_dump().encode(),
+                        "text/plain; charset=utf-8")
 
     def _handle_get_schema(self, req: Request) -> Response:
         return Response.json({"indexes": self.holder.schema()})

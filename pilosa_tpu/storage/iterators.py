@@ -48,9 +48,11 @@ class BufIterator:
 
     def unread(self) -> None:
         """Push the previous pair back; error if one is already buffered
-        (iterator.go:73-80 panics)."""
+        (iterator.go:73-80 panics) or nothing has been read yet."""
         if self._full:
             raise RuntimeError("BufIterator: buffer full")
+        if self._buf is None:
+            raise RuntimeError("BufIterator: nothing read yet")
         self._full = True
 
 
@@ -64,6 +66,7 @@ class LimitIterator:
         self._eof = False
 
     def seek(self, row_id: int, column_id: int) -> None:
+        self._eof = False   # re-positioning revives a drained iterator
         self._itr.seek(row_id, column_id)
 
     def next(self) -> tuple[int, int, bool]:

@@ -1,0 +1,115 @@
+"""Profiling: sampling CPU profiles and thread dumps.
+
+Reference: Go pprof mounted at ``/debug/pprof`` (handler.go:30,99) plus
+the ``--profile.cpu`` / ``--profile.cpu-time`` server flags
+(cmd/server.go:47-62,99-100). Go's pprof is a statistical sampler of all
+goroutine stacks; the Python-host equivalent here samples
+``sys._current_frames()`` across all threads on a fixed interval and
+aggregates collapsed stacks (flamegraph-compatible ``a;b;c count``
+lines). The device side needs no custom hooks — JAX's own profiler and
+XLA dump flags cover TPU kernels; this module profiles the CPU host path
+(parsing, routing, roaring maintenance) that surrounds them.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from collections import Counter
+
+
+def collect_sample(skip_threads: tuple[int, ...] = ()) -> list[str]:
+    """One collapsed stack per live thread, innermost frame last."""
+    out = []
+    for tid, frame in sys._current_frames().items():
+        if tid in skip_threads:
+            continue
+        stack = []
+        f = frame
+        while f is not None:
+            code = f.f_code
+            stack.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
+            f = f.f_back
+        out.append(";".join(reversed(stack)))
+    return out
+
+
+def sample_profile(seconds: float, interval: float = 0.005) -> str:
+    """Sample all thread stacks for ``seconds``; return collapsed-stack
+    counts sorted by weight (the pprof-profile equivalent)."""
+    counts: Counter[str] = Counter()
+    me = threading.get_ident()
+    deadline = time.monotonic() + seconds
+    n = 0
+    while time.monotonic() < deadline:
+        for stack in collect_sample(skip_threads=(me,)):
+            counts[stack] += 1
+        n += 1
+        time.sleep(interval)
+    lines = [f"# cpu profile: {n} samples over {seconds:g}s "
+             f"@ {interval * 1000:g}ms"]
+    for stack, c in counts.most_common():
+        lines.append(f"{stack} {c}")
+    return "\n".join(lines) + "\n"
+
+
+def thread_dump() -> str:
+    """Stack trace of every live thread (the pprof-goroutine
+    equivalent)."""
+    frames = sys._current_frames()
+    lines = []
+    for t in threading.enumerate():
+        frame = frames.get(t.ident)
+        daemon = " daemon" if t.daemon else ""
+        lines.append(f"thread {t.name} (id {t.ident}{daemon}):")
+        if frame is not None:
+            lines.extend(line.rstrip() for line in
+                         traceback.format_stack(frame))
+        lines.append("")
+    return "\n".join(lines)
+
+
+class CPUProfiler:
+    """Background sampler for the ``--profile.cpu`` server flag: starts
+    on open, writes the collapsed-stack report at stop (or after
+    ``duration`` seconds, whichever comes first)."""
+
+    def __init__(self, path: str, duration: float = 30.0,
+                 interval: float = 0.005):
+        self.path = path
+        self.duration = duration
+        self.interval = interval
+        self._counts: Counter[str] = Counter()
+        self._samples = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="cpu-profiler", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        deadline = time.monotonic() + self.duration
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            for stack in collect_sample(skip_threads=(me,)):
+                self._counts[stack] += 1
+            self._samples += 1
+            time.sleep(self.interval)
+        self._write()
+
+    def _write(self) -> None:
+        lines = [f"# cpu profile: {self._samples} samples "
+                 f"@ {self.interval * 1000:g}ms"]
+        for stack, c in self._counts.most_common():
+            lines.append(f"{stack} {c}")
+        with open(self.path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)

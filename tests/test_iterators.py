@@ -73,6 +73,19 @@ def test_limit_iterator_eof_past_max_pair():
     assert itr.next() == (0, 0, True)    # stays EOF (iterator.go:105-108)
 
 
+def test_limit_iterator_seek_revives_after_eof():
+    itr = LimitIterator(make_slice_iter(), 2, 0)
+    pairs(itr)                           # drain past the limit
+    itr.seek(1, 0)
+    assert itr.next() == (1, 3, False)
+
+
+def test_buf_iterator_unread_before_next_errors():
+    itr = BufIterator(make_slice_iter())
+    with pytest.raises(RuntimeError):
+        itr.unread()
+
+
 def test_limit_iterator_row_boundary():
     itr = LimitIterator(make_slice_iter(), 1, 1 << 62)
     assert pairs(itr) == [(1, 3), (1, 9)]

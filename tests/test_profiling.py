@@ -1,0 +1,86 @@
+"""Profiling subsystem tests: the sampled CPU profile, thread dump, the
+/debug/pprof HTTP surface, and the --profile.cpu background profiler
+(reference: net/http/pprof at handler.go:30,99; cmd/server.go:47-62)."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu.utils.profiling import (
+    CPUProfiler,
+    collect_sample,
+    sample_profile,
+    thread_dump,
+)
+
+
+def busy(stop: threading.Event) -> None:
+    while not stop.is_set():
+        sum(i * i for i in range(500))
+
+
+def test_collect_sample_sees_other_threads():
+    stop = threading.Event()
+    t = threading.Thread(target=busy, args=(stop,), name="busy", daemon=True)
+    t.start()
+    try:
+        stacks = collect_sample(skip_threads=(threading.get_ident(),))
+        assert any("busy" in s for s in stacks), stacks
+    finally:
+        stop.set()
+        t.join()
+
+
+def test_sample_profile_collapsed_stacks():
+    stop = threading.Event()
+    t = threading.Thread(target=busy, args=(stop,), daemon=True)
+    t.start()
+    try:
+        report = sample_profile(0.2, interval=0.002)
+    finally:
+        stop.set()
+        t.join()
+    lines = report.splitlines()
+    assert lines[0].startswith("# cpu profile:")
+    # Collapsed-stack lines end with a sample count; busy() must appear.
+    assert any("busy" in line and line.rsplit(" ", 1)[-1].isdigit()
+               for line in lines[1:]), report
+
+
+def test_thread_dump_lists_main_thread():
+    dump = thread_dump()
+    assert "MainThread" in dump
+    assert "test_thread_dump_lists_main_thread" in dump
+
+
+def test_cpu_profiler_writes_report(tmp_path):
+    out = tmp_path / "cpu.prof"
+    p = CPUProfiler(str(out), duration=10.0, interval=0.002)
+    p.start()
+    time.sleep(0.1)
+    p.stop()
+    text = out.read_text()
+    assert text.startswith("# cpu profile:")
+
+
+def test_pprof_http_endpoints(tmp_path):
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.server.handler import Handler
+
+    from test_handler import call
+
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    try:
+        handler = Handler(h, Executor(h, host="local"), host="local")
+        status, _, body = call(handler, "GET", "/debug/pprof/")
+        assert status == 200 and b"profile" in body
+        status, _, body = call(handler, "GET",
+                               "/debug/pprof/profile?seconds=0.1")
+        assert status == 200 and body.startswith(b"# cpu profile:")
+        status, _, body = call(handler, "GET", "/debug/pprof/threads")
+        assert status == 200 and b"MainThread" in body
+    finally:
+        h.close()
