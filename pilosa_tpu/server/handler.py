@@ -280,9 +280,12 @@ class Handler:
 
     def _handle_pprof_profile(self, req: Request) -> Response:
         from ..utils.profiling import sample_profile
+        import math
         try:
             seconds = float(req.query.get("seconds", "5"))
         except ValueError:
+            raise HTTPError(400, "invalid seconds")
+        if not math.isfinite(seconds):
             raise HTTPError(400, "invalid seconds")
         seconds = min(max(seconds, 0.1), 120.0)
         return Response(200, sample_profile(seconds).encode(),

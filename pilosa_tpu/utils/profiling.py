@@ -20,11 +20,35 @@ import traceback
 from collections import Counter
 
 
-def collect_sample(skip_threads: tuple[int, ...] = ()) -> list[str]:
+# A thread whose innermost Python frame is one of these is blocked in an
+# idle primitive (lock/event wait, selector poll), not burning CPU. Go's
+# pprof samples on-CPU time via SIGPROF; Python has no per-thread
+# equivalent, so this wall-clock sampler drops known-idle leaves instead
+# and reports how many it dropped.
+_IDLE_LEAVES = {
+    ("threading.py", "wait"),
+    ("threading.py", "_wait_for_tstate_lock"),
+    ("threading.py", "join"),
+    ("selectors.py", "select"),
+    ("socketserver.py", "serve_forever"),
+    ("connection.py", "poll"),
+}
+
+
+def _is_idle_leaf(frame) -> bool:
+    code = frame.f_code
+    return (code.co_filename.rsplit("/", 1)[-1],
+            code.co_name) in _IDLE_LEAVES
+
+
+def collect_sample(skip_threads: tuple[int, ...] = (),
+                   include_idle: bool = True) -> list[str]:
     """One collapsed stack per live thread, innermost frame last."""
     out = []
     for tid, frame in sys._current_frames().items():
         if tid in skip_threads:
+            continue
+        if not include_idle and _is_idle_leaf(frame):
             continue
         stack = []
         f = frame
@@ -36,23 +60,50 @@ def collect_sample(skip_threads: tuple[int, ...] = ()) -> list[str]:
     return out
 
 
-def sample_profile(seconds: float, interval: float = 0.005) -> str:
-    """Sample all thread stacks for ``seconds``; return collapsed-stack
-    counts sorted by weight (the pprof-profile equivalent)."""
+def _sample_loop(seconds: float, interval: float,
+                 stop: threading.Event | None = None
+                 ) -> tuple[Counter, int, int]:
+    """Shared sampler: returns (stack counts, #samples, #idle dropped)."""
     counts: Counter[str] = Counter()
     me = threading.get_ident()
     deadline = time.monotonic() + seconds
-    n = 0
-    while time.monotonic() < deadline:
-        for stack in collect_sample(skip_threads=(me,)):
-            counts[stack] += 1
+    n = idle = 0
+    while time.monotonic() < deadline and (stop is None
+                                           or not stop.is_set()):
+        frames = sys._current_frames()
+        for tid, frame in frames.items():
+            if tid == me:
+                continue
+            if _is_idle_leaf(frame):
+                idle += 1
+                continue
+            stack = []
+            f = frame
+            while f is not None:
+                code = f.f_code
+                stack.append(f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{f.f_lineno})")
+                f = f.f_back
+            counts[";".join(reversed(stack))] += 1
         n += 1
         time.sleep(interval)
-    lines = [f"# cpu profile: {n} samples over {seconds:g}s "
+    return counts, n, idle
+
+
+def _format_report(counts: Counter, samples: int, idle: int,
+                   interval: float) -> str:
+    lines = [f"# cpu profile (wall-clock sampler, idle leaves dropped): "
+             f"{samples} samples, {idle} idle stacks dropped "
              f"@ {interval * 1000:g}ms"]
     for stack, c in counts.most_common():
         lines.append(f"{stack} {c}")
     return "\n".join(lines) + "\n"
+
+
+def sample_profile(seconds: float, interval: float = 0.005) -> str:
+    """Sample all thread stacks for ``seconds``; return collapsed-stack
+    counts sorted by weight (the pprof-profile equivalent)."""
+    counts, n, idle = _sample_loop(seconds, interval)
+    return _format_report(counts, n, idle, interval)
 
 
 def thread_dump() -> str:
@@ -92,22 +143,11 @@ class CPUProfiler:
         self._thread.start()
 
     def _run(self) -> None:
-        me = threading.get_ident()
-        deadline = time.monotonic() + self.duration
-        while not self._stop.is_set() and time.monotonic() < deadline:
-            for stack in collect_sample(skip_threads=(me,)):
-                self._counts[stack] += 1
-            self._samples += 1
-            time.sleep(self.interval)
-        self._write()
-
-    def _write(self) -> None:
-        lines = [f"# cpu profile: {self._samples} samples "
-                 f"@ {self.interval * 1000:g}ms"]
-        for stack, c in self._counts.most_common():
-            lines.append(f"{stack} {c}")
+        self._counts, self._samples, idle = _sample_loop(
+            self.duration, self.interval, stop=self._stop)
         with open(self.path, "w") as f:
-            f.write("\n".join(lines) + "\n")
+            f.write(_format_report(self._counts, self._samples, idle,
+                                   self.interval))
 
     def stop(self) -> None:
         self._stop.set()

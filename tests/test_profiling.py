@@ -42,7 +42,7 @@ def test_sample_profile_collapsed_stacks():
         stop.set()
         t.join()
     lines = report.splitlines()
-    assert lines[0].startswith("# cpu profile:")
+    assert lines[0].startswith("# cpu profile")
     # Collapsed-stack lines end with a sample count; busy() must appear.
     assert any("busy" in line and line.rsplit(" ", 1)[-1].isdigit()
                for line in lines[1:]), report
@@ -61,7 +61,7 @@ def test_cpu_profiler_writes_report(tmp_path):
     time.sleep(0.1)
     p.stop()
     text = out.read_text()
-    assert text.startswith("# cpu profile:")
+    assert text.startswith("# cpu profile")
 
 
 def test_pprof_http_endpoints(tmp_path):
@@ -79,7 +79,7 @@ def test_pprof_http_endpoints(tmp_path):
         assert status == 200 and b"profile" in body
         status, _, body = call(handler, "GET",
                                "/debug/pprof/profile?seconds=0.1")
-        assert status == 200 and body.startswith(b"# cpu profile:")
+        assert status == 200 and body.startswith(b"# cpu profile")
         status, _, body = call(handler, "GET", "/debug/pprof/threads")
         assert status == 200 and b"MainThread" in body
     finally:

@@ -61,6 +61,45 @@ class TestSingleNode:
         status, body = http_get(host, "/status")
         assert json.loads(body)["status"]["nodes"][0]["state"] == "OK"
 
+    def test_set_quick_random_bits_survive_restart(self, tmp_path):
+        """Randomized property test through the full HTTP stack: random
+        SetBits, rows cross-checked before AND after a server restart
+        (reference server_test.go:42-121 TestMain_Set_Quick)."""
+        import random
+        rng = random.Random(42)
+        want: dict[int, set[int]] = {}
+
+        s = make_server(tmp_path, "quick")
+        s.open()
+        host = s.host
+        http_post(host, "/index/qi", b"{}")
+        http_post(host, "/index/qi/frame/qf", b"{}")
+        for _ in range(120):
+            row = rng.randrange(8)
+            col = rng.randrange(3 * (1 << 20))   # spans three slices
+            http_post(host, "/index/qi/query",
+                      f'SetBit(frame="qf", rowID={row}, '
+                      f'columnID={col})'.encode())
+            want.setdefault(row, set()).add(col)
+
+        def check(h):
+            for row, cols in want.items():
+                _, body = http_post(h, "/index/qi/query",
+                                    f'Bitmap(frame="qf", '
+                                    f'rowID={row})'.encode())
+                got = json.loads(body)["results"][0]["bits"]
+                assert got == sorted(cols), (row, got)
+
+        check(host)
+        s.close()
+
+        s2 = make_server(tmp_path, "quick")
+        s2.open()
+        try:
+            check(s2.host)
+        finally:
+            s2.close()
+
     def test_restart_persists(self, tmp_path):
         s = make_server(tmp_path, "sp")
         s.open()
