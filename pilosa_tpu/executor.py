@@ -24,10 +24,13 @@ mesh axis and the reduce is an XLA psum instead of a Python loop.
 from __future__ import annotations
 
 import datetime as dt
+import os
 import threading
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from dataclasses import dataclass
 from typing import Callable, Optional
+
+import numpy as np
 
 from .cluster.topology import Cluster, Node, new_cluster
 from .errors import (TIME_FORMAT, FrameNotFoundError, IndexNotFoundError,
@@ -91,12 +94,36 @@ class Executor:
 
     def __init__(self, holder, host: str = "",
                  cluster: Optional[Cluster] = None, client=None,
-                 max_workers: int = 16):
+                 max_workers: int = 16, use_mesh: Optional[bool] = None,
+                 mesh_min_slices: Optional[int] = None):
         self.holder = holder
         self.host = host
         self.cluster = cluster or new_cluster([host])
         self.client = client
         self.max_workers = max_workers
+        if use_mesh is None:
+            use_mesh = os.environ.get("PILOSA_TPU_MESH", "1") != "0"
+        self.use_mesh = use_mesh
+        if mesh_min_slices is None:
+            mesh_min_slices = int(os.environ.get(
+                "PILOSA_TPU_MESH_MIN_SLICES", "8"))
+        # Below this many local slices the per-slice host path wins: one
+        # device dispatch costs a host↔device sync (~65 ms through the
+        # TPU tunnel) that only pays for itself on wide fan-outs.
+        self.mesh_min_slices = mesh_min_slices
+        self._mesh = None  # lazy: built on first device-batched call
+
+    def _mesh_or_none(self):
+        if not self.use_mesh:
+            return None
+        if self._mesh is None:
+            try:
+                from .parallel import mesh as mesh_mod
+                self._mesh = mesh_mod.make_mesh()
+            except Exception:  # noqa: BLE001 - no backend → host path
+                self.use_mesh = False  # don't re-probe on every query
+                return None
+        return self._mesh
 
     # -- entry point (executor.go:62-143) ------------------------------------
 
@@ -301,9 +328,92 @@ class Executor:
             return self._bitmap_call_slice(index, c.children[0],
                                            slice).count()
 
+        local_fn = self._count_local_device_fn(index, c.children[0])
         result = self._map_reduce(index, slices, c, opt, map_fn,
-                                  lambda prev, v: (prev or 0) + v)
+                                  lambda prev, v: (prev or 0) + v,
+                                  local_fn=local_fn)
         return result or 0
+
+    # -- device-batched Count (TPU fast path) --------------------------------
+
+    _DEVICE_FOLD_OPS = {"Intersect": "and", "Union": "or",
+                        "Difference": "andnot"}
+
+    def _compile_device_expr(self, index: str, c: Call, leaves: list):
+        """Compile a pure bitmap call tree into a mesh.count_expr tree.
+
+        Supported: Bitmap leaves (standard or inverse) combined with
+        Intersect/Union/Difference. Returns None when the tree contains
+        anything else (Range, malformed args, missing frames) — those run
+        through the per-slice path, which owns the error semantics.
+        """
+        if c.name == "Bitmap":
+            idx = self.holder.index(index)
+            if idx is None:
+                return None
+            frame = idx.frame(c.args.get("frame") or DEFAULT_FRAME)
+            if frame is None:
+                return None
+            row_id, row_ok = c.uint_arg(frame.row_label)
+            col_id, col_ok = c.uint_arg(idx.column_label)
+            if row_ok == col_ok:
+                return None
+            view, id = (VIEW_STANDARD, row_id) if row_ok else \
+                (VIEW_INVERSE, col_id)
+            if view == VIEW_INVERSE and not frame.inverse_enabled:
+                return None
+            leaves.append((frame.name, view, id))
+            return ("leaf", len(leaves) - 1)
+        op = self._DEVICE_FOLD_OPS.get(c.name)
+        if op is None or not c.children:
+            return None
+        parts = [self._compile_device_expr(index, ch, leaves)
+                 for ch in c.children]
+        if any(p is None for p in parts):
+            return None
+        expr = parts[0]
+        for p in parts[1:]:  # n-ary folds left-to-right, like _fold_slice
+            expr = (op, expr, p)
+        return expr
+
+    def _count_local_device_fn(self, index: str, child: Call):
+        """Batched local-leg Count: all slices in ONE mesh program.
+
+        Returns a ``local_fn(slices) -> int`` for _map_reduce, or None
+        when the expression can't run on device. Leaf rows are packed
+        host-side into [n_leaves, n_slices, words] and the whole
+        expression + popcount + sum runs as a single psum-reduced SPMD
+        call (parallel.mesh.count_expr) — the mesh form of the per-slice
+        count map (executor.go:568-597).
+        """
+        if not self.use_mesh:
+            return None
+        leaves: list[tuple] = []
+        expr = self._compile_device_expr(index, child, leaves)
+        if expr is None:
+            return None
+
+        def local_fn(slices: list[int]):
+            if len(slices) < self.mesh_min_slices:
+                return NotImplemented  # host path wins below the sync cost
+            mesh = self._mesh_or_none()  # backend init only past threshold
+            if mesh is None:
+                return NotImplemented
+            from .ops.packed import WORDS_PER_SLICE
+            from .parallel import mesh as mesh_mod
+            block = np.zeros((len(leaves), len(slices), WORDS_PER_SLICE),
+                             dtype=np.uint32)
+            for li, (frame, view, row_id) in enumerate(leaves):
+                for si, slice in enumerate(slices):
+                    frag = self.holder.fragment(index, frame, view, slice)
+                    if frag is not None:
+                        frag.pack_row(row_id, out=block[li, si])
+            try:
+                return mesh_mod.count_expr(mesh, expr, block)
+            except Exception:  # noqa: BLE001 - device trouble ≠ node down
+                return NotImplemented
+
+        return local_fn
 
     # -- TopN (executor.go:271-396) ------------------------------------------
 
@@ -548,7 +658,7 @@ class Executor:
 
     def _map_reduce(self, index: str, slices: list[int], c: Call,
                     opt: ExecOptions, map_fn: Callable,
-                    reduce_fn: Callable):
+                    reduce_fn: Callable, local_fn: Callable = None):
         if not slices:
             return None
         if opt.remote:
@@ -565,7 +675,8 @@ class Executor:
                 for node, node_slices in self._slices_by_node(
                         nodes, index, slices):
                     fut = pool.submit(self._mapper_node, node, index, c,
-                                      node_slices, opt, map_fn, reduce_fn)
+                                      node_slices, opt, map_fn, reduce_fn,
+                                      local_fn)
                     futures[fut] = (node, node_slices)
 
             submit(nodes, slices)
@@ -589,8 +700,13 @@ class Executor:
         return result
 
     def _mapper_node(self, node: Node, index: str, c: Call,
-                     slices: list[int], opt: ExecOptions, map_fn, reduce_fn):
+                     slices: list[int], opt: ExecOptions, map_fn, reduce_fn,
+                     local_fn=None):
         if node.host == self.host:
+            if local_fn is not None:
+                r = local_fn(slices)
+                if r is not NotImplemented:
+                    return r
             return self._mapper_local(slices, map_fn, reduce_fn)
         results = self._exec_remote(node, index, Query([c]), slices, opt)
         return results[0] if results else None

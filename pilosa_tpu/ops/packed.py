@@ -62,19 +62,25 @@ def pack_bitmap(b: Bitmap, n_words: int, out: np.ndarray | None = None,
     return out
 
 
-def pack_rows(storage: Bitmap, row_ids) -> np.ndarray:
-    """Pack rows of a fragment-local storage bitmap into u32[n, 32768].
+def pack_storage_row(storage: Bitmap, row_id: int,
+                     out: np.ndarray) -> np.ndarray:
+    """Pack one row of a fragment-local storage bitmap into dense words.
 
     ``storage`` holds positions ``pos = row * SLICE_WIDTH + col`` (the
-    fragment bit layout, reference fragment.go:1511-1514); row ``r`` of the
-    result is the dense words of columns [0, 2^20) of that row.
+    fragment bit layout, reference fragment.go:1511-1514); the result is
+    the dense words of columns [0, 2^20) of that row.
     """
+    row_bm = storage.offset_range(0, row_id * SLICE_WIDTH,
+                                  (row_id + 1) * SLICE_WIDTH)
+    return pack_bitmap(row_bm, out.shape[-1], out=out)
+
+
+def pack_rows(storage: Bitmap, row_ids) -> np.ndarray:
+    """Pack rows of a fragment-local storage bitmap into u32[n, 32768]."""
     row_ids = list(row_ids)
     out = np.zeros((len(row_ids), WORDS_PER_SLICE), dtype=np.uint32)
     for i, row in enumerate(row_ids):
-        row_bm = storage.offset_range(0, row * SLICE_WIDTH,
-                                      (row + 1) * SLICE_WIDTH)
-        pack_bitmap(row_bm, WORDS_PER_SLICE, out=out[i])
+        pack_storage_row(storage, row, out[i])
     return out
 
 

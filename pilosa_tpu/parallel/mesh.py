@@ -103,6 +103,66 @@ def count_op(mesh: Mesh, op: str, a: jax.Array, b: jax.Array) -> int:
     return (int(hi) << 16) + int(lo)
 
 
+@functools.lru_cache(maxsize=256)  # keyed on query-shaped exprs: bound it
+def _count_expr_fn(mesh: Mesh, expr: tuple):
+    """[L, S, W] leaf blocks → scalar count of the expression bitmap.
+
+    ``expr`` is a hashable tree: ``("leaf", i)`` selects leaf block i,
+    ``(op, a, b)`` combines subtrees with a bitwise op from kernels._BITWISE.
+    One jitted SPMD program per (mesh, expr) — the whole PQL bitmap
+    expression (e.g. Count(Intersect(Bitmap, Bitmap))) is evaluated
+    elementwise over every slice at once and reduced with a single psum,
+    replacing the reference's per-slice goroutine map + sum reduce
+    (executor.go:568-597,1103-1236).
+    """
+
+    def eval_node(e, leaves):
+        if e[0] == "leaf":
+            return leaves[e[1]]
+        return _BITWISE[e[0]](eval_node(e[1], leaves),
+                              eval_node(e[2], leaves))
+
+    def per_shard(leaves):  # leaves: [L, S/n, W]
+        words = eval_node(expr, leaves)
+        pc = jax.lax.population_count(words).astype(jnp.int32)
+        row = jnp.sum(pc, axis=-1).ravel()
+        hi = jax.lax.psum(jnp.sum(row >> 16), AXIS_SLICES)
+        lo = jax.lax.psum(jnp.sum(row & 0xFFFF), AXIS_SLICES)
+        return hi, lo
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(None, AXIS_SLICES),), out_specs=(P(), P())))
+
+
+def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
+    """Count the bitmap expression over slice-sharded leaf blocks.
+
+    ``leaves`` is ``[n_leaves, n_slices, n_words]`` u32; slices are padded
+    to the mesh and chunked at 2^15 (the hi/lo int32 bound), so any slice
+    count works.
+    """
+    n_dev = mesh.shape[AXIS_SLICES]
+    fn = _count_expr_fn(mesh, expr)
+    total = 0
+    for off in range(0, leaves.shape[1], 1 << 15):
+        chunk = leaves[:, off:off + (1 << 15)]
+        rem = chunk.shape[1] % n_dev
+        if rem:
+            pad = [(0, 0), (0, n_dev - rem), (0, 0)]
+            chunk = np.pad(chunk, pad)
+        hi, lo = fn(shard_slices_axis1(mesh, chunk))
+        total += (int(hi) << 16) + int(lo)
+    return total
+
+
+def shard_slices_axis1(mesh: Mesh, arr: np.ndarray) -> jax.Array:
+    """Place ``[L, n_slices, ...]`` on the mesh, sharded over axis 1."""
+    spec = [None] * arr.ndim
+    spec[1] = AXIS_SLICES
+    return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
 @functools.lru_cache(maxsize=None)
 def _topn_fn(mesh: Mesh, op: str, k: int):
     """rows [S, R, W] × src [S, W] → (top-k counts, top-k row indices).

@@ -236,6 +236,16 @@ class Fragment:
                 self.row_cache.add(row_id, bm)
             return bm
 
+    def pack_row(self, row_id: int, out: np.ndarray) -> np.ndarray:
+        """Pack one row's slice-local columns into dense u32 words.
+
+        ``out`` is a caller-provided zeroed u32[WORDS_PER_SLICE] buffer —
+        the executor's mesh fast path fills one [leaf, slice] plane of its
+        batched block per call."""
+        from ..ops.packed import pack_storage_row
+        with self._mu:
+            return pack_storage_row(self.storage, row_id, out)
+
     def row_count(self, row_id: int) -> int:
         return self.storage.count_range(row_id * SLICE_WIDTH,
                                         (row_id + 1) * SLICE_WIDTH)

@@ -398,3 +398,78 @@ class TestDistributed:
         e, client, cluster = self._two_node(holder, fn)
         e.execute("i", 'SetRowAttrs(rowID=10, frame=f, foo="bar")')
         assert len(client.calls) == 1  # forwarded to the one other node
+
+
+class TestDeviceCountPath:
+    """The mesh-batched Count fast path must agree exactly with the
+    per-slice host path on randomized data (and engage when eligible)."""
+
+    def _fill(self, holder, rng, frame="f", rows=(1, 2, 3), slices=3):
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists(frame)
+        for row in rows:
+            cols = rng.choice(slices * SLICE_WIDTH,
+                              size=rng.integers(50, 200), replace=False)
+            for col in cols:
+                f.set_bit("standard", int(row), int(col))
+
+    def test_matches_host_path(self, holder):
+        import numpy as np
+        rng = np.random.default_rng(7)
+        self._fill(holder, rng)
+        queries = [
+            'Count(Bitmap(rowID=1, frame=f))',
+            'Count(Intersect(Bitmap(rowID=1, frame=f),'
+            ' Bitmap(rowID=2, frame=f)))',
+            'Count(Union(Bitmap(rowID=1, frame=f), Bitmap(rowID=2, frame=f),'
+            ' Bitmap(rowID=3, frame=f)))',
+            'Count(Difference(Bitmap(rowID=1, frame=f),'
+            ' Bitmap(rowID=2, frame=f), Bitmap(rowID=3, frame=f)))',
+            'Count(Union(Intersect(Bitmap(rowID=1, frame=f),'
+            ' Bitmap(rowID=2, frame=f)), Bitmap(rowID=3, frame=f)))',
+            'Count(Bitmap(rowID=99, frame=f))',  # absent row
+        ]
+        fast = Executor(holder, host="local", use_mesh=True,
+                        mesh_min_slices=1)
+        slow = Executor(holder, host="local", use_mesh=False)
+        for q in queries:
+            assert fast.execute("i", q) == slow.execute("i", q), q
+
+    def test_fast_path_engages(self, holder, monkeypatch):
+        import numpy as np
+        rng = np.random.default_rng(8)
+        self._fill(holder, rng)
+        f = holder.frame("i", "f")
+        for col in (7, SLICE_WIDTH + 9, 2 * SLICE_WIDTH + 11):
+            f.set_bit("standard", 1, col)
+            f.set_bit("standard", 2, col)
+        ex = Executor(holder, host="local", use_mesh=True,
+                      mesh_min_slices=1)
+        called = {}
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        orig = mesh_mod.count_expr
+
+        def spy(mesh, expr, leaves):
+            called["expr"] = expr
+            called["shape"] = leaves.shape
+            return orig(mesh, expr, leaves)
+
+        monkeypatch.setattr(mesh_mod, "count_expr", spy)
+        res = ex.execute("i", 'Count(Intersect(Bitmap(rowID=1, frame=f),'
+                              ' Bitmap(rowID=2, frame=f)))')
+        assert called["expr"] == ("and", ("leaf", 0), ("leaf", 1))
+        assert called["shape"][0] == 2
+        assert res[0] >= 3  # the three overlap columns, one per slice
+
+    def test_range_falls_back(self, holder):
+        """Range inside Count isn't device-eligible — must still answer."""
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists(
+            "tq", FrameOptions(time_quantum="YMD"))
+        ex = Executor(holder, host="local", use_mesh=True)
+        ex.execute("i", 'SetBit(rowID=1, frame=tq, columnID=5,'
+                        ' timestamp="2017-01-02T00:00")')
+        res = ex.execute(
+            "i", 'Count(Range(rowID=1, frame=tq,'
+                 ' start="2017-01-01T00:00", end="2017-02-01T00:00"))')
+        assert res[0] == 1
