@@ -339,6 +339,10 @@ class Executor:
     _DEVICE_FOLD_OPS = {"Intersect": "and", "Union": "or",
                         "Difference": "andnot"}
 
+    # Largest dense candidate block the TopN mesh path may materialize
+    # host-side (slices × candidates × 128 KB); larger sets fall back.
+    _TOPN_HOST_BLOCK_BYTES = 2 << 30
+
     def _compile_device_expr(self, index: str, c: Call, leaves: list):
         """Compile a pure bitmap call tree into a mesh.count_expr tree.
 
@@ -399,21 +403,28 @@ class Executor:
             mesh = self._mesh_or_none()  # backend init only past threshold
             if mesh is None:
                 return NotImplemented
-            from .ops.packed import WORDS_PER_SLICE
             from .parallel import mesh as mesh_mod
-            block = np.zeros((len(leaves), len(slices), WORDS_PER_SLICE),
-                             dtype=np.uint32)
-            for li, (frame, view, row_id) in enumerate(leaves):
-                for si, slice in enumerate(slices):
-                    frag = self.holder.fragment(index, frame, view, slice)
-                    if frag is not None:
-                        frag.pack_row(row_id, out=block[li, si])
+            block = self._pack_leaf_block(index, leaves, slices)
             try:
                 return mesh_mod.count_expr(mesh, expr, block)
             except Exception:  # noqa: BLE001 - device trouble ≠ node down
                 return NotImplemented
 
         return local_fn
+
+    def _pack_leaf_block(self, index: str, leaves: list[tuple],
+                         slices: list[int]) -> np.ndarray:
+        """[n_leaves, n_slices, words] block of packed leaf rows; absent
+        fragments stay zero (the identity for every count reduce)."""
+        from .ops.packed import WORDS_PER_SLICE
+        block = np.zeros((len(leaves), len(slices), WORDS_PER_SLICE),
+                         dtype=np.uint32)
+        for li, (frame, view, row_id) in enumerate(leaves):
+            for si, slice in enumerate(slices):
+                frag = self.holder.fragment(index, frame, view, slice)
+                if frag is not None:
+                    frag.pack_row(row_id, out=block[li, si])
+        return block
 
     # -- TopN (executor.go:271-396) ------------------------------------------
 
@@ -441,8 +452,75 @@ class Executor:
         def reduce_fn(prev, v):
             return pairs_add(prev or [], v)
 
-        pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        local_fn = self._topn_local_device_fn(index, c)
+        pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
+                                 local_fn=local_fn)
         return pairs_sort(pairs or [])
+
+    def _topn_local_device_fn(self, index: str, c: Call):
+        """Batched local-leg TopN exact-count phase: ALL candidate rows ×
+        ALL slices in one psum-reduced mesh program.
+
+        Eligible only for the with-source exact-count form — explicit
+        candidate ids, a device-compilable source bitmap, no attribute
+        filter, no Tanimoto, default threshold — where the per-slice
+        algorithm (fragment.go:490-625) degenerates to "sum
+        count(row ∩ src) over slices, drop zeros": exactly a mesh
+        reduction (parallel.mesh.topn_exact). The ids-without-source
+        form stays host-side on purpose: there the per-slice path
+        answers from RankCache counts, and the device's fresh popcounts
+        could disagree with a stale cache entry. Everything else keeps
+        the per-slice path, which owns the full semantics.
+        """
+        if not self.use_mesh:
+            return None
+        row_ids, _ = c.uint_slice_arg("ids")
+        if not row_ids:
+            return None  # candidate-selection phase reads rank caches
+        min_threshold, _ = c.uint_arg("threshold")
+        tanimoto, _ = c.uint_arg("tanimotoThreshold")
+        if (c.args.get("field") or c.args.get("filters")
+                or min_threshold > 1 or tanimoto):
+            return None
+        if len(c.children) != 1:
+            return None
+        frame_name = c.args.get("frame") or DEFAULT_FRAME
+        leaves: list[tuple] = []
+        expr = self._compile_device_expr(index, c.children[0], leaves)
+        if expr is None:
+            return None
+
+        def local_fn(slices: list[int]):
+            if len(slices) < self.mesh_min_slices:
+                return NotImplemented
+            from .ops.packed import WORDS_PER_SLICE
+            # Host-allocation guard: huge candidate sets stay on the
+            # per-slice path, which never materializes a dense block.
+            if (len(slices) * len(row_ids) * WORDS_PER_SLICE * 4
+                    > self._TOPN_HOST_BLOCK_BYTES):
+                return NotImplemented
+            mesh = self._mesh_or_none()
+            if mesh is None:
+                return NotImplemented
+            from .parallel import mesh as mesh_mod
+            rows = np.zeros((len(slices), len(row_ids), WORDS_PER_SLICE),
+                            dtype=np.uint32)
+            for si, slice in enumerate(slices):
+                frag = self.holder.fragment(index, frame_name,
+                                            VIEW_STANDARD, slice)
+                if frag is None:
+                    continue
+                for ri, rid in enumerate(row_ids):
+                    frag.pack_row(rid, out=rows[si, ri])
+            leaf_block = self._pack_leaf_block(index, leaves, slices)
+            try:
+                counts = mesh_mod.topn_exact(mesh, expr, rows, leaf_block)
+            except Exception:  # noqa: BLE001 - device trouble ≠ node down
+                return NotImplemented
+            return [Pair(rid, cnt)
+                    for rid, cnt in zip(row_ids, counts) if cnt > 0]
+
+        return local_fn
 
     def _top_n_slice(self, index: str, c: Call, slice: int) -> list[Pair]:
         # executor.go:325-396

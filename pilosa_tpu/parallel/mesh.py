@@ -116,14 +116,8 @@ def _count_expr_fn(mesh: Mesh, expr: tuple):
     (executor.go:568-597,1103-1236).
     """
 
-    def eval_node(e, leaves):
-        if e[0] == "leaf":
-            return leaves[e[1]]
-        return _BITWISE[e[0]](eval_node(e[1], leaves),
-                              eval_node(e[2], leaves))
-
     def per_shard(leaves):  # leaves: [L, S/n, W]
-        words = eval_node(expr, leaves)
+        words = _eval_expr(expr, leaves)
         pc = jax.lax.population_count(words).astype(jnp.int32)
         row = jnp.sum(pc, axis=-1).ravel()
         hi = jax.lax.psum(jnp.sum(row >> 16), AXIS_SLICES)
@@ -161,6 +155,82 @@ def shard_slices_axis1(mesh: Mesh, arr: np.ndarray) -> jax.Array:
     spec = [None] * arr.ndim
     spec[1] = AXIS_SLICES
     return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
+
+
+def _eval_expr(expr, leaves):
+    if expr[0] == "leaf":
+        return leaves[expr[1]]
+    return _BITWISE[expr[0]](_eval_expr(expr[1], leaves),
+                             _eval_expr(expr[2], leaves))
+
+
+@functools.lru_cache(maxsize=256)
+def _topn_exact_fn(mesh: Mesh, expr):
+    """Exact candidate counts across slices, one psum-reduced program.
+
+    rows [S, R, W] (candidate row blocks per slice) → [R] counts of
+    ``popcount(row ∩ expr)`` (or plain row popcount when expr is None),
+    summed over every slice — the device form of the executor's TopN
+    exact-count re-query (executor.go:273-310 second phase). Per-(slice,
+    row) counts ≤ 2^20 are split 16/16 before the psum so int32 holds up
+    to 2^15 slices per call (callers chunk above that).
+    """
+
+    def per_shard(rows, leaves):  # rows: [S/n, R, W]; leaves: [L, S/n, W]
+        words = rows
+        if expr is not None:
+            src = _eval_expr(expr, leaves)        # [S/n, W]
+            words = jnp.bitwise_and(rows, src[:, None, :])
+        pc = jax.lax.population_count(words).astype(jnp.int32)
+        per_slice = jnp.sum(pc, axis=-1)          # [S/n, R], each ≤ 2^20
+        hi = jax.lax.psum(jnp.sum(per_slice >> 16, axis=0), AXIS_SLICES)
+        lo = jax.lax.psum(jnp.sum(per_slice & 0xFFFF, axis=0), AXIS_SLICES)
+        return hi, lo
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(AXIS_SLICES), P(None, AXIS_SLICES)),
+        out_specs=(P(), P())))
+
+
+# Device-block budget for one topn_exact call (mirrors the 256 MB
+# per-block bound of the per-fragment path, fragment.py chunk=2048).
+_TOPN_BLOCK_BYTES = 256 << 20
+
+
+def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
+               leaves: np.ndarray | None) -> list[int]:
+    """[R] exact counts of each candidate row against ``expr`` (or the
+    rows' own popcounts when expr is None), summed over all slices.
+
+    Chunks both axes: slices at 2^15 (the int32 hi/lo bound) and
+    candidate rows by the device-block byte budget — counts are
+    independent per row and additive per slice, so any tiling is exact.
+    """
+    n_dev = mesh.shape[AXIS_SLICES]
+    fn = _topn_exact_fn(mesh, expr)
+    n_slices, n_rows, n_words = rows.shape
+    slice_chunk = min(1 << 15, n_slices) or 1
+    row_chunk = max(1, _TOPN_BLOCK_BYTES // (slice_chunk * n_words * 4))
+    totals = [0] * n_rows
+    for s_off in range(0, n_slices, slice_chunk):
+        lc = None
+        if leaves is not None:
+            lc = leaves[:, s_off:s_off + slice_chunk]
+        for r_off in range(0, n_rows, row_chunk):
+            rc = rows[s_off:s_off + slice_chunk, r_off:r_off + row_chunk]
+            lcc = lc if lc is not None else \
+                np.zeros((0, rc.shape[0], 1), dtype=np.uint32)
+            rem = rc.shape[0] % n_dev
+            if rem:
+                rc = np.pad(rc, [(0, n_dev - rem), (0, 0), (0, 0)])
+                lcc = np.pad(lcc, [(0, 0), (0, n_dev - rem), (0, 0)])
+            hi, lo = fn(shard_slices(mesh, rc),
+                        shard_slices_axis1(mesh, lcc))
+            hi, lo = np.asarray(hi), np.asarray(lo)
+            for r in range(rc.shape[1]):
+                totals[r_off + r] += (int(hi[r]) << 16) + int(lo[r])
+    return totals
 
 
 @functools.lru_cache(maxsize=None)

@@ -473,3 +473,71 @@ class TestDeviceCountPath:
             "i", 'Count(Range(rowID=1, frame=tq,'
                  ' start="2017-01-01T00:00", end="2017-02-01T00:00"))')
         assert res[0] == 1
+
+
+class TestDeviceTopNPath:
+    """Mesh-batched TopN exact-count phase must agree with the per-slice
+    host path and engage for the eligible form."""
+
+    def _fill(self, holder, slices=3):
+        import numpy as np
+        rng = np.random.default_rng(11)
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("f")
+        for row in range(6):
+            cols = rng.choice(slices * SLICE_WIDTH, size=120, replace=False)
+            for col in cols:
+                f.set_bit("standard", row, int(col))
+        # deterministic overlaps so intersections are non-trivial
+        for col in range(0, slices * SLICE_WIDTH, SLICE_WIDTH // 2):
+            for row in range(6):
+                f.set_bit("standard", row, col)
+
+    def test_topn_matches_host_path(self, holder):
+        self._fill(holder)
+        fast = Executor(holder, host="local", use_mesh=True,
+                        mesh_min_slices=1)
+        slow = Executor(holder, host="local", use_mesh=False)
+        queries = [
+            'TopN(frame=f, n=3)',
+            'TopN(frame=f, n=4, ids=[0,1,2,3,4,5])',
+            'TopN(Bitmap(rowID=0, frame=f), frame=f, n=4)',
+            'TopN(Intersect(Bitmap(rowID=0, frame=f),'
+            ' Bitmap(rowID=1, frame=f)), frame=f, n=3)',
+        ]
+        for q in queries:
+            assert fast.execute("i", q) == slow.execute("i", q), q
+
+    def test_exact_phase_engages(self, holder, monkeypatch):
+        self._fill(holder)
+        ex = Executor(holder, host="local", use_mesh=True,
+                      mesh_min_slices=1)
+        calls = []
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        orig = mesh_mod.topn_exact
+
+        def spy(mesh, expr, rows, leaves):
+            calls.append((expr, rows.shape))
+            return orig(mesh, expr, rows, leaves)
+
+        monkeypatch.setattr(mesh_mod, "topn_exact", spy)
+        res = ex.execute("i", 'TopN(Bitmap(rowID=0, frame=f), frame=f, n=3)')
+        assert calls, "TopN exact phase did not use the mesh path"
+        assert calls[-1][0] == ("leaf", 0)
+        assert len(res[0]) == 3
+
+    def test_filters_fall_back(self, holder, monkeypatch):
+        self._fill(holder)
+        holder.frame("i", "f").row_attr_store.set_attrs(0, {"cat": "x"})
+        ex = Executor(holder, host="local", use_mesh=True,
+                      mesh_min_slices=1)
+        from pilosa_tpu.parallel import mesh as mesh_mod
+
+        def boom(*a, **kw):
+            raise AssertionError("device path must not engage with filters")
+
+        monkeypatch.setattr(mesh_mod, "topn_exact", boom)
+        res = ex.execute(
+            "i", 'TopN(frame=f, n=2, field="cat", filters=["x"],'
+                 ' ids=[0,1,2])')
+        assert all(p.id == 0 for p in res[0])
