@@ -503,6 +503,8 @@ class Executor:
             if mesh is None:
                 return NotImplemented
             from .parallel import mesh as mesh_mod
+            from .parallel.residency import DEFAULT_MAX_ROWS
+            cached = len(row_ids) <= DEFAULT_MAX_ROWS
             rows = np.zeros((len(slices), len(row_ids), WORDS_PER_SLICE),
                             dtype=np.uint32)
             for si, slice in enumerate(slices):
@@ -511,7 +513,7 @@ class Executor:
                 if frag is None:
                     continue
                 for ri, rid in enumerate(row_ids):
-                    frag.pack_row(rid, out=rows[si, ri])
+                    frag.pack_row(rid, out=rows[si, ri], cached=cached)
             leaf_block = self._pack_leaf_block(index, leaves, slices)
             try:
                 counts = mesh_mod.topn_exact(mesh, expr, rows, leaf_block)

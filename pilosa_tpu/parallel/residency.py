@@ -30,6 +30,10 @@ class DeviceRowCache:
     def __init__(self, max_rows: int = DEFAULT_MAX_ROWS):
         self.max_rows = max_rows
         self._rows: OrderedDict[int, jax.Array] = OrderedDict()
+        # Host-side packed words, feeding both device pinning and the
+        # executor's mesh block builds (which stack rows across
+        # fragments host-side before one sharded device_put).
+        self._host_rows: OrderedDict[int, np.ndarray] = OrderedDict()
         # Write generation: bumped on every invalidation so cached row
         # blocks (keyed by ids+generation) go stale automatically.
         self.generation = 0
@@ -38,20 +42,31 @@ class DeviceRowCache:
 
     # -- single rows
 
-    def row_words(self, storage, row_id: int) -> jax.Array:
-        """Device words for one row; packs and pins on miss.
+    def host_row_words(self, storage, row_id: int) -> np.ndarray:
+        """Packed host words for one row (read-only view); caches on miss.
 
         ``storage`` is the fragment-local roaring bitmap
         (pos = row*SLICE_WIDTH + col).
         """
+        words = self._host_rows.get(row_id)
+        if words is not None:
+            self._host_rows.move_to_end(row_id)
+            return words
+        words = np.zeros(packed.WORDS_PER_SLICE, dtype=np.uint32)
+        packed.pack_storage_row(storage, row_id, words)
+        words.flags.writeable = False  # callers copy, never mutate
+        self._host_rows[row_id] = words
+        while len(self._host_rows) > self.max_rows:
+            self._host_rows.popitem(last=False)
+        return words
+
+    def row_words(self, storage, row_id: int) -> jax.Array:
+        """Device words for one row; packs and pins on miss."""
         arr = self._rows.get(row_id)
         if arr is not None:
             self._rows.move_to_end(row_id)
             return arr
-        row_bm = storage.offset_range(0, row_id * SLICE_WIDTH,
-                                      (row_id + 1) * SLICE_WIDTH)
-        words = packed.pack_bitmap(row_bm, packed.WORDS_PER_SLICE)
-        arr = jax.device_put(words)
+        arr = jax.device_put(self.host_row_words(storage, row_id))
         self._rows[row_id] = arr
         while len(self._rows) > self.max_rows:
             self._rows.popitem(last=False)
@@ -59,10 +74,12 @@ class DeviceRowCache:
 
     def invalidate_row(self, row_id: int) -> None:
         self._rows.pop(row_id, None)
+        self._host_rows.pop(row_id, None)
         self.generation += 1
 
     def invalidate_all(self) -> None:
         self._rows.clear()
+        self._host_rows.clear()
         self._block_key = None
         self._block = None
         self.generation += 1

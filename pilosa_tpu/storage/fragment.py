@@ -236,15 +236,24 @@ class Fragment:
                 self.row_cache.add(row_id, bm)
             return bm
 
-    def pack_row(self, row_id: int, out: np.ndarray) -> np.ndarray:
-        """Pack one row's slice-local columns into dense u32 words.
+    def pack_row(self, row_id: int, out: np.ndarray,
+                 cached: bool = True) -> np.ndarray:
+        """Copy one row's packed slice-local words into ``out``.
 
         ``out`` is a caller-provided zeroed u32[WORDS_PER_SLICE] buffer —
-        the executor's mesh fast path fills one [leaf, slice] plane of its
-        batched block per call."""
+        the executor's mesh fast path fills one [leaf, slice] plane of
+        its batched block per call. With ``cached`` (the default for hot
+        leaf rows) packed words come from the residency manager's host
+        cache, so repeated queries memcpy instead of re-walking roaring
+        containers; bulk packs of sets larger than the cache budget pass
+        ``cached=False`` to avoid churning the LRU for a 0% hit rate."""
         from ..ops.packed import pack_storage_row
         with self._mu:
-            return pack_storage_row(self.storage, row_id, out)
+            if cached:
+                out[:] = self.device.host_row_words(self.storage, row_id)
+            else:
+                pack_storage_row(self.storage, row_id, out)
+        return out
 
     def row_count(self, row_id: int) -> int:
         return self.storage.count_range(row_id * SLICE_WIDTH,

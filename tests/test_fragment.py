@@ -329,3 +329,30 @@ class TestReviewRegressions:
             assert f.row_count(1) == 1  # opens fine, cache rebuilt lazily
         finally:
             f.close()
+
+
+class TestPackedRowCache:
+    def test_pack_row_caches_and_invalidates(self, tmp_path):
+        import numpy as np
+        from pilosa_tpu.ops.packed import WORDS_PER_SLICE
+        from pilosa_tpu.storage.fragment import Fragment
+        f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f.open()
+        try:
+            f.set_bit(1, 5)
+            f.set_bit(1, 65)
+            out = np.zeros(WORDS_PER_SLICE, dtype=np.uint32)
+            f.pack_row(1, out)
+            assert out[0] == 1 << 5 and out[2] == 1 << 1
+            # second pack comes from the host cache (same contents)
+            out2 = np.zeros(WORDS_PER_SLICE, dtype=np.uint32)
+            f.pack_row(1, out2)
+            assert (out == out2).all()
+            assert 1 in f.device._host_rows
+            # a write invalidates the cached packed row
+            f.set_bit(1, 6)
+            assert 1 not in f.device._host_rows
+            f.pack_row(1, out)
+            assert out[0] == (1 << 5) | (1 << 6)
+        finally:
+            f.close()
