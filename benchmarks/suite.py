@@ -1,0 +1,223 @@
+"""Benchmark suite for the BASELINE.md target configurations.
+
+Prints one JSON line per config. `bench.py` at the repo root remains the
+single-metric benchmark of record; this suite covers the remaining
+BASELINE.json configs for documentation and regression tracking:
+
+1. Single-fragment Intersect+Count on two 1M-column rows (config 1) —
+   through the Fragment/query layer, host path vs device kernel.
+2. Union/Difference over 1K rows in one slice, mixed container kinds
+   (config 2) — device row-block fold vs the C++/numpy host kernel.
+3. TopN(n) over a rows×columns frame with a source bitmap (config 3) —
+   p50 latency of the executor's exact-count phase, host vs mesh.
+4. Count(Intersect) across N slices on the device mesh (config 4) —
+   mesh.count_expr, the mapReduce replacement.
+5. Cluster-style TopN across N slices (config 5, single-host form) —
+   mesh.topn_exact; the multi-host leg adds HTTP remote legs on top.
+
+Timing through the TPU tunnel: per-call sync costs ~65 ms regardless of
+payload, so each measurement chains dispatches and syncs once
+(see bench.py's methodology note), except the latency configs (3) where
+the sync IS part of the reported p50.
+
+Env: PILOSA_BENCH_SCALE (default 1.0) scales row/slice counts down for
+smoke runs; PILOSA_BENCH_DEVICE=0 skips device measurements.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+SCALE = float(os.environ.get("PILOSA_BENCH_SCALE", "1.0"))
+USE_DEVICE = os.environ.get("PILOSA_BENCH_DEVICE", "1") != "0"
+
+
+def emit(metric: str, value: float, unit: str, **extra) -> None:
+    print(json.dumps({"metric": metric, "value": round(value, 4),
+                      "unit": unit, **extra}), flush=True)
+
+
+def _timed_chain(fn, iters: int) -> float:
+    """Median-of-3 per-call seconds, chained dispatch + single sync."""
+    np.asarray(fn())  # warmup/compile
+    best = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn()
+        np.asarray(out)
+        best.append((time.perf_counter() - t0) / iters)
+    return sorted(best)[1]
+
+
+def config1_fragment_intersect_count() -> None:
+    from pilosa_tpu.ops import kernels
+    from pilosa_tpu.storage import native
+    import jax
+
+    n_words = (1 << 20) // 32
+    rng = np.random.default_rng(1)
+    a = rng.integers(0, 2**32, size=n_words, dtype=np.uint32)
+    b = rng.integers(0, 2**32, size=n_words, dtype=np.uint32)
+
+    native.popcnt_and(a.view(np.uint64), b.view(np.uint64))
+    t0 = time.perf_counter()
+    iters = 50
+    for _ in range(iters):
+        native.popcnt_and(a.view(np.uint64), b.view(np.uint64))
+    host_s = (time.perf_counter() - t0) / iters
+    emit("c1_intersect_count_1M_host", 1.0 / host_s, "ops/sec")
+
+    if USE_DEVICE:
+        da, db = jax.device_put(a), jax.device_put(b)
+        dev_s = _timed_chain(
+            lambda: kernels.op_count_rows("and", da, db), 64)
+        emit("c1_intersect_count_1M_device", 1.0 / dev_s, "ops/sec",
+             vs_host=round(host_s / dev_s, 3))
+
+
+def config2_union_difference_1k_rows() -> None:
+    from pilosa_tpu.ops import kernels
+    import jax
+
+    n_rows = max(8, int(1000 * SCALE))
+    n_words = (1 << 20) // 32
+    rng = np.random.default_rng(2)
+    # mixed "containers": half dense rows, half sparse (array-like)
+    rows = rng.integers(0, 2**32, size=(n_rows, n_words), dtype=np.uint32)
+    rows[n_rows // 2:] &= rng.integers(0, 2, size=(n_rows - n_rows // 2,
+                                                   n_words),
+                                       dtype=np.uint32)  # sparsify
+    other = rng.integers(0, 2**32, size=n_words, dtype=np.uint32)
+
+    t0 = time.perf_counter()
+    np.bitwise_count(np.bitwise_or(rows, other[None, :])).sum(axis=-1)
+    host_s = time.perf_counter() - t0
+    emit("c2_union_1k_rows_host", 1.0 / host_s, "ops/sec")
+
+    if USE_DEVICE:
+        dr, do = jax.device_put(rows), jax.device_put(other)
+        dev_s = _timed_chain(
+            lambda: kernels.row_block_op_count("or", dr, do), 16)
+        emit("c2_union_1k_rows_device", 1.0 / dev_s, "ops/sec",
+             vs_host=round(host_s / dev_s, 3))
+        dev_s = _timed_chain(
+            lambda: kernels.row_block_op_count("andnot", dr, do), 16)
+        emit("c2_difference_1k_rows_device", 1.0 / dev_s, "ops/sec")
+
+
+def config3_topn_latency() -> None:
+    """TopN exact-count phase p50 latency, host loop vs one mesh call."""
+    from pilosa_tpu.parallel import mesh as mesh_mod
+    import jax
+
+    n_rows = max(64, int(1000 * SCALE))
+    n_slices = max(2, int(10 * SCALE))
+    n_words = (1 << 20) // 32
+    rng = np.random.default_rng(3)
+    rows = rng.integers(0, 2**32, size=(n_slices, n_rows, n_words),
+                        dtype=np.uint32)
+    src = rng.integers(0, 2**32, size=(1, n_slices, n_words),
+                       dtype=np.uint32)
+
+    lat = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        np.bitwise_count(rows & src[0][:, None, :]).sum(axis=(0, 2))
+        lat.append(time.perf_counter() - t0)
+    emit("c3_topn_exact_host_p50", sorted(lat)[2] * 1e3, "ms",
+         rows=n_rows, slices=n_slices)
+
+    if USE_DEVICE:
+        mesh = mesh_mod.make_mesh()
+        expr = ("leaf", 0)
+        mesh_mod.topn_exact(mesh, expr, rows, src)  # compile
+        lat = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            mesh_mod.topn_exact(mesh, expr, rows, src)
+            lat.append(time.perf_counter() - t0)
+        emit("c3_topn_exact_mesh_p50", sorted(lat)[2] * 1e3, "ms",
+             rows=n_rows, slices=n_slices)
+
+
+def config4_mesh_count_over_slices() -> None:
+    from pilosa_tpu.parallel import mesh as mesh_mod
+    import jax
+
+    n_slices = max(8, int(256 * SCALE))
+    n_words = (1 << 20) // 32
+    rng = np.random.default_rng(4)
+    leaves = rng.integers(0, 2**32, size=(2, n_slices, n_words),
+                          dtype=np.uint32)
+
+    t0 = time.perf_counter()
+    int(np.bitwise_count(leaves[0] & leaves[1]).sum())
+    host_s = time.perf_counter() - t0
+    emit("c4_count_intersect_host", 1.0 / host_s, "ops/sec",
+         slices=n_slices)
+
+    if USE_DEVICE:
+        mesh = mesh_mod.make_mesh()
+        expr = ("and", ("leaf", 0), ("leaf", 1))
+        mesh_mod.count_expr(mesh, expr, leaves)  # compile
+        lat = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            mesh_mod.count_expr(mesh, expr, leaves)
+            lat.append(time.perf_counter() - t0)
+        dev_s = sorted(lat)[2]
+        emit("c4_count_intersect_mesh", 1.0 / dev_s, "ops/sec",
+             slices=n_slices, devices=len(jax.devices()),
+             vs_host=round(host_s / dev_s, 3))
+
+
+def config5_cluster_topn() -> None:
+    from pilosa_tpu.parallel import mesh as mesh_mod
+    import jax
+
+    n_slices = max(8, int(256 * SCALE))
+    n_rows = max(16, int(100 * SCALE))
+    n_words = (1 << 20) // 32
+    rng = np.random.default_rng(5)
+    rows = rng.integers(0, 2**32, size=(n_slices, n_rows, n_words),
+                        dtype=np.uint32)
+    src = rng.integers(0, 2**32, size=(1, n_slices, n_words),
+                       dtype=np.uint32)
+
+    if USE_DEVICE:
+        mesh = mesh_mod.make_mesh()
+        mesh_mod.topn_exact(mesh, ("leaf", 0), rows, src)  # compile
+        lat = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            counts = mesh_mod.topn_exact(mesh, ("leaf", 0), rows, src)
+            lat.append(time.perf_counter() - t0)
+        emit("c5_cluster_topn_mesh_p50", sorted(lat)[2] * 1e3, "ms",
+             slices=n_slices, rows=n_rows,
+             devices=len(jax.devices()))
+
+
+def main() -> None:
+    for fn in (config1_fragment_intersect_count,
+               config2_union_difference_1k_rows,
+               config3_topn_latency,
+               config4_mesh_count_over_slices,
+               config5_cluster_topn):
+        try:
+            fn()
+        except Exception as e:  # noqa: BLE001 - report and continue
+            emit(fn.__name__, -1, "error", error=str(e)[:200])
+
+
+if __name__ == "__main__":
+    main()

@@ -503,8 +503,6 @@ class Executor:
             if mesh is None:
                 return NotImplemented
             from .parallel import mesh as mesh_mod
-            from .parallel.residency import DEFAULT_MAX_ROWS
-            cached = len(row_ids) <= DEFAULT_MAX_ROWS
             rows = np.zeros((len(slices), len(row_ids), WORDS_PER_SLICE),
                             dtype=np.uint32)
             for si, slice in enumerate(slices):
@@ -512,6 +510,10 @@ class Executor:
                                             VIEW_STANDARD, slice)
                 if frag is None:
                     continue
+                # Bypass the packed-row LRU when this candidate set
+                # exceeds the fragment's own budget (0% hit rate, pure
+                # churn against the hot leaf rows).
+                cached = len(row_ids) <= frag.device.max_rows
                 for ri, rid in enumerate(row_ids):
                     frag.pack_row(rid, out=rows[si, ri], cached=cached)
             leaf_block = self._pack_leaf_block(index, leaves, slices)

@@ -29,8 +29,7 @@ DEFAULT_MAX_ROWS = 256
 class DeviceRowCache:
     def __init__(self, max_rows: int = DEFAULT_MAX_ROWS):
         self.max_rows = max_rows
-        self._rows: OrderedDict[int, jax.Array] = OrderedDict()
-        # Host-side packed words, feeding both device pinning and the
+        # Host-side packed words, feeding the device row blocks and the
         # executor's mesh block builds (which stack rows across
         # fragments host-side before one sharded device_put).
         self._host_rows: OrderedDict[int, np.ndarray] = OrderedDict()
@@ -60,25 +59,11 @@ class DeviceRowCache:
             self._host_rows.popitem(last=False)
         return words
 
-    def row_words(self, storage, row_id: int) -> jax.Array:
-        """Device words for one row; packs and pins on miss."""
-        arr = self._rows.get(row_id)
-        if arr is not None:
-            self._rows.move_to_end(row_id)
-            return arr
-        arr = jax.device_put(self.host_row_words(storage, row_id))
-        self._rows[row_id] = arr
-        while len(self._rows) > self.max_rows:
-            self._rows.popitem(last=False)
-        return arr
-
     def invalidate_row(self, row_id: int) -> None:
-        self._rows.pop(row_id, None)
         self._host_rows.pop(row_id, None)
         self.generation += 1
 
     def invalidate_all(self) -> None:
-        self._rows.clear()
         self._host_rows.clear()
         self._block_key = None
         self._block = None
@@ -97,5 +82,3 @@ class DeviceRowCache:
         self._block_key = key
         return self._block
 
-    def resident_rows(self) -> list[int]:
-        return list(self._rows)
