@@ -1,0 +1,165 @@
+"""Multi-host TPU execution: one SPMD mesh across a pod of hosts.
+
+The reference scales across machines with HTTP remote legs + gossip
+(executor.go:1001-1083, gossip/gossip.go); pilosa-tpu keeps that DCN
+path for *cross-cluster* queries, and adds this layer for the case the
+reference cannot express: a single TPU pod spanning several hosts (e.g.
+v5e-16 = 2 hosts × 8 chips), where the slice axis shards over EVERY
+chip in the pod and Count/TopN reductions ride ICI end-to-end instead
+of merging per-host results over HTTP.
+
+Design (scaling-book recipe):
+- each host in the pod is one jax.distributed process; together they
+  own one global ``Mesh`` over all chips (slices axis, optional rows
+  axis);
+- each host feeds ONLY its local shard of the leaf/candidate blocks
+  (``jax.make_array_from_process_local_data``) — slice placement is
+  aligned so the slices a host serves are the slices its chips hold;
+- the jitted programs are the SAME ones the single-host executor uses
+  (parallel.mesh._count_expr_fn / _topn_exact_fn): under SPMD every
+  process runs the identical program and the psum spans the pod.
+
+The coordinator/membership control plane stays host-side HTTP/gossip —
+metadata is not bandwidth-bound (SURVEY.md §5).
+
+Environment contract (set by the pod launcher):
+  PILOSA_TPU_DIST_COORDINATOR  host:port of process 0
+  PILOSA_TPU_DIST_NUM_PROCS    total process count
+  PILOSA_TPU_DIST_PROC_ID      this process's id (0-based)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from . import mesh as mesh_mod
+
+_initialized = False
+
+
+def initialize_from_env() -> bool:
+    """Join the pod's jax.distributed job if the env contract is set.
+
+    Idempotent; returns True when running as part of a multi-process
+    job (including a degenerate 1-process one, which is how tests
+    exercise this path without pod hardware).
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coord = os.environ.get("PILOSA_TPU_DIST_COORDINATOR")
+    if not coord:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coord,
+        num_processes=int(os.environ.get("PILOSA_TPU_DIST_NUM_PROCS", "1")),
+        process_id=int(os.environ.get("PILOSA_TPU_DIST_PROC_ID", "0")))
+    _initialized = True
+    return True
+
+
+def pod_mesh(rows: int = 1) -> Mesh:
+    """A (rows × slices) mesh over every chip in the pod (all processes)."""
+    return mesh_mod.make_mesh(len(jax.devices()), rows=rows)
+
+
+def process_slice_range(n_slices: int) -> tuple[int, int]:
+    """[lo, hi) rows of the global slice axis this process must feed.
+
+    The global block is sharded evenly over the slice axis; with
+    process-local device order matching mesh order (the default
+    make_mesh layout), each process feeds a contiguous range. Slice
+    placement in the cluster layer should assign these slices to this
+    host so packing is local (no cross-host reads).
+    """
+    n_procs = jax.process_count()
+    if n_slices % n_procs:
+        raise ValueError(f"{n_slices} slices not divisible by"
+                         f" {n_procs} processes (pad first)")
+    per = n_slices // n_procs
+    pid = jax.process_index()
+    return pid * per, (pid + 1) * per
+
+
+# Local slice-axis chunk size: every process uses the same bound, so
+# chunk boundaries agree pod-wide; the global per-chunk slice count
+# (chunk × n_procs) stays within the int32 hi/lo split for any pod that
+# divides 2^15.
+def _local_chunk() -> int:
+    return max(1, (1 << 15) // jax.process_count())
+
+
+def _pad_local(local: np.ndarray, axis: int) -> np.ndarray:
+    """Pad this process's shard so every process contributes the same
+    number of slice rows per device. Zero slices are the identity for
+    every count/TopN reduction, so the result is exact even though the
+    zeros interleave between process ranges in the global order."""
+    per_dev = len(jax.devices()) // jax.process_count()
+    rem = local.shape[axis] % per_dev
+    if rem == 0 and local.shape[axis] > 0:
+        return local
+    pad_n = (per_dev - rem) % per_dev or (per_dev if local.shape[axis] == 0
+                                          else 0)
+    pad = [(0, 0)] * local.ndim
+    pad[axis] = (0, pad_n)
+    return np.pad(local, pad)
+
+
+def _global_from_local(mesh: Mesh, local: np.ndarray,
+                       axis: int) -> jax.Array:
+    """Assemble the pod-global sharded array from this process's shard."""
+    spec = [None] * local.ndim
+    spec[axis] = mesh_mod.AXIS_SLICES
+    sharding = NamedSharding(mesh, P(*spec))
+    global_shape = list(local.shape)
+    global_shape[axis] = local.shape[axis] * jax.process_count()
+    return jax.make_array_from_process_local_data(
+        sharding, local, tuple(global_shape))
+
+
+def count_expr(mesh: Mesh, expr: tuple, local_leaves: np.ndarray) -> int:
+    """Pod-wide Count: each process passes its local [L, S_local, W]
+    leaf shard; the psum spans every chip on every host. Chunks the
+    slice axis identically on every process (int32 hi/lo bound)."""
+    total = 0
+    step = _local_chunk()
+    for off in range(0, max(local_leaves.shape[1], 1), step):
+        chunk = _pad_local(local_leaves[:, off:off + step], 1)
+        arr = _global_from_local(mesh, chunk, 1)
+        hi, lo = mesh_mod._count_expr_fn(mesh, expr)(arr)
+        total += (int(hi) << 16) + int(lo)
+    return total
+
+
+def topn_exact(mesh: Mesh, expr, local_rows: np.ndarray,
+               local_leaves: Optional[np.ndarray]) -> list[int]:
+    """Pod-wide TopN exact counts: local shards in, global counts out.
+
+    Chunks slices (int32 bound) and candidate rows (device-block byte
+    budget, mirroring mesh.topn_exact) with pod-wide identical bounds.
+    """
+    n_local, n_rows, n_words = local_rows.shape
+    if local_leaves is None:
+        local_leaves = np.zeros((0, n_local, 1), dtype=np.uint32)
+    s_step = _local_chunk()
+    r_step = max(1, mesh_mod._TOPN_BLOCK_BYTES
+                 // (max(s_step, 1) * n_words * 4))
+    totals = [0] * n_rows
+    for s_off in range(0, max(n_local, 1), s_step):
+        for r_off in range(0, n_rows, r_step):
+            rc = _pad_local(
+                local_rows[s_off:s_off + s_step, r_off:r_off + r_step], 0)
+            lc = _pad_local(local_leaves[:, s_off:s_off + s_step], 1)
+            rows = _global_from_local(mesh, rc, 0)
+            leaves = _global_from_local(mesh, lc, 1)
+            hi, lo = mesh_mod._topn_exact_fn(mesh, expr)(rows, leaves)
+            hi, lo = np.asarray(hi), np.asarray(lo)
+            for r in range(rc.shape[1]):
+                totals[r_off + r] += (int(hi[r]) << 16) + int(lo[r])
+    return totals

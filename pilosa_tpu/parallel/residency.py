@@ -1,12 +1,12 @@
 """HBM working-set manager: device residency for hot fragment rows.
 
 The reference mutates mmap'd bitmaps in place; device arrays are immutable
-and HBM is smaller than the on-disk index, so device copies are an explicit
-cache: rows are packed (pilosa_tpu.ops.packed) and pinned on device on first
-use, invalidated by writes, and evicted LRU under a row budget. The rank
-cache already identifies the hot rows (TopN candidates), so the TopN row
-*block* — a stacked u32 matrix — is cached as a unit keyed by (row ids,
-write generation).
+and HBM is smaller than the on-disk index, so device state is an explicit
+cache with two layers: a host-side LRU of packed row words (feeding the
+executor's mesh block builds and device uploads; invalidated per row by
+writes, bounded by ``max_rows``), and the TopN candidate row *block* — a
+stacked u32 matrix pinned in HBM as a unit, keyed by (row ids, write
+generation) since the rank cache already identifies the hot rows.
 
 One manager exists per fragment (pilosa_tpu.storage.fragment.Fragment).
 """
@@ -22,7 +22,7 @@ import numpy as np
 from .. import SLICE_WIDTH
 from ..ops import packed
 
-# Default HBM budget per fragment, in rows (256 rows × 128 KB = 32 MB).
+# Default packed-row budget per fragment (256 rows × 128 KB = 32 MB\n# host-side; the device holds only the TopN block).
 DEFAULT_MAX_ROWS = 256
 
 

@@ -541,3 +541,48 @@ class TestDeviceTopNPath:
             "i", 'TopN(frame=f, n=2, field="cat", filters=["x"],'
                  ' ids=[0,1,2])')
         assert all(p.id == 0 for p in res[0])
+
+
+class TestDevicePathFuzz:
+    """Randomized parity: device mesh Count/TopN vs the host roaring
+    path over random expression trees and bit distributions (the
+    reference's quick-check style, applied to the TPU fast paths)."""
+
+    def test_random_expressions_agree(self, holder):
+        import numpy as np
+        rng = np.random.default_rng(1234)
+        slices = 4
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("f")
+        n_rows = 5
+        for row in range(n_rows):
+            # mixed densities: some rows dense in one slice, sparse rest
+            dense_slice = int(rng.integers(slices))
+            cols = rng.choice(SLICE_WIDTH // 64, size=300, replace=False)
+            for col in cols:
+                f.set_bit("standard", row,
+                          int(dense_slice * SLICE_WIDTH + col))
+            cols = rng.choice(slices * SLICE_WIDTH, size=60, replace=False)
+            for col in cols:
+                f.set_bit("standard", row, int(col))
+
+        def rand_expr(depth):
+            if depth == 0 or rng.random() < 0.4:
+                return f'Bitmap(rowID={int(rng.integers(n_rows + 1))},' \
+                       ' frame=f)'
+            op = rng.choice(["Intersect", "Union", "Difference"])
+            k = int(rng.integers(2, 4))
+            return f"{op}({', '.join(rand_expr(depth - 1) for _ in range(k))})"
+
+        fast = Executor(holder, host="local", use_mesh=True,
+                        mesh_min_slices=1)
+        slow = Executor(holder, host="local", use_mesh=False)
+        for _ in range(25):
+            q = f"Count({rand_expr(2)})"
+            assert fast.execute("i", q) == slow.execute("i", q), q
+        for _ in range(10):
+            ids = sorted(set(int(x) for x in rng.integers(n_rows + 1,
+                                                          size=3)))
+            q = (f"TopN({rand_expr(1)}, frame=f, n=4,"
+                 f" ids={list(ids)})")
+            assert fast.execute("i", q) == slow.execute("i", q), q
