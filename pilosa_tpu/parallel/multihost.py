@@ -22,6 +22,17 @@ Design (scaling-book recipe):
 The coordinator/membership control plane stays host-side HTTP/gossip —
 metadata is not bandwidth-bound (SURVEY.md §5).
 
+Deployment contract: a pod is ONE logical cluster node (only the pod
+coordinator appears in ``cluster.hosts``; a cluster of pods lists one
+coordinator per pod). Every process of the pod must enter each
+collective together with identically-shaped shards — so this layer is
+driven by a pod-internal query broadcast (the launcher or a worker
+loop replays each query to all processes), NOT by the executor's
+per-node map-reduce, which would double-count the pod-global psum if
+pod hosts were also cluster nodes. The executor integrates via that
+broadcast in a later round; until then pods serve through this library
+API directly.
+
 Environment contract (set by the pod launcher):
   PILOSA_TPU_DIST_COORDINATOR  host:port of process 0
   PILOSA_TPU_DIST_NUM_PROCS    total process count
@@ -104,8 +115,7 @@ def _pad_local(local: np.ndarray, axis: int) -> np.ndarray:
     rem = local.shape[axis] % per_dev
     if rem == 0 and local.shape[axis] > 0:
         return local
-    pad_n = (per_dev - rem) % per_dev or (per_dev if local.shape[axis] == 0
-                                          else 0)
+    pad_n = per_dev - rem if local.shape[axis] else per_dev
     pad = [(0, 0)] * local.ndim
     pad[axis] = (0, pad_n)
     return np.pad(local, pad)

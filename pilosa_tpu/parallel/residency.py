@@ -22,7 +22,8 @@ import numpy as np
 from .. import SLICE_WIDTH
 from ..ops import packed
 
-# Default packed-row budget per fragment (256 rows × 128 KB = 32 MB\n# host-side; the device holds only the TopN block).
+# Default packed-row budget per fragment (256 rows × 128 KB = 32 MB
+# host-side; the device holds only the TopN block).
 DEFAULT_MAX_ROWS = 256
 
 

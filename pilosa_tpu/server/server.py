@@ -84,6 +84,12 @@ class Server:
             raise PilosaError(f"invalid host: {self.host!r}"
                               " (expected host:port)")
 
+        # Pod membership (multi-host TPU) joins before any jax use so the
+        # executor's mesh spans every chip in the pod; a no-op unless the
+        # PILOSA_TPU_DIST_* env contract is set (parallel.multihost).
+        from ..parallel import multihost
+        multihost.initialize_from_env()
+
         self.holder.open()
 
         client = _RoutingClient(self)

@@ -89,8 +89,16 @@ print("POD OK", got)
             "PILOSA_TPU_DIST_PROC_ID": "0",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
         })
-        out = subprocess.run([sys.executable, "-c", code % repo],
-                             capture_output=True, text=True, env=env,
-                             timeout=240)
+        out = None
+        for _attempt in range(2):  # retry once on coordinator-port races
+            out = subprocess.run([sys.executable, "-c", code % repo],
+                                 capture_output=True, text=True, env=env,
+                                 timeout=240)
+            if out.returncode == 0:
+                break
+            with socket.socket() as sk:
+                sk.bind(("127.0.0.1", 0))
+                env["PILOSA_TPU_DIST_COORDINATOR"] = \
+                    f"127.0.0.1:{sk.getsockname()[1]}"
         assert out.returncode == 0, out.stderr[-2000:]
         assert "POD OK" in out.stdout
