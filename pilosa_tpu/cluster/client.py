@@ -8,8 +8,10 @@ streaming (client.go:463-674).
 
 from __future__ import annotations
 
+import http.client
 import json
 import random
+import threading
 import urllib.error
 import urllib.request
 from typing import Optional
@@ -55,32 +57,80 @@ def group_by_slice(bits: list[Bit]) -> dict[int, list[Bit]]:
 
 
 class Client:
-    """HTTP client against one host (plus owner discovery for imports)."""
+    """HTTP client against one host (plus owner discovery for imports).
+
+    Connections are pooled per host with keep-alive: write-heavy flows
+    (imports, `bench -op set-bit`, anti-entropy block sync) issue many
+    small requests, and a fresh TCP connect per request dominates their
+    latency. The pool is thread-safe (executor fan-out shares one
+    Client across worker threads); a request that fails on a pooled
+    connection retries once on a fresh one, since the server may have
+    closed an idle socket.
+    """
 
     def __init__(self, host: str, timeout: float = 30.0):
         if not host:
             raise ClientError("host required")
         self.host = host
         self.timeout = timeout
+        self._pool: dict[str, list[http.client.HTTPConnection]] = {}
+        self._pool_mu = threading.Lock()
 
     # -- low-level -----------------------------------------------------------
+
+    _POOL_PER_HOST = 8
+
+    def _conn_get(self, host: str) -> Optional[http.client.HTTPConnection]:
+        with self._pool_mu:
+            conns = self._pool.get(host)
+            return conns.pop() if conns else None
+
+    def _conn_put(self, host: str, conn: http.client.HTTPConnection) -> None:
+        with self._pool_mu:
+            conns = self._pool.setdefault(host, [])
+            if len(conns) < self._POOL_PER_HOST:
+                conns.append(conn)
+                return
+        conn.close()
+
+    def close(self) -> None:
+        with self._pool_mu:
+            for conns in self._pool.values():
+                for c in conns:
+                    c.close()
+            self._pool.clear()
 
     def _do(self, method: str, path: str, body: Optional[bytes] = None,
             headers: Optional[dict] = None, host: Optional[str] = None
             ) -> tuple[int, bytes]:
-        url = f"http://{host or self.host}{path}"
-        req = urllib.request.Request(url, data=body, method=method,
-                                     headers=headers or {})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-                return resp.status, resp.read()
-        except urllib.error.HTTPError as e:
-            return e.code, e.read()
-        except (urllib.error.URLError, OSError) as e:
-            # Unreachable host → ClientError so failover loops can catch
-            # and try the next owner.
-            raise ClientError(f"{method} http://{host or self.host}"
-                              f"{path}: {e}")
+        target = host or self.host
+        last_err = None
+        for attempt in range(2):
+            conn = None if attempt else self._conn_get(target)
+            fresh = conn is None
+            if conn is None:
+                try:
+                    conn = http.client.HTTPConnection(
+                        target, timeout=self.timeout)
+                except Exception as e:  # bad host string
+                    raise ClientError(f"{method} http://{target}{path}: {e}")
+            try:
+                conn.request(method, path, body=body, headers=headers or {})
+                resp = conn.getresponse()
+                data = resp.read()
+                if resp.will_close:
+                    conn.close()
+                else:
+                    self._conn_put(target, conn)
+                return resp.status, data
+            except (http.client.HTTPException, OSError) as e:
+                conn.close()
+                last_err = e
+                if fresh:  # a fresh connection failing is a real error
+                    break
+        # Unreachable host → ClientError so failover loops can catch
+        # and try the next owner.
+        raise ClientError(f"{method} http://{target}{path}: {last_err}")
 
     def _ok(self, status: int, body: bytes, what: str) -> bytes:
         if status != 200:
