@@ -100,10 +100,18 @@ class Client:
                     c.close()
             self._pool.clear()
 
+    _IDEMPOTENT = frozenset({"GET", "HEAD", "PUT", "DELETE"})
+
     def _do(self, method: str, path: str, body: Optional[bytes] = None,
-            headers: Optional[dict] = None, host: Optional[str] = None
-            ) -> tuple[int, bytes]:
+            headers: Optional[dict] = None, host: Optional[str] = None,
+            idempotent: Optional[bool] = None) -> tuple[int, bytes]:
+        """``idempotent`` overrides the per-method default for POST
+        endpoints that are safe to replay (queries, attr diffs, create-
+        if-not-exists) — those keep the transparent stale-keep-alive
+        retry; everything else (e.g. /import op-log appends) does not."""
         target = host or self.host
+        if idempotent is None:
+            idempotent = method in self._IDEMPOTENT
         last_err = None
         for attempt in range(2):
             conn = None if attempt else self._conn_get(target)
@@ -114,8 +122,10 @@ class Client:
                         target, timeout=self.timeout)
                 except Exception as e:  # bad host string
                     raise ClientError(f"{method} http://{target}{path}: {e}")
+            sent = False
             try:
                 conn.request(method, path, body=body, headers=headers or {})
+                sent = True
                 resp = conn.getresponse()
                 data = resp.read()
                 if resp.will_close:
@@ -127,6 +137,14 @@ class Client:
                 conn.close()
                 last_err = e
                 if fresh:  # a fresh connection failing is a real error
+                    break
+                if sent and not idempotent:
+                    # The request reached the wire on a pooled socket and
+                    # only the response failed — the server may already
+                    # have processed it. Re-POSTing would re-execute a
+                    # non-idempotent write (e.g. /import op-log appends),
+                    # so surface the error instead (urllib3 safe-retry
+                    # policy).
                     break
         # Unreachable host → ClientError so failover loops can catch
         # and try the next owner.
@@ -152,7 +170,8 @@ class Client:
         status, raw = self._do(
             "POST", f"/index/{index}/query", body,
             {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF},
-            host=_host_of(node) if node is not None else None)
+            host=_host_of(node) if node is not None else None,
+            idempotent=True)  # PQL writes set absolute state — replayable
         self._ok(status, raw, "execute query")
         resp = pb.QueryResponse.FromString(raw)
         if resp.Err:
@@ -180,7 +199,8 @@ class Client:
     def create_index(self, index: str, options: Optional[dict] = None
                      ) -> None:
         body = json.dumps({"options": options or {}}).encode()
-        status, raw = self._do("POST", f"/index/{index}", body)
+        status, raw = self._do("POST", f"/index/{index}", body,
+                               idempotent=True)
         if status not in (200, 409):
             self._ok(status, raw, "create index")
 
@@ -188,7 +208,7 @@ class Client:
                      options: Optional[dict] = None) -> None:
         body = json.dumps({"options": options or {}}).encode()
         status, raw = self._do("POST", f"/index/{index}/frame/{frame}",
-                               body)
+                               body, idempotent=True)
         if status not in (200, 409):
             self._ok(status, raw, "create frame")
 
@@ -300,7 +320,8 @@ class Client:
     def _attr_diff(self, path: str, blocks, host) -> dict[int, dict]:
         from ..server import codec
         body = json.dumps({"blocks": codec.blocks_to_json(blocks)}).encode()
-        status, raw = self._do("POST", path, body, host=host)
+        status, raw = self._do("POST", path, body, host=host,
+                               idempotent=True)  # pure read
         if status == 404:
             raise FragmentNotFoundError()
         attrs = json.loads(self._ok(status, raw, "attr diff"))["attrs"]

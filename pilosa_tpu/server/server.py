@@ -70,6 +70,19 @@ class Server:
         self._httpd = None
         self._threads: list[threading.Thread] = []
         self._closing = threading.Event()
+        # One pooled Client per target host, shared by the executor
+        # fan-out and the max-slice poll loop so keep-alive connections
+        # actually get reused (client.py pools per Client instance).
+        self._clients: dict[str, Client] = {}
+        self._clients_mu = threading.Lock()
+
+    def client_for(self, host: str) -> Client:
+        """The shared keep-alive Client for a peer host."""
+        with self._clients_mu:
+            client = self._clients.get(host)
+            if client is None:
+                client = self._clients[host] = Client(host)
+            return client
 
     # -- lifecycle (server.go:89-180) ----------------------------------------
 
@@ -141,6 +154,10 @@ class Server:
             self._httpd.server_close()
         if self.cluster.node_set is not None:
             self.cluster.node_set.close()
+        with self._clients_mu:
+            for client in self._clients.values():
+                client.close()
+            self._clients.clear()
         self.holder.close()
 
     def _spawn(self, fn, name: str) -> None:
@@ -182,7 +199,7 @@ class Server:
         for node in self.cluster.nodes:
             if node.host == self.host:
                 continue
-            client = Client(node.host)
+            client = self.client_for(node.host)
             for name, value in client.max_slices().items():
                 idx = self.holder.index(name)
                 if idx is not None:
@@ -263,5 +280,5 @@ class _RoutingClient:
         self.server = server
 
     def execute_query(self, node, index, query, slices, remote):
-        return Client(node.host).execute_query(node, index, query, slices,
-                                               remote=remote)
+        return self.server.client_for(node.host).execute_query(
+            node, index, query, slices, remote=remote)
