@@ -26,6 +26,7 @@ smoke runs; PILOSA_BENCH_DEVICE=0 skips device measurements.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import sys
@@ -154,6 +155,36 @@ def config3_topn_latency() -> None:
              rows=n_rows, slices=n_slices)
 
 
+def _kernel_ab_modes() -> list[tuple[str, str]]:
+    """(label, PILOSA_TPU_PALLAS value) pairs to A/B on this backend.
+
+    On TPU both serving-path kernel variants are measured — the Pallas
+    fused kernels vs XLA fusion — so the winner is chosen from data,
+    per the round-2 mandate. Off-TPU only XLA runs (interpret-mode
+    Pallas is a correctness tool, not a performance candidate).
+    """
+    import jax
+    if jax.devices()[0].platform == "tpu":
+        return [("xla", "0"), ("pallas", "auto")]
+    return [("xla", "0")]
+
+
+@contextlib.contextmanager
+def _pallas_mode_env(mode: str):
+    """Force PILOSA_TPU_PALLAS for one measurement, restoring the
+    caller's value even when the measured leg throws (main() continues
+    fail-soft past per-config errors)."""
+    prior = os.environ.get("PILOSA_TPU_PALLAS")
+    os.environ["PILOSA_TPU_PALLAS"] = mode
+    try:
+        yield
+    finally:
+        if prior is None:
+            os.environ.pop("PILOSA_TPU_PALLAS", None)
+        else:
+            os.environ["PILOSA_TPU_PALLAS"] = prior
+
+
 def config4_mesh_count_over_slices() -> None:
     from pilosa_tpu.parallel import mesh as mesh_mod
     import jax
@@ -173,16 +204,18 @@ def config4_mesh_count_over_slices() -> None:
     if USE_DEVICE:
         mesh = mesh_mod.make_mesh()
         expr = ("and", ("leaf", 0), ("leaf", 1))
-        mesh_mod.count_expr(mesh, expr, leaves)  # compile
-        lat = []
-        for _ in range(5):
-            t0 = time.perf_counter()
-            mesh_mod.count_expr(mesh, expr, leaves)
-            lat.append(time.perf_counter() - t0)
-        dev_s = sorted(lat)[2]
-        emit("c4_count_intersect_mesh", 1.0 / dev_s, "ops/sec",
-             slices=n_slices, devices=len(jax.devices()),
-             vs_host=round(host_s / dev_s, 3))
+        for label, mode in _kernel_ab_modes():
+            with _pallas_mode_env(mode):
+                mesh_mod.count_expr(mesh, expr, leaves)  # compile
+                lat = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    mesh_mod.count_expr(mesh, expr, leaves)
+                    lat.append(time.perf_counter() - t0)
+            dev_s = sorted(lat)[2]
+            emit(f"c4_count_intersect_mesh_{label}", 1.0 / dev_s,
+                 "ops/sec", slices=n_slices, devices=len(jax.devices()),
+                 vs_host=round(host_s / dev_s, 3))
 
 
 def config5_cluster_topn() -> None:
@@ -200,15 +233,17 @@ def config5_cluster_topn() -> None:
 
     if USE_DEVICE:
         mesh = mesh_mod.make_mesh()
-        mesh_mod.topn_exact(mesh, ("leaf", 0), rows, src)  # compile
-        lat = []
-        for _ in range(5):
-            t0 = time.perf_counter()
-            counts = mesh_mod.topn_exact(mesh, ("leaf", 0), rows, src)
-            lat.append(time.perf_counter() - t0)
-        emit("c5_cluster_topn_mesh_p50", sorted(lat)[2] * 1e3, "ms",
-             slices=n_slices, rows=n_rows,
-             devices=len(jax.devices()))
+        for label, mode in _kernel_ab_modes():
+            with _pallas_mode_env(mode):
+                mesh_mod.topn_exact(mesh, ("leaf", 0), rows, src)  # compile
+                lat = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    mesh_mod.topn_exact(mesh, ("leaf", 0), rows, src)
+                    lat.append(time.perf_counter() - t0)
+            emit(f"c5_cluster_topn_mesh_p50_{label}",
+                 sorted(lat)[2] * 1e3, "ms", slices=n_slices,
+                 rows=n_rows, devices=len(jax.devices()))
 
 
 def main() -> None:

@@ -112,6 +112,24 @@ class Executor:
         # TPU tunnel) that only pays for itself on wide fan-outs.
         self.mesh_min_slices = mesh_min_slices
         self._mesh = None  # lazy: built on first device-batched call
+        # Device-fallback observability (a real kernel bug would
+        # otherwise silently demote every query to the host path):
+        # counted per executor, surfaced via stats + one-shot warning.
+        self.device_fallbacks = 0
+        self._fallback_warned = False
+
+    def _note_device_fallback(self, where: str, exc: Exception) -> None:
+        self.device_fallbacks += 1
+        stats = getattr(self.holder, "stats", None)
+        if stats is not None:
+            stats.count("deviceFallback", 1)
+        if not self._fallback_warned:
+            self._fallback_warned = True
+            import logging
+            logging.getLogger("pilosa_tpu.executor").warning(
+                "device mesh path failed in %s (%s: %s); falling back to "
+                "the host per-slice path — further fallbacks are counted "
+                "but not logged", where, type(exc).__name__, exc)
 
     def _mesh_or_none(self):
         if not self.use_mesh:
@@ -407,7 +425,8 @@ class Executor:
             block = self._pack_leaf_block(index, leaves, slices)
             try:
                 return mesh_mod.count_expr(mesh, expr, block)
-            except Exception:  # noqa: BLE001 - device trouble ≠ node down
+            except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
+                self._note_device_fallback("count_expr", e)
                 return NotImplemented
 
         return local_fn
@@ -519,7 +538,8 @@ class Executor:
             leaf_block = self._pack_leaf_block(index, leaves, slices)
             try:
                 counts = mesh_mod.topn_exact(mesh, expr, rows, leaf_block)
-            except Exception:  # noqa: BLE001 - device trouble ≠ node down
+            except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
+                self._note_device_fallback("topn_exact", e)
                 return NotImplemented
             return [Pair(rid, cnt)
                     for rid, cnt in zip(row_ids, counts) if cnt > 0]

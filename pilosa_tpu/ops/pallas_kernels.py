@@ -31,7 +31,24 @@ def should_use_pallas(a: jax.Array) -> bool:
             else jax.default_backend()
     except Exception:
         platform = jax.default_backend()
-    return platform == "tpu"
+    return pallas_mode(platform) is not None
+
+
+def pallas_mode(platform: str) -> str | None:
+    """How the serving path should run Pallas kernels on ``platform``.
+
+    Returns "compiled" (real TPU), "interpret" (forced via
+    PILOSA_TPU_PALLAS=interpret — CPU tests exercising the kernel
+    path), or None (XLA fusion path). PILOSA_TPU_PALLAS=0 disables
+    Pallas everywhere — the A/B switch for benchmarks/suite.py.
+    """
+    import os
+    v = os.environ.get("PILOSA_TPU_PALLAS", "auto")
+    if v == "0":
+        return None
+    if v == "interpret":
+        return "interpret"
+    return "compiled" if platform == "tpu" else None
 
 
 def _count_kernel(op_name, a_ref, b_ref, out_ref):
@@ -64,6 +81,117 @@ def _op_count_padded(op: str, a: jax.Array, b: jax.Array,
         interpret=interpret,
     )(a, b)
     return jnp.sum(partials, axis=-1)
+
+
+def _eval_expr_ref(expr, leaves_ref):
+    """Evaluate a hashable expr tree over a Pallas leaves ref: ``("leaf",
+    i)`` loads leaf block i, ``(op, a, b)`` combines in VMEM — the whole
+    PQL bitmap expression runs per tile with no HBM intermediates."""
+    if expr[0] == "leaf":
+        return leaves_ref[expr[1]]
+    return _BITWISE[expr[0]](_eval_expr_ref(expr[1], leaves_ref),
+                             _eval_expr_ref(expr[2], leaves_ref))
+
+
+def _expr_count_kernel(expr, leaves_ref, out_ref):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    words = _eval_expr_ref(expr, leaves_ref)
+    pc = jax.lax.population_count(words).astype(jnp.int32)
+    tr, tw = pc.shape
+    out_ref[:] += pc.reshape(tr, tw // _LANES, _LANES).sum(axis=1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def expr_count_rows_pallas(expr, leaves: jax.Array,
+                           interpret: bool = False) -> jax.Array:
+    """Per-slice-row counts of a bitmap expression, one fused kernel.
+
+    ``leaves`` is ``[n_leaves, S, W]`` u32; returns ``[S]`` int32 of
+    ``sum(popcount(expr(leaves[:, s])))``. The expression tree, the
+    popcount, and the word reduction all run tile-resident in VMEM —
+    the serving-path generalization of the 2-operand count kernel
+    (replacing roaring.go:1192-1268's per-container-pair loops for an
+    arbitrary expression). Pads rows/words to tile multiples (zero
+    words count zero).
+    """
+    n_leaves, rows, words = leaves.shape
+    tile_w = min(_TILE_W, -(-words // _LANES) * _LANES)
+    pr = (-rows) % _TILE_R
+    pw = (-words) % tile_w
+    if pr or pw:
+        leaves = jnp.pad(leaves, ((0, 0), (0, pr), (0, pw)))
+    grid = (leaves.shape[1] // _TILE_R, leaves.shape[2] // tile_w)
+    partials = pl.pallas_call(
+        functools.partial(_expr_count_kernel, expr),
+        out_shape=jax.ShapeDtypeStruct((leaves.shape[1], _LANES),
+                                       jnp.int32),
+        grid=grid,
+        in_specs=[pl.BlockSpec((n_leaves, _TILE_R, tile_w),
+                               lambda i, j: (0, i, j))],
+        out_specs=pl.BlockSpec((_TILE_R, _LANES), lambda i, j: (i, 0)),
+        interpret=interpret,
+    )(leaves)
+    return jnp.sum(partials, axis=-1)[:rows]
+
+
+def _topn_block_kernel(expr, rows_ref, leaves_ref, out_ref):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    words = rows_ref[0]                      # [TILE_R, tile_w]
+    if expr is not None:
+        src = _eval_expr_ref(expr, leaves_ref)  # [1, tile_w]
+        words = jnp.bitwise_and(words, src)     # broadcast over rows
+    pc = jax.lax.population_count(words).astype(jnp.int32)
+    tr, tw = pc.shape
+    out_ref[0] += pc.reshape(tr, tw // _LANES, _LANES).sum(axis=1)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def topn_block_count_pallas(expr, rows: jax.Array, leaves: jax.Array,
+                            interpret: bool = False) -> jax.Array:
+    """Per-(slice, candidate) counts of ``popcount(row ∩ expr)``.
+
+    ``rows`` is ``[S, R, W]``, ``leaves`` ``[n_leaves, S, W]`` (ignored
+    when ``expr`` is None → plain row popcounts). Returns ``[S, R]``
+    int32. The TopN exact-count hot loop as one fused kernel: candidate
+    tile, source-expression tile, AND, popcount, and reduction all stay
+    in VMEM (the vectorized device replacement for the reference's
+    sequential per-row IntersectionCount, fragment.go:560-614).
+    """
+    n_slices, rows_n, words = rows.shape
+    tile_w = min(_TILE_W, -(-words // _LANES) * _LANES)
+    pr = (-rows_n) % _TILE_R
+    pw = (-words) % tile_w
+    if pr or pw:
+        rows = jnp.pad(rows, ((0, 0), (0, pr), (0, pw)))
+        leaves = jnp.pad(leaves, ((0, 0), (0, 0), (0, pw)))
+    grid = (n_slices, rows.shape[1] // _TILE_R, rows.shape[2] // tile_w)
+    n_leaves = max(leaves.shape[0], 1)
+    if leaves.shape[0] == 0:  # expr None: feed a 1-leaf dummy block
+        leaves = jnp.zeros((1, n_slices, rows.shape[2]), jnp.uint32)
+    partials = pl.pallas_call(
+        functools.partial(_topn_block_kernel, expr),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_slices, rows.shape[1], _LANES), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, _TILE_R, tile_w), lambda s, i, j: (s, i, j)),
+            pl.BlockSpec((n_leaves, 1, tile_w), lambda s, i, j: (0, s, j)),
+        ],
+        out_specs=pl.BlockSpec((1, _TILE_R, _LANES),
+                               lambda s, i, j: (s, i, 0)),
+        interpret=interpret,
+    )(rows, leaves)
+    return jnp.sum(partials, axis=-1)[:, :rows_n]
 
 
 def op_count_rows_pallas(op: str, a: jax.Array, b: jax.Array,

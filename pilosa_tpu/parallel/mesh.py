@@ -34,6 +34,26 @@ AXIS_SLICES = "slices"
 AXIS_ROWS = "rows"
 
 
+def _mesh_pallas_mode(mesh: Mesh) -> str | None:
+    """Pallas dispatch mode for programs compiled onto ``mesh`` —
+    "compiled" on TPU meshes, "interpret" when forced for tests, None
+    for the XLA fusion path (ops.pallas_kernels.pallas_mode)."""
+    from ..ops import pallas_kernels
+    return pallas_kernels.pallas_mode(mesh.devices.flat[0].platform)
+
+
+def _rows_popcount(expr, leaves, mode):
+    """Per-slice-row int32 counts of ``expr`` over ``leaves`` [L, S, W],
+    via the fused Pallas kernel when ``mode`` says so, else XLA."""
+    if mode is not None:
+        from ..ops import pallas_kernels
+        return pallas_kernels.expr_count_rows_pallas(
+            expr, leaves, interpret=(mode == "interpret"))
+    words = _eval_expr(expr, leaves)
+    pc = jax.lax.population_count(words).astype(jnp.int32)
+    return jnp.sum(pc, axis=-1)
+
+
 def make_mesh(n_devices: int | None = None, rows: int = 1) -> Mesh:
     """A (rows × slices) device mesh. ``rows=1`` gives the common 1-D
     slice mesh; TopN row-sharding uses rows>1."""
@@ -104,8 +124,24 @@ def count_op(mesh: Mesh, op: str, a: jax.Array, b: jax.Array) -> int:
 
 
 @functools.lru_cache(maxsize=256)  # keyed on query-shaped exprs: bound it
-def _count_expr_fn(mesh: Mesh, expr: tuple):
-    """[L, S, W] leaf blocks → scalar count of the expression bitmap.
+def _count_expr_fn_cached(mesh: Mesh, expr: tuple, mode: str | None):
+    def per_shard(leaves):  # leaves: [L, S/n, W]
+        row = _rows_popcount(expr, leaves, mode).ravel()
+        hi = jax.lax.psum(jnp.sum(row >> 16), AXIS_SLICES)
+        lo = jax.lax.psum(jnp.sum(row & 0xFFFF), AXIS_SLICES)
+        return hi, lo
+
+    # check_vma off when Pallas is in the shard body: pallas_call's
+    # out_shape carries no varying-axis info, which trips the inference.
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(None, AXIS_SLICES),), out_specs=(P(), P()),
+        check_vma=(mode is None)))
+
+
+def count_expr_fn(mesh: Mesh, expr: tuple):
+    """[L, S, W] leaf blocks → (hi, lo) 16-bit halves of the expression
+    bitmap's count (recombine as ``(hi << 16) + lo``).
 
     ``expr`` is a hashable tree: ``("leaf", i)`` selects leaf block i,
     ``(op, a, b)`` combines subtrees with a bitwise op from kernels._BITWISE.
@@ -113,20 +149,12 @@ def _count_expr_fn(mesh: Mesh, expr: tuple):
     expression (e.g. Count(Intersect(Bitmap, Bitmap))) is evaluated
     elementwise over every slice at once and reduced with a single psum,
     replacing the reference's per-slice goroutine map + sum reduce
-    (executor.go:568-597,1103-1236).
+    (executor.go:568-597,1103-1236). On TPU the per-shard body is the
+    fused Pallas expression-count kernel (ops.pallas_kernels); elsewhere
+    XLA fusion. Public: the pod layer (parallel.multihost) feeds these
+    programs process-local shards.
     """
-
-    def per_shard(leaves):  # leaves: [L, S/n, W]
-        words = _eval_expr(expr, leaves)
-        pc = jax.lax.population_count(words).astype(jnp.int32)
-        row = jnp.sum(pc, axis=-1).ravel()
-        hi = jax.lax.psum(jnp.sum(row >> 16), AXIS_SLICES)
-        lo = jax.lax.psum(jnp.sum(row & 0xFFFF), AXIS_SLICES)
-        return hi, lo
-
-    return jax.jit(jax.shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(P(None, AXIS_SLICES),), out_specs=(P(), P())))
+    return _count_expr_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
 
 
 def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
@@ -137,7 +165,7 @@ def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
     count works.
     """
     n_dev = mesh.shape[AXIS_SLICES]
-    fn = _count_expr_fn(mesh, expr)
+    fn = count_expr_fn(mesh, expr)
     total = 0
     for off in range(0, leaves.shape[1], 1 << 15):
         chunk = leaves[:, off:off + (1 << 15)]
@@ -165,24 +193,19 @@ def _eval_expr(expr, leaves):
 
 
 @functools.lru_cache(maxsize=256)
-def _topn_exact_fn(mesh: Mesh, expr):
-    """Exact candidate counts across slices, one psum-reduced program.
-
-    rows [S, R, W] (candidate row blocks per slice) → [R] counts of
-    ``popcount(row ∩ expr)`` (or plain row popcount when expr is None),
-    summed over every slice — the device form of the executor's TopN
-    exact-count re-query (executor.go:273-310 second phase). Per-(slice,
-    row) counts ≤ 2^20 are split 16/16 before the psum so int32 holds up
-    to 2^15 slices per call (callers chunk above that).
-    """
-
+def _topn_exact_fn_cached(mesh: Mesh, expr, mode: str | None):
     def per_shard(rows, leaves):  # rows: [S/n, R, W]; leaves: [L, S/n, W]
-        words = rows
-        if expr is not None:
-            src = _eval_expr(expr, leaves)        # [S/n, W]
-            words = jnp.bitwise_and(rows, src[:, None, :])
-        pc = jax.lax.population_count(words).astype(jnp.int32)
-        per_slice = jnp.sum(pc, axis=-1)          # [S/n, R], each ≤ 2^20
+        if mode is not None:
+            from ..ops import pallas_kernels
+            per_slice = pallas_kernels.topn_block_count_pallas(
+                expr, rows, leaves, interpret=(mode == "interpret"))
+        else:
+            words = rows
+            if expr is not None:
+                src = _eval_expr(expr, leaves)        # [S/n, W]
+                words = jnp.bitwise_and(rows, src[:, None, :])
+            pc = jax.lax.population_count(words).astype(jnp.int32)
+            per_slice = jnp.sum(pc, axis=-1)      # [S/n, R], each ≤ 2^20
         hi = jax.lax.psum(jnp.sum(per_slice >> 16, axis=0), AXIS_SLICES)
         lo = jax.lax.psum(jnp.sum(per_slice & 0xFFFF, axis=0), AXIS_SLICES)
         return hi, lo
@@ -190,12 +213,28 @@ def _topn_exact_fn(mesh: Mesh, expr):
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES), P(None, AXIS_SLICES)),
-        out_specs=(P(), P())))
+        out_specs=(P(), P()), check_vma=(mode is None)))
+
+
+def topn_exact_fn(mesh: Mesh, expr):
+    """Exact candidate counts across slices, one psum-reduced program.
+
+    rows [S, R, W] (candidate row blocks per slice) → per-row (hi, lo)
+    16-bit halves of ``popcount(row ∩ expr)`` (or plain row popcount
+    when expr is None), summed over every slice — the device form of
+    the executor's TopN exact-count re-query (executor.go:273-310
+    second phase). Per-(slice, row) counts ≤ 2^20 are split 16/16
+    before the psum so int32 holds up to 2^15 slices per call (callers
+    chunk above that). On TPU the per-shard body is the fused Pallas
+    TopN block kernel. Public: the pod layer (parallel.multihost)
+    feeds these programs process-local shards.
+    """
+    return _topn_exact_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
 
 
 # Device-block budget for one topn_exact call (mirrors the 256 MB
 # per-block bound of the per-fragment path, fragment.py chunk=2048).
-_TOPN_BLOCK_BYTES = 256 << 20
+TOPN_BLOCK_BYTES = 256 << 20
 
 
 def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
@@ -208,10 +247,10 @@ def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
     independent per row and additive per slice, so any tiling is exact.
     """
     n_dev = mesh.shape[AXIS_SLICES]
-    fn = _topn_exact_fn(mesh, expr)
+    fn = topn_exact_fn(mesh, expr)
     n_slices, n_rows, n_words = rows.shape
     slice_chunk = min(1 << 15, n_slices) or 1
-    row_chunk = max(1, _TOPN_BLOCK_BYTES // (slice_chunk * n_words * 4))
+    row_chunk = max(1, TOPN_BLOCK_BYTES // (slice_chunk * n_words * 4))
     totals = [0] * n_rows
     for s_off in range(0, n_slices, slice_chunk):
         lc = None

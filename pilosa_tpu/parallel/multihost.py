@@ -16,7 +16,7 @@ Design (scaling-book recipe):
   (``jax.make_array_from_process_local_data``) — slice placement is
   aligned so the slices a host serves are the slices its chips hold;
 - the jitted programs are the SAME ones the single-host executor uses
-  (parallel.mesh._count_expr_fn / _topn_exact_fn): under SPMD every
+  (parallel.mesh.count_expr_fn / topn_exact_fn): under SPMD every
   process runs the identical program and the psum spans the pod.
 
 The coordinator/membership control plane stays host-side HTTP/gossip —
@@ -142,7 +142,7 @@ def count_expr(mesh: Mesh, expr: tuple, local_leaves: np.ndarray) -> int:
     for off in range(0, max(local_leaves.shape[1], 1), step):
         chunk = _pad_local(local_leaves[:, off:off + step], 1)
         arr = _global_from_local(mesh, chunk, 1)
-        hi, lo = mesh_mod._count_expr_fn(mesh, expr)(arr)
+        hi, lo = mesh_mod.count_expr_fn(mesh, expr)(arr)
         total += (int(hi) << 16) + int(lo)
     return total
 
@@ -158,7 +158,7 @@ def topn_exact(mesh: Mesh, expr, local_rows: np.ndarray,
     if local_leaves is None:
         local_leaves = np.zeros((0, n_local, 1), dtype=np.uint32)
     s_step = _local_chunk()
-    r_step = max(1, mesh_mod._TOPN_BLOCK_BYTES
+    r_step = max(1, mesh_mod.TOPN_BLOCK_BYTES
                  // (max(s_step, 1) * n_words * 4))
     totals = [0] * n_rows
     for s_off in range(0, max(n_local, 1), s_step):
@@ -168,7 +168,7 @@ def topn_exact(mesh: Mesh, expr, local_rows: np.ndarray,
             lc = _pad_local(local_leaves[:, s_off:s_off + s_step], 1)
             rows = _global_from_local(mesh, rc, 0)
             leaves = _global_from_local(mesh, lc, 1)
-            hi, lo = mesh_mod._topn_exact_fn(mesh, expr)(rows, leaves)
+            hi, lo = mesh_mod.topn_exact_fn(mesh, expr)(rows, leaves)
             hi, lo = np.asarray(hi), np.asarray(lo)
             for r in range(rc.shape[1]):
                 totals[r_off + r] += (int(hi[r]) << 16) + int(lo[r])

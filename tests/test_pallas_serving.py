@@ -1,0 +1,108 @@
+"""Parity tests for the Pallas serving-path kernels (interpret mode off
+TPU) and the mesh dispatch that selects them.
+
+The serving path (mesh.count_expr_fn / topn_exact_fn) runs these fused
+kernels on TPU; forcing PILOSA_TPU_PALLAS=interpret exercises the same
+dispatch + kernels on the CPU test mesh, proving the Pallas path answers
+queries identically to the XLA fusion path (the reference bar:
+roaring/assembly_test.go asm-vs-Go parity).
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import pallas_kernels as pk
+from pilosa_tpu.parallel import mesh as mesh_mod
+
+EXPR = ("or", ("and", ("leaf", 0), ("leaf", 1)),
+        ("andnot", ("leaf", 2), ("leaf", 0)))
+
+
+def _eval(expr, leaves):
+    if expr[0] == "leaf":
+        return leaves[expr[1]]
+    f = {"and": np.bitwise_and, "or": np.bitwise_or,
+         "xor": np.bitwise_xor,
+         "andnot": lambda a, b: a & ~b}[expr[0]]
+    return f(_eval(expr[1], leaves), _eval(expr[2], leaves))
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(7)
+    L, S, R, W = 3, 16, 9, 384
+    leaves = rng.integers(0, 2**32, size=(L, S, W), dtype=np.uint32)
+    rows = rng.integers(0, 2**32, size=(S, R, W), dtype=np.uint32)
+    return leaves, rows
+
+
+class TestExprCountPallas:
+    def test_parity(self, data):
+        leaves, _ = data
+        want = np.bitwise_count(_eval(EXPR, leaves)).sum(axis=-1)
+        got = np.asarray(pk.expr_count_rows_pallas(EXPR, leaves,
+                                                   interpret=True))
+        assert got.tolist() == want.tolist()
+
+    def test_single_leaf(self, data):
+        leaves, _ = data
+        got = np.asarray(pk.expr_count_rows_pallas(("leaf", 2), leaves,
+                                                   interpret=True))
+        want = np.bitwise_count(leaves[2]).sum(axis=-1)
+        assert got.tolist() == want.tolist()
+
+    def test_unaligned_shapes(self):
+        # Rows and words that don't divide the tile sizes must pad
+        # losslessly.
+        rng = np.random.default_rng(8)
+        leaves = rng.integers(0, 2**32, size=(2, 5, 130), dtype=np.uint32)
+        expr = ("xor", ("leaf", 0), ("leaf", 1))
+        got = np.asarray(pk.expr_count_rows_pallas(expr, leaves,
+                                                   interpret=True))
+        want = np.bitwise_count(leaves[0] ^ leaves[1]).sum(axis=-1)
+        assert got.tolist() == want.tolist()
+
+
+class TestTopNBlockPallas:
+    def test_with_expr(self, data):
+        leaves, rows = data
+        src = _eval(EXPR, leaves)
+        want = np.bitwise_count(rows & src[:, None, :]).sum(axis=-1)
+        got = np.asarray(pk.topn_block_count_pallas(EXPR, rows, leaves,
+                                                    interpret=True))
+        assert got.tolist() == want.tolist()
+
+    def test_plain_popcount(self, data):
+        _, rows = data
+        S = rows.shape[0]
+        got = np.asarray(pk.topn_block_count_pallas(
+            None, rows, np.zeros((0, S, 1), np.uint32), interpret=True))
+        want = np.bitwise_count(rows).sum(axis=-1)
+        assert got.tolist() == want.tolist()
+
+
+class TestMeshPallasDispatch:
+    def test_count_expr_via_pallas(self, data, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "interpret")
+        leaves, _ = data
+        m = mesh_mod.make_mesh(8)
+        want = int(np.bitwise_count(_eval(EXPR, leaves)).sum())
+        assert mesh_mod.count_expr(m, EXPR, leaves) == want
+
+    def test_topn_exact_via_pallas(self, data, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "interpret")
+        leaves, rows = data
+        m = mesh_mod.make_mesh(8)
+        src = _eval(EXPR, leaves)
+        want = np.bitwise_count(rows & src[:, None, :]) \
+            .sum(axis=(0, 2)).tolist()
+        assert mesh_mod.topn_exact(m, EXPR, rows, leaves) == want
+
+    def test_mode_selection(self, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "0")
+        assert pk.pallas_mode("tpu") is None
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "interpret")
+        assert pk.pallas_mode("cpu") == "interpret"
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "auto")
+        assert pk.pallas_mode("tpu") == "compiled"
+        assert pk.pallas_mode("cpu") is None
