@@ -162,13 +162,17 @@ class Client:
     def execute_query(self, node, index: str, query: str,
                       slices: Optional[list[int]] = None,
                       remote: bool = True,
-                      column_attrs: bool = False) -> list:
+                      column_attrs: bool = False,
+                      pod_local: bool = False) -> list:
         from ..server import codec
         body = codec.encode_query_request(query, slices,
                                           column_attrs=column_attrs,
                                           remote=remote)
+        path = f"/index/{index}/query"
+        if pod_local:  # pod-internal leg (parallel.pod)
+            path += "?podLocal=true"
         status, raw = self._do(
-            "POST", f"/index/{index}/query", body,
+            "POST", path, body,
             {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF},
             host=_host_of(node) if node is not None else None,
             idempotent=True)  # PQL writes set absolute state — replayable

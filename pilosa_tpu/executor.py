@@ -55,8 +55,12 @@ _WRITE_CALLS = ("SetBit", "ClearBit", "SetRowAttrs", "SetColumnAttrs")
 @dataclass
 class ExecOptions:
     """Remote=True marks a query forwarded by another node: process only
-    local slices and don't re-forward (executor.go:1290-1292)."""
+    local slices and don't re-forward (executor.go:1290-1292).
+    pod_local=True marks a pod-internal leg (parallel.pod): run the
+    plain local path over the given slices — no pod dispatch, no
+    pod-global collectives."""
     remote: bool = False
+    pod_local: bool = False
 
 
 def _needs_slices(calls: list[Call]) -> bool:
@@ -95,11 +99,16 @@ class Executor:
     def __init__(self, holder, host: str = "",
                  cluster: Optional[Cluster] = None, client=None,
                  max_workers: int = 16, use_mesh: Optional[bool] = None,
-                 mesh_min_slices: Optional[int] = None):
+                 mesh_min_slices: Optional[int] = None, pod=None):
         self.holder = holder
         self.host = host
         self.cluster = cluster or new_cluster([host])
         self.client = client
+        # Multi-host pod membership (parallel.pod.Pod) — None in the
+        # ordinary single-process server. On the pod coordinator the
+        # local leg fans out pod-wide (collectives for device-batched
+        # Count/TopN, podLocal HTTP legs for everything else).
+        self.pod = pod
         self.max_workers = max_workers
         if use_mesh is None:
             use_mesh = os.environ.get("PILOSA_TPU_MESH", "1") != "0"
@@ -346,7 +355,7 @@ class Executor:
             return self._bitmap_call_slice(index, c.children[0],
                                            slice).count()
 
-        local_fn = self._count_local_device_fn(index, c.children[0])
+        local_fn = self._count_local_device_fn(index, c.children[0], opt)
         result = self._map_reduce(index, slices, c, opt, map_fn,
                                   lambda prev, v: (prev or 0) + v,
                                   local_fn=local_fn)
@@ -398,7 +407,8 @@ class Executor:
             expr = (op, expr, p)
         return expr
 
-    def _count_local_device_fn(self, index: str, child: Call):
+    def _count_local_device_fn(self, index: str, child: Call,
+                               opt: ExecOptions):
         """Batched local-leg Count: all slices in ONE mesh program.
 
         Returns a ``local_fn(slices) -> int`` for _map_reduce, or None
@@ -406,7 +416,9 @@ class Executor:
         host-side into [n_leaves, n_slices, words] and the whole
         expression + popcount + sum runs as a single psum-reduced SPMD
         call (parallel.mesh.count_expr) — the mesh form of the per-slice
-        count map (executor.go:568-597).
+        count map (executor.go:568-597). On a pod coordinator the call
+        becomes a pod-wide collective (parallel.pod.Pod.count_expr);
+        pod workers and podLocal legs use the host path.
         """
         if not self.use_mesh:
             return None
@@ -414,6 +426,19 @@ class Executor:
         expr = self._compile_device_expr(index, child, leaves)
         if expr is None:
             return None
+        if self.pod is not None:
+            if not self.pod.is_coordinator or opt.pod_local:
+                return None  # plain local path on pod-internal legs
+
+            def pod_fn(slices: list[int]):
+                if len(slices) < self.mesh_min_slices:
+                    return NotImplemented  # pod host legs win when small
+                try:
+                    return self.pod.count_expr(index, expr, leaves, slices)
+                except Exception as e:  # noqa: BLE001 - pod host fan-out
+                    self._note_device_fallback("pod.count_expr", e)
+                    return NotImplemented  # correct via _pod_host_mapper
+            return pod_fn
 
         def local_fn(slices: list[int]):
             if len(slices) < self.mesh_min_slices:
@@ -471,12 +496,12 @@ class Executor:
         def reduce_fn(prev, v):
             return pairs_add(prev or [], v)
 
-        local_fn = self._topn_local_device_fn(index, c)
+        local_fn = self._topn_local_device_fn(index, c, opt)
         pairs = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
                                  local_fn=local_fn)
         return pairs_sort(pairs or [])
 
-    def _topn_local_device_fn(self, index: str, c: Call):
+    def _topn_local_device_fn(self, index: str, c: Call, opt: ExecOptions):
         """Batched local-leg TopN exact-count phase: ALL candidate rows ×
         ALL slices in one psum-reduced mesh program.
 
@@ -508,6 +533,27 @@ class Executor:
         expr = self._compile_device_expr(index, c.children[0], leaves)
         if expr is None:
             return None
+        if self.pod is not None:
+            if not self.pod.is_coordinator or opt.pod_local:
+                return None  # plain local path on pod-internal legs
+
+            def pod_fn(slices: list[int]):
+                from .ops.packed import WORDS_PER_SLICE
+                # Same host-allocation guard as the single-process path,
+                # per pod process (every process densifies its shard).
+                if (len(slices) < self.mesh_min_slices
+                        or self.pod.max_shard_slices(slices) * len(row_ids)
+                        * WORDS_PER_SLICE * 4 > self._TOPN_HOST_BLOCK_BYTES):
+                    return NotImplemented
+                try:
+                    counts = self.pod.topn_exact(index, frame_name, expr,
+                                                 leaves, row_ids, slices)
+                except Exception as e:  # noqa: BLE001 - pod host fan-out
+                    self._note_device_fallback("pod.topn_exact", e)
+                    return NotImplemented  # correct via _pod_host_mapper
+                return [Pair(rid, cnt)
+                        for rid, cnt in zip(row_ids, counts) if cnt > 0]
+            return pod_fn
 
         def local_fn(slices: list[int]):
             if len(slices) < self.mesh_min_slices:
@@ -637,6 +683,14 @@ class Executor:
         ret = False
         for node in self.cluster.fragment_nodes(index, slice):
             if node.host == self.host:
+                if (self.pod is not None and not opt.pod_local
+                        and self.pod.owner_pid(slice) != self.pod.pid):
+                    # This pod owns the slice, but a different pod
+                    # process holds it — forward the single-view call
+                    # as a podLocal leg (parallel.pod placement).
+                    if self._pod_write_remote(index, c, view, slice):
+                        ret = True
+                    continue
                 op = frame.set_bit if set else frame.clear_bit
                 if op(view, row_id, col_id, timestamp):
                     ret = True
@@ -647,6 +701,41 @@ class Executor:
             if res and res[0]:
                 ret = True
         return ret
+
+    def _pod_write_remote(self, index: str, c: Call, view: str,
+                          slice: int) -> bool:
+        """Forward one view's bit mutation to the owning pod process and
+        remember the slice exists (the coordinator computes query slice
+        lists from its own max-slice knowledge)."""
+        pid = self.pod.owner_pid(slice)
+        other = c.clone()
+        other.args["view"] = view  # pin: owner differs per view axis
+        if self.client is None:
+            raise SliceUnavailableError(
+                f"no client to reach pod process {pid}")
+        res = self.client.execute_query(
+            Node(self.pod.peers[pid]), index, str(Query([other])), None,
+            remote=True, pod_local=True)
+        idx = self.holder.index(index)
+        if idx is not None:
+            if view == VIEW_INVERSE:
+                idx.set_remote_max_inverse_slice(slice)
+            else:
+                idx.set_remote_max_slice(slice)
+        return bool(res and res[0])
+
+    def _pod_forward_attrs(self, index: str, calls: list[Call],
+                           opt: ExecOptions) -> None:
+        """Attribute writes replicate to every pod process (workers read
+        their own attr stores for TopN filters), even on cluster-remote
+        legs — only podLocal legs stop the fan-out."""
+        if (self.pod is None or opt.pod_local
+                or not self.pod.is_coordinator or self.client is None):
+            return
+        q = str(Query(list(calls)))
+        for pid in range(1, self.pod.n_procs):
+            self.client.execute_query(Node(self.pod.peers[pid]), index,
+                                      q, None, remote=True, pod_local=True)
 
     # -- attributes (executor.go:800-988) ------------------------------------
 
@@ -673,6 +762,7 @@ class Executor:
                                opt: ExecOptions) -> None:
         _, frame, row_id, attrs = self._row_attrs_of(index, c)
         frame.row_attr_store.set_attrs(row_id, attrs)
+        self._pod_forward_attrs(index, [c], opt)
         self._broadcast_call(index, [c], opt)
 
     def _execute_bulk_set_row_attrs(self, index: str, calls: list[Call],
@@ -686,6 +776,7 @@ class Executor:
         for frame_name, rows in by_frame.items():
             self.holder.frame(index, frame_name).row_attr_store \
                 .set_bulk_attrs(rows)
+        self._pod_forward_attrs(index, calls, opt)
         self._broadcast_call(index, calls, opt)
         return [None] * len(calls)
 
@@ -704,6 +795,7 @@ class Executor:
         attrs = dict(c.args)
         attrs.pop(col_name, None)
         idx.column_attr_store.set_attrs(id, attrs)
+        self._pod_forward_attrs(index, [c], opt)
         self._broadcast_call(index, [c], opt)
 
     def _broadcast_call(self, index: str, calls: list[Call],
@@ -809,8 +901,45 @@ class Executor:
                 r = local_fn(slices)
                 if r is not NotImplemented:
                     return r
+            if (self.pod is not None and self.pod.is_coordinator
+                    and not opt.pod_local):
+                return self._pod_host_mapper(index, c, slices, opt,
+                                             map_fn, reduce_fn)
             return self._mapper_local(slices, map_fn, reduce_fn)
         results = self._exec_remote(node, index, Query([c]), slices, opt)
+        return results[0] if results else None
+
+    def _pod_host_mapper(self, index: str, c: Call, slices: list[int],
+                         opt: ExecOptions, map_fn, reduce_fn):
+        """Pod-internal host-path fan-out: this pod's "local" slices are
+        spread over its processes, so partition by owner process — owned
+        slices run the plain local path, the rest go to the owning pod
+        process as podLocal HTTP legs (parallel.pod placement)."""
+        by_pid: dict[int, list[int]] = {}
+        for s in slices:
+            by_pid.setdefault(self.pod.owner_pid(s), []).append(s)
+        result = None
+        with ThreadPoolExecutor(max_workers=max(1, len(by_pid))) as pool:
+            futs = []
+            for pid, group in by_pid.items():
+                if pid == self.pod.pid:
+                    futs.append(pool.submit(self._mapper_local, group,
+                                            map_fn, reduce_fn))
+                else:
+                    futs.append(pool.submit(self._exec_pod_remote, pid,
+                                            index, c, group))
+            for fut in futs:
+                result = reduce_fn(result, fut.result())
+        return result
+
+    def _exec_pod_remote(self, pid: int, index: str, c: Call,
+                         slices: list[int]):
+        if self.client is None:
+            raise SliceUnavailableError(
+                f"no client to reach pod process {pid}")
+        results = self.client.execute_query(
+            Node(self.pod.peers[pid]), index, str(Query([c])), slices,
+            remote=True, pod_local=True)
         return results[0] if results else None
 
     def _mapper_local(self, slices: list[int], map_fn, reduce_fn):

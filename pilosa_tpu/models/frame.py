@@ -203,9 +203,16 @@ class Frame:
 
     # -- bulk import (frame.go:530-606) --------------------------------------
 
-    def import_bits(self, row_ids, column_ids, timestamps=None) -> None:
+    def import_bits(self, row_ids, column_ids, timestamps=None,
+                    views: str = None) -> None:
         """Group bits by (view, slice) — including time views and the
-        inverse transpose — then bulk-import each fragment."""
+        inverse transpose — then bulk-import each fragment.
+
+        ``views`` filters the fan-out: None = all, "standard" =
+        standard + time views only, "inverse" = inverse views only.
+        Pod-internal import legs use the filter because standard and
+        inverse views of the same bit live on different pod processes
+        (column-slice vs row-slice placement, parallel.pod)."""
         from .. import SLICE_WIDTH
         rows = np.asarray(row_ids, dtype=np.uint64)
         cols = np.asarray(column_ids, dtype=np.uint64)
@@ -230,15 +237,18 @@ class Frame:
             data[key][0].append(rid)
             data[key][1].append(cid)
 
+        do_standard = views in (None, "standard")
+        do_inverse = views in (None, "inverse")
         for rid, cid, ts in zip(rows.tolist(), cols.tolist(), timestamps):
-            if ts is None:
-                standard = [VIEW_STANDARD]
-            else:
-                standard = tq.views_by_time(VIEW_STANDARD, ts, q)
-                standard.append(VIEW_STANDARD)
-            for vn in standard:
-                put(vn, rid, cid)
-            if self.inverse_enabled:
+            if do_standard:
+                if ts is None:
+                    standard = [VIEW_STANDARD]
+                else:
+                    standard = tq.views_by_time(VIEW_STANDARD, ts, q)
+                    standard.append(VIEW_STANDARD)
+                for vn in standard:
+                    put(vn, rid, cid)
+            if self.inverse_enabled and do_inverse:
                 if ts is None:
                     inverse = [VIEW_INVERSE]
                 else:

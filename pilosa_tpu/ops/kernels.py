@@ -106,12 +106,7 @@ def op_count(op: str, a: jax.Array, b: jax.Array) -> jax.Array:
     """Fused count, auto-selecting the Pallas kernel on TPU (interpret
     mode when forced via PILOSA_TPU_PALLAS=interpret for CPU tests)."""
     from . import pallas_kernels
-    try:
-        platform = a.devices().pop().platform if hasattr(a, "devices") \
-            else jax.default_backend()
-    except Exception:  # noqa: BLE001 - tracer/abstract values
-        platform = jax.default_backend()
-    mode = pallas_kernels.pallas_mode(platform)
+    mode = pallas_kernels.pallas_mode(pallas_kernels.platform_of(a))
     if mode is not None:
         return pallas_kernels.op_count_rows_pallas(
             op, a, b, interpret=(mode == "interpret"))

@@ -25,13 +25,14 @@ _TILE_W = 4096
 _LANES = 128
 
 
-def should_use_pallas(a: jax.Array) -> bool:
+def platform_of(a: jax.Array) -> str:
+    """Platform of the array's device (default backend for tracers and
+    abstract values) — the input to pallas_mode."""
     try:
-        platform = a.devices().pop().platform if hasattr(a, "devices") \
+        return a.devices().pop().platform if hasattr(a, "devices") \
             else jax.default_backend()
-    except Exception:
-        platform = jax.default_backend()
-    return pallas_mode(platform) is not None
+    except Exception:  # noqa: BLE001 - tracer/abstract values
+        return jax.default_backend()
 
 
 def pallas_mode(platform: str) -> str | None:

@@ -25,13 +25,13 @@ metadata is not bandwidth-bound (SURVEY.md §5).
 Deployment contract: a pod is ONE logical cluster node (only the pod
 coordinator appears in ``cluster.hosts``; a cluster of pods lists one
 coordinator per pod). Every process of the pod must enter each
-collective together with identically-shaped shards — so this layer is
-driven by a pod-internal query broadcast (the launcher or a worker
-loop replays each query to all processes), NOT by the executor's
+collective together with identically-shaped shards — the pod-internal
+query broadcast in ``parallel.pod`` drives this layer from the
+Server/Executor stack: the coordinator replays each device-batched
+Count/TopN as a work item to every process's ``/pod/exec`` route and
+all processes enter the collective together (NOT the executor's
 per-node map-reduce, which would double-count the pod-global psum if
-pod hosts were also cluster nodes. The executor integrates via that
-broadcast in a later round; until then pods serve through this library
-API directly.
+pod hosts were also cluster nodes).
 
 Environment contract (set by the pod launcher):
   PILOSA_TPU_DIST_COORDINATOR  host:port of process 0
@@ -67,6 +67,13 @@ def initialize_from_env() -> bool:
     coord = os.environ.get("PILOSA_TPU_DIST_COORDINATOR")
     if not coord:
         return False
+    # CPU-pod support (tests and TPU-less staging): give each process N
+    # virtual CPU devices and gloo cross-process collectives. Must be
+    # configured before the first backend touch.
+    cpu_devs = os.environ.get("PILOSA_TPU_DIST_CPU_DEVICES")
+    if cpu_devs:
+        jax.config.update("jax_num_cpu_devices", int(cpu_devs))
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=coord,
         num_processes=int(os.environ.get("PILOSA_TPU_DIST_NUM_PROCS", "1")),
@@ -106,6 +113,24 @@ def _local_chunk() -> int:
     return max(1, (1 << 15) // jax.process_count())
 
 
+def _assert_uniform_shards(*dims: int) -> None:
+    """Every process must enter the chunk loops with identically-sized
+    local shards — unequal shards execute different numbers of
+    collectives and deadlock the pod. One tiny allgather per call
+    (entered by all processes together) catches the mismatch up front.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    mine = np.asarray(dims, dtype=np.int64)
+    everyone = np.asarray(multihost_utils.process_allgather(mine))
+    if not (everyone == mine[None, :]).all():
+        raise ValueError(
+            "pod shard shapes differ across processes:"
+            f" {everyone.tolist()} — every process must pass the same"
+            " local slice/row counts (pad with zero slices)")
+
+
 def _pad_local(local: np.ndarray, axis: int) -> np.ndarray:
     """Pad this process's shard so every process contributes the same
     number of slice rows per device. Zero slices are the identity for
@@ -137,6 +162,7 @@ def count_expr(mesh: Mesh, expr: tuple, local_leaves: np.ndarray) -> int:
     """Pod-wide Count: each process passes its local [L, S_local, W]
     leaf shard; the psum spans every chip on every host. Chunks the
     slice axis identically on every process (int32 hi/lo bound)."""
+    _assert_uniform_shards(*local_leaves.shape)
     total = 0
     step = _local_chunk()
     for off in range(0, max(local_leaves.shape[1], 1), step):
@@ -155,6 +181,7 @@ def topn_exact(mesh: Mesh, expr, local_rows: np.ndarray,
     budget, mirroring mesh.topn_exact) with pod-wide identical bounds.
     """
     n_local, n_rows, n_words = local_rows.shape
+    _assert_uniform_shards(n_local, n_rows, n_words)
     if local_leaves is None:
         local_leaves = np.zeros((0, n_local, 1), dtype=np.uint32)
     s_step = _local_chunk()

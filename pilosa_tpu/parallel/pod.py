@@ -1,0 +1,379 @@
+"""Pod-internal query broadcast: a multi-host TPU pod as ONE cluster node.
+
+The reference scales only by adding cluster nodes that merge results
+over HTTP (executor.go:1103-1236); a TPU pod instead spans hosts with a
+single device mesh whose collectives ride ICI. This module makes such a
+pod serve PQL through the ordinary Server/Executor stack:
+
+- Only the pod *coordinator* (jax process 0) appears in the cluster's
+  host list. Clients (and other cluster nodes' remote legs) talk to it.
+- Slice ownership inside the pod is round-robin by process:
+  ``owner_pid(slice) = slice % n_procs``. Stable as the index grows, so
+  writes and reads agree on placement without any rebalancing.
+- Device-batched Count/TopN: the coordinator broadcasts a *work item*
+  (expression tree + leaf descriptors + the global slice list) to every
+  worker process over HTTP, then all processes pack their owned slices
+  and enter the SAME SPMD collective together
+  (parallel.multihost.count_expr / topn_exact) — the psum spans every
+  chip in the pod. Workers run the item from the ``/pod/exec`` route.
+- Host-path reads (Bitmap/Range materialization, TopN candidate phase)
+  and writes route within the pod over HTTP as ``podLocal`` query legs:
+  the executor partitions slices by owner process and the owning
+  process runs its plain local path (executor._pod_host_mapper).
+
+Failure semantics match TPU pods, not the reference's replica retry: a
+pod process that dies mid-collective stalls the pod until the
+collective layer times out — the pod is one failure domain, and
+cluster-level replication (whole pods as ReplicaN nodes) provides the
+redundancy.
+
+Environment contract (in addition to parallel.multihost's):
+  PILOSA_TPU_POD_PEERS   comma list of every pod process's HTTP host,
+                         in process order (index 0 = coordinator)
+  PILOSA_TPU_POD_TIMEOUT seconds to wait for worker legs (default 300)
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ..errors import PilosaError
+from . import multihost
+
+ENV_PEERS = "PILOSA_TPU_POD_PEERS"
+
+
+class PodError(PilosaError):
+    pass
+
+
+def _expr_from_json(v):
+    """JSON arrays back to the hashable tuple trees mesh kernels key on."""
+    if isinstance(v, list):
+        return tuple(_expr_from_json(x) for x in v)
+    return v
+
+
+class Pod:
+    """Pod membership + the work-item protocol. One per Server process."""
+
+    def __init__(self, holder, peers: list[str]):
+        import jax
+        self.holder = holder
+        self.pid = jax.process_index()
+        self.n_procs = jax.process_count()
+        if len(peers) != self.n_procs:
+            raise PodError(
+                f"{ENV_PEERS} lists {len(peers)} hosts for"
+                f" {self.n_procs} pod processes")
+        self.peers = peers
+        self.timeout = float(os.environ.get("PILOSA_TPU_POD_TIMEOUT",
+                                            "300"))
+        self._run_mu = threading.Lock()       # one collective at a time
+        self._dispatch_mu = threading.Lock()  # one item in flight pod-wide
+        # Set when a dispatch failed AFTER some worker received the item:
+        # that worker may be parked inside the orphaned collective, and a
+        # new collective would cross-match with it. Once poisoned, the
+        # device path stays off (the podLocal host fan-out remains
+        # correct) until the pod is restarted — a pod is one failure
+        # domain, like a real TPU pod job.
+        self._poisoned = False
+        # Per-peer keep-alive connections for pod-internal requests
+        # (serialized per peer; reconnect on any error).
+        self._conns: dict[int, http.client.HTTPConnection] = {}
+        self._conn_mus = {pid: threading.Lock()
+                          for pid in range(self.n_procs)}
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.pid == 0
+
+    # -- slice placement -----------------------------------------------------
+
+    def owner_pid(self, slice: int) -> int:
+        return slice % self.n_procs
+
+    def owned(self, slices, pid: Optional[int] = None) -> list[int]:
+        pid = self.pid if pid is None else pid
+        return sorted(s for s in slices if s % self.n_procs == pid)
+
+    def max_shard_slices(self, slices) -> int:
+        """Per-process shard length for an item's slice list: the max
+        owned count over processes, so arbitrary (non-round-robin-
+        balanced) lists still give every process an equal shard."""
+        counts = [0] * self.n_procs
+        for s in slices:
+            counts[s % self.n_procs] += 1
+        return max(counts) if counts else 0
+
+    def _local_slices(self, slices: list[int]) -> list[int]:
+        """This process's shard of the item's slice list, padded with -1
+        (absent → zero slices, the identity for every reduction) so all
+        processes feed identically-shaped shards to the collective —
+        deterministic from the item alone, so every process agrees."""
+        per = self.max_shard_slices(slices)
+        mine = self.owned(slices)
+        return mine + [-1] * (per - len(mine))
+
+    # -- packing (zeros for absent fragments / pad slices) -------------------
+
+    def _pack_leaves(self, index: str, leaves: list[tuple],
+                     local_slices: list[int]) -> np.ndarray:
+        from ..ops.packed import WORDS_PER_SLICE
+        block = np.zeros(
+            (len(leaves), len(local_slices), WORDS_PER_SLICE),
+            dtype=np.uint32)
+        for li, (frame, view, row_id) in enumerate(leaves):
+            for si, s in enumerate(local_slices):
+                if s < 0:
+                    continue
+                frag = self.holder.fragment(index, frame, view, s)
+                if frag is not None:
+                    frag.pack_row(row_id, out=block[li, si])
+        return block
+
+    def _pack_rows(self, index: str, frame: str, row_ids: list[int],
+                   local_slices: list[int]) -> np.ndarray:
+        from ..models.view import VIEW_STANDARD
+        from ..ops.packed import WORDS_PER_SLICE
+        rows = np.zeros(
+            (len(local_slices), len(row_ids), WORDS_PER_SLICE),
+            dtype=np.uint32)
+        for si, s in enumerate(local_slices):
+            if s < 0:
+                continue
+            frag = self.holder.fragment(index, frame, VIEW_STANDARD, s)
+            if frag is None:
+                continue
+            cached = len(row_ids) <= frag.device.max_rows
+            for ri, rid in enumerate(row_ids):
+                frag.pack_row(rid, out=rows[si, ri], cached=cached)
+        return rows
+
+    # -- the collective leg (every process runs this) ------------------------
+
+    def run_item(self, item: dict) -> dict:
+        """Pack this process's shard and enter the pod-wide collective.
+
+        Called inline by the coordinator and from the ``/pod/exec``
+        route by workers. All processes compute the same shard layout
+        from the item, so the SPMD programs line up.
+        """
+        with self._run_mu:
+            kind = item["kind"]
+            index = item["index"]
+            slices = [int(s) for s in item["slices"]]
+            leaves = [tuple(leaf) for leaf in item["leaves"]]
+            expr = _expr_from_json(item["expr"])
+            local = self._local_slices(slices)
+            mesh = multihost.pod_mesh()
+            if kind == "count_expr":
+                block = self._pack_leaves(index, leaves, local)
+                return {"total": multihost.count_expr(mesh, expr, block)}
+            if kind == "topn_exact":
+                rows = self._pack_rows(index, item["frame"],
+                                       item["row_ids"], local)
+                lblock = self._pack_leaves(index, leaves, local)
+                return {"counts": multihost.topn_exact(
+                    mesh, expr, rows, lblock)}
+            raise PodError(f"unknown pod work item kind: {kind}")
+
+    # -- coordinator dispatch ------------------------------------------------
+
+    def _request(self, pid: int, method: str, path: str, body: bytes,
+                 content_type: str,
+                 sent: Optional[threading.Event] = None) -> bytes:
+        """One pod-internal request on the peer's keep-alive connection
+        (serialized per peer; reconnect once on a stale socket)."""
+        with self._conn_mus[pid]:
+            for attempt in range(2):
+                conn = self._conns.pop(pid, None)
+                fresh = conn is None
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        self.peers[pid], timeout=self.timeout)
+                try:
+                    conn.request(method, path, body=body,
+                                 headers={"Content-Type": content_type})
+                except (http.client.HTTPException, OSError):
+                    conn.close()
+                    if fresh:
+                        raise
+                    continue  # stale keep-alive socket — retry fresh
+                if sent is not None:
+                    sent.set()  # delivered — worker enters the collective
+                try:
+                    resp = conn.getresponse()
+                    data = resp.read()
+                except (http.client.HTTPException, OSError):
+                    conn.close()
+                    raise
+                if resp.will_close:
+                    conn.close()
+                else:
+                    self._conns[pid] = conn
+                if resp.status != 200:
+                    raise PodError(f"pod process {pid} {method} {path}:"
+                                   f" {data.decode(errors='replace')}")
+                return data
+
+    def _post_item(self, pid: int, body: bytes, sent: threading.Event,
+                   out: list, errs: list) -> None:
+        try:
+            out[pid] = json.loads(self._request(
+                pid, "POST", "/pod/exec", body, "application/json",
+                sent=sent))
+        except Exception as e:  # noqa: BLE001 - collected by dispatcher
+            errs.append((pid, e))
+
+    def _dispatch(self, item: dict) -> dict:
+        """Broadcast the item to every worker, run our own leg, verify
+        all legs agree (they all hold the same psum result)."""
+        if self._poisoned:
+            raise PodError("pod collective path disabled after an earlier"
+                           " partial dispatch failure (restart the pod)")
+        body = json.dumps(item).encode()
+        out: list = [None] * self.n_procs
+        errs: list = []
+        sent_events = []
+        threads = []
+        with self._dispatch_mu:
+            for pid in range(1, self.n_procs):
+                sent = threading.Event()
+                t = threading.Thread(
+                    target=self._post_item, args=(pid, body, sent, out,
+                                                  errs), daemon=True)
+                t.start()
+                sent_events.append((pid, sent))
+                threads.append(t)
+            # Only enter the collective once every worker has the item —
+            # entering with a worker unreachable would stall the pod
+            # until the collective layer times out.
+            delivered = []
+            undelivered = []
+            for pid, sent in sent_events:
+                (delivered if sent.wait(min(self.timeout, 15.0))
+                 else undelivered).append(pid)
+            if undelivered:
+                if delivered:
+                    # Some workers are already entering the orphaned
+                    # collective; a new one would cross-match with it.
+                    self._poisoned = True
+                raise PodError(
+                    f"pod processes {undelivered} not reachable for"
+                    " work-item broadcast"
+                    + (" — pod collective path disabled" if delivered
+                       else ""))
+            try:
+                mine = self.run_item(item)
+            except Exception:
+                # The collective itself failed (e.g. a worker died after
+                # receiving the item) — remaining processes may be parked
+                # in it; nothing further can safely pair up.
+                self._poisoned = True
+                raise
+            for t in threads:
+                t.join()
+        if errs:
+            pid, e = errs[0]
+            raise PodError(f"pod process {pid} failed: {e}") from e
+        for pid in range(1, self.n_procs):
+            if out[pid] != mine:
+                raise PodError(
+                    f"pod divergence: process {pid} returned {out[pid]},"
+                    f" coordinator computed {mine}")
+        return mine
+
+    def count_expr(self, index: str, expr: tuple, leaves: list[tuple],
+                   slices: list[int]) -> int:
+        if not slices:
+            return 0
+        return self._dispatch({
+            "kind": "count_expr", "index": index, "expr": expr,
+            "leaves": [list(leaf) for leaf in leaves],
+            "slices": sorted(slices)})["total"]
+
+    def topn_exact(self, index: str, frame: str, expr, leaves: list[tuple],
+                   row_ids: list[int], slices: list[int]) -> list[int]:
+        if not slices or not row_ids:
+            return [0] * len(row_ids)
+        return self._dispatch({
+            "kind": "topn_exact", "index": index, "frame": frame,
+            "expr": expr, "leaves": [list(leaf) for leaf in leaves],
+            "row_ids": [int(r) for r in row_ids],
+            "slices": sorted(slices)})["counts"]
+
+    # -- pod-internal forwarding helpers -------------------------------------
+
+    def forward_raw(self, pid: int, method: str, path: str, body: bytes,
+                    content_type: str) -> bytes:
+        """One pod-internal HTTP request (import forwarding, schema
+        replication) on the peer's keep-alive connection."""
+        return self._request(pid, method, path, body, content_type)
+
+
+class PodBroadcaster:
+    """Wraps the coordinator's cluster broadcaster so schema mutations
+    also reach every pod worker (their ``/messages`` route) — workers
+    are not cluster nodes, but must hold the same schema to serve
+    pod-internal legs."""
+
+    def __init__(self, base, pod: Pod):
+        self.base = base
+        self.pod = pod
+
+    def _pod_send(self, m) -> None:
+        from ..cluster.broadcast import marshal_message
+        body = marshal_message(m)
+        errs = []
+        threads = []
+
+        def post(pid):
+            try:
+                self.pod.forward_raw(pid, "POST", "/messages", body,
+                                     "application/x-protobuf")
+            except Exception as e:  # noqa: BLE001 - collected below
+                errs.append(e)
+
+        for pid in range(1, self.pod.n_procs):
+            t = threading.Thread(target=post, args=(pid,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+    def send_sync(self, m) -> None:
+        self.base.send_sync(m)
+        self._pod_send(m)
+
+    def send_async(self, m) -> None:
+        self.base.send_async(m)
+        threading.Thread(target=lambda: self._quiet_pod_send(m),
+                         daemon=True).start()
+
+    def _quiet_pod_send(self, m) -> None:
+        try:
+            self._pod_send(m)
+        except Exception:  # noqa: BLE001 - async sends are best-effort
+            pass
+
+
+def maybe_pod(holder) -> Optional[Pod]:
+    """A Pod when the multihost env contract is active with >1 process;
+    None in the ordinary single-process server."""
+    if not multihost.initialize_from_env():
+        return None
+    import jax
+    if jax.process_count() <= 1:
+        return None
+    peers = [p.strip()
+             for p in os.environ.get(ENV_PEERS, "").split(",") if p.strip()]
+    return Pod(holder, peers)

@@ -136,11 +136,13 @@ class Handler:
 
     def __init__(self, holder, executor, cluster=None, host: str = "",
                  broadcaster=NOP_BROADCASTER, broadcast_handler=None,
-                 status_handler=None, stats=None, client_factory=None):
+                 status_handler=None, stats=None, client_factory=None,
+                 pod=None):
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
         self.host = host
+        self.pod = pod  # parallel.pod.Pod when serving as a pod process
         self.broadcaster = broadcaster
         self.broadcast_handler = broadcast_handler
         self.status_handler = status_handler
@@ -200,6 +202,7 @@ class Handler:
         r("GET", "/status", self._handle_get_status)
         r("GET", "/version", self._handle_get_version)
         r("POST", "/messages", self._handle_post_message)
+        r("POST", "/pod/exec", self._handle_pod_exec)
 
     def __call__(self, environ, start_response):
         method = environ.get("REQUEST_METHOD", "GET")
@@ -442,7 +445,8 @@ class Handler:
         try:
             results = self.executor.execute(
                 index_name, query, slices or None,
-                ExecOptions(remote=remote))
+                ExecOptions(remote=remote,
+                            pod_local=req.query.get("podLocal") == "true"))
         except PilosaError as e:
             return error_resp(400, str(e))
         except Exception as e:  # noqa: BLE001 - surfaced in response
@@ -513,9 +517,64 @@ class Handler:
             dt.datetime.fromtimestamp(ts / 1e9, dt.timezone.utc)
             .replace(tzinfo=None) if ts else None
             for ts in ireq.Timestamps] if ireq.Timestamps else None
-        frame.import_bits(list(ireq.RowIDs), list(ireq.ColumnIDs),
-                          timestamps)
+        pod_view = req.query.get("podView")
+        if (self.pod is not None and self.pod.is_coordinator
+                and pod_view is None):
+            self._pod_import(ireq, idx, frame, timestamps)
+        else:
+            frame.import_bits(list(ireq.RowIDs), list(ireq.ColumnIDs),
+                              timestamps, views=pod_view)
         return Response.proto(pb.ImportResponse())
+
+    def _pod_import(self, ireq, idx, frame, timestamps) -> None:
+        """Split an import within the pod (parallel.pod placement):
+        standard + time views live on the owner of the column slice;
+        inverse views group by row slice, one leg per owning process."""
+        from .. import SLICE_WIDTH
+        pod = self.pod
+        rows, cols = list(ireq.RowIDs), list(ireq.ColumnIDs)
+        ts_ns = list(ireq.Timestamps) if ireq.Timestamps else [0] * len(rows)
+
+        owner = pod.owner_pid(ireq.Slice)
+        if owner == pod.pid:
+            frame.import_bits(rows, cols, timestamps, views="standard")
+        else:
+            self._pod_forward_import(owner, ireq.Index, frame.name,
+                                     ireq.Slice, rows, cols, ts_ns,
+                                     "standard")
+            idx.set_remote_max_slice(ireq.Slice)
+
+        if not frame.inverse_enabled:
+            return
+        groups: dict[int, tuple[list, list, list]] = {}
+        for i, (r, c) in enumerate(zip(rows, cols)):
+            pid = pod.owner_pid(r // SLICE_WIDTH)
+            g = groups.setdefault(pid, ([], [], []))
+            g[0].append(r)
+            g[1].append(c)
+            g[2].append(i)
+        for pid, (rs, cs, idxs) in sorted(groups.items()):
+            if pid == pod.pid:
+                sub_ts = ([timestamps[i] for i in idxs]
+                          if timestamps else None)
+                frame.import_bits(rs, cs, sub_ts, views="inverse")
+            else:
+                self._pod_forward_import(
+                    pid, ireq.Index, frame.name, ireq.Slice, rs, cs,
+                    [ts_ns[i] for i in idxs], "inverse")
+                idx.set_remote_max_inverse_slice(
+                    max(r // SLICE_WIDTH for r in rs))
+
+    def _pod_forward_import(self, pid: int, index: str, frame: str,
+                            slice: int, rows, cols, ts_ns,
+                            view: str) -> None:
+        body = pb.ImportRequest(
+            Index=index, Frame=frame, Slice=slice,
+            RowIDs=[int(r) for r in rows],
+            ColumnIDs=[int(c) for c in cols],
+            Timestamps=[int(t) for t in ts_ns]).SerializeToString()
+        self.pod.forward_raw(pid, "POST", f"/import?podView={view}",
+                             body, _PROTOBUF)
 
     def _handle_get_export(self, req: Request) -> Response:
         if req.accept != "text/csv":
@@ -620,6 +679,13 @@ class Handler:
                     continue
                 frag.read_from(io.BytesIO(rd))
         return Response.json({})
+
+    # -- pod work items (parallel.pod) ---------------------------------------
+
+    def _handle_pod_exec(self, req: Request) -> Response:
+        if self.pod is None:
+            raise HTTPError(404, "not a pod process")
+        return Response.json(self.pod.run_item(req.json()))
 
     # -- broadcast ingest ----------------------------------------------------
 

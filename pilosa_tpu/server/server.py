@@ -66,6 +66,7 @@ class Server:
                              stats=stats)
         self.executor: Optional[Executor] = None
         self.handler: Optional[Handler] = None
+        self.pod = None  # parallel.pod.Pod once open() joins a pod
 
         self._httpd = None
         self._threads: list[threading.Thread] = []
@@ -100,19 +101,28 @@ class Server:
         # Pod membership (multi-host TPU) joins before any jax use so the
         # executor's mesh spans every chip in the pod; a no-op unless the
         # PILOSA_TPU_DIST_* env contract is set (parallel.multihost).
-        from ..parallel import multihost
+        from ..parallel import multihost, pod as pod_mod
         multihost.initialize_from_env()
 
         self.holder.open()
 
+        # Pod-internal query broadcast (parallel.pod): the coordinator
+        # fans device-batched Count/TopN to every pod process as one
+        # collective and replicates schema mutations to pod workers.
+        self.pod = pod_mod.maybe_pod(self.holder)
+        if self.pod is not None and self.pod.is_coordinator:
+            self.broadcaster = pod_mod.PodBroadcaster(self.broadcaster,
+                                                      self.pod)
+
         client = _RoutingClient(self)
         self.executor = Executor(self.holder, host=self.host,
-                                 cluster=self.cluster, client=client)
+                                 cluster=self.cluster, client=client,
+                                 pod=self.pod)
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
             broadcast_handler=self, status_handler=self,
-            stats=self.stats, client_factory=Client)
+            stats=self.stats, client_factory=Client, pod=self.pod)
 
         self._httpd = make_server(bind_host, port, self.handler,
                                   server_class=_ThreadingWSGIServer,
@@ -279,6 +289,8 @@ class _RoutingClient:
     def __init__(self, server: Server):
         self.server = server
 
-    def execute_query(self, node, index, query, slices, remote):
+    def execute_query(self, node, index, query, slices, remote,
+                      pod_local=False):
         return self.server.client_for(node.host).execute_query(
-            node, index, query, slices, remote=remote)
+            node, index, query, slices, remote=remote,
+            pod_local=pod_local)

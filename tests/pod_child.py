@@ -1,0 +1,115 @@
+"""Child process for the pod end-to-end test (tests/test_pod.py).
+
+Runs one pod process of a 2-process CPU pod (gloo collectives). The
+launcher passes the whole env contract; this script only builds a
+Server, and — on the coordinator — drives PQL through the full
+HTTP → executor → pod broadcast → mesh-collective stack and checks
+pod-wide results, mirroring the reference's whole-process cluster tests
+(server/server_test.go:375-496).
+
+Usage: python pod_child.py <proc_id> <data_dir>
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.server.server import Server
+
+
+def http(method, host, path, body=b"", content_type="application/json"):
+    req = urllib.request.Request(
+        f"http://{host}{path}", data=body, method=method,
+        headers={"Content-Type": content_type})
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.read()
+
+
+def query(host, index, pql):
+    raw = http("POST", host, f"/index/{index}/query", pql.encode())
+    return json.loads(raw)["results"]
+
+
+def main() -> None:
+    proc_id = int(sys.argv[1])
+    data_dir = sys.argv[2]
+    host = os.environ["PILOSA_TPU_POD_PEERS"].split(",")[proc_id]
+
+    srv = Server(data_dir, host=host, anti_entropy_interval=0,
+                 polling_interval=0)
+    srv.open()
+    print(f"pod process {proc_id} serving on {srv.host}", flush=True)
+
+    if proc_id != 0:
+        # Worker: serve pod legs until the launcher kills us.
+        while True:
+            time.sleep(0.5)
+
+    coord = srv.host
+    http("POST", coord, "/index/i", b"{}")
+    http("POST", coord, "/index/i/frame/f", b"{}")
+
+    # Bits across 4 slices: pod of 2 procs → proc 0 owns slices 0 & 2,
+    # proc 1 owns slices 1 & 3 (round-robin placement, parallel.pod).
+    # Row 1: 3 bits per slice; row 2: the first 2 of those; row 3: 1.
+    for s in range(4):
+        for j in range(3):
+            query(coord, "i", f"SetBit(frame=f, rowID=1,"
+                              f" columnID={s * SLICE_WIDTH + j})")
+        for j in range(2):
+            query(coord, "i", f"SetBit(frame=f, rowID=2,"
+                              f" columnID={s * SLICE_WIDTH + j})")
+        query(coord, "i", f"SetBit(frame=f, rowID=3,"
+                          f" columnID={s * SLICE_WIDTH})")
+
+    # Pod-wide Count through the device-collective path (all 4 slices,
+    # psum across both processes' chips).
+    got = query(coord, "i", "Count(Bitmap(frame=f, rowID=1))")[0]
+    assert got == 12, f"Count(row1): {got} != 12"
+    got = query(coord, "i", "Count(Intersect(Bitmap(frame=f, rowID=1),"
+                            " Bitmap(frame=f, rowID=2)))")[0]
+    assert got == 8, f"Count(Intersect): {got} != 8"
+    got = query(coord, "i", "Count(Difference(Bitmap(frame=f, rowID=1),"
+                            " Bitmap(frame=f, rowID=2)))")[0]
+    assert got == 4, f"Count(Difference): {got} != 4"
+
+    # Bitmap materialization rides the podLocal host legs: bits from
+    # worker-owned slices must appear.
+    bits = query(coord, "i", "Bitmap(frame=f, rowID=3)")[0]["bits"]
+    assert bits == [s * SLICE_WIDTH for s in range(4)], bits
+
+    # TopN candidate phase (rank caches on every process) + exact-count
+    # phase (pod collective).
+    pairs = query(coord, "i", "TopN(frame=f, n=2)")
+    got = [(p["id"], p["count"]) for p in pairs[0]]
+    assert got == [(1, 12), (2, 8)], got
+    pairs = query(coord, "i",
+                  "TopN(Bitmap(frame=f, rowID=2), frame=f, ids=[1, 3])")
+    got = [(p["id"], p["count"]) for p in pairs[0]]
+    assert got == [(1, 8), (3, 4)], got
+
+    # Pod executions really did run: the coordinator's executor must not
+    # have fallen back to the (coordinator-only) host path silently.
+    assert srv.executor.device_fallbacks == 0, srv.executor.device_fallbacks
+
+    print("POD_TEST_OK", flush=True)
+    srv.close()
+
+
+if __name__ == "__main__":
+    # Hard-exit either way: jax.distributed's atexit shutdown can hang
+    # waiting on peers, and the launcher only watches our rc/stdout.
+    try:
+        main()
+    except BaseException:
+        import traceback
+        traceback.print_exc()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)
+    os._exit(0)
