@@ -447,8 +447,16 @@ class Executor:
             if mesh is None:
                 return NotImplemented
             from .parallel import mesh as mesh_mod
-            block = self._pack_leaf_block(index, leaves, slices)
             try:
+                if len(slices) <= mesh_mod.slice_chunk_bound(
+                        mesh.shape[mesh_mod.AXIS_SLICES]):
+                    # Residency fast path: leaf slabs stay device-
+                    # resident across queries (budgeted HBM cache).
+                    arrs = [self._leaf_device_array(mesh, index, leaf,
+                                                    tuple(slices))
+                            for leaf in leaves]
+                    return mesh_mod.count_expr_sharded(mesh, expr, arrs)
+                block = self._pack_leaf_block(index, leaves, slices)
                 return mesh_mod.count_expr(mesh, expr, block)
             except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
                 self._note_device_fallback("count_expr", e)
@@ -469,6 +477,37 @@ class Executor:
                 if frag is not None:
                     frag.pack_row(row_id, out=block[li, si])
         return block
+
+    def _leaf_device_array(self, mesh, index: str, leaf: tuple,
+                           slices: tuple[int, ...]):
+        """Device-resident [n_slices(+pad), words] slab for one PQL leaf
+        row, held in the budgeted HBM cache (parallel.residency).
+
+        The key embeds every backing fragment's (uid, generation), so
+        writes/reopens stop the entry being referenced and it ages out
+        of the LRU — repeated Count/TopN over a stable index re-use the
+        upload instead of re-packing + re-transferring per query."""
+        from .parallel import mesh as mesh_mod
+        from .parallel.residency import device_cache
+        frame, view, row_id = leaf
+        frags = [self.holder.fragment(index, frame, view, s)
+                 for s in slices]
+        gens = tuple((f.device.uid, f.device.generation) if f is not None
+                     else (0, 0) for f in frags)
+        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+        key = ("leaf", id(self.holder), index, frame, view, row_id,
+               slices, gens, n_dev)
+
+        def build():
+            from .ops.packed import WORDS_PER_SLICE
+            n = len(slices) + (-len(slices) % n_dev)
+            block = np.zeros((n, WORDS_PER_SLICE), dtype=np.uint32)
+            for si, frag in enumerate(frags):
+                if frag is not None:
+                    frag.pack_row(row_id, out=block[si])
+            return mesh_mod.shard_slices(mesh, block)
+
+        return device_cache().get_or_build(key, build)
 
     # -- TopN (executor.go:271-396) ------------------------------------------
 
@@ -568,22 +607,21 @@ class Executor:
             if mesh is None:
                 return NotImplemented
             from .parallel import mesh as mesh_mod
-            rows = np.zeros((len(slices), len(row_ids), WORDS_PER_SLICE),
-                            dtype=np.uint32)
-            for si, slice in enumerate(slices):
-                frag = self.holder.fragment(index, frame_name,
-                                            VIEW_STANDARD, slice)
-                if frag is None:
-                    continue
-                # Bypass the packed-row LRU when this candidate set
-                # exceeds the fragment's own budget (0% hit rate, pure
-                # churn against the hot leaf rows).
-                cached = len(row_ids) <= frag.device.max_rows
-                for ri, rid in enumerate(row_ids):
-                    frag.pack_row(rid, out=rows[si, ri], cached=cached)
-            leaf_block = self._pack_leaf_block(index, leaves, slices)
             try:
-                counts = mesh_mod.topn_exact(mesh, expr, rows, leaf_block)
+                block_bytes = (len(slices) * len(row_ids)
+                               * WORDS_PER_SLICE * 4)
+                if (len(slices) <= mesh_mod.slice_chunk_bound(
+                        mesh.shape[mesh_mod.AXIS_SLICES])
+                        and block_bytes <= mesh_mod.TOPN_BLOCK_BYTES):
+                    counts = self._topn_exact_resident(
+                        mesh, index, frame_name, expr, leaves,
+                        tuple(row_ids), tuple(slices))
+                else:
+                    counts = mesh_mod.topn_exact(
+                        mesh, expr,
+                        self._pack_candidate_rows(index, frame_name,
+                                                  row_ids, slices),
+                        self._pack_leaf_block(index, leaves, slices))
             except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
                 self._note_device_fallback("topn_exact", e)
                 return NotImplemented
@@ -591,6 +629,62 @@ class Executor:
                     for rid, cnt in zip(row_ids, counts) if cnt > 0]
 
         return local_fn
+
+    def _pack_candidate_rows(self, index: str, frame_name: str,
+                             row_ids: list[int],
+                             slices: list[int]) -> np.ndarray:
+        """[n_slices, n_rows, words] dense candidate block, host-side."""
+        from .ops.packed import WORDS_PER_SLICE
+        rows = np.zeros((len(slices), len(row_ids), WORDS_PER_SLICE),
+                        dtype=np.uint32)
+        for si, slice in enumerate(slices):
+            frag = self.holder.fragment(index, frame_name,
+                                        VIEW_STANDARD, slice)
+            if frag is None:
+                continue
+            # Bypass the packed-row LRU when this candidate set
+            # exceeds the fragment's own budget (0% hit rate, pure
+            # churn against the hot leaf rows).
+            cached = len(row_ids) <= frag.device.max_rows
+            for ri, rid in enumerate(row_ids):
+                frag.pack_row(rid, out=rows[si, ri], cached=cached)
+        return rows
+
+    def _topn_exact_resident(self, mesh, index: str, frame_name: str,
+                             expr, leaves: list[tuple],
+                             row_ids: tuple[int, ...],
+                             slices: tuple[int, ...]) -> list[int]:
+        """TopN exact counts with the candidate block and leaf slabs
+        device-resident (budgeted HBM cache) — repeat TopN queries skip
+        the per-query pack + upload entirely."""
+        from .parallel import mesh as mesh_mod
+        from .parallel.residency import device_cache
+        frags = [self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
+                 for s in slices]
+        gens = tuple((f.device.uid, f.device.generation) if f is not None
+                     else (0, 0) for f in frags)
+        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+        key = ("topnrows", id(self.holder), index, frame_name, row_ids,
+               slices, gens, n_dev)
+
+        def build():
+            from .ops.packed import WORDS_PER_SLICE
+            n = len(slices) + (-len(slices) % n_dev)
+            rows = np.zeros((n, len(row_ids), WORDS_PER_SLICE),
+                            dtype=np.uint32)
+            for si, frag in enumerate(frags):
+                if frag is None:
+                    continue
+                cached = len(row_ids) <= frag.device.max_rows
+                for ri, rid in enumerate(row_ids):
+                    frag.pack_row(rid, out=rows[si, ri], cached=cached)
+            return mesh_mod.shard_slices(mesh, rows)
+
+        rows_arr = device_cache().get_or_build(key, build)
+        leaf_arrays = [self._leaf_device_array(mesh, index, leaf, slices)
+                       for leaf in leaves]
+        return mesh_mod.topn_exact_sharded(mesh, expr, rows_arr,
+                                           leaf_arrays)
 
     def _top_n_slice(self, index: str, c: Call, slice: int) -> list[Pair]:
         # executor.go:325-396

@@ -140,6 +140,16 @@ def expr_count_rows_pallas(expr, leaves: jax.Array,
     return jnp.sum(partials, axis=-1)[:rows]
 
 
+def _eval_expr_ref_t(expr, leaves_ref):
+    """_eval_expr_ref for the slice-major leaves layout of the TopN
+    kernel: the block is ``[1, n_leaves, tile_w]``, so leaf i loads as
+    ``leaves_ref[:, i, :]`` → ``[1, tile_w]``."""
+    if expr[0] == "leaf":
+        return leaves_ref[:, expr[1], :]
+    return _BITWISE[expr[0]](_eval_expr_ref_t(expr[1], leaves_ref),
+                             _eval_expr_ref_t(expr[2], leaves_ref))
+
+
 def _topn_block_kernel(expr, rows_ref, leaves_ref, out_ref):
     j = pl.program_id(2)
 
@@ -149,8 +159,8 @@ def _topn_block_kernel(expr, rows_ref, leaves_ref, out_ref):
 
     words = rows_ref[0]                      # [TILE_R, tile_w]
     if expr is not None:
-        src = _eval_expr_ref(expr, leaves_ref)  # [1, tile_w]
-        words = jnp.bitwise_and(words, src)     # broadcast over rows
+        src = _eval_expr_ref_t(expr, leaves_ref)  # [1, tile_w]
+        words = jnp.bitwise_and(words, src)       # broadcast over rows
     pc = jax.lax.population_count(words).astype(jnp.int32)
     tr, tw = pc.shape
     out_ref[0] += pc.reshape(tr, tw // _LANES, _LANES).sum(axis=1)
@@ -179,6 +189,11 @@ def topn_block_count_pallas(expr, rows: jax.Array, leaves: jax.Array,
     n_leaves = max(leaves.shape[0], 1)
     if leaves.shape[0] == 0:  # expr None: feed a 1-leaf dummy block
         leaves = jnp.zeros((1, n_slices, rows.shape[2]), jnp.uint32)
+    # Slice-major leaves layout: the per-slice leaf block's trailing two
+    # dims become (n_leaves, tile_w), satisfying the TPU tiling rule
+    # (second-to-last must divide 8 OR equal the array dim — a size-1
+    # slice block over [L, S, W] does neither when S isn't tiny).
+    leaves_t = jnp.transpose(leaves, (1, 0, 2))  # [S, L, W]
     partials = pl.pallas_call(
         functools.partial(_topn_block_kernel, expr),
         out_shape=jax.ShapeDtypeStruct(
@@ -186,12 +201,12 @@ def topn_block_count_pallas(expr, rows: jax.Array, leaves: jax.Array,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, _TILE_R, tile_w), lambda s, i, j: (s, i, j)),
-            pl.BlockSpec((n_leaves, 1, tile_w), lambda s, i, j: (0, s, j)),
+            pl.BlockSpec((1, n_leaves, tile_w), lambda s, i, j: (s, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, _TILE_R, _LANES),
                                lambda s, i, j: (s, i, 0)),
         interpret=interpret,
-    )(rows, leaves)
+    )(rows, leaves_t)
     return jnp.sum(partials, axis=-1)[:, :rows_n]
 
 

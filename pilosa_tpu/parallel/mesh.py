@@ -157,18 +157,26 @@ def count_expr_fn(mesh: Mesh, expr: tuple):
     return _count_expr_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
 
 
+def slice_chunk_bound(n_dev: int) -> int:
+    """Max slice-rows per psum'd program: the 16-bit lo halves sum to at
+    most ``rows × 0xFFFF``, which must stay under int32 — 2^15 rows is
+    the bound, and padding to the device multiple must not cross it."""
+    return (1 << 15) - n_dev
+
+
 def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
     """Count the bitmap expression over slice-sharded leaf blocks.
 
     ``leaves`` is ``[n_leaves, n_slices, n_words]`` u32; slices are padded
-    to the mesh and chunked at 2^15 (the hi/lo int32 bound), so any slice
+    to the mesh and chunked at the hi/lo int32 bound, so any slice
     count works.
     """
     n_dev = mesh.shape[AXIS_SLICES]
     fn = count_expr_fn(mesh, expr)
     total = 0
-    for off in range(0, leaves.shape[1], 1 << 15):
-        chunk = leaves[:, off:off + (1 << 15)]
+    step = slice_chunk_bound(n_dev)
+    for off in range(0, leaves.shape[1], step):
+        chunk = leaves[:, off:off + step]
         rem = chunk.shape[1] % n_dev
         if rem:
             pad = [(0, 0), (0, n_dev - rem), (0, 0)]
@@ -176,6 +184,86 @@ def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
         hi, lo = fn(shard_slices_axis1(mesh, chunk))
         total += (int(hi) << 16) + int(lo)
     return total
+
+
+@functools.lru_cache(maxsize=256)
+def _count_expr_sharded_fn(mesh: Mesh, expr: tuple, n_leaves: int,
+                           mode: str | None):
+    def per_shard(*leaf_shards):  # each [S/n, W]
+        leaves = jnp.stack(leaf_shards)  # [L, S/n, W]
+        row = _rows_popcount(expr, leaves, mode).ravel()
+        hi = jax.lax.psum(jnp.sum(row >> 16), AXIS_SLICES)
+        lo = jax.lax.psum(jnp.sum(row & 0xFFFF), AXIS_SLICES)
+        return hi, lo
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(AXIS_SLICES),) * n_leaves, out_specs=(P(), P()),
+        check_vma=(mode is None)))
+
+
+def count_expr_sharded(mesh: Mesh, expr: tuple,
+                       leaf_arrays: list[jax.Array]) -> int:
+    """Count over per-leaf DEVICE-resident [n_slices, n_words] slabs
+    (each sharded over the slice axis, e.g. from the residency cache —
+    no host pack or upload on this path). All slabs must share one
+    shape with n_slices ≤ slice_chunk_bound; leaves stack on device
+    inside the compiled program.
+    """
+    if leaf_arrays[0].shape[0] > slice_chunk_bound(
+            mesh.shape[AXIS_SLICES]):
+        raise ValueError("count_expr_sharded: slice count above the"
+                         " int32 hi/lo bound — use count_expr")
+    fn = _count_expr_sharded_fn(mesh, expr, len(leaf_arrays),
+                                _mesh_pallas_mode(mesh))
+    hi, lo = fn(*leaf_arrays)
+    return (int(hi) << 16) + int(lo)
+
+
+@functools.lru_cache(maxsize=256)
+def _topn_exact_sharded_fn(mesh: Mesh, expr, n_leaves: int,
+                           mode: str | None):
+    def per_shard(rows, *leaf_shards):  # rows [S/n, R, W]
+        if n_leaves:
+            leaves = jnp.stack(leaf_shards)  # [L, S/n, W]
+        else:
+            leaves = jnp.zeros((0,) + rows.shape[::2], dtype=rows.dtype)
+        if mode is not None:
+            from ..ops import pallas_kernels
+            per_slice = pallas_kernels.topn_block_count_pallas(
+                expr, rows, leaves, interpret=(mode == "interpret"))
+        else:
+            words = rows
+            if expr is not None:
+                src = _eval_expr(expr, leaves)
+                words = jnp.bitwise_and(rows, src[:, None, :])
+            pc = jax.lax.population_count(words).astype(jnp.int32)
+            per_slice = jnp.sum(pc, axis=-1)
+        hi = jax.lax.psum(jnp.sum(per_slice >> 16, axis=0), AXIS_SLICES)
+        lo = jax.lax.psum(jnp.sum(per_slice & 0xFFFF, axis=0), AXIS_SLICES)
+        return hi, lo
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(AXIS_SLICES),) * (n_leaves + 1),
+        out_specs=(P(), P()), check_vma=(mode is None)))
+
+
+def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
+                       leaf_arrays: list[jax.Array]) -> list[int]:
+    """TopN exact counts over a DEVICE-resident candidate block
+    ``rows [n_slices, R, W]`` and per-leaf slabs (all sharded over the
+    slice axis, e.g. from the residency cache). Single program — the
+    caller bounds n_slices (slice_chunk_bound) and the block bytes.
+    """
+    if rows.shape[0] > slice_chunk_bound(mesh.shape[AXIS_SLICES]):
+        raise ValueError("topn_exact_sharded: slice count above the"
+                         " int32 hi/lo bound — use topn_exact")
+    fn = _topn_exact_sharded_fn(mesh, expr, len(leaf_arrays),
+                                _mesh_pallas_mode(mesh))
+    hi, lo = fn(rows, *leaf_arrays)
+    hi, lo = np.asarray(hi), np.asarray(lo)
+    return [(int(hi[r]) << 16) + int(lo[r]) for r in range(rows.shape[1])]
 
 
 def shard_slices_axis1(mesh: Mesh, arr: np.ndarray) -> jax.Array:
@@ -249,7 +337,7 @@ def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
     n_dev = mesh.shape[AXIS_SLICES]
     fn = topn_exact_fn(mesh, expr)
     n_slices, n_rows, n_words = rows.shape
-    slice_chunk = min(1 << 15, n_slices) or 1
+    slice_chunk = min(slice_chunk_bound(n_dev), n_slices) or 1
     row_chunk = max(1, TOPN_BLOCK_BYTES // (slice_chunk * n_words * 4))
     totals = [0] * n_rows
     for s_off in range(0, n_slices, slice_chunk):

@@ -107,10 +107,11 @@ def process_slice_range(n_slices: int) -> tuple[int, int]:
 
 # Local slice-axis chunk size: every process uses the same bound, so
 # chunk boundaries agree pod-wide; the global per-chunk slice count
-# (chunk × n_procs) stays within the int32 hi/lo split for any pod that
-# divides 2^15.
+# (chunk × n_procs, plus per-device padding ≤ n_devices) stays within
+# the int32 hi/lo split (mesh.slice_chunk_bound).
 def _local_chunk() -> int:
-    return max(1, (1 << 15) // jax.process_count())
+    return max(1, ((1 << 15) - len(jax.devices()))
+               // jax.process_count())
 
 
 def _assert_uniform_shards(*dims: int) -> None:

@@ -1,44 +1,146 @@
 """HBM working-set manager: device residency for hot fragment rows.
 
-The reference mutates mmap'd bitmaps in place; device arrays are immutable
-and HBM is smaller than the on-disk index, so device state is an explicit
-cache with two layers: a host-side LRU of packed row words (feeding the
-executor's mesh block builds and device uploads; invalidated per row by
-writes, bounded by ``max_rows``), and the TopN candidate row *block* — a
-stacked u32 matrix pinned in HBM as a unit, keyed by (row ids, write
-generation) since the rank cache already identifies the hot rows.
+The reference mutates mmap'd bitmaps in place and relies on the OS page
+cache plus its own row cache for hot-row reuse (fragment.go:338-367);
+device arrays are immutable and HBM is smaller than the on-disk index,
+so device state is an explicit, *budgeted* cache:
 
-One manager exists per fragment (pilosa_tpu.storage.fragment.Fragment).
+- ``DeviceBlockCache`` — a process-wide LRU over device-resident packed
+  blocks with an HBM byte budget (PILOSA_TPU_HBM_BUDGET_MB). Entries
+  are the executor's mesh leaf blocks (one [slices, words] slab per
+  PQL leaf row), the mesh TopN candidate blocks, and each fragment's
+  single-device candidate blocks. The hot entries are exactly the rank
+  cache's top rows — LRU over query use keeps that working set pinned
+  while bounded eviction stops 50k-rows × many-fragments from
+  exceeding HBM (SURVEY §7 hard part 2).
+- ``DeviceRowCache`` — per-fragment host-side LRU of packed row words
+  (feeds block builds and mesh uploads; invalidated per row by writes).
+
+Staleness is handled by keys, not callbacks: every cached block's key
+embeds the owning fragments' ``(uid, generation)`` pairs — writes bump
+the generation, fragment reopen mints a fresh uid — so stale entries
+simply stop being referenced and age out of the LRU.
 """
 
 from __future__ import annotations
 
+import itertools
+import os
+import threading
 from collections import OrderedDict
-from typing import Optional
+from typing import Callable, Optional
 
 import jax
 import numpy as np
 
-from .. import SLICE_WIDTH
 from ..ops import packed
 
 # Default packed-row budget per fragment (256 rows × 128 KB = 32 MB
-# host-side; the device holds only the TopN block).
+# host-side).
 DEFAULT_MAX_ROWS = 256
+
+# Process-wide HBM budget for device-resident blocks. v5e chips have
+# ~16 GB HBM; leave headroom for the programs' own activations.
+DEFAULT_HBM_BUDGET_MB = 1024
+
+_uid_counter = itertools.count(1)
+
+
+class DeviceBlockCache:
+    """Budgeted process-wide LRU of device-resident arrays.
+
+    Thread-safe. An entry larger than the whole budget is returned
+    uncached (one-shot upload) rather than evicting everything else.
+    """
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        if budget_bytes is None:
+            budget_bytes = int(os.environ.get(
+                "PILOSA_TPU_HBM_BUDGET_MB", str(DEFAULT_HBM_BUDGET_MB))
+            ) << 20
+        self.budget_bytes = budget_bytes
+        self._mu = threading.Lock()
+        self._lru: OrderedDict[tuple, jax.Array] = OrderedDict()
+        self.used_bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _nbytes(arr) -> int:
+        return int(np.prod(arr.shape)) * arr.dtype.itemsize
+
+    def get_or_build(self, key: tuple,
+                     build: Callable[[], jax.Array]) -> jax.Array:
+        with self._mu:
+            arr = self._lru.get(key)
+            if arr is not None:
+                self._lru.move_to_end(key)
+                self.hits += 1
+                return arr
+            self.misses += 1
+        # Build outside the lock: packing + device_put can take long and
+        # must not serialize unrelated queries. Concurrent builders of
+        # the same key race benignly (last insert wins).
+        arr = build()
+        nbytes = self._nbytes(arr)
+        if nbytes > self.budget_bytes:
+            return arr  # one-shot: bigger than the whole working set
+        with self._mu:
+            if key not in self._lru:
+                self._lru[key] = arr
+                self.used_bytes += nbytes
+            self._lru.move_to_end(key)
+            # len > 1 keeps the just-built entry (now most-recent) alive.
+            while self.used_bytes > self.budget_bytes and len(self._lru) > 1:
+                _, old = self._lru.popitem(last=False)
+                self.used_bytes -= self._nbytes(old)
+                self.evictions += 1
+        return arr
+
+    def clear(self) -> None:
+        with self._mu:
+            self._lru.clear()
+            self.used_bytes = 0
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {"entries": len(self._lru),
+                    "usedBytes": self.used_bytes,
+                    "budgetBytes": self.budget_bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "evictions": self.evictions}
+
+
+_device_cache: Optional[DeviceBlockCache] = None
+_device_cache_mu = threading.Lock()
+
+
+def device_cache() -> DeviceBlockCache:
+    """The process-wide device block cache (lazy singleton)."""
+    global _device_cache
+    with _device_cache_mu:
+        if _device_cache is None:
+            _device_cache = DeviceBlockCache()
+        return _device_cache
 
 
 class DeviceRowCache:
+    """Per-fragment residency state: host packed-row LRU + the device
+    block handle into the shared ``DeviceBlockCache``."""
+
     def __init__(self, max_rows: int = DEFAULT_MAX_ROWS):
         self.max_rows = max_rows
         # Host-side packed words, feeding the device row blocks and the
         # executor's mesh block builds (which stack rows across
         # fragments host-side before one sharded device_put).
         self._host_rows: OrderedDict[int, np.ndarray] = OrderedDict()
-        # Write generation: bumped on every invalidation so cached row
-        # blocks (keyed by ids+generation) go stale automatically.
+        # (uid, generation) is this fragment's staleness key fragment:
+        # generation bumps on every write-invalidation; uid is unique
+        # per DeviceRowCache instance so a reopened fragment at
+        # generation 0 can never alias a prior instance's entries.
+        self.uid = next(_uid_counter)
         self.generation = 0
-        self._block_key: Optional[tuple] = None
-        self._block: Optional[jax.Array] = None
 
     # -- single rows
 
@@ -66,20 +168,14 @@ class DeviceRowCache:
 
     def invalidate_all(self) -> None:
         self._host_rows.clear()
-        self._block_key = None
-        self._block = None
         self.generation += 1
 
-    # -- row blocks (TopN candidates)
+    # -- row blocks (TopN candidates), budgeted in the shared cache
 
     def block(self, storage, row_ids: tuple[int, ...]) -> jax.Array:
-        """Stacked u32[n, 32768] device matrix for the given rows, cached by
-        (ids, generation)."""
-        key = (row_ids, self.generation)
-        if self._block_key == key:
-            return self._block
-        matrix = packed.pack_rows(storage, row_ids)
-        self._block = jax.device_put(matrix)
-        self._block_key = key
-        return self._block
-
+        """Stacked u32[n, 32768] device matrix for the given rows, held
+        in the process-wide budgeted cache keyed by this fragment's
+        (uid, generation) + the id tuple."""
+        key = ("fragblock", self.uid, self.generation, row_ids)
+        return device_cache().get_or_build(
+            key, lambda: jax.device_put(packed.pack_rows(storage, row_ids)))

@@ -518,6 +518,8 @@ class Handler:
             .replace(tzinfo=None) if ts else None
             for ts in ireq.Timestamps] if ireq.Timestamps else None
         pod_view = req.query.get("podView")
+        if pod_view is not None and pod_view not in ("standard", "inverse"):
+            raise HTTPError(400, f"invalid podView: {pod_view}")
         if (self.pod is not None and self.pod.is_coordinator
                 and pod_view is None):
             self._pod_import(ireq, idx, frame, timestamps)

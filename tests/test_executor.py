@@ -447,18 +447,18 @@ class TestDeviceCountPath:
                       mesh_min_slices=1)
         called = {}
         from pilosa_tpu.parallel import mesh as mesh_mod
-        orig = mesh_mod.count_expr
+        orig = mesh_mod.count_expr_sharded
 
-        def spy(mesh, expr, leaves):
+        def spy(mesh, expr, arrs):
             called["expr"] = expr
-            called["shape"] = leaves.shape
-            return orig(mesh, expr, leaves)
+            called["n_leaves"] = len(arrs)
+            return orig(mesh, expr, arrs)
 
-        monkeypatch.setattr(mesh_mod, "count_expr", spy)
+        monkeypatch.setattr(mesh_mod, "count_expr_sharded", spy)
         res = ex.execute("i", 'Count(Intersect(Bitmap(rowID=1, frame=f),'
                               ' Bitmap(rowID=2, frame=f)))')
         assert called["expr"] == ("and", ("leaf", 0), ("leaf", 1))
-        assert called["shape"][0] == 2
+        assert called["n_leaves"] == 2
         assert res[0] >= 3  # the three overlap columns, one per slice
 
     def test_range_falls_back(self, holder):
@@ -514,13 +514,13 @@ class TestDeviceTopNPath:
                       mesh_min_slices=1)
         calls = []
         from pilosa_tpu.parallel import mesh as mesh_mod
-        orig = mesh_mod.topn_exact
+        orig = mesh_mod.topn_exact_sharded
 
         def spy(mesh, expr, rows, leaves):
             calls.append((expr, rows.shape))
             return orig(mesh, expr, rows, leaves)
 
-        monkeypatch.setattr(mesh_mod, "topn_exact", spy)
+        monkeypatch.setattr(mesh_mod, "topn_exact_sharded", spy)
         res = ex.execute("i", 'TopN(Bitmap(rowID=0, frame=f), frame=f, n=3)')
         assert calls, "TopN exact phase did not use the mesh path"
         assert calls[-1][0] == ("leaf", 0)
@@ -537,6 +537,7 @@ class TestDeviceTopNPath:
             raise AssertionError("device path must not engage with filters")
 
         monkeypatch.setattr(mesh_mod, "topn_exact", boom)
+        monkeypatch.setattr(mesh_mod, "topn_exact_sharded", boom)
         res = ex.execute(
             "i", 'TopN(frame=f, n=2, field="cat", filters=["x"],'
                  ' ids=[0,1,2])')

@@ -53,19 +53,26 @@ def test_pod_two_process_count_topn(tmp_path):
     script = os.path.join(_HERE, "pod_child.py")
 
     procs = []
+    worker_log = tmp_path / "worker.log"
     try:
         for pid in range(2):
             data_dir = tmp_path / f"node{pid}"
             data_dir.mkdir()
+            if pid == 0:
+                stdout, stderr = subprocess.PIPE, subprocess.PIPE
+            else:
+                # A file, not a PIPE: nothing drains the long-lived
+                # worker, and a full pipe buffer would wedge it.
+                stdout = stderr = open(worker_log, "w")
             procs.append(subprocess.Popen(
                 [sys.executable, script, str(pid), str(data_dir)],
                 env=_child_env(pid, jax_port, peers),
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                text=True))
+                stdout=stdout, stderr=stderr, text=True))
         out, err = procs[0].communicate(timeout=240)
         assert procs[0].returncode == 0, (
             f"coordinator failed rc={procs[0].returncode}\n"
-            f"stdout:\n{out}\nstderr:\n{err[-4000:]}")
+            f"stdout:\n{out}\nstderr:\n{err[-4000:]}\n"
+            f"worker:\n{worker_log.read_text()[-2000:]}")
         assert "POD_TEST_OK" in out, out
     finally:
         for p in procs:

@@ -1,0 +1,139 @@
+"""Budgeted HBM residency: the process-wide device block cache.
+
+SURVEY §7 hard part 2: 50k cached rows × many fragments exceed HBM, so
+device blocks live in one budgeted LRU (parallel.residency) keyed by
+fragment (uid, generation) — repeat queries reuse uploads, writes
+invalidate by key, the byte budget bounds total HBM.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.parallel import residency
+from pilosa_tpu.parallel.residency import DeviceBlockCache
+
+
+def _arr(n_bytes: int):
+    import jax
+    return jax.device_put(np.zeros(n_bytes // 4, dtype=np.uint32))
+
+
+class TestDeviceBlockCache:
+    def test_hit_returns_same_array(self):
+        c = DeviceBlockCache(budget_bytes=1 << 20)
+        a = c.get_or_build(("k",), lambda: _arr(1024))
+        b = c.get_or_build(("k",), lambda: pytest.fail("rebuilt on hit"))
+        assert a is b
+        assert c.hits == 1 and c.misses == 1
+
+    def test_budget_evicts_lru(self):
+        c = DeviceBlockCache(budget_bytes=4096)
+        c.get_or_build(("a",), lambda: _arr(2048))
+        c.get_or_build(("b",), lambda: _arr(2048))
+        c.get_or_build(("a",), lambda: pytest.fail("a evicted early"))
+        c.get_or_build(("c",), lambda: _arr(2048))  # evicts b (LRU)
+        assert c.evictions == 1
+        assert c.used_bytes <= 4096
+        rebuilt = []
+        c.get_or_build(("b",), lambda: rebuilt.append(1) or _arr(2048))
+        assert rebuilt  # b was the evicted one
+
+    def test_oversize_entry_not_cached(self):
+        c = DeviceBlockCache(budget_bytes=1024)
+        c.get_or_build(("small",), lambda: _arr(512))
+        c.get_or_build(("big",), lambda: _arr(4096))
+        assert c.used_bytes == 512  # big stayed one-shot
+        c.get_or_build(("small",), lambda: pytest.fail("small evicted"))
+
+    def test_snapshot(self):
+        c = DeviceBlockCache(budget_bytes=1 << 20)
+        c.get_or_build(("k",), lambda: _arr(1024))
+        snap = c.snapshot()
+        assert snap["entries"] == 1 and snap["usedBytes"] == 1024
+        assert snap["misses"] == 1
+
+
+class TestFragmentResidency:
+    def test_block_cached_and_generation_invalidates(self, tmp_path):
+        from pilosa_tpu.storage.fragment import Fragment
+        frag = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        frag.open()
+        try:
+            for r in range(4):
+                for col in range(r + 1):
+                    frag.set_bit(r, col)
+            cache = residency.device_cache()
+            m0 = cache.misses
+            b1 = frag.device.block(frag.storage, (0, 1, 2, 3))
+            b2 = frag.device.block(frag.storage, (0, 1, 2, 3))
+            assert b1 is b2
+            assert cache.misses == m0 + 1
+            frag.set_bit(0, 100)  # bumps generation
+            b3 = frag.device.block(frag.storage, (0, 1, 2, 3))
+            assert b3 is not b1
+            assert np.asarray(b3)[0].sum() != np.asarray(b1)[0].sum()
+        finally:
+            frag.close()
+
+    def test_uid_unique_across_reopen(self, tmp_path):
+        from pilosa_tpu.storage.fragment import Fragment
+        path = str(tmp_path / "frag")
+        frag = Fragment(path, "i", "f", "standard", 0)
+        frag.open()
+        uid1 = frag.device.uid
+        frag.close()
+        frag = Fragment(path, "i", "f", "standard", 0)
+        frag.open()
+        assert frag.device.uid != uid1
+        frag.close()
+
+
+class TestExecutorResidency:
+    @pytest.fixture
+    def holder_exec(self, tmp_path):
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.models.holder import Holder
+        holder = Holder(str(tmp_path))
+        holder.open()
+        idx = holder.create_index_if_not_exists("i")
+        frame = idx.create_frame_if_not_exists("f")
+        from pilosa_tpu import SLICE_WIDTH
+        for s in range(8):
+            for r in (1, 2):
+                for j in range(3 - r + 1):
+                    frame.set_bit("standard", r, s * SLICE_WIDTH + j)
+        ex = Executor(holder, host="h", mesh_min_slices=1)
+        yield holder, ex
+        holder.close()
+
+    def test_repeat_count_reuses_device_blocks(self, holder_exec):
+        holder, ex = holder_exec
+        cache = residency.device_cache()
+        q = "Count(Intersect(Bitmap(frame=f, rowID=1)," \
+            " Bitmap(frame=f, rowID=2)))"
+        first = ex.execute("i", q)[0]
+        misses_after_first = cache.misses
+        again = ex.execute("i", q)[0]
+        assert again == first == 8 * 2  # rows 1∩2 share 2 cols/slice
+        assert cache.misses == misses_after_first  # no re-upload
+        assert ex.device_fallbacks == 0
+
+    def test_repeat_topn_reuses_device_blocks(self, holder_exec):
+        holder, ex = holder_exec
+        cache = residency.device_cache()
+        q = "TopN(Bitmap(frame=f, rowID=1), frame=f, ids=[1, 2])"
+        first = ex.execute("i", q)[0]
+        misses_after_first = cache.misses
+        again = ex.execute("i", q)[0]
+        assert [(p.id, p.count) for p in first] == \
+            [(p.id, p.count) for p in again] == [(1, 24), (2, 16)]
+        assert cache.misses == misses_after_first
+        assert ex.device_fallbacks == 0
+
+    def test_write_invalidates_leaf_entry(self, holder_exec):
+        holder, ex = holder_exec
+        q = "Count(Bitmap(frame=f, rowID=1))"
+        assert ex.execute("i", q)[0] == 24
+        ex.execute("i", "SetBit(frame=f, rowID=1, columnID=500)")
+        assert ex.execute("i", q)[0] == 25  # fresh generation → re-pack
+        assert ex.device_fallbacks == 0
