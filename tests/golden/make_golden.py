@@ -1,0 +1,110 @@
+"""Generate golden interchange fixtures in the reference wire format.
+
+These bytes are hand-assembled with struct.pack from the DOCUMENTED
+layout of the reference file format (roaring.go:475-614 for the
+snapshot body, roaring.go:1560-1626 for op records) — deliberately
+independent of pilosa_tpu.storage.roaring, so the tests in
+tests/test_golden.py prove interchange against the format itself, not
+against our own serializer reading its own output.
+
+Layout (all little-endian):
+  snapshot := cookie(u32 = 12346) containerN(u32)
+              [key(u64) n_minus_1(u32)] * containerN
+              [offset(u32)] * containerN
+              container blocks: array (n ≤ 4096): n × u32 (low 16 bits)
+                                bitmap (n > 4096): 1024 × u64
+  op       := typ(u8: 0=add, 1=remove) value(u64) fnv1a32(of first 9B)(u32)
+
+Run ``python tests/golden/make_golden.py`` to (re)write the fixtures;
+test_golden.py asserts the committed bytes match this generator, so the
+fixtures cannot rot silently.
+"""
+
+import os
+import struct
+
+COOKIE = 12346
+ARRAY_MAX = 4096
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def fnv1a32(data: bytes) -> int:
+    h = 0x811C9DC5
+    for byte in data:
+        h ^= byte
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h
+
+
+def snapshot(containers: list[tuple[int, list[int]]]) -> bytes:
+    """containers: sorted [(key, sorted low-16-bit values)]."""
+    header = struct.pack("<II", COOKIE, len(containers))
+    keys = b""
+    blocks = []
+    for key, vals in containers:
+        assert vals == sorted(set(vals)) and all(0 <= v < 65536
+                                                 for v in vals)
+        keys += struct.pack("<QI", key, len(vals) - 1)
+        if len(vals) <= ARRAY_MAX:
+            blocks.append(struct.pack(f"<{len(vals)}I", *vals))
+        else:
+            words = [0] * 1024
+            for v in vals:
+                words[v >> 6] |= 1 << (v & 63)
+            blocks.append(struct.pack("<1024Q", *words))
+    offsets = b""
+    off = len(header) + len(keys) + 4 * len(containers)
+    for blk in blocks:
+        offsets += struct.pack("<I", off)
+        off += len(blk)
+    return header + keys + offsets + b"".join(blocks)
+
+
+def op(typ: int, value: int) -> bytes:
+    body = struct.pack("<BQ", typ, value)
+    return body + struct.pack("<I", fnv1a32(body))
+
+
+def fixtures() -> dict[str, bytes]:
+    """name → hand-assembled bytes for every fixture."""
+    out = {
+        "empty.roaring": snapshot([]),
+        "simple_array.roaring": snapshot([(0, SIMPLE_VALUES)]),
+        "multi_container.roaring": snapshot([
+            (0, list(range(10))),
+            (1, BITMAP_LOWS),
+            (HIGH_KEY, [123]),
+        ]),
+    }
+    # Snapshot + appended op log (the on-disk WAL form a fragment file
+    # has between snapshots, fragment.go:179-234).
+    out["with_oplog.roaring"] = (
+        out["simple_array.roaring"] + b"".join(op(t, v) for t, v in OPS))
+    # The same logical bitmap in canonical snapshot form (what a
+    # post-replay re-serialization must produce).
+    replayed = sorted({v for v in SIMPLE_VALUES if v != 100}
+                      | {5, 42, 2 * 65536 + 7})
+    by_key: dict[int, list[int]] = {}
+    for v in replayed:
+        by_key.setdefault(v >> 16, []).append(v & 0xFFFF)
+    out["with_oplog.expected.roaring"] = snapshot(sorted(by_key.items()))
+    return out
+
+
+# Fixture bit sets, kept in sync with tests/test_golden.py.
+SIMPLE_VALUES = [1, 5, 100, 65535]
+BITMAP_LOWS = list(range(0, 10000, 2))       # 5000 values → bitmap kind
+HIGH_KEY = 1 << 21                           # a 48-bit container key
+OPS = [(0, 2 * 65536 + 7), (0, 5), (1, 100), (0, 42)]  # add/add/rm/add
+
+
+def main(out_dir: str = HERE) -> None:
+    for name, data in fixtures().items():
+        with open(os.path.join(out_dir, name), "wb") as f:
+            f.write(data)
+        print(f"{name}: {len(data)} bytes")
+
+
+if __name__ == "__main__":
+    import sys
+    main(sys.argv[1] if len(sys.argv) > 1 else HERE)
