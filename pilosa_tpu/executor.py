@@ -544,16 +544,18 @@ class Executor:
         """Batched local-leg TopN exact-count phase: ALL candidate rows ×
         ALL slices in one psum-reduced mesh program.
 
-        Eligible only for the with-source exact-count form — explicit
-        candidate ids, a device-compilable source bitmap, no attribute
-        filter, no Tanimoto, default threshold — where the per-slice
-        algorithm (fragment.go:490-625) degenerates to "sum
-        count(row ∩ src) over slices, drop zeros": exactly a mesh
-        reduction (parallel.mesh.topn_exact). The ids-without-source
-        form stays host-side on purpose: there the per-slice path
-        answers from RankCache counts, and the device's fresh popcounts
-        could disagree with a stale cache entry. Everything else keeps
-        the per-slice path, which owns the full semantics.
+        Eligible for the with-source exact-count forms — explicit
+        candidate ids plus a device-compilable source bitmap. The plain
+        form is a mesh reduction (parallel.mesh.topn_exact); threshold>1
+        and Tanimoto run the per-slice pruning on device
+        (mesh.topn_filtered_sharded, fragment.go:560-614 semantics);
+        attribute filters drop candidates host-side first (row attrs
+        are frame-global, so pre-filtering ids is exactly the per-slice
+        filter). The ids-without-source form stays host-side on
+        purpose: there the per-slice path answers from RankCache
+        counts, and the device's fresh popcounts could disagree with a
+        stale cache entry. Everything else keeps the per-slice path,
+        which owns the full semantics.
         """
         if not self.use_mesh:
             return None
@@ -562,9 +564,10 @@ class Executor:
             return None  # candidate-selection phase reads rank caches
         min_threshold, _ = c.uint_arg("threshold")
         tanimoto, _ = c.uint_arg("tanimotoThreshold")
-        if (c.args.get("field") or c.args.get("filters")
-                or min_threshold > 1 or tanimoto):
-            return None
+        if tanimoto > 100:
+            return None  # host path owns the error semantics
+        field = c.args.get("field")
+        filters = c.args.get("filters")
         if len(c.children) != 1:
             return None
         frame_name = c.args.get("frame") or DEFAULT_FRAME
@@ -572,7 +575,11 @@ class Executor:
         expr = self._compile_device_expr(index, c.children[0], leaves)
         if expr is None:
             return None
+        threshold = max(min_threshold, MIN_THRESHOLD)
+        filtered = threshold > 1 or tanimoto > 0
         if self.pod is not None:
+            if filtered or (field and filters):
+                return None  # pod host legs own the filtered forms
             if not self.pod.is_coordinator or opt.pod_local:
                 return None  # plain local path on pod-internal legs
 
@@ -597,36 +604,51 @@ class Executor:
         def local_fn(slices: list[int]):
             if len(slices) < self.mesh_min_slices:
                 return NotImplemented
+            ids = list(row_ids)
+            if field and filters:
+                # Row attrs are frame-global: pre-filtering candidates
+                # equals the per-slice attr filter (fragment.top).
+                frame = self.holder.frame(index, frame_name)
+                store = frame.row_attr_store if frame else None
+                if store is None:
+                    return NotImplemented
+                fset = set(filters)
+                ids = [rid for rid in ids
+                       if (val := (store.attrs(rid) or {}).get(field))
+                       is not None and val in fset]
+                if not ids:
+                    return []
             from .ops.packed import WORDS_PER_SLICE
             # Host-allocation guard: huge candidate sets stay on the
             # per-slice path, which never materializes a dense block.
-            if (len(slices) * len(row_ids) * WORDS_PER_SLICE * 4
-                    > self._TOPN_HOST_BLOCK_BYTES):
+            block_bytes = len(slices) * len(ids) * WORDS_PER_SLICE * 4
+            if block_bytes > self._TOPN_HOST_BLOCK_BYTES:
                 return NotImplemented
             mesh = self._mesh_or_none()
             if mesh is None:
                 return NotImplemented
             from .parallel import mesh as mesh_mod
+            resident_ok = (len(slices) <= mesh_mod.slice_chunk_bound(
+                mesh.shape[mesh_mod.AXIS_SLICES])
+                and block_bytes <= mesh_mod.TOPN_BLOCK_BYTES)
+            if filtered and not resident_ok:
+                return NotImplemented  # no streaming filtered kernel
             try:
-                block_bytes = (len(slices) * len(row_ids)
-                               * WORDS_PER_SLICE * 4)
-                if (len(slices) <= mesh_mod.slice_chunk_bound(
-                        mesh.shape[mesh_mod.AXIS_SLICES])
-                        and block_bytes <= mesh_mod.TOPN_BLOCK_BYTES):
+                if resident_ok:
                     counts = self._topn_exact_resident(
                         mesh, index, frame_name, expr, leaves,
-                        tuple(row_ids), tuple(slices))
+                        tuple(ids), tuple(slices), threshold, tanimoto)
                 else:
                     counts = mesh_mod.topn_exact(
                         mesh, expr,
                         self._pack_candidate_rows(index, frame_name,
-                                                  row_ids, slices),
+                                                  ids, slices),
                         self._pack_leaf_block(index, leaves, slices))
             except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
                 self._note_device_fallback("topn_exact", e)
                 return NotImplemented
             return [Pair(rid, cnt)
-                    for rid, cnt in zip(row_ids, counts) if cnt > 0]
+                    for rid, cnt in zip(ids, counts) if cnt > 0]
 
         return local_fn
 
@@ -653,10 +675,13 @@ class Executor:
     def _topn_exact_resident(self, mesh, index: str, frame_name: str,
                              expr, leaves: list[tuple],
                              row_ids: tuple[int, ...],
-                             slices: tuple[int, ...]) -> list[int]:
+                             slices: tuple[int, ...],
+                             threshold: int = 1,
+                             tanimoto: int = 0) -> list[int]:
         """TopN exact counts with the candidate block and leaf slabs
         device-resident (budgeted HBM cache) — repeat TopN queries skip
-        the per-query pack + upload entirely."""
+        the per-query pack + upload entirely. threshold>1 / tanimoto
+        engage the per-slice pruning program (mesh.topn_filtered_sharded)."""
         from .parallel import mesh as mesh_mod
         from .parallel.residency import device_cache
         frags = [self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
@@ -683,6 +708,10 @@ class Executor:
         rows_arr = device_cache().get_or_build(key, build)
         leaf_arrays = [self._leaf_device_array(mesh, index, leaf, slices)
                        for leaf in leaves]
+        if threshold > 1 or tanimoto > 0:
+            return mesh_mod.topn_filtered_sharded(
+                mesh, expr, rows_arr, leaf_arrays,
+                threshold=threshold, tanimoto=tanimoto)
         return mesh_mod.topn_exact_sharded(mesh, expr, rows_arr,
                                            leaf_arrays)
 

@@ -228,25 +228,94 @@ def _topn_exact_sharded_fn(mesh: Mesh, expr, n_leaves: int,
             leaves = jnp.stack(leaf_shards)  # [L, S/n, W]
         else:
             leaves = jnp.zeros((0,) + rows.shape[::2], dtype=rows.dtype)
-        if mode is not None:
-            from ..ops import pallas_kernels
-            per_slice = pallas_kernels.topn_block_count_pallas(
-                expr, rows, leaves, interpret=(mode == "interpret"))
-        else:
-            words = rows
-            if expr is not None:
-                src = _eval_expr(expr, leaves)
-                words = jnp.bitwise_and(rows, src[:, None, :])
-            pc = jax.lax.population_count(words).astype(jnp.int32)
-            per_slice = jnp.sum(pc, axis=-1)
-        hi = jax.lax.psum(jnp.sum(per_slice >> 16, axis=0), AXIS_SLICES)
-        lo = jax.lax.psum(jnp.sum(per_slice & 0xFFFF, axis=0), AXIS_SLICES)
-        return hi, lo
+        return _psum_hi_lo_rows(
+            _shard_topn_inter(expr, rows, leaves, mode))
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES),) * (n_leaves + 1),
         out_specs=(P(), P()), check_vma=(mode is None)))
+
+
+def _shard_topn_inter(expr, rows, leaves, mode):
+    """Per-(slice, row) intersection counts for one shard — the shared
+    count body of the TopN programs (Pallas kernel or XLA fusion)."""
+    if mode is not None:
+        from ..ops import pallas_kernels
+        return pallas_kernels.topn_block_count_pallas(
+            expr, rows, leaves, interpret=(mode == "interpret"))
+    words = rows
+    if expr is not None:
+        src = _eval_expr(expr, leaves)
+        words = jnp.bitwise_and(rows, src[:, None, :])
+    return jnp.sum(jax.lax.population_count(words).astype(jnp.int32),
+                   axis=-1)
+
+
+def _psum_hi_lo_rows(per_slice):
+    """[S/n, R] per-slice counts → per-row (hi, lo) 16-bit halves,
+    psum'd over the slice axis (the int32-safe reduction split)."""
+    hi = jax.lax.psum(jnp.sum(per_slice >> 16, axis=0), AXIS_SLICES)
+    lo = jax.lax.psum(jnp.sum(per_slice & 0xFFFF, axis=0), AXIS_SLICES)
+    return hi, lo
+
+
+@functools.lru_cache(maxsize=256)
+def _topn_filtered_sharded_fn(mesh: Mesh, expr, n_leaves: int,
+                              mode: str | None):
+    """Per-row counts with the reference's per-slice threshold/Tanimoto
+    pruning applied BEFORE the slice reduction (fragment.go:560-614 —
+    the per-slice algorithm drops a slice's contribution when that
+    slice's row count or intersection count fails the bar, then the
+    executor sums the survivors; exact integer forms of the float
+    comparisons, identical results). threshold/tanimoto are runtime
+    scalars — one compiled program per (mesh, expr)."""
+
+    def per_shard(threshold, tanimoto, rows, *leaf_shards):
+        leaves = jnp.stack(leaf_shards)  # [L, S/n, W]
+        inter = _shard_topn_inter(expr, rows, leaves, mode)   # [S/n, R]
+        rowc = _shard_topn_inter(None, rows, leaves[:0], mode)
+        if mode is not None:
+            from ..ops import pallas_kernels
+            srcc = pallas_kernels.expr_count_rows_pallas(
+                expr, leaves, interpret=(mode == "interpret"))
+        else:
+            srcc = jnp.sum(
+                jax.lax.population_count(_eval_expr(expr, leaves))
+                .astype(jnp.int32), axis=-1)
+        s = srcc[:, None]                                     # [S/n, 1]
+        # cnt > srcc·t/100  ∧  cnt < srcc·100/t  ∧  inter > 0
+        # ∧  ceil(100·inter / (cnt + srcc − inter)) > t
+        keep_tan = ((100 * rowc > s * tanimoto)
+                    & (rowc * tanimoto < s * 100)
+                    & (inter > 0)
+                    & (100 * inter > tanimoto * (rowc + s - inter)))
+        keep_thr = (rowc >= threshold) & (inter >= threshold)
+        keep = jnp.where(tanimoto > 0, keep_tan, keep_thr)
+        return _psum_hi_lo_rows(jnp.where(keep, inter, 0))
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P()) + (P(AXIS_SLICES),) * (n_leaves + 1),
+        out_specs=(P(), P()), check_vma=(mode is None)))
+
+
+def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
+                          leaf_arrays: list[jax.Array],
+                          threshold: int = 1,
+                          tanimoto: int = 0) -> list[int]:
+    """TopN counts with per-slice threshold/Tanimoto pruning on device
+    (see _topn_filtered_sharded_fn). Same residency contract as
+    topn_exact_sharded."""
+    if rows.shape[0] > slice_chunk_bound(mesh.shape[AXIS_SLICES]):
+        raise ValueError("topn_filtered_sharded: slice count above the"
+                         " int32 hi/lo bound")
+    fn = _topn_filtered_sharded_fn(mesh, expr, len(leaf_arrays),
+                                   _mesh_pallas_mode(mesh))
+    hi, lo = fn(jnp.int32(threshold), jnp.int32(tanimoto),
+                rows, *leaf_arrays)
+    hi, lo = np.asarray(hi), np.asarray(lo)
+    return [(int(hi[r]) << 16) + int(lo[r]) for r in range(rows.shape[1])]
 
 
 def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
@@ -283,20 +352,8 @@ def _eval_expr(expr, leaves):
 @functools.lru_cache(maxsize=256)
 def _topn_exact_fn_cached(mesh: Mesh, expr, mode: str | None):
     def per_shard(rows, leaves):  # rows: [S/n, R, W]; leaves: [L, S/n, W]
-        if mode is not None:
-            from ..ops import pallas_kernels
-            per_slice = pallas_kernels.topn_block_count_pallas(
-                expr, rows, leaves, interpret=(mode == "interpret"))
-        else:
-            words = rows
-            if expr is not None:
-                src = _eval_expr(expr, leaves)        # [S/n, W]
-                words = jnp.bitwise_and(rows, src[:, None, :])
-            pc = jax.lax.population_count(words).astype(jnp.int32)
-            per_slice = jnp.sum(pc, axis=-1)      # [S/n, R], each ≤ 2^20
-        hi = jax.lax.psum(jnp.sum(per_slice >> 16, axis=0), AXIS_SLICES)
-        lo = jax.lax.psum(jnp.sum(per_slice & 0xFFFF, axis=0), AXIS_SLICES)
-        return hi, lo
+        return _psum_hi_lo_rows(
+            _shard_topn_inter(expr, rows, leaves, mode))
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,

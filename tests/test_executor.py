@@ -508,6 +508,42 @@ class TestDeviceTopNPath:
         for q in queries:
             assert fast.execute("i", q) == slow.execute("i", q), q
 
+    def test_topn_all_option_combinations_match_host(self, holder):
+        """VERDICT r1 item 7: threshold>1, Tanimoto, and attr filters
+        must run the device path with per-slice pruning semantics
+        identical to the per-slice host path, at ≥8 slices."""
+        self._fill(holder, slices=8)
+        store = holder.frame("i", "f").row_attr_store
+        for rid in range(6):
+            store.set_attrs(rid, {"cat": "x" if rid % 2 == 0 else "y"})
+        fast = Executor(holder, host="local", use_mesh=True,
+                        mesh_min_slices=1)
+        slow = Executor(holder, host="local", use_mesh=False)
+        ids = "ids=[0,1,2,3,4,5]"
+        src = "Bitmap(rowID=0, frame=f)"
+        queries = [
+            f'TopN({src}, frame=f, {ids}, threshold=2)',
+            f'TopN({src}, frame=f, {ids}, threshold=40)',
+            f'TopN({src}, frame=f, {ids}, tanimotoThreshold=5)',
+            f'TopN({src}, frame=f, {ids}, tanimotoThreshold=60)',
+            f'TopN({src}, frame=f, {ids}, field="cat", filters=["x"])',
+            f'TopN({src}, frame=f, {ids}, field="cat", filters=["y"],'
+            ' threshold=2)',
+            f'TopN({src}, frame=f, {ids}, field="cat", filters=["x"],'
+            ' tanimotoThreshold=10)',
+            f'TopN({src}, frame=f, {ids}, field="cat", filters=["z"])',
+            # no-ids phase with options still goes per-slice, then the
+            # refetch phase engages the device with the options cloned
+            f'TopN({src}, frame=f, n=3, threshold=2)',
+            f'TopN({src}, frame=f, n=3, field="cat", filters=["x"])',
+        ]
+        for q in queries:
+            f_res = fast.execute("i", q)
+            s_res = slow.execute("i", q)
+            assert [(p.id, p.count) for p in f_res[0]] == \
+                [(p.id, p.count) for p in s_res[0]], q
+        assert fast.device_fallbacks == 0
+
     def test_exact_phase_engages(self, holder, monkeypatch):
         self._fill(holder)
         ex = Executor(holder, host="local", use_mesh=True,

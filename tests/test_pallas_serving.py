@@ -98,6 +98,36 @@ class TestMeshPallasDispatch:
             .sum(axis=(0, 2)).tolist()
         assert mesh_mod.topn_exact(m, EXPR, rows, leaves) == want
 
+    def test_topn_filtered_via_pallas(self, data, monkeypatch):
+        """The per-slice threshold/Tanimoto pruning program must agree
+        with a per-slice host reference when its counts come from the
+        Pallas kernels (interpret mode — the compiled-TPU branch)."""
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "interpret")
+        leaves, rows = data
+        m = mesh_mod.make_mesh(8)
+        src = _eval(EXPR, leaves)
+        inter = np.bitwise_count(rows & src[:, None, :]).sum(axis=-1)
+        rowc = np.bitwise_count(rows).sum(axis=-1)
+        srcc = np.bitwise_count(src).sum(axis=-1)[:, None]
+        d_rows = mesh_mod.shard_slices(m, rows)
+        d_leaves = [mesh_mod.shard_slices(m, leaves[i])
+                    for i in range(leaves.shape[0])]
+        for threshold, tanimoto in ((1, 0), (3, 0), (10**6, 0),
+                                    (1, 5), (1, 50), (1, 99)):
+            if tanimoto:
+                keep = ((100 * rowc > srcc * tanimoto)
+                        & (rowc * tanimoto < srcc * 100)
+                        & (inter > 0)
+                        & (100 * inter
+                           > tanimoto * (rowc + srcc - inter)))
+            else:
+                keep = (rowc >= threshold) & (inter >= threshold)
+            want = np.where(keep, inter, 0).sum(axis=0).tolist()
+            got = mesh_mod.topn_filtered_sharded(
+                m, EXPR, d_rows, d_leaves,
+                threshold=threshold, tanimoto=tanimoto)
+            assert got == want, (threshold, tanimoto)
+
     def test_mode_selection(self, monkeypatch):
         monkeypatch.setenv("PILOSA_TPU_PALLAS", "0")
         assert pk.pallas_mode("tpu") is None
