@@ -246,6 +246,48 @@ def config5_cluster_topn() -> None:
                  rows=n_rows, devices=len(jax.devices()))
 
 
+def config2_executor_wide_union() -> None:
+    """Config 2 through the EXECUTOR: materializing Union/Difference
+    over many rows — device fold + repack vs per-slice roaring merges."""
+    import tempfile
+    import numpy as np
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models.holder import Holder
+
+    n_rows = max(16, int(1000 * SCALE))
+    rng = np.random.default_rng(8)
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(d)
+        holder.open()
+        frame = holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        for row in range(n_rows):
+            cols = rng.choice(SLICE_WIDTH, size=500, replace=False)
+            frame.import_bits([row] * len(cols), cols.tolist())
+        children = ", ".join(f"Bitmap(rowID={r}, frame=f)"
+                             for r in range(n_rows))
+        for name in ("Union", "Difference"):
+            q = f"{name}({children})"
+            want = None
+            for label, use_mesh in (("host", False),) + (
+                    (("device", True),) if USE_DEVICE else ()):
+                ex = Executor(holder, host="local", use_mesh=use_mesh,
+                              mesh_min_slices=1)
+                got = ex.execute("i", q)[0].count()  # warmup/compile
+                if want is None:
+                    want = got
+                assert got == want, (name, label, got, want)
+                lat = []
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    ex.execute("i", q)
+                    lat.append(time.perf_counter() - t0)
+                emit(f"c2_executor_{name.lower()}_{n_rows}rows_{label}",
+                     sorted(lat)[1] * 1e3, "ms", bits=int(want))
+        holder.close()
+
+
 def config_residency_repeat_latency() -> None:
     """Configs 3-4 through the EXECUTOR with the budgeted HBM residency
     cache: first query packs + uploads leaf/candidate blocks, repeats
@@ -301,6 +343,7 @@ def config_residency_repeat_latency() -> None:
 def main() -> None:
     for fn in (config1_fragment_intersect_count,
                config2_union_difference_1k_rows,
+               config2_executor_wide_union,
                config3_topn_latency,
                config4_mesh_count_over_slices,
                config5_cluster_topn,

@@ -120,6 +120,11 @@ class Executor:
         # device dispatch costs a host↔device sync (~65 ms through the
         # TPU tunnel) that only pays for itself on wide fan-outs.
         self.mesh_min_slices = mesh_min_slices
+        # Materializing bitmap calls engage the device only past this
+        # many leaf rows (config 2's wide-Union form); below it the
+        # per-slice roaring merges win.
+        self.mesh_min_leaves = int(os.environ.get(
+            "PILOSA_TPU_MESH_MIN_LEAVES", "8"))
         self._mesh = None  # lazy: built on first device-batched call
         # Device-fallback observability (a real kernel bug would
         # otherwise silently demote every query to the host path):
@@ -229,7 +234,9 @@ class Executor:
             prev.merge(v)
             return prev
 
-        bm = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn)
+        local_fn = self._bitmap_local_device_fn(index, c, opt)
+        bm = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
+                              local_fn=local_fn)
         if bm is None:
             bm = Bitmap()
         if c.name == "Bitmap":
@@ -406,6 +413,57 @@ class Executor:
         for p in parts[1:]:  # n-ary folds left-to-right, like _fold_slice
             expr = (op, expr, p)
         return expr
+
+    def _bitmap_local_device_fn(self, index: str, c: Call,
+                                opt: ExecOptions):
+        """Materializing Union/Intersect/Difference on device for WIDE
+        fan-outs (BASELINE config 2: Union over 1 K rows): fold the
+        packed leaf slabs in one sharded program (the leaf axis reduces
+        associatively on device), fetch the dense result words, and
+        repack to roaring segments — replacing leaf-count many
+        container-walking merges (roaring.go:1270-1558) with one HBM
+        pass. Narrow calls keep the host path: below ~mesh_min_leaves
+        rows the roaring merges beat the device sync + repack."""
+        if not self.use_mesh or self.pod is not None:
+            return None  # pod host legs own pod materialization
+        if c.name not in ("Union", "Intersect", "Difference"):
+            return None
+        leaves: list[tuple] = []
+        expr = self._compile_device_expr(index, c, leaves)
+        if expr is None or len(leaves) < self.mesh_min_leaves:
+            return None
+
+        def local_fn(slices: list[int]):
+            from .ops import packed
+            # Result + every leaf slab are dense host-side — bound the
+            # TOTAL allocation like the TopN block guard.
+            if (len(slices) * (len(leaves) + 1) * packed.WORDS_PER_SLICE
+                    * 4 > self._TOPN_HOST_BLOCK_BYTES):
+                return NotImplemented
+            mesh = self._mesh_or_none()
+            if mesh is None:
+                return NotImplemented
+            from .parallel import mesh as mesh_mod
+            try:
+                arrs = [self._leaf_device_array(mesh, index, leaf,
+                                                tuple(slices))
+                        for leaf in leaves]
+                words = mesh_mod.materialize_expr_sharded(mesh, expr,
+                                                          arrs)
+            except Exception as e:  # noqa: BLE001 - device trouble
+                self._note_device_fallback("materialize", e)
+                return NotImplemented
+            out = Bitmap()
+            for si, slice in enumerate(slices):
+                w = words[si]
+                if not w.any():
+                    continue
+                data = packed.unpack_to_bitmap(
+                    w, base_word=slice * (packed.WORDS_PER_SLICE))
+                out.add_segment(data, slice, writable=True)
+            return out
+
+        return local_fn
 
     def _count_local_device_fn(self, index: str, child: Call,
                                opt: ExecOptions):

@@ -42,9 +42,17 @@ def _mesh_pallas_mode(mesh: Mesh) -> str | None:
     return pallas_kernels.pallas_mode(mesh.devices.flat[0].platform)
 
 
+# The Pallas kernels hold every leaf's tile in VMEM at once; beyond
+# this the XLA path (which fuses the fold without materializing all
+# leaves) is both safer and faster.
+_PALLAS_MAX_LEAVES = 16
+
+
 def _rows_popcount(expr, leaves, mode):
     """Per-slice-row int32 counts of ``expr`` over ``leaves`` [L, S, W],
     via the fused Pallas kernel when ``mode`` says so, else XLA."""
+    if mode is not None and leaves.shape[0] > _PALLAS_MAX_LEAVES:
+        mode = None
     if mode is not None:
         from ..ops import pallas_kernels
         return pallas_kernels.expr_count_rows_pallas(
@@ -240,6 +248,8 @@ def _topn_exact_sharded_fn(mesh: Mesh, expr, n_leaves: int,
 def _shard_topn_inter(expr, rows, leaves, mode):
     """Per-(slice, row) intersection counts for one shard — the shared
     count body of the TopN programs (Pallas kernel or XLA fusion)."""
+    if mode is not None and leaves.shape[0] > _PALLAS_MAX_LEAVES:
+        mode = None
     if mode is not None:
         from ..ops import pallas_kernels
         return pallas_kernels.topn_block_count_pallas(
@@ -275,14 +285,7 @@ def _topn_filtered_sharded_fn(mesh: Mesh, expr, n_leaves: int,
         leaves = jnp.stack(leaf_shards)  # [L, S/n, W]
         inter = _shard_topn_inter(expr, rows, leaves, mode)   # [S/n, R]
         rowc = _shard_topn_inter(None, rows, leaves[:0], mode)
-        if mode is not None:
-            from ..ops import pallas_kernels
-            srcc = pallas_kernels.expr_count_rows_pallas(
-                expr, leaves, interpret=(mode == "interpret"))
-        else:
-            srcc = jnp.sum(
-                jax.lax.population_count(_eval_expr(expr, leaves))
-                .astype(jnp.int32), axis=-1)
+        srcc = _rows_popcount(expr, leaves, mode)             # [S/n]
         s = srcc[:, None]                                     # [S/n, 1]
         # cnt > srcc·t/100  ∧  cnt < srcc·100/t  ∧  inter > 0
         # ∧  ceil(100·inter / (cnt + srcc − inter)) > t
@@ -342,7 +345,49 @@ def shard_slices_axis1(mesh: Mesh, arr: np.ndarray) -> jax.Array:
     return jax.device_put(arr, NamedSharding(mesh, P(*spec)))
 
 
+def _flatten_fold(expr):
+    """(op, [leaf ids]) when ``expr`` is a pure left fold of one op over
+    leaves — the shape _compile_device_expr builds for n-ary PQL calls.
+    None for mixed trees. Iterative: a 1000-child Union is a 1000-deep
+    left-leaning tuple tree, and recursing it would overflow Python's
+    stack before XLA ever saw it."""
+    op = expr[0]
+    if op == "leaf":
+        return None
+    ids = []
+    node = expr
+    while isinstance(node, tuple) and node[0] == op:
+        if node[2][0] != "leaf":
+            return None
+        ids.append(node[2][1])
+        node = node[1]
+    if node[0] != "leaf":
+        return None
+    ids.append(node[1])
+    ids.reverse()
+    return op, ids
+
+
 def _eval_expr(expr, leaves):
+    flat = _flatten_fold(expr)
+    if (flat is not None and len(flat[1]) >= 3
+            and flat[0] in ("or", "and", "andnot")):
+        # Wide fold → one associative lax.reduce over the leaf axis
+        # instead of a leaf-count-deep op chain. Left-fold Difference
+        # rewrites exactly: ((a∖b)∖c)… = a ∧ ¬(b∨c∨…). (xor and any
+        # other op fall through to the generic chain below.)
+        op, ids = flat
+        sel = leaves if list(ids) == list(range(leaves.shape[0])) \
+            else leaves[jnp.asarray(ids)]
+        if op == "or":
+            return jax.lax.reduce(sel, np.uint32(0),
+                                  jax.lax.bitwise_or, (0,))
+        if op == "and":
+            return jax.lax.reduce(sel, np.uint32(0xFFFFFFFF),
+                                  jax.lax.bitwise_and, (0,))
+        rest = jax.lax.reduce(sel[1:], np.uint32(0),
+                              jax.lax.bitwise_or, (0,))
+        return jnp.bitwise_and(sel[0], jnp.bitwise_not(rest))
     if expr[0] == "leaf":
         return leaves[expr[1]]
     return _BITWISE[expr[0]](_eval_expr(expr[1], leaves),
@@ -375,6 +420,29 @@ def topn_exact_fn(mesh: Mesh, expr):
     feeds these programs process-local shards.
     """
     return _topn_exact_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
+
+
+@functools.lru_cache(maxsize=256)
+def _materialize_fn(mesh: Mesh, expr, n_leaves: int):
+    def per_shard(*leaf_shards):  # each [S/n, W]
+        return _eval_expr(expr, jnp.stack(leaf_shards))
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(AXIS_SLICES),) * n_leaves,
+        out_specs=P(AXIS_SLICES)))
+
+
+def materialize_expr_sharded(mesh: Mesh, expr,
+                             leaf_arrays: list[jax.Array]) -> np.ndarray:
+    """[S, W] dense words of the expression bitmap: one sharded device
+    fold over the leaf slabs (the materializing form of count_expr —
+    BASELINE config 2's Union/Difference over many rows), fetched to
+    host for roaring repack. No psum → no slice-count bound; wide folds
+    reduce associatively on device (_eval_expr's lax.reduce path).
+    """
+    fn = _materialize_fn(mesh, expr, len(leaf_arrays))
+    return np.asarray(fn(*leaf_arrays))
 
 
 # Device-block budget for one topn_exact call (mirrors the 256 MB

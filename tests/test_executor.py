@@ -580,6 +580,74 @@ class TestDeviceTopNPath:
         assert all(p.id == 0 for p in res[0])
 
 
+class TestDeviceMaterializePath:
+    """Materializing Union/Intersect/Difference on device (BASELINE
+    config 2) must agree bit-for-bit with the per-slice roaring path
+    and engage only on wide fan-outs."""
+
+    N_ROWS = 10
+
+    def _fill(self, holder, slices=8):
+        import numpy as np
+        rng = np.random.default_rng(77)
+        f = holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        for row in range(self.N_ROWS):
+            cols = rng.choice(slices * SLICE_WIDTH, size=300,
+                              replace=False)
+            for col in cols:
+                f.set_bit("standard", row, int(col))
+
+    def _wide(self, name, rows=None):
+        rows = rows if rows is not None else range(self.N_ROWS)
+        children = ", ".join(f"Bitmap(rowID={r}, frame=f)" for r in rows)
+        return f"{name}({children})"
+
+    def test_wide_calls_match_host(self, holder):
+        self._fill(holder)
+        fast = Executor(holder, host="local", use_mesh=True,
+                        mesh_min_slices=1)
+        slow = Executor(holder, host="local", use_mesh=False)
+        for q in (self._wide("Union"), self._wide("Intersect"),
+                  self._wide("Difference"),
+                  self._wide("Union", range(0, self.N_ROWS, 2))):
+            f_bits = list(fast.execute("i", q)[0].bits())
+            s_bits = list(slow.execute("i", q)[0].bits())
+            assert f_bits == s_bits, q
+        assert fast.device_fallbacks == 0
+
+    def test_engages_wide_not_narrow(self, holder, monkeypatch):
+        self._fill(holder)
+        ex = Executor(holder, host="local", use_mesh=True,
+                      mesh_min_slices=1)
+        calls = []
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        orig = mesh_mod.materialize_expr_sharded
+
+        def spy(mesh, expr, arrs):
+            calls.append(len(arrs))
+            return orig(mesh, expr, arrs)
+
+        monkeypatch.setattr(mesh_mod, "materialize_expr_sharded", spy)
+        ex.execute("i", self._wide("Union"))
+        assert calls == [self.N_ROWS]
+        ex.execute("i", "Union(Bitmap(rowID=0, frame=f),"
+                        " Bitmap(rowID=1, frame=f))")
+        assert calls == [self.N_ROWS]  # narrow fold stayed host-side
+
+    def test_count_over_wide_union_uses_reduce(self, holder):
+        """The 3+-leaf fold goes through _eval_expr's lax.reduce path —
+        counts must stay exact."""
+        self._fill(holder, slices=4)
+        fast = Executor(holder, host="local", use_mesh=True,
+                        mesh_min_slices=1)
+        slow = Executor(holder, host="local", use_mesh=False)
+        q = f"Count({self._wide('Union')})"
+        assert fast.execute("i", q) == slow.execute("i", q)
+        q = f"Count({self._wide('Difference')})"
+        assert fast.execute("i", q) == slow.execute("i", q)
+
+
 class TestDevicePathFuzz:
     """Randomized parity: device mesh Count/TopN vs the host roaring
     path over random expression trees and bit distributions (the
