@@ -283,6 +283,8 @@ def config2_executor_wide_union() -> None:
                     t0 = time.perf_counter()
                     ex.execute("i", q)
                     lat.append(time.perf_counter() - t0)
+                if use_mesh:  # the device label must measure the device
+                    assert ex.device_fallbacks == 0, "device path fell back"
                 emit(f"c2_executor_{name.lower()}_{n_rows}rows_{label}",
                      sorted(lat)[1] * 1e3, "ms", bits=int(want))
         holder.close()

@@ -376,6 +376,9 @@ class Executor:
     # Largest dense candidate block the TopN mesh path may materialize
     # host-side (slices × candidates × 128 KB); larger sets fall back.
     _TOPN_HOST_BLOCK_BYTES = 2 << 30
+    # HBM bound for one materializing fold: every leaf slab plus the
+    # result are simultaneously live as the program's inputs/output.
+    _MATERIALIZE_DEVICE_BYTES = 4 << 30
 
     def _compile_device_expr(self, index: str, c: Call, leaves: list):
         """Compile a pure bitmap call tree into a mesh.count_expr tree.
@@ -435,10 +438,14 @@ class Executor:
 
         def local_fn(slices: list[int]):
             from .ops import packed
-            # Result + every leaf slab are dense host-side — bound the
-            # TOTAL allocation like the TopN block guard.
-            if (len(slices) * (len(leaves) + 1) * packed.WORDS_PER_SLICE
-                    * 4 > self._TOPN_HOST_BLOCK_BYTES):
+            slab = len(slices) * packed.WORDS_PER_SLICE * 4
+            # Peak HOST allocation is the dense result block plus one
+            # transient leaf slab (slabs pack one at a time before the
+            # device_put); all leaf slabs plus the result are live in
+            # HBM together as inputs/output of the one fold program.
+            if (2 * slab > self._TOPN_HOST_BLOCK_BYTES
+                    or (len(leaves) + 1) * slab
+                    > self._MATERIALIZE_DEVICE_BYTES):
                 return NotImplemented
             mesh = self._mesh_or_none()
             if mesh is None:
