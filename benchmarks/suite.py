@@ -143,13 +143,21 @@ def config3_topn_latency() -> None:
          rows=n_rows, slices=n_slices)
 
     if USE_DEVICE:
+        # Device-resident form — what the executor's residency cache
+        # serves on repeat queries (first-query upload is measured by
+        # config_residency_repeat_latency's first_ms).
         mesh = mesh_mod.make_mesh()
         expr = ("leaf", 0)
-        mesh_mod.topn_exact(mesh, expr, rows, src)  # compile
+        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+        rows_p = mesh_mod.pad_to_multiple(rows, n_dev)
+        d_rows = mesh_mod.shard_slices(mesh, rows_p)
+        d_leaves = [mesh_mod.shard_slices(
+            mesh, mesh_mod.pad_to_multiple(src[0], n_dev))]
+        mesh_mod.topn_exact_sharded(mesh, expr, d_rows, d_leaves)
         lat = []
         for _ in range(5):
             t0 = time.perf_counter()
-            mesh_mod.topn_exact(mesh, expr, rows, src)
+            mesh_mod.topn_exact_sharded(mesh, expr, d_rows, d_leaves)
             lat.append(time.perf_counter() - t0)
         emit("c3_topn_exact_mesh_p50", sorted(lat)[2] * 1e3, "ms",
              rows=n_rows, slices=n_slices)
@@ -202,15 +210,20 @@ def config4_mesh_count_over_slices() -> None:
          slices=n_slices)
 
     if USE_DEVICE:
+        # Device-resident leaf slabs (the executor residency form).
         mesh = mesh_mod.make_mesh()
         expr = ("and", ("leaf", 0), ("leaf", 1))
+        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+        arrs = [mesh_mod.shard_slices(
+            mesh, mesh_mod.pad_to_multiple(leaves[i], n_dev))
+            for i in range(2)]
         for label, mode in _kernel_ab_modes():
             with _pallas_mode_env(mode):
-                mesh_mod.count_expr(mesh, expr, leaves)  # compile
+                mesh_mod.count_expr_sharded(mesh, expr, arrs)  # compile
                 lat = []
                 for _ in range(5):
                     t0 = time.perf_counter()
-                    mesh_mod.count_expr(mesh, expr, leaves)
+                    mesh_mod.count_expr_sharded(mesh, expr, arrs)
                     lat.append(time.perf_counter() - t0)
             dev_s = sorted(lat)[2]
             emit(f"c4_count_intersect_mesh_{label}", 1.0 / dev_s,
@@ -233,13 +246,20 @@ def config5_cluster_topn() -> None:
 
     if USE_DEVICE:
         mesh = mesh_mod.make_mesh()
+        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+        d_rows = mesh_mod.shard_slices(
+            mesh, mesh_mod.pad_to_multiple(rows, n_dev))
+        d_leaves = [mesh_mod.shard_slices(
+            mesh, mesh_mod.pad_to_multiple(src[0], n_dev))]
         for label, mode in _kernel_ab_modes():
             with _pallas_mode_env(mode):
-                mesh_mod.topn_exact(mesh, ("leaf", 0), rows, src)  # compile
+                mesh_mod.topn_exact_sharded(mesh, ("leaf", 0), d_rows,
+                                            d_leaves)  # compile
                 lat = []
                 for _ in range(5):
                     t0 = time.perf_counter()
-                    mesh_mod.topn_exact(mesh, ("leaf", 0), rows, src)
+                    mesh_mod.topn_exact_sharded(mesh, ("leaf", 0),
+                                                d_rows, d_leaves)
                     lat.append(time.perf_counter() - t0)
             emit(f"c5_cluster_topn_mesh_p50_{label}",
                  sorted(lat)[2] * 1e3, "ms", slices=n_slices,

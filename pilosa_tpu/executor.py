@@ -641,48 +641,44 @@ class Executor:
         if expr is None:
             return None
         threshold = max(min_threshold, MIN_THRESHOLD)
-        filtered = threshold > 1 or tanimoto > 0
         if self.pod is not None:
-            if filtered or (field and filters):
-                return None  # pod host legs own the filtered forms
             if not self.pod.is_coordinator or opt.pod_local:
                 return None  # plain local path on pod-internal legs
 
             def pod_fn(slices: list[int]):
+                ids = self._attr_filtered_ids(index, frame_name, row_ids,
+                                              field, filters)
+                if ids is None:
+                    return NotImplemented
+                if not ids:
+                    return []
                 from .ops.packed import WORDS_PER_SLICE
                 # Same host-allocation guard as the single-process path,
                 # per pod process (every process densifies its shard).
                 if (len(slices) < self.mesh_min_slices
-                        or self.pod.max_shard_slices(slices) * len(row_ids)
+                        or self.pod.max_shard_slices(slices) * len(ids)
                         * WORDS_PER_SLICE * 4 > self._TOPN_HOST_BLOCK_BYTES):
                     return NotImplemented
                 try:
-                    counts = self.pod.topn_exact(index, frame_name, expr,
-                                                 leaves, row_ids, slices)
+                    counts = self.pod.topn_exact(
+                        index, frame_name, expr, leaves, ids, slices,
+                        threshold=threshold, tanimoto=tanimoto)
                 except Exception as e:  # noqa: BLE001 - pod host fan-out
                     self._note_device_fallback("pod.topn_exact", e)
                     return NotImplemented  # correct via _pod_host_mapper
                 return [Pair(rid, cnt)
-                        for rid, cnt in zip(row_ids, counts) if cnt > 0]
+                        for rid, cnt in zip(ids, counts) if cnt > 0]
             return pod_fn
 
         def local_fn(slices: list[int]):
             if len(slices) < self.mesh_min_slices:
                 return NotImplemented
-            ids = list(row_ids)
-            if field and filters:
-                # Row attrs are frame-global: pre-filtering candidates
-                # equals the per-slice attr filter (fragment.top).
-                frame = self.holder.frame(index, frame_name)
-                store = frame.row_attr_store if frame else None
-                if store is None:
-                    return NotImplemented
-                fset = set(filters)
-                ids = [rid for rid in ids
-                       if (val := (store.attrs(rid) or {}).get(field))
-                       is not None and val in fset]
-                if not ids:
-                    return []
+            ids = self._attr_filtered_ids(index, frame_name, row_ids,
+                                          field, filters)
+            if ids is None:
+                return NotImplemented
+            if not ids:
+                return []
             from .ops.packed import WORDS_PER_SLICE
             # Host-allocation guard: huge candidate sets stay on the
             # per-slice path, which never materializes a dense block.
@@ -696,8 +692,6 @@ class Executor:
             resident_ok = (len(slices) <= mesh_mod.slice_chunk_bound(
                 mesh.shape[mesh_mod.AXIS_SLICES])
                 and block_bytes <= mesh_mod.TOPN_BLOCK_BYTES)
-            if filtered and not resident_ok:
-                return NotImplemented  # no streaming filtered kernel
             try:
                 if resident_ok:
                     counts = self._topn_exact_resident(
@@ -708,7 +702,8 @@ class Executor:
                         mesh, expr,
                         self._pack_candidate_rows(index, frame_name,
                                                   ids, slices),
-                        self._pack_leaf_block(index, leaves, slices))
+                        self._pack_leaf_block(index, leaves, slices),
+                        threshold=threshold, tanimoto=tanimoto)
             except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
                 self._note_device_fallback("topn_exact", e)
                 return NotImplemented
@@ -716,6 +711,22 @@ class Executor:
                     for rid, cnt in zip(ids, counts) if cnt > 0]
 
         return local_fn
+
+    def _attr_filtered_ids(self, index: str, frame_name: str,
+                           row_ids, field, filters) -> Optional[list[int]]:
+        """Candidate ids surviving the attribute filter. Row attrs are
+        frame-global, so pre-filtering equals the per-slice filter
+        (fragment.top). None = no attr store (caller falls back)."""
+        if not (field and filters):
+            return list(row_ids)
+        frame = self.holder.frame(index, frame_name)
+        store = frame.row_attr_store if frame else None
+        if store is None:
+            return None
+        fset = set(filters)
+        return [rid for rid in row_ids
+                if (val := (store.attrs(rid) or {}).get(field))
+                is not None and val in fset]
 
     def _pack_candidate_rows(self, index: str, frame_name: str,
                              row_ids: list[int],

@@ -270,6 +270,26 @@ def _psum_hi_lo_rows(per_slice):
     return hi, lo
 
 
+def _filtered_counts(expr, rows, leaves, threshold, tanimoto, mode):
+    """[S/n, R] intersection counts with the reference's per-slice
+    threshold/Tanimoto pruning applied (fragment.go:560-614 — a slice's
+    contribution drops when that slice's row count or intersection
+    count fails the bar; exact integer forms of the float comparisons)."""
+    inter = _shard_topn_inter(expr, rows, leaves, mode)   # [S/n, R]
+    rowc = _shard_topn_inter(None, rows, leaves[:0], mode)
+    srcc = _rows_popcount(expr, leaves, mode)             # [S/n]
+    s = srcc[:, None]                                     # [S/n, 1]
+    # cnt > srcc·t/100  ∧  cnt < srcc·100/t  ∧  inter > 0
+    # ∧  ceil(100·inter / (cnt + srcc − inter)) > t
+    keep_tan = ((100 * rowc > s * tanimoto)
+                & (rowc * tanimoto < s * 100)
+                & (inter > 0)
+                & (100 * inter > tanimoto * (rowc + s - inter)))
+    keep_thr = (rowc >= threshold) & (inter >= threshold)
+    keep = jnp.where(tanimoto > 0, keep_tan, keep_thr)
+    return jnp.where(keep, inter, 0)
+
+
 @functools.lru_cache(maxsize=256)
 def _topn_filtered_sharded_fn(mesh: Mesh, expr, n_leaves: int,
                               mode: str | None):
@@ -282,20 +302,9 @@ def _topn_filtered_sharded_fn(mesh: Mesh, expr, n_leaves: int,
     scalars — one compiled program per (mesh, expr)."""
 
     def per_shard(threshold, tanimoto, rows, *leaf_shards):
-        leaves = jnp.stack(leaf_shards)  # [L, S/n, W]
-        inter = _shard_topn_inter(expr, rows, leaves, mode)   # [S/n, R]
-        rowc = _shard_topn_inter(None, rows, leaves[:0], mode)
-        srcc = _rows_popcount(expr, leaves, mode)             # [S/n]
-        s = srcc[:, None]                                     # [S/n, 1]
-        # cnt > srcc·t/100  ∧  cnt < srcc·100/t  ∧  inter > 0
-        # ∧  ceil(100·inter / (cnt + srcc − inter)) > t
-        keep_tan = ((100 * rowc > s * tanimoto)
-                    & (rowc * tanimoto < s * 100)
-                    & (inter > 0)
-                    & (100 * inter > tanimoto * (rowc + s - inter)))
-        keep_thr = (rowc >= threshold) & (inter >= threshold)
-        keep = jnp.where(tanimoto > 0, keep_tan, keep_thr)
-        return _psum_hi_lo_rows(jnp.where(keep, inter, 0))
+        return _psum_hi_lo_rows(_filtered_counts(
+            expr, rows, jnp.stack(leaf_shards), threshold, tanimoto,
+            mode))
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
@@ -315,6 +324,7 @@ def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
                          " int32 hi/lo bound")
     fn = _topn_filtered_sharded_fn(mesh, expr, len(leaf_arrays),
                                    _mesh_pallas_mode(mesh))
+    threshold = min(threshold, 2**31 - 1)  # counts never exceed 2^31
     hi, lo = fn(jnp.int32(threshold), jnp.int32(tanimoto),
                 rows, *leaf_arrays)
     hi, lo = np.asarray(hi), np.asarray(lo)
@@ -406,6 +416,26 @@ def _topn_exact_fn_cached(mesh: Mesh, expr, mode: str | None):
         out_specs=(P(), P()), check_vma=(mode is None)))
 
 
+@functools.lru_cache(maxsize=256)
+def _topn_filtered_fn_cached(mesh: Mesh, expr, mode: str | None):
+    def per_shard(threshold, tanimoto, rows, leaves):
+        return _psum_hi_lo_rows(_filtered_counts(
+            expr, rows, leaves, threshold, tanimoto, mode))
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P(), P(AXIS_SLICES), P(None, AXIS_SLICES)),
+        out_specs=(P(), P()), check_vma=(mode is None)))
+
+
+def topn_filtered_fn(mesh: Mesh, expr):
+    """The streaming-layout filtered TopN program: ``(threshold,
+    tanimoto, rows [S, R, W], leaves [L, S, W]) → per-row (hi, lo)``,
+    with per-slice threshold/Tanimoto pruning before the psum. Public
+    for the pod layer (parallel.multihost), like topn_exact_fn."""
+    return _topn_filtered_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
+
+
 def topn_exact_fn(mesh: Mesh, expr):
     """Exact candidate counts across slices, one psum-reduced program.
 
@@ -451,16 +481,27 @@ TOPN_BLOCK_BYTES = 256 << 20
 
 
 def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
-               leaves: np.ndarray | None) -> list[int]:
+               leaves: np.ndarray | None, threshold: int = 1,
+               tanimoto: int = 0) -> list[int]:
     """[R] exact counts of each candidate row against ``expr`` (or the
     rows' own popcounts when expr is None), summed over all slices.
+    threshold>1 / tanimoto engage the per-slice pruning program.
 
-    Chunks both axes: slices at 2^15 (the int32 hi/lo bound) and
-    candidate rows by the device-block byte budget — counts are
-    independent per row and additive per slice, so any tiling is exact.
+    Chunks both axes: slices at the int32 hi/lo bound and candidate
+    rows by the device-block byte budget — counts are independent per
+    row, additive per slice, and the pruning masks are per-slice, so
+    any tiling is exact.
     """
     n_dev = mesh.shape[AXIS_SLICES]
-    fn = topn_exact_fn(mesh, expr)
+    filtered = threshold > 1 or tanimoto > 0
+    if filtered:
+        # Counts never exceed 2^31, so clamping is semantically exact
+        # (and jnp.int32 would raise on larger Python ints).
+        threshold = min(threshold, 2**31 - 1)
+        fn = functools.partial(topn_filtered_fn(mesh, expr),
+                               jnp.int32(threshold), jnp.int32(tanimoto))
+    else:
+        fn = topn_exact_fn(mesh, expr)
     n_slices, n_rows, n_words = rows.shape
     slice_chunk = min(slice_chunk_bound(n_dev), n_slices) or 1
     row_chunk = max(1, TOPN_BLOCK_BYTES // (slice_chunk * n_words * 4))

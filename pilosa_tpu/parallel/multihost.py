@@ -175,16 +175,30 @@ def count_expr(mesh: Mesh, expr: tuple, local_leaves: np.ndarray) -> int:
 
 
 def topn_exact(mesh: Mesh, expr, local_rows: np.ndarray,
-               local_leaves: Optional[np.ndarray]) -> list[int]:
+               local_leaves: Optional[np.ndarray], threshold: int = 1,
+               tanimoto: int = 0) -> list[int]:
     """Pod-wide TopN exact counts: local shards in, global counts out.
+    threshold>1 / tanimoto engage the per-slice pruning program
+    (mesh.topn_filtered_fn) — masks are per-slice, so shard-local
+    evaluation composes exactly.
 
     Chunks slices (int32 bound) and candidate rows (device-block byte
     budget, mirroring mesh.topn_exact) with pod-wide identical bounds.
     """
+    import functools
+
+    import jax.numpy as jnp
     n_local, n_rows, n_words = local_rows.shape
-    _assert_uniform_shards(n_local, n_rows, n_words)
+    _assert_uniform_shards(n_local, n_rows, n_words, threshold, tanimoto)
     if local_leaves is None:
         local_leaves = np.zeros((0, n_local, 1), dtype=np.uint32)
+    filtered = threshold > 1 or tanimoto > 0
+    if filtered:
+        threshold = min(threshold, 2**31 - 1)  # counts never exceed 2^31
+        fn = functools.partial(mesh_mod.topn_filtered_fn(mesh, expr),
+                               jnp.int32(threshold), jnp.int32(tanimoto))
+    else:
+        fn = mesh_mod.topn_exact_fn(mesh, expr)
     s_step = _local_chunk()
     r_step = max(1, mesh_mod.TOPN_BLOCK_BYTES
                  // (max(s_step, 1) * n_words * 4))
@@ -196,7 +210,7 @@ def topn_exact(mesh: Mesh, expr, local_rows: np.ndarray,
             lc = _pad_local(local_leaves[:, s_off:s_off + s_step], 1)
             rows = _global_from_local(mesh, rc, 0)
             leaves = _global_from_local(mesh, lc, 1)
-            hi, lo = mesh_mod.topn_exact_fn(mesh, expr)(rows, leaves)
+            hi, lo = fn(rows, leaves)
             hi, lo = np.asarray(hi), np.asarray(lo)
             for r in range(rc.shape[1]):
                 totals[r_off + r] += (int(hi[r]) << 16) + int(lo[r])

@@ -181,7 +181,9 @@ class Pod:
                                        item["row_ids"], local)
                 lblock = self._pack_leaves(index, leaves, local)
                 return {"counts": multihost.topn_exact(
-                    mesh, expr, rows, lblock)}
+                    mesh, expr, rows, lblock,
+                    threshold=int(item.get("threshold", 1)),
+                    tanimoto=int(item.get("tanimoto", 0)))}
             raise PodError(f"unknown pod work item kind: {kind}")
 
     # -- coordinator dispatch ------------------------------------------------
@@ -300,13 +302,15 @@ class Pod:
             "slices": sorted(slices)})["total"]
 
     def topn_exact(self, index: str, frame: str, expr, leaves: list[tuple],
-                   row_ids: list[int], slices: list[int]) -> list[int]:
+                   row_ids: list[int], slices: list[int],
+                   threshold: int = 1, tanimoto: int = 0) -> list[int]:
         if not slices or not row_ids:
             return [0] * len(row_ids)
         return self._dispatch({
             "kind": "topn_exact", "index": index, "frame": frame,
             "expr": expr, "leaves": [list(leaf) for leaf in leaves],
             "row_ids": [int(r) for r in row_ids],
+            "threshold": int(threshold), "tanimoto": int(tanimoto),
             "slices": sorted(slices)})["counts"]
 
     # -- pod-internal forwarding helpers -------------------------------------

@@ -93,6 +93,13 @@ def main() -> None:
     got = [(p["id"], p["count"]) for p in pairs[0]]
     assert got == [(1, 8), (3, 4)], got
 
+    # Filtered exact phase on the pod collective: per-slice threshold 2
+    # drops row 3 (1 bit ∩ src per slice) but keeps row 1 (2 per slice).
+    pairs = query(coord, "i", "TopN(Bitmap(frame=f, rowID=2), frame=f,"
+                              " ids=[1, 3], threshold=2)")
+    got = [(p["id"], p["count"]) for p in pairs[0]]
+    assert got == [(1, 8)], got
+
     # Pod executions really did run: the coordinator's executor must not
     # have fallen back to the (coordinator-only) host path silently.
     assert srv.executor.device_fallbacks == 0, srv.executor.device_fallbacks

@@ -544,6 +544,29 @@ class TestDeviceTopNPath:
                 [(p.id, p.count) for p in s_res[0]], q
         assert fast.device_fallbacks == 0
 
+    def test_topn_filtered_streaming_matches_host(self, holder,
+                                                  monkeypatch):
+        """Filtered forms past the resident block budget must stream
+        through the chunked filtered program, staying exact."""
+        self._fill(holder, slices=8)
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        # Shrink the device-block budget so the 8-slice candidate block
+        # exceeds it → the executor takes the streaming branch, and the
+        # stream itself row-chunks.
+        monkeypatch.setattr(mesh_mod, "TOPN_BLOCK_BYTES", 1 << 20)
+        fast = Executor(holder, host="local", use_mesh=True,
+                        mesh_min_slices=1)
+        slow = Executor(holder, host="local", use_mesh=False)
+        src = "Bitmap(rowID=0, frame=f)"
+        for q in (f'TopN({src}, frame=f, ids=[0,1,2,3,4,5], threshold=2)',
+                  f'TopN({src}, frame=f, ids=[0,1,2,3,4,5],'
+                  ' tanimotoThreshold=20)'):
+            f_res = fast.execute("i", q)
+            s_res = slow.execute("i", q)
+            assert [(p.id, p.count) for p in f_res[0]] == \
+                [(p.id, p.count) for p in s_res[0]], q
+        assert fast.device_fallbacks == 0
+
     def test_exact_phase_engages(self, holder, monkeypatch):
         self._fill(holder)
         ex = Executor(holder, host="local", use_mesh=True,

@@ -55,6 +55,22 @@ class TestSingleProcessForms:
         want = [_popcount(rows[:, r, :] & src[0]) for r in range(R)]
         assert got == want
 
+    def test_topn_filtered_matches_single_host_path(self):
+        rng = np.random.default_rng(2)
+        mesh = multihost.pod_mesh()
+        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+        S, R, W = n_dev * 2, 5, 128
+        rows = rng.integers(0, 2**32, size=(S, R, W), dtype=np.uint32)
+        src = rng.integers(0, 2**32, size=(1, S, W), dtype=np.uint32)
+        for threshold, tanimoto in ((3, 0), (W * 16, 0), (1, 40)):
+            got = multihost.topn_exact(mesh, ("leaf", 0), rows, src,
+                                       threshold=threshold,
+                                       tanimoto=tanimoto)
+            assert got == mesh_mod.topn_exact(
+                mesh, ("leaf", 0), rows, src,
+                threshold=threshold, tanimoto=tanimoto), \
+                (threshold, tanimoto)
+
 
 class TestDistributedBootstrap:
     def test_one_process_pod_in_subprocess(self):
