@@ -38,12 +38,6 @@ OPS = tuple(_BITWISE)
 
 
 @functools.partial(jax.jit, static_argnums=0)
-def set_op(op: str, a: jax.Array, b: jax.Array) -> jax.Array:
-    """Materializing bitwise set op over packed words."""
-    return _BITWISE[op](a, b)
-
-
-@functools.partial(jax.jit, static_argnums=0)
 def op_count_rows(op: str, a: jax.Array, b: jax.Array) -> jax.Array:
     """Fused ``popcount(a ⊕ b)`` summed over the word axis → int32 per row."""
     words = _BITWISE[op](a, b)
@@ -96,12 +90,6 @@ def row_block_op_count(op: str, rows: jax.Array, other: jax.Array
     return jnp.sum(jax.lax.population_count(words).astype(jnp.int32), axis=-1)
 
 
-@functools.partial(jax.jit, static_argnums=1)
-def top_k_rows(counts: jax.Array, k: int):
-    """(values, row_indices) of the k largest per-row counts."""
-    return jax.lax.top_k(counts, k)
-
-
 def op_count(op: str, a: jax.Array, b: jax.Array) -> jax.Array:
     """Fused count, auto-selecting the Pallas kernel on TPU (interpret
     mode when forced via PILOSA_TPU_PALLAS=interpret for CPU tests)."""
@@ -112,8 +100,3 @@ def op_count(op: str, a: jax.Array, b: jax.Array) -> jax.Array:
             op, a, b, interpret=(mode == "interpret"))
     return op_count_rows(op, a, b)
 
-
-@jax.jit
-def union_rows(rows: jax.Array) -> jax.Array:
-    """OR-fold a row block → one row (Union of many rows on device)."""
-    return jax.lax.reduce(rows, jnp.uint32(0), jax.lax.bitwise_or, (0,))

@@ -1,0 +1,143 @@
+"""Unit tests for parallel.pod's placement and failure semantics —
+the parts the 2-process end-to-end test (test_pod.py) can't easily
+exercise: partial-broadcast poisoning, divergence detection, and the
+max-shard padding for unbalanced slice lists. Pod instances are built
+without jax.distributed by stubbing process identity.
+"""
+
+import threading
+
+import pytest
+
+from pilosa_tpu.errors import PilosaError
+from pilosa_tpu.parallel import pod as pod_mod
+
+
+def make_pod(pid=0, n=2, peers=None, holder=None):
+    p = pod_mod.Pod.__new__(pod_mod.Pod)
+    p.holder = holder
+    p.pid = pid
+    p.n_procs = n
+    p.peers = peers or [f"h{i}:1" for i in range(n)]
+    p.timeout = 1.0
+    p._run_mu = threading.Lock()
+    p._dispatch_mu = threading.Lock()
+    p._poisoned = False
+    p._conns = {}
+    p._conn_mus = {i: threading.Lock() for i in range(n)}
+    return p
+
+
+class TestPlacement:
+    def test_owner_round_robin(self):
+        p = make_pod(n=3)
+        assert [p.owner_pid(s) for s in range(6)] == [0, 1, 2, 0, 1, 2]
+
+    def test_owned_filters_and_sorts(self):
+        p = make_pod(pid=1, n=2)
+        assert p.owned([5, 3, 0, 1, 7]) == [1, 3, 5, 7]
+
+    def test_max_shard_balances_unbalanced_lists(self):
+        p = make_pod(n=2)
+        # [1,3,5,7] all land on pid 1 — shard length must cover it.
+        assert p.max_shard_slices([1, 3, 5, 7]) == 4
+        assert p._local_slices([1, 3, 5, 7]) == [-1, -1, -1, -1]
+        p1 = make_pod(pid=1, n=2)
+        assert p1._local_slices([1, 3, 5, 7]) == [1, 3, 5, 7]
+        # Mixed list: pid0 owns 2, pid1 owns 1 → both pad to 2.
+        assert p.max_shard_slices([0, 2, 3]) == 2
+        assert p._local_slices([0, 2, 3]) == [0, 2]
+        assert p1._local_slices([0, 2, 3]) == [3, -1]
+
+    def test_empty(self):
+        p = make_pod()
+        assert p.max_shard_slices([]) == 0
+
+
+class TestDispatchFailureSemantics:
+    def test_unreachable_worker_before_any_delivery_not_poisoned(self):
+        """No worker got the item → nothing entered a collective →
+        retrying later is safe (not poisoned)."""
+        p = make_pod(n=2)
+
+        def never_delivers(pid, method, path, body, ctype, sent=None):
+            raise OSError("connection refused")
+
+        p._request = never_delivers
+        with pytest.raises(PilosaError, match="not reachable"):
+            p._dispatch({"kind": "count_expr", "index": "i", "expr": [],
+                         "leaves": [], "slices": [0, 1]})
+        assert not p._poisoned
+        with pytest.raises(PilosaError, match="not reachable"):
+            p._dispatch({"kind": "count_expr", "index": "i", "expr": [],
+                         "leaves": [], "slices": [0, 1]})
+
+    def test_partial_delivery_poisons(self):
+        """One worker got the item, another didn't → the delivered one
+        is parked in an orphaned collective; the device path must shut
+        off for the pod's lifetime."""
+        p = make_pod(n=3)
+
+        def one_delivers(pid, method, path, body, ctype, sent=None):
+            if pid == 1:
+                if sent is not None:
+                    sent.set()
+                return b'{"total": 0}'
+            raise OSError("connection refused")
+
+        p._request = one_delivers
+        with pytest.raises(PilosaError, match="disabled"):
+            p._dispatch({"kind": "count_expr", "index": "i", "expr": [],
+                         "leaves": [], "slices": [0, 1]})
+        assert p._poisoned
+        with pytest.raises(PilosaError, match="disabled"):
+            p._dispatch({"kind": "count_expr", "index": "i", "expr": [],
+                         "leaves": [], "slices": [0, 1]})
+
+    def test_collective_failure_poisons(self):
+        p = make_pod(n=2)
+
+        def delivers(pid, method, path, body, ctype, sent=None):
+            if sent is not None:
+                sent.set()
+            return b'{"total": 7}'
+
+        p._request = delivers
+
+        def boom(item):
+            raise RuntimeError("gloo timeout")
+
+        p.run_item = boom
+        with pytest.raises(RuntimeError, match="gloo timeout"):
+            p._dispatch({"kind": "count_expr", "index": "i", "expr": [],
+                         "leaves": [], "slices": [0]})
+        assert p._poisoned
+
+    def test_divergent_worker_result_raises(self):
+        p = make_pod(n=2)
+
+        def delivers(pid, method, path, body, ctype, sent=None):
+            if sent is not None:
+                sent.set()
+            return b'{"total": 999}'
+
+        p._request = delivers
+        p.run_item = lambda item: {"total": 7}
+        with pytest.raises(PilosaError, match="divergence"):
+            p._dispatch({"kind": "count_expr", "index": "i", "expr": [],
+                         "leaves": [], "slices": [0]})
+
+    def test_agreeing_results_succeed(self):
+        p = make_pod(n=2)
+
+        def delivers(pid, method, path, body, ctype, sent=None):
+            if sent is not None:
+                sent.set()
+            return b'{"total": 7}'
+
+        p._request = delivers
+        p.run_item = lambda item: {"total": 7}
+        out = p._dispatch({"kind": "count_expr", "index": "i", "expr": [],
+                           "leaves": [], "slices": [0]})
+        assert out == {"total": 7}
+        assert not p._poisoned
