@@ -65,9 +65,16 @@ class Pod:
 
     def __init__(self, holder, peers: list[str]):
         import jax
+        self._init_state(holder, jax.process_index(),
+                         jax.process_count(), peers)
+
+    def _init_state(self, holder, pid: int, n_procs: int,
+                    peers: list[str]) -> None:
+        """All non-jax state — shared by __init__ and unit tests that
+        build Pods without a jax.distributed job."""
         self.holder = holder
-        self.pid = jax.process_index()
-        self.n_procs = jax.process_count()
+        self.pid = pid
+        self.n_procs = n_procs
         if len(peers) != self.n_procs:
             raise PodError(
                 f"{ENV_PEERS} lists {len(peers)} hosts for"

@@ -271,6 +271,18 @@ class Handler:
     def _handle_expvar(self, req: Request) -> Response:
         snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") \
             else {}
+        # Device-path observability: HBM residency cache + fallback
+        # counters (reference exposes runtime internals the same way
+        # via expvar, handler.go:1287-1300).
+        from ..parallel import residency
+        snap = dict(snap)
+        snap["deviceBlockCache"] = residency.device_cache().snapshot()
+        fallbacks = getattr(self.executor, "device_fallbacks", None)
+        if fallbacks is not None:
+            # Authoritative value for the stats pipeline's
+            # "deviceFallback" counter (executor._note_device_fallback)
+            # — one name, one source.
+            snap["deviceFallback"] = fallbacks
         return Response.json(snap)
 
     # -- profiling (reference handler.go:30,99 mounts net/http/pprof) --------

@@ -311,3 +311,14 @@ class TestFragmentEndpoints:
                                json.dumps({"blocks": []}).encode())
         assert status == 200
         assert json.loads(body)["attrs"] == {"5": {"x": 1}}
+
+
+class TestExpvar:
+    def test_device_observability_counters(self, handler):
+        status, _, body = call(handler, "GET", "/debug/vars")
+        assert status == 200
+        snap = json.loads(body)
+        cache = snap["deviceBlockCache"]
+        assert {"entries", "usedBytes", "budgetBytes", "hits",
+                "misses", "evictions"} <= set(cache)
+        assert snap["deviceFallback"] == 0

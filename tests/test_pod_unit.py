@@ -2,10 +2,8 @@
 the parts the 2-process end-to-end test (test_pod.py) can't easily
 exercise: partial-broadcast poisoning, divergence detection, and the
 max-shard padding for unbalanced slice lists. Pod instances are built
-without jax.distributed by stubbing process identity.
+without jax.distributed via Pod._init_state.
 """
-
-import threading
 
 import pytest
 
@@ -15,16 +13,9 @@ from pilosa_tpu.parallel import pod as pod_mod
 
 def make_pod(pid=0, n=2, peers=None, holder=None):
     p = pod_mod.Pod.__new__(pod_mod.Pod)
-    p.holder = holder
-    p.pid = pid
-    p.n_procs = n
-    p.peers = peers or [f"h{i}:1" for i in range(n)]
+    p._init_state(holder, pid, n,
+                  peers or [f"h{i}:1" for i in range(n)])
     p.timeout = 1.0
-    p._run_mu = threading.Lock()
-    p._dispatch_mu = threading.Lock()
-    p._poisoned = False
-    p._conns = {}
-    p._conn_mus = {i: threading.Lock() for i in range(n)}
     return p
 
 
