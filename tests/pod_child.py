@@ -10,29 +10,18 @@ pod-wide results, mirroring the reference's whole-process cluster tests
 Usage: python pod_child.py <proc_id> <data_dir>
 """
 
-import json
 import os
 import sys
 import time
-import urllib.request
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
 
-from pilosa_tpu import SLICE_WIDTH
-from pilosa_tpu.server.server import Server
+from podenv import child_main, http, query  # noqa: E402
 
-
-def http(method, host, path, body=b"", content_type="application/json"):
-    req = urllib.request.Request(
-        f"http://{host}{path}", data=body, method=method,
-        headers={"Content-Type": content_type})
-    with urllib.request.urlopen(req, timeout=120) as resp:
-        return resp.read()
-
-
-def query(host, index, pql):
-    raw = http("POST", host, f"/index/{index}/query", pql.encode())
-    return json.loads(raw)["results"]
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.server.server import Server  # noqa: E402
 
 
 def main() -> None:
@@ -109,14 +98,4 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    # Hard-exit either way: jax.distributed's atexit shutdown can hang
-    # waiting on peers, and the launcher only watches our rc/stdout.
-    try:
-        main()
-    except BaseException:
-        import traceback
-        traceback.print_exc()
-        sys.stdout.flush()
-        sys.stderr.flush()
-        os._exit(1)
-    os._exit(0)
+    child_main(main)

@@ -1,0 +1,109 @@
+"""Child process for the cluster-of-pods test (tests/test_pod_cluster.py).
+
+Three processes, two cluster nodes: a plain node A and a 2-process pod
+whose coordinator B0 is the second cluster node (worker B1 serves only
+pod-internal legs). Node A is the test driver: it writes bits through
+the full cluster routing (jump-hash owner → HTTP remote leg → pod slice
+routing) and checks pod-wide + cluster-wide Count/TopN results.
+
+Usage: python pod_cluster_child.py <role: a|b0|b1> <data_dir>
+Env: POD_CLUSTER_A, POD_CLUSTER_B0 — the two cluster hosts; pod procs
+additionally carry the PILOSA_TPU_DIST_* / POD_PEERS contract.
+"""
+
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+from podenv import child_main, http, query, wait_up  # noqa: E402
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.cluster.broadcast import StaticNodeSet  # noqa: E402
+from pilosa_tpu.cluster.topology import Cluster, Node  # noqa: E402
+from pilosa_tpu.server.server import Server  # noqa: E402
+
+
+def main() -> None:
+    role = sys.argv[1]
+    data_dir = sys.argv[2]
+    host_a = os.environ["POD_CLUSTER_A"]
+    host_b0 = os.environ["POD_CLUSTER_B0"]
+
+    my_host = {"a": host_a, "b0": host_b0}.get(role)
+    if role == "b1":
+        my_host = os.environ["PILOSA_TPU_POD_PEERS"].split(",")[1]
+
+    if role == "b1":
+        cluster = None  # single-node self cluster (not a cluster member)
+    else:
+        nodes = [Node(host_a), Node(host_b0)]
+        cluster = Cluster(nodes=nodes, node_set=StaticNodeSet(nodes))
+
+    # Max-slice knowledge crosses cluster nodes via the poll loop
+    # (server.go:216-252 equivalent) — keep it fast for the test.
+    srv = Server(data_dir, host=my_host, cluster=cluster,
+                 anti_entropy_interval=0,
+                 polling_interval=0 if role == "b1" else 0.3)
+    srv.open()
+    print(f"{role} serving on {srv.host}", flush=True)
+
+    if role != "a":
+        while True:
+            time.sleep(0.5)
+
+    # --- node A drives the test ------------------------------------------
+    wait_up(host_b0)
+    # Static cluster: create the schema on both cluster nodes (B0's pod
+    # broadcaster replicates it to B1).
+    for h in (host_a, host_b0):
+        http("POST", h, "/index/i", b"{}")
+        http("POST", h, "/index/i/frame/f", b"{}")
+
+    # Bits across 6 slices, routed by jump hash to A or the pod, and
+    # inside the pod by slice % 2 — all through ONE client entry point.
+    for s in range(6):
+        for j in range(3):
+            query(host_a, "i", f"SetBit(frame=f, rowID=1,"
+                               f" columnID={s * SLICE_WIDTH + j})")
+        for j in range(2):
+            query(host_a, "i", f"SetBit(frame=f, rowID=2,"
+                               f" columnID={s * SLICE_WIDTH + j})")
+
+    # Wait for A to adopt the pod's max slice through the poll loop.
+    deadline = time.time() + 30
+    while time.time() - deadline < 0:
+        if query(host_a, "i", "Count(Bitmap(frame=f, rowID=1))")[0] == 18:
+            break
+        time.sleep(0.3)
+
+    got = query(host_a, "i", "Count(Bitmap(frame=f, rowID=1))")[0]
+    assert got == 18, f"Count(row1): {got} != 18"
+    got = query(host_a, "i", "Count(Intersect(Bitmap(frame=f, rowID=1),"
+                             " Bitmap(frame=f, rowID=2)))")[0]
+    assert got == 12, f"Count(Intersect): {got} != 12"
+
+    # Cluster-wide TopN: candidate phase per node (pod host legs on B),
+    # exact phase per node (pod collective on B), merged at A.
+    pairs = query(host_a, "i", "TopN(frame=f, n=2)")
+    got = [(p["id"], p["count"]) for p in pairs[0]]
+    assert got == [(1, 18), (2, 12)], got
+    pairs = query(host_a, "i",
+                  "TopN(Bitmap(frame=f, rowID=1), frame=f, ids=[1, 2])")
+    got = [(p["id"], p["count"]) for p in pairs[0]]
+    assert got == [(1, 18), (2, 12)], got
+
+    # Bits materialize across both cluster nodes and the pod.
+    bits = query(host_a, "i", "Bitmap(frame=f, rowID=2)")[0]["bits"]
+    want = sorted(s * SLICE_WIDTH + j for s in range(6) for j in range(2))
+    assert bits == want, bits[:8]
+
+    print("POD_CLUSTER_OK", flush=True)
+    srv.close()
+
+
+if __name__ == "__main__":
+    child_main(main)

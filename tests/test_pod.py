@@ -11,71 +11,33 @@ Style mirror: the reference's multi-process cluster tests
 """
 
 import os
-import socket
-import subprocess
 import sys
 
-import pytest
+from podenv import ChildSet, free_port, pod_env
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
-
-
-def _child_env(proc_id: int, jax_port: int, peers: list[str]) -> dict:
-    env = dict(os.environ)
-    # The axon sitecustomize hook registers the TPU plugin at interpreter
-    # start when this var is set — drop it so the children get stock
-    # CPU JAX (same trick as __graft_entry__._cpu_mesh_env).
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = " ".join(
-        f for f in env.get("XLA_FLAGS", "").split()
-        if not f.startswith("--xla_force_host_platform_device_count"))
-    env.update({
-        "PILOSA_TPU_DIST_COORDINATOR": f"localhost:{jax_port}",
-        "PILOSA_TPU_DIST_NUM_PROCS": "2",
-        "PILOSA_TPU_DIST_PROC_ID": str(proc_id),
-        "PILOSA_TPU_DIST_CPU_DEVICES": "2",
-        "PILOSA_TPU_POD_PEERS": ",".join(peers),
-        "PILOSA_TPU_MESH_MIN_SLICES": "1",
-    })
-    return env
-
-
 def test_pod_two_process_count_topn(tmp_path):
-    jax_port = _free_port()
-    peers = [f"localhost:{_free_port()}", f"localhost:{_free_port()}"]
+    jax_port = free_port()
+    peers = [f"localhost:{free_port()}", f"localhost:{free_port()}"]
     script = os.path.join(_HERE, "pod_child.py")
 
-    procs = []
-    worker_log = tmp_path / "worker.log"
+    children = ChildSet(tmp_path)
     try:
         for pid in range(2):
             data_dir = tmp_path / f"node{pid}"
             data_dir.mkdir()
-            if pid == 0:
-                stdout, stderr = subprocess.PIPE, subprocess.PIPE
-            else:
-                # A file, not a PIPE: nothing drains the long-lived
-                # worker, and a full pipe buffer would wedge it.
-                stdout = stderr = open(worker_log, "w")
-            procs.append(subprocess.Popen(
+            children.spawn(
+                f"worker{pid}",
                 [sys.executable, script, str(pid), str(data_dir)],
-                env=_child_env(pid, jax_port, peers),
-                stdout=stdout, stderr=stderr, text=True))
-        out, err = procs[0].communicate(timeout=240)
-        assert procs[0].returncode == 0, (
-            f"coordinator failed rc={procs[0].returncode}\n"
+                pod_env(pid, jax_port, peers), pipe=(pid == 0))
+        out, err = children.procs["worker0"].communicate(timeout=240)
+        assert children.procs["worker0"].returncode == 0, (
+            f"coordinator failed"
+            f" rc={children.procs['worker0'].returncode}\n"
             f"stdout:\n{out}\nstderr:\n{err[-4000:]}\n"
-            f"worker:\n{worker_log.read_text()[-2000:]}")
+            f"{children.logs_tail()}")
         assert "POD_TEST_OK" in out, out
     finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.wait()
+        children.cleanup()

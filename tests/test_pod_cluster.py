@@ -1,0 +1,50 @@
+"""Cluster-of-pods end-to-end: a plain node + a 2-process CPU pod as
+the two cluster nodes (BASELINE config 5's shape, single-host form).
+
+Every query enters through the plain node: cluster map-reduce forwards
+the pod's slices to the coordinator over HTTP, which serves them
+pod-wide (collectives for Count/TopN exact, podLocal legs for
+materialization) — the full three-process composition of
+executor map-reduce × pod broadcast.
+"""
+
+import os
+import sys
+
+from podenv import ChildSet, cpu_env, free_port, pod_env
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def test_cluster_of_plain_node_and_pod(tmp_path):
+    jax_port = free_port()
+    host_a = f"localhost:{free_port()}"
+    pod_peers = [f"localhost:{free_port()}", f"localhost:{free_port()}"]
+    script = os.path.join(_HERE, "pod_cluster_child.py")
+
+    def env_for(role):
+        if role == "a":
+            env = cpu_env()
+            env["PILOSA_TPU_MESH"] = "0"  # plain host-path node
+        else:
+            env = pod_env(0 if role == "b0" else 1, jax_port, pod_peers)
+        env["POD_CLUSTER_A"] = host_a
+        env["POD_CLUSTER_B0"] = pod_peers[0]
+        return env
+
+    children = ChildSet(tmp_path)
+    try:
+        for role in ("b0", "b1", "a"):
+            data_dir = tmp_path / role
+            data_dir.mkdir()
+            children.spawn(
+                role, [sys.executable, script, role, str(data_dir)],
+                env_for(role), pipe=(role == "a"))
+        out, err = children.procs["a"].communicate(timeout=240)
+        assert children.procs["a"].returncode == 0, (
+            f"node A failed rc={children.procs['a'].returncode}\n"
+            f"stdout:\n{out}\nstderr:\n{err[-4000:]}\n"
+            f"{children.logs_tail()}")
+        assert "POD_CLUSTER_OK" in out, out
+    finally:
+        children.cleanup()
