@@ -78,6 +78,9 @@ def device_worker() -> None:
     Runs in its own process so a hung/broken TPU backend init cannot take
     down the benchmark of record — the parent enforces the timeout.
     """
+    t_begin = time.perf_counter()  # budget anchor: the parent's kill
+    # deadline started when this process did
+
     import jax
 
     from pilosa_tpu.ops.kernels import op_count
@@ -100,8 +103,11 @@ def device_worker() -> None:
     t0 = time.perf_counter()
     np.asarray(op_count("and", da, db))
     probe_s = time.perf_counter() - t0
-    budget = 0.5 * float(os.environ.get("PILOSA_BENCH_DEVICE_TIMEOUT",
-                                        "300"))
+    # Budget = what's left of the parent's deadline (minus headroom for
+    # the final sync + result print), not a fixed slice — setup (4 GB
+    # generation, upload, warmup/verify) already consumed part of it.
+    deadline = float(os.environ.get("PILOSA_BENCH_DEVICE_TIMEOUT", "300"))
+    budget = max(5.0, 0.8 * deadline - (time.perf_counter() - t_begin))
     iters = max(1, min(iters, int(budget / max(probe_s, 1e-9) / trials)))
 
     best = []

@@ -89,6 +89,55 @@ def main() -> None:
     got = [(p["id"], p["count"]) for p in pairs[0]]
     assert got == [(1, 8)], got
 
+    # Inverse views route by ROW slice inside the pod (a bit's standard
+    # and inverse views can live on different processes) — the
+    # per-view pinning in executor._pod_write_remote.
+    http("POST", coord, "/index/i/frame/inv",
+         b'{"options": {"inverseEnabled": true}}')
+    for s in range(4):
+        # row id s*W+7 → inverse slice s; column 2*W+1 → standard slice 2
+        query(coord, "i", f"SetBit(frame=inv, rowID={s * SLICE_WIDTH + 7},"
+                          f" columnID={2 * SLICE_WIDTH + 1})")
+    bits = query(coord, "i",
+                 f"Bitmap(frame=inv, columnID={2 * SLICE_WIDTH + 1})"
+                 )[0]["bits"]
+    assert bits == [s * SLICE_WIDTH + 7 for s in range(4)], bits
+
+    # Range over time views runs the podLocal host legs with view names.
+    http("POST", coord, "/index/i/frame/tq",
+         b'{"options": {"timeQuantum": "YMD"}}')
+    for s in range(4):
+        query(coord, "i", f"SetBit(frame=tq, rowID=1,"
+                          f" columnID={s * SLICE_WIDTH},"
+                          f' timestamp="2017-01-0{s + 1}T00:00")')
+    got = query(coord, "i", 'Count(Range(rowID=1, frame=tq,'
+                            ' start="2017-01-01T00:00",'
+                            ' end="2017-01-03T00:00"))')[0]
+    assert got == 2, got
+
+    # Randomized parity: pod results must equal a pure host model.
+    import random
+    rng = random.Random(7)
+    model = {1: set(), 2: set()}
+    for _ in range(60):
+        row = rng.choice((1, 2))
+        col = rng.randrange(4 * SLICE_WIDTH)
+        query(coord, "i", f"SetBit(frame=f, rowID={row}, columnID={col})")
+        model[row].add(col)
+    for s in range(4):
+        for j in range(3):
+            model[1].add(s * SLICE_WIDTH + j)
+        for j in range(2):
+            model[2].add(s * SLICE_WIDTH + j)
+    got = query(coord, "i", "Count(Union(Bitmap(frame=f, rowID=1),"
+                            " Bitmap(frame=f, rowID=2)))")[0]
+    assert got == len(model[1] | model[2]), got
+    got = query(coord, "i", "Count(Intersect(Bitmap(frame=f, rowID=1),"
+                            " Bitmap(frame=f, rowID=2)))")[0]
+    assert got == len(model[1] & model[2]), got
+    bits = query(coord, "i", "Bitmap(frame=f, rowID=2)")[0]["bits"]
+    assert bits == sorted(model[2]), (len(bits), len(model[2]))
+
     # Pod executions really did run: the coordinator's executor must not
     # have fallen back to the (coordinator-only) host path silently.
     assert srv.executor.device_fallbacks == 0, srv.executor.device_fallbacks
