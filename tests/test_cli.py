@@ -149,3 +149,19 @@ class TestBenchConfig:
         # env beats file
         cfg = config_mod.load(str(toml), env={"PILOSA_HOST": "h9:9"})
         assert cfg.host == "h9:9"
+
+
+def test_check_accepts_reference_format_golden_files(capsys):
+    """`pilosa check` must validate files in the reference wire format
+    (the golden interchange fixtures) — CLI × interchange composition."""
+    import glob
+    import os
+
+    from pilosa_tpu.cli.commands import main as cli_main
+    golden = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "golden", "*.roaring")))
+    assert golden
+    rc = cli_main(["check", *golden])
+    out = capsys.readouterr().out
+    assert rc in (0, None)
+    assert out.count(": ok") == len(golden)
