@@ -163,5 +163,5 @@ def test_check_accepts_reference_format_golden_files(capsys):
     assert golden
     rc = cli_main(["check", *golden])
     out = capsys.readouterr().out
-    assert rc in (0, None)
+    assert rc == 0
     assert out.count(": ok") == len(golden)
