@@ -190,7 +190,18 @@ class Executor:
             return self._execute_bulk_set_row_attrs(index, query.calls, opt)
 
         results = []
-        for call in query.calls:
+        i = 0
+        while i < len(query.calls):
+            # Consecutive device-compilable Count calls fuse into ONE
+            # mesh program — K counts, one dispatch (one sync).
+            batch = self._count_batch_run(index, query.calls, i, slices,
+                                          opt)
+            if batch is not None:
+                counts, n = batch
+                results.extend(counts)
+                i += n
+                continue
+            call = query.calls[i]
             call_slices = slices
             if call.supports_inverse() and needs and computed_slices:
                 frame_name = call.args.get("frame") or DEFAULT_FRAME
@@ -199,7 +210,9 @@ class Executor:
                     raise FrameNotFoundError(frame_name)
                 if call.is_inverse(frame.row_label, column_label):
                     call_slices = inverse_slices
-            results.append(self._execute_call(index, call, call_slices, opt))
+            results.append(self._execute_call(index, call, call_slices,
+                                              opt))
+            i += 1
         return results
 
     def _execute_call(self, index: str, c: Call, slices: list[int],
@@ -370,12 +383,81 @@ class Executor:
 
     # -- device-batched Count (TPU fast path) --------------------------------
 
+    def _count_batch_run(self, index: str, calls: list[Call], start: int,
+                         slices: list[int], opt: ExecOptions):
+        """(counts, n_calls) for a maximal run of ≥2 consecutive
+        device-compilable Count calls starting at ``start``, fused into
+        one mesh program over shared (deduplicated) leaf slabs — or
+        None to fall back to per-call execution.
+
+        Only for the single-node, non-pod serving shape: cluster
+        map-reduce and the pod broadcast fan out per call, so batching
+        there would bypass their remote legs. Count calls never take
+        the inverse slice list (only Bitmap does), so every call in
+        the run shares ``slices``.
+        """
+        if (not self.use_mesh or self.pod is not None
+                or len(self.cluster.nodes) != 1
+                or len(slices) < self.mesh_min_slices):
+            return None
+        # Cheap necessary condition before any compile work: a run
+        # needs ≥2 Counts, so a lone Count (the common query shape)
+        # must not pay a discarded device-expr compilation here.
+        if (start + 1 >= len(calls) or calls[start].name != "Count"
+                or calls[start + 1].name != "Count"):
+            return None
+        from .parallel import mesh as mesh_mod
+        leaves: list[tuple] = []
+        leaf_ids: dict[tuple, int] = {}
+        exprs: list[tuple] = []
+        j = start
+        while j < len(calls) and len(exprs) < self._BATCH_MAX_COUNTS:
+            c = calls[j]
+            if c.name != "Count" or len(c.children) != 1:
+                break
+            call_leaves: list[tuple] = []
+            expr = self._compile_device_expr(index, c.children[0],
+                                             call_leaves)
+            if expr is None:
+                break
+            remap = {}
+            for li, leaf in enumerate(call_leaves):
+                if leaf not in leaf_ids:
+                    leaf_ids[leaf] = len(leaves)
+                    leaves.append(leaf)
+                remap[li] = leaf_ids[leaf]
+            if all(k == v for k, v in remap.items()):
+                exprs.append(expr)  # first call / no shared leaves
+            else:
+                exprs.append(mesh_mod.remap_expr_leaves(expr, remap))
+            j += 1
+        if j - start < 2:
+            return None
+        mesh = self._mesh_or_none()
+        if mesh is None or len(slices) > mesh_mod.slice_chunk_bound(
+                mesh.shape[mesh_mod.AXIS_SLICES]):
+            return None
+        try:
+            arrs = [self._leaf_device_array(mesh, index, leaf,
+                                            tuple(slices))
+                    for leaf in leaves]
+            counts = mesh_mod.count_exprs_sharded(mesh, tuple(exprs),
+                                                  arrs)
+        except Exception as e:  # noqa: BLE001 - fall back per call
+            self._note_device_fallback("count_exprs", e)
+            return None
+        return counts, j - start
+
     _DEVICE_FOLD_OPS = {"Intersect": "and", "Union": "or",
                         "Difference": "andnot"}
 
     # Largest dense candidate block the TopN mesh path may materialize
     # host-side (slices × candidates × 128 KB); larger sets fall back.
     _TOPN_HOST_BLOCK_BYTES = 2 << 30
+    # Max Count calls fused into one program: each distinct expr tuple
+    # compiles its own XLA program, so unbounded runs would stall the
+    # serving path in compilation (longer runs split into chunks).
+    _BATCH_MAX_COUNTS = 16
     # HBM bound for one materializing fold: every leaf slab plus the
     # result are simultaneously live as the program's inputs/output.
     _MATERIALIZE_DEVICE_BYTES = 4 << 30

@@ -194,14 +194,62 @@ def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
     return total
 
 
+def expr_leaf_ids(expr) -> list[int]:
+    """Ordered unique leaf ids referenced by an expr tree (iterative —
+    wide folds are ~leaf-count deep)."""
+    seen: list[int] = []
+    stack = [expr]
+    while stack:
+        node = stack.pop()
+        if node[0] == "leaf":
+            if node[1] not in seen:
+                seen.append(node[1])
+        else:
+            stack.append(node[2])
+            stack.append(node[1])
+    return seen
+
+
+def remap_expr_leaves(expr, remap: dict[int, int]) -> tuple:
+    """Rebuild an expr tree with leaf ids remapped (iterative)."""
+    done: dict[int, tuple] = {}
+    stack = [expr]
+    while stack:
+        node = stack[-1]
+        if node[0] == "leaf":
+            done[id(node)] = ("leaf", remap[node[1]])
+            stack.pop()
+            continue
+        left, right = node[1], node[2]
+        if id(left) in done and id(right) in done:
+            done[id(node)] = (node[0], done[id(left)], done[id(right)])
+            stack.pop()
+        else:
+            if id(right) not in done:
+                stack.append(right)
+            if id(left) not in done:
+                stack.append(left)
+    return done[id(expr)]
+
+
 @functools.lru_cache(maxsize=256)
-def _count_expr_sharded_fn(mesh: Mesh, expr: tuple, n_leaves: int,
-                           mode: str | None):
+def _count_exprs_sharded_fn(mesh: Mesh, exprs: tuple, n_leaves: int,
+                            mode: str | None):
     def per_shard(*leaf_shards):  # each [S/n, W]
-        leaves = jnp.stack(leaf_shards)  # [L, S/n, W]
-        row = _rows_popcount(expr, leaves, mode).ravel()
-        hi = jax.lax.psum(jnp.sum(row >> 16), AXIS_SLICES)
-        lo = jax.lax.psum(jnp.sum(row & 0xFFFF), AXIS_SLICES)
+        his, los = [], []
+        for expr in exprs:
+            # Each expression reads only ITS leaves: no redundant HBM
+            # traffic for the others, and the Pallas leaf-tile cap
+            # applies per expression, not to the deduplicated union.
+            ids = expr_leaf_ids(expr)
+            sub = jnp.stack([leaf_shards[i] for i in ids])
+            local = remap_expr_leaves(
+                expr, {g: li for li, g in enumerate(ids)})
+            row = _rows_popcount(local, sub, mode).ravel()
+            his.append(jnp.sum(row >> 16))
+            los.append(jnp.sum(row & 0xFFFF))
+        hi = jax.lax.psum(jnp.stack(his), AXIS_SLICES)
+        lo = jax.lax.psum(jnp.stack(los), AXIS_SLICES)
         return hi, lo
 
     return jax.jit(jax.shard_map(
@@ -210,22 +258,35 @@ def _count_expr_sharded_fn(mesh: Mesh, expr: tuple, n_leaves: int,
         check_vma=(mode is None)))
 
 
+def count_exprs_sharded(mesh: Mesh, exprs: tuple,
+                        leaf_arrays: list[jax.Array]) -> list[int]:
+    """K expression counts in ONE compiled program over shared
+    device-resident leaf slabs — a PQL query carrying several Count
+    calls pays one dispatch (and one tunnel/host sync) instead of K.
+    The reference executes calls strictly sequentially
+    (executor.go:135-142); the counts are independent, so fusing them
+    is observationally identical. Same bounds as count_expr_sharded.
+    """
+    if leaf_arrays[0].shape[0] > slice_chunk_bound(
+            mesh.shape[AXIS_SLICES]):
+        raise ValueError("count_exprs_sharded: slice count above the"
+                         " int32 hi/lo bound")
+    fn = _count_exprs_sharded_fn(mesh, exprs, len(leaf_arrays),
+                                 _mesh_pallas_mode(mesh))
+    hi, lo = fn(*leaf_arrays)
+    hi, lo = np.asarray(hi), np.asarray(lo)
+    return [(int(hi[k]) << 16) + int(lo[k]) for k in range(len(exprs))]
+
+
 def count_expr_sharded(mesh: Mesh, expr: tuple,
                        leaf_arrays: list[jax.Array]) -> int:
     """Count over per-leaf DEVICE-resident [n_slices, n_words] slabs
     (each sharded over the slice axis, e.g. from the residency cache —
     no host pack or upload on this path). All slabs must share one
     shape with n_slices ≤ slice_chunk_bound; leaves stack on device
-    inside the compiled program.
+    inside the compiled program. The K=1 form of count_exprs_sharded.
     """
-    if leaf_arrays[0].shape[0] > slice_chunk_bound(
-            mesh.shape[AXIS_SLICES]):
-        raise ValueError("count_expr_sharded: slice count above the"
-                         " int32 hi/lo bound — use count_expr")
-    fn = _count_expr_sharded_fn(mesh, expr, len(leaf_arrays),
-                                _mesh_pallas_mode(mesh))
-    hi, lo = fn(*leaf_arrays)
-    return (int(hi) << 16) + int(lo)
+    return count_exprs_sharded(mesh, (expr,), leaf_arrays)[0]
 
 
 @functools.lru_cache(maxsize=256)

@@ -603,6 +603,104 @@ class TestDeviceTopNPath:
         assert all(p.id == 0 for p in res[0])
 
 
+class TestBatchedCounts:
+    """Consecutive Count calls in one PQL query fuse into ONE mesh
+    program (one device dispatch) with shared, deduplicated leaves."""
+
+    def _fill(self, holder, slices=8):
+        import numpy as np
+        rng = np.random.default_rng(55)
+        f = holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        for row in range(4):
+            for col in rng.choice(slices * SLICE_WIDTH, size=150,
+                                  replace=False):
+                f.set_bit("standard", row, int(col))
+
+    QUERY = ("Count(Bitmap(rowID=0, frame=f))"
+             " Count(Intersect(Bitmap(rowID=0, frame=f),"
+             " Bitmap(rowID=1, frame=f)))"
+             " Count(Union(Bitmap(rowID=2, frame=f),"
+             " Bitmap(rowID=3, frame=f)))")
+
+    def test_batch_matches_sequential(self, holder):
+        self._fill(holder)
+        fast = Executor(holder, host="local", use_mesh=True,
+                        mesh_min_slices=1)
+        slow = Executor(holder, host="local", use_mesh=False)
+        assert fast.execute("i", self.QUERY) == \
+            slow.execute("i", self.QUERY)
+        assert fast.device_fallbacks == 0
+
+    def test_single_dispatch_with_shared_leaves(self, holder,
+                                                monkeypatch):
+        self._fill(holder)
+        ex = Executor(holder, host="local", use_mesh=True,
+                      mesh_min_slices=1)
+        calls = []
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        orig = mesh_mod.count_exprs_sharded
+
+        def spy(mesh, exprs, arrs):
+            calls.append((exprs, len(arrs)))
+            return orig(mesh, exprs, arrs)
+
+        monkeypatch.setattr(mesh_mod, "count_exprs_sharded", spy)
+        ex.execute("i", self.QUERY)
+        assert len(calls) == 1  # three Counts, one program
+        exprs, n_leaves = calls[0]
+        assert len(exprs) == 3
+        assert n_leaves == 4  # rowID 0 shared between calls 1 and 2
+        assert exprs[1] == ("and", ("leaf", 0), ("leaf", 1))
+
+    def test_mixed_calls_batch_only_runs(self, holder, monkeypatch):
+        self._fill(holder)
+        ex = Executor(holder, host="local", use_mesh=True,
+                      mesh_min_slices=1)
+        calls = []
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        orig = mesh_mod.count_exprs_sharded
+
+        def spy(mesh, exprs, arrs):
+            calls.append(len(exprs))
+            return orig(mesh, exprs, arrs)
+
+        monkeypatch.setattr(mesh_mod, "count_exprs_sharded", spy)
+        q = ("Count(Bitmap(rowID=0, frame=f))"
+             " Count(Bitmap(rowID=1, frame=f))"
+             " SetBit(rowID=9, frame=f, columnID=3)"
+             " Count(Bitmap(rowID=2, frame=f))")
+        res = ex.execute("i", q)
+        # The leading run of 2 fuses; the trailing lone Count runs as
+        # the K=1 form through the same program builder.
+        assert calls == [2, 1]
+        assert res[2] is True and len(res) == 4
+        slow = Executor(holder, host="local", use_mesh=False)
+        assert res[:2] == slow.execute(
+            "i", "Count(Bitmap(rowID=0, frame=f))"
+                 " Count(Bitmap(rowID=1, frame=f))")
+
+    def test_cluster_does_not_batch(self, holder, monkeypatch):
+        """Batching would bypass remote legs — multi-node clusters
+        must keep per-call map-reduce."""
+        self._fill(holder, slices=2)
+        cluster = new_cluster(["local", "other"])
+        ex = Executor(holder, host="local", cluster=cluster,
+                      use_mesh=True, mesh_min_slices=1,
+                      client=type("C", (), {
+                          "execute_query":
+                          lambda self, node, index, q, s, remote:
+                          [0]})())
+        from pilosa_tpu.parallel import mesh as mesh_mod
+
+        def boom(*a, **kw):
+            raise AssertionError("batched on a multi-node cluster")
+
+        monkeypatch.setattr(mesh_mod, "count_exprs_sharded", boom)
+        ex.execute("i", "Count(Bitmap(rowID=0, frame=f))"
+                        " Count(Bitmap(rowID=1, frame=f))")
+
+
 class TestDeviceMaterializePath:
     """Materializing Union/Intersect/Difference on device (BASELINE
     config 2) must agree bit-for-bit with the per-slice roaring path
