@@ -390,15 +390,18 @@ class Executor:
         one mesh program over shared (deduplicated) leaf slabs — or
         None to fall back to per-call execution.
 
-        Only for the single-node, non-pod serving shape: cluster
-        map-reduce and the pod broadcast fan out per call, so batching
-        there would bypass their remote legs. Count calls never take
-        the inverse slice list (only Bitmap does), so every call in
-        the run shares ``slices``.
+        Only for the single-node serving shape (a pod counts as one
+        node: its coordinator dispatches the batch as ONE pod work
+        item): cluster map-reduce fans out per call, so batching there
+        would bypass its remote legs. Count calls never take the
+        inverse slice list (only Bitmap does), so every call in the
+        run shares ``slices``.
         """
-        if (not self.use_mesh or self.pod is not None
-                or len(self.cluster.nodes) != 1
+        if (not self.use_mesh or len(self.cluster.nodes) != 1
                 or len(slices) < self.mesh_min_slices):
+            return None
+        if self.pod is not None and (not self.pod.is_coordinator
+                                     or opt.pod_local):
             return None
         # Cheap necessary condition before any compile work: a run
         # needs ≥2 Counts, so a lone Count (the common query shape)
@@ -433,6 +436,14 @@ class Executor:
             j += 1
         if j - start < 2:
             return None
+        if self.pod is not None:
+            try:
+                counts = self.pod.count_exprs(index, exprs, leaves,
+                                              slices)
+            except Exception as e:  # noqa: BLE001 - per-call pod paths
+                self._note_device_fallback("pod.count_exprs", e)
+                return None
+            return counts, j - start
         mesh = self._mesh_or_none()
         if mesh is None or len(slices) > mesh_mod.slice_chunk_bound(
                 mesh.shape[mesh_mod.AXIS_SLICES]):

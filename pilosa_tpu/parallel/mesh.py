@@ -165,6 +165,47 @@ def count_expr_fn(mesh: Mesh, expr: tuple):
     return _count_expr_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
 
 
+def _exprs_hi_lo(exprs, leaves, mode):
+    """Per-expression (hi, lo) 16-bit count halves over one leaf block
+    [L, S/n, W] — each expression reads only ITS leaves (no redundant
+    HBM traffic; the Pallas leaf-tile cap applies per expression).
+    Shared body of the batched-count programs."""
+    his, los = [], []
+    n = leaves.shape[0]
+    for expr in exprs:
+        ids = expr_leaf_ids(expr)
+        if ids == list(range(n)):
+            sub, local = leaves, expr  # common case: uses every leaf
+        else:
+            sub = leaves[jnp.asarray(ids)]
+            local = remap_expr_leaves(
+                expr, {g: li for li, g in enumerate(ids)})
+        row = _rows_popcount(local, sub, mode).ravel()
+        his.append(jnp.sum(row >> 16))
+        los.append(jnp.sum(row & 0xFFFF))
+    return jnp.stack(his), jnp.stack(los)
+
+
+@functools.lru_cache(maxsize=256)
+def _count_exprs_fn_cached(mesh: Mesh, exprs: tuple, mode: str | None):
+    def per_shard(leaves):  # leaves: [L, S/n, W]
+        his, los = _exprs_hi_lo(exprs, leaves, mode)
+        return (jax.lax.psum(his, AXIS_SLICES),
+                jax.lax.psum(los, AXIS_SLICES))
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(None, AXIS_SLICES),), out_specs=(P(), P()),
+        check_vma=(mode is None)))
+
+
+def count_exprs_fn(mesh: Mesh, exprs: tuple):
+    """K-expression batch form of count_expr_fn: ``[L, S, W]`` shared
+    leaf block → per-expression (hi, lo) 16-bit halves, one program.
+    Public for the pod layer (parallel.multihost)."""
+    return _count_exprs_fn_cached(mesh, exprs, _mesh_pallas_mode(mesh))
+
+
 def slice_chunk_bound(n_dev: int) -> int:
     """Max slice-rows per psum'd program: the 16-bit lo halves sum to at
     most ``rows × 0xFFFF``, which must stay under int32 — 2^15 rows is
@@ -236,21 +277,9 @@ def remap_expr_leaves(expr, remap: dict[int, int]) -> tuple:
 def _count_exprs_sharded_fn(mesh: Mesh, exprs: tuple, n_leaves: int,
                             mode: str | None):
     def per_shard(*leaf_shards):  # each [S/n, W]
-        his, los = [], []
-        for expr in exprs:
-            # Each expression reads only ITS leaves: no redundant HBM
-            # traffic for the others, and the Pallas leaf-tile cap
-            # applies per expression, not to the deduplicated union.
-            ids = expr_leaf_ids(expr)
-            sub = jnp.stack([leaf_shards[i] for i in ids])
-            local = remap_expr_leaves(
-                expr, {g: li for li, g in enumerate(ids)})
-            row = _rows_popcount(local, sub, mode).ravel()
-            his.append(jnp.sum(row >> 16))
-            los.append(jnp.sum(row & 0xFFFF))
-        hi = jax.lax.psum(jnp.stack(his), AXIS_SLICES)
-        lo = jax.lax.psum(jnp.stack(los), AXIS_SLICES)
-        return hi, lo
+        his, los = _exprs_hi_lo(exprs, jnp.stack(leaf_shards), mode)
+        return (jax.lax.psum(his, AXIS_SLICES),
+                jax.lax.psum(los, AXIS_SLICES))
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,

@@ -174,6 +174,25 @@ def count_expr(mesh: Mesh, expr: tuple, local_leaves: np.ndarray) -> int:
     return total
 
 
+def count_exprs(mesh: Mesh, exprs: tuple,
+                local_leaves: np.ndarray) -> list[int]:
+    """Pod-wide batched Counts: K expressions over one shared local
+    leaf shard, one collective program per chunk (the pod form of
+    mesh.count_exprs_sharded — K counts, one dispatch)."""
+    _assert_uniform_shards(*local_leaves.shape, len(exprs))
+    fn = mesh_mod.count_exprs_fn(mesh, tuple(exprs))
+    totals = [0] * len(exprs)
+    step = _local_chunk()
+    for off in range(0, max(local_leaves.shape[1], 1), step):
+        chunk = _pad_local(local_leaves[:, off:off + step], 1)
+        arr = _global_from_local(mesh, chunk, 1)
+        hi, lo = fn(arr)
+        hi, lo = np.asarray(hi), np.asarray(lo)
+        for k in range(len(exprs)):
+            totals[k] += (int(hi[k]) << 16) + int(lo[k])
+    return totals
+
+
 def topn_exact(mesh: Mesh, expr, local_rows: np.ndarray,
                local_leaves: Optional[np.ndarray], threshold: int = 1,
                tanimoto: int = 0) -> list[int]:

@@ -91,6 +91,9 @@ class Pod:
         # correct) until the pod is restarted — a pod is one failure
         # domain, like a real TPU pod job.
         self._poisoned = False
+        # Per-kind successful dispatch counts (observability + tests
+        # pinning that the collective path actually engaged).
+        self.dispatch_counts: dict[str, int] = {}
         # Per-peer keep-alive connections for pod-internal requests
         # (serialized per peer; reconnect on any error).
         self._conns: dict[int, http.client.HTTPConnection] = {}
@@ -177,18 +180,23 @@ class Pod:
             index = item["index"]
             slices = [int(s) for s in item["slices"]]
             leaves = [tuple(leaf) for leaf in item["leaves"]]
-            expr = _expr_from_json(item["expr"])
             local = self._local_slices(slices)
             mesh = multihost.pod_mesh()
             if kind == "count_expr":
                 block = self._pack_leaves(index, leaves, local)
-                return {"total": multihost.count_expr(mesh, expr, block)}
+                return {"total": multihost.count_expr(
+                    mesh, _expr_from_json(item["expr"]), block)}
+            if kind == "count_exprs":
+                exprs = tuple(_expr_from_json(e) for e in item["exprs"])
+                block = self._pack_leaves(index, leaves, local)
+                return {"totals": multihost.count_exprs(mesh, exprs,
+                                                        block)}
             if kind == "topn_exact":
                 rows = self._pack_rows(index, item["frame"],
                                        item["row_ids"], local)
                 lblock = self._pack_leaves(index, leaves, local)
                 return {"counts": multihost.topn_exact(
-                    mesh, expr, rows, lblock,
+                    mesh, _expr_from_json(item["expr"]), rows, lblock,
                     threshold=int(item.get("threshold", 1)),
                     tanimoto=int(item.get("tanimoto", 0)))}
             raise PodError(f"unknown pod work item kind: {kind}")
@@ -297,6 +305,8 @@ class Pod:
                 raise PodError(
                     f"pod divergence: process {pid} returned {out[pid]},"
                     f" coordinator computed {mine}")
+        kind = item["kind"]
+        self.dispatch_counts[kind] = self.dispatch_counts.get(kind, 0) + 1
         return mine
 
     def count_expr(self, index: str, expr: tuple, leaves: list[tuple],
@@ -307,6 +317,18 @@ class Pod:
             "kind": "count_expr", "index": index, "expr": expr,
             "leaves": [list(leaf) for leaf in leaves],
             "slices": sorted(slices)})["total"]
+
+    def count_exprs(self, index: str, exprs: list[tuple],
+                    leaves: list[tuple], slices: list[int]) -> list[int]:
+        """K batched Counts in one pod collective (one work item, one
+        dispatch) — the pod form of executor._count_batch_run."""
+        if not slices:
+            return [0] * len(exprs)
+        return self._dispatch({
+            "kind": "count_exprs", "index": index,
+            "exprs": list(exprs),
+            "leaves": [list(leaf) for leaf in leaves],
+            "slices": sorted(slices)})["totals"]
 
     def topn_exact(self, index: str, frame: str, expr, leaves: list[tuple],
                    row_ids: list[int], slices: list[int],

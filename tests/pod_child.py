@@ -67,6 +67,18 @@ def main() -> None:
                             " Bitmap(frame=f, rowID=2)))")[0]
     assert got == 4, f"Count(Difference): {got} != 4"
 
+    # Batched Counts: one PQL query, one pod collective for all three —
+    # the dispatch counter pins that the fused path engaged (the values
+    # alone would also pass via per-call fallback).
+    before = srv.pod.dispatch_counts.get("count_exprs", 0)
+    res = query(coord, "i",
+                "Count(Bitmap(frame=f, rowID=1))"
+                " Count(Bitmap(frame=f, rowID=2))"
+                " Count(Intersect(Bitmap(frame=f, rowID=1),"
+                " Bitmap(frame=f, rowID=2)))")
+    assert res == [12, 8, 8], res
+    assert srv.pod.dispatch_counts.get("count_exprs", 0) == before + 1
+
     # Bitmap materialization rides the podLocal host legs: bits from
     # worker-owned slices must appear.
     bits = query(coord, "i", "Bitmap(frame=f, rowID=3)")[0]["bits"]

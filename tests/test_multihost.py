@@ -55,6 +55,19 @@ class TestSingleProcessForms:
         want = [_popcount(rows[:, r, :] & src[0]) for r in range(R)]
         assert got == want
 
+    def test_count_exprs_batch_matches_singles(self):
+        rng = np.random.default_rng(3)
+        mesh = multihost.pod_mesh()
+        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+        S, W = n_dev * 2, 256
+        leaves = rng.integers(0, 2**32, size=(3, S, W), dtype=np.uint32)
+        exprs = (("leaf", 0),
+                 ("and", ("leaf", 0), ("leaf", 1)),
+                 ("or", ("leaf", 1), ("leaf", 2)))
+        got = multihost.count_exprs(mesh, exprs, leaves)
+        assert got == [multihost.count_expr(mesh, e, leaves)
+                       for e in exprs]
+
     def test_topn_filtered_matches_single_host_path(self):
         rng = np.random.default_rng(2)
         mesh = multihost.pod_mesh()
