@@ -812,3 +812,10 @@ class TestDevicePathFuzz:
             q = (f"TopN({rand_expr(1)}, frame=f, n=4,"
                  f" ids={list(ids)})")
             assert fast.execute("i", q) == slow.execute("i", q), q
+        # Multi-Count queries fuse into one batched program — parity
+        # must hold for random run lengths and shared leaves.
+        for _ in range(10):
+            k = int(rng.integers(2, 6))
+            q = " ".join(f"Count({rand_expr(1)})" for _ in range(k))
+            assert fast.execute("i", q) == slow.execute("i", q), q
+        assert fast.device_fallbacks == 0
