@@ -410,6 +410,7 @@ class Executor:
                 or calls[start + 1].name != "Count"):
             return None
         from .parallel import mesh as mesh_mod
+        shard, budget = self._count_budget(slices)
         leaves: list[tuple] = []
         leaf_ids: dict[tuple, int] = {}
         exprs: list[tuple] = []
@@ -423,6 +424,9 @@ class Executor:
                                              call_leaves)
             if expr is None:
                 break
+            new = sum(1 for leaf in call_leaves if leaf not in leaf_ids)
+            if self._leaf_block_bytes(len(leaves) + new, shard) > budget:
+                break  # fuse the prefix that fits; the rest runs per call
             remap = {}
             for li, leaf in enumerate(call_leaves):
                 if leaf not in leaf_ids:
@@ -472,6 +476,28 @@ class Executor:
     # HBM bound for one materializing fold: every leaf slab plus the
     # result are simultaneously live as the program's inputs/output.
     _MATERIALIZE_DEVICE_BYTES = 4 << 30
+
+    @staticmethod
+    def _leaf_block_bytes(n_leaves: int, n_slices: int) -> int:
+        from .ops.packed import WORDS_PER_SLICE
+        return n_leaves * n_slices * WORDS_PER_SLICE * 4
+
+    def _count_budget(self, slices: list[int]) -> tuple[int, int]:
+        """(per-shard slice count, byte budget) for one Count program's
+        leaf set. Resident single-host programs hold every slab live in
+        HBM (_MATERIALIZE_DEVICE_BYTES); the streaming and pod paths
+        chunk the device side but build the full numpy pack up-front,
+        so the host-block bound applies to the (per-process) shard."""
+        if self.pod is not None:
+            return (self.pod.max_shard_slices(slices),
+                    self._TOPN_HOST_BLOCK_BYTES)
+        from .parallel import mesh as mesh_mod
+        mesh = self._mesh
+        n_dev = (mesh.shape[mesh_mod.AXIS_SLICES] if mesh is not None
+                 else 1)
+        if len(slices) <= mesh_mod.slice_chunk_bound(n_dev):
+            return len(slices), self._MATERIALIZE_DEVICE_BYTES
+        return len(slices), self._TOPN_HOST_BLOCK_BYTES
 
     def _compile_device_expr(self, index: str, c: Call, leaves: list):
         """Compile a pure bitmap call tree into a mesh.count_expr tree.
@@ -589,7 +615,10 @@ class Executor:
                 return None  # plain local path on pod-internal legs
 
             def pod_fn(slices: list[int]):
-                if len(slices) < self.mesh_min_slices:
+                shard, budget = self._count_budget(slices)
+                if (len(slices) < self.mesh_min_slices
+                        or self._leaf_block_bytes(len(leaves), shard)
+                        > budget):
                     return NotImplemented  # pod host legs win when small
                 try:
                     return self.pod.count_expr(index, expr, leaves, slices)
@@ -604,6 +633,9 @@ class Executor:
             mesh = self._mesh_or_none()  # backend init only past threshold
             if mesh is None:
                 return NotImplemented
+            shard, budget = self._count_budget(slices)
+            if self._leaf_block_bytes(len(leaves), shard) > budget:
+                return NotImplemented  # oversized leaf set: host path
             from .parallel import mesh as mesh_mod
             try:
                 if len(slices) <= mesh_mod.slice_chunk_bound(

@@ -134,10 +134,9 @@ def count_op(mesh: Mesh, op: str, a: jax.Array, b: jax.Array) -> int:
 @functools.lru_cache(maxsize=256)  # keyed on query-shaped exprs: bound it
 def _count_expr_fn_cached(mesh: Mesh, expr: tuple, mode: str | None):
     def per_shard(leaves):  # leaves: [L, S/n, W]
-        row = _rows_popcount(expr, leaves, mode).ravel()
-        hi = jax.lax.psum(jnp.sum(row >> 16), AXIS_SLICES)
-        lo = jax.lax.psum(jnp.sum(row & 0xFFFF), AXIS_SLICES)
-        return hi, lo
+        his, los = _exprs_hi_lo((expr,), leaves, mode)
+        return (jax.lax.psum(his[0], AXIS_SLICES),
+                jax.lax.psum(los[0], AXIS_SLICES))
 
     # check_vma off when Pallas is in the shard body: pallas_call's
     # out_shape carries no varying-axis info, which trips the inference.
