@@ -162,16 +162,9 @@ def _global_from_local(mesh: Mesh, local: np.ndarray,
 def count_expr(mesh: Mesh, expr: tuple, local_leaves: np.ndarray) -> int:
     """Pod-wide Count: each process passes its local [L, S_local, W]
     leaf shard; the psum spans every chip on every host. Chunks the
-    slice axis identically on every process (int32 hi/lo bound)."""
-    _assert_uniform_shards(*local_leaves.shape)
-    total = 0
-    step = _local_chunk()
-    for off in range(0, max(local_leaves.shape[1], 1), step):
-        chunk = _pad_local(local_leaves[:, off:off + step], 1)
-        arr = _global_from_local(mesh, chunk, 1)
-        hi, lo = mesh_mod.count_expr_fn(mesh, expr)(arr)
-        total += (int(hi) << 16) + int(lo)
-    return total
+    slice axis identically on every process (int32 hi/lo bound).
+    The K=1 form of count_exprs."""
+    return count_exprs(mesh, (expr,), local_leaves)[0]
 
 
 def count_exprs(mesh: Mesh, exprs: tuple,
