@@ -9,10 +9,9 @@ pilosa_tpu.parallel.residency).
 
 from __future__ import annotations
 
-import heapq
 import time
 from collections import OrderedDict
-from typing import Iterable, Optional
+from typing import Iterable
 
 # ThresholdFactor of maxEntries is how far the unsorted entry map may grow
 # past maxEntries before a trim (reference cache.go:30-33, factor 1.1).

@@ -43,7 +43,7 @@ from ..parallel.residency import DeviceRowCache
 from ..proto import internal_pb2 as pb
 from . import cache as cache_mod
 from . import roaring
-from .bitmap import Bitmap, BitmapSegment
+from .bitmap import Bitmap
 from .cache import Pair
 
 # Number of operations before a snapshot rewrite (reference fragment.go:63-65).
