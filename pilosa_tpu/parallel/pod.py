@@ -216,8 +216,11 @@ class Pod:
                     conn = http.client.HTTPConnection(
                         self.peers[pid], timeout=self.timeout)
                 try:
+                    # Accept mirrors Content-Type: the /import route
+                    # negotiates strictly on both (handler 406/415).
                     conn.request(method, path, body=body,
-                                 headers={"Content-Type": content_type})
+                                 headers={"Content-Type": content_type,
+                                          "Accept": content_type})
                 except (http.client.HTTPException, OSError):
                     conn.close()
                     if fresh:

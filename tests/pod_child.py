@@ -115,6 +115,27 @@ def main() -> None:
                  )[0]["bits"]
     assert bits == [s * SLICE_WIDTH + 7 for s in range(4)], bits
 
+    # Bulk /import through the coordinator splits within the pod:
+    # standard+time views go to the column-slice owner, inverse views
+    # group by ROW slice with one leg per owning process
+    # (handler._pod_import + podView legs).
+    from pilosa_tpu.proto import internal_pb2 as pb
+    http("POST", coord, "/index/i/frame/imp",
+         b'{"options": {"inverseEnabled": true}}')
+    rows_i = [s * SLICE_WIDTH + 3 for s in range(4)]   # 4 inverse slices
+    cols_i = [1 * SLICE_WIDTH + 9] * 4                 # one standard slice
+    body = pb.ImportRequest(
+        Index="i", Frame="imp", Slice=1,
+        RowIDs=rows_i, ColumnIDs=cols_i,
+        Timestamps=[0] * 4).SerializeToString()
+    http("POST", coord, "/import", body, "application/x-protobuf")
+    bits = query(coord, "i",
+                 f"Bitmap(frame=imp, columnID={cols_i[0]})")[0]["bits"]
+    assert bits == rows_i, bits
+    got = query(coord, "i",
+                f"Count(Bitmap(frame=imp, rowID={rows_i[2]}))")[0]
+    assert got == 1, got
+
     # Range over time views runs the podLocal host legs with view names.
     http("POST", coord, "/index/i/frame/tq",
          b'{"options": {"timeQuantum": "YMD"}}')

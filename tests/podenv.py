@@ -16,6 +16,7 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.error
 import urllib.request
 
 
@@ -96,12 +97,18 @@ class ChildSet:
 # ---- helpers for the child scripts themselves --------------------------
 
 
-def http(method: str, host: str, path: str, body: bytes = b"") -> bytes:
+def http(method: str, host: str, path: str, body: bytes = b"",
+         content_type: str = "application/json") -> bytes:
     req = urllib.request.Request(
         f"http://{host}{path}", data=body, method=method,
-        headers={"Content-Type": "application/json"})
-    with urllib.request.urlopen(req, timeout=120) as resp:
-        return resp.read()
+        headers={"Content-Type": content_type, "Accept": content_type})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.read()
+    except urllib.error.HTTPError as e:
+        raise RuntimeError(
+            f"{method} {path}: {e.code}: "
+            f"{e.read().decode(errors='replace')[:500]}") from e
 
 
 def query(host: str, index: str, pql: str):
