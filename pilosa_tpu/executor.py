@@ -126,6 +126,7 @@ class Executor:
         self.mesh_min_leaves = int(os.environ.get(
             "PILOSA_TPU_MESH_MIN_LEAVES", "8"))
         self._mesh = None  # lazy: built on first device-batched call
+        self._mesh_failed_until = None  # backoff after backend failure
         # Device-fallback observability (a real kernel bug would
         # otherwise silently demote every query to the host path):
         # counted per executor, surfaced via stats + one-shot warning.
@@ -145,15 +146,27 @@ class Executor:
                 "the host per-slice path — further fallbacks are counted "
                 "but not logged", where, type(exc).__name__, exc)
 
+    # Seconds to serve host-side before re-probing a failed device
+    # backend (tunnel/pool outages are transient; a server started
+    # during one should pick the device back up without a restart).
+    _MESH_RETRY_S = 300.0
+
     def _mesh_or_none(self):
+        import time
         if not self.use_mesh:
             return None
         if self._mesh is None:
+            if (self._mesh_failed_until is not None
+                    and time.monotonic() < self._mesh_failed_until):
+                return None  # inside the backoff window: host path
             try:
                 from .parallel import mesh as mesh_mod
                 self._mesh = mesh_mod.make_mesh()
-            except Exception:  # noqa: BLE001 - no backend → host path
-                self.use_mesh = False  # don't re-probe on every query
+                self._mesh_failed_until = None
+            except Exception as e:  # noqa: BLE001 - backend unavailable
+                self._mesh_failed_until = (time.monotonic()
+                                           + self._MESH_RETRY_S)
+                self._note_device_fallback("make_mesh", e)
                 return None
         return self._mesh
 

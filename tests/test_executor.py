@@ -819,3 +819,38 @@ class TestDevicePathFuzz:
             q = " ".join(f"Count({rand_expr(1)})" for _ in range(k))
             assert fast.execute("i", q) == slow.execute("i", q), q
         assert fast.device_fallbacks == 0
+
+
+class TestMeshBackendRecovery:
+    def test_backend_failure_backs_off_then_recovers(self, holder,
+                                                     monkeypatch):
+        """A server started during a TPU outage serves host-side, then
+        picks the device back up after the backoff window — no restart
+        (round-2 pool outages motivated this)."""
+        import numpy as np
+        rng = np.random.default_rng(3)
+        f = holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        for col in rng.choice(8 * SLICE_WIDTH, size=64, replace=False):
+            f.set_bit("standard", 1, int(col))
+        ex = Executor(holder, host="local", use_mesh=True,
+                      mesh_min_slices=1)
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        orig_make = mesh_mod.make_mesh
+
+        def broken(*a, **kw):
+            raise RuntimeError("backend unavailable")
+
+        monkeypatch.setattr(mesh_mod, "make_mesh", broken)
+        q = "Count(Bitmap(frame=f, rowID=1))"
+        assert ex.execute("i", q)[0] == 64  # host path, correct
+        assert ex.device_fallbacks == 1
+        assert ex._mesh is None
+        # Within the backoff window: no re-probe (make_mesh would raise).
+        assert ex.execute("i", q)[0] == 64
+        assert ex.device_fallbacks == 1
+        # Outage ends + backoff expires → device path resumes.
+        monkeypatch.setattr(mesh_mod, "make_mesh", orig_make)
+        ex._mesh_failed_until = 0.0
+        assert ex.execute("i", q)[0] == 64
+        assert ex._mesh is not None
