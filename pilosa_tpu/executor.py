@@ -26,6 +26,7 @@ from __future__ import annotations
 import datetime as dt
 import os
 import threading
+import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from dataclasses import dataclass
 from typing import Callable, Optional
@@ -151,18 +152,29 @@ class Executor:
     # during one should pick the device back up without a restart).
     _MESH_RETRY_S = 300.0
 
+    def _mesh_backoff_active(self) -> bool:
+        """True inside the backoff window after a device-backend
+        failure. Device-eligible gates consult this before compiling a
+        device expr so a meshless host serves the backoff window with
+        zero discarded work (the compile is cheap, but it is pure waste
+        when _mesh_or_none is known to return None)."""
+        if self._mesh is not None or self._mesh_failed_until is None:
+            return False
+        return time.monotonic() < self._mesh_failed_until
+
     def _mesh_or_none(self):
-        import time
         if not self.use_mesh:
             return None
         if self._mesh is None:
-            if (self._mesh_failed_until is not None
-                    and time.monotonic() < self._mesh_failed_until):
+            if self._mesh_backoff_active():
                 return None  # inside the backoff window: host path
             try:
                 from .parallel import mesh as mesh_mod
                 self._mesh = mesh_mod.make_mesh()
                 self._mesh_failed_until = None
+                # Failure is cyclic under retry (outage → recovery →
+                # outage); re-arm the one-shot log for the next one.
+                self._fallback_warned = False
             except Exception as e:  # noqa: BLE001 - backend unavailable
                 self._mesh_failed_until = (time.monotonic()
                                            + self._MESH_RETRY_S)
@@ -416,6 +428,8 @@ class Executor:
         if self.pod is not None and (not self.pod.is_coordinator
                                      or opt.pod_local):
             return None
+        if self.pod is None and self._mesh_backoff_active():
+            return None
         # Cheap necessary condition before any compile work: a run
         # needs ≥2 Counts, so a lone Count (the common query shape)
         # must not pay a discarded device-expr compilation here.
@@ -559,7 +573,8 @@ class Executor:
         container-walking merges (roaring.go:1270-1558) with one HBM
         pass. Narrow calls keep the host path: below ~mesh_min_leaves
         rows the roaring merges beat the device sync + repack."""
-        if not self.use_mesh or self.pod is not None:
+        if (not self.use_mesh or self.pod is not None
+                or self._mesh_backoff_active()):
             return None  # pod host legs own pod materialization
         if c.name not in ("Union", "Intersect", "Difference"):
             return None
@@ -618,6 +633,8 @@ class Executor:
         pod workers and podLocal legs use the host path.
         """
         if not self.use_mesh:
+            return None
+        if self.pod is None and self._mesh_backoff_active():
             return None
         leaves: list[tuple] = []
         expr = self._compile_device_expr(index, child, leaves)
@@ -761,6 +778,8 @@ class Executor:
         which owns the full semantics.
         """
         if not self.use_mesh:
+            return None
+        if self.pod is None and self._mesh_backoff_active():
             return None
         row_ids, _ = c.uint_slice_arg("ids")
         if not row_ids:

@@ -186,12 +186,17 @@ class Container:
         """Dense u64[1024] word view (built on demand for array containers)."""
         if self.bitmap is not None:
             return self.bitmap
-        words = np.zeros(BITMAP_N, dtype=np.uint64)
         a = self.array
-        if a is not None and len(a):
-            np.bitwise_or.at(words, a >> np.uint32(6),
-                             np.uint64(1) << (a.astype(np.uint64) & np.uint64(63)))
-        return words
+        if a is None or not len(a):
+            return np.zeros(BITMAP_N, dtype=np.uint64)
+        # One pass through a byte mask + packbits beats a u64 or.at
+        # scatter ~4x: both are O(range), but packbits runs at memcpy
+        # speed while or.at is a per-element C loop.
+        bits = np.zeros(1 << 16, dtype=np.uint8)
+        bits[a] = 1
+        # "<u8": packbits emits value 64w+8i+j as byte 8w+i bit j, which
+        # is a little-endian u64 word regardless of host endianness.
+        return np.packbits(bits, bitorder="little").view("<u8")
 
     def count_range(self, start: int, end: int) -> int:
         """Number of set values in [start, end) within this container."""
@@ -452,16 +457,25 @@ class Bitmap:
         values = np.asarray(values, dtype=np.uint64)
         if not len(values):
             return 0
-        values = np.unique(values)  # sorts
-        highs = (values >> np.uint64(16)).astype(np.uint64)
-        lows = (values & np.uint64(0xFFFF)).astype(np.uint32)
-        bounds = np.flatnonzero(np.diff(highs)) + 1
+        # from_sorted callers (row unpacks, golden loads, offset_range
+        # repacks) feed pre-sorted positions; skip the O(n log n)
+        # re-sort for that case and dedupe with one linear pass.
+        if len(values) > 1 and not bool(np.all(values[:-1] <= values[1:])):
+            values = np.sort(values)
+        if len(values) > 1:
+            keep = np.empty(len(values), dtype=bool)
+            keep[0] = True
+            np.not_equal(values[1:], values[:-1], out=keep[1:])
+            if not keep.all():
+                values = values[keep]
+        highs = values >> np.uint64(16)
+        bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1
         starts = np.concatenate(([0], bounds))
         ends = np.concatenate((bounds, [len(values)]))
         added = 0
         for s, e in zip(starts, ends):
             key = int(highs[s])
-            chunk = lows[s:e]
+            chunk = (values[s:e] & np.uint64(0xFFFF)).astype(np.uint32)
             c = self._container_or_create(key)
             before = c.n
             if c.n == 0:
