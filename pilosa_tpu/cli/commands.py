@@ -65,6 +65,8 @@ def cmd_server(args, stdout, stderr) -> int:
         cfg.data_dir = args.data_dir
     if args.bind:
         cfg.host = args.bind
+    if getattr(args, "plugins_path", ""):
+        cfg.plugins_path = args.plugins_path
 
     cluster = None
     if cfg.cluster.hosts:
@@ -290,6 +292,9 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar="PATH",
                    help="write a sampled CPU profile to PATH")
     from ..utils.config import parse_duration
+    s.add_argument("--plugins.path", dest="plugins_path", default="",
+                   help="path to plugin directory (accepted but inert, "
+                        "as in the reference at this vintage)")
     s.add_argument("--profile.cpu-time", dest="profile_cpu_time",
                    type=parse_duration, default=30.0, metavar="DUR",
                    help="duration of the CPU profile (default 30s)")

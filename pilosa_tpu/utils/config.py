@@ -57,6 +57,10 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
     log_path: str = ""
+    # Accepted and persisted but inert, exactly like the reference at
+    # this vintage: config.go:48-50 declares [plugins] path and
+    # cmd/server.go:96 flags it, but nothing ever loads a plugin.
+    plugins_path: str = ""
 
     def to_toml(self) -> str:
         hosts = ", ".join(f'"{h}"' for h in self.cluster.hosts)
@@ -73,6 +77,9 @@ internal-hosts = [{internal}]
 polling-interval = "{int(self.cluster.polling_interval)}s"
 internal-port = "{self.cluster.internal_port}"
 gossip-seed = "{self.cluster.gossip_seed}"
+
+[plugins]
+path = "{self.plugins_path}"
 
 [anti-entropy]
 interval = "{int(self.anti_entropy_interval)}s"
@@ -105,6 +112,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
         ae = data.get("anti-entropy", {})
         if "interval" in ae:
             cfg.anti_entropy_interval = parse_duration(ae["interval"])
+        cfg.plugins_path = data.get("plugins", {}).get(
+            "path", cfg.plugins_path)
     env = os.environ if env is None else env
     if env.get("PILOSA_DATA_DIR"):
         cfg.data_dir = env["PILOSA_DATA_DIR"]
@@ -121,4 +130,6 @@ def load(path: str = "", env: dict | None = None) -> Config:
         cfg.cluster.internal_port = env["PILOSA_CLUSTER_INTERNAL_PORT"]
     if env.get("PILOSA_CLUSTER_GOSSIP_SEED"):
         cfg.cluster.gossip_seed = env["PILOSA_CLUSTER_GOSSIP_SEED"]
+    if env.get("PILOSA_PLUGINS_PATH"):
+        cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
     return cfg

@@ -150,6 +150,23 @@ class TestBenchConfig:
         cfg = config_mod.load(str(toml), env={"PILOSA_HOST": "h9:9"})
         assert cfg.host == "h9:9"
 
+    def test_config_parse_plugins(self, tmp_path):
+        """[plugins] path parses from TOML and env, and round-trips
+        through `pilosa config` output (cmd/server_test.go:86,
+        config.go:48-50)."""
+        from pilosa_tpu.utils import config as config_mod
+        toml = tmp_path / "cfg.toml"
+        toml.write_text('[plugins]\npath = "/var/sloth"\n')
+        cfg = config_mod.load(str(toml), env={})
+        assert cfg.plugins_path == "/var/sloth"
+        assert 'path = "/var/sloth"' in cfg.to_toml()
+        cfg = config_mod.load(str(toml),
+                              env={"PILOSA_PLUGINS_PATH": "/opt/p"})
+        assert cfg.plugins_path == "/opt/p"
+        # default prints the empty key, like ctl/config.go:58
+        rc, out, _ = run(["config"])
+        assert rc == 0 and "[plugins]" in out
+
 
 def test_check_accepts_reference_format_golden_files(capsys):
     """`pilosa check` must validate files in the reference wire format
