@@ -16,6 +16,8 @@ and continue with [A-Za-z0-9_\\-.]; numbers allow one leading '-' and one
 
 from __future__ import annotations
 
+import re
+
 from ..errors import PilosaError
 from .ast import Call, Query
 
@@ -41,16 +43,22 @@ class ParseError(PilosaError):
         super().__init__(f"{message} occurred at line {pos[0]}, char {pos[1]}")
 
 
-def _is_letter(ch):
-    return "a" <= ch <= "z" or "A" <= ch <= "Z"
-
-
-def _is_digit(ch):
-    return "0" <= ch <= "9"
-
-
-def _is_ident_char(ch):
-    return _is_letter(ch) or _is_digit(ch) or ch in "_-."
+# Token regexes (compiled once; the scanner was the query hot path's
+# biggest cost as a char-at-a-time loop — PQL parse was ~55% of SetBit
+# service time). Each preserves the reference scanner's rules exactly:
+# idents start with a letter and continue [A-Za-z0-9_\-.]; numbers take
+# an optional leading '-' and at most one '.'; strings are single- or
+# double-quoted with \n \\ \" \' escapes and may not span lines.
+_IDENT_RE = re.compile(r"[A-Za-z][A-Za-z0-9_\-.]*")
+# [0-9] not \d: the reference's isDigit is ASCII-only, and \d would
+# admit Unicode digits that int() then silently converts.
+_NUMBER_RE = re.compile(
+    r"-(?:[0-9]+(?:\.[0-9]*)?|\.[0-9]*)?|[0-9]+(?:\.[0-9]*)?")
+_STRING_RE = re.compile(r"(['\"])((?:\\[n\\\"']|[^\\\n])*?)\1")
+_ESCAPE_RE = re.compile(r"\\(.)")
+_ESCAPES = {"n": "\n", "\\": "\\", '"': '"', "'": "'"}
+_SIMPLE_TOKENS = {"=": EQ, ",": COMMA, "(": LPAREN, ")": RPAREN,
+                  "[": LBRACK, "]": RBRACK}
 
 
 class Scanner:
@@ -60,153 +68,128 @@ class Scanner:
         self._line = 0
         self._char = 0
 
-    def _read(self) -> str:
-        if self._i >= len(self._s):
-            self._i += 1
-            return ""
-        ch = self._s[self._i]
-        self._i += 1
-        if ch == "\n":
-            self._line += 1
-            self._char = 0
+    def _advance(self, j: int) -> None:
+        """Consume self._s[self._i:j], updating (line, char)."""
+        s, i = self._s, self._i
+        nl = s.count("\n", i, j)
+        if nl:
+            self._line += nl
+            self._char = j - (s.rindex("\n", i, j) + 1)
         else:
-            self._char += 1
-        return ch
-
-    def _unread(self):
-        self._i -= 1
-        if 0 <= self._i < len(self._s) and self._s[self._i] == "\n":
-            self._line -= 1
-        else:
-            self._char -= 1
+            self._char += j - i
+        self._i = j
 
     def scan(self):
+        s, i = self._s, self._i
         pos = (self._line, self._char)
-        ch = self._read()
-        if ch == "":
+        if i >= len(s):
+            self._i += 1
             return EOF, pos, ""
+        ch = s[i]
         if ch.isspace():
-            self._unread()
-            return self._scan_whitespace()
-        if _is_letter(ch):
-            self._unread()
-            return self._scan_ident()
-        if _is_digit(ch) or ch == "-":
-            self._unread()
-            return self._scan_number()
-        if ch in "\"'":
-            self._unread()
-            return self._scan_string()
-        simple = {"=": EQ, ",": COMMA, "(": LPAREN, ")": RPAREN,
-                  "[": LBRACK, "]": RBRACK}
-        return simple.get(ch, ILLEGAL), pos, ch
+            j, n = i + 1, len(s)
+            while j < n and s[j].isspace():
+                j += 1
+            lit = s[i:j]
+            self._advance(j)
+            return WS, pos, lit
+        if "a" <= ch <= "z" or "A" <= ch <= "Z":
+            m = _IDENT_RE.match(s, i)
+            self._advance(m.end())
+            return IDENT, pos, m.group()
+        if "0" <= ch <= "9" or ch == "-":
+            m = _NUMBER_RE.match(s, i)
+            lit = m.group()
+            self._advance(m.end())
+            return (FLOAT if "." in lit else INTEGER), pos, lit
+        if ch == '"' or ch == "'":
+            m = _STRING_RE.match(s, i)
+            if m is None:  # unterminated / newline / bad escape
+                return self._scan_badstring(pos)
+            body = m.group(2)
+            self._advance(m.end())
+            if "\\" in body:
+                body = _ESCAPE_RE.sub(
+                    lambda mm: _ESCAPES[mm.group(1)], body)
+            return STRING, pos, body
+        self._advance(i + 1)
+        return _SIMPLE_TOKENS.get(ch, ILLEGAL), pos, ch
 
-    def _scan_whitespace(self):
-        pos = (self._line, self._char)
+    def _scan_badstring(self, pos):
+        """Failure path of the string rule: unterminated input, embedded
+        newline, or invalid escape ⇒ BADSTRING with the partial body
+        (same consumption as the reference's char loop)."""
+        s, n = self._s, len(self._s)
+        ending = s[self._i]
+        j = self._i + 1
         buf = []
         while True:
-            ch = self._read()
-            if ch == "" or not ch.isspace():
-                if ch != "":
-                    self._unread()
-                break
-            buf.append(ch)
-        return WS, pos, "".join(buf)
-
-    def _scan_ident(self):
-        pos = (self._line, self._char)
-        buf = []
-        while True:
-            ch = self._read()
-            if ch == "" or not _is_ident_char(ch):
-                if ch != "":
-                    self._unread()
-                break
-            buf.append(ch)
-        return IDENT, pos, "".join(buf)
-
-    def _scan_number(self):
-        pos = (self._line, self._char)
-        tok = INTEGER
-        buf = []
-        first = True
-        seen_dot = False
-        while True:
-            ch = self._read()
-            if not (_is_digit(ch) or (first and ch == "-")
-                    or (not seen_dot and ch == ".")):
-                if ch != "":
-                    self._unread()
-                break
-            if ch == ".":
-                seen_dot = True
-                tok = FLOAT
-            buf.append(ch)
-            first = False
-        return tok, pos, "".join(buf)
-
-    def _scan_string(self):
-        pos = (self._line, self._char)
-        ending = self._read()
-        buf = []
-        while True:
-            ch = self._read()
+            if j >= n:
+                self._advance(n)
+                self._i = n + 1  # past-EOF bump, as a char read would
+                return BADSTRING, pos, "".join(buf)
+            ch = s[j]
             if ch == ending:
-                break
-            if ch in ("\n", ""):
+                # The char loop accepts exactly what _STRING_RE does, so
+                # a terminated string can't reach this fallback; if the
+                # regex and loop ever diverge, fail loudly.
+                raise AssertionError(
+                    "string regex / badstring loop divergence")
+            if ch == "\n":
+                self._advance(j + 1)
                 return BADSTRING, pos, "".join(buf)
             if ch == "\\":
-                nxt = self._read()
-                if nxt == "n":
-                    buf.append("\n")
-                elif nxt in ("\\", '"', "'"):
-                    buf.append(nxt)
-                else:
+                if j + 1 >= n:
+                    self._advance(n)
+                    self._i = n + 1
                     return BADSTRING, pos, "".join(buf)
-            else:
-                buf.append(ch)
-        return STRING, pos, "".join(buf)
+                nxt = s[j + 1]
+                if nxt in _ESCAPES:
+                    buf.append(_ESCAPES[nxt])
+                    j += 2
+                    continue
+                self._advance(j + 2)
+                return BADSTRING, pos, "".join(buf)
+            buf.append(ch)
+            j += 1
 
 
 class Parser:
-    """Recursive-descent parser with an unread token buffer
-    (reference scanner.go:216-263 uses an 8-token ring; a list works)."""
+    """Recursive-descent parser over a pre-tokenized stream.
+
+    The reference scans lazily with an 8-token unread ring
+    (scanner.go:216-263); tokenizing the whole query up front with WS
+    dropped gives the same stream semantics while unread becomes an
+    index decrement — the token plumbing was the parse hot path's
+    remaining cost once the scanner went regex."""
 
     def __init__(self, text: str):
-        self._scanner = Scanner(text)
-        self._buf: list[tuple] = []   # pushback stack of (tok, pos, lit)
-        self._history: list[tuple] = []
+        sc = Scanner(text)
+        toks: list[tuple] = []
+        while True:
+            item = sc.scan()
+            if item[0] == WS:
+                continue
+            toks.append(item)
+            if item[0] == EOF:
+                break
+        self._toks = toks
+        self._pos = 0
 
     # -- token stream helpers
 
     def _scan(self):
-        if self._buf:
-            item = self._buf.pop()
-        else:
-            item = self._scanner.scan()
-        self._history.append(item)
-        return item
+        p = self._pos
+        self._pos = p + 1
+        toks = self._toks
+        return toks[p] if p < len(toks) else toks[-1]  # EOF repeats
 
     def _unscan(self, n: int = 1):
-        for _ in range(n):
-            self._buf.append(self._history.pop())
+        self._pos -= n
 
-    def _scan_skip_ws(self):
-        while True:
-            item = self._scan()
-            if item[0] != WS:
-                return item
-
-    def _unscan_skip_ws(self, n: int = 1):
-        """Unscan n non-WS tokens (plus any WS between them)."""
-        count = 0
-        while count < n:
-            if not self._history:
-                return
-            tok = self._history[-1][0]
-            self._unscan()
-            if tok != WS:
-                count += 1
+    # WS never enters the stream, so the skip forms are the plain ones.
+    _scan_skip_ws = _scan
+    _unscan_skip_ws = _unscan
 
     # -- grammar
 
@@ -240,12 +223,16 @@ class Parser:
     def _parse_children(self) -> list[Call]:
         children = []
         while True:
-            tok, _, _ = self._scan_skip_ws()
+            tok, pos, lit = self._scan_skip_ws()
             if tok != IDENT:
                 self._unscan_skip_ws(1)
                 return children
-            tok2, _, _ = self._scan()
-            if tok2 != LPAREN:
+            tok2, pos2, _ = self._scan()
+            # A child call needs LPAREN ADJACENT to the ident — the
+            # reference checks it with a raw (non-WS-skipping) scan
+            # (parser.go:119-126), so "Bitmap (" falls through to args.
+            # The WS-free stream keeps that rule via token positions.
+            if tok2 != LPAREN or pos2 != (pos[0], pos[1] + len(lit)):
                 self._unscan()            # the non-LPAREN token
                 self._unscan_skip_ws(1)   # the IDENT
                 return children

@@ -29,6 +29,26 @@ class TestParser:
         assert inner.name == "Intersect"
         assert [ch.args["rowID"] for ch in inner.children] == [1, 2]
 
+    def test_child_paren_must_be_adjacent(self):
+        """A child call needs LPAREN immediately after the ident — the
+        reference checks IDENT+LPAREN with a raw scan (parser.go:
+        119-126), so "Bitmap (" is not a child and the ident falls
+        through to argument parsing, which then fails on '('."""
+        with pytest.raises(pql.ParseError):
+            pql.parse('Count(Bitmap (rowID=1))')
+        # whitespace before a TOP-LEVEL call's paren stays legal
+        c = parse1('Count (Bitmap(rowID=1))')
+        assert c.name == "Count" and c.children[0].name == "Bitmap"
+
+    def test_unicode_digits_rejected(self):
+        """Number tokens are ASCII-only like the reference's isDigit —
+        a Unicode digit must not silently extend an integer (int()
+        would convert it)."""
+        with pytest.raises(pql.ParseError):
+            pql.parse('SetBit(rowID=5٥)')
+        with pytest.raises(pql.ParseError):
+            pql.parse('SetBit(rowID=-٥)')
+
     def test_children_and_args(self):
         c = parse1('TopN(Bitmap(rowID=1), frame="f", n=5)')
         assert len(c.children) == 1
