@@ -133,6 +133,42 @@ class Executor:
         # counted per executor, surfaced via stats + one-shot warning.
         self.device_fallbacks = 0
         self._fallback_warned = False
+        # Persistent worker pools (created lazily on first fan-out).
+        # Spawning a ThreadPoolExecutor per query cost more than the
+        # whole host-side map at small fan-outs. Three tiers because a
+        # task in one tier blocks on the tier below (node mapper →
+        # pod legs → slice map); a single shared pool could deadlock.
+        self._pools: dict[str, ThreadPoolExecutor] = {}
+        self._pools_mu = threading.Lock()
+
+    def _pool(self, tier: str) -> ThreadPoolExecutor:
+        with self._pools_mu:
+            pool = self._pools.get(tier)
+            if pool is None:
+                size = self.max_workers
+                if tier == "pod" and self.pod is not None:
+                    # Pod legs must all run concurrently — latency is
+                    # the max over legs, not the sum (the old per-query
+                    # pool sized itself to the leg count).
+                    size = max(size, len(self.pod.peers))
+                pool = self._pools[tier] = ThreadPoolExecutor(
+                    max_workers=size,
+                    thread_name_prefix=f"pilosa-exec-{tier}")
+            return pool
+
+    def close(self) -> None:
+        """Shut down the worker pools (idempotent; the executor remains
+        usable afterwards — pools are recreated on demand)."""
+        with self._pools_mu:
+            pools, self._pools = dict(self._pools), {}
+        for pool in pools.values():
+            pool.shutdown(wait=False, cancel_futures=True)
+
+    # Idle pool threads also exit when the executor is collected
+    # (worker threads hold only a weakref to their pool), but bare
+    # Executors that stay referenced would otherwise pin threads for
+    # process lifetime — reclaim eagerly.
+    __del__ = close
 
     def _note_device_fallback(self, where: str, exc: Exception) -> None:
         self.device_fallbacks += 1
@@ -1218,18 +1254,19 @@ class Executor:
 
         result = None
         processed = 0
-        with ThreadPoolExecutor(max_workers=self.max_workers) as pool:
-            futures: dict = {}
+        pool = self._pool("node")
+        futures: dict = {}
 
-            def submit(nodes, slices):
-                for node, node_slices in self._slices_by_node(
-                        nodes, index, slices):
-                    fut = pool.submit(self._mapper_node, node, index, c,
-                                      node_slices, opt, map_fn, reduce_fn,
-                                      local_fn)
-                    futures[fut] = (node, node_slices)
+        def submit(nodes, slices):
+            for node, node_slices in self._slices_by_node(
+                    nodes, index, slices):
+                fut = pool.submit(self._mapper_node, node, index, c,
+                                  node_slices, opt, map_fn, reduce_fn,
+                                  local_fn)
+                futures[fut] = (node, node_slices)
 
-            submit(nodes, slices)
+        submit(nodes, slices)
+        try:
             while processed < len(slices):
                 done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
                 for fut in done:
@@ -1247,6 +1284,14 @@ class Executor:
                         continue
                     result = reduce_fn(result, r)
                     processed += len(node_slices)
+        finally:
+            # On an error path, drain what we started: the pool is
+            # shared with other queries, and the old per-query pool's
+            # exit joined its legs — keep that (cancel what hasn't
+            # started, wait out what has).
+            pending = [f for f in futures if not f.cancel()]
+            if pending:
+                wait(pending)
         return result
 
     def _mapper_node(self, node: Node, index: str, c: Call,
@@ -1275,17 +1320,25 @@ class Executor:
         for s in slices:
             by_pid.setdefault(self.pod.owner_pid(s), []).append(s)
         result = None
-        with ThreadPoolExecutor(max_workers=max(1, len(by_pid))) as pool:
-            futs = []
-            for pid, group in by_pid.items():
-                if pid == self.pod.pid:
-                    futs.append(pool.submit(self._mapper_local, group,
-                                            map_fn, reduce_fn))
-                else:
-                    futs.append(pool.submit(self._exec_pod_remote, pid,
-                                            index, c, group))
+        pool = self._pool("pod")
+        futs = []
+        for pid, group in by_pid.items():
+            if pid == self.pod.pid:
+                futs.append(pool.submit(self._mapper_local, group,
+                                        map_fn, reduce_fn))
+            else:
+                futs.append(pool.submit(self._exec_pod_remote, pid,
+                                        index, c, group))
+        try:
             for fut in futs:
                 result = reduce_fn(result, fut.result())
+        finally:
+            # Shared pool: a failed leg must not abandon its siblings
+            # mid-flight (the caller may re-map these slices onto
+            # replicas — an abandoned leg would execute them twice).
+            pending = [f for f in futs if not f.cancel()]
+            if pending:
+                wait(pending)
         return result
 
     def _exec_pod_remote(self, pid: int, index: str, c: Call,
@@ -1304,8 +1357,6 @@ class Executor:
         if len(slices) == 1:
             return reduce_fn(None, map_fn(slices[0]))
         result = None
-        with ThreadPoolExecutor(
-                max_workers=min(len(slices), self.max_workers)) as pool:
-            for r in pool.map(map_fn, slices):
-                result = reduce_fn(result, r)
+        for r in self._pool("slice").map(map_fn, slices):
+            result = reduce_fn(result, r)
         return result

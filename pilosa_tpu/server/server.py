@@ -164,6 +164,8 @@ class Server:
             self._httpd.server_close()
         if self.cluster.node_set is not None:
             self.cluster.node_set.close()
+        if self.executor is not None:
+            self.executor.close()
         with self._clients_mu:
             for client in self._clients.values():
                 client.close()
