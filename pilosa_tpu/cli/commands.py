@@ -15,31 +15,41 @@ import datetime as dt
 import io
 import mmap
 import random
+import re
 import sys
 import time
 from typing import Optional
+
+import numpy as np
 
 from ..errors import TIME_FORMAT, PilosaError
 
 IMPORT_BUFFER_SIZE = 10_000_000  # bits per import batch (ctl/import.go:58)
 
 
-def _parse_csv_bits(stream, stderr):
+def _parse_csv_bits(stream, stderr, start_rnum: int = 1):
     """CSV rows → Bit triples, streamed (ctl/import.go:119-180)."""
     from ..cluster.client import Bit
-    for rnum, record in enumerate(csv.reader(stream), 1):
+    for rnum, record in enumerate(csv.reader(stream), start_rnum):
         if not record or record[0] == "":
             continue
         if len(record) < 2:
             raise PilosaError(
                 f"bad column count on row {rnum}: col={len(record)}")
+        # Like the reference's strconv.ParseUint (ctl/import.go): ids
+        # are unsigned 64-bit — negatives and overflow are per-row
+        # errors, not wrapped or truncated.
         try:
             row_id = int(record[0])
+            if not 0 <= row_id < 1 << 64:
+                raise ValueError
         except ValueError:
             raise PilosaError(
                 f"invalid row id on row {rnum}: {record[0]!r}")
         try:
             col_id = int(record[1])
+            if not 0 <= col_id < 1 << 64:
+                raise ValueError
         except ValueError:
             raise PilosaError(
                 f"invalid column id on row {rnum}: {record[1]!r}")
@@ -52,6 +62,50 @@ def _parse_csv_bits(stream, stderr):
                     f"invalid timestamp on row {rnum}: {record[2]!r}")
             ts = int(t.replace(tzinfo=dt.timezone.utc).timestamp() * 1e9)
         yield Bit(row_id, col_id, ts)
+
+
+def _parse_csv_arrays(stream, stderr, chunk_lines: int):
+    """CSV → (rows u64, cols u64, ts i64|None) array chunks.
+
+    Fast path: numpy's C CSV parser (np.loadtxt) on each chunk — ~30x
+    the per-record Python loop for the plain ``row,col`` form that bulk
+    imports are. The gate is a single digits-only regex pass over the
+    chunk: numpy's parser is laxer than the reference's ParseUint
+    (negatives wrap under u64, floats truncate, '#' starts a comment),
+    so only chunks that are provably ``digits,digits`` take it. Any
+    other chunk (timestamps, malformed rows) re-parses through
+    _parse_csv_bits, which owns the exact per-row error messages (and
+    their absolute row numbers)."""
+    import itertools
+
+    # ≤19 digits is always < 2^64 — longer runs (possibly past
+    # ParseUint range, where loadtxt silently degrades to float) go to
+    # the exact path, which accepts or rejects them per row.
+    clean = re.compile(r"(?:[0-9]{1,19},[0-9]{1,19}(?:\r?\n|\Z))+\Z")
+    rnum = 1
+    while True:
+        lines = list(itertools.islice(stream, chunk_lines))
+        if not lines:
+            return
+        arr = None
+        if clean.match("".join(lines)):
+            try:
+                arr = np.loadtxt(lines, delimiter=",", dtype=np.uint64,
+                                 ndmin=2, comments=None)
+            except ValueError:
+                pass  # e.g. an id past 2^64: the exact path rejects it
+        if arr is not None and len(arr):
+            yield arr[:, 0], arr[:, 1], None
+        else:
+            bits = list(_parse_csv_bits(iter(lines), stderr,
+                                        start_rnum=rnum))
+            if bits:
+                yield (np.array([b.row_id for b in bits], dtype=np.uint64),
+                       np.array([b.column_id for b in bits],
+                                dtype=np.uint64),
+                       np.array([b.timestamp for b in bits],
+                                dtype=np.int64))
+        rnum += len(lines)
 
 
 def cmd_server(args, stdout, stderr) -> int:
@@ -126,18 +180,12 @@ def cmd_import(args, stdout, stderr) -> int:
     client = Client(args.host)
 
     def import_stream(stream):
-        # Flush every IMPORT_BUFFER_SIZE bits so memory stays flat on
-        # multi-GB files (ctl/import.go:166-171).
-        buf = []
-        for bit in _parse_csv_bits(stream, stderr):
-            buf.append(bit)
-            if len(buf) >= IMPORT_BUFFER_SIZE:
-                print(f"importing {len(buf)} bits", file=stderr)
-                client.import_bits(args.index, args.frame, buf)
-                buf = []
-        if buf:
-            print(f"importing {len(buf)} bits", file=stderr)
-            client.import_bits(args.index, args.frame, buf)
+        # One array chunk per IMPORT_BUFFER_SIZE lines so memory stays
+        # flat on multi-GB files (ctl/import.go:166-171).
+        for rows, cols, ts in _parse_csv_arrays(stream, stderr,
+                                                IMPORT_BUFFER_SIZE):
+            print(f"importing {len(rows)} bits", file=stderr)
+            client.import_arrays(args.index, args.frame, rows, cols, ts)
 
     for path in args.paths:
         print(f"parsing: {path}", file=stderr)
@@ -177,20 +225,27 @@ def cmd_restore(args, stdout, stderr) -> int:
 
 def cmd_sort(args, stdout, stderr) -> int:
     # Sort CSV rows by fragment bit position (ctl/sort.go:49-106).
+    # Key (slice, row*W + col%W) == lexicographic (slice, row, col%W),
+    # which lexsort computes without the u64 overflow of row*W.
     from .. import SLICE_WIDTH
     with open(args.path, newline="") as f:
-        bits = list(_parse_csv_bits(f, stderr))
-    bits.sort(key=lambda b: (b.column_id // SLICE_WIDTH,
-                             b.row_id * SLICE_WIDTH
-                             + b.column_id % SLICE_WIDTH))
-    for b in bits:
-        if b.timestamp:
-            t = dt.datetime.fromtimestamp(
-                b.timestamp / 1e9, dt.timezone.utc)
-            stdout.write(f"{b.row_id},{b.column_id},"
+        chunks = list(_parse_csv_arrays(f, stderr, IMPORT_BUFFER_SIZE))
+    if not chunks:
+        return 0
+    rows = np.concatenate([c[0] for c in chunks])
+    cols = np.concatenate([c[1] for c in chunks])
+    ts = np.concatenate([c[2] if c[2] is not None
+                         else np.zeros(len(c[0]), dtype=np.int64)
+                         for c in chunks])
+    w = np.uint64(SLICE_WIDTH)
+    order = np.lexsort((cols % w, rows, cols // w))
+    for i in order:
+        if ts[i]:
+            t = dt.datetime.fromtimestamp(ts[i] / 1e9, dt.timezone.utc)
+            stdout.write(f"{rows[i]},{cols[i]},"
                          f"{t.strftime(TIME_FORMAT)}\n")
         else:
-            stdout.write(f"{b.row_id},{b.column_id}\n")
+            stdout.write(f"{rows[i]},{cols[i]}\n")
     return 0
 
 

@@ -225,16 +225,22 @@ class Client:
 
     def import_bits(self, index: str, frame: str, bits: list[Bit]) -> None:
         """Group by slice, then POST each group to EVERY owner node."""
-        for slice, group in sorted(group_by_slice(bits).items()):
-            self._import_slice(index, frame, slice, group)
+        self.import_arrays(
+            index, frame,
+            np.fromiter((b.row_id for b in bits), dtype=np.uint64,
+                        count=len(bits)),
+            np.fromiter((b.column_id for b in bits), dtype=np.uint64,
+                        count=len(bits)),
+            np.fromiter((b.timestamp for b in bits), dtype=np.int64,
+                        count=len(bits)))
 
     def _import_slice(self, index: str, frame: str, slice: int,
-                      bits: list[Bit]) -> None:
+                      rows: np.ndarray, cols: np.ndarray,
+                      ts: np.ndarray) -> None:
         req = pb.ImportRequest(
             Index=index, Frame=frame, Slice=slice,
-            RowIDs=[b.row_id for b in bits],
-            ColumnIDs=[b.column_id for b in bits],
-            Timestamps=[b.timestamp for b in bits])
+            RowIDs=rows.tolist(), ColumnIDs=cols.tolist(),
+            Timestamps=ts.tolist())
         body = req.SerializeToString()
         nodes = self.fragment_nodes(index, slice)
         if not nodes:
@@ -251,13 +257,24 @@ class Client:
 
     def import_arrays(self, index: str, frame: str, row_ids, column_ids,
                       timestamps=None) -> None:
+        """Array-native import: group by slice with one stable argsort
+        (the vector form of Bits.GroupBySlice, client.go:1027-1040) and
+        POST each slice's block to every owner."""
         rows = np.asarray(row_ids, dtype=np.uint64)
         cols = np.asarray(column_ids, dtype=np.uint64)
         ts = (np.zeros(len(rows), dtype=np.int64) if timestamps is None
               else np.asarray(timestamps, dtype=np.int64))
-        bits = [Bit(int(r), int(c), int(t))
-                for r, c, t in zip(rows, cols, ts)]
-        self.import_bits(index, frame, bits)
+        if not len(rows):
+            return
+        slices = cols // np.uint64(SLICE_WIDTH)
+        order = np.argsort(slices, kind="stable")
+        rows, cols, ts, slices = (rows[order], cols[order], ts[order],
+                                  slices[order])
+        bounds = np.flatnonzero(slices[1:] != slices[:-1]) + 1
+        for s, e in zip(np.concatenate(([0], bounds)),
+                        np.concatenate((bounds, [len(rows)]))):
+            self._import_slice(index, frame, int(slices[s]),
+                               rows[s:e], cols[s:e], ts[s:e])
 
     # -- export (client.go:392-460) ------------------------------------------
 

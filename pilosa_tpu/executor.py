@@ -164,11 +164,16 @@ class Executor:
         for pool in pools.values():
             pool.shutdown(wait=False, cancel_futures=True)
 
-    # Idle pool threads also exit when the executor is collected
-    # (worker threads hold only a weakref to their pool), but bare
-    # Executors that stay referenced would otherwise pin threads for
-    # process lifetime — reclaim eagerly.
-    __del__ = close
+    def __del__(self):
+        # Idle pool threads also exit when the executor is collected
+        # (worker threads hold only a weakref to their pool), but bare
+        # Executors that stay referenced would otherwise pin threads
+        # for process lifetime — reclaim eagerly. Swallow everything:
+        # at interpreter shutdown, pool internals may be torn down.
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _note_device_fallback(self, where: str, exc: Exception) -> None:
         self.device_fallbacks += 1

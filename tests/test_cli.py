@@ -56,6 +56,51 @@ class TestImportExportSort:
         assert rc == 0, err
         assert "standard_2017" in server.holder.frame("i", "f").views
 
+    def test_import_multislice_groups(self, server, tmp_path):
+        """The vectorized import path must group by slice exactly like
+        Bits.GroupBySlice (client.go:1027-1040)."""
+        setup_schema(server)
+        from pilosa_tpu import SLICE_WIDTH
+        csv_file = tmp_path / "m.csv"
+        csv_file.write_text(f"1,5\n1,{SLICE_WIDTH + 5}\n"
+                            f"7,{2 * SLICE_WIDTH + 3}\n1,6\n")
+        rc, _, err = run(["import", "--host", server.host,
+                          "-i", "i", "-f", "f", str(csv_file)])
+        assert rc == 0, err
+        holder = server.holder
+        assert holder.fragment("i", "f", "standard", 0).row(1).count() == 2
+        assert holder.fragment("i", "f", "standard", 1).row(1).count() == 1
+        assert holder.fragment("i", "f", "standard", 2).row(7).count() == 1
+
+    def test_import_rejects_comment_lines(self, server, tmp_path):
+        """np.loadtxt silently skips '#' lines; the import pipeline must
+        not — the reference parser errors on them (ctl/import.go)."""
+        setup_schema(server)
+        csv_file = tmp_path / "c.csv"
+        csv_file.write_text("1,2\n# not a bit\n3,4\n")
+        rc, _, err = run(["import", "--host", server.host,
+                          "-i", "i", "-f", "f", str(csv_file)])
+        assert rc == 1
+        assert "row 2" in err
+
+    @pytest.mark.parametrize("line,what", [
+        ("-1,2", "row id"),          # negative: u64 would wrap
+        ("1.5,2", "row id"),         # float: loadtxt would truncate
+        ("1,2 # note", "column id"),  # inline comment
+        (f"{1 << 64},2", "row id"),  # past ParseUint range
+    ])
+    def test_import_rejects_non_uint_fields(self, server, tmp_path,
+                                            line, what):
+        """numpy's C parser is laxer than the reference's ParseUint —
+        these must all be per-row errors, never wrapped/truncated bits."""
+        setup_schema(server)
+        csv_file = tmp_path / "bad.csv"
+        csv_file.write_text(f"1,2\n{line}\n")
+        rc, _, err = run(["import", "--host", server.host,
+                          "-i", "i", "-f", "f", str(csv_file)])
+        assert rc == 1
+        assert f"invalid {what} on row 2" in err
+
     def test_import_bad_row(self, server, tmp_path):
         setup_schema(server)
         csv_file = tmp_path / "bad.csv"
