@@ -47,15 +47,6 @@ class Bit:
         self.timestamp = timestamp  # ns since epoch, 0 = none
 
 
-def group_by_slice(bits: list[Bit]) -> dict[int, list[Bit]]:
-    """Group bits by the slice their column falls in
-    (client.go:1027-1040)."""
-    m: dict[int, list[Bit]] = {}
-    for b in bits:
-        m.setdefault(b.column_id // SLICE_WIDTH, []).append(b)
-    return m
-
-
 class Client:
     """HTTP client against one host (plus owner discovery for imports).
 
