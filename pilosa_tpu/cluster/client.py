@@ -19,6 +19,7 @@ from typing import Optional
 import numpy as np
 
 from .. import SLICE_WIDTH
+from ..utils.arrays import group_by_key
 from ..errors import FragmentNotFoundError, PilosaError
 from ..pql import parser as pql
 from ..proto import internal_pb2 as pb
@@ -257,15 +258,9 @@ class Client:
               else np.asarray(timestamps, dtype=np.int64))
         if not len(rows):
             return
-        slices = cols // np.uint64(SLICE_WIDTH)
-        order = np.argsort(slices, kind="stable")
-        rows, cols, ts, slices = (rows[order], cols[order], ts[order],
-                                  slices[order])
-        bounds = np.flatnonzero(slices[1:] != slices[:-1]) + 1
-        for s, e in zip(np.concatenate(([0], bounds)),
-                        np.concatenate((bounds, [len(rows)]))):
-            self._import_slice(index, frame, int(slices[s]),
-                               rows[s:e], cols[s:e], ts[s:e])
+        for slice, rs, cs, tss in group_by_key(
+                cols // np.uint64(SLICE_WIDTH), rows, cols, ts):
+            self._import_slice(index, frame, slice, rs, cs, tss)
 
     # -- export (client.go:392-460) ------------------------------------------
 

@@ -21,6 +21,7 @@ import numpy as np
 from ..errors import PilosaError
 from ..proto import internal_pb2 as pb
 from ..storage import cache as cache_mod
+from ..utils.arrays import group_by_key
 from ..storage.attrs import AttrStore
 from ..utils import timequantum as tq
 from ..utils.stats import NOP
@@ -218,47 +219,70 @@ class Frame:
         cols = np.asarray(column_ids, dtype=np.uint64)
         if len(rows) != len(cols):
             raise ValueError("row/column length mismatch")
-        if timestamps is None:
-            timestamps = [None] * len(rows)
-        else:
+        if timestamps is not None:
             timestamps = list(timestamps)
-        if len(timestamps) != len(rows):
-            raise ValueError("timestamp length mismatch")
+            if len(timestamps) != len(rows):
+                raise ValueError("timestamp length mismatch")
 
         q = self.time_quantum()
-        # data[(view, slice)] = ([rows], [cols])
-        data: dict[tuple[str, int], tuple[list, list]] = {}
-
-        def put(view_name, rid, cid):
-            slice = cid // SLICE_WIDTH
-            key = (view_name, slice)
-            if key not in data:
-                data[key] = ([], [])
-            data[key][0].append(rid)
-            data[key][1].append(cid)
-
+        # data[(view, slice)] = list of (rows, cols) array chunks
+        data: dict[tuple[str, int], list] = {}
         do_standard = views in (None, "standard")
-        do_inverse = views in (None, "inverse")
-        for rid, cid, ts in zip(rows.tolist(), cols.tolist(), timestamps):
-            if do_standard:
-                if ts is None:
-                    standard = [VIEW_STANDARD]
-                else:
-                    standard = tq.views_by_time(VIEW_STANDARD, ts, q)
-                    standard.append(VIEW_STANDARD)
-                for vn in standard:
-                    put(vn, rid, cid)
-            if self.inverse_enabled and do_inverse:
-                if ts is None:
-                    inverse = [VIEW_INVERSE]
-                else:
-                    inverse = tq.views_by_time(VIEW_INVERSE, ts, q)
-                    inverse.append(VIEW_INVERSE)
-                for vn in inverse:
-                    put(vn, cid, rid)  # transpose
+        do_inverse = self.inverse_enabled and views in (None, "inverse")
 
-        for (view_name, slice), (rids, cids) in sorted(data.items()):
+        def put_arrays(view_name, rids_a, cids_a):
+            # One stable argsort groups a whole view's bits by slice —
+            # this is the bulk-import hot lane (per-bit grouping cost
+            # more than the roaring adds it fed).
+            for slice, rs, cs in group_by_key(
+                    cids_a // np.uint64(SLICE_WIDTH), rids_a, cids_a):
+                data.setdefault((view_name, slice), []).append((rs, cs))
+
+        if timestamps is None:
+            plain = np.ones(len(rows), dtype=bool)
+        else:
+            plain = np.array([t is None for t in timestamps], dtype=bool)
+        if plain.any():
+            r0, c0 = rows[plain], cols[plain]
+            if do_standard:
+                put_arrays(VIEW_STANDARD, r0, c0)
+            if do_inverse:
+                put_arrays(VIEW_INVERSE, c0, r0)  # transpose
+
+        if not plain.all():
+            # Timestamped bits fan out to per-quantum time views
+            # (frame.go:538-573) — view membership depends on each
+            # timestamp, so these stay per-bit.
+            lists: dict[tuple[str, int], tuple[list, list]] = {}
+
+            def put(view_name, rid, cid):
+                key = (view_name, cid // SLICE_WIDTH)
+                if key not in lists:
+                    lists[key] = ([], [])
+                lists[key][0].append(rid)
+                lists[key][1].append(cid)
+
+            for i in np.flatnonzero(~plain).tolist():
+                rid, cid, ts = int(rows[i]), int(cols[i]), timestamps[i]
+                if do_standard:
+                    for vn in tq.views_by_time(VIEW_STANDARD, ts, q) + [
+                            VIEW_STANDARD]:
+                        put(vn, rid, cid)
+                if do_inverse:
+                    for vn in tq.views_by_time(VIEW_INVERSE, ts, q) + [
+                            VIEW_INVERSE]:
+                        put(vn, cid, rid)  # transpose
+            for key, (rids, cids) in lists.items():
+                data.setdefault(key, []).append(
+                    (np.array(rids, dtype=np.uint64),
+                     np.array(cids, dtype=np.uint64)))
+
+        for (view_name, slice), chunks in sorted(data.items()):
             view = self.create_view_if_not_exists(view_name)
             frag = view.create_fragment_if_not_exists(slice)
-            frag.import_bits(np.array(rids, dtype=np.uint64),
-                             np.array(cids, dtype=np.uint64))
+            if len(chunks) == 1:
+                rs, cs = chunks[0]
+            else:
+                rs = np.concatenate([c[0] for c in chunks])
+                cs = np.concatenate([c[1] for c in chunks])
+            frag.import_bits(rs, cs)

@@ -1,0 +1,22 @@
+"""Shared vectorized array idioms used across the import/storage paths."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def group_by_key(keys: np.ndarray, *arrays: np.ndarray):
+    """Yield ``(key, sub_array, ...)`` groups of ``arrays`` split by
+    equal values of ``keys``, via one stable argsort — the vector form
+    of a dict-of-lists group-by. Groups come out in ascending key
+    order; within a group, elements keep their input order.
+    """
+    if not len(keys):
+        return
+    order = np.argsort(keys, kind="stable")
+    ks = keys[order]
+    arrs = [a[order] for a in arrays]
+    bounds = np.flatnonzero(ks[1:] != ks[:-1]) + 1
+    for s, e in zip(np.concatenate(([0], bounds)),
+                    np.concatenate((bounds, [len(ks)]))):
+        yield (int(ks[s]), *(a[s:e] for a in arrs))

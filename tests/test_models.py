@@ -125,6 +125,35 @@ class TestFrame:
         finally:
             f.close()
 
+    def test_import_mixed_timestamps_multislice(self, tmp_path):
+        """The vectorized no-timestamp lane and the per-bit time-view
+        lane must compose: one import with plain and timestamped bits
+        across slices, inverse enabled (frame.go:538-573)."""
+        f = Frame(str(tmp_path / "i" / "f"), "i", "f",
+                  options=FrameOptions(inverse_enabled=True,
+                                       time_quantum="YM"))
+        f.open()
+        try:
+            f.import_bits(
+                [1, 2, 3], [5, SLICE_WIDTH, 7],
+                [None, dt.datetime(2017, 3, 4, 10, 30),
+                 dt.datetime(2018, 1, 1)])
+            assert f.views.keys() >= {
+                "standard", "inverse", "standard_2017",
+                "standard_201703", "inverse_2017", "inverse_2018"}
+            std = f.view("standard")
+            assert std.fragment(0).row(1).count() == 1
+            assert std.fragment(0).row(3).count() == 1
+            assert std.fragment(1).row(2).count() == 1  # plain view too
+            inv = f.view("inverse")
+            assert inv.fragment(0).row(5).count() == 1
+            assert inv.fragment(0).row(SLICE_WIDTH).count() == 1
+            assert f.view("standard_201703").fragment(1) \
+                    .row(2).count() == 1
+            assert f.view("inverse_2018").fragment(0).row(7).count() == 1
+        finally:
+            f.close()
+
     def test_max_slice(self, frame):
         frame.set_bit(VIEW_STANDARD, 0, 3 * SLICE_WIDTH + 1)
         assert frame.max_slice() == 3
