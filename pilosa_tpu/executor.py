@@ -396,32 +396,55 @@ class Executor:
             return Bitmap()
         return frag.row(id)
 
-    def _range_slice(self, index: str, c: Call, slice: int) -> Bitmap:
-        # executor.go:490-546: union the minimal time-view cover.
+    def _range_views(self, index: str, c: Call, strict: bool):
+        """Resolve a Range call to ``(frame_name, row_id, view_names)``
+        — the minimal time-view cover (executor.go:490-546). The ONE
+        parse both the host path and the device compiler use, so their
+        semantics can't drift. ``strict`` raises the host path's errors;
+        non-strict returns None (device compile declines, host owns the
+        error). An empty view list means an empty result, not an error
+        (frame without a time quantum, or an out-of-data window)."""
         frame_name = c.args.get("frame") or DEFAULT_FRAME
         frame = self.holder.frame(index, frame_name)
         if frame is None:
+            if not strict:
+                return None
             raise FrameNotFoundError(frame_name)
         row_id, ok = c.uint_arg(frame.row_label)
         if not ok:
+            if not strict:
+                return None
             raise PilosaError(
                 f"Range() row field '{frame.row_label}' required")
         start = c.args.get("start")
         if start is None:
+            if not strict:
+                return None
             raise PilosaError("Range() start time required")
         end = c.args.get("end")
         if end is None:
+            if not strict:
+                return None
             raise PilosaError("Range() end time required")
         try:
             start_t = dt.datetime.strptime(start, TIME_FORMAT)
             end_t = dt.datetime.strptime(end, TIME_FORMAT)
         except (TypeError, ValueError):
+            if not strict:
+                return None
             raise PilosaError("cannot parse Range() time")
         q = frame.time_quantum()
         if not q:
-            return Bitmap()
+            return frame_name, row_id, []
+        return (frame_name, row_id,
+                tq.views_by_time_range(VIEW_STANDARD, start_t, end_t, q))
+
+    def _range_slice(self, index: str, c: Call, slice: int) -> Bitmap:
+        # executor.go:490-546: union the minimal time-view cover.
+        frame_name, row_id, views = self._range_views(index, c,
+                                                      strict=True)
         bm = Bitmap()
-        for view in tq.views_by_time_range(VIEW_STANDARD, start_t, end_t, q):
+        for view in views:
             frag = self.holder.fragment(index, frame_name, view, slice)
             if frag is None:
                 continue
@@ -570,11 +593,24 @@ class Executor:
     def _compile_device_expr(self, index: str, c: Call, leaves: list):
         """Compile a pure bitmap call tree into a mesh.count_expr tree.
 
-        Supported: Bitmap leaves (standard or inverse) combined with
-        Intersect/Union/Difference. Returns None when the tree contains
-        anything else (Range, malformed args, missing frames) — those run
-        through the per-slice path, which owns the error semantics.
+        Supported: Bitmap leaves (standard or inverse) and Range (an
+        or-fold over its minimal time-view cover — a leaf per view,
+        executor.go:490-546) combined with Intersect/Union/Difference.
+        Returns None when the tree contains anything else (malformed
+        args, missing frames, no time quantum) — those run through the
+        per-slice path, which owns the error semantics.
         """
+        if c.name == "Range":
+            parsed = self._range_views(index, c, strict=False)
+            if parsed is None or not parsed[2]:
+                return None  # malformed or empty cover: host path owns it
+            frame_name, row_id, views = parsed
+            expr = None
+            for vn in views:
+                leaves.append((frame_name, vn, row_id))
+                part = ("leaf", len(leaves) - 1)
+                expr = part if expr is None else ("or", expr, part)
+            return expr
         if c.name == "Bitmap":
             idx = self.holder.index(index)
             if idx is None:

@@ -461,18 +461,68 @@ class TestDeviceCountPath:
         assert called["n_leaves"] == 2
         assert res[0] >= 3  # the three overlap columns, one per slice
 
-    def test_range_falls_back(self, holder):
-        """Range inside Count isn't device-eligible — must still answer."""
+    def test_range_on_device_matches_host(self, holder, monkeypatch):
+        """Range compiles to an or-fold over its time-view cover
+        (executor.go:490-546 semantics on the mesh path)."""
+        import numpy as np
         idx = holder.create_index_if_not_exists("i")
         idx.create_frame_if_not_exists(
             "tq", FrameOptions(time_quantum="YMD"))
-        ex = Executor(holder, host="local", use_mesh=True)
-        ex.execute("i", 'SetBit(rowID=1, frame=tq, columnID=5,'
-                        ' timestamp="2017-01-02T00:00")')
+        rng = np.random.default_rng(13)
+        write = Executor(holder, host="local", use_mesh=False)
+        for day in (2, 3, 9, 28):
+            for col in rng.choice(3 * SLICE_WIDTH, size=40, replace=False):
+                write.execute(
+                    "i", f'SetBit(rowID=1, frame=tq, columnID={int(col)},'
+                         f' timestamp="2017-01-{day:02d}T00:00")')
+        queries = [
+            'Count(Range(rowID=1, frame=tq,'
+            ' start="2017-01-01T00:00", end="2017-02-01T00:00"))',
+            'Count(Range(rowID=1, frame=tq,'
+            ' start="2017-01-03T00:00", end="2017-01-10T00:00"))',
+            # Range composed with a plain Bitmap leaf
+            'Count(Intersect(Range(rowID=1, frame=tq,'
+            ' start="2017-01-01T00:00", end="2018-01-01T00:00"),'
+            ' Bitmap(rowID=1, frame=tq)))',
+            # empty cover window
+            'Count(Range(rowID=1, frame=tq,'
+            ' start="2016-01-01T00:00", end="2016-02-01T00:00"))',
+        ]
+        fast = Executor(holder, host="local", use_mesh=True,
+                        mesh_min_slices=1)
+        slow = Executor(holder, host="local", use_mesh=False)
+        # Prove the device path actually executes the Range form — a
+        # compile regression to None would make fast == slow trivially.
+        engaged = []
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        orig = mesh_mod.count_expr_sharded
+
+        def spy(mesh, expr, arrs):
+            engaged.append(len(arrs))
+            return orig(mesh, expr, arrs)
+
+        monkeypatch.setattr(mesh_mod, "count_expr_sharded", spy)
+        for q in queries:
+            assert fast.execute("i", q) == slow.execute("i", q), q
+        assert fast.device_fallbacks == 0
+        # All 4 engage — the time cover is by WINDOW, not data, so the
+        # out-of-data 2016 window still compiles (absent fragments pack
+        # as zeros). Jan 3→10 covers exactly 7 day views.
+        assert engaged == [1, 7, 2, 1], engaged
+
+    def test_range_without_quantum_falls_back(self, holder):
+        """Range on a quantum-less frame isn't device-eligible — must
+        still answer through the host path (which owns the semantics:
+        empty bitmap)."""
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists("plain")
+        ex = Executor(holder, host="local", use_mesh=True,
+                      mesh_min_slices=1)
+        ex.execute("i", 'SetBit(rowID=1, frame=plain, columnID=5)')
         res = ex.execute(
-            "i", 'Count(Range(rowID=1, frame=tq,'
+            "i", 'Count(Range(rowID=1, frame=plain,'
                  ' start="2017-01-01T00:00", end="2017-02-01T00:00"))')
-        assert res[0] == 1
+        assert res[0] == 0
 
 
 class TestDeviceTopNPath:
