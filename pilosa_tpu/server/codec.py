@@ -11,7 +11,12 @@ from __future__ import annotations
 import base64
 from typing import Optional
 
+import numpy as np
+
+from .. import SLICE_WIDTH
 from ..proto import internal_pb2 as pb
+from ..storage import roaring
+from ..utils.arrays import group_by_key
 from ..storage.attrs import (ATTR_TYPE_BOOL, ATTR_TYPE_FLOAT, ATTR_TYPE_INT,
                              ATTR_TYPE_STRING)
 from ..storage.bitmap import Bitmap
@@ -54,14 +59,20 @@ def decode_attr_list(attrs) -> dict:
 # -- bitmap / pairs -----------------------------------------------------------
 
 def encode_bitmap(bm: Bitmap) -> pb.Bitmap:
-    return pb.Bitmap(Bits=[int(b) for b in bm.bits()],
+    return pb.Bitmap(Bits=bm.bits().tolist(),
                      Attrs=encode_attr_list(bm.attrs))
 
 
 def decode_bitmap(msg: pb.Bitmap) -> Bitmap:
+    """Rebuild the segmented result bitmap from the wire bit list in
+    bulk (one roaring build per slice, not one add per bit)."""
     bm = Bitmap()
-    for bit in msg.Bits:
-        bm.set_bit(bit)
+    if msg.Bits:
+        cols = np.fromiter(msg.Bits, dtype=np.uint64, count=len(msg.Bits))
+        for slice, group in group_by_key(cols // np.uint64(SLICE_WIDTH),
+                                         cols):
+            bm.add_segment(roaring.Bitmap.from_sorted(group), slice,
+                           writable=True)
     bm.attrs = decode_attr_list(msg.Attrs)
     return bm
 

@@ -18,6 +18,8 @@ import re
 from typing import Callable, Optional
 from urllib.parse import parse_qs
 
+import numpy as np
+
 from .. import __version__
 from ..cluster.broadcast import NOP_BROADCASTER, unmarshal_message
 from ..errors import (FrameExistsError, IndexExistsError, PilosaError,
@@ -468,8 +470,9 @@ class Handler:
         attr_sets = []
         if column_attrs:
             idx = self.holder.index(index_name)
-            ids = sorted({int(b) for r in results
-                          if isinstance(r, Bitmap) for b in r.bits()})
+            arrs = [r.bits() for r in results if isinstance(r, Bitmap)]
+            ids = (np.unique(np.concatenate(arrs)).tolist()
+                   if arrs else [])
             for id in ids:
                 attrs = idx.column_attr_store.attrs(id)
                 if attrs:

@@ -172,7 +172,7 @@ class Bitmap:
         return np.concatenate(parts)
 
     def to_json(self) -> dict:
-        return {"attrs": self.attrs, "bits": [int(b) for b in self.bits()]}
+        return {"attrs": self.attrs, "bits": self.bits().tolist()}
 
 
 def union_all(bitmaps: Iterable[Bitmap]) -> Bitmap:
