@@ -842,17 +842,35 @@ class TestDevicePathFuzz:
             for col in cols:
                 f.set_bit("standard", row, int(col))
 
+        # A time-quantum frame so random leaves can also be Range calls
+        # (compiled as or-folds over their time-view covers).
+        tqf = idx.create_frame_if_not_exists(
+            "tqf", FrameOptions(time_quantum="YMD"))
+        slow = Executor(holder, host="local", use_mesh=False)
+        for day in (1, 5, 14, 27):
+            for col in rng.choice(slices * SLICE_WIDTH, size=30,
+                                  replace=False):
+                slow.execute(
+                    "i", f'SetBit(rowID=1, frame=tqf, columnID={int(col)},'
+                         f' timestamp="2017-06-{day:02d}T00:00")')
+
+        def rand_leaf():
+            if rng.random() < 0.25:
+                d0, d1 = sorted(rng.integers(1, 29, size=2).tolist())
+                return (f'Range(rowID=1, frame=tqf,'
+                        f' start="2017-06-{d0:02d}T00:00",'
+                        f' end="2017-06-{d1 + 1:02d}T00:00")')
+            return f'Bitmap(rowID={int(rng.integers(n_rows + 1))}, frame=f)'
+
         def rand_expr(depth):
             if depth == 0 or rng.random() < 0.4:
-                return f'Bitmap(rowID={int(rng.integers(n_rows + 1))},' \
-                       ' frame=f)'
+                return rand_leaf()
             op = rng.choice(["Intersect", "Union", "Difference"])
             k = int(rng.integers(2, 4))
             return f"{op}({', '.join(rand_expr(depth - 1) for _ in range(k))})"
 
         fast = Executor(holder, host="local", use_mesh=True,
                         mesh_min_slices=1)
-        slow = Executor(holder, host="local", use_mesh=False)
         for _ in range(25):
             q = f"Count({rand_expr(2)})"
             assert fast.execute("i", q) == slow.execute("i", q), q
