@@ -285,9 +285,17 @@ class Parser:
             return lit
         if tok == INTEGER:
             try:
-                return int(lit)
+                v = int(lit)
             except ValueError:
                 raise ParseError(pos, f"invalid integer: {lit!r}")
+            # int64 bounds, like the reference's strconv.ParseInt(lit,
+            # 10, 64) (parser.go:186,243) — larger ids are unparseable
+            # there, and letting them through would let one stray
+            # SetBit push max_slice past 2^43 and explode every later
+            # query's slice enumeration.
+            if not -(1 << 63) <= v < 1 << 63:
+                raise ParseError(pos, f"invalid integer: {lit!r}")
+            return v
         if tok == FLOAT and not in_list:
             try:
                 return float(lit)

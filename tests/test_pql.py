@@ -40,6 +40,19 @@ class TestParser:
         c = parse1('Count (Bitmap(rowID=1))')
         assert c.name == "Count" and c.children[0].name == "Bitmap"
 
+    def test_int64_bounds(self):
+        """Integers parse as int64 like the reference (parser.go:186):
+        out-of-range ids fail at parse, which also keeps a stray huge
+        columnID from exploding max_slice."""
+        assert parse1(f"X(a={2**63 - 1})").args["a"] == 2**63 - 1
+        assert parse1(f"X(a={-2**63})").args["a"] == -(2**63)
+        with pytest.raises(pql.ParseError):
+            pql.parse(f"X(a={2**63})")
+        with pytest.raises(pql.ParseError):
+            pql.parse(f"SetBit(columnID={2**70})")
+        with pytest.raises(pql.ParseError):
+            pql.parse(f"X(a=[1, {2**64}])")
+
     def test_unicode_digits_rejected(self):
         """Number tokens are ASCII-only like the reference's isDigit —
         a Unicode digit must not silently extend an integer (int()
