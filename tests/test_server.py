@@ -100,6 +100,67 @@ class TestSingleNode:
         finally:
             s2.close()
 
+    def test_soak_mixed_mutations_multi_restart(self, tmp_path):
+        """Durability soak: three write/verify/restart cycles mixing
+        per-op PQL SetBit/ClearBit (WAL appends + MAX_OP_N snapshot
+        churn) with bulk imports (snapshot rewrites), cross-checking
+        full row contents and exact TopN counts after every restart."""
+        import random
+        rng = random.Random(7)
+        want: dict[int, set[int]] = {r: set() for r in range(6)}
+
+        def check(h):
+            for row, cols in want.items():
+                _, body = http_post(h, "/index/qi/query",
+                                    f'Bitmap(frame="qf", '
+                                    f'rowID={row})'.encode())
+                assert json.loads(body)["results"][0]["bits"] \
+                    == sorted(cols), row
+            ids = sorted(want)
+            _, body = http_post(h, "/index/qi/query",
+                                f'TopN(frame="qf", ids={ids})'.encode())
+            got = {p["id"]: p["count"]
+                   for p in json.loads(body)["results"][0]}
+            assert got == {r: len(c) for r, c in want.items() if c}
+
+        for cycle in range(3):
+            s = make_server(tmp_path, "soak")
+            s.open()
+            host = s.host
+            if cycle == 0:
+                http_post(host, "/index/qi", b"{}")
+                http_post(host, "/index/qi/frame/qf", b"{}")
+            check(host)  # previous cycle's state survived the restart
+            for _ in range(150):
+                row = rng.randrange(6)
+                col = rng.randrange(2 * (1 << 20))
+                if rng.random() < 0.25 and want[row]:
+                    col = rng.choice(sorted(want[row]))
+                    http_post(host, "/index/qi/query",
+                              f'ClearBit(frame="qf", rowID={row}, '
+                              f'columnID={col})'.encode())
+                    want[row].discard(col)
+                else:
+                    http_post(host, "/index/qi/query",
+                              f'SetBit(frame="qf", rowID={row}, '
+                              f'columnID={col})'.encode())
+                    want[row].add(col)
+            # One bulk import per cycle: snapshot path, distinct rows
+            bulk = [(rng.randrange(6), rng.randrange(2 * (1 << 20)))
+                    for _ in range(2000)]
+            Client(host).import_bits(
+                "qi", "qf", [Bit(r, c) for r, c in bulk])
+            for r, c in bulk:
+                want[r].add(c)
+            check(host)
+            s.close()
+        s = make_server(tmp_path, "soak")
+        s.open()
+        try:
+            check(s.host)
+        finally:
+            s.close()
+
     def test_restart_persists(self, tmp_path):
         s = make_server(tmp_path, "sp")
         s.open()
