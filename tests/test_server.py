@@ -237,6 +237,53 @@ class TestTwoNodeCluster:
         assert owner_server.holder.fragment(
             "i", "f", "standard", 0).row(1).count() == 3
 
+    def test_replica_failover_serves_reads(self, tmp_path):
+        """ReplicaN=2 over two real servers: writes fan to both owners;
+        after one node dies, queries through the survivor re-map the
+        dead node's slices onto its replica (executor.go:1137-1151)
+        and still return exact results."""
+        import random
+        s1 = make_server(tmp_path, "f1")
+        s2 = make_server(tmp_path, "f2")
+        s1.open()
+        s2.open()
+        try:
+            try:
+                cross_wire(s1, s2)
+                s1.cluster.replica_n = 2
+                s2.cluster.replica_n = 2
+                self._create_everywhere((s1, s2))
+                rng = random.Random(5)
+                want: dict[int, set[int]] = {}
+                for _ in range(80):
+                    row = rng.randrange(4)
+                    col = rng.randrange(8 * (1 << 20))
+                    http_post(s1.host, "/index/i/query",
+                              f'SetBit(frame="f", rowID={row}, '
+                              f'columnID={col})'.encode())
+                    want.setdefault(row, set()).add(col)
+                # The jump hash (index name + slice → node INDEX, port-
+                # independent) must give the to-be-killed node at least
+                # one primary, or this wouldn't exercise retry.
+                primaries = {s1.cluster.fragment_nodes("i", sl)[0].host
+                             for sl in range(8)}
+                assert s2.host in primaries
+            finally:
+                s2.close()
+            for row, cols in want.items():
+                _, body = http_post(
+                    s1.host, "/index/i/query",
+                    f'Count(Bitmap(frame="f", rowID={row}))'.encode())
+                assert json.loads(body) == {"results": [len(cols)]}, row
+            _, body = http_post(
+                s1.host, "/index/i/query",
+                f'TopN(frame="f", ids={sorted(want)})'.encode())
+            got = {p["id"]: p["count"]
+                   for p in json.loads(body)["results"][0]}
+            assert got == {r: len(c) for r, c in want.items()}
+        finally:
+            s1.close()
+
     def test_http_broadcast_schema_propagation(self, tmp_path):
         s1 = make_server(tmp_path, "b1")
         s2 = make_server(tmp_path, "b2")
