@@ -362,6 +362,54 @@ def config_residency_repeat_latency() -> None:
         holder.close()
 
 
+def config_host_write_and_import() -> None:
+    """Host write-side throughput (the device only serves reads): bulk
+    CSV parse, server-side bulk apply, and per-op SetBit through the
+    executor — the round-2 host-path optimizations, reproducible."""
+    import io
+    import random
+    import tempfile
+
+    from pilosa_tpu.cli.commands import _parse_csv_arrays
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models.holder import Holder
+
+    n = int(1_000_000 * SCALE)
+    random.seed(0)
+    buf = io.StringIO()
+    for _ in range(n):
+        buf.write(f"{random.randrange(100)},{random.randrange(1 << 22)}\n")
+    buf.seek(0)
+    t0 = time.perf_counter()
+    chunks = list(_parse_csv_arrays(buf, sys.stderr, 10_000_000))
+    emit("host_csv_parse", n / (time.perf_counter() - t0), "bits/sec")
+
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(d)
+        holder.open()
+        try:
+            frame = holder.create_index("bench").create_frame("f")
+            t0 = time.perf_counter()
+            for rows, cols, ts in chunks:
+                frame.import_bits(rows, cols, ts)
+            emit("host_import_apply", n / (time.perf_counter() - t0),
+                 "bits/sec")
+
+            ex = Executor(holder, host="local", use_mesh=False)
+            k = int(5000 * SCALE)
+            ex.execute("bench", 'SetBit(frame="f", rowID=0, columnID=0)')
+            t0 = time.perf_counter()
+            for i in range(k):
+                ex.execute("bench",
+                           f'SetBit(frame="f", rowID={i % 50},'
+                           f' columnID={i * 13 % (1 << 20)})')
+            emit("host_setbit_inprocess", k / (time.perf_counter() - t0),
+                 "ops/sec")
+            ex.close()
+        finally:
+            holder.close()
+
+
 def main() -> None:
     for fn in (config1_fragment_intersect_count,
                config2_union_difference_1k_rows,
@@ -369,7 +417,8 @@ def main() -> None:
                config3_topn_latency,
                config4_mesh_count_over_slices,
                config5_cluster_topn,
-               config_residency_repeat_latency):
+               config_residency_repeat_latency,
+               config_host_write_and_import):
         try:
             fn()
         except Exception as e:  # noqa: BLE001 - report and continue
