@@ -302,6 +302,53 @@ class TestTwoNodeCluster:
             s1.close()
             s2.close()
 
+    def test_gossip_backed_servers_merge_schema(self, tmp_path):
+        """Full gossip integration at the Server level (the cmd_server
+        wiring): node B joins via seed, learns A's schema through the
+        push-pull full-state exchange (server.go:306-387 StatusHandler),
+        membership converges both ways, and a later create on B reaches
+        A through the gossip broadcast channel."""
+        from test_gossip import wait_until
+
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+
+        def gossip_server(name, seeds):
+            # ":0" throughout — Server.open resolves the real port and
+            # renames the cluster node AND the node_set host
+            # (server.py ":0" rebind), so no pre-picked-port race.
+            ns = GossipNodeSet("127.0.0.1:0", gossip_host="127.0.0.1:0",
+                               seeds=seeds, probe_interval=0.1,
+                               probe_timeout=0.2, push_pull_interval=0.25)
+            s = Server(str(tmp_path / name), host="127.0.0.1:0",
+                       broadcast_receiver=ns, broadcaster=ns,
+                       anti_entropy_interval=0, polling_interval=0)
+            s.cluster.node_set = ns
+            s.open()
+            return s, ns
+
+        sa, ga = gossip_server("ga", [])
+        sb = None
+        try:
+            http_post(sa.host, "/index/gi", b"{}")
+            http_post(sa.host, "/index/gi/frame/gf", b"{}")
+            sb, gb = gossip_server("gb", [ga.gossip_host])
+            assert wait_until(
+                lambda: sb.holder.frame("gi", "gf") is not None), \
+                "schema did not merge via push-pull"
+            want = {sa.host, sb.host}
+            assert wait_until(
+                lambda: {n.host for n in ga.nodes()} == want
+                and {n.host for n in gb.nodes()} == want), \
+                "membership did not converge"
+            http_post(sb.host, "/index/gj", b"{}")
+            assert wait_until(
+                lambda: sa.holder.index("gj") is not None), \
+                "gossip broadcast did not deliver the create"
+        finally:
+            if sb is not None:
+                sb.close()
+            sa.close()
+
     def test_max_slice_polling(self, pair):
         s1, s2 = pair
         self._create_everywhere(pair)
