@@ -108,19 +108,55 @@ def _parse_csv_arrays(stream, stderr, chunk_lines: int):
         rnum += len(lines)
 
 
-def cmd_server(args, stdout, stderr) -> int:
-    from ..cluster.broadcast import HTTPBroadcaster
-    from ..cluster.topology import Cluster, Node
-    from ..server.server import Server
+def load_server_config(args, env=None):
+    """Config for the server subcommand with flags > env > file priority
+    (reference cmd/root.go:99-153 viper merge; flags cmd/server.go:88-104).
+    ``load`` applies defaults ← file ← env; explicit flags overlay last."""
     from ..utils import config as config_mod
 
-    cfg = config_mod.load(args.config or "")
+    cfg = config_mod.load(args.config or "", env=env)
     if args.data_dir:
         cfg.data_dir = args.data_dir
     if args.bind:
         cfg.host = args.bind
     if getattr(args, "plugins_path", ""):
         cfg.plugins_path = args.plugins_path
+    if getattr(args, "log_path", ""):
+        cfg.log_path = args.log_path
+    if getattr(args, "cluster_hosts", ""):
+        cfg.cluster.hosts = [h.strip() for h in
+                             args.cluster_hosts.split(",") if h.strip()]
+    if getattr(args, "cluster_internal_hosts", ""):
+        cfg.cluster.internal_hosts = [
+            h.strip() for h in args.cluster_internal_hosts.split(",")
+            if h.strip()]
+    if getattr(args, "cluster_replicas", None) is not None:
+        cfg.cluster.replica_n = args.cluster_replicas
+    if getattr(args, "cluster_type", ""):
+        cfg.cluster.type = args.cluster_type
+    if getattr(args, "cluster_internal_port", ""):
+        cfg.cluster.internal_port = args.cluster_internal_port
+    if getattr(args, "cluster_gossip_seed", ""):
+        cfg.cluster.gossip_seed = args.cluster_gossip_seed
+    if getattr(args, "cluster_poll_interval", None) is not None:
+        cfg.cluster.polling_interval = args.cluster_poll_interval
+    if getattr(args, "anti_entropy_interval", None) is not None:
+        cfg.anti_entropy_interval = args.anti_entropy_interval
+    return cfg
+
+
+def cmd_server(args, stdout, stderr) -> int:
+    from ..cluster.broadcast import HTTPBroadcaster
+    from ..cluster.topology import Cluster, Node
+    from ..server.server import Server
+    from ..utils import logger as logger_mod
+
+    cfg = load_server_config(args)
+    import os
+    if cfg.log_path:
+        logger = logger_mod.Logger.open(os.path.expanduser(cfg.log_path))
+    else:
+        logger = logger_mod.Logger(stderr)
 
     cluster = None
     if cfg.cluster.hosts:
@@ -131,7 +167,6 @@ def cmd_server(args, stdout, stderr) -> int:
             nodes.append(Node(h, internal_host=ih))
         cluster = Cluster(nodes=nodes, replica_n=cfg.cluster.replica_n)
 
-    import os
     broadcast_receiver = None
     gossip_set = None
     if cfg.cluster.type == "gossip":
@@ -140,7 +175,7 @@ def cmd_server(args, stdout, stderr) -> int:
         gossip_set = GossipNodeSet(
             cfg.host, gossip_host=f"{bind_host}:{cfg.cluster.internal_port}",
             seeds=[cfg.cluster.gossip_seed] if cfg.cluster.gossip_seed
-            else [])
+            else [], logger=logger)
         if cluster is None:
             cluster = Cluster(nodes=[Node(cfg.host)])
         cluster.node_set = gossip_set
@@ -148,7 +183,8 @@ def cmd_server(args, stdout, stderr) -> int:
     server = Server(os.path.expanduser(cfg.data_dir), host=cfg.host,
                     cluster=cluster, broadcast_receiver=broadcast_receiver,
                     anti_entropy_interval=cfg.anti_entropy_interval,
-                    polling_interval=cfg.cluster.polling_interval)
+                    polling_interval=cfg.cluster.polling_interval,
+                    logger=logger)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -172,6 +208,7 @@ def cmd_server(args, stdout, stderr) -> int:
         if profiler is not None:
             profiler.stop()
         server.close()
+        logger.close()
     return 0
 
 
@@ -337,16 +374,40 @@ def build_parser() -> argparse.ArgumentParser:
         description="TPU-native distributed bitmap index")
     sub = p.add_subparsers(dest="command", required=True)
 
+    # Full server flag surface (reference cmd/server.go:88-104).
+    from ..utils.config import parse_duration
     s = sub.add_parser("server", help="run a pilosa-tpu node")
     s.add_argument("-d", "--data-dir", default="")
     s.add_argument("-b", "--bind", default="",
                    help="host:port to listen on (default localhost:10101)")
     s.add_argument("-c", "--config", default="", help="TOML config file")
+    s.add_argument("--log-path", dest="log_path", default="",
+                   help="log file path (default stderr)")
+    s.add_argument("--cluster.replicas", dest="cluster_replicas",
+                   type=int, default=None, metavar="N",
+                   help="number of hosts each piece of data is stored on")
+    s.add_argument("--cluster.hosts", dest="cluster_hosts", default="",
+                   help="comma-separated list of hosts in cluster")
+    s.add_argument("--cluster.internal-hosts",
+                   dest="cluster_internal_hosts", default="",
+                   help="comma-separated internal-communication hosts")
+    s.add_argument("--cluster.type", dest="cluster_type", default="",
+                   choices=["", "static", "http", "gossip"],
+                   help="cluster membership backend")
+    s.add_argument("--cluster.internal-port", dest="cluster_internal_port",
+                   default="", help="internal state-sharing (gossip) port")
+    s.add_argument("--cluster.gossip-seed", dest="cluster_gossip_seed",
+                   default="", help="host:port to seed gossip membership")
+    s.add_argument("--cluster.poll-interval", dest="cluster_poll_interval",
+                   type=parse_duration, default=None, metavar="DUR",
+                   help="max-slice polling interval (e.g. 60s)")
+    s.add_argument("--anti-entropy.interval", dest="anti_entropy_interval",
+                   type=parse_duration, default=None, metavar="DUR",
+                   help="anti-entropy sweep interval (e.g. 10m)")
     # Profiling flags (reference cmd/server.go:47-62,99-100).
     s.add_argument("--profile.cpu", dest="profile_cpu", default="",
                    metavar="PATH",
                    help="write a sampled CPU profile to PATH")
-    from ..utils.config import parse_duration
     s.add_argument("--plugins.path", dest="plugins_path", default="",
                    help="path to plugin directory (accepted but inert, "
                         "as in the reference at this vintage)")

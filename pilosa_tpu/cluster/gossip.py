@@ -37,6 +37,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..utils import logger as logger_mod
 from .broadcast import marshal_message, unmarshal_message
 from .topology import Node
 
@@ -102,8 +103,9 @@ class GossipNodeSet:
                  seeds: Optional[list[str]] = None,
                  probe_interval: float = 1.0, probe_timeout: float = 0.5,
                  push_pull_interval: float = 15.0, suspect_after: int = 3,
-                 retransmit_mult: int = 3):
+                 retransmit_mult: int = 3, logger=logger_mod.NOP):
         self.host = host
+        self.logger = logger
         self.gossip_host = gossip_host or f"localhost:{DEFAULT_GOSSIP_PORT}"
         self.seeds = list(seeds or [])
         self.probe_interval = probe_interval
@@ -168,6 +170,8 @@ class GossipNodeSet:
         if adv_host in ("", "0.0.0.0", "::"):
             adv_host = _primary_ip()
         self.gossip_host = f"{adv_host}:{port}"
+        self.logger.printf("gossip: listening on %s (node %s)",
+                           self.gossip_host, self.host)
         with self._mu:
             self._members[self.host] = Member(self.host, self.gossip_host)
 
@@ -260,6 +264,7 @@ class GossipNodeSet:
         alive. A dead rumor about *ourselves* is refuted by re-announcing
         alive with a bumped incarnation."""
         deliver_update = False
+        log_line = None
         with self._mu:
             cur = self._members.get(w.name)
             if w.name == self.host:
@@ -267,19 +272,28 @@ class GossipNodeSet:
                 if w.state == STATE_DEAD and w.incarnation >= me.incarnation:
                     me.incarnation = w.incarnation + 1  # refute
                     deliver_update = True
+                    log_line = ("gossip: refuting death rumor about self"
+                                f" (inc={me.incarnation})")
             elif cur is None:
                 self._members[w.name] = Member(w.name, w.addr,
                                                w.incarnation, w.state)
                 deliver_update = True
+                log_line = (f"gossip: member joined: {w.name} ({w.addr})"
+                            f" state={w.state}")
             elif (w.incarnation > cur.incarnation
                   or (w.incarnation == cur.incarnation
                       and w.state == STATE_DEAD
                       and cur.state != STATE_DEAD)):
+                if cur.state != w.state:
+                    log_line = (f"gossip: member {w.name} {cur.state}"
+                                f" -> {w.state} (inc={w.incarnation})")
                 cur.incarnation = w.incarnation
                 cur.state = w.state
                 cur.addr = w.addr
                 cur.fails = 0
                 deliver_update = True
+        if log_line:
+            self.logger.printf("%s", log_line)
         if deliver_update:
             self._gossip_update(self._member_snapshot(w.name))
 
@@ -483,6 +497,9 @@ class GossipNodeSet:
                 dead = Member(cur.name, cur.addr, cur.incarnation,
                               STATE_DEAD)
         if dead is not None:
+            self.logger.printf(
+                "gossip: node %s missed %d probes, declaring dead",
+                dead.name, self.suspect_after)
             self._gossip_update(dead)
 
 

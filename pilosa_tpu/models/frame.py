@@ -23,6 +23,7 @@ from ..proto import internal_pb2 as pb
 from ..storage import cache as cache_mod
 from ..utils.arrays import group_by_key
 from ..storage.attrs import AttrStore
+from ..utils import logger as logger_mod
 from ..utils import timequantum as tq
 from ..utils.stats import NOP
 from .view import (VIEW_INVERSE, VIEW_STANDARD, View, is_inverse_view,
@@ -60,7 +61,8 @@ class FrameOptions:
 class Frame:
     def __init__(self, path: str, index: str, name: str,
                  options: Optional[FrameOptions] = None,
-                 on_create_slice=None, stats=NOP):
+                 on_create_slice=None, stats=NOP, logger=logger_mod.NOP):
+        self.logger = logger
         self.path = path
         self.index = index
         self.name = name
@@ -141,7 +143,8 @@ class Frame:
                     cache_size=self.options.cache_size,
                     row_attr_store=self.row_attr_store,
                     on_create_slice=self._announce_slice(name),
-                    stats=self.stats.with_tags(f"view:{name}"))
+                    stats=self.stats.with_tags(f"view:{name}"),
+                    logger=self.logger)
 
     def _announce_slice(self, view_name: str):
         if self.on_create_slice is None:

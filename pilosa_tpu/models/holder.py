@@ -14,22 +14,26 @@ import threading
 from typing import Optional
 
 from ..errors import IndexExistsError, validate_name
+from ..utils import logger as logger_mod
 from ..utils.stats import NOP
 from .index import Index, IndexOptions
 
 
 class Holder:
-    def __init__(self, path: str, on_create_slice=None, stats=NOP):
+    def __init__(self, path: str, on_create_slice=None, stats=NOP,
+                 logger=logger_mod.NOP):
         self.path = path
         self.indexes: dict[str, Index] = {}
         self.on_create_slice = on_create_slice  # fn(index, slice, inverse)
         self.stats = stats
+        self.logger = logger
         self._mu = threading.RLock()
 
     # -- lifecycle
 
     def open(self) -> None:
         with self._mu:
+            self.logger.printf("open holder path: %s", self.path)
             os.makedirs(self.path, exist_ok=True)
             for entry in sorted(os.listdir(self.path)):
                 full = os.path.join(self.path, entry)
@@ -39,6 +43,7 @@ class Holder:
                     validate_name(entry)
                 except Exception:
                     continue
+                self.logger.printf("opening index: %s", entry)
                 idx = self._new_index(entry, IndexOptions())
                 idx.open()
                 self.indexes[entry] = idx
@@ -64,7 +69,8 @@ class Holder:
                 holder.on_create_slice(_name, slice, inverse)
         return Index(self.index_path(name), name, options=options,
                      on_create_slice=announce,
-                     stats=self.stats.with_tags(f"index:{name}"))
+                     stats=self.stats.with_tags(f"index:{name}"),
+                     logger=self.logger)
 
     def index(self, name: str) -> Optional[Index]:
         return self.indexes.get(name)

@@ -18,6 +18,7 @@ from ..errors import (FrameExistsError, PilosaError, validate_label,
                       validate_name)
 from ..proto import internal_pb2 as pb
 from ..storage.attrs import AttrStore
+from ..utils import logger as logger_mod
 from ..utils import timequantum as tq
 from ..utils.stats import NOP
 from .frame import Frame, FrameOptions
@@ -44,8 +45,9 @@ class IndexOptions:
 class Index:
     def __init__(self, path: str, name: str,
                  options: Optional[IndexOptions] = None,
-                 on_create_slice=None, stats=NOP):
+                 on_create_slice=None, stats=NOP, logger=logger_mod.NOP):
         validate_name(name)
+        self.logger = logger
         self.path = path
         self.name = name
         self.options = options or IndexOptions()
@@ -147,7 +149,8 @@ class Index:
     def _new_frame(self, name: str, options: FrameOptions) -> Frame:
         return Frame(self.frame_path(name), self.name, name, options=options,
                      on_create_slice=self.on_create_slice,
-                     stats=self.stats.with_tags(f"frame:{name}"))
+                     stats=self.stats.with_tags(f"frame:{name}"),
+                     logger=self.logger)
 
     def create_frame(self, name: str, options: Optional[FrameOptions] = None
                      ) -> Frame:

@@ -16,6 +16,7 @@ from typing import Callable, Optional
 from .. import SLICE_WIDTH
 from ..storage import cache as cache_mod
 from ..storage.fragment import Fragment
+from ..utils import logger as logger_mod
 from ..utils.stats import NOP
 
 VIEW_STANDARD = "standard"
@@ -36,7 +37,8 @@ class View:
                  cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
                  row_attr_store=None,
                  on_create_slice: Optional[Callable[[int], None]] = None,
-                 stats=NOP):
+                 stats=NOP, logger=logger_mod.NOP):
+        self.logger = logger
         self.path = path
         self.index = index
         self.frame = frame
@@ -82,7 +84,8 @@ class View:
                         self.name, slice, cache_type=self.cache_type,
                         cache_size=self.cache_size,
                         row_attr_store=self.row_attr_store,
-                        stats=self.stats.with_tags(f"slice:{slice}"))
+                        stats=self.stats.with_tags(f"slice:{slice}"),
+                        logger=self.logger)
 
     # -- fragments
 

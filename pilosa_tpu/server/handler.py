@@ -139,7 +139,9 @@ class Handler:
     def __init__(self, holder, executor, cluster=None, host: str = "",
                  broadcaster=NOP_BROADCASTER, broadcast_handler=None,
                  status_handler=None, stats=None, client_factory=None,
-                 pod=None):
+                 pod=None, logger=None):
+        from ..utils import logger as logger_mod
+        self.logger = logger or logger_mod.NOP
         self.holder = holder
         self.executor = executor
         self.cluster = cluster
@@ -226,6 +228,7 @@ class Handler:
                 resp = Response(400, (str(e) + "\n").encode(),
                                 "text/plain; charset=utf-8")
             except Exception as e:  # noqa: BLE001 - surface as 500
+                self.logger.printf("http error: %s %s: %s", method, path, e)
                 resp = Response(500, (str(e) + "\n").encode(),
                                 "text/plain; charset=utf-8")
             break
@@ -464,6 +467,8 @@ class Handler:
         except PilosaError as e:
             return error_resp(400, str(e))
         except Exception as e:  # noqa: BLE001 - surfaced in response
+            self.logger.printf("query error: index=%s query=%.120s: %s",
+                               index_name, query_str, e)
             return error_resp(500, str(e))
 
         # Optional column-attribute join (handler.go:208-227).

@@ -25,6 +25,7 @@ from ..models.frame import FrameOptions
 from ..models.holder import Holder
 from ..models.index import IndexOptions
 from ..proto import internal_pb2 as pb
+from ..utils import logger as logger_mod
 from ..utils.stats import NOP
 from .handler import Handler
 
@@ -51,9 +52,11 @@ class Server:
                  broadcast_receiver=None, stats=NOP,
                  anti_entropy_interval: float
                  = DEFAULT_ANTI_ENTROPY_INTERVAL,
-                 polling_interval: float = DEFAULT_POLLING_INTERVAL):
+                 polling_interval: float = DEFAULT_POLLING_INTERVAL,
+                 logger=logger_mod.NOP):
         self.data_dir = data_dir
         self.host = host
+        self.logger = logger
         self.cluster = cluster or Cluster(
             nodes=[Node(host)], node_set=StaticNodeSet([Node(host)]))
         self.broadcaster = broadcaster or NOP_BROADCASTER
@@ -63,7 +66,7 @@ class Server:
         self.polling_interval = polling_interval
 
         self.holder = Holder(data_dir, on_create_slice=self._on_create_slice,
-                             stats=stats)
+                             stats=stats, logger=logger)
         self.executor: Optional[Executor] = None
         self.handler: Optional[Handler] = None
         self.pod = None  # parallel.pod.Pod once open() joins a pod
@@ -122,7 +125,8 @@ class Server:
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
             broadcast_handler=self, status_handler=self,
-            stats=self.stats, client_factory=Client, pod=self.pod)
+            stats=self.stats, client_factory=Client, pod=self.pod,
+            logger=self.logger)
 
         self._httpd = make_server(bind_host, port, self.handler,
                                   server_class=_ThreadingWSGIServer,
@@ -150,6 +154,7 @@ class Server:
         if self.cluster.node_set is not None:
             self.cluster.node_set.open()
 
+        self.logger.printf("listening as http://%s", self.host)
         self._spawn(self._serve, "http")
         self._spawn(self._monitor_cache_flush, "cache-flush")
         if self.polling_interval > 0:
@@ -158,6 +163,7 @@ class Server:
             self._spawn(self._monitor_anti_entropy, "anti-entropy")
 
     def close(self) -> None:
+        self.logger.printf("server closing: %s", self.host)
         self._closing.set()
         if self._httpd is not None:
             self._httpd.shutdown()
@@ -192,20 +198,22 @@ class Server:
 
     # -- background loops ----------------------------------------------------
 
-    def _loop(self, interval: float, fn) -> None:
+    def _loop(self, interval: float, fn, name: str = "loop") -> None:
         while not self._closing.wait(interval):
             try:
                 fn()
-            except Exception:  # noqa: BLE001 - loops must survive errors
-                pass
+            except Exception as e:  # noqa: BLE001 - loops must survive errors
+                self.logger.printf("%s error: %s", name, e)
 
     def _monitor_cache_flush(self) -> None:
-        self._loop(CACHE_FLUSH_INTERVAL, self.holder.flush_caches)
+        self._loop(CACHE_FLUSH_INTERVAL, self.holder.flush_caches,
+                   "holder cache flush")
 
     def _monitor_max_slices(self) -> None:
         # Poll peers' /slices/max and adopt larger values
         # (server.go:216-252).
-        self._loop(self.polling_interval, self.poll_max_slices)
+        self._loop(self.polling_interval, self.poll_max_slices,
+                   "max slices poll")
 
     def poll_max_slices(self) -> None:
         for node in self.cluster.nodes:
@@ -223,9 +231,16 @@ class Server:
 
     def _monitor_anti_entropy(self) -> None:
         from .syncer import HolderSyncer
-        self._loop(self.anti_entropy_interval,
-                   lambda: HolderSyncer(self.holder, self.host,
-                                        self.cluster).sync_holder())
+
+        def run():
+            # server.go:182-214 logs the start and total duration of
+            # every anti-entropy sweep.
+            with self.logger.track("holder sync"):
+                HolderSyncer(self.holder, self.host, self.cluster,
+                             closing=self._closing,
+                             logger=self.logger).sync_holder()
+
+        self._loop(self.anti_entropy_interval, run, "anti-entropy")
 
     # -- BroadcastHandler (server.go:255-300) --------------------------------
 

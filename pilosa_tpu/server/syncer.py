@@ -19,17 +19,19 @@ from ..cluster.client import Client
 from ..errors import FragmentNotFoundError, FrameNotFoundError
 from ..models.view import VIEW_STANDARD
 from ..storage.fragment import PairSet
+from ..utils import logger as logger_mod
 
 
 class HolderSyncer:
     def __init__(self, holder, host: str, cluster,
                  closing: Optional[threading.Event] = None,
-                 client_factory=Client):
+                 client_factory=Client, logger=logger_mod.NOP):
         self.holder = holder
         self.host = host
         self.cluster = cluster
         self.closing = closing or threading.Event()
         self.client_factory = client_factory
+        self.logger = logger
 
     def is_closing(self) -> bool:
         return self.closing.is_set()
@@ -110,18 +112,20 @@ class HolderSyncer:
         v = f.create_view_if_not_exists(view)
         frag = v.create_fragment_if_not_exists(slice)
         FragmentSyncer(frag, self.host, self.cluster, self.closing,
-                       self.client_factory).sync_fragment()
+                       self.client_factory,
+                       logger=self.logger).sync_fragment()
 
 
 class FragmentSyncer:
     def __init__(self, fragment, host: str, cluster,
                  closing: Optional[threading.Event] = None,
-                 client_factory=Client):
+                 client_factory=Client, logger=logger_mod.NOP):
         self.fragment = fragment
         self.host = host
         self.cluster = cluster
         self.closing = closing or threading.Event()
         self.client_factory = client_factory
+        self.logger = logger
 
     def is_closing(self) -> bool:
         return self.closing.is_set()
@@ -192,6 +196,11 @@ class FragmentSyncer:
         if self.is_closing():
             return
         sets, clears = f.merge_block(block_id, pair_sets)
+        self.logger.printf(
+            "sync block %s/%s/%s/%d block=%d: pushing sets=%d clears=%d",
+            f.index, f.frame, f.view, f.slice, block_id,
+            sum(len(s.column_ids) for s in sets),
+            sum(len(c.column_ids) for c in clears))
 
         base = f.slice * SLICE_WIDTH
         for client, set_ps, clear_ps in zip(clients, sets, clears):

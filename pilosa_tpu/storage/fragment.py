@@ -41,6 +41,7 @@ from .. import SLICE_WIDTH
 from ..errors import PilosaError
 from ..parallel.residency import DeviceRowCache
 from ..proto import internal_pb2 as pb
+from ..utils import logger as logger_mod
 from . import cache as cache_mod
 from . import roaring
 from .bitmap import Bitmap
@@ -100,7 +101,8 @@ class Fragment:
                  slice: int, cache_type: str = cache_mod.DEFAULT_CACHE_TYPE,
                  cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
                  row_attr_store=None, use_device: Optional[bool] = None,
-                 stats=None):
+                 stats=None, logger=logger_mod.NOP):
+        self.logger = logger
         self.path = path
         self.index = index
         self.frame = frame
@@ -294,15 +296,18 @@ class Fragment:
         """Atomically rewrite the data file from current state and remap
         (reference fragment.go:991-1057)."""
         with self._mu:
-            self.storage.unmap()
-            tmp = self.path + ".snapshotting"
-            with open(tmp, "wb") as f:
-                self.storage.write_to(f)
-                f.flush()
-                os.fsync(f.fileno())
-            self._close_storage()
-            os.replace(tmp, self.path)
-            self._open_storage()
+            with self.logger.track("fragment: snapshot %s/%s/%s/%d",
+                                   self.index, self.frame, self.view,
+                                   self.slice):
+                self.storage.unmap()
+                tmp = self.path + ".snapshotting"
+                with open(tmp, "wb") as f:
+                    self.storage.write_to(f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                self._close_storage()
+                os.replace(tmp, self.path)
+                self._open_storage()
 
     def import_bits(self, row_ids, column_ids) -> None:
         """Bulk import: direct adds with the op-log detached, then snapshot

@@ -130,6 +130,18 @@ def load(path: str = "", env: dict | None = None) -> Config:
         cfg.cluster.internal_port = env["PILOSA_CLUSTER_INTERNAL_PORT"]
     if env.get("PILOSA_CLUSTER_GOSSIP_SEED"):
         cfg.cluster.gossip_seed = env["PILOSA_CLUSTER_GOSSIP_SEED"]
+    if env.get("PILOSA_CLUSTER_INTERNAL_HOSTS"):
+        cfg.cluster.internal_hosts = [
+            h.strip() for h in
+            env["PILOSA_CLUSTER_INTERNAL_HOSTS"].split(",") if h]
+    if env.get("PILOSA_CLUSTER_POLL_INTERVAL"):
+        cfg.cluster.polling_interval = parse_duration(
+            env["PILOSA_CLUSTER_POLL_INTERVAL"])
+    if env.get("PILOSA_LOG_PATH"):
+        cfg.log_path = env["PILOSA_LOG_PATH"]
+    if env.get("PILOSA_ANTI_ENTROPY_INTERVAL"):
+        cfg.anti_entropy_interval = parse_duration(
+            env["PILOSA_ANTI_ENTROPY_INTERVAL"])
     if env.get("PILOSA_PLUGINS_PATH"):
         cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
     return cfg
