@@ -424,18 +424,24 @@ class GossipNodeSet:
             return json.loads(_recv_frame(conn).decode())
 
     def _local_state(self) -> dict:
-        """Full state for push/pull: membership + NodeStatus
-        (gossip.go:193-205, LocalState)."""
+        """Full state for push/pull: membership + the protobuf
+        ``NodeStatus`` (schema metas + owned slices) exactly as the
+        reference's memberlist delegate marshals it (gossip.go:193-205
+        LocalState; internal/private.proto:74-90). The protobuf rides
+        base64 inside the JSON frame."""
         with self._mu:
             members = [m.to_wire() for m in self._members.values()]
-        status = None
+        status_b64 = None
         if self._handler is not None and hasattr(self._handler,
                                                  "local_status"):
             try:
-                status = self._handler.local_status()
-            except Exception:  # noqa: BLE001 - status is best-effort
-                status = None
-        return {"t": "pushpull", "members": members, "status": status}
+                status = self._handler.local_status()  # pb.NodeStatus
+                status_b64 = _b64(status.SerializeToString())
+            except Exception as e:  # noqa: BLE001 - status is best-effort
+                self.logger.printf("gossip: error getting local state:"
+                                   " %s", e)
+        return {"t": "pushpull", "members": members,
+                "status_pb": status_b64}
 
     def _absorb_state(self, state: dict) -> None:
         """MergeRemoteState (gossip.go:208-222)."""
@@ -444,13 +450,16 @@ class GossipNodeSet:
                 self._merge_member(Member.from_wire(w))
             except (KeyError, ValueError):
                 continue
-        status = state.get("status")
-        if status and self._handler is not None and hasattr(
+        status_b64 = state.get("status_pb")
+        if status_b64 and self._handler is not None and hasattr(
                 self._handler, "handle_remote_status"):
+            from ..proto import internal_pb2 as pb
             try:
-                self._handler.handle_remote_status(status)
-            except Exception:  # noqa: BLE001 - merge is best-effort
-                pass
+                ns = pb.NodeStatus.FromString(
+                    base64.b64decode(status_b64))
+                self._handler.handle_remote_status(ns)
+            except Exception as e:  # noqa: BLE001 - merge is best-effort
+                self.logger.printf("gossip: merge state error: %s", e)
 
     def _push_pull(self, addr: str) -> None:
         resp = self._tcp_request(addr, self._local_state())

@@ -55,9 +55,17 @@ class Node:
     host: str
     internal_host: str = ""
     state: str = NODE_STATE_UP
+    # Last pb.NodeStatus received from this node (schema + owned slices),
+    # set by the status merge like the reference's Node.SetStatus
+    # (cluster.go:58-76).
+    status: Optional[object] = field(default=None, compare=False,
+                                     repr=False)
 
     def set_state(self, s: str) -> None:
         self.state = s
+
+    def set_status(self, ns) -> None:
+        self.status = ns
 
 
 def filter_host(nodes: list[Node], host: str) -> list[Node]:

@@ -267,8 +267,18 @@ class Handler:
 
     def _handle_get_status(self, req: Request) -> Response:
         if self.status_handler is not None:
-            return Response.json(
-                {"status": self.status_handler.cluster_status()})
+            cs = self.status_handler.cluster_status()  # pb.ClusterStatus
+            if _PROTOBUF in req.accept:
+                return Response.proto(cs)
+            return Response.json({"status": {"nodes": [
+                {"host": ns.Host, "state": ns.State,
+                 "indexes": [{"name": ix.Name,
+                              "maxSlice": ix.MaxSlice,
+                              "slices": list(ix.Slices),
+                              "frames": [{"name": f.Name}
+                                         for f in ix.Frames]}
+                             for ix in ns.Indexes]}
+                for ns in cs.Nodes]}})
         states = self.cluster.node_states() if self.cluster else {}
         return Response.json({"status": {"Nodes": [
             {"Host": h, "State": s} for h, s in sorted(states.items())]}})

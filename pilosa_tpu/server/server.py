@@ -18,7 +18,8 @@ from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 from ..cluster.broadcast import NOP_BROADCASTER, StaticNodeSet
 from ..cluster.client import Client
-from ..cluster.topology import Cluster, Node
+from ..cluster.topology import (NODE_STATE_DOWN, NODE_STATE_UP, Cluster,
+                                Node)
 from ..errors import PilosaError
 from ..executor import Executor
 from ..models.frame import FrameOptions
@@ -272,31 +273,56 @@ class Server:
 
     # -- StatusHandler (server.go:306-440) -----------------------------------
 
-    def local_status(self) -> dict:
+    def local_status(self) -> pb.NodeStatus:
+        """This node's state as the wire type the gossip push/pull
+        carries: schema with metas + the slice list this node owns per
+        index (server.go:306-323, internal/private.proto NodeStatus)."""
         indexes = []
         for name in sorted(self.holder.indexes):
             idx = self.holder.indexes[name]
-            indexes.append({
-                "name": name,
-                "maxSlice": idx.max_slice(),
-                "frames": [{"name": fn} for fn in sorted(idx.frames)],
-            })
-        return {"host": self.host, "state": "OK", "indexes": indexes}
+            max_slice = idx.max_slice()
+            indexes.append(pb.Index(
+                Name=name, Meta=idx.options.encode(), MaxSlice=max_slice,
+                Frames=[pb.Frame(Name=fn,
+                                 Meta=idx.frames[fn].options.encode())
+                        for fn in sorted(idx.frames)],
+                Slices=self.cluster.owns_slices(name, max_slice,
+                                                self.host)))
+        return pb.NodeStatus(Host=self.host, State=NODE_STATE_UP,
+                             Indexes=indexes)
 
-    def cluster_status(self) -> dict:
-        return {"nodes": [
-            self.local_status() if n.host == self.host
-            else {"host": n.host,
-                  "state": self.cluster.node_states().get(n.host, "DOWN")}
-            for n in self.cluster.nodes]}
+    def cluster_status(self) -> pb.ClusterStatus:
+        """NodeStatus for every node: ours live, peers from the last
+        status merge, membership deciding UP/DOWN (server.go:325-351)."""
+        states = self.cluster.node_states()
+        nodes = []
+        for n in self.cluster.nodes:
+            if n.host == self.host:
+                nodes.append(self.local_status())
+                continue
+            ns = pb.NodeStatus()
+            if n.status is not None:
+                ns.CopyFrom(n.status)
+            ns.Host = n.host
+            ns.State = states.get(n.host, NODE_STATE_DOWN)
+            nodes.append(ns)
+        return pb.ClusterStatus(Nodes=nodes)
 
-    def handle_remote_status(self, status: dict) -> None:
-        """Merge a peer's schema into ours (server.go:344-387)."""
-        for idx_info in status.get("indexes", []):
-            idx = self.holder.create_index_if_not_exists(idx_info["name"])
-            idx.set_remote_max_slice(idx_info.get("maxSlice", 0))
-            for frame_info in idx_info.get("frames", []):
-                idx.create_frame_if_not_exists(frame_info["name"])
+    def handle_remote_status(self, status: pb.NodeStatus) -> None:
+        """Merge a peer's schema + owned-slice knowledge into ours
+        (server.go:353-387 mergeRemoteStatus)."""
+        node = self.cluster.node_by_host(status.Host)
+        if node is not None:
+            node.set_status(status)
+        for idx_info in status.Indexes:
+            idx = self.holder.create_index_if_not_exists(
+                idx_info.Name, IndexOptions.decode(idx_info.Meta))
+            remote_max = max([idx_info.MaxSlice] +
+                             [int(s) for s in idx_info.Slices])
+            idx.set_remote_max_slice(remote_max)
+            for frame_info in idx_info.Frames:
+                idx.create_frame_if_not_exists(
+                    frame_info.Name, FrameOptions.decode(frame_info.Meta))
 
 
 class _RoutingClient:

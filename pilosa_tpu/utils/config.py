@@ -123,7 +123,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
         cfg.cluster.type = env["PILOSA_CLUSTER_TYPE"]
     if env.get("PILOSA_CLUSTER_HOSTS"):
         cfg.cluster.hosts = [h.strip() for h in
-                             env["PILOSA_CLUSTER_HOSTS"].split(",") if h]
+                             env["PILOSA_CLUSTER_HOSTS"].split(",")
+                             if h.strip()]
     if env.get("PILOSA_CLUSTER_REPLICAS"):
         cfg.cluster.replica_n = int(env["PILOSA_CLUSTER_REPLICAS"])
     if env.get("PILOSA_CLUSTER_INTERNAL_PORT"):
@@ -133,7 +134,7 @@ def load(path: str = "", env: dict | None = None) -> Config:
     if env.get("PILOSA_CLUSTER_INTERNAL_HOSTS"):
         cfg.cluster.internal_hosts = [
             h.strip() for h in
-            env["PILOSA_CLUSTER_INTERNAL_HOSTS"].split(",") if h]
+            env["PILOSA_CLUSTER_INTERNAL_HOSTS"].split(",") if h.strip()]
     if env.get("PILOSA_CLUSTER_POLL_INTERVAL"):
         cfg.cluster.polling_interval = parse_duration(
             env["PILOSA_CLUSTER_POLL_INTERVAL"])

@@ -26,12 +26,13 @@ class RecordingHandler:
     def receive_message(self, m) -> None:
         self.messages.append(m)
 
-    def local_status(self) -> dict:
-        return {"host": self.host, "indexes": [{"name": "i0",
-                                                "maxSlice": 3,
-                                                "frames": []}]}
+    def local_status(self) -> pb.NodeStatus:
+        # The wire type the reference's push/pull carries
+        # (internal/private.proto:74-90, gossip.go:193-205).
+        return pb.NodeStatus(Host=self.host, State="UP", Indexes=[
+            pb.Index(Name="i0", MaxSlice=3, Slices=[0, 2])])
 
-    def handle_remote_status(self, status: dict) -> None:
+    def handle_remote_status(self, status: pb.NodeStatus) -> None:
         self.remote_statuses.append(status)
 
 
@@ -73,11 +74,15 @@ def test_join_via_seed(pair):
 
 def test_push_pull_merges_status(pair):
     a, ha, b, hb = pair
-    # The join push/pull already exchanged NodeStatus both ways.
+    # The join push/pull already exchanged protobuf NodeStatus both ways,
+    # including schema + owned slices.
     assert wait_until(lambda: any(
-        s.get("host") == "hostB:10101" for s in ha.remote_statuses))
+        s.Host == "hostB:10101" for s in ha.remote_statuses))
     assert wait_until(lambda: any(
-        s.get("host") == "hostA:10101" for s in hb.remote_statuses))
+        s.Host == "hostA:10101" for s in hb.remote_statuses))
+    ns = next(s for s in ha.remote_statuses if s.Host == "hostB:10101")
+    assert [(ix.Name, ix.MaxSlice, list(ix.Slices))
+            for ix in ns.Indexes] == [("i0", 3, [0, 2])]
 
 
 def test_send_sync_delivers_to_peers(pair):
@@ -152,5 +157,46 @@ def test_nodes_excludes_nothing_when_alone():
     a, _ = make_node("solo:10101")
     try:
         assert [n.host for n in a.nodes()] == ["solo:10101"]
+    finally:
+        a.close()
+
+
+def test_refutation_after_false_death(pair):
+    """A false dead rumor about a live node is refuted: the victim hears
+    it is presumed dead (via push/pull), re-announces alive with a higher
+    incarnation, and the accuser flips it back (SWIM refutation)."""
+    from pilosa_tpu.cluster.gossip import Member, STATE_DEAD
+    a, _, b, _ = pair
+    assert wait_until(lambda: len(a.nodes()) == 2)
+    # Inject the false rumor into A: B is dead at B's current incarnation.
+    inc = a._member_snapshot("hostB:10101").incarnation
+    a._merge_member(Member("hostB:10101", b.gossip_host, inc, STATE_DEAD))
+    assert [n.host for n in a.nodes()] == ["hostA:10101"]
+    # B's periodic push/pull with A carries the dead rumor back to B,
+    # which refutes with incarnation inc+1; A must resurrect B.
+    assert wait_until(lambda: len(a.nodes()) == 2, timeout=10.0)
+    assert a._member_snapshot("hostB:10101").incarnation > inc
+
+
+def test_dead_node_revival_after_partition_heal():
+    """A node that really died and was marked dead rejoins (same name,
+    fresh process): the join push/pull tells it the cluster believes it
+    dead, it refutes, and membership heals to 2 alive."""
+    a, _ = make_node("hostA:10101", suspect_after=2)
+    b, _ = make_node("hostB:10101", seeds=[a.gossip_host], suspect_after=2)
+    try:
+        assert wait_until(lambda: len(a.nodes()) == 2)
+        b.close()  # partition / crash
+        assert wait_until(
+            lambda: [n.host for n in a.nodes()] == ["hostA:10101"],
+            timeout=10.0)
+        # Heal: restart B under the same cluster identity.
+        b2, _ = make_node("hostB:10101", seeds=[a.gossip_host],
+                          suspect_after=2)
+        try:
+            assert wait_until(lambda: len(a.nodes()) == 2, timeout=10.0)
+            assert wait_until(lambda: len(b2.nodes()) == 2, timeout=10.0)
+        finally:
+            b2.close()
     finally:
         a.close()

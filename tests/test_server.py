@@ -59,7 +59,10 @@ class TestSingleNode:
         status, body = http_get(host, "/schema")
         assert json.loads(body)["indexes"][0]["name"] == "i"
         status, body = http_get(host, "/status")
-        assert json.loads(body)["status"]["nodes"][0]["state"] == "OK"
+        node = json.loads(body)["status"]["nodes"][0]
+        assert node["state"] == "UP"
+        # Owned-slice knowledge rides the status (server.go:317-321).
+        assert node["indexes"][0]["slices"] == [0]
 
     def test_set_quick_random_bits_survive_restart(self, tmp_path):
         """Randomized property test through the full HTTP stack: random
