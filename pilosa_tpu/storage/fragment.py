@@ -545,21 +545,65 @@ class Fragment:
             majority = (len(copies) + 1) // 2
             want = counts >= majority
             sets_out, clears_out = [], []
+            local_set_pos = local_clear_pos = None
             for ps, pos in zip(copies, positions):
                 has = np.isin(uniq, pos, assume_unique=True)
                 to_set = uniq[want & ~has]
                 to_clear = uniq[~want & has]
+                if local_set_pos is None:  # first copy = local
+                    local_set_pos, local_clear_pos = to_set, to_clear
                 sets_out.append(PairSet(to_set // np.uint64(SLICE_WIDTH),
                                         to_set % np.uint64(SLICE_WIDTH)))
                 clears_out.append(PairSet(to_clear // np.uint64(SLICE_WIDTH),
                                           to_clear % np.uint64(SLICE_WIDTH)))
             # Apply local diffs.
-            base_col = self.slice * SLICE_WIDTH
-            for r, c in zip(sets_out[0].row_ids, sets_out[0].column_ids):
-                self._mutate(int(r), base_col + int(c), set=True)
-            for r, c in zip(clears_out[0].row_ids, clears_out[0].column_ids):
-                self._mutate(int(r), base_col + int(c), set=False)
+            self._apply_merge_diffs(local_set_pos, local_clear_pos)
             return sets_out[1:], clears_out[1:]
+
+    # Above this many local diffs, per-bit WAL appends (plus a per-op
+    # row-count cache update) cost more than one snapshot rewrite — the
+    # same trade bulk import makes (fragment.go:924-989).
+    MERGE_BULK_THRESHOLD = 256
+
+    def _apply_merge_diffs(self, set_pos: np.ndarray,
+                           clear_pos: np.ndarray) -> None:
+        """Apply a merge_block consensus diff locally. Small diffs go
+        through the per-bit path (cheap WAL appends); large divergences
+        bulk-apply with the op-log detached and one snapshot, so
+        anti-entropy of a badly diverged replica does not crawl through
+        a Python loop (reference bulk semantics: fragment.go:802-920)."""
+        total = len(set_pos) + len(clear_pos)
+        if total == 0:
+            return
+        base_col = self.slice * SLICE_WIDTH
+        if total <= self.MERGE_BULK_THRESHOLD:
+            for pos in set_pos:
+                self._mutate(int(pos) // SLICE_WIDTH,
+                             base_col + int(pos) % SLICE_WIDTH, set=True)
+            for pos in clear_pos:
+                self._mutate(int(pos) // SLICE_WIDTH,
+                             base_col + int(pos) % SLICE_WIDTH, set=False)
+            return
+        writer, self.storage.op_writer = self.storage.op_writer, None
+        try:
+            added = self.storage.add_many(set_pos)
+            removed = self.storage.remove_many(clear_pos)
+        finally:
+            self.storage.op_writer = writer
+        # Same per-bit side effects as _mutate, batched per row.
+        rows = np.unique(np.concatenate((set_pos, clear_pos))
+                         // np.uint64(SLICE_WIDTH))
+        for rid in rows:
+            rid = int(rid)
+            self.checksums.pop(rid // HASH_BLOCK_SIZE, None)
+            self.row_cache.invalidate(rid)
+            self.device.invalidate_row(rid)
+            self.cache.bulk_add(rid, self.row_count(rid))
+        self.cache.recalculate()
+        if self.stats is not None:
+            self.stats.count("setN", added)
+            self.stats.count("clearN", removed)
+        self.snapshot()
 
     # -- iteration / export --------------------------------------------------
 

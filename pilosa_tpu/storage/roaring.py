@@ -497,6 +497,49 @@ class Bitmap:
             added += c.n - before
         return added
 
+    def remove_many(self, values: np.ndarray) -> int:
+        """Vectorized bulk remove of a u64 value vector; returns #cleared.
+
+        The anti-entropy bulk-repair path (reference fragment.go:802-920
+        applies merge diffs through the fragment with the op log handled
+        by the caller) — callers detach the op writer and snapshot after,
+        exactly like add_many's import contract."""
+        values = np.asarray(values, dtype=np.uint64)
+        if not len(values):
+            return 0
+        if len(values) > 1 and not bool(np.all(values[:-1] <= values[1:])):
+            values = np.sort(values)
+        highs = values >> np.uint64(16)
+        bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1
+        starts = np.concatenate(([0], bounds))
+        ends = np.concatenate((bounds, [len(values)]))
+        removed = 0
+        for s, e in zip(starts, ends):
+            c = self.container(int(highs[s]))
+            if c is None or c.n == 0:
+                continue
+            chunk = (values[s:e] & np.uint64(0xFFFF)).astype(np.uint32)
+            before = c.n
+            if c.is_array():
+                keep = ~np.isin(c.array, chunk, assume_unique=False)
+                if keep.all():
+                    continue
+                c._unmap()
+                c.array = c.array[keep]
+                c.n = len(c.array)
+            else:
+                # AND-NOT scatter; duplicate words in chunk compose fine
+                # because each element clears only its own bit.
+                c._unmap()
+                np.bitwise_and.at(
+                    c.bitmap, chunk >> np.uint32(6),
+                    ~(np.uint64(1) << (chunk.astype(np.uint64)
+                                       & np.uint64(63))))
+                c.n = int(np.bitwise_count(c.bitmap).sum())
+            c._maybe_convert()
+            removed += before - c.n
+        return removed
+
     @staticmethod
     def from_sorted(values: np.ndarray) -> "Bitmap":
         b = Bitmap()

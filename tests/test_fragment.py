@@ -281,6 +281,47 @@ class TestMergeBlock:
         assert frag.row(0).count() == 1          # local keeps the bit
         assert list(map(int, sets[0].column_ids)) == [7]  # peer must set it
 
+    def test_bulk_divergence_repairs_fast_and_correct(self, tmp_path):
+        """A 10k-bit divergence must bulk-apply (one snapshot, no per-bit
+        WAL loop) and repair in about a second — the anti-entropy crawl
+        guard. Two replicated peers agree against a diverged local."""
+        import time
+        frag = make_fragment(tmp_path)
+        try:
+            rng = np.random.default_rng(7)
+            rows = rng.integers(0, HASH_BLOCK_SIZE, 5000).astype(np.uint64)
+            cols = rng.integers(0, 200000, 5000).astype(np.uint64)
+            # Local-only bits: majority (2 peers without them vs local)
+            # says clear all 5000.
+            frag.import_bits(rows, cols)
+            # Peer-only bits: majority says set all of these locally.
+            peer_rows = rng.integers(0, HASH_BLOCK_SIZE,
+                                     5000).astype(np.uint64)
+            peer_cols = (rng.integers(0, 200000, 5000).astype(np.uint64)
+                         + np.uint64(300000))
+            peer = PairSet(peer_rows, peer_cols)
+            peer2 = PairSet(peer_rows.copy(), peer_cols.copy())
+
+            start = time.perf_counter()
+            sets, clears = frag.merge_block(0, [peer, peer2])
+            elapsed = time.perf_counter() - start
+
+            want = {(int(r), int(c)) for r, c in zip(peer_rows, peer_cols)}
+            got = {(r, c) for r, c in frag.for_each_bit()}
+            assert got == want
+            # Peers need the sets/clears that bring them to consensus:
+            # nothing to set (they have all consensus bits), and they
+            # must clear nothing (local-only bits lost the vote and
+            # peers never had them).
+            for ps in sets + clears:
+                assert len(ps.column_ids) == 0
+            assert elapsed < 1.5, f"bulk merge took {elapsed:.2f}s"
+            # Survives a reopen (the bulk path snapshotted).
+            frag = reopen(frag)
+            assert {(r, c) for r, c in frag.for_each_bit()} == want
+        finally:
+            frag.close()
+
 
 class TestCachePersistence:
     def test_cache_flush_and_reload(self, tmp_path):

@@ -107,6 +107,32 @@ class TestBulk:
         b.add_many(np.array([1, 2, 65537], dtype=np.uint64))
         assert sorted(int(x) for x in b.values()) == [1, 2, 100, 65536, 65537]
 
+    def test_remove_many_matches_loop(self):
+        rng = random.Random(11)
+        vals = rand_values(rng, 8000, hi=1 << 20)  # dense → bitmap blocks
+        b = Bitmap(*vals)
+        drop = vals[::3] + [999999999, 12345678]  # incl. absent values
+        n = b.remove_many(np.array(drop, dtype=np.uint64))
+        model = set(vals) - set(drop)
+        assert n == len(set(vals)) - len(model)
+        assert set(int(x) for x in b.values()) == model
+        b.check()
+
+    def test_remove_many_converts_bitmap_to_array(self):
+        vals = list(range(6000))  # one bitmap container
+        b = Bitmap(*vals)
+        assert not b.containers[0].is_array()
+        b.remove_many(np.arange(5000, dtype=np.uint64))
+        assert b.containers[0].is_array()  # n=1000 ≤ 4096 → array block
+        assert set(int(x) for x in b.values()) == set(range(5000, 6000))
+        b.check()
+
+    def test_remove_many_duplicate_values_clear_once(self):
+        b = Bitmap(1, 2, 3)
+        n = b.remove_many(np.array([2, 2, 2], dtype=np.uint64))
+        assert n == 1
+        assert sorted(int(x) for x in b.values()) == [1, 3]
+
     def test_count_range_and_slice_range(self):
         vals = [0, 1, 100, 65535, 65536, 1 << 20, (1 << 20) + 5]
         b = Bitmap(*vals)
