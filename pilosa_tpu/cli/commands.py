@@ -75,7 +75,13 @@ def _parse_csv_arrays(stream, stderr, chunk_lines: int):
     so only chunks that are provably ``digits,digits`` take it. Any
     other chunk (timestamps, malformed rows) re-parses through
     _parse_csv_bits, which owns the exact per-row error messages (and
-    their absolute row numbers)."""
+    their absolute row numbers).
+
+    Known limit: chunking is by physical lines, so a quoted CSV field
+    containing a newline can straddle a chunk boundary, and row numbers
+    count lines rather than csv records. Pilosa's import format is
+    numeric ``row,col[,timestamp]`` — quoted multi-line fields are not
+    valid input here, so the trade is taken for the 30x parse speed."""
     import itertools
 
     # ≤19 digits is always < 2^64 — longer runs (possibly past

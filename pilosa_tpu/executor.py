@@ -144,13 +144,23 @@ class Executor:
     def _pool(self, tier: str) -> ThreadPoolExecutor:
         with self._pools_mu:
             pool = self._pools.get(tier)
+            size = self.max_workers
+            if tier == "pod" and self.pod is not None:
+                # Pod legs must all run concurrently — latency is
+                # the max over legs, not the sum (the old per-query
+                # pool sized itself to the leg count). If the peer set
+                # has grown since the pool was built, grow with it: a
+                # too-small pool serializes legs (no deadlock — pod
+                # legs only block on the tier below — just latency).
+                size = max(size, len(self.pod.peers))
+            if pool is not None and pool._max_workers < size:
+                # Don't shutdown(): a concurrent query may still hold a
+                # reference and submit to it — shutdown would fail that
+                # query with RuntimeError. Dropped pools drain naturally
+                # (their idle threads exit when the pool is collected).
+                self._pools.pop(tier)
+                pool = None
             if pool is None:
-                size = self.max_workers
-                if tier == "pod" and self.pod is not None:
-                    # Pod legs must all run concurrently — latency is
-                    # the max over legs, not the sum (the old per-query
-                    # pool sized itself to the leg count).
-                    size = max(size, len(self.pod.peers))
                 pool = self._pools[tier] = ThreadPoolExecutor(
                     max_workers=size,
                     thread_name_prefix=f"pilosa-exec-{tier}")
@@ -1397,7 +1407,18 @@ class Executor:
         # and device work inside map_fn releases the GIL.
         if len(slices) == 1:
             return reduce_fn(None, map_fn(slices[0]))
+        pool = self._pool("slice")
+        futs = [pool.submit(map_fn, s) for s in slices]
         result = None
-        for r in self._pool("slice").map(map_fn, slices):
-            result = reduce_fn(result, r)
+        try:
+            for fut in futs:
+                result = reduce_fn(result, fut.result())
+        finally:
+            # Shared pool: if map_fn or reduce_fn raised, don't abandon
+            # in-flight legs — the caller re-maps these slices onto a
+            # replica, and an abandoned leg would run them twice while
+            # occupying pool slots (same drain as _mapper/_mapper_pod).
+            pending = [f for f in futs if not f.cancel()]
+            if pending:
+                wait(pending)
         return result

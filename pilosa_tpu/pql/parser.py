@@ -92,6 +92,9 @@ class Scanner:
                 j += 1
             lit = s[i:j]
             self._advance(j)
+            # WS positions here are exact even across newlines (the
+            # reference's unread() lost the column there); harmless
+            # divergence — WS is dropped before parsing.
             return WS, pos, lit
         if "a" <= ch <= "z" or "A" <= ch <= "Z":
             m = _IDENT_RE.match(s, i)
