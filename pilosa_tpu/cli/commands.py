@@ -245,8 +245,8 @@ def cmd_export(args, stdout, stderr) -> int:
     client = Client(args.host)
     max_slice = client.max_slices().get(args.index, 0)
     for slice in range(max_slice + 1):
-        stdout.write(client.export_csv(args.index, args.frame,
-                                       args.view, slice))
+        client.export_csv_to(stdout, args.index, args.frame,
+                             args.view, slice)
     return 0
 
 

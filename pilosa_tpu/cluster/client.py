@@ -36,6 +36,24 @@ def _host_of(node) -> str:
     return node.host if isinstance(node, Node) else str(node)
 
 
+class _StreamReader:
+    """File-like over an HTTPResponse + its dedicated connection."""
+
+    def __init__(self, resp, conn):
+        self.resp = resp
+        self.conn = conn
+        self.status = resp.status
+
+    def read(self, size: int = -1) -> bytes:
+        return self.resp.read(size)
+
+    def close(self) -> None:
+        try:
+            self.resp.close()
+        finally:
+            self.conn.close()
+
+
 class Bit:
     """One (row, column, timestamp) triple for import
     (client.go:977-1005)."""
@@ -104,8 +122,13 @@ class Client:
         target = host or self.host
         if idempotent is None:
             idempotent = method in self._IDEMPOTENT
+        # File-like bodies (streaming restore) must rewind between
+        # attempts — http.client reads them destructively.
+        body_start = body.tell() if hasattr(body, "seek") else None
         last_err = None
         for attempt in range(2):
+            if body_start is not None:
+                body.seek(body_start)
             conn = None if attempt else self._conn_get(target)
             fresh = conn is None
             if conn is None:
@@ -141,6 +164,21 @@ class Client:
         # Unreachable host → ClientError so failover loops can catch
         # and try the next owner.
         raise ClientError(f"{method} http://{target}{path}: {last_err}")
+
+    def _do_stream(self, path: str, host: Optional[str] = None,
+                   headers: Optional[dict] = None) -> "_StreamReader":
+        """GET on a dedicated (unpooled) connection, returning the
+        response as a file-like the caller reads in chunks and closes —
+        the streaming leg of backup (client.go:552-580 attaches the
+        response body as an io.ReadCloser)."""
+        target = host or self.host
+        try:
+            conn = http.client.HTTPConnection(target, timeout=self.timeout)
+            conn.request("GET", path, headers=headers or {})
+            resp = conn.getresponse()
+        except (http.client.HTTPException, OSError) as e:
+            raise ClientError(f"GET http://{target}{path}: {e}")
+        return _StreamReader(resp, conn)
 
     def _ok(self, status: int, body: bytes, what: str) -> bytes:
         if status != 200:
@@ -264,27 +302,56 @@ class Client:
 
     # -- export (client.go:392-460) ------------------------------------------
 
-    def export_csv(self, index: str, frame: str, view: str, slice: int
-                   ) -> str:
-        """CSV of (row,column) for one slice, trying each owner until one
-        succeeds (client.go:407-418)."""
+    def export_csv_to(self, w, index: str, frame: str, view: str,
+                      slice: int) -> None:
+        """Stream one slice's CSV into text writer ``w``, trying each
+        owner until one succeeds (client.go:392-460 streams through
+        io.Copy; whole-slice CSV is too big to buffer). The download
+        spools through a bounded temp file so an owner dying mid-body
+        fails over without having written partial rows to ``w``."""
+        import shutil
+        import tempfile
         nodes = self.fragment_nodes(index, slice)
         random.shuffle(nodes)
         last_err = None
         for node in nodes:
             try:
-                status, raw = self._do(
-                    "GET",
+                rd = self._do_stream(
                     f"/export?index={index}&frame={frame}&view={view}"
-                    f"&slice={slice}", headers={"Accept": "text/csv"},
-                    host=node["host"])
+                    f"&slice={slice}", host=node["host"],
+                    headers={"Accept": "text/csv"})
             except ClientError as e:
                 last_err = e
                 continue
-            if status == 200:
-                return raw.decode()
-            last_err = ClientError(f"export: status={status}")
+            try:
+                if rd.status != 200:
+                    last_err = ClientError(f"export: status={rd.status}")
+                    continue
+                with tempfile.SpooledTemporaryFile(
+                        max_size=self._SPOOL_MAX) as spool:
+                    try:
+                        shutil.copyfileobj(rd, spool, 1 << 20)
+                    except (http.client.HTTPException, OSError) as e:
+                        last_err = ClientError(
+                            f"export from {node['host']}: {e}")
+                        continue
+                    spool.seek(0)
+                    while True:
+                        chunk = spool.read(1 << 20)
+                        if not chunk:
+                            return
+                        w.write(chunk.decode())
+            finally:
+                rd.close()
         raise last_err or ClientError("no nodes")
+
+    def export_csv(self, index: str, frame: str, view: str, slice: int
+                   ) -> str:
+        """Buffered convenience form of export_csv_to."""
+        import io as _io
+        buf = _io.StringIO()
+        self.export_csv_to(buf, index, frame, view, slice)
+        return buf.getvalue()
 
     # -- anti-entropy (client.go:798-974) ------------------------------------
 
@@ -336,73 +403,117 @@ class Client:
 
     # -- backup / restore (client.go:463-674) --------------------------------
 
-    def backup_slice(self, index: str, frame: str, view: str, slice: int
-                     ) -> Optional[bytes]:
-        """Fragment tar stream from any owner; None if the slice doesn't
-        exist yet (client.go:541-551)."""
+    # Spool cap: slices smaller than this stay in memory; larger ones
+    # roll to a temp file, so a 128 MB+ slice never sits in RAM whole.
+    _SPOOL_MAX = 1 << 24
+
+    def backup_slice(self, index: str, frame: str, view: str, slice: int):
+        """One slice's fragment tar as a seekable bounded spool (the
+        caller closes it); None if the slice doesn't exist yet
+        (client.go:541-580). The body downloads inside the per-owner
+        loop so a node dying mid-transfer fails over to a replica."""
+        import shutil
+        import tempfile
         nodes = self.fragment_nodes(index, slice)
         random.shuffle(nodes)
         last_err: Optional[Exception] = None
         for node in nodes:
             try:
-                status, raw = self._do(
-                    "GET", f"/fragment/data?index={index}&frame={frame}"
-                           f"&view={view}&slice={slice}",
-                    host=node["host"])
+                rd = self._do_stream(
+                    f"/fragment/data?index={index}&frame={frame}"
+                    f"&view={view}&slice={slice}", host=node["host"])
             except ClientError as e:
                 last_err = e
                 continue
-            if status == 200:
-                return raw
-            if status == 404:
-                return None
-            last_err = ClientError(f"backup slice: status={status}")
+            try:
+                if rd.status == 404:
+                    return None
+                if rd.status != 200:
+                    last_err = ClientError(
+                        f"backup slice: status={rd.status}")
+                    continue
+                spool = tempfile.SpooledTemporaryFile(
+                    max_size=self._SPOOL_MAX)
+                try:
+                    shutil.copyfileobj(rd, spool, 1 << 20)
+                except (http.client.HTTPException, OSError) as e:
+                    spool.close()
+                    last_err = ClientError(
+                        f"backup slice from {node['host']}: {e}")
+                    continue
+                spool.seek(0)
+                return spool
+            finally:
+                rd.close()
         if last_err:
             raise last_err
         return None
 
     def restore_slice(self, index: str, frame: str, view: str, slice: int,
-                      data: bytes) -> None:
+                      data) -> None:
+        """POST one slice tar (bytes or a sized file-like) to this host."""
+        headers = {"Content-Type": "application/octet-stream"}
+        if not isinstance(data, bytes):
+            # An explicit length keeps http.client from chunking, which
+            # the WSGI server does not decode.
+            pos = data.tell()
+            data.seek(0, 2)
+            headers["Content-Length"] = str(data.tell() - pos)
+            data.seek(pos)
         status, raw = self._do(
             "POST", f"/fragment/data?index={index}&frame={frame}"
-                    f"&view={view}&slice={slice}", data,
-            {"Content-Type": "application/octet-stream"})
+                    f"&view={view}&slice={slice}", data, headers)
         self._ok(status, raw, "restore slice")
 
     def backup_to(self, w, index: str, frame: str, view: str) -> None:
         """Stream every slice of (index, frame, view) into a tar whose
-        entries are named by slice id (client.go:463-529)."""
+        entries are named by slice id (client.go:463-529). Slices spool
+        through bounded temp files (tar headers need the size upfront);
+        peak memory stays at the spool cap, not the slice size."""
         import tarfile
         tw = tarfile.open(fileobj=w, mode="w|")
         max_slice = self.max_slices().get(index, 0)
         for slice in range(max_slice + 1):
-            data = self.backup_slice(index, frame, view, slice)
-            if data is None:
+            spool = self.backup_slice(index, frame, view, slice)
+            if spool is None:
                 continue
-            info = tarfile.TarInfo(str(slice))
-            info.size = len(data)
-            info.mode = 0o666
-            import io as _io
-            tw.addfile(info, _io.BytesIO(data))
+            with spool:
+                spool.seek(0, 2)
+                size = spool.tell()
+                spool.seek(0)
+                info = tarfile.TarInfo(str(slice))
+                info.size = size
+                info.mode = 0o666
+                tw.addfile(info, spool)
         tw.close()
 
     def restore_from(self, r, index: str, frame: str, view: str) -> None:
         """Restore a backup_to tar: push each slice entry to every owner
-        (client.go:583-674)."""
+        (client.go:583-674). Entries spool through a bounded temp file
+        (each goes to multiple owners, so the source must be re-readable)
+        and POST as streaming bodies."""
+        import shutil
         import tarfile
+        import tempfile
         tr = tarfile.open(fileobj=r, mode="r|")
         for info in tr:
             if not info.name.isdigit():
                 raise ClientError(f"invalid backup entry: {info.name}")
             slice = int(info.name)
-            data = tr.extractfile(info).read()
-            for node in self.fragment_nodes(index, slice):
-                status, raw = self._do(
-                    "POST", f"/fragment/data?index={index}&frame={frame}"
-                            f"&view={view}&slice={slice}", data,
-                    {"Content-Type": "application/octet-stream"},
-                    host=node["host"])
-                self._ok(status, raw, f"restore slice {slice}")
+            src = tr.extractfile(info)
+            with tempfile.SpooledTemporaryFile(
+                    max_size=self._SPOOL_MAX) as spool:
+                shutil.copyfileobj(src, spool, 1 << 20)
+                for node in self.fragment_nodes(index, slice):
+                    spool.seek(0)
+                    status, raw = self._do(
+                        "POST", f"/fragment/data?index={index}"
+                                f"&frame={frame}&view={view}"
+                                f"&slice={slice}", spool,
+                        {"Content-Type": "application/octet-stream",
+                         "Content-Length": str(info.size)},
+                        host=node["host"])
+                    self._ok(status, raw, f"restore slice {slice}")
 
     def restore_frame(self, host: str, index: str, frame: str) -> None:
         """Ask this node to pull a frame from a remote cluster host

@@ -31,6 +31,7 @@ from ..proto import internal_pb2 as pb
 from ..storage.attrs import diff_blocks
 from ..storage.bitmap import Bitmap
 from ..utils import timequantum as tq
+from ..utils.streams import CappedReader
 from . import codec
 
 _PROTOBUF = "application/x-protobuf"
@@ -78,6 +79,18 @@ class Request:
             return b""
         return stream.read(length)
 
+    def body_stream(self):
+        """The request body as a bounded file-like, without buffering it
+        — restores stream 128 MB+ fragment tars straight to disk."""
+        try:
+            length = int(self.environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            length = 0
+        stream = self.environ.get("wsgi.input")
+        if stream is None or length <= 0:
+            return io.BytesIO(b"")
+        return CappedReader(stream, length)
+
     def json(self) -> dict:
         raw = self.body()
         if not raw:
@@ -110,6 +123,20 @@ class Response:
     @staticmethod
     def proto(msg, status: int = 200) -> "Response":
         return Response(status, msg.SerializeToString(), _PROTOBUF)
+
+
+def _export_csv_chunks(frag):
+    """Vectorized, chunked CSV body: one chunk per roaring container, so
+    a 128 MB+ fragment never sits in memory as text (the reference
+    streams via csv.Writer over ForEachBit, handler.go:985-1025)."""
+    from .. import SLICE_WIDTH
+    base = frag.slice * SLICE_WIDTH
+    w = np.uint64(SLICE_WIDTH)
+    for vals in frag.storage.value_chunks():
+        rows = (vals // w).tolist()
+        cols = (vals % w).tolist()
+        yield "".join(f"{r},{base + c}\r\n"
+                      for r, c in zip(rows, cols)).encode()
 
 
 def _stream_chunks(f, chunk_size: int = 1 << 20):
@@ -244,10 +271,13 @@ class Handler:
                            [("Content-Type", resp.content_type),
                             ("Content-Length", str(len(resp.body)))])
             return [resp.body]
-        # Streamed file-object body.
+        # Streamed body: file object (chunked reads) or a generator of
+        # byte chunks (CSV export) — either way, never buffered whole.
         start_response(status_line,
                        [("Content-Type", resp.content_type)])
-        return _stream_chunks(resp.body)
+        if hasattr(resp.body, "read"):
+            return _stream_chunks(resp.body)
+        return resp.body
 
     # -- meta ----------------------------------------------------------------
 
@@ -621,10 +651,7 @@ class Handler:
                                     req.query.get("view", ""), slice)
         if frag is None:
             return Response(200, b"", "text/csv")
-        buf = io.StringIO()
-        for row_id, col_id in frag.for_each_bit():
-            buf.write(f"{row_id},{col_id}\r\n")
-        return Response(200, buf.getvalue().encode(), "text/csv")
+        return Response(200, _export_csv_chunks(frag), "text/csv")
 
     # -- fragment endpoints --------------------------------------------------
 
@@ -680,7 +707,16 @@ class Handler:
             raise HTTPError(404, "frame not found")
         view = frame.create_view_if_not_exists(req.query.get("view", ""))
         frag = view.create_fragment_if_not_exists(slice)
-        frag.read_from(io.BytesIO(req.body()))
+        # Spool the body to a bounded temp file BEFORE read_from: the
+        # restore swaps storage under the fragment lock, which must be
+        # held at disk speed, not for a slow client's whole upload —
+        # and an aborted upload then never reaches the storage swap.
+        import shutil
+        import tempfile
+        with tempfile.SpooledTemporaryFile(max_size=1 << 24) as spool:
+            shutil.copyfileobj(req.body_stream(), spool, 1 << 20)
+            spool.seek(0)
+            frag.read_from(spool)
         return Response.json({})
 
     def _handle_post_frame_restore(self, req: Request) -> Response:
@@ -709,7 +745,10 @@ class Handler:
                                          slice)
                 if rd is None:
                     continue
-                frag.read_from(io.BytesIO(rd))
+                try:
+                    frag.read_from(rd)
+                finally:
+                    rd.close()
         return Response.json({})
 
     # -- pod work items (parallel.pod) ---------------------------------------

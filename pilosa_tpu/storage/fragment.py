@@ -42,6 +42,7 @@ from ..errors import PilosaError
 from ..parallel.residency import DeviceRowCache
 from ..proto import internal_pb2 as pb
 from ..utils import logger as logger_mod
+from ..utils.streams import CappedReader
 from . import cache as cache_mod
 from . import roaring
 from .bitmap import Bitmap
@@ -76,24 +77,6 @@ class PairSet:
     def empty() -> "PairSet":
         z = np.empty(0, dtype=np.uint64)
         return PairSet(z, z)
-
-
-class _CappedReader:
-    """File-like reader limited to the first n bytes — the WAL may grow
-    after the size is captured, and tar headers carry a fixed size."""
-
-    def __init__(self, f, n: int):
-        self.f = f
-        self.remaining = n
-
-    def read(self, size: int = -1) -> bytes:
-        if self.remaining <= 0:
-            return b""
-        if size < 0 or size > self.remaining:
-            size = self.remaining
-        out = self.f.read(size)
-        self.remaining -= len(out)
-        return out
 
 
 class Fragment:
@@ -637,7 +620,7 @@ class Fragment:
             info = tarfile.TarInfo("data")
             info.size = data_size
             info.mode = 0o600
-            tw.addfile(info, _CappedReader(f, data_size))
+            tw.addfile(info, CappedReader(f, data_size))
         try:
             with open(self.cache_path, "rb") as f:
                 cache_size = os.fstat(f.fileno()).st_size
@@ -666,11 +649,18 @@ class Fragment:
                 if info.name == "data":
                     self._close_storage()
                     tmp = self.path + ".restoring"
-                    with open(tmp, "wb") as f:
-                        shutil.copyfileobj(src, f)
-                        f.flush()
-                        os.fsync(f.fileno())
-                    os.replace(tmp, self.path)
+                    try:
+                        with open(tmp, "wb") as f:
+                            shutil.copyfileobj(src, f)
+                            f.flush()
+                            os.fsync(f.fileno())
+                        os.replace(tmp, self.path)
+                    except BaseException:
+                        # A truncated source (aborted upload) must not
+                        # leave the fragment with storage closed — the
+                        # old data file is still in place; reopen it.
+                        self._open_storage()
+                        raise
                     self._open_storage()
                     self.row_cache.clear()
                     self.device.invalidate_all()

@@ -548,14 +548,19 @@ class Bitmap:
 
     def values(self) -> np.ndarray:
         """All set positions as a sorted u64 vector."""
-        parts = []
-        for key, c in zip(self.keys, self.containers):
-            if c.n:
-                parts.append(np.uint64(key << 16) +
-                             c.values().astype(np.uint64))
+        parts = list(self.value_chunks())
         if not parts:
             return _EMPTY_U64
         return np.concatenate(parts)
+
+    def value_chunks(self):
+        """Sorted set positions as one u64 array per container — the
+        streaming form of values() for exports that must not
+        materialize a whole 100M+-bit fragment (reference streams
+        exports bit-by-bit, handler.go:985-1025)."""
+        for key, c in zip(list(self.keys), list(self.containers)):
+            if c.n:
+                yield np.uint64(key << 16) + c.values().astype(np.uint64)
 
     # -- counts / ranges
 
