@@ -175,8 +175,58 @@ def main() -> None:
     # have fallen back to the (coordinator-only) host path silently.
     assert srv.executor.device_fallbacks == 0, srv.executor.device_fallbacks
 
+    if os.environ.get("POD_TEST_POISON") == "1":
+        poison_phase(srv, coord, model)
+
     print("POD_TEST_OK", flush=True)
     srv.close()
+
+
+def poison_phase(srv, coord, model) -> None:
+    """Force a real partial-dispatch failure, then prove the poisoned
+    pod still serves correct results via the host fan-out under
+    concurrent load (the pod's workers stay HTTP-alive; only the
+    collective path is off)."""
+    import concurrent.futures
+
+    from pilosa_tpu.parallel.pod import PodError
+
+    # A bogus work item is delivered to every worker (their legs error)
+    # and the coordinator's own leg raises — the genuine poisoning
+    # transition in Pod._dispatch, not a flag poke.
+    try:
+        srv.pod._dispatch({"kind": "bogus", "index": "i",
+                           "slices": [0, 1, 2, 3], "leaves": []})
+        raise AssertionError("bogus dispatch must raise")
+    except PodError:
+        pass
+    assert srv.pod._poisoned, "partial dispatch failure must poison"
+
+    want_union = len(model[1] | model[2])
+    want_r1, want_r2 = len(model[1]), len(model[2])
+
+    def check(_):
+        got = query(coord, "i",
+                    "Count(Union(Bitmap(frame=f, rowID=1),"
+                    " Bitmap(frame=f, rowID=2)))")[0]
+        assert got == want_union, (got, want_union)
+        bits = query(coord, "i", "Bitmap(frame=f, rowID=2)")[0]["bits"]
+        assert bits == sorted(model[2]), len(bits)
+        pairs = query(coord, "i", "TopN(frame=f, n=2)")[0]
+        got = [(p["id"], p["count"]) for p in pairs]
+        assert got == [(1, want_r1), (2, want_r2)], got
+        return True
+
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        assert all(ex.map(check, range(24)))
+    # The device path really was refused and the host fan-out used.
+    assert srv.executor.device_fallbacks > 0
+    try:
+        srv.pod._dispatch({"kind": "count_expr", "index": "i",
+                           "expr": [], "leaves": [], "slices": [0]})
+        raise AssertionError("poisoned pod must refuse collectives")
+    except PodError:
+        pass
 
 
 if __name__ == "__main__":

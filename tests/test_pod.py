@@ -18,20 +18,22 @@ from podenv import ChildSet, free_port, pod_env
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
 
-def test_pod_two_process_count_topn(tmp_path):
+def run_pod(tmp_path, n_procs: int, extra_env: dict | None = None):
     jax_port = free_port()
-    peers = [f"localhost:{free_port()}", f"localhost:{free_port()}"]
+    peers = [f"localhost:{free_port()}" for _ in range(n_procs)]
     script = os.path.join(_HERE, "pod_child.py")
 
     children = ChildSet(tmp_path)
     try:
-        for pid in range(2):
+        for pid in range(n_procs):
             data_dir = tmp_path / f"node{pid}"
             data_dir.mkdir()
+            env = pod_env(pid, jax_port, peers)
+            env.update(extra_env or {})
             children.spawn(
                 f"worker{pid}",
                 [sys.executable, script, str(pid), str(data_dir)],
-                pod_env(pid, jax_port, peers), pipe=(pid == 0))
+                env, pipe=(pid == 0))
         out, err = children.procs["worker0"].communicate(timeout=240)
         assert children.procs["worker0"].returncode == 0, (
             f"coordinator failed"
@@ -41,3 +43,15 @@ def test_pod_two_process_count_topn(tmp_path):
         assert "POD_TEST_OK" in out, out
     finally:
         children.cleanup()
+
+
+def test_pod_two_process_count_topn(tmp_path):
+    run_pod(tmp_path, 2)
+
+
+def test_pod_three_process_poisoned_serves_host_path(tmp_path):
+    """3 processes: 4 slices land 2/1/1 (owner_pid placement is
+    non-trivial), and after a forced partial-dispatch failure the
+    poisoned pod must keep serving correct results under concurrent
+    load via the host fan-out (pod_child.poison_phase)."""
+    run_pod(tmp_path, 3, {"POD_TEST_POISON": "1"})
