@@ -126,6 +126,18 @@ class Executor:
         # per-slice roaring merges win.
         self.mesh_min_leaves = int(os.environ.get(
             "PILOSA_TPU_MESH_MIN_LEAVES", "8"))
+        # Calibrated device/host routing (parallel.costmodel): above the
+        # static floor, a measured cost model can still veto the device
+        # when the host path is a clear predicted win on this hardware.
+        # Injectable for tests; PILOSA_TPU_COST_MODEL=0 disables.
+        self.cost_model = None
+        self._cost_model_enabled = os.environ.get(
+            "PILOSA_TPU_COST_MODEL", "1") != "0"
+        self._cost_margin = float(os.environ.get(
+            "PILOSA_TPU_COST_MARGIN", "0.5"))
+        # Deliberate host routings by the cost model (observability —
+        # distinct from device_fallbacks, which count failures).
+        self.cost_vetoes = 0
         self._mesh = None  # lazy: built on first device-batched call
         self._mesh_failed_until = None  # backoff after backend failure
         # Device-fallback observability (a real kernel bug would
@@ -553,6 +565,12 @@ class Executor:
         if mesh is None or len(slices) > mesh_mod.slice_chunk_bound(
                 mesh.shape[mesh_mod.AXIS_SLICES]):
             return None
+        # One sync serves all K counts; the host alternative re-walks
+        # each count's leaves, so its bytes are ≥ the unique-leaf block
+        # the veto prices — a vetoed batch falls to per-call gates that
+        # agree, landing everything on the host path.
+        if not self._device_pays(mesh, len(leaves), len(slices)):
+            return None
         try:
             arrs = [self._leaf_device_array(mesh, index, leaf,
                                             tuple(slices))
@@ -750,6 +768,8 @@ class Executor:
             mesh = self._mesh_or_none()  # backend init only past threshold
             if mesh is None:
                 return NotImplemented
+            if not self._device_pays(mesh, len(leaves), len(slices)):
+                return NotImplemented  # calibrated: host clearly faster
             shard, budget = self._count_budget(slices)
             if self._leaf_block_bytes(len(leaves), shard) > budget:
                 return NotImplemented  # oversized leaf set: host path
@@ -770,6 +790,28 @@ class Executor:
                 return NotImplemented
 
         return local_fn
+
+    def _device_pays(self, mesh, n_rows: int, n_slices: int) -> bool:
+        """Calibrated routing veto: False when the host path clearly
+        wins for a block of ``n_rows × n_slices`` packed rows on this
+        hardware (round 2's c4 showed the static threshold sending
+        128-slice Counts to a path 4× slower through the tunnel)."""
+        if not self._cost_model_enabled:
+            return True
+        if self.cost_model is None:
+            from .parallel import costmodel
+            try:
+                self.cost_model = costmodel.get_model(
+                    mesh, margin=self._cost_margin)
+            except Exception:  # noqa: BLE001 - never fail a query on this
+                self._cost_model_enabled = False
+                return True
+        from .ops.packed import WORDS_PER_SLICE
+        pays = self.cost_model.device_pays(
+            n_rows * n_slices * WORDS_PER_SLICE * 4)
+        if not pays:
+            self.cost_vetoes += 1
+        return pays
 
     def _pack_leaf_block(self, index: str, leaves: list[tuple],
                          slices: list[int]) -> np.ndarray:
@@ -932,6 +974,9 @@ class Executor:
             mesh = self._mesh_or_none()
             if mesh is None:
                 return NotImplemented
+            if not self._device_pays(mesh, len(ids) + len(leaves),
+                                     len(slices)):
+                return NotImplemented  # calibrated: host clearly faster
             from .parallel import mesh as mesh_mod
             resident_ok = (len(slices) <= mesh_mod.slice_chunk_bound(
                 mesh.shape[mesh_mod.AXIS_SLICES])

@@ -1,0 +1,128 @@
+"""Calibrated device/host routing for the executor's fast paths.
+
+The reference has one path and always takes it (executor.go:1103-1236's
+host map-reduce). This build has two — the roaring host path and the
+mesh device path — and the right one depends on hardware the code can't
+know statically: through a tunnel one host↔device round trip costs
+~130 ms, while a direct-attached chip does it in ~1 ms. A fixed slice
+threshold therefore mis-routes on one rig or the other (round 2's
+measured c4: 128-slice Counts went to a device path 4× slower than the
+host through the tunnel).
+
+So the executor calibrates at first mesh use and predicts per query:
+
+- ``sync_s``   — one measured no-op dispatch + result fetch round trip
+                 (the device path's fixed cost, whatever the transport);
+- ``host_bps`` — the measured roaring intersection-count rate on this
+                 host (the host path's per-byte cost on packed words);
+- ``device_bps`` — HBM-rate constant for the fused count kernel (the
+                 device's per-byte cost; ~2nd-order vs the sync floor).
+
+Routing rule: the device serves unless the predicted host cost is a
+CLEAR win (< margin × device cost, margin 0.5 by default). The margin
+keeps marginal shapes on the device, where residency caching and
+dispatch batching improve repeat queries; the env override
+``PILOSA_TPU_COST_MARGIN`` tunes it, ``PILOSA_TPU_COST_MODEL=0``
+disables the veto entirely (pre-calibration behavior).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+# Assumed HBM streaming rate for the fused count kernel. Deliberately a
+# constant: at the shapes where it matters the sync floor dominates, and
+# measuring it well needs the big-operand bench (bench.py), not a
+# startup probe. ~400 GB/s is v5e-class effective rate.
+DEVICE_BPS = 4.0e11
+
+
+@dataclass
+class Calibration:
+    sync_s: float       # one dispatch + fetch round trip, seconds
+    host_bps: float     # roaring count throughput, bytes/second
+
+    def device_cost(self, total_bytes: int) -> float:
+        return self.sync_s + total_bytes / DEVICE_BPS
+
+    def host_cost(self, total_bytes: int) -> float:
+        return total_bytes / self.host_bps
+
+
+class CostModel:
+    def __init__(self, cal: Calibration, margin: float = 0.5):
+        self.cal = cal
+        self.margin = margin
+
+    def device_pays(self, total_bytes: int) -> bool:
+        """False only when the host path is a clear predicted win."""
+        host = self.cal.host_cost(total_bytes)
+        device = self.cal.device_cost(total_bytes)
+        return host >= self.margin * device
+
+
+def _measure_sync_s(mesh) -> float:
+    """One no-op dispatch + fetch through whatever transport this mesh
+    uses (tunnel: ~130 ms; direct or CPU: ~1 ms). Compile excluded."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def probe(x):
+        return x.sum()
+
+    x = jax.device_put(jnp.ones(128, jnp.int32), mesh.devices.flat[0])
+    int(probe(x))  # compile + warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        int(probe(x))  # int() forces the result fetch
+        best = min(best, time.perf_counter() - t0)
+    return max(best, 1e-6)
+
+
+def _measure_host_bps() -> float:
+    """The host path's real per-byte rate: roaring intersection_count
+    over dense bitmap containers (the shape the device path competes
+    with), including the per-container Python dispatch cost."""
+    from ..storage import roaring
+
+    n_bits = 1 << 23  # 8 Mbit → 128 bitmap containers → 1 MB operands
+    a = roaring.Bitmap.from_sorted(
+        np.arange(0, n_bits, 2, dtype=np.uint64))
+    b = roaring.Bitmap.from_sorted(
+        np.arange(0, n_bits, 3, dtype=np.uint64))
+    a.intersection_count(b)  # warm
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        a.intersection_count(b)
+        best = min(best, time.perf_counter() - t0)
+    # Bytes "processed" = both operands' packed words.
+    return (2 * n_bits / 8) / max(best, 1e-9)
+
+
+_cache: dict[str, Calibration] = {}
+_cache_mu = threading.Lock()
+
+
+def get_model(mesh, margin: float = 0.5) -> CostModel:
+    """Calibrate once per backend platform per process; the margin is
+    per-caller (a cached calibration must not freeze the first caller's
+    margin for everyone). Measurement happens OUTSIDE the lock — on a
+    tunnel rig it costs several ~130 ms round trips, and concurrent
+    queries must not stall behind it; a losing racer just discards its
+    duplicate measurement."""
+    platform = mesh.devices.flat[0].platform
+    with _cache_mu:
+        cal = _cache.get(platform)
+    if cal is None:
+        cal = Calibration(sync_s=_measure_sync_s(mesh),
+                          host_bps=_measure_host_bps())
+        with _cache_mu:
+            cal = _cache.setdefault(platform, cal)
+    return CostModel(cal, margin)

@@ -328,6 +328,14 @@ class Handler:
             # "deviceFallback" counter (executor._note_device_fallback)
             # — one name, one source.
             snap["deviceFallback"] = fallbacks
+        vetoes = getattr(self.executor, "cost_vetoes", None)
+        if vetoes is not None:
+            snap["costModelVetoes"] = vetoes
+        model = getattr(self.executor, "cost_model", None)
+        if model is not None:
+            snap["costModel"] = {"syncS": model.cal.sync_s,
+                                 "hostBps": model.cal.host_bps,
+                                 "margin": model.margin}
         return Response.json(snap)
 
     # -- profiling (reference handler.go:30,99 mounts net/http/pprof) --------

@@ -1,0 +1,97 @@
+"""Calibrated device/host routing (parallel.costmodel).
+
+Round 2's measured c4 showed the static slice threshold routing
+128-slice Counts onto a device path ~4× slower than the host through
+the tunnel. The cost model predicts per query from measured hardware
+numbers; these tests pin the decision function on injected calibrations
+for both hardware classes, and that the executor's veto actually routes
+a query onto the host path under a tunnel-shaped calibration.
+"""
+
+import numpy as np
+
+from pilosa_tpu.ops.packed import WORDS_PER_SLICE
+from pilosa_tpu.parallel.costmodel import Calibration, CostModel
+
+
+def block_bytes(rows: int, slices: int) -> int:
+    return rows * slices * WORDS_PER_SLICE * 4
+
+
+# Round-2 measured shapes: tunnel sync ~130 ms, host roaring ~1 GB/s.
+TUNNEL = Calibration(sync_s=0.130, host_bps=1.0e9)
+# Direct-attached chip: ~1 ms sync, same host.
+DIRECT = Calibration(sync_s=0.001, host_bps=1.0e9)
+
+
+class TestDecision:
+    def test_tunnel_c4_routes_host(self):
+        # BASELINE config 4: Count(Intersect) = 2 leaves × 128 slices
+        # (~34 MB). Host ~33 ms vs device ≥130 ms — clear host win.
+        m = CostModel(TUNNEL)
+        assert not m.device_pays(block_bytes(2, 128))
+
+    def test_tunnel_1gbit_rows_route_device(self):
+        # The metric of record: 2 leaves × 1024 slices (~268 MB).
+        # Host ~268 ms vs device ~131 ms — device wins even on tunnel.
+        m = CostModel(TUNNEL)
+        assert m.device_pays(block_bytes(2, 1024))
+
+    def test_direct_attach_routes_device_at_c4(self):
+        # Without the tunnel floor the same c4 shape belongs on device.
+        m = CostModel(DIRECT)
+        assert m.device_pays(block_bytes(2, 128))
+
+    def test_margin_keeps_marginal_shapes_on_device(self):
+        # Host must be a CLEAR win (margin 0.5): a shape where host
+        # cost ≈ device cost stays on the device path.
+        cal = Calibration(sync_s=0.010, host_bps=1.0e9)
+        bytes_ = int(0.010 * 1.0e9)  # host cost == sync cost
+        assert CostModel(cal, margin=0.5).device_pays(bytes_)
+        assert not CostModel(cal, margin=1.5).device_pays(bytes_)
+
+
+class TestExecutorVeto:
+    def test_veto_routes_query_to_host(self, tmp_path):
+        """With an injected tunnel calibration, a wide Count above the
+        static slice floor must serve via the host path (no device
+        dispatch), and still answer correctly."""
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.models.holder import Holder
+        from pilosa_tpu import SLICE_WIDTH
+
+        holder = Holder(str(tmp_path))
+        holder.open()
+        idx = holder.create_index("i")
+        frame = idx.create_frame("f")
+        n_slices = 16
+        cols = np.arange(n_slices, dtype=np.uint64) * np.uint64(
+            SLICE_WIDTH)
+        frame.import_bits(np.zeros(n_slices, dtype=np.uint64), cols)
+        frame.import_bits(np.zeros(n_slices, dtype=np.uint64),
+                          cols + np.uint64(1))
+
+        ex = Executor(holder, host="h", mesh_min_slices=1)
+        # Tunnel-shaped hardware: host clearly wins at 16 slices.
+        ex.cost_model = CostModel(TUNNEL)
+        try:
+            got = ex.execute(
+                "i", 'Count(Bitmap(frame="f", rowID=0))',
+                list(range(n_slices)))
+            assert got == [2 * n_slices]
+            assert ex.cost_vetoes > 0, "tunnel calibration must veto"
+            assert ex.device_fallbacks == 0  # a veto is not a failure
+
+            # Same query with the model disabled takes the device path.
+            ex2 = Executor(holder, host="h", mesh_min_slices=1)
+            ex2._cost_model_enabled = False
+            got = ex2.execute(
+                "i", 'Count(Bitmap(frame="f", rowID=0))',
+                list(range(n_slices)))
+            assert got == [2 * n_slices]
+            assert ex2.cost_vetoes == 0
+            assert ex2._mesh is not None, "device path must engage"
+            ex2.close()
+        finally:
+            ex.close()
+            holder.close()
