@@ -147,6 +147,11 @@ def main() -> None:
             native.popcnt_and(a64[i], b64[i])
             host_times.append(time.perf_counter() - t0)
     host_s = sorted(host_times)[len(host_times) // 2]
+    # Pin the denominator: this shared 1-core VM is noisy, and a freshly
+    # measured host leg swung vs_baseline 2× between otherwise identical
+    # runs. Persist the best (fastest) host measurement across rounds
+    # and divide by that; both raw legs are reported alongside.
+    host_pinned_s = _pin_host_baseline(bits, k_rows, host_s)
     # The device subprocess regenerates its own operands — drop ours
     # (4 GB at default ROWS) so peak host RSS doesn't double.
     del a, b, a64, b64
@@ -180,13 +185,26 @@ def main() -> None:
 
     metric = f"intersect_count_{bits // (1 << 20)}Mbit_rows"
     if device_s is not None:
-        print(json.dumps({
+        line = {
             "metric": metric,
             "value": round(1.0 / device_s, 3),
             "unit": "ops/sec",
-            "vs_baseline": round(host_s / device_s, 3),
+            "vs_baseline": round(host_pinned_s / device_s, 3),
             "platform": platform,
-        }))
+            "device_ops": round(1.0 / device_s, 3),
+            "host_ops_this_run": round(1.0 / host_s, 3),
+            "host_ops_pinned": round(1.0 / host_pinned_s, 3),
+        }
+        # Second clause of the metric of record: TopN(1000) p50 at
+        # BASELINE config-3 scale, measured by benchmarks/suite.py
+        # (config3_topn1000_end_to_end) and recorded for the artifact.
+        try:
+            with open(os.path.join(os.path.dirname(_BASELINE_PATH),
+                                   "TOPN1000.json")) as f:
+                line["topn1000_p50_ms"] = json.load(f)["device_p50_ms"]
+        except (OSError, ValueError, KeyError):
+            pass
+        print(json.dumps(line))
     else:
         # Fail-soft: record the host-C++ denominator so the round still
         # has a number, flagged with the device error.
@@ -198,6 +216,33 @@ def main() -> None:
             "platform": "host-cpp-fallback",
             "error": err or "device measurement unavailable",
         }))
+
+
+_BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmarks", "HOST_BASELINE.json")
+
+
+def _pin_host_baseline(bits: int, k_rows: int, host_s: float) -> float:
+    """Best-of-all-rounds host seconds for this workload shape; updates
+    the persisted record when this run's measurement is faster."""
+    key = f"bits={bits},rows={k_rows}"
+    record = {}
+    try:
+        with open(_BASELINE_PATH) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        pass
+    best = record.get(key, {}).get("best_host_s")
+    if best is None or host_s < best:
+        record[key] = {"best_host_s": host_s,
+                       "updated": time.strftime("%Y-%m-%d")}
+        try:
+            with open(_BASELINE_PATH, "w") as f:
+                json.dump(record, f, indent=1, sort_keys=True)
+        except OSError:
+            pass
+        return host_s
+    return best
 
 
 if __name__ == "__main__":
