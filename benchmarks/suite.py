@@ -506,12 +506,7 @@ def config3_topn1000_end_to_end() -> None:
 def _write_topn1000_artifact(p50_ms, p95_ms, first_ms, rows, slices):
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "TOPN1000.json")
-    try:
-        with open(path) as f:
-            rec = json.load(f)
-    except (OSError, ValueError):
-        rec = {}
-    rec.update({
+    rec = {
         "config": f"BASELINE config 3: TopN(n=1000), {rows} rows x "
                   f"{slices} slices, end-to-end through the executor",
         "date": time.strftime("%Y-%m-%d"),
@@ -519,7 +514,7 @@ def _write_topn1000_artifact(p50_ms, p95_ms, first_ms, rows, slices):
         "device_p95_ms": round(p95_ms, 1),
         "device_first_ms": round(first_ms, 1),
         "sync_floor_ms": round(_SYNC_FLOOR_MS, 1),
-    })
+    }
     try:
         with open(path, "w") as f:
             json.dump(rec, f, indent=1)
@@ -567,26 +562,33 @@ def config4_executor_routing() -> None:
                 lat.append(time.perf_counter() - t0)
             assert got == want
             p50 = sorted(lat)[len(lat) // 2]
+            # 8 routed executions (1 warm + 7 timed): all vetoed = the
+            # host path, none = the device path, anything in between =
+            # mixed per-query decisions (report it, don't guess).
+            if label == "routed":
+                chose = {0: "device", 8: "host"}.get(ex.cost_vetoes,
+                                                     "mixed")
+            else:
+                chose = "device" if label == "device_forced" else "host"
             emit_latency(f"c4_executor_{label}_p50", p50 * 1e3,
-                         device=(label == "device_forced"),
+                         device=(chose != "host"),
                          slices=n_slices, vetoes=ex.cost_vetoes)
-            vetoed = ex.cost_vetoes > 0
             ex.close()
-            return p50, vetoed
+            return p50, chose
 
         # routed before device_forced: the forced leg leaves queued
         # device work draining, which contaminates whatever follows on
         # this shared-core rig.
         host, _ = measure("host", use_mesh=False)
         if USE_DEVICE:
-            routed, vetoed = measure("routed")
+            routed, chose = measure("routed")
             forced, _ = measure("device_forced")
             best = min(host, forced)
             emit("c4_routing_overhead", routed / best, "x_vs_best",
                  host_ms=round(host * 1e3, 2),
                  device_ms=round(forced * 1e3, 2),
                  routed_ms=round(routed * 1e3, 2),
-                 chose="host" if vetoed else "device")
+                 chose=chose)
         holder.close()
 
 

@@ -569,7 +569,9 @@ class Executor:
         # each count's leaves, so its bytes are ≥ the unique-leaf block
         # the veto prices — a vetoed batch falls to per-call gates that
         # agree, landing everything on the host path.
-        if not self._device_pays(mesh, len(leaves), len(slices)):
+        if not self._device_pays(
+                mesh, len(leaves), len(slices),
+                cold_rows=self._cold_leaves(mesh, index, leaves, slices)):
             return None
         try:
             arrs = [self._leaf_device_array(mesh, index, leaf,
@@ -768,7 +770,10 @@ class Executor:
             mesh = self._mesh_or_none()  # backend init only past threshold
             if mesh is None:
                 return NotImplemented
-            if not self._device_pays(mesh, len(leaves), len(slices)):
+            if not self._device_pays(
+                    mesh, len(leaves), len(slices),
+                    cold_rows=self._cold_leaves(mesh, index, leaves,
+                                                slices)):
                 return NotImplemented  # calibrated: host clearly faster
             shard, budget = self._count_budget(slices)
             if self._leaf_block_bytes(len(leaves), shard) > budget:
@@ -791,11 +796,15 @@ class Executor:
 
         return local_fn
 
-    def _device_pays(self, mesh, n_rows: int, n_slices: int) -> bool:
+    def _device_pays(self, mesh, n_rows: int, n_slices: int,
+                     cold_rows: int = 0) -> bool:
         """Calibrated routing veto: False when the host path clearly
         wins for a block of ``n_rows × n_slices`` packed rows on this
         hardware (round 2's c4 showed the static threshold sending
-        128-slice Counts to a path 4× slower through the tunnel)."""
+        128-slice Counts to a path 4× slower through the tunnel).
+        ``cold_rows`` of those are not device-resident and must be
+        packed + uploaded first — through a tunnel that transfer, not
+        the compute, dominates."""
         if not self._cost_model_enabled:
             return True
         if self.cost_model is None:
@@ -807,11 +816,35 @@ class Executor:
                 self._cost_model_enabled = False
                 return True
         from .ops.packed import WORDS_PER_SLICE
+        row_bytes = n_slices * WORDS_PER_SLICE * 4
         pays = self.cost_model.device_pays(
-            n_rows * n_slices * WORDS_PER_SLICE * 4)
+            n_rows * row_bytes, cold_bytes=cold_rows * row_bytes)
         if not pays:
             self.cost_vetoes += 1
         return pays
+
+    def _leaf_cache_key(self, mesh, index: str, leaf: tuple,
+                        slices: tuple[int, ...]) -> tuple:
+        from .parallel import mesh as mesh_mod
+        frame, view, row_id = leaf
+        frags = [self.holder.fragment(index, frame, view, s)
+                 for s in slices]
+        gens = tuple((f.device.uid, f.device.generation) if f is not None
+                     else (0, 0) for f in frags)
+        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+        return ("leaf", id(self.holder), index, frame, view, row_id,
+                slices, gens, n_dev)
+
+    def _cold_leaves(self, mesh, index: str, leaves: list[tuple],
+                     slices: list[int]) -> int:
+        """How many leaf slabs an upcoming dispatch would have to pack
+        and upload (i.e. are not in the device residency cache)."""
+        from .parallel.residency import device_cache
+        cache = device_cache()
+        t = tuple(slices)
+        return sum(1 for leaf in leaves
+                   if not cache.contains(
+                       self._leaf_cache_key(mesh, index, leaf, t)))
 
     def _pack_leaf_block(self, index: str, leaves: list[tuple],
                          slices: list[int]) -> np.ndarray:
@@ -841,11 +874,8 @@ class Executor:
         frame, view, row_id = leaf
         frags = [self.holder.fragment(index, frame, view, s)
                  for s in slices]
-        gens = tuple((f.device.uid, f.device.generation) if f is not None
-                     else (0, 0) for f in frags)
         n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
-        key = ("leaf", id(self.holder), index, frame, view, row_id,
-               slices, gens, n_dev)
+        key = self._leaf_cache_key(mesh, index, leaf, slices)
 
         def build():
             from .ops.packed import WORDS_PER_SLICE
@@ -974,18 +1004,29 @@ class Executor:
             mesh = self._mesh_or_none()
             if mesh is None:
                 return NotImplemented
-            if not self._device_pays(mesh, len(ids) + len(leaves),
-                                     len(slices)):
-                return NotImplemented  # calibrated: host clearly faster
             from .parallel import mesh as mesh_mod
+            from .parallel.residency import device_cache
             resident_ok = (len(slices) <= mesh_mod.slice_chunk_bound(
                 mesh.shape[mesh_mod.AXIS_SLICES])
                 and block_bytes <= mesh_mod.TOPN_BLOCK_BYTES)
+            # Cold estimate: the candidate block (the dominant upload)
+            # counts as cold unless it is already resident; the
+            # streaming form re-packs it every query, so it is always
+            # cold there. Leaf slabs add their own cold rows.
+            rows_key = self._topn_rows_key(mesh, index, frame_name,
+                                           tuple(ids), tuple(slices))
+            cold = self._cold_leaves(mesh, index, leaves, slices)
+            if not (resident_ok and device_cache().contains(rows_key)):
+                cold += len(ids)
+            if not self._device_pays(mesh, len(ids) + len(leaves),
+                                     len(slices), cold_rows=cold):
+                return NotImplemented  # calibrated: host clearly faster
             try:
                 if resident_ok:
                     counts = self._topn_exact_resident(
                         mesh, index, frame_name, expr, leaves,
-                        tuple(ids), tuple(slices), threshold, tanimoto)
+                        tuple(ids), tuple(slices), threshold, tanimoto,
+                        rows_key=rows_key)
                 else:
                     counts = mesh_mod.topn_exact(
                         mesh, expr,
@@ -1037,12 +1078,26 @@ class Executor:
                 frag.pack_row(rid, out=rows[si, ri], cached=cached)
         return rows
 
+    def _topn_rows_key(self, mesh, index: str, frame_name: str,
+                       row_ids: tuple[int, ...],
+                       slices: tuple[int, ...]) -> tuple:
+        from .parallel import mesh as mesh_mod
+        frags = [self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
+                 for s in slices]
+        gens = tuple((f.device.uid, f.device.generation) if f is not None
+                     else (0, 0) for f in frags)
+        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+        return ("topnrows", id(self.holder), index, frame_name, row_ids,
+                slices, gens, n_dev)
+
     def _topn_exact_resident(self, mesh, index: str, frame_name: str,
                              expr, leaves: list[tuple],
                              row_ids: tuple[int, ...],
                              slices: tuple[int, ...],
                              threshold: int = 1,
-                             tanimoto: int = 0) -> list[int]:
+                             tanimoto: int = 0,
+                             rows_key: Optional[tuple] = None
+                             ) -> list[int]:
         """TopN exact counts with the candidate block and leaf slabs
         device-resident (budgeted HBM cache) — repeat TopN queries skip
         the per-query pack + upload entirely. threshold>1 / tanimoto
@@ -1051,11 +1106,9 @@ class Executor:
         from .parallel.residency import device_cache
         frags = [self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
                  for s in slices]
-        gens = tuple((f.device.uid, f.device.generation) if f is not None
-                     else (0, 0) for f in frags)
         n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
-        key = ("topnrows", id(self.holder), index, frame_name, row_ids,
-               slices, gens, n_dev)
+        key = rows_key if rows_key is not None else self._topn_rows_key(
+            mesh, index, frame_name, row_ids, slices)
 
         def build():
             from .ops.packed import WORDS_PER_SLICE

@@ -45,9 +45,15 @@ DEVICE_BPS = 4.0e11
 class Calibration:
     sync_s: float       # one dispatch + fetch round trip, seconds
     host_bps: float     # roaring count throughput, bytes/second
+    upload_bps: float = 1.0e9   # host→device transfer rate (measured)
 
-    def device_cost(self, total_bytes: int) -> float:
-        return self.sync_s + total_bytes / DEVICE_BPS
+    def device_cost(self, total_bytes: int, cold_bytes: int = 0) -> float:
+        # cold_bytes = data not device-resident: it must be packed and
+        # shipped at the measured transfer rate (through a tunnel this
+        # is the dominant term — ~512 MB of candidate block costs
+        # seconds, not the microseconds the HBM term suggests).
+        return (self.sync_s + cold_bytes / self.upload_bps
+                + total_bytes / DEVICE_BPS)
 
     def host_cost(self, total_bytes: int) -> float:
         return total_bytes / self.host_bps
@@ -58,10 +64,10 @@ class CostModel:
         self.cal = cal
         self.margin = margin
 
-    def device_pays(self, total_bytes: int) -> bool:
+    def device_pays(self, total_bytes: int, cold_bytes: int = 0) -> bool:
         """False only when the host path is a clear predicted win."""
         host = self.cal.host_cost(total_bytes)
-        device = self.cal.device_cost(total_bytes)
+        device = self.cal.device_cost(total_bytes, cold_bytes)
         return host >= self.margin * device
 
 
@@ -83,6 +89,26 @@ def _measure_sync_s(mesh) -> float:
         int(probe(x))  # int() forces the result fetch
         best = min(best, time.perf_counter() - t0)
     return max(best, 1e-6)
+
+
+def _measure_upload_bps(mesh, sync_s: float) -> float:
+    """Host→device transfer rate for a packed block. The measured wall
+    time includes one round-trip floor (which device_cost prices
+    separately as sync_s), so subtract it — on a tunnel rig the floor
+    is ~10× a 16 MB transfer and would otherwise be double-counted,
+    under-estimating the rate ~15×."""
+    import jax
+
+    buf = np.zeros(4 << 20, dtype=np.uint32)  # 16 MB
+    dev = mesh.devices.flat[0]
+    jax.device_put(buf, dev).block_until_ready()  # warm the path
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        jax.device_put(buf, dev).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    transfer_s = max(best - sync_s, best / 10, 1e-9)
+    return buf.nbytes / transfer_s
 
 
 def _measure_host_bps() -> float:
@@ -121,8 +147,10 @@ def get_model(mesh, margin: float = 0.5) -> CostModel:
     with _cache_mu:
         cal = _cache.get(platform)
     if cal is None:
-        cal = Calibration(sync_s=_measure_sync_s(mesh),
-                          host_bps=_measure_host_bps())
+        sync_s = _measure_sync_s(mesh)
+        cal = Calibration(sync_s=sync_s,
+                          host_bps=_measure_host_bps(),
+                          upload_bps=_measure_upload_bps(mesh, sync_s))
         with _cache_mu:
             cal = _cache.setdefault(platform, cal)
     return CostModel(cal, margin)

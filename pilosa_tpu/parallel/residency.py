@@ -98,6 +98,12 @@ class DeviceBlockCache:
                 self.evictions += 1
         return arr
 
+    def contains(self, key: tuple) -> bool:
+        """Residency probe WITHOUT touching LRU order — the routing
+        cost model asks whether an upload would be needed."""
+        with self._mu:
+            return key in self._lru
+
     def clear(self) -> None:
         with self._mu:
             self._lru.clear()

@@ -13,6 +13,8 @@ import time
 from collections import OrderedDict
 from typing import Iterable
 
+import numpy as np
+
 # ThresholdFactor of maxEntries is how far the unsorted entry map may grow
 # past maxEntries before a trim (reference cache.go:30-33, factor 1.1).
 THRESHOLD_FACTOR = 1.1
@@ -57,6 +59,20 @@ def pairs_sort(pairs: Iterable[Pair]) -> list[Pair]:
     return sorted(pairs, key=lambda p: (-p.count, p.id))
 
 
+def _rank_arrays(keys, values, n: int) -> tuple[np.ndarray, np.ndarray]:
+    """(ids, counts) in rank order — count desc, id asc on ties — via
+    one numpy lexsort instead of a 50 K-object Python sort. This is the
+    TopN candidate phase's hot loop at BASELINE config-3 scale."""
+    ids = np.fromiter(keys, dtype=np.uint64, count=n)
+    counts = np.fromiter(values, dtype=np.int64, count=n)
+    order = np.lexsort((ids, -counts))
+    return ids[order], counts[order]
+
+
+def _pairs_from_arrays(ids: np.ndarray, counts: np.ndarray) -> list[Pair]:
+    return [Pair(i, c) for i, c in zip(ids.tolist(), counts.tolist())]
+
+
 class RankCache:
     """Keeps ids with counts above a dynamic threshold, ranked.
 
@@ -71,7 +87,9 @@ class RankCache:
         self.threshold_buffer = int(THRESHOLD_FACTOR * max_entries)
         self.threshold_value = 0
         self.entries: dict[int, int] = {}
-        self.rankings: list[Pair] = []
+        self.rankings: list[Pair] | None = []
+        self._rank_ids = np.empty(0, dtype=np.uint64)
+        self._rank_counts = np.empty(0, dtype=np.int64)
         self._update_time = 0.0
 
     def add(self, id: int, n: int) -> None:
@@ -102,19 +120,30 @@ class RankCache:
         self.recalculate()
 
     def recalculate(self) -> None:
-        rankings = pairs_sort(Pair(i, c) for i, c in self.entries.items())
-        if len(rankings) > self.max_entries:
-            self.threshold_value = rankings[self.max_entries].count
-            rankings = rankings[:self.max_entries]
+        ids, counts = _rank_arrays(self.entries.keys(),
+                                   self.entries.values(),
+                                   len(self.entries))
+        if len(ids) > self.max_entries:
+            self.threshold_value = int(counts[self.max_entries])
+            ids = ids[:self.max_entries]
+            counts = counts[:self.max_entries]
         else:
             self.threshold_value = 1
-        self.rankings = rankings
+        self._rank_ids, self._rank_counts = ids, counts
+        self.rankings = None  # Pair list built lazily by top()
         self._update_time = time.monotonic()
         if len(self.entries) > self.threshold_buffer:
             self.entries = {i: c for i, c in self.entries.items()
                             if c > self.threshold_value}
 
+    def top_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(ids, counts) in rank order, no per-entry objects."""
+        return self._rank_ids, self._rank_counts
+
     def top(self) -> list[Pair]:
+        if self.rankings is None:
+            self.rankings = _pairs_from_arrays(self._rank_ids,
+                                               self._rank_counts)
         return self.rankings
 
 
@@ -124,19 +153,21 @@ class LRUCache:
     def __init__(self, max_entries: int = DEFAULT_CACHE_SIZE):
         self.max_entries = max_entries
         self._od: OrderedDict[int, int] = OrderedDict()
+        self._ranked = None  # cached (ids, counts) arrays, rank order
 
     def add(self, id: int, n: int) -> None:
         self._od[id] = n
         self._od.move_to_end(id)
         while len(self._od) > self.max_entries:
             self._od.popitem(last=False)
+        self._ranked = None
 
     bulk_add = add
 
     def get(self, id: int) -> int:
         n = self._od.get(id, 0)
         if id in self._od:
-            self._od.move_to_end(id)
+            self._od.move_to_end(id)  # recency changes, counts don't
         return n
 
     def __len__(self):
@@ -151,8 +182,18 @@ class LRUCache:
     def recalculate(self) -> None:
         pass
 
+    def top_arrays(self) -> tuple[np.ndarray, np.ndarray]:
+        """(ids, counts) in rank order; cached until the next mutation
+        — the old per-call Python sort of 50 K entries dominated the
+        TopN candidate phase."""
+        if self._ranked is None:
+            self._ranked = _rank_arrays(self._od.keys(),
+                                        self._od.values(), len(self._od))
+        return self._ranked
+
     def top(self) -> list[Pair]:
-        return pairs_sort(Pair(i, c) for i, c in self._od.items())
+        ids, counts = self.top_arrays()
+        return _pairs_from_arrays(ids, counts)
 
 
 class SimpleCache:

@@ -12,13 +12,14 @@ Durability model (identical to the reference):
   (fragment.go:1067-1093)
 
 TPU-first departures:
-- the compute path for TopN/Top with a source row runs on device: candidate
-  rows are packed into an HBM-resident u32 matrix
-  (pilosa_tpu.parallel.residency) and intersection counts for *all*
-  candidates are computed in one vectorized kernel pass
-  (ops.kernels.row_block_op_count), then the reference's sequential
-  heap/threshold semantics (fragment.go:490-625) are replayed over the
-  precomputed counts — same results, no per-row device round-trips.
+- TopN candidate ranking reads numpy rank arrays straight off the caches
+  and src intersection counts come from ONE vectorized pass over the
+  fragment (cached per src × mutation epoch), then the reference's
+  sequential heap/threshold semantics (fragment.go:490-625) replay over
+  the precomputed counts — same results, no per-row walks. Device
+  serving of TopN (cross-slice batched exact counts, HBM-resident
+  candidate blocks) lives at the executor layer under the calibrated
+  cost model (executor._topn_exact_resident).
 - block checksums hash vectorized position spans (numpy → sha1) instead of
   iterator walks; MergeBlock consensus is a vectorized multiset vote.
 """
@@ -83,8 +84,7 @@ class Fragment:
     def __init__(self, path: str, index: str, frame: str, view: str,
                  slice: int, cache_type: str = cache_mod.DEFAULT_CACHE_TYPE,
                  cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
-                 row_attr_store=None, use_device: Optional[bool] = None,
-                 stats=None, logger=logger_mod.NOP):
+                 row_attr_store=None, stats=None, logger=logger_mod.NOP):
         self.logger = logger
         self.path = path
         self.index = index
@@ -101,14 +101,16 @@ class Fragment:
         self.device = DeviceRowCache()
         self.checksums: dict[int, bytes] = {}
         self.stats = stats
+        # src-TopN count maps, keyed by src-content hash, valid for one
+        # mutation epoch (both TopN phases and repeat queries reuse
+        # the one O(fragment bits) pass).
+        self._src_counts: dict[bytes, tuple[int, np.ndarray]] = {}
+        self._epoch = 0
 
         self._mu = threading.RLock()
         self._file = None
         self._mmap: Optional[mmap.mmap] = None
         self._open = False
-        if use_device is None:
-            use_device = os.environ.get("PILOSA_TPU_DEVICE", "1") != "0"
-        self.use_device = use_device
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -262,6 +264,7 @@ class Fragment:
         changed = self.storage.add(pos) if set else self.storage.remove(pos)
         if not changed:
             return False
+        self._epoch += 1
         self.checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self.row_cache.invalidate(row_id)
         self.device.invalidate_row(row_id)
@@ -306,6 +309,7 @@ class Fragment:
         positions = rows * np.uint64(SLICE_WIDTH) + (
             cols % np.uint64(SLICE_WIDTH))
         with self._mu:
+            self._epoch += 1
             writer, self.storage.op_writer = self.storage.op_writer, None
             try:
                 self.storage.add_many(positions)
@@ -336,22 +340,63 @@ class Fragment:
                 pairs.append(Pair(rid, n))
         return pairs
 
-    def _batch_intersection_counts(self, row_ids: list[int],
-                                   src: Bitmap) -> dict[int, int]:
-        """Intersection counts of src against many rows in one device pass."""
-        from ..ops import kernels, packed
+    _EMPTY_COUNTS = (np.empty(0, dtype=np.int64),
+                     np.empty(0, dtype=np.int64))
+
+    def _host_src_count_map(self, src: Bitmap
+                            ) -> tuple[np.ndarray, np.ndarray]:
+        """src ∩ row intersection counts for EVERY row of this fragment
+        in one vectorized pass, as (sorted row ids, counts).
+
+        O(fragment bits) once, instead of one roaring walk per visited
+        candidate — the unbounded rank-cache src-TopN walk (up to 50 K
+        rows) costs seconds through per-row Python calls and ~10 ms
+        here (reference does per-row counts, fragment.go:529-560, but
+        its per-call cost is nanoseconds; ours is not)."""
         seg = src._segment(self.slice, False)
-        src_words = packed.pack_bitmap(
-            seg.data if seg else roaring.Bitmap(), packed.WORDS_PER_SLICE,
-            base_word=self.slice * (SLICE_WIDTH // 32))
-        out: dict[int, int] = {}
-        chunk = 2048  # 2048 rows × 128 KB = 256 MB per device block
-        for i in range(0, len(row_ids), chunk):
-            ids = tuple(row_ids[i:i + chunk])
-            block = self.device.block(self.storage, ids)
-            counts = np.asarray(kernels.row_block_op_count(
-                "and", block, src_words))
-            out.update(zip(ids, (int(c) for c in counts)))
+        if seg is None:
+            return self._EMPTY_COUNTS
+        w = np.uint64(SLICE_WIDTH)
+        src_cols = seg.data.values() % w   # absolute → slice-local
+        if not len(src_cols):
+            return self._EMPTY_COUNTS
+        key = hashlib.sha1(src_cols.tobytes()).digest()
+        hit = self._src_counts.get(key)
+        if hit is not None and hit[0] == self._epoch:
+            return hit[1]
+        hit_rows: list[np.ndarray] = []
+        # Batch container chunks to ~1 M positions per isin: sparse
+        # fragments have millions of near-empty containers, and a
+        # per-container isin pays its sort setup millions of times.
+        batch: list[np.ndarray] = []
+        batch_len = 0
+
+        def flush() -> None:
+            nonlocal batch, batch_len
+            if not batch:
+                return
+            vals = batch[0] if len(batch) == 1 else np.concatenate(batch)
+            batch, batch_len = [], 0
+            hits = vals[np.isin(vals % w, src_cols)]
+            if len(hits):
+                hit_rows.append((hits // w).astype(np.int64))
+
+        for vals in self.storage.value_chunks():
+            batch.append(vals)
+            batch_len += len(vals)
+            if batch_len >= (1 << 20):
+                flush()
+        flush()
+        if hit_rows:
+            # (sorted row ids, counts) — NOT a bincount array, whose
+            # size is max-row-id+1 and explodes on sparse huge ids.
+            out = np.unique(np.concatenate(hit_rows), return_counts=True)
+        else:
+            z = np.empty(0, dtype=np.int64)
+            out = (z, z)
+        self._src_counts[key] = (self._epoch, out)
+        while len(self._src_counts) > 4:
+            self._src_counts.pop(next(iter(self._src_counts)))
         return out
 
     def top(self, opt: TopOptions = None) -> list[Pair]:
@@ -359,6 +404,25 @@ class Fragment:
         (reference fragment.go:490-625; same semantics, batched counts)."""
         opt = opt or TopOptions()
         with self._mu:
+            # Array fast path for the plain TopN(frame, n) shape — no
+            # source bitmap, no attribute filter, no tanimoto: the
+            # answer is the first n rank-cache entries with count ≥
+            # max(threshold, 1), which the heap replay below computes
+            # identically but one Python object at a time. At config-3
+            # scale (50 K-entry caches × 10 slices) this is the
+            # candidate phase's entire cost.
+            if (opt.src is None and not opt.row_ids
+                    and not (opt.filter_field and opt.filter_values)
+                    and opt.tanimoto_threshold <= 0
+                    and hasattr(self.cache, "top_arrays")):
+                self.cache.invalidate()
+                ids, counts = self.cache.top_arrays()
+                keep = counts >= max(opt.min_threshold, 1)
+                ids, counts = ids[keep], counts[keep]
+                if opt.n:
+                    ids, counts = ids[:opt.n], counts[:opt.n]
+                return [Pair(i, c) for i, c in zip(ids.tolist(),
+                                                   counts.tolist())]
             pairs = self._top_pairs(opt.row_ids)
             n = 0 if opt.row_ids else opt.n
 
@@ -375,18 +439,39 @@ class Fragment:
                 min_tan = src_count * tanimoto / 100
                 max_tan = src_count * 100 / tanimoto
 
-            # Pre-compute all candidate ∩ src counts in one device pass.
-            inter: dict[int, int] = {}
-            if opt.src is not None:
-                candidates = [p.id for p in pairs if p.count > 0]
-                if self.use_device and len(candidates) >= 8:
-                    inter = self._batch_intersection_counts(candidates,
-                                                            opt.src)
+            # Candidate ∩ src counts. Past a handful of candidates, ONE
+            # vectorized pass over the fragment computes every row's
+            # count (O(fragment bits), ~10 ms/slice, cached per src ×
+            # mutation epoch), then zero-overlap candidates drop out
+            # before the replay. Safe: a src-count-0 pair can never
+            # push (the replay skips count==0) and removing it cannot
+            # move the break point (the next visited pair's cache
+            # count is ≤ the removed one's, so the break still fires
+            # before any further push). Small candidate sets (point
+            # lookups, short ids=[...]) keep the per-row roaring
+            # intersection — a full-fragment scan for 3 rows is waste.
+            # Per-slice device batching was measured strictly worse on
+            # every shape — one sync per slice per query; cross-slice
+            # batched exact counts with residency live on the
+            # EXECUTOR's device path (_topn_exact_resident), where the
+            # cost model routes them.
+            count_ids = count_vals = None
+            if opt.src is not None and len(pairs) > self.SRC_MAP_MIN:
+                count_ids, count_vals = self._host_src_count_map(opt.src)
+                if len(pairs):
+                    pid = np.fromiter((p.id for p in pairs),
+                                      dtype=np.int64, count=len(pairs))
+                    keep = np.isin(pid, count_ids)
+                    pairs = [p for p, k in zip(pairs, keep.tolist())
+                             if k]
 
             def src_count_of(rid: int) -> int:
-                if rid in inter:
-                    return inter[rid]
-                return opt.src.intersection_count(self.row(rid))
+                if count_ids is None:
+                    return opt.src.intersection_count(self.row(rid))
+                i = np.searchsorted(count_ids, rid)
+                if i < len(count_ids) and count_ids[i] == rid:
+                    return int(count_vals[i])
+                return 0
 
             # Replay the reference's heap algorithm over the counts.
             results: list[tuple[int, int]] = []  # min-heap of (count, -id)
@@ -548,6 +633,11 @@ class Fragment:
     # same trade bulk import makes (fragment.go:924-989).
     MERGE_BULK_THRESHOLD = 256
 
+    # src-TopN candidate sets up to this size use per-row roaring
+    # intersections; larger walks take the one-pass vectorized map.
+    SRC_MAP_MIN = 64
+
+
     def _apply_merge_diffs(self, set_pos: np.ndarray,
                            clear_pos: np.ndarray) -> None:
         """Apply a merge_block consensus diff locally. Small diffs go
@@ -567,6 +657,7 @@ class Fragment:
                 self._mutate(int(pos) // SLICE_WIDTH,
                              base_col + int(pos) % SLICE_WIDTH, set=False)
             return
+        self._epoch += 1
         writer, self.storage.op_writer = self.storage.op_writer, None
         try:
             added = self.storage.add_many(set_pos)
@@ -662,6 +753,7 @@ class Fragment:
                         self._open_storage()
                         raise
                     self._open_storage()
+                    self._epoch += 1
                     self.row_cache.clear()
                     self.device.invalidate_all()
                     self.checksums.clear()

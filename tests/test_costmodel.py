@@ -42,6 +42,24 @@ class TestDecision:
         m = CostModel(DIRECT)
         assert m.device_pays(block_bytes(2, 128))
 
+    def test_cold_upload_flips_decision_on_tunnel(self):
+        # TopN phase 2: 1000 candidates × 10 slices (~1.3 GB block).
+        # Resident, the device wins (host ~1.3 s vs sync floor); cold,
+        # the upload at a tunnel-rate 100 MB/s (~13 s) hands it to the
+        # host.
+        cal = Calibration(sync_s=0.130, host_bps=1.0e9, upload_bps=1.0e8)
+        m = CostModel(cal)
+        bytes_ = block_bytes(1000, 10)
+        assert m.device_pays(bytes_, cold_bytes=0)
+        assert not m.device_pays(bytes_, cold_bytes=bytes_)
+
+    def test_cold_upload_cheap_on_direct_attach(self):
+        # Direct-attached: 20 GB/s transfers make the same cold block a
+        # device win again.
+        cal = Calibration(sync_s=0.001, host_bps=1.0e9, upload_bps=2.0e10)
+        bytes_ = block_bytes(1000, 10)
+        assert CostModel(cal).device_pays(bytes_, cold_bytes=bytes_)
+
     def test_margin_keeps_marginal_shapes_on_device(self):
         # Host must be a CLEAR win (margin 0.5): a shape where host
         # cost ≈ device cost stays on the device path.

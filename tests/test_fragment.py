@@ -38,8 +38,7 @@ def frag(tmp_path):
 
 def make_fragment(tmp_path, slice=0, cache_type="ranked", name="frag"):
     f = Fragment(str(tmp_path / name), "i", "f", "standard", slice,
-                 cache_type=cache_type, row_attr_store=AttrStoreStub(),
-                 use_device=True)
+                 cache_type=cache_type, row_attr_store=AttrStoreStub())
     f.open()
     return f
 
@@ -48,8 +47,7 @@ def reopen(f):
     path, slice = f.path, f.slice
     f.close()
     f2 = Fragment(path, f.index, f.frame, f.view, slice,
-                  cache_type=f.cache_type, row_attr_store=f.row_attr_store,
-                  use_device=True)
+                  cache_type=f.cache_type, row_attr_store=f.row_attr_store)
     f2.open()
     return f2
 
@@ -190,19 +188,70 @@ class TestTopN:
         #          count=4, tan=ceil(400/(4+6-4))=67 > 50 ✓
         assert got == {100: 6, 101: 6, 102: 4}
 
-    def test_device_batch_matches_host(self, tmp_path):
-        # Same query with and without the device path must agree.
+    def test_src_topn_paths_match_bruteforce(self, tmp_path):
+        """Randomized parity for TopN with a source bitmap: the
+        vectorized count-map path must reproduce a brute-force
+        (count desc, id asc) model at several candidate-set sizes."""
+        rng = np.random.default_rng(17)
+        for trial, n_rows in enumerate((8, 40, 300, 3000)):
+            frag = make_fragment(tmp_path, name=f"srctop{trial}")
+            try:
+                rows = rng.integers(0, n_rows, 2000).astype(np.uint64)
+                cols = rng.integers(0, 5000, 2000).astype(np.uint64)
+                frag.import_bits(rows, cols)
+                src_cols = np.unique(
+                    rng.integers(0, 5000, 400)).astype(np.uint64)
+                src = Bitmap()
+                from pilosa_tpu.storage import roaring
+                src.add_segment(roaring.Bitmap.from_sorted(src_cols), 0,
+                                writable=True)
+
+                model = {}
+                bits = {}
+                for r, c in zip(rows.tolist(), cols.tolist()):
+                    bits.setdefault(r, set()).add(c)
+                srcset = set(src_cols.tolist())
+                for r, s in bits.items():
+                    cnt = len(s & srcset)
+                    if cnt > 0:
+                        model[r] = cnt
+                want = sorted(model.items(),
+                              key=lambda kv: (-kv[1], kv[0]))[:10]
+
+                got = frag.top(TopOptions(n=10, src=src))
+                assert [(p.id, p.count) for p in got[:10]] == want, \
+                    (trial, got[:10], want)
+            finally:
+                frag.close()
+
+    def test_src_count_map_matches_per_row_intersections(self, tmp_path):
+        # The one-pass vectorized count map must agree with per-row
+        # roaring intersection counts (the reference's per-row walk).
         rng = np.random.default_rng(0)
         rows = rng.integers(0, 64, 20000).astype(np.uint64)
         cols = rng.integers(0, SLICE_WIDTH, 20000).astype(np.uint64)
         src = Bitmap(*np.unique(rng.integers(0, SLICE_WIDTH, 5000)).tolist())
         f1 = make_fragment(tmp_path, name="dev")
         f1.import_bits(rows, cols)
-        got_dev = f1.top(TopOptions(n=10, src=src))
-        f1.use_device = False
-        got_host = f1.top(TopOptions(n=10, src=src))
+        ids, counts = f1._host_src_count_map(src)
+        lookup = dict(zip(ids.tolist(), counts.tolist()))
+        for rid in range(64):
+            want = src.intersection_count(f1.row(rid))
+            assert lookup.get(rid, 0) == want, (rid, want)
         f1.close()
-        assert got_dev == got_host
+
+    def test_src_count_map_handles_huge_row_ids(self, tmp_path):
+        # A bit at a huge row id must not allocate a row-id-sized
+        # count array (the map is (ids, counts), not a bincount).
+        frag = make_fragment(tmp_path, name="hugerow")
+        big = 10**12
+        frag.set_bit(big, 5)
+        frag.set_bit(3, 5)
+        frag.recalculate_cache()  # skip the 10 s rank re-sort limiter
+        src = Bitmap(5)
+        got = frag.top(TopOptions(n=10, src=src))
+        assert [(p.id, p.count) for p in got] == [(3, 1), (big, 1)]
+        frag.close()
 
 
 class TestImport:
