@@ -125,6 +125,8 @@ class GossipNodeSet:
         self._acks: dict[int, threading.Event] = {}
         self._udp: Optional[socket.socket] = None
         self._tcp: Optional[socket.socket] = None
+        self._send_pool = None          # lazy bounded sync-send pool
+        self._send_pool_mu = threading.Lock()
         self._closing = threading.Event()
         self._threads: list[threading.Thread] = []
 
@@ -193,6 +195,10 @@ class GossipNodeSet:
 
     def close(self) -> None:
         self._closing.set()
+        with self._send_pool_mu:
+            pool, self._send_pool = self._send_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
         for s in (self._udp, self._tcp):
             if s is not None:
                 try:
@@ -217,11 +223,21 @@ class GossipNodeSet:
 
     # -- Broadcaster (gossip.go:124-164) -------------------------------------
 
+    # Concurrent sync-broadcast legs (the reference's errgroup fan-out,
+    # gossip.go:124-149, is similarly unbounded, but a thread per peer
+    # per message does not survive n=50 clusters under write load).
+    _SEND_SYNC_WORKERS = 16
+
     def send_sync(self, m) -> None:
-        """Direct TCP frame to every alive member (gossip.go:124-149)."""
+        """Direct TCP frame to every alive member (gossip.go:124-149),
+        fanned out over a bounded worker pool."""
+        import concurrent.futures as futures
+        from concurrent.futures import ThreadPoolExecutor
         data = marshal_message(m)
+        peers = self._alive_peers()
+        if not peers:
+            return
         errs: list[Exception] = []
-        threads = []
 
         def send(addr: str) -> None:
             try:
@@ -230,12 +246,18 @@ class GossipNodeSet:
             except Exception as e:  # noqa: BLE001 - collected below
                 errs.append(e)
 
-        for mem in self._alive_peers():
-            t = threading.Thread(target=send, args=(mem.addr,), daemon=True)
-            t.start()
-            threads.append(t)
-        for t in threads:
-            t.join()
+        with self._send_pool_mu:
+            if self._closing.is_set():
+                return  # close() owns the pool; don't resurrect it
+            pool = self._send_pool
+            if pool is None:
+                pool = self._send_pool = ThreadPoolExecutor(
+                    max_workers=self._SEND_SYNC_WORKERS,
+                    thread_name_prefix="gossip-send")
+        try:
+            list(pool.map(send, [mem.addr for mem in peers]))
+        except futures.CancelledError:
+            return  # close() cancelled the fan-out mid-flight
         if errs:
             raise errs[0]
 

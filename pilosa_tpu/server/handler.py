@@ -220,6 +220,7 @@ class Handler:
         r("GET", "/debug/pprof/", self._handle_pprof_index)
         r("GET", "/debug/pprof/profile", self._handle_pprof_profile)
         r("GET", "/debug/pprof/threads", self._handle_pprof_threads)
+        r("GET", "/debug/pprof/heap", self._handle_pprof_heap)
         r("GET", "/export", self._handle_get_export)
         r("GET", "/fragment/block/data", self._handle_fragment_block_data)
         r("GET", "/fragment/blocks", self._handle_fragment_blocks)
@@ -343,8 +344,22 @@ class Handler:
     def _handle_pprof_index(self, req: Request) -> Response:
         return Response(
             200, b"profile: sampled CPU profile (?seconds=N, default 5)\n"
-                 b"threads: stack dump of all live threads\n",
+                 b"threads: stack dump of all live threads\n"
+                 b"heap: tracemalloc allocation sites (?n=N, default 30;"
+                 b" first call arms tracing, ?off=1 disarms)\n",
             "text/plain; charset=utf-8")
+
+    def _handle_pprof_heap(self, req: Request) -> Response:
+        from ..utils.profiling import heap_profile
+        try:
+            top_n = int(req.query.get("n", "30"))
+        except ValueError:
+            raise HTTPError(400, "invalid n")
+        stop = req.query.get("off") == "1"
+        return Response(200,
+                        heap_profile(max(1, min(top_n, 500)),
+                                     stop=stop).encode(),
+                        "text/plain; charset=utf-8")
 
     def _handle_pprof_profile(self, req: Request) -> Response:
         from ..utils.profiling import sample_profile

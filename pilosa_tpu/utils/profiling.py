@@ -41,6 +41,41 @@ def _is_idle_leaf(frame) -> bool:
             code.co_name) in _IDLE_LEAVES
 
 
+def heap_profile(top_n: int = 30, stop: bool = False) -> str:
+    """Python heap allocation report via tracemalloc (the reference gets
+    /debug/pprof/heap free from net/http/pprof, handler.go:30,99).
+
+    tracemalloc costs ~2× on allocations while tracing, so it is armed
+    by the first call, reports on subsequent calls, and is DISARMED
+    with ``stop`` (?off=1 on the endpoint) when the leak hunt is over —
+    demand-driven like Go's heap profile, but the tax is removable
+    without a restart. One frame per allocation is recorded: the report
+    groups by source line and never reads deeper frames."""
+    import tracemalloc
+    if stop:
+        if tracemalloc.is_tracing():
+            tracemalloc.stop()
+            return "tracemalloc stopped; allocation tracing disarmed.\n"
+        return "tracemalloc was not tracing.\n"
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(1)
+        return ("tracemalloc started. Allocations are now traced; "
+                "request this endpoint again for the report, and add "
+                "?off=1 to disarm (tracing costs ~2x on allocation-"
+                "heavy paths).\n")
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")
+    total = sum(s.size for s in stats)
+    lines = [f"traced memory: {total / (1 << 20):.1f} MiB in "
+             f"{sum(s.count for s in stats)} blocks "
+             f"(top {min(top_n, len(stats))} sites)\n"]
+    for s in stats[:top_n]:
+        fr = s.traceback[0]
+        lines.append(f"{s.size / 1024:10.1f} KiB {s.count:8d} blocks  "
+                     f"{fr.filename.rsplit('/', 1)[-1]}:{fr.lineno}\n")
+    return "".join(lines)
+
+
 def collect_sample(skip_threads: tuple[int, ...] = (),
                    include_idle: bool = True) -> list[str]:
     """One collapsed stack per live thread, innermost frame last."""

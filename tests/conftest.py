@@ -16,6 +16,11 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
+# Deterministic routing in tests: the calibrated device/host cost model
+# measures THIS machine and could veto device paths that device-path
+# tests assert engage. Cost-model behavior is tested explicitly with
+# injected calibrations (tests/test_costmodel.py).
+os.environ.setdefault("PILOSA_TPU_COST_MODEL", "0")
 
 import jax  # noqa: E402
 

@@ -91,6 +91,9 @@ class TestExecutorVeto:
 
         ex = Executor(holder, host="h", mesh_min_slices=1)
         # Tunnel-shaped hardware: host clearly wins at 16 slices.
+        # (conftest disables the model by default for determinism —
+        # re-enable it here with an injected calibration.)
+        ex._cost_model_enabled = True
         ex.cost_model = CostModel(TUNNEL)
         try:
             got = ex.execute(

@@ -82,5 +82,19 @@ def test_pprof_http_endpoints(tmp_path):
         assert status == 200 and body.startswith(b"# cpu profile")
         status, _, body = call(handler, "GET", "/debug/pprof/threads")
         assert status == 200 and b"MainThread" in body
+        # Heap: first call arms tracemalloc, second reports top sites,
+        # ?off=1 disarms.
+        status, _, body = call(handler, "GET", "/debug/pprof/heap")
+        assert status == 200
+        if b"started" in body:
+            blob = bytearray(1 << 16)  # some traced allocations
+            status, _, body = call(handler, "GET",
+                                   "/debug/pprof/heap?n=10")
+            del blob
+        assert status == 200 and b"traced memory" in body
+        status, _, body = call(handler, "GET", "/debug/pprof/heap?off=1")
+        assert status == 200 and b"stopped" in body
+        import tracemalloc
+        assert not tracemalloc.is_tracing()
     finally:
         h.close()
