@@ -223,9 +223,12 @@ _BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _pin_host_baseline(bits: int, k_rows: int, host_s: float) -> float:
-    """Best-of-all-rounds host seconds for this workload shape; updates
-    the persisted record when this run's measurement is faster."""
-    key = f"bits={bits},rows={k_rows}"
+    """Best-of-all-rounds host seconds for this workload shape ON THIS
+    MACHINE (the key carries the hostname — a faster rig's measurement
+    must not poison vs_baseline for every other rig); updates the
+    persisted record when this run's measurement is faster."""
+    import platform
+    key = f"bits={bits},rows={k_rows},host={platform.node()}"
     record = {}
     try:
         with open(_BASELINE_PATH) as f:
