@@ -240,6 +240,105 @@ class TestTwoNodeCluster:
         assert owner_server.holder.fragment(
             "i", "f", "standard", 0).row(1).count() == 3
 
+    def test_replicated_cluster_random_soak_converges(self, tmp_path):
+        """Randomized cluster consistency soak (reference style:
+        server_test.go:42-121 quick test, raised to a replicated
+        2-node cluster): random SetBit/ClearBit enter through EITHER
+        node across 4 slices plus an inverse frame; Bitmap/Count/TopN
+        reads from BOTH nodes must match a brute-force model; then a
+        deliberately diverged replica must converge through
+        anti-entropy to identical fragment checksums."""
+        import random
+        from pilosa_tpu import SLICE_WIDTH
+
+        s1 = make_server(tmp_path, "r1")
+        s2 = make_server(tmp_path, "r2")
+        s1.open()
+        s2.open()
+        try:
+            cross_wire(s1, s2)
+            for s in (s1, s2):
+                s.cluster.replica_n = 2
+                http_post(s.host, "/index/i", b"{}")
+                http_post(s.host, "/index/i/frame/f", b"{}")
+                http_post(s.host, "/index/i/frame/inv",
+                          b'{"options": {"inverseEnabled": true}}')
+
+            rng = random.Random(99)
+            servers = (s1, s2)
+            model: dict[int, set[int]] = {}
+            inv_model: dict[int, set[int]] = {}
+            for _ in range(600):
+                s = servers[rng.randrange(2)]
+                row = rng.randrange(6)
+                col = rng.randrange(4 * SLICE_WIDTH)
+                frame, m = (("f", model) if rng.random() < 0.8
+                            else ("inv", inv_model))
+                if rng.random() < 0.85:
+                    http_post(s.host, "/index/i/query",
+                              f'SetBit(frame="{frame}", rowID={row},'
+                              f' columnID={col})'.encode())
+                    m.setdefault(row, set()).add(col)
+                else:
+                    http_post(s.host, "/index/i/query",
+                              f'ClearBit(frame="{frame}", rowID={row},'
+                              f' columnID={col})'.encode())
+                    m.setdefault(row, set()).discard(col)
+
+            for s in servers:  # both nodes serve identical results
+                for row in range(6):
+                    want = sorted(model.get(row, ()))
+                    _, body = http_post(
+                        s.host, "/index/i/query",
+                        f'Bitmap(frame="f", rowID={row})'.encode())
+                    got = json.loads(body)["results"][0]["bits"]
+                    assert got == want, (s.host, row)
+                _, body = http_post(
+                    s.host, "/index/i/query",
+                    b'Count(Union(Bitmap(frame="f", rowID=0),'
+                    b' Bitmap(frame="f", rowID=1)))')
+                assert json.loads(body)["results"][0] == len(
+                    model.get(0, set()) | model.get(1, set()))
+                _, body = http_post(s.host, "/index/i/query",
+                                    b'TopN(frame="f", n=3)')
+                got = [(p["id"], p["count"])
+                       for p in json.loads(body)["results"][0]]
+                want = sorted(((r, len(c)) for r, c in model.items()
+                               if len(c)),
+                              key=lambda rc: (-rc[1], rc[0]))[:3]
+                assert got == want, (s.host, got, want)
+                # Inverse reads: Bitmap(columnID=c) = rows having c.
+                inv_cols = {c for cols in inv_model.values()
+                            for c in cols}
+                for col in sorted(inv_cols)[:5]:
+                    _, body = http_post(
+                        s.host, "/index/i/query",
+                        f'Bitmap(frame="inv", columnID={col})'.encode())
+                    got = json.loads(body)["results"][0]["bits"]
+                    want = sorted(r for r, cols in inv_model.items()
+                                  if col in cols)
+                    assert got == want, (s.host, col)
+
+            # Replicated writes: every owned fragment exists on both
+            # nodes with identical contents already; now diverge one
+            # replica directly and let anti-entropy repair it.
+            frag2 = s2.holder.fragment("i", "f", "standard", 0)
+            if frag2 is not None:
+                for col in range(100, 160):
+                    frag2.set_bit(5, col)
+            HolderSyncer(s1.holder, s1.host, s1.cluster).sync_holder()
+            HolderSyncer(s2.holder, s2.host, s2.cluster).sync_holder()
+            for slice in range(4):
+                f1 = s1.holder.fragment("i", "f", "standard", slice)
+                f2 = s2.holder.fragment("i", "f", "standard", slice)
+                if f1 is None or f2 is None:
+                    assert (f1 is None) == (f2 is None), slice
+                    continue
+                assert f1.checksum() == f2.checksum(), slice
+        finally:
+            s1.close()
+            s2.close()
+
     def test_replica_failover_serves_reads(self, tmp_path):
         """ReplicaN=2 over two real servers: writes fan to both owners;
         after one node dies, queries through the survivor re-map the
