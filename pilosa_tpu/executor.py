@@ -36,6 +36,7 @@ import numpy as np
 from .cluster.topology import Cluster, Node, new_cluster
 from .errors import (TIME_FORMAT, FrameNotFoundError, IndexNotFoundError,
                      PilosaError, QueryRequiredError, SliceUnavailableError)
+from . import SLICE_WIDTH
 from .models.view import VIEW_INVERSE, VIEW_STANDARD
 from .pql.ast import Call, Query
 from .pql.parser import parse as parse_pql
@@ -377,16 +378,64 @@ class Executor:
                                     require_children=False)
         raise PilosaError(f"unknown call: {c.name}")
 
+    _HOST_FOLD_OPS = {"union": "or", "intersect": "and",
+                      "difference": "andnot"}
+
     def _fold_slice(self, index: str, c: Call, slice: int, op: str,
                     require_children: bool) -> Bitmap:
         if require_children and not c.children:
             raise PilosaError(f"empty {c.name} query is currently"
                               " not supported")
+        # Wide folds whose children are all plain Bitmap rows of one
+        # (frame, view) collapse to ONE vectorized pass over the
+        # fragment (fold_rows) instead of a roaring merge per child —
+        # measured ~10× on the 1000-row config-2 shape. Narrow folds
+        # and mixed/nested children keep the per-child merge, which
+        # also owns all the error semantics.
+        if len(c.children) >= self.mesh_min_leaves:
+            plain = self._plain_fold_leaves(index, c)
+            if plain is not None:
+                frame_name, view, rids = plain
+                frag = self.holder.fragment(index, frame_name, view,
+                                            slice)
+                if frag is None:
+                    return Bitmap()
+                if frag.fold_scan_pays(rids):
+                    from .storage import roaring
+                    out = Bitmap()
+                    cols = frag.fold_rows(self._HOST_FOLD_OPS[op], rids)
+                    if len(cols):
+                        base = np.uint64(slice) * np.uint64(SLICE_WIDTH)
+                        out.add_segment(
+                            roaring.Bitmap.from_sorted(cols + base),
+                            slice, writable=True)
+                    return out
         out = Bitmap()
         for i, child in enumerate(c.children):
             bm = self._bitmap_call_slice(index, child, slice)
             out = bm if i == 0 else getattr(out, op)(bm)
         return out
+
+    def _plain_fold_leaves(self, index: str, c: Call):
+        """(frame, view, row ids) when every child is a plain Bitmap
+        leaf of one (frame, view); None otherwise (the per-child path
+        owns errors and mixed shapes)."""
+        leaves: list[tuple] = []
+        frame_view = None
+        rids = []
+        for child in c.children:
+            expr = self._compile_device_expr(index, child, leaves)
+            if expr is None or expr[0] != "leaf":
+                return None
+            frame_name, view, rid = leaves[expr[1]]
+            if frame_view is None:
+                frame_view = (frame_name, view)
+            elif frame_view != (frame_name, view):
+                return None
+            rids.append(rid)
+        if frame_view is None:
+            return None
+        return frame_view[0], frame_view[1], rids
 
     def _bitmap_slice(self, index: str, c: Call, slice: int) -> Bitmap:
         # executor.go:420-465: row id → standard view, column id → inverse.

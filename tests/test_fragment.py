@@ -224,6 +224,36 @@ class TestTopN:
             finally:
                 frag.close()
 
+    def test_fold_rows_matches_sequential_set_ops(self, tmp_path):
+        """fold_rows (one-pass vectorized or/and/andnot over many rows)
+        must match per-row Python set folds, including duplicate row
+        ids and rows with no bits."""
+        rng = np.random.default_rng(5)
+        frag = make_fragment(tmp_path, name="fold")
+        rows = rng.integers(0, 30, 4000).astype(np.uint64)
+        cols = rng.integers(0, 3000, 4000).astype(np.uint64)
+        frag.import_bits(rows, cols)
+        bits: dict[int, set[int]] = {}
+        for r, c in zip(rows.tolist(), cols.tolist()):
+            bits.setdefault(r, set()).add(c)
+        for trial in range(20):
+            k = rng.integers(2, 12)
+            ids = [int(x) for x in rng.integers(0, 35, k)]  # incl. empty
+            sets = [bits.get(r, set()) for r in ids]
+            want_or = set().union(*sets)
+            want_and = set(sets[0])
+            for s in sets[1:]:
+                want_and &= s
+            want_andnot = set(sets[0])
+            for s in sets[1:]:
+                want_andnot -= s
+            for op, want in (("or", want_or), ("and", want_and),
+                             ("andnot", want_andnot)):
+                got = frag.fold_rows(op, ids)
+                assert sorted(int(x) for x in got) == sorted(want), \
+                    (trial, op, ids)
+        frag.close()
+
     def test_src_count_map_matches_per_row_intersections(self, tmp_path):
         # The one-pass vectorized count map must agree with per-row
         # roaring intersection counts (the reference's per-row walk).
