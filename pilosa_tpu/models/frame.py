@@ -254,31 +254,32 @@ class Frame:
 
         if not plain.all():
             # Timestamped bits fan out to per-quantum time views
-            # (frame.go:538-573) — view membership depends on each
-            # timestamp, so these stay per-bit.
-            lists: dict[tuple[str, int], tuple[list, list]] = {}
-
-            def put(view_name, rid, cid):
-                key = (view_name, cid // SLICE_WIDTH)
-                if key not in lists:
-                    lists[key] = ([], [])
-                lists[key][0].append(rid)
-                lists[key][1].append(cid)
-
+            # (frame.go:538-573). View membership depends only on the
+            # timestamp VALUE, so group by unique timestamp and fan
+            # each group out array-at-a-time — time-series imports
+            # carry few distinct timestamps across many bits, and the
+            # old per-bit loop was the bulk-import long pole for them.
+            by_ts: dict = {}
             for i in np.flatnonzero(~plain).tolist():
-                rid, cid, ts = int(rows[i]), int(cols[i]), timestamps[i]
+                ts = timestamps[i]
+                # View names come from LOCAL datetime fields
+                # (strftime in views_by_time), so group by those —
+                # equal-instant aware datetimes in different zones
+                # belong to different time views.
+                key = (ts.replace(tzinfo=None)
+                       if isinstance(ts, dt.datetime) else ts)
+                by_ts.setdefault(key, []).append(i)
+            for ts, ii in by_ts.items():
+                idx = np.asarray(ii)
+                r_ts, c_ts = rows[idx], cols[idx]
                 if do_standard:
                     for vn in tq.views_by_time(VIEW_STANDARD, ts, q) + [
                             VIEW_STANDARD]:
-                        put(vn, rid, cid)
+                        put_arrays(vn, r_ts, c_ts)
                 if do_inverse:
                     for vn in tq.views_by_time(VIEW_INVERSE, ts, q) + [
                             VIEW_INVERSE]:
-                        put(vn, cid, rid)  # transpose
-            for key, (rids, cids) in lists.items():
-                data.setdefault(key, []).append(
-                    (np.array(rids, dtype=np.uint64),
-                     np.array(cids, dtype=np.uint64)))
+                        put_arrays(vn, c_ts, r_ts)  # transpose
 
         for (view_name, slice), chunks in sorted(data.items()):
             view = self.create_view_if_not_exists(view_name)
