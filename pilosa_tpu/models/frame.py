@@ -233,13 +233,16 @@ class Frame:
         do_standard = views in (None, "standard")
         do_inverse = self.inverse_enabled and views in (None, "inverse")
 
-        def put_arrays(view_name, rids_a, cids_a):
-            # One stable argsort groups a whole view's bits by slice —
-            # this is the bulk-import hot lane (per-bit grouping cost
-            # more than the roaring adds it fed).
+        def put_arrays(view_names, rids_a, cids_a):
+            # One stable argsort groups the bits by slice, shared by
+            # every view name that receives them (time fan-out sends
+            # the same arrays to up to 5 views) — this is the
+            # bulk-import hot lane (per-bit grouping cost more than
+            # the roaring adds it fed).
             for slice, rs, cs in group_by_key(
                     cids_a // np.uint64(SLICE_WIDTH), rids_a, cids_a):
-                data.setdefault((view_name, slice), []).append((rs, cs))
+                for vn in view_names:
+                    data.setdefault((vn, slice), []).append((rs, cs))
 
         if timestamps is None:
             plain = np.ones(len(rows), dtype=bool)
@@ -248,9 +251,9 @@ class Frame:
         if plain.any():
             r0, c0 = rows[plain], cols[plain]
             if do_standard:
-                put_arrays(VIEW_STANDARD, r0, c0)
+                put_arrays([VIEW_STANDARD], r0, c0)
             if do_inverse:
-                put_arrays(VIEW_INVERSE, c0, r0)  # transpose
+                put_arrays([VIEW_INVERSE], c0, r0)  # transpose
 
         if not plain.all():
             # Timestamped bits fan out to per-quantum time views
@@ -273,13 +276,13 @@ class Frame:
                 idx = np.asarray(ii)
                 r_ts, c_ts = rows[idx], cols[idx]
                 if do_standard:
-                    for vn in tq.views_by_time(VIEW_STANDARD, ts, q) + [
-                            VIEW_STANDARD]:
-                        put_arrays(vn, r_ts, c_ts)
+                    put_arrays(
+                        tq.views_by_time(VIEW_STANDARD, ts, q)
+                        + [VIEW_STANDARD], r_ts, c_ts)
                 if do_inverse:
-                    for vn in tq.views_by_time(VIEW_INVERSE, ts, q) + [
-                            VIEW_INVERSE]:
-                        put_arrays(vn, c_ts, r_ts)  # transpose
+                    put_arrays(
+                        tq.views_by_time(VIEW_INVERSE, ts, q)
+                        + [VIEW_INVERSE], c_ts, r_ts)  # transpose
 
         for (view_name, slice), chunks in sorted(data.items()):
             view = self.create_view_if_not_exists(view_name)

@@ -451,6 +451,69 @@ class TestTwoNodeCluster:
                 sb.close()
             sa.close()
 
+    def test_three_gossip_servers_death_and_revival(self, tmp_path):
+        """3 gossip-backed servers: transitive membership through one
+        seed, schema everywhere, probe-declared death visible at every
+        survivor, and a restarted node (same identity, same data dir)
+        rejoining to full membership with its schema intact."""
+        from test_gossip import wait_until
+
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+
+        def gossip_server(name, seeds, host="127.0.0.1:0"):
+            ns = GossipNodeSet(host, gossip_host="127.0.0.1:0",
+                               seeds=seeds, probe_interval=0.1,
+                               probe_timeout=0.2, push_pull_interval=0.25,
+                               suspect_after=2)
+            s = Server(str(tmp_path / name), host=host,
+                       broadcast_receiver=ns, broadcaster=ns,
+                       anti_entropy_interval=0, polling_interval=0)
+            s.cluster.node_set = ns
+            s.open()
+            return s, ns
+
+        sa, ga = gossip_server("g3a", [])
+        sb, gb = gossip_server("g3b", [ga.gossip_host])
+        sc = None
+        try:
+            sc, gc = gossip_server("g3c", [ga.gossip_host])
+            all_sets = (ga, gb, gc)
+            want = {sa.host, sb.host, sc.host}
+            assert wait_until(
+                lambda: all({n.host for n in g.nodes()} == want
+                            for g in all_sets), timeout=10.0), \
+                "3-node membership did not converge"
+            http_post(sc.host, "/index/g3", b"{}")
+            http_post(sc.host, "/index/g3/frame/f", b"{}")
+            assert wait_until(
+                lambda: all(s.holder.frame("g3", "f") is not None
+                            for s in (sa, sb, sc)), timeout=10.0), \
+                "schema did not reach every node"
+
+            # C dies; both survivors converge on its absence.
+            c_host = sc.host
+            sc.close()
+            sc = None
+            survivors = {sa.host, sb.host}
+            assert wait_until(
+                lambda: {n.host for n in ga.nodes()} == survivors
+                and {n.host for n in gb.nodes()} == survivors,
+                timeout=15.0), "death did not converge"
+
+            # Revival: same cluster identity and data dir rejoins (the
+            # SWIM refutation path), schema still present locally.
+            sc, gc = gossip_server("g3c", [ga.gossip_host], host=c_host)
+            want = {sa.host, sb.host, sc.host}
+            assert wait_until(
+                lambda: all({n.host for n in g.nodes()} == want
+                            for g in (ga, gb, gc)), timeout=15.0), \
+                "revived node did not rejoin everywhere"
+            assert sc.holder.frame("g3", "f") is not None
+        finally:
+            for s in (sa, sb, sc):
+                if s is not None:
+                    s.close()
+
     def test_max_slice_polling(self, pair):
         s1, s2 = pair
         self._create_everywhere(pair)
