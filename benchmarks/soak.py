@@ -1,0 +1,236 @@
+"""Longevity soak: a 2-node gossip cluster under continuous mixed load.
+
+Not a pytest (it runs for minutes by design) — a reproducible harness
+whose results land in RESULTS.md. It exercises, at once, the surfaces
+that only misbehave over time: WAL growth + snapshotting under a write
+storm, anti-entropy sweeps against live writes, gossip probes across a
+mid-soak node restart, the device residency cache under a changing
+working set, and the Python heap (sampled via /debug/pprof/heap).
+
+Usage: python benchmarks/soak.py [minutes]   (default 10)
+
+Prints one JSON line per minute (ops so far, error count, RSS of each
+server, traced heap) and a final PASS/FAIL verdict with the consistency
+check: every sampled row's Bitmap must equal the model on BOTH nodes
+after a final anti-entropy pass.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests"))
+
+from podenv import cpu_env, free_port, wait_up  # noqa: E402
+
+SLICE_SPAN = 4 * (1 << 20)   # 4 slices of columns
+ROWS = 64
+
+
+def http(method, host, path, body=b"", timeout=60):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method=method)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+def query(host, pql, timeout=60):
+    raw = http("POST", host, "/index/si/query", pql.encode(),
+               timeout=timeout)
+    return json.loads(raw)["results"]
+
+
+class Node:
+    def __init__(self, name, data_dir, port, internal_port, seed=""):
+        self.name = name
+        self.data_dir = data_dir
+        self.port = port
+        self.host = f"127.0.0.1:{port}"
+        self.internal_port = internal_port
+        self.seed = seed
+        self.log = open(os.path.join(data_dir, "..", f"{name}.log"), "a")
+        self.proc = None
+
+    def start(self):
+        env = cpu_env()
+        env["PILOSA_TPU_MESH"] = "0"  # device-free children: a kill or
+        # crash here must never touch the shared accelerator state
+        argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                "-d", self.data_dir, "-b", self.host,
+                "--cluster.type", "gossip",
+                "--cluster.hosts", CLUSTER_HOSTS,
+                "--cluster.replicas", "2",
+                "--cluster.internal-port", str(self.internal_port),
+                "--anti-entropy.interval", "45s",
+                "--log-path", os.path.join(self.data_dir, "..",
+                                           f"{self.name}-server.log")]
+        if self.seed:
+            argv += ["--cluster.gossip-seed", self.seed]
+        self.proc = subprocess.Popen(argv, env=env, stdout=self.log,
+                                     stderr=self.log, cwd=_REPO)
+        wait_up(self.host)
+
+    def stop(self, sig=signal.SIGINT, timeout=30):
+        if self.proc is None:
+            return
+        self.proc.send_signal(sig)
+        try:
+            self.proc.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
+        self.proc = None
+
+    def rss_mb(self):
+        if self.proc is None:
+            return 0.0
+        try:
+            with open(f"/proc/{self.proc.pid}/statm") as f:
+                return int(f.read().split()[1]) * 4096 / (1 << 20)
+        except OSError:
+            return 0.0
+
+
+def main():
+    minutes = float(sys.argv[1]) if len(sys.argv) > 1 else 10.0
+    base = f"/tmp/pilosa-soak-{os.getpid()}"
+    os.makedirs(base, exist_ok=True)
+    pa, pb = free_port(), free_port()
+    ga, gb = free_port(), free_port()
+    global CLUSTER_HOSTS
+    CLUSTER_HOSTS = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+
+    for name, port in (("a", pa), ("b", pb)):
+        os.makedirs(f"{base}/{name}", exist_ok=True)
+    na = Node("a", f"{base}/a", pa, ga)
+    nb = Node("b", f"{base}/b", pb, gb, seed=f"127.0.0.1:{ga}")
+    na.start()
+    nb.start()
+    nodes = [na, nb]
+
+    http("POST", na.host, "/index/si", b"{}")
+    http("POST", na.host, "/index/si/frame/sf", b"{}")
+    time.sleep(2)  # let the schema gossip
+
+    model = {r: set() for r in range(ROWS)}
+    # Bits whose final state is unknowable: the write errored
+    # client-side (restart window) but may have applied server-side —
+    # at-least-once semantics, exactly like the reference's replicated
+    # writes (no rollback of a partially-applied fan-out).
+    uncertain = {r: set() for r in range(ROWS)}
+    model_mu = threading.Lock()
+    stop = threading.Event()
+    stats = {"writes": 0, "reads": 0, "errors": 0, "restarts": 0}
+
+    def writer(seed):
+        rng = random.Random(seed)
+        while not stop.is_set():
+            r = rng.randrange(ROWS)
+            c = rng.randrange(SLICE_SPAN)
+            setbit = rng.random() < 0.9
+            host = nodes[rng.randrange(2)].host
+            verb = "SetBit" if setbit else "ClearBit"
+            try:
+                query(host, f'{verb}(frame="sf", rowID={r},'
+                            f' columnID={c})', timeout=30)
+            except Exception:
+                stats["errors"] += 1  # restart window errors tolerated
+                with model_mu:
+                    uncertain[r].add(c)
+                time.sleep(0.5)
+                continue
+            with model_mu:
+                (model[r].add if setbit else model[r].discard)(c)
+                uncertain[r].discard(c)
+            stats["writes"] += 1
+
+    def reader(seed):
+        rng = random.Random(seed)
+        while not stop.is_set():
+            host = nodes[rng.randrange(2)].host
+            r = rng.randrange(ROWS)
+            try:
+                if rng.random() < 0.5:
+                    query(host, f'Count(Bitmap(frame="sf", rowID={r}))',
+                          timeout=30)
+                else:
+                    query(host, 'TopN(frame="sf", n=5)', timeout=30)
+            except Exception:
+                stats["errors"] += 1
+                time.sleep(0.5)
+                continue
+            stats["reads"] += 1
+            time.sleep(0.02)
+
+    threads = [threading.Thread(target=writer, args=(i,), daemon=True)
+               for i in range(2)]
+    threads += [threading.Thread(target=reader, args=(10 + i,),
+                                 daemon=True) for i in range(2)]
+    for t in threads:
+        t.start()
+
+    t0 = time.monotonic()
+    deadline = t0 + minutes * 60
+    restarted = False
+    minute = 0
+    http("GET", na.host, "/debug/pprof/heap")  # arm tracing on A
+    while time.monotonic() < deadline:
+        time.sleep(min(60, max(1, deadline - time.monotonic())))
+        minute += 1
+        heap = http("GET", na.host,
+                    "/debug/pprof/heap?n=1").decode().splitlines()[0]
+        print(json.dumps({
+            "minute": minute, **stats,
+            "rss_a_mb": round(na.rss_mb(), 1),
+            "rss_b_mb": round(nb.rss_mb(), 1),
+            "heap_a": heap}), flush=True)
+        if not restarted and time.monotonic() - t0 > minutes * 30:
+            # Mid-soak: clean-restart node B under load.
+            restarted = True
+            stats["restarts"] += 1
+            nb.stop()
+            time.sleep(2)
+            nb.start()
+            print(json.dumps({"event": "restarted b"}), flush=True)
+
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+
+    # Settle, then final consistency: both nodes answer the model for a
+    # sample of rows (anti-entropy has had >1 sweep since the restart).
+    time.sleep(3)
+    rng = random.Random(0)
+    failures = []
+    for r in rng.sample(range(ROWS), 16):
+        with model_mu:
+            base = model[r] - uncertain[r]
+            upper = model[r] | uncertain[r]
+        for node in nodes:
+            got = set(query(node.host,
+                            f'Bitmap(frame="sf", rowID={r})')[0]["bits"])
+            if not (base <= got <= upper):
+                failures.append((node.name, r, len(got - upper),
+                                 len(base - got)))
+    verdict = "PASS" if not failures else f"FAIL: {failures[:4]}"
+    print(json.dumps({"verdict": verdict, **stats,
+                      "minutes": minutes}), flush=True)
+    na.stop()
+    nb.stop()
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,8 +103,9 @@ class Fragment:
         self.stats = stats
         # src-TopN count maps, keyed by src-content hash, valid for one
         # mutation epoch (both TopN phases and repeat queries reuse
-        # the one O(fragment bits) pass).
-        self._src_counts: dict[bytes, tuple[int, np.ndarray]] = {}
+        # the one O(fragment bits) pass). Value: (epoch, (ids, counts)).
+        self._src_counts: dict[
+            bytes, tuple[int, tuple[np.ndarray, np.ndarray]]] = {}
         self._epoch = 0
 
         self._mu = threading.RLock()
