@@ -592,6 +592,72 @@ def config4_executor_routing() -> None:
         holder.close()
 
 
+def config5_executor_cluster_topn() -> None:
+    """BASELINE config 5's single-host form through the EXECUTOR: TopN
+    over a 256-slice (268 M-column) ranked frame, end to end — the
+    candidate phase walks 256 rank caches, the exact phase merges
+    cluster-wide, and the calibrated router picks the serving path.
+    (The multi-host form of the same program is exercised by the pod
+    tests and the driver's dryrun_multichip.)"""
+    import tempfile
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models.holder import Holder
+
+    n_slices = max(8, int(256 * SCALE))
+    n_rows = max(100, int(1000 * SCALE))
+    rng = np.random.default_rng(55)
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(d)
+        holder.open()
+        frame = holder.create_index_if_not_exists("t5") \
+            .create_frame_if_not_exists("f")
+        head = min(500, n_rows)
+        counts = np.concatenate([
+            np.maximum(40, 2000 - 4 * np.arange(head)).astype(np.int64),
+            np.full(n_rows - head, 8, dtype=np.int64)])
+        rows = np.repeat(np.arange(n_rows, dtype=np.uint64), counts)
+        cols = rng.integers(0, n_slices * SLICE_WIDTH, size=len(rows),
+                            dtype=np.uint64)
+        order = np.argsort(cols // np.uint64(SLICE_WIDTH), kind="stable")
+        rows, cols = rows[order], cols[order]
+        t0 = time.perf_counter()
+        step = max(1, len(rows) // 16)
+        for i in range(0, len(rows), step):
+            frame.import_bits(rows[i:i + step], cols[i:i + step])
+        build_s = time.perf_counter() - t0
+
+        legs = (("host", False),)
+        if USE_DEVICE:
+            legs += (("routed", True),)
+        want: dict = {}
+        for label, use_mesh in legs:
+            ex = Executor(holder, host="local", use_mesh=use_mesh,
+                          mesh_min_slices=1)
+            for q, tag in (("TopN(frame=f, n=10)", "plain"),
+                           ("TopN(Bitmap(frame=f, rowID=0), frame=f,"
+                            " n=10)", "src")):
+                t0 = time.perf_counter()
+                got = ex.execute("t5", q)[0]
+                first_s = time.perf_counter() - t0
+                assert want.setdefault(tag, got) == got, (label, tag)
+                lat = []
+                for _ in range(5):
+                    t0 = time.perf_counter()
+                    again = ex.execute("t5", q)[0]
+                    lat.append(time.perf_counter() - t0)
+                assert again == got
+                lat.sort()
+                emit_latency(f"c5_executor_topn_{tag}_{label}_p50",
+                             lat[2] * 1e3, device=(label != "host"),
+                             slices=n_slices, rows=n_rows,
+                             first_ms=round(first_s * 1e3, 1),
+                             build_s=round(build_s, 1))
+            ex.close()
+        holder.close()
+
+
 _SYNC_FLOOR_MS: float = 0.0
 
 
@@ -627,6 +693,7 @@ def main() -> None:
                config4_mesh_count_over_slices,
                config4_executor_routing,
                config5_cluster_topn,
+               config5_executor_cluster_topn,
                config_residency_repeat_latency,
                config_host_write_and_import):
         try:

@@ -1551,11 +1551,29 @@ class Executor:
 
     def _mapper_local(self, slices: list[int], map_fn, reduce_fn):
         # Goroutine-per-slice equivalent (executor.go:1201-1236); the numpy
-        # and device work inside map_fn releases the GIL.
+        # and device work inside map_fn releases the GIL. Wide fan-outs
+        # chunk several slices per pool task and pre-reduce inside the
+        # task: at 256 slices the per-task submit/schedule overhead was
+        # a third of the whole query, and reduce order is already
+        # arbitrary (the cluster layer reduces in completion order).
         if len(slices) == 1:
             return reduce_fn(None, map_fn(slices[0]))
         pool = self._pool("slice")
-        futs = [pool.submit(map_fn, s) for s in slices]
+        chunk = max(1, len(slices) // (4 * self.max_workers))
+
+        def run_group(group: list[int]):
+            r = None
+            for s in group:
+                r = reduce_fn(r, map_fn(s))
+            return r
+
+        if chunk == 1:
+            # Narrow fan-out: submit per slice — a single-slice group
+            # would pay one extra reduce_fn pass per slice for nothing.
+            futs = [pool.submit(map_fn, s) for s in slices]
+        else:
+            futs = [pool.submit(run_group, slices[i:i + chunk])
+                    for i in range(0, len(slices), chunk)]
         result = None
         try:
             for fut in futs:
