@@ -649,10 +649,14 @@ def config5_executor_cluster_topn() -> None:
                     lat.append(time.perf_counter() - t0)
                 assert again == got
                 lat.sort()
+                # The routed leg only crossed the device when nothing
+                # was vetoed (mirrors config4_executor_routing).
+                crossed = (label != "host" and ex.cost_vetoes == 0)
                 emit_latency(f"c5_executor_topn_{tag}_{label}_p50",
-                             lat[2] * 1e3, device=(label != "host"),
+                             lat[2] * 1e3, device=crossed,
                              slices=n_slices, rows=n_rows,
                              first_ms=round(first_s * 1e3, 1),
+                             vetoes=ex.cost_vetoes,
                              build_s=round(build_s, 1))
             ex.close()
         holder.close()

@@ -501,6 +501,20 @@ class Fragment:
                     ids, counts = ids[:opt.n], counts[:opt.n]
                 return [Pair(i, c) for i, c in zip(ids.tolist(),
                                                    counts.tolist())]
+            # ids-form fast path (TopN's exact phase re-queries every
+            # candidate on every slice): rank-sort the per-id counts,
+            # skipping the heap replay — at 256 slices × ~200
+            # candidates the replay's per-pair heap ops were phase 2's
+            # whole cost. Identical output: the replay with row_ids has
+            # n=0 (push all positives ≥ threshold) and pops in
+            # (count desc, id asc) order, which is exactly pairs_sort.
+            if (opt.src is None and opt.row_ids
+                    and not (opt.filter_field and opt.filter_values)
+                    and opt.tanimoto_threshold <= 0):
+                floor = max(opt.min_threshold, 1)
+                return cache_mod.pairs_sort(
+                    p for p in self._top_pairs(opt.row_ids)
+                    if p.count >= floor)
             pairs = self._top_pairs(opt.row_ids)
             n = 0 if opt.row_ids else opt.n
 
