@@ -185,16 +185,28 @@ class Fragment:
     def _close_storage(self) -> None:
         if self.storage is not None:
             self.storage.op_writer = None
-            self.storage.unmap()
-        # Do NOT mmap.close(): row-cache entries and escaped query results
-        # share zero-copy container views into the map, so an explicit close
-        # would either raise BufferError or invalidate live results. Dropping
-        # the reference lets the OS unmap when the last view is GC'd. The fd
-        # can close immediately (the mapping outlives it), which also
-        # releases the flock.
+        # Do NOT mmap.close() and do NOT copy containers out
+        # (storage.unmap): row-cache entries and escaped query results
+        # share zero-copy container views into the map, and those views
+        # PIN the mapping — dropping our references lets the OS unmap
+        # when the last view is GC'd, while an eager copy-out pays a
+        # whole-fragment heap copy (100 MB+ per restore/snapshot of a
+        # large slice, measured) for data that is about to be garbage.
+        # The old inode stays valid under os.replace, so mapped views
+        # never go stale. The fd closes immediately (the mapping
+        # outlives it) — but NOT the flock: see the explicit unlock
+        # below.
         self._mmap = None
         self.row_cache.clear()
         if self._file is not None:
+            # Release the flock EXPLICITLY: mmap dups the fd, and a dup
+            # shares the open file description — so while any container
+            # view keeps the old map alive, close() alone would leave
+            # the lock held and block the next open of this path.
+            try:
+                fcntl.flock(self._file.fileno(), fcntl.LOCK_UN)
+            except OSError:
+                pass
             self._file.close()
             self._file = None
 
@@ -286,7 +298,9 @@ class Fragment:
             with self.logger.track("fragment: snapshot %s/%s/%s/%d",
                                    self.index, self.frame, self.view,
                                    self.slice):
-                self.storage.unmap()
+                # No unmap/copy-out: write_to reads the mapped
+                # containers directly, and _close_storage just drops
+                # the map reference (see its comment).
                 tmp = self.path + ".snapshotting"
                 with open(tmp, "wb") as f:
                     self.storage.write_to(f)

@@ -735,10 +735,13 @@ class Bitmap:
     def unmap(self) -> None:
         """Copy all mapped container data out of the backing buffer.
 
-        Required before closing the mmap a bitmap was loaded from: numpy
-        views pin the buffer (mmap.close() raises BufferError otherwise).
-        The fragment snapshot path (rewrite file → remap, reference
-        fragment.go:1017-1057) calls this before releasing the old map.
+        Only required before an operation that INVALIDATES the mapping
+        — ftruncate of the backing file (the fragment torn-tail trim)
+        or an explicit mmap.close() (numpy views pin the buffer;
+        close() raises BufferError otherwise). Ordinary close/snapshot/
+        restore paths just drop references instead: live views keep the
+        mapping alive, and a copy-out would pay a whole-fragment heap
+        copy for nothing (fragment._close_storage).
         """
         for c in self.containers:
             c._unmap()
