@@ -284,6 +284,33 @@ class TestTopN:
         frag.close()
 
 
+class TestNoCopyClose:
+    def test_escaped_results_survive_snapshot_and_reopen(self, tmp_path):
+        """Close/snapshot drop the old mapping WITHOUT copying container
+        data out; escaped query results must stay valid (their views
+        pin the map), and the flock must release so the same path
+        reopens immediately even while those results are alive."""
+        frag = make_fragment(tmp_path, name="nocopy")
+        cols = list(range(0, 3000, 3))
+        frag.import_bits([7] * len(cols), cols)
+        row_before = frag.row(7)          # zero-copy views of map #1
+        bits_before = row_before.bits().copy()
+
+        frag.set_bit(7, 1)                # mutate + snapshot new file
+        frag.snapshot()
+        # Old result still reads map-#1 data, unchanged.
+        assert np.array_equal(row_before.bits(), bits_before)
+
+        frag2 = reopen(frag)              # flock must not be held
+        try:
+            got = sorted(int(b) for b in frag2.row(7).bits())
+            assert got == sorted(cols + [1])
+            # The pre-snapshot escaped result STILL reads its snapshot.
+            assert np.array_equal(row_before.bits(), bits_before)
+        finally:
+            frag2.close()
+
+
 class TestImport:
     def test_import_and_counts(self, frag):
         rows = np.array([0, 0, 1, 1, 1], dtype=np.uint64)
