@@ -264,15 +264,31 @@ class TestTwoNodeCluster:
                 http_post(s.host, "/index/i/frame/inv",
                           b'{"options": {"inverseEnabled": true}}')
 
+            for s in (s1, s2):
+                http_post(s.host, "/index/i/frame/tq",
+                          b'{"options": {"timeQuantum": "YMD"}}')
+
             rng = random.Random(99)
             servers = (s1, s2)
             model: dict[int, set[int]] = {}
             inv_model: dict[int, set[int]] = {}
+            ts_model: dict[tuple[int, int], set[int]] = {}  # (row, day)
             for _ in range(600):
                 s = servers[rng.randrange(2)]
                 row = rng.randrange(6)
                 col = rng.randrange(4 * SLICE_WIDTH)
-                frame, m = (("f", model) if rng.random() < 0.8
+                pick = rng.random()
+                if pick < 0.15:
+                    # Timestamped write into the time-quantum frame.
+                    day = rng.randrange(1, 5)
+                    http_post(s.host, "/index/i/query",
+                              f'SetBit(frame="tq", rowID={row},'
+                              f' columnID={col},'
+                              f' timestamp="2017-01-0{day}T00:00")'
+                              .encode())
+                    ts_model.setdefault((row, day), set()).add(col)
+                    continue
+                frame, m = (("f", model) if pick < 0.75
                             else ("inv", inv_model))
                 if rng.random() < 0.85:
                     http_post(s.host, "/index/i/query",
@@ -318,6 +334,19 @@ class TestTwoNodeCluster:
                     want = sorted(r for r, cols in inv_model.items()
                                   if col in cols)
                     assert got == want, (s.host, col)
+                # Range over the time-view cover, cluster-wide.
+                for row in range(6):
+                    for lo, hi in ((1, 3), (2, 5), (1, 5)):
+                        _, body = http_post(
+                            s.host, "/index/i/query",
+                            f'Count(Range(rowID={row}, frame="tq",'
+                            f' start="2017-01-0{lo}T00:00",'
+                            f' end="2017-01-0{hi}T00:00"))'.encode())
+                        got = json.loads(body)["results"][0]
+                        want = len(set().union(*(
+                            ts_model.get((row, d), set())
+                            for d in range(lo, hi))))
+                        assert got == want, (s.host, row, lo, hi)
 
             # Replicated writes: every owned fragment exists on both
             # nodes with identical contents already; now diverge one
