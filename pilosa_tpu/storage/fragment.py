@@ -509,8 +509,13 @@ class Fragment:
                     and hasattr(self.cache, "top_arrays")):
                 self.cache.invalidate()
                 ids, counts = self.cache.top_arrays()
-                keep = counts >= max(opt.min_threshold, 1)
-                ids, counts = ids[keep], counts[keep]
+                # counts are rank-sorted descending: the ≥-floor set is
+                # a prefix, found by binary search on the reversed view
+                # — no 50K-entry boolean mask per slice per query.
+                floor = max(opt.min_threshold, 1)
+                cut = len(counts) - int(np.searchsorted(
+                    counts[::-1], floor, side="left"))
+                ids, counts = ids[:cut], counts[:cut]
                 if opt.n:
                     ids, counts = ids[:opt.n], counts[:opt.n]
                 return [Pair(i, c) for i, c in zip(ids.tolist(),
