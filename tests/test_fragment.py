@@ -9,7 +9,6 @@ import numpy as np
 import pytest
 
 from pilosa_tpu import SLICE_WIDTH
-from pilosa_tpu.storage import fragment as frag_mod
 from pilosa_tpu.storage.bitmap import Bitmap
 from pilosa_tpu.storage.cache import Pair
 from pilosa_tpu.storage.fragment import (Fragment, PairSet, TopOptions,

@@ -12,7 +12,6 @@ import subprocess
 import sys
 
 import numpy as np
-import pytest
 
 from pilosa_tpu.parallel import mesh as mesh_mod
 from pilosa_tpu.parallel import multihost

@@ -4,7 +4,7 @@ String() round-trip, which the executor relies on for query forwarding."""
 import pytest
 
 from pilosa_tpu.pql import parser as pql
-from pilosa_tpu.pql.ast import Call, Query
+from pilosa_tpu.pql.ast import Call
 
 
 def parse1(s):

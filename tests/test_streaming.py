@@ -16,9 +16,7 @@ import urllib.error
 import urllib.request
 
 import numpy as np
-import pytest
 
-from pilosa_tpu import SLICE_WIDTH
 from pilosa_tpu.cluster.client import Client
 from pilosa_tpu.server.server import Server
 from pilosa_tpu.storage import roaring
