@@ -124,5 +124,11 @@ def test_executor_1b_column_index(tmp_path):
         sres = slow.execute("i", q)
         assert got == {p.id: p.count for p in sres[0]}
         assert got[1] == N_SLICES  # row ∩ itself = every slice's bit
+        # Plain TopN (both phases: 1024 rank-cache walks + the exact
+        # re-query across every slice) — BASELINE config 5's shape at
+        # the full 1 B-column axis.
+        res = ex.execute("i", "TopN(frame=f, n=2)")[0]
+        assert [(p.id, p.count) for p in res] == \
+            [(1, N_SLICES), (2, N_SLICES)]
     finally:
         holder.close()
