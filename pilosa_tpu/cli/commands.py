@@ -375,9 +375,13 @@ def cmd_config(args, stdout, stderr) -> int:
 
 
 def build_parser() -> argparse.ArgumentParser:
+    from .. import __version__
     p = argparse.ArgumentParser(
         prog="pilosa-tpu",
-        description="TPU-native distributed bitmap index")
+        description=f"TPU-native distributed bitmap index"
+                    f" (version {__version__})")
+    p.add_argument("--version", action="version",
+                   version=f"pilosa-tpu {__version__}")
     sub = p.add_subparsers(dest="command", required=True)
 
     # Full server flag surface (reference cmd/server.go:88-104).
