@@ -216,6 +216,47 @@ class TestTopN:
             "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=2)")
         assert res[0] == [Pair(0, 4), Pair(5, 2)]
 
+    def test_top_n_fill(self, holder, executor):
+        """executor_test.go:300-322: the global winner's count must
+        aggregate across slices even when the per-slice tops differ —
+        the exact phase re-queries every candidate everywhere."""
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists(
+            "f", FrameOptions(cache_type="ranked"))
+        f = holder.frame("i", "f")
+        for col in (0, 1, 2):
+            f.set_bit("standard", 0, col)
+        f.set_bit("standard", 0, SLICE_WIDTH)
+        f.set_bit("standard", 1, SLICE_WIDTH + 2)
+        f.set_bit("standard", 1, SLICE_WIDTH)
+        for frag in f.view("standard").fragments.values():
+            frag.recalculate_cache()
+        res = executor.execute("i", "TopN(frame=f, n=1)")
+        assert res[0] == [Pair(0, 4)]
+
+    def test_top_n_fill_small(self, holder, executor):
+        """executor_test.go:324-356: row 0 is never any slice's sole
+        standout (1 bit/slice over 5 slices vs 2-bit rows per slice)
+        yet must win globally with count 5."""
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists(
+            "f", FrameOptions(cache_type="ranked"))
+        f = holder.frame("i", "f")
+        for s in range(5):
+            f.set_bit("standard", 0, s * SLICE_WIDTH)
+        f.set_bit("standard", 1, 0)
+        f.set_bit("standard", 1, 1)
+        f.set_bit("standard", 2, SLICE_WIDTH)
+        f.set_bit("standard", 2, SLICE_WIDTH + 1)
+        f.set_bit("standard", 3, 2 * SLICE_WIDTH)
+        f.set_bit("standard", 3, 2 * SLICE_WIDTH + 1)
+        f.set_bit("standard", 4, 3 * SLICE_WIDTH)
+        f.set_bit("standard", 4, 3 * SLICE_WIDTH + 1)
+        for frag in f.view("standard").fragments.values():
+            frag.recalculate_cache()
+        res = executor.execute("i", "TopN(frame=f, n=1)")
+        assert res[0] == [Pair(0, 5)]
+
     def test_top_n_ids(self, holder, executor):
         idx = holder.create_index_if_not_exists("i")
         idx.create_frame_if_not_exists(
