@@ -220,6 +220,39 @@ class TestQuery:
         resp = pb.QueryResponse.FromString(body)
         assert resp.Results[0].Pairs[0].Key == 5
 
+    def test_invalid_slice_argument(self, holder, handler):
+        # handler_test.go:203-212: ?slices=a,b → 400 JSON error object.
+        holder.create_index_if_not_exists("i")
+        status, _, body = call(handler, "POST",
+                               "/index/i/query?slices=a,b",
+                               b'Bitmap(frame="f", rowID=1)')
+        assert status == 400
+        assert json.loads(body) == {"error": "invalid slice argument"}
+
+    def test_executor_error_json_and_protobuf(self, holder):
+        # handler_test.go:447-484: executor failures surface as 500
+        # with {"error": msg} JSON, or QueryResponse.Err as protobuf.
+        def boom(index, query, slices, opt):
+            raise RuntimeError("marker")
+
+        h = Handler(holder, MockExecutor(boom), host="local")
+        holder.create_index_if_not_exists("i")
+        status, _, body = call(h, "POST", "/index/i/query",
+                               b'Bitmap(frame="f", rowID=1)')
+        assert status == 500
+        assert json.loads(body) == {"error": "marker"}
+        status, _, body = call(h, "POST", "/index/i/query",
+                               b'TopN(frame="f", n=2)',
+                               accept=_PROTOBUF)
+        assert status == 500
+        assert pb.QueryResponse.FromString(body).Err == "marker"
+
+    def test_query_method_not_allowed(self, holder, handler):
+        # handler_test.go:486-493.
+        holder.create_index_if_not_exists("i")
+        status, _, _ = call(handler, "GET", "/index/i/query")
+        assert status == 405
+
     def test_column_attrs_join(self, holder, handler):
         idx = holder.create_index_if_not_exists("i")
         idx.create_frame_if_not_exists("f").set_bit("standard", 1, 3)
