@@ -187,6 +187,44 @@ class TestTopN:
         #          count=4, tan=ceil(400/(4+6-4))=67 > 50 ✓
         assert got == {100: 6, 101: 6, 102: 4}
 
+    def test_topn_intersect_large(self, tmp_path):
+        """fragment_test.go:233-272 verbatim: rows 0..999 where row i
+        holds bits 0..i-1, src = {980..999}; the top-10 by intersection
+        must be rows 999..990 with counts 19..10 — exercises threshold
+        pruning where rank-cache counts and src counts diverge."""
+        frag = make_fragment(tmp_path, name="toplarge")
+        try:
+            rows = np.repeat(np.arange(1000, dtype=np.uint64),
+                             np.arange(1000))
+            cols = np.concatenate([np.arange(i, dtype=np.uint64)
+                                   for i in range(1000)])
+            frag.import_bits(rows, cols)
+            src = Bitmap(*range(980, 1000))
+            pairs = frag.top(TopOptions(n=10, src=src))
+            assert [(p.id, p.count) for p in pairs] == \
+                [(999 - k, 19 - k) for k in range(10)]
+        finally:
+            frag.close()
+
+    def test_topn_cache_size_bounds_result(self, tmp_path):
+        """fragment_test.go:295-358: a ranked cache of size 3 caps the
+        candidate set — TopN(5) returns exactly the 3 cached rows."""
+        frag = make_fragment(tmp_path, name="topsize")
+        frag.cache_size = 3
+        from pilosa_tpu.storage import cache as cache_mod
+        frag.cache = cache_mod.RankCache(3)
+        try:
+            self.fill(frag, {100: 3, 101: 4, 102: 5, 103: 6, 104: 7})
+            frag.set_bit(105, 10)
+            frag.set_bit(105, 11)
+            frag.recalculate_cache()
+            pairs = frag.top(TopOptions(n=5))
+            assert len(pairs) <= 3
+            assert [(p.id, p.count) for p in pairs] == \
+                [(104, 7), (103, 6), (102, 5)]
+        finally:
+            frag.close()
+
     def test_src_topn_paths_match_bruteforce(self, tmp_path):
         """Randomized parity for TopN with a source bitmap: the
         vectorized count-map path must reproduce a brute-force
