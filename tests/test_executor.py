@@ -257,6 +257,29 @@ class TestTopN:
         res = executor.execute("i", "TopN(frame=f, n=1)")
         assert res[0] == [Pair(0, 5)]
 
+    def test_top_n_int_attr_filter(self, holder, executor):
+        """executor_test.go:391-435: attribute filters with INT values
+        (filters=[123] against an int64-typed attr), with and without a
+        source bitmap, across two slices."""
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists(
+            "f", FrameOptions(cache_type="ranked"))
+        f = holder.frame("i", "f")
+        f.set_bit("standard", 0, 0)
+        f.set_bit("standard", 0, 1)
+        f.set_bit("standard", 10, SLICE_WIDTH)
+        f.row_attr_store.set_attrs(10, {"category": 123})
+        for view in f.views.values():
+            for frag in view.fragments.values():
+                frag.recalculate_cache()
+        res = executor.execute(
+            "i", 'TopN(frame="f", n=1, field="category", filters=[123])')
+        assert res[0] == [Pair(10, 1)]
+        res = executor.execute(
+            "i", 'TopN(Bitmap(rowID=10, frame=f), frame="f", n=1,'
+                 ' field="category", filters=[123])')
+        assert res[0] == [Pair(10, 1)]
+
     def test_top_n_ids(self, holder, executor):
         idx = holder.create_index_if_not_exists("i")
         idx.create_frame_if_not_exists(
