@@ -317,6 +317,29 @@ class TestAttrs:
         assert holder.index("i").column_attr_store.attrs(10) == \
             {"foo": "baz"}
 
+    def test_typed_attrs_persist_across_reopen(self, holder, executor):
+        """All four reference attr types (attr.go:34-40) through PQL,
+        surviving a holder reopen byte-typed (protobuf AttrMap)."""
+        must_set(holder, "i", "f", 1, 1)
+        executor.execute(
+            "i", 'SetRowAttrs(frame="f", rowID=1, active=true,'
+                 ' weight=1.5, name="x", rank=9)')
+        want = {"active": True, "weight": 1.5, "name": "x", "rank": 9}
+        assert holder.frame("i", "f").row_attr_store.attrs(1) == want
+        path = holder.path
+        holder.close()
+        h2 = Holder(path)
+        h2.open()
+        try:
+            got = h2.frame("i", "f").row_attr_store.attrs(1)
+            assert got == want
+            assert isinstance(got["active"], bool)
+            assert isinstance(got["weight"], float)
+            assert isinstance(got["rank"], int)
+        finally:
+            h2.close()
+            holder.open()  # fixture teardown closes it again
+
 
 class FakeClient:
     """Scripted remote transport (reference executor_test.go mock server)."""
