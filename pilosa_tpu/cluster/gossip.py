@@ -258,6 +258,13 @@ class GossipNodeSet:
             list(pool.map(send, [mem.addr for mem in peers]))
         except futures.CancelledError:
             return  # close() cancelled the fan-out mid-flight
+        except RuntimeError:
+            # close() shut the pool down between our _send_pool_mu check
+            # and pool.map scheduling ("cannot schedule new futures after
+            # shutdown"); during shutdown this is benign, same as a cancel.
+            if self._closing.is_set():
+                return
+            raise
         if errs:
             raise errs[0]
 

@@ -128,11 +128,18 @@ class Response:
 def _export_csv_chunks(frag):
     """Vectorized, chunked CSV body: one chunk per roaring container, so
     a 128 MB+ fragment never sits in memory as text (the reference
-    streams via csv.Writer over ForEachBit, handler.go:985-1025)."""
+    streams via csv.Writer over ForEachBit, handler.go:985-1025).
+
+    The WSGI layer drains this generator after the handler returns, so
+    it streams from Fragment.snapshot_value_chunks(): a point-in-time
+    copy of the compressed container buffers taken under the fragment
+    lock — concurrent mutations during the (possibly long) transfer
+    can't tear a row mid-stream, and peak memory is bounded by the
+    compressed fragment size, not the rendered text."""
     from .. import SLICE_WIDTH
     base = frag.slice * SLICE_WIDTH
     w = np.uint64(SLICE_WIDTH)
-    for vals in frag.storage.value_chunks():
+    for vals in frag.snapshot_value_chunks():
         rows = (vals // w).tolist()
         cols = (vals % w).tolist()
         yield "".join(f"{r},{base + c}\r\n"

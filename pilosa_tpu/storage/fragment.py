@@ -80,6 +80,12 @@ class PairSet:
         return PairSet(z, z)
 
 
+# Matched-position budget before folding src-count hits into a
+# partial (ids, counts) map; module-level so tests can shrink it to
+# exercise the multi-partial merge.
+_SRC_FOLD_POSITIONS = 1 << 20
+
+
 class Fragment:
     def __init__(self, path: str, index: str, frame: str, view: str,
                  slice: int, cache_type: str = cache_mod.DEFAULT_CACHE_TYPE,
@@ -379,15 +385,33 @@ class Fragment:
         hit = self._src_counts.get(key)
         if hit is not None and hit[0] == self._epoch:
             return hit[1]
+        # Partial (ids, counts) maps, folded every ~1 M matched
+        # positions: peak memory is bounded by DISTINCT row ids, not by
+        # matched bits (a broad src over 100 M matched bits would
+        # otherwise hold ~800 MB of int64 row ids before one unique).
+        partial_ids: list[np.ndarray] = []
+        partial_counts: list[np.ndarray] = []
         hit_rows: list[np.ndarray] = []
+        hit_len = 0
         # Batch container chunks to ~1 M positions per isin: sparse
         # fragments have millions of near-empty containers, and a
         # per-container isin pays its sort setup millions of times.
         batch: list[np.ndarray] = []
         batch_len = 0
 
+        def fold_hits() -> None:
+            nonlocal hit_rows, hit_len
+            if not hit_rows:
+                return
+            rows = (hit_rows[0] if len(hit_rows) == 1
+                    else np.concatenate(hit_rows))
+            hit_rows, hit_len = [], 0
+            ids, counts = np.unique(rows, return_counts=True)
+            partial_ids.append(ids)
+            partial_counts.append(counts)
+
         def flush() -> None:
-            nonlocal batch, batch_len
+            nonlocal batch, batch_len, hit_len
             if not batch:
                 return
             vals = batch[0] if len(batch) == 1 else np.concatenate(batch)
@@ -395,6 +419,9 @@ class Fragment:
             hits = vals[np.isin(vals % w, src_cols)]
             if len(hits):
                 hit_rows.append((hits // w).astype(np.int64))
+                hit_len += len(hits)
+                if hit_len >= _SRC_FOLD_POSITIONS:
+                    fold_hits()
 
         for vals in self.storage.value_chunks():
             batch.append(vals)
@@ -402,10 +429,19 @@ class Fragment:
             if batch_len >= (1 << 20):
                 flush()
         flush()
-        if hit_rows:
-            # (sorted row ids, counts) — NOT a bincount array, whose
-            # size is max-row-id+1 and explodes on sparse huge ids.
-            out = np.unique(np.concatenate(hit_rows), return_counts=True)
+        fold_hits()
+        if partial_ids:
+            # Merge the bounded partials: (sorted row ids, counts) — NOT
+            # a bincount array, whose size is max-row-id+1 and explodes
+            # on sparse huge ids.
+            if len(partial_ids) == 1:
+                out = (partial_ids[0], partial_counts[0])
+            else:
+                all_ids = np.concatenate(partial_ids)
+                all_counts = np.concatenate(partial_counts)
+                ids, inv = np.unique(all_ids, return_inverse=True)
+                out = (ids, np.bincount(inv, weights=all_counts)
+                       .astype(np.int64))
         else:
             z = np.empty(0, dtype=np.int64)
             out = (z, z)
@@ -791,6 +827,34 @@ class Fragment:
         self.snapshot()
 
     # -- iteration / export --------------------------------------------------
+
+    def snapshot_value_chunks(self):
+        """Point-in-time set positions, one sorted u64 array per
+        container, safe to drain long after the call (e.g. by a WSGI
+        layer streaming a CSV export). The fragment lock is held only
+        while copying the COMPRESSED container buffers (u16 arrays /
+        u64 words — bounded by on-disk size, not 8 B per set bit);
+        expansion to positions happens lazily per yield, so neither
+        lock-hold time nor peak memory scales with the rendered
+        output. The reference streams exports bit-by-bit under its
+        fragment mutex (handler.go:985-1025); this is the
+        snapshot-then-stream equivalent."""
+        with self._mu:
+            snap = []
+            for key, c in zip(list(self.storage.keys),
+                              list(self.storage.containers)):
+                if not c.n:
+                    continue
+                snap.append((int(key),
+                             None if c.array is None else c.array.copy(),
+                             None if c.bitmap is None else c.bitmap.copy()))
+
+        def expand():
+            for key, arr, words in snap:
+                if arr is None:
+                    arr = roaring.bitmap_words_to_values(words)
+                yield np.uint64(key << 16) + arr.astype(np.uint64)
+        return expand()
 
     def for_each_bit(self):
         """Yield (row_id, absolute_column_id) for every set bit."""
