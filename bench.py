@@ -211,6 +211,25 @@ def main() -> None:
                 line["topn1000_p50_ms"] = json.load(f)["device_p50_ms"]
         except (OSError, ValueError, KeyError):
             pass
+        # Kernel-level Pallas-vs-XLA A/B record (benchmarks/pallas_ab.py)
+        # and the write-path legs (suite._write_denominator) — the two
+        # round-4 perf-proof artifacts, carried in the line of record.
+        try:
+            with open(os.path.join(os.path.dirname(_BASELINE_PATH),
+                                   "PALLAS_AB.json")) as f:
+                ab = json.load(f)
+                line["pallas_ab"] = {
+                    "pallas_wins": ab["pallas_wins"],
+                    "total": ab["total"],
+                    "serving_default": "xla"}
+        except (OSError, ValueError, KeyError):
+            pass
+        try:
+            with open(os.path.join(os.path.dirname(_BASELINE_PATH),
+                                   "WRITEPATH.json")) as f:
+                line["write_path"] = json.load(f)
+        except (OSError, ValueError, KeyError):
+            pass
         print(json.dumps(line))
     else:
         # Fail-soft: record the host-C++ denominator so the round still
@@ -233,26 +252,13 @@ def _pin_host_baseline(bits: int, k_rows: int, host_s: float) -> float:
     """Best-of-all-rounds host seconds for this workload shape ON THIS
     MACHINE (the key carries the hostname — a faster rig's measurement
     must not poison vs_baseline for every other rig); updates the
-    persisted record when this run's measurement is faster."""
+    persisted record when this run's measurement is faster. One shared
+    writer for HOST_BASELINE.json lives in benchmarks.pinning."""
     import platform
-    key = f"bits={bits},rows={k_rows},host={platform.node()}"
-    record = {}
-    try:
-        with open(_BASELINE_PATH) as f:
-            record = json.load(f)
-    except (OSError, ValueError):
-        pass
-    best = record.get(key, {}).get("best_host_s")
-    if best is None or host_s < best:
-        record[key] = {"best_host_s": host_s,
-                       "updated": time.strftime("%Y-%m-%d")}
-        try:
-            with open(_BASELINE_PATH, "w") as f:
-                json.dump(record, f, indent=1, sort_keys=True)
-        except OSError:
-            pass
-        return host_s
-    return best
+
+    from benchmarks.pinning import pin
+    return pin(f"bits={bits},rows={k_rows},host={platform.node()}",
+               "best_host_s", host_s, lambda new, old: new < old)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,112 @@
+"""Measure the sparse-upload densify path against dense device_put.
+
+The round-3 cold-path numbers (c5 first src-TopN 2378 ms vs 86-126 ms
+repeat) are transfer-bound: candidate blocks ship as dense words at the
+~1.1 GB/s tunnel rate. The sparse path ships (word idx, word value)
+pairs and densifies on device (ops.pallas_kernels.densify_pallas).
+This harness measures, at a c5-scale block shape:
+
+- dense leg:   pack host → device_put [T, 32768] u32      (the status quo)
+- sparse leg:  device_put idx/val [T, P] + densify kernel (the new path)
+
+plus the kernel-only dispatch time and first-call compile cost, and
+writes benchmarks/DENSIFY.json. Run on the real chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                   "DENSIFY.json")
+
+
+def main() -> None:
+    import jax
+
+    from pilosa_tpu.ops import packed
+    from pilosa_tpu.ops.pallas_kernels import densify_pallas
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(5)
+    W = packed.WORDS_PER_SLICE  # 32768
+
+    out = {"platform": platform, "cases": []}
+    # (tiles, set bits per row) — c5-ish: 256 slices x 64 candidates,
+    # ~2000 bits/row (the suite's ranked-frame density), and a denser
+    # variant to find the crossover.
+    for t_rows, bits_per_row in ((256 * 64, 2000), (256 * 64, 30),
+                                 (2048, 16000)):
+        # synth sparse rows: bits_per_row distinct positions per row
+        pos = np.sort(
+            rng.choice(W * 32, size=bits_per_row, replace=False))
+        widx = (pos >> 5).astype(np.int32)
+        vals = (np.uint32(1) << (pos & 31).astype(np.uint32))
+        starts = np.concatenate(([0], np.flatnonzero(np.diff(widx)) + 1))
+        uidx = widx[starts]
+        uval = np.bitwise_or.reduceat(vals, starts)
+        p_pad = -(-len(uidx) // 512) * 512
+        idx = np.zeros((t_rows, p_pad), np.int32)
+        val = np.zeros((t_rows, p_pad), np.uint32)
+        idx[:, :len(uidx)] = uidx
+        val[:, :len(uval)] = uval
+
+        dense = np.zeros((t_rows, W), np.uint32)
+        dense[:, uidx] = uval
+
+        # dense leg: transfer the packed words
+        jax.device_put(dense[:64]).block_until_ready()  # warm path
+        t0 = time.perf_counter()
+        d = jax.device_put(dense)
+        d.block_until_ready()
+        dense_s = time.perf_counter() - t0
+        del d
+
+        # sparse leg: transfer pairs + densify
+        t0 = time.perf_counter()
+        di, dv = jax.device_put(idx), jax.device_put(val)
+        jax.block_until_ready((di, dv))
+        upload_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        got = densify_pallas(di, dv, W)
+        got.block_until_ready()
+        first_kernel_s = time.perf_counter() - t0  # includes compile
+        ok = bool((np.asarray(got[:2]) == dense[:2]).all())
+        # kernel-only, chained
+        t0 = time.perf_counter()
+        for _ in range(8):
+            got = densify_pallas(di, dv, W)
+        got.block_until_ready()
+        kernel_ms = (time.perf_counter() - t0) / 8 * 1e3
+        del di, dv, got
+
+        case = {
+            "tiles": t_rows, "bits_per_row": bits_per_row,
+            "pairs_per_row": int(len(uidx)), "p_padded": int(p_pad),
+            "dense_mb": round(dense.nbytes / 1e6, 1),
+            "sparse_mb": round((idx.nbytes + val.nbytes) / 1e6, 1),
+            "dense_put_s": round(dense_s, 3),
+            "sparse_put_s": round(upload_s, 3),
+            "densify_first_s": round(first_kernel_s, 3),
+            "densify_ms": round(kernel_ms, 2),
+            "sparse_total_s": round(upload_s + kernel_ms / 1e3, 3),
+            "speedup": round(dense_s / (upload_s + kernel_ms / 1e3), 2),
+            "verified": ok,
+        }
+        print(json.dumps(case), flush=True)
+        out["cases"].append(case)
+
+    with open(OUT, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"wrote": OUT}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Shared best-ever pinning for benchmarks/HOST_BASELINE.json.
+
+Both bench.py (read denominator, best = LOWEST seconds) and the suite's
+write denominator (best = HIGHEST ops/s) persist per-machine best-ever
+host-native measurements here; one writer keeps the record schema and
+error handling in one place. Keys carry the hostname so a faster rig's
+measurement never poisons another rig's ratio.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "HOST_BASELINE.json")
+
+
+def pin(key: str, field: str, value: float, better) -> float:
+    """Update HOST_BASELINE.json[key][field] with ``value`` when
+    ``better(value, recorded)`` says it improves; returns the pinned
+    (monotone best-ever) value either way. ``better`` is e.g.
+    ``lambda new, old: new < old`` for seconds."""
+    record = {}
+    try:
+        with open(PATH) as f:
+            record = json.load(f)
+    except (OSError, ValueError):
+        pass
+    best = record.get(key, {}).get(field)
+    if best is None or better(value, best):
+        record[key] = {field: value,
+                       "updated": time.strftime("%Y-%m-%d")}
+        try:
+            with open(PATH, "w") as f:
+                json.dump(record, f, indent=1, sort_keys=True)
+        except OSError:
+            pass
+        return value
+    return best

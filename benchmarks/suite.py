@@ -173,7 +173,7 @@ def _kernel_ab_modes() -> list[tuple[str, str]]:
     """
     import jax
     if jax.devices()[0].platform == "tpu":
-        return [("xla", "0"), ("pallas", "auto")]
+        return [("xla", "0"), ("pallas", "1")]
     return [("xla", "0")]
 
 
@@ -410,11 +410,86 @@ def config_host_write_and_import() -> None:
                 ex.execute("bench",
                            f'SetBit(frame="f", rowID={i % 50},'
                            f' columnID={i * 13 % (1 << 20)})')
-            emit("host_setbit_inprocess", k / (time.perf_counter() - t0),
-                 "ops/sec")
+            setbit_exec = k / (time.perf_counter() - t0)
+            emit("host_setbit_inprocess", setbit_exec, "ops/sec")
             ex.close()
         finally:
             holder.close()
+
+    _write_denominator(setbit_exec)
+
+
+def _write_denominator(setbit_exec: float) -> None:
+    """The write path's measured host-native denominator (round-3
+    verdict: writes were the one surface with no reference-equivalent
+    number). Runs the same workload through (a) the C++ write
+    micro-engine (native.bench_setbit: container mutate + 13-byte WAL
+    append per op + snapshot/fsync/rename every MAX_OP_N — the faithful
+    stand-in for fragment.go:369-459 with no Go toolchain here) and
+    (b) Fragment.set_bit in-process; pins the native best in
+    HOST_BASELINE.json and leaves both in benchmarks/WRITEPATH.json for
+    bench.py to stamp into the round artifact."""
+    import tempfile
+
+    from pilosa_tpu.storage import native
+    from pilosa_tpu.storage.fragment import MAX_OP_N, Fragment
+
+    rng = np.random.default_rng(9)
+    n = int(100_000 * SCALE)
+    rows = rng.integers(0, 1000, n).astype(np.uint64)
+    cols = rng.integers(0, 1 << 20, n).astype(np.uint64)
+    pos = (rows << np.uint64(20)) + cols
+
+    native_ops = None
+    if native.available():
+        with tempfile.TemporaryDirectory() as d:
+            t0 = time.perf_counter()
+            native.bench_setbit(os.path.join(d, "frag"), pos, MAX_OP_N)
+            native_ops = n / (time.perf_counter() - t0)
+        emit("host_setbit_native", native_ops, "ops/sec")
+
+    # Same op count as the native leg: amortized snapshot cost grows
+    # with bits set so far, so different run lengths would bias the
+    # published ratio (review finding, round 4).
+    with tempfile.TemporaryDirectory() as d:
+        frag = Fragment(os.path.join(d, "frag"), "bench", "f",
+                        "standard", 0)
+        frag.open()
+        try:
+            t0 = time.perf_counter()
+            for r, c in zip(rows.tolist(), cols.tolist()):
+                frag.set_bit(r, c)
+            frag_ops = n / (time.perf_counter() - t0)
+        finally:
+            frag.close()
+    emit("host_setbit_fragment", frag_ops, "ops/sec")
+
+    # Key carries the op count: snapshot amortization scales with run
+    # length, so a short smoke run must not pin the canonical shape.
+    pinned = (pin_best(f"setbit_native,n={n}", native_ops)
+              if native_ops else None)
+    art = {"setbit_native_ops": round(native_ops, 1) if native_ops else None,
+           "setbit_native_pinned_ops": round(pinned, 1) if pinned else None,
+           "setbit_fragment_ops": round(frag_ops, 1),
+           "setbit_executor_ops": round(setbit_exec, 1),
+           "fragment_vs_native_pinned": (
+               round(pinned / frag_ops, 2) if pinned else None)}
+    emit("write_denominator", art["fragment_vs_native_pinned"] or 0.0,
+         "x_native_over_fragment", **art)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "WRITEPATH.json"), "w") as f:
+        json.dump(art, f, indent=1)
+
+
+def pin_best(name: str, ops_s: float) -> float:
+    """Persist the best-ever (highest ops/s) host-native measurement for
+    ``name`` on this machine; returns the pinned best (monotone, like
+    bench.py's read denominator — one shared writer, benchmarks.pinning)."""
+    import platform
+
+    from benchmarks.pinning import pin
+    return pin(f"{name},host={platform.node()}", "best_ops_s", ops_s,
+               lambda new, old: new > old)
 
 
 def _build_topn_frame(holder, n_rows: int, n_slices: int):

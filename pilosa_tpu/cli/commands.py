@@ -144,6 +144,8 @@ def load_server_config(args, env=None):
         cfg.cluster.internal_port = args.cluster_internal_port
     if getattr(args, "cluster_gossip_seed", ""):
         cfg.cluster.gossip_seed = args.cluster_gossip_seed
+    if getattr(args, "cluster_gossip_secret", ""):
+        cfg.cluster.gossip_secret = args.cluster_gossip_secret
     if getattr(args, "cluster_poll_interval", None) is not None:
         cfg.cluster.polling_interval = args.cluster_poll_interval
     if getattr(args, "anti_entropy_interval", None) is not None:
@@ -181,7 +183,8 @@ def cmd_server(args, stdout, stderr) -> int:
         gossip_set = GossipNodeSet(
             cfg.host, gossip_host=f"{bind_host}:{cfg.cluster.internal_port}",
             seeds=[cfg.cluster.gossip_seed] if cfg.cluster.gossip_seed
-            else [], logger=logger)
+            else [],
+            secret_key=cfg.cluster.gossip_secret or None, logger=logger)
         if cluster is None:
             cluster = Cluster(nodes=[Node(cfg.host)])
         cluster.node_set = gossip_set
@@ -406,6 +409,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cluster membership backend")
     s.add_argument("--cluster.internal-port", dest="cluster_internal_port",
                    default="", help="internal state-sharing (gossip) port")
+    s.add_argument("--cluster.gossip-secret", dest="cluster_gossip_secret",
+                   default="", help="shared HMAC key authenticating gossip"
+                   " frames (unset = unauthenticated)")
     s.add_argument("--cluster.gossip-seed", dest="cluster_gossip_seed",
                    default="", help="host:port to seed gossip membership")
     s.add_argument("--cluster.poll-interval", dest="cluster_poll_interval",

@@ -17,9 +17,19 @@ behavior directly on sockets — a deliberately small SWIM variant:
   (memberlist's TransmitLimitedQueue policy).
 - **TCP** carries sync broadcasts (one frame per connection) and the
   push/pull full-state exchange used for join and periodic anti-entropy.
-- Failure detection: a member that misses ``suspect_after`` consecutive
-  probes is declared dead and the rumor gossips; a node hearing it is dead
-  refutes with a higher incarnation (SWIM's refutation rule).
+- Failure detection (full SWIM, memberlist semantics gossip.go:48-54):
+  a member that misses ``suspect_after`` consecutive direct probes is
+  probed INDIRECTLY through ``indirect_probes`` random relays (ping-req);
+  only if no relay can reach it either is it marked *suspect* — a state
+  gossiped like dead but reversible: the suspect hears the rumor and
+  refutes with a higher incarnation within ``suspect_timeout``, or the
+  window expires and the member is declared dead. An asymmetric or lossy
+  direct path therefore cannot kill a node other peers still reach.
+- Optional shared-key auth: with ``secret_key`` set, every UDP datagram
+  and TCP frame carries an HMAC-SHA256 tag; unauthenticated or tampered
+  frames are dropped before parsing (memberlist encrypts with its
+  SecretKey; this build authenticates, which is the property the
+  membership layer needs — a spoofed packet must not poison the view).
 
 Membership stays a host-side CPU concern in the TPU build — it is
 metadata over DCN; only bitmap reductions ride ICI (parallel.mesh).
@@ -28,12 +38,15 @@ metadata over DCN; only bitmap reductions ride ICI (parallel.mesh).
 from __future__ import annotations
 
 import base64
+import hmac as hmac_mod
+import hashlib
 import json
 import math
 import random
 import socket
 import struct
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -44,7 +57,15 @@ from .topology import Node
 DEFAULT_GOSSIP_PORT = 14000      # reference internal/gossip port default
 
 STATE_ALIVE = "alive"
+STATE_SUSPECT = "suspect"
 STATE_DEAD = "dead"
+
+# Merge precedence at equal incarnation (memberlist: dead beats suspect
+# beats alive; an alive claim only un-suspects with a HIGHER incarnation).
+_STATE_RANK = {STATE_ALIVE: 0, STATE_SUSPECT: 1, STATE_DEAD: 2}
+
+_HMAC_TAG = b"PGS1"  # sealed-frame magic
+_HMAC_LEN = 32
 
 
 @dataclass
@@ -54,6 +75,7 @@ class Member:
     incarnation: int = 0
     state: str = STATE_ALIVE
     fails: int = field(default=0, compare=False)
+    suspect_at: float = field(default=0.0, compare=False)
 
     def to_wire(self) -> dict:
         return {"name": self.name, "addr": self.addr,
@@ -103,7 +125,10 @@ class GossipNodeSet:
                  seeds: Optional[list[str]] = None,
                  probe_interval: float = 1.0, probe_timeout: float = 0.5,
                  push_pull_interval: float = 15.0, suspect_after: int = 3,
-                 retransmit_mult: int = 3, logger=logger_mod.NOP):
+                 retransmit_mult: int = 3, indirect_probes: int = 3,
+                 suspect_timeout: Optional[float] = None,
+                 secret_key: Optional[bytes] = None,
+                 logger=logger_mod.NOP):
         self.host = host
         self.logger = logger
         self.gossip_host = gossip_host or f"localhost:{DEFAULT_GOSSIP_PORT}"
@@ -113,6 +138,14 @@ class GossipNodeSet:
         self.push_pull_interval = push_pull_interval
         self.suspect_after = suspect_after
         self.retransmit_mult = retransmit_mult
+        self.indirect_probes = indirect_probes
+        # Refutation window before a suspect is declared dead
+        # (memberlist's SuspicionMult scaled to the probe cadence).
+        self.suspect_timeout = (suspect_timeout if suspect_timeout
+                                is not None else 4.0 * probe_interval)
+        if isinstance(secret_key, str):
+            secret_key = secret_key.encode()
+        self.secret_key = secret_key
 
         self._handler = None          # server: BroadcastHandler+StatusHandler
         self._mu = threading.Lock()
@@ -123,6 +156,8 @@ class GossipNodeSet:
         self._bcast_n = 0
         self._seq = 0
         self._acks: dict[int, threading.Event] = {}
+        # ping-req relays in flight: our relay seq -> (origin addr, origin seq)
+        self._relays: dict[int, tuple[str, int]] = {}
         self._udp: Optional[socket.socket] = None
         self._tcp: Optional[socket.socket] = None
         self._send_pool = None          # lazy bounded sync-send pool
@@ -207,10 +242,13 @@ class GossipNodeSet:
                     pass
 
     def nodes(self) -> list[Node]:
+        # Suspect members are still cluster members (memberlist keeps
+        # them in the node list until the refutation window confirms
+        # death) — dropping them early would reshard slices on a blip.
         with self._mu:
             return [Node(m.name) for m in
                     sorted(self._members.values(), key=lambda m: m.name)
-                    if m.state == STATE_ALIVE]
+                    if m.state != STATE_DEAD]
 
     def join(self, nodes) -> None:  # parity with StaticNodeSet
         for n in nodes:
@@ -284,9 +322,11 @@ class GossipNodeSet:
     # -- membership internals ------------------------------------------------
 
     def _alive_peers(self) -> list[Member]:
+        """Broadcast/gossip fan-out targets: every non-dead peer
+        (suspects still receive traffic — they are probably alive)."""
         with self._mu:
             return [m for m in self._members.values()
-                    if m.state == STATE_ALIVE and m.name != self.host]
+                    if m.state != STATE_DEAD and m.name != self.host]
 
     def _merge_member(self, w: Member) -> None:
         """SWIM merge rule: higher incarnation wins; on a tie, dead beats
@@ -297,25 +337,35 @@ class GossipNodeSet:
         with self._mu:
             cur = self._members.get(w.name)
             if w.name == self.host:
+                # Refute ANY non-alive rumor about ourselves (suspect or
+                # dead) with a bumped incarnation — the SWIM refutation
+                # that closes a suspect's window (gossip.go:48-54).
                 me = self._members[self.host]
-                if w.state == STATE_DEAD and w.incarnation >= me.incarnation:
-                    me.incarnation = w.incarnation + 1  # refute
+                if (w.state in (STATE_DEAD, STATE_SUSPECT)
+                        and w.incarnation >= me.incarnation):
+                    me.incarnation = w.incarnation + 1
                     deliver_update = True
-                    log_line = ("gossip: refuting death rumor about self"
-                                f" (inc={me.incarnation})")
+                    log_line = (f"gossip: refuting {w.state} rumor about"
+                                f" self (inc={me.incarnation})")
             elif cur is None:
-                self._members[w.name] = Member(w.name, w.addr,
-                                               w.incarnation, w.state)
+                self._members[w.name] = m = Member(w.name, w.addr,
+                                                   w.incarnation, w.state)
+                if m.state == STATE_SUSPECT:
+                    m.suspect_at = time.monotonic()
                 deliver_update = True
                 log_line = (f"gossip: member joined: {w.name} ({w.addr})"
                             f" state={w.state}")
             elif (w.incarnation > cur.incarnation
                   or (w.incarnation == cur.incarnation
-                      and w.state == STATE_DEAD
-                      and cur.state != STATE_DEAD)):
+                      and _STATE_RANK[w.state]
+                      > _STATE_RANK[cur.state])):
+                # dead > suspect > alive at equal incarnation; an alive
+                # claim needs a HIGHER incarnation to clear suspicion.
                 if cur.state != w.state:
                     log_line = (f"gossip: member {w.name} {cur.state}"
                                 f" -> {w.state} (inc={w.incarnation})")
+                if w.state == STATE_SUSPECT and cur.state != STATE_SUSPECT:
+                    cur.suspect_at = time.monotonic()
                 cur.incarnation = w.incarnation
                 cur.state = w.state
                 cur.addr = w.addr
@@ -337,6 +387,33 @@ class GossipNodeSet:
         peers = self._alive_peers()
         for peer in random.sample(peers, min(3, len(peers))):
             self._udp_send(peer.addr, pkt)
+
+    # -- frame auth ----------------------------------------------------------
+
+    def _seal(self, payload: bytes) -> bytes:
+        """Tag a frame with HMAC-SHA256 when a secret key is set."""
+        if self.secret_key is None:
+            return payload
+        mac = hmac_mod.new(self.secret_key, payload,
+                           hashlib.sha256).digest()
+        return _HMAC_TAG + mac + payload
+
+    def _open_sealed(self, data: bytes) -> Optional[bytes]:
+        """Verify + strip the HMAC tag; None = drop the frame. With a
+        key configured, untagged or bad-MAC frames never reach the
+        parser (the spoofed-datagram hole in round 3's SWIM-lite)."""
+        if self.secret_key is None:
+            return data
+        if (len(data) < len(_HMAC_TAG) + _HMAC_LEN
+                or not data.startswith(_HMAC_TAG)):
+            return None
+        mac = data[len(_HMAC_TAG):len(_HMAC_TAG) + _HMAC_LEN]
+        payload = data[len(_HMAC_TAG) + _HMAC_LEN:]
+        want = hmac_mod.new(self.secret_key, payload,
+                            hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(mac, want):
+            return None
+        return payload
 
     # -- packet plumbing -----------------------------------------------------
 
@@ -361,7 +438,8 @@ class GossipNodeSet:
 
     def _udp_send(self, addr: str, pkt: dict) -> None:
         try:
-            self._udp.sendto(json.dumps(pkt).encode(), _split_addr(addr))
+            self._udp.sendto(self._seal(json.dumps(pkt).encode()),
+                             _split_addr(addr))
         except OSError:
             pass
 
@@ -372,15 +450,38 @@ class GossipNodeSet:
             except OSError:
                 return
             try:
+                buf = self._open_sealed(buf)
+                if buf is None:
+                    continue  # unauthenticated/tampered: drop pre-parse
                 pkt = json.loads(buf.decode())
                 self._absorb(pkt)
-                if pkt.get("t") == "ping":
+                typ = pkt.get("t")
+                if typ == "ping":
                     self._udp_send("%s:%d" % src,
                                    self._packet("ack", seq=pkt.get("seq", 0)))
-                elif pkt.get("t") == "ack":
-                    ev = self._acks.get(pkt.get("seq", -1))
+                elif typ == "pingreq":
+                    # Relay an indirect probe: ping the target with our
+                    # own seq; the eventual ack maps back to the origin.
+                    target = pkt.get("target", "")
+                    origin = pkt.get("origin") or "%s:%d" % src
+                    with self._mu:
+                        self._seq += 1
+                        relay_seq = self._seq
+                        self._relays[relay_seq] = (origin,
+                                                   int(pkt.get("seq", 0)))
+                        while len(self._relays) > 1024:
+                            self._relays.pop(next(iter(self._relays)))
+                    self._udp_send(target,
+                                   self._packet("ping", seq=relay_seq))
+                elif typ == "ack":
+                    seq = pkt.get("seq", -1)
+                    ev = self._acks.get(seq)
                     if ev is not None:
                         ev.set()
+                    relay = self._relays.pop(seq, None)
+                    if relay is not None:  # forward to the ping-req origin
+                        self._udp_send(relay[0],
+                                       self._packet("ack", seq=relay[1]))
             except Exception:  # noqa: BLE001 - a bad packet must not kill IO
                 continue
 
@@ -432,16 +533,19 @@ class GossipNodeSet:
         try:
             with conn:
                 conn.settimeout(10.0)
-                req = json.loads(_recv_frame(conn).decode())
+                raw = self._open_sealed(_recv_frame(conn))
+                if raw is None:
+                    return  # unauthenticated frame: drop
+                req = json.loads(raw.decode())
                 if req.get("t") == "bcast":
                     # Sync sends are point-to-point: deliver directly,
                     # no gossip relay and no dedup (gossip.go:124-149).
                     self._handle_envelope(base64.b64decode(req["data"]))
-                    _send_frame(conn, b'{"t":"ok"}')
+                    _send_frame(conn, self._seal(b'{"t":"ok"}'))
                 elif req.get("t") == "pushpull":
                     self._absorb_state(req)
-                    _send_frame(conn,
-                                json.dumps(self._local_state()).encode())
+                    _send_frame(conn, self._seal(
+                        json.dumps(self._local_state()).encode()))
         except (OSError, ValueError, ConnectionError, KeyError):
             pass
 
@@ -449,8 +553,11 @@ class GossipNodeSet:
                      timeout: float = 10.0) -> dict:
         with socket.create_connection(_split_addr(addr),
                                       timeout=timeout) as conn:
-            _send_frame(conn, json.dumps(req).encode())
-            return json.loads(_recv_frame(conn).decode())
+            _send_frame(conn, self._seal(json.dumps(req).encode()))
+            raw = self._open_sealed(_recv_frame(conn))
+            if raw is None:
+                raise ConnectionError("unauthenticated gossip response")
+            return json.loads(raw.decode())
 
     def _local_state(self) -> dict:
         """Full state for push/pull: membership + the protobuf
@@ -508,37 +615,94 @@ class GossipNodeSet:
 
     def _probe_loop(self) -> None:
         while not self._closing.wait(self.probe_interval):
-            peers = self._alive_peers()
+            self._expire_suspects()
+            peers = self._probe_targets()
             if not peers:
                 continue
             self._probe(random.choice(peers))
 
-    def _probe(self, peer: Member) -> None:
+    def _probe_targets(self) -> list[Member]:
+        with self._mu:
+            return [m for m in self._members.values()
+                    if m.state in (STATE_ALIVE, STATE_SUSPECT)
+                    and m.name != self.host]
+
+    def _ping(self, addr: str) -> bool:
+        """One direct ping/ack round trip."""
         with self._mu:
             self._seq += 1
             seq = self._seq
             ev = self._acks[seq] = threading.Event()
-        self._udp_send(peer.addr, self._packet("ping", seq=seq))
+        self._udp_send(addr, self._packet("ping", seq=seq))
         ok = ev.wait(self.probe_timeout)
         self._acks.pop(seq, None)
-        dead = None
+        return ok
+
+    def _ping_indirect(self, peer: Member) -> bool:
+        """SWIM ping-req: ask k random other peers to probe ``peer``
+        and relay the ack — a lossy/asymmetric direct path must not
+        condemn a node the rest of the cluster reaches fine
+        (memberlist's IndirectChecks, gossip.go:48-54)."""
+        relays = [m for m in self._probe_targets()
+                  if m.name != peer.name and m.state == STATE_ALIVE]
+        if not relays or self.indirect_probes <= 0:
+            return False
+        relays = random.sample(relays,
+                               min(self.indirect_probes, len(relays)))
+        with self._mu:
+            self._seq += 1
+            seq = self._seq
+            ev = self._acks[seq] = threading.Event()
+        pkt = self._packet("pingreq", seq=seq, target=peer.addr,
+                           origin=self.gossip_host)
+        for r in relays:
+            self._udp_send(r.addr, pkt)
+        # Relays each pay one probe_timeout; allow one extra hop's worth.
+        ok = ev.wait(2.0 * self.probe_timeout)
+        self._acks.pop(seq, None)
+        return ok
+
+    def _probe(self, peer: Member) -> None:
+        ok = self._ping(peer.addr)
+        if not ok:
+            ok = self._ping_indirect(peer)
+        suspect = None
         with self._mu:
             cur = self._members.get(peer.name)
-            if cur is None or cur.state != STATE_ALIVE:
+            if cur is None or cur.state == STATE_DEAD:
                 return
             if ok:
                 cur.fails = 0
                 return
             cur.fails += 1
-            if cur.fails >= self.suspect_after:
-                cur.state = STATE_DEAD
-                dead = Member(cur.name, cur.addr, cur.incarnation,
-                              STATE_DEAD)
-        if dead is not None:
+            if (cur.state == STATE_ALIVE
+                    and cur.fails >= self.suspect_after):
+                cur.state = STATE_SUSPECT
+                cur.suspect_at = time.monotonic()
+                suspect = Member(cur.name, cur.addr, cur.incarnation,
+                                 STATE_SUSPECT)
+        if suspect is not None:
             self.logger.printf(
-                "gossip: node %s missed %d probes, declaring dead",
-                dead.name, self.suspect_after)
-            self._gossip_update(dead)
+                "gossip: node %s missed %d direct+indirect probes,"
+                " marking suspect", suspect.name, self.suspect_after)
+            self._gossip_update(suspect)
+
+    def _expire_suspects(self) -> None:
+        """Suspects whose refutation window lapsed are declared dead."""
+        now = time.monotonic()
+        dead = []
+        with self._mu:
+            for m in self._members.values():
+                if (m.state == STATE_SUSPECT
+                        and now - m.suspect_at > self.suspect_timeout):
+                    m.state = STATE_DEAD
+                    dead.append(Member(m.name, m.addr, m.incarnation,
+                                       STATE_DEAD))
+        for d in dead:
+            self.logger.printf(
+                "gossip: suspect %s not refuted in %.1fs, declaring"
+                " dead", d.name, self.suspect_timeout)
+            self._gossip_update(d)
 
 
 def _b64(data: bytes) -> str:

@@ -51,6 +51,23 @@ uint64_t popcnt(const uint64_t* a, int64_t n) {
 // Standard two-pointer merges; out must have room for the worst case
 // (min(na,nb) for intersect, na+nb for union, na for difference).
 
+// Copy-insert v into sorted a[0..n) -> out[0..n+1); returns new length,
+// or -1 when v is already present (out untouched). One call replaces a
+// searchsorted + three slice copies on the Python write hot path.
+int64_t insert_sorted_u32(const uint32_t* a, int64_t n, uint32_t v,
+                          uint32_t* out) {
+    int64_t lo = 0, hi = n;
+    while (lo < hi) {
+        int64_t mid = (lo + hi) / 2;
+        if (a[mid] < v) lo = mid + 1; else hi = mid;
+    }
+    if (lo < n && a[lo] == v) return -1;
+    memcpy(out, a, lo * 4);
+    out[lo] = v;
+    memcpy(out + lo + 1, a + lo, (n - lo) * 4);
+    return n + 1;
+}
+
 int64_t intersect_sorted_u32(const uint32_t* a, int64_t na,
                              const uint32_t* b, int64_t nb, uint32_t* out) {
     int64_t i = 0, j = 0, k = 0;
@@ -130,3 +147,133 @@ int64_t unpack_words_u32(const uint32_t* words, int64_t n_words,
 }
 
 }  // extern "C"
+
+// ---- native write-path micro-engine ----------------------------------------
+// The measured host denominator for the SetBit path (the reference's is
+// fragment.go:369-459 driven by ctl/bench.go:71-102; no Go toolchain in
+// this image, so this is the C++ stand-in, as popcnt_and is for reads).
+// Faithful shape: per op — locate the container (pos>>16), sorted-array
+// insert or bitmap set with array->bitmap conversion at 4096, append a
+// 13-byte op record to the data file with one unbuffered write(), and
+// after every max_op_n ops rewrite a snapshot of all containers to a
+// temp file, fsync, and rename over the data file (the same durability
+// cadence the Python fragment and the reference both pay).
+
+#include <cstdio>
+#include <cstdlib>
+#include <fcntl.h>
+#include <unistd.h>
+
+namespace {
+
+struct WContainer {
+    uint16_t* array;     // sorted u16 values, or null when bitmap
+    uint64_t* bitmap;    // u64[1024], or null when array
+    int32_t n;
+    int32_t cap;
+};
+
+const int32_t kArrayMax = 4096;
+const int32_t kBitmapWords = 1024;
+
+bool wcontainer_add(WContainer* c, uint16_t v) {
+    if (c->bitmap) {
+        uint64_t bit = 1ULL << (v & 63);
+        if (c->bitmap[v >> 6] & bit) return false;
+        c->bitmap[v >> 6] |= bit;
+        c->n++;
+        return true;
+    }
+    // binary search
+    int32_t lo = 0, hi = c->n;
+    while (lo < hi) {
+        int32_t mid = (lo + hi) / 2;
+        if (c->array[mid] < v) lo = mid + 1; else hi = mid;
+    }
+    if (lo < c->n && c->array[lo] == v) return false;
+    if (c->n + 1 > kArrayMax) {  // convert then set
+        uint64_t* bm = (uint64_t*)calloc(kBitmapWords, 8);
+        for (int32_t i = 0; i < c->n; i++)
+            bm[c->array[i] >> 6] |= 1ULL << (c->array[i] & 63);
+        free(c->array);
+        c->array = nullptr;
+        c->bitmap = bm;
+        return wcontainer_add(c, v);
+    }
+    if (c->n == c->cap) {
+        c->cap = c->cap ? c->cap * 2 : 8;
+        c->array = (uint16_t*)realloc(c->array, c->cap * 2);
+    }
+    memmove(c->array + lo + 1, c->array + lo, (c->n - lo) * 2);
+    c->array[lo] = v;
+    c->n++;
+    return true;
+}
+
+}  // namespace
+
+// Runs n_ops SetBit ops (64-bit fragment positions) against a data file
+// at `path` with WAL append per op and a snapshot rewrite every
+// max_op_n ops. Returns ops actually changed (idempotent re-sets don't
+// append), or -1 on IO error. Elapsed time is the caller's job.
+extern "C" int64_t bench_setbit(const char* path, const uint64_t* positions,
+                     int64_t n_ops, int64_t max_op_n) {
+    int64_t max_key = 0;
+    for (int64_t i = 0; i < n_ops; i++)
+        if ((int64_t)(positions[i] >> 16) > max_key)
+            max_key = positions[i] >> 16;
+    WContainer* conts = (WContainer*)calloc(max_key + 1,
+                                            sizeof(WContainer));
+    int fd = open(path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+    if (fd < 0) { free(conts); return -1; }
+
+    unsigned char rec[13];
+    int64_t changed = 0, op_n = 0;
+    char tmp_path[4096];
+    snprintf(tmp_path, sizeof tmp_path, "%s.snapshotting", path);
+
+    for (int64_t i = 0; i < n_ops; i++) {
+        uint64_t pos = positions[i];
+        WContainer* c = &conts[pos >> 16];
+        if (!wcontainer_add(c, (uint16_t)(pos & 0xFFFF))) continue;
+        changed++;
+        // 13-byte op record: type(1) + value(8) + checksum(4) — the
+        // same record size the storage WAL appends per mutation.
+        rec[0] = 0;
+        memcpy(rec + 1, &pos, 8);
+        uint32_t sum = (uint32_t)(pos ^ (pos >> 32)) * 2654435761u;
+        memcpy(rec + 9, &sum, 4);
+        if (write(fd, rec, 13) != 13) { close(fd); free(conts); return -1; }
+        if (++op_n > max_op_n) {
+            // snapshot: rewrite every live container, fsync, rename.
+            int sfd = open(tmp_path, O_WRONLY | O_CREAT | O_TRUNC, 0644);
+            if (sfd < 0) { close(fd); free(conts); return -1; }
+            for (int64_t k = 0; k <= max_key; k++) {
+                WContainer* cc = &conts[k];
+                if (cc->n == 0) continue;
+                if (cc->bitmap) {
+                    if (write(sfd, cc->bitmap, kBitmapWords * 8) < 0)
+                        { close(sfd); close(fd); free(conts); return -1; }
+                } else {
+                    if (write(sfd, cc->array, cc->n * 2) < 0)
+                        { close(sfd); close(fd); free(conts); return -1; }
+                }
+            }
+            fsync(sfd);
+            close(sfd);
+            if (rename(tmp_path, path) != 0)
+                { close(fd); free(conts); return -1; }
+            close(fd);
+            fd = open(path, O_WRONLY | O_APPEND, 0644);
+            if (fd < 0) { free(conts); return -1; }
+            op_n = 0;
+        }
+    }
+    close(fd);
+    for (int64_t k = 0; k <= max_key; k++) {
+        free(conts[k].array);
+        free(conts[k].bitmap);
+    }
+    free(conts);
+    return changed;
+}

@@ -97,3 +97,73 @@ def unpack_to_bitmap(words: np.ndarray, base_word: int = 0) -> Bitmap:
     if base_word:
         pos = pos + np.uint64(base_word * WORD_BITS)
     return Bitmap.from_sorted(pos)
+
+
+def sparse_words(b: Bitmap, n_words: int, base_word: int = 0
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """Sparse word form of a roaring bitmap: (sorted unique i32 word
+    indices, u32 word values) — the upload payload of the device
+    densify kernel (ops.pallas_kernels.densify_pallas). Bounded by SET
+    words (= on-disk density), not row width: bitmap containers list
+    their nonzero u32 words directly, array containers group positions
+    by word with one reduceat. Positions relative to ``base_word*32``;
+    words outside [0, n_words) are dropped."""
+    idx_parts: list[np.ndarray] = []
+    val_parts: list[np.ndarray] = []
+    for key, c in zip(b.keys, b.containers):
+        if c.n == 0:
+            continue
+        word0 = key * _WORDS_PER_CONTAINER - base_word
+        if word0 >= n_words or word0 + _WORDS_PER_CONTAINER <= 0:
+            continue
+        if not c.is_array():
+            view = c.bitmap.view("<u4")
+            nz = np.flatnonzero(view)
+            widx = word0 + nz.astype(np.int64)
+            keep = (widx >= 0) & (widx < n_words)
+            idx_parts.append(widx[keep].astype(np.int32))
+            val_parts.append(view[nz[keep]])
+        else:
+            a = c.array
+            widx = word0 + (a >> np.uint32(5)).astype(np.int64)
+            keep = (widx >= 0) & (widx < n_words)
+            widx, a = widx[keep], a[keep]
+            if not len(widx):
+                continue
+            bits = np.uint32(1) << (a & np.uint32(31))
+            # positions are sorted, so equal word indices are adjacent:
+            # one reduceat ORs each word's bits together.
+            starts = np.concatenate(
+                ([0], np.flatnonzero(np.diff(widx)) + 1))
+            idx_parts.append(widx[starts].astype(np.int32))
+            val_parts.append(np.bitwise_or.reduceat(bits, starts))
+    if not idx_parts:
+        return (np.empty(0, np.int32), np.empty(0, np.uint32))
+    return np.concatenate(idx_parts), np.concatenate(val_parts)
+
+
+def sparse_row_words(storage: Bitmap, row_id: int
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """sparse_words for one fragment row (pos = row*SLICE_WIDTH + col)."""
+    row_bm = storage.offset_range(0, row_id * SLICE_WIDTH,
+                                  (row_id + 1) * SLICE_WIDTH)
+    return sparse_words(row_bm, WORDS_PER_SLICE)
+
+
+def sparse_rows(storage: Bitmap, row_ids, pad_to: int | None = None
+                ) -> tuple[np.ndarray, np.ndarray]:
+    """Padded sparse form of a row block: ``([n, P] i32 idx, [n, P] u32
+    val)`` with ``val == 0`` padding (a densify no-op). ``P`` is the max
+    set-word count over the rows, rounded up to ``pad_to`` granularity
+    (shape-bucketing keeps the device kernel's compile cache small)."""
+    rows = [sparse_row_words(storage, r) for r in row_ids]
+    p = max((len(i) for i, _ in rows), default=0)
+    if pad_to:
+        p = max(pad_to, -(-p // pad_to) * pad_to)
+    p = max(p, 1)
+    idx = np.zeros((len(rows), p), dtype=np.int32)
+    val = np.zeros((len(rows), p), dtype=np.uint32)
+    for n, (i, v) in enumerate(rows):
+        idx[n, :len(i)] = i
+        val[n, :len(v)] = v
+    return idx, val

@@ -36,20 +36,33 @@ def platform_of(a: jax.Array) -> str:
 
 
 def pallas_mode(platform: str) -> str | None:
-    """How the serving path should run Pallas kernels on ``platform``.
+    """How the serving path should run these Pallas kernels on
+    ``platform``.
 
-    Returns "compiled" (real TPU), "interpret" (forced via
-    PILOSA_TPU_PALLAS=interpret — CPU tests exercising the kernel
-    path), or None (XLA fusion path). PILOSA_TPU_PALLAS=0 disables
-    Pallas everywhere — the A/B switch for benchmarks/suite.py.
+    DEFAULT IS XLA (returns None): the round-4 kernel-level A/B at the
+    literal BASELINE shapes (benchmarks/PALLAS_AB.json) measured XLA
+    fusion equal-or-faster on 5 of 6 serving shapes — 1.23x at the
+    1 B-bit metric-of-record shape, 3.7x on a single long row, ~1.5x on
+    TopN candidate blocks; the single Pallas "win" was 0.96x (noise).
+    These kernels remain available as an explicit experiment
+    (PILOSA_TPU_PALLAS=1|force → compiled on TPU) and as a correctness
+    harness (=interpret, used by CPU tests), matching the reference's
+    rule of dispatching to its asm path only when CPUID proves it pays
+    (roaring/assembly_asm.go:15,40-80). The sparse-upload densify
+    kernel (densify_pallas) is NOT gated here — scatter is XLA's known
+    TPU weak spot, so the sparse-upload path selects it independently
+    (see parallel.residency's sparse block builds).
     """
     import os
-    v = os.environ.get("PILOSA_TPU_PALLAS", "auto")
-    if v == "0":
-        return None
+    v = os.environ.get("PILOSA_TPU_PALLAS", "xla")
+    if v in ("1", "force", "auto"):
+        # "auto" kept for round-3 compatibility: it now means "let the
+        # recorded A/B decide", and the A/B said XLA — but an explicit
+        # opt-in should still get the Pallas path on real TPU.
+        return "compiled" if platform == "tpu" and v != "auto" else None
     if v == "interpret":
         return "interpret"
-    return "compiled" if platform == "tpu" else None
+    return None
 
 
 def _count_kernel(op_name, a_ref, b_ref, out_ref):
@@ -239,3 +252,50 @@ def op_count_rows_pallas(op: str, a: jax.Array, b: jax.Array,
     out = _op_count_padded(op, a, b, interpret)
     out = out[:rows]
     return out[0] if squeeze else out
+
+
+# -- sparse densify: the cold-path upload killer ---------------------------
+#
+# First queries used to ship DENSE words through the ~1.1 GB/s tunnel
+# (128 KB per slice row regardless of density). The sparse path ships
+# (word index, word value) pairs — bounded by set words, typically
+# 25-1000x smaller — and densifies ON DEVICE with this kernel: per
+# output row tile, zero the 32768-word VMEM block and OR each pair in.
+# XLA's scatter lowering made this a loss (benchmarks/RESULTS.md
+# negative result #2: 14.6 s sparse vs 3.1 s dense for a 256 MB block);
+# the Pallas loop writes VMEM directly. This is the device analogue of
+# the reference materializing a row in O(containers), not O(row width)
+# (roaring.go:253-285).
+
+def _densify_kernel(idx_ref, val_ref, out_ref):
+    out_ref[:] = jnp.zeros_like(out_ref)
+
+    def body(j, carry):
+        k = idx_ref[0, j]
+        out_ref[0, k] |= val_ref[0, j]
+        return carry
+
+    jax.lax.fori_loop(0, idx_ref.shape[1], body, 0, unroll=8)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def densify_pallas(idx: jax.Array, val: jax.Array, n_words: int,
+                   interpret: bool = False) -> jax.Array:
+    """``[T, P]`` i32 word indices + u32 word values (``val == 0``
+    padding entries are OR no-ops) → ``[T, n_words]`` u32 dense rows.
+
+    Each grid step owns one output row: indices must lie in
+    ``[0, n_words)``; duplicate indices OR together (callers pre-OR
+    duplicates host-side, ops.packed.sparse_row_words)."""
+    t_rows, _ = idx.shape
+    return pl.pallas_call(
+        _densify_kernel,
+        out_shape=jax.ShapeDtypeStruct((t_rows, n_words), jnp.uint32),
+        grid=(t_rows,),
+        in_specs=[
+            pl.BlockSpec((1, idx.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((1, val.shape[1]), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, n_words), lambda i: (i, 0)),
+        interpret=interpret,
+    )(idx, val)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import bisect
 import io
+import struct
 from typing import Callable, Iterator as TIterator, Optional
 
 import numpy as np
@@ -130,21 +131,29 @@ class Container:
     # -- point ops
 
     def add(self, v: int) -> bool:
+        # The single-op write hot path: manual copy-insert instead of
+        # np.insert (which is a Python-level helper costing ~15 us per
+        # call), and plain Python ints on the bitmap branch (numpy
+        # scalar ops pay ~2 us each). Building a fresh array also
+        # detaches from a mapped buffer, so no _unmap() copy on the
+        # array branch.
         if self.bitmap is None:
             a = self.array
-            i = int(np.searchsorted(a, v))
-            if i < len(a) and a[i] == v:
+            grown = np.empty(len(a) + 1, dtype=np.uint32)
+            if native.insert_sorted_u32_into(a, v, grown) < 0:
                 return False
-            self._unmap()
-            self.array = np.insert(self.array, i, np.uint32(v))
+            self.array = grown
+            self.mapped = False
             self.n += 1
             self._maybe_convert()
             return True
-        w, b = v >> 6, np.uint64(1) << np.uint64(v & 63)
-        if self.bitmap[w] & b:
+        w = v >> 6
+        word = int(self.bitmap[w])
+        bit = 1 << (v & 63)
+        if word & bit:
             return False
         self._unmap()
-        self.bitmap[w] |= b
+        self.bitmap[w] = word | bit
         self.n += 1
         return True
 
@@ -344,6 +353,9 @@ def _xor(a: Container, b: Container) -> Container:
 # --- op-log ------------------------------------------------------------------
 
 
+_OP_BODY = struct.Struct("<BQ")  # op type + u64 value (13-byte record w/ checksum)
+
+
 class Op:
     """One op-log record (roaring.go:1560-1626)."""
 
@@ -354,7 +366,7 @@ class Op:
         self.value = value
 
     def marshal(self) -> bytes:
-        body = bytes([self.typ]) + int(self.value).to_bytes(8, "little")
+        body = _OP_BODY.pack(self.typ, self.value)
         return body + fnv1a32(body).to_bytes(4, "little")
 
     @staticmethod
@@ -766,30 +778,31 @@ class Bitmap:
             c._maybe_convert()
         live = [(k, c) for k, c in zip(self.keys, self.containers) if c.n > 0]
         n_cont = len(live)
-        header = bytearray(HEADER_SIZE + n_cont * 12 + n_cont * 4)
-        header[0:4] = COOKIE.to_bytes(4, "little")
-        header[4:8] = n_cont.to_bytes(4, "little")
-        pos = HEADER_SIZE
-        for key, c in live:
-            header[pos:pos + 8] = int(key).to_bytes(8, "little")
-            header[pos + 8:pos + 12] = (c.n - 1).to_bytes(4, "little")
-            pos += 12
-        offset = len(header)
-        for key, c in live:
-            header[pos:pos + 4] = offset.to_bytes(4, "little")
-            pos += 4
-            offset += c.size_bytes()
-        written = 0
-        w.write(bytes(header))
-        written += len(header)
-        for _, c in live:
-            if c.is_array():
-                blob = np.ascontiguousarray(c.array, dtype="<u4").tobytes()
-            else:
-                blob = np.ascontiguousarray(c.bitmap, dtype="<u8").tobytes()
-            w.write(blob)
-            written += len(blob)
-        return written
+        # Header via numpy, payload via one join + one write: a snapshot
+        # used to issue one write() per container (16 K syscalls for a
+        # 200 K-bit fragment) and pack headers int-by-int — together
+        # most of the snapshot cost on the write path's MAX_OP_N cadence.
+        hdr = np.empty(n_cont, dtype=np.dtype([("key", "<u8"),
+                                               ("n", "<u4")]))
+        hdr["key"] = np.fromiter((k for k, _ in live), np.uint64, n_cont)
+        ns = np.fromiter((c.n for _, c in live), np.uint32, n_cont)
+        hdr["n"] = ns - 1
+        sizes = np.where(ns <= ARRAY_MAX_SIZE, ns * 4, BITMAP_N * 8)
+        data_start = HEADER_SIZE + n_cont * 12 + n_cont * 4
+        offsets = data_start + np.concatenate(
+            ([0], np.cumsum(sizes[:-1], dtype=np.int64))) \
+            if n_cont else np.empty(0, np.int64)
+        parts = [COOKIE.to_bytes(4, "little"),
+                 n_cont.to_bytes(4, "little"),
+                 hdr.tobytes(), offsets.astype("<u4").tobytes()]
+        parts += [(np.ascontiguousarray(c.array, dtype="<u4")
+                   if c.is_array()
+                   else np.ascontiguousarray(c.bitmap, dtype="<u8"))
+                  .tobytes()
+                  for _, c in live]
+        blob = b"".join(parts)
+        w.write(blob)
+        return len(blob)
 
     def marshal(self) -> bytes:
         buf = io.BytesIO()

@@ -48,6 +48,7 @@ class ClusterConfig:
     polling_interval: float = DEFAULT_POLLING_INTERVAL
     internal_port: str = DEFAULT_INTERNAL_PORT  # gossip bind port
     gossip_seed: str = ""                       # seed "host:port" to join
+    gossip_secret: str = ""                     # HMAC key for gossip frames
 
 
 @dataclass
@@ -77,6 +78,7 @@ internal-hosts = [{internal}]
 polling-interval = "{int(self.cluster.polling_interval)}s"
 internal-port = "{self.cluster.internal_port}"
 gossip-seed = "{self.cluster.gossip_seed}"
+gossip-secret = "{self.cluster.gossip_secret}"
 
 [plugins]
 path = "{self.plugins_path}"
@@ -109,6 +111,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
                                                cfg.cluster.internal_port))
         cfg.cluster.gossip_seed = cl.get("gossip-seed",
                                          cfg.cluster.gossip_seed)
+        cfg.cluster.gossip_secret = cl.get("gossip-secret",
+                                           cfg.cluster.gossip_secret)
         ae = data.get("anti-entropy", {})
         if "interval" in ae:
             cfg.anti_entropy_interval = parse_duration(ae["interval"])
@@ -131,6 +135,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
         cfg.cluster.internal_port = env["PILOSA_CLUSTER_INTERNAL_PORT"]
     if env.get("PILOSA_CLUSTER_GOSSIP_SEED"):
         cfg.cluster.gossip_seed = env["PILOSA_CLUSTER_GOSSIP_SEED"]
+    if env.get("PILOSA_CLUSTER_GOSSIP_SECRET"):
+        cfg.cluster.gossip_secret = env["PILOSA_CLUSTER_GOSSIP_SECRET"]
     if env.get("PILOSA_CLUSTER_INTERNAL_HOSTS"):
         cfg.cluster.internal_hosts = [
             h.strip() for h in

@@ -200,3 +200,121 @@ def test_dead_node_revival_after_partition_heal():
             b2.close()
     finally:
         a.close()
+
+
+def test_asymmetric_direct_loss_does_not_kill():
+    """SWIM indirect probes: with ONLY the direct A->B ping path cut,
+    relay C still reaches B, so A must keep B alive indefinitely; when
+    the indirect path is cut too, B becomes suspect and then dead."""
+    from pilosa_tpu.cluster.gossip import STATE_ALIVE
+    a, _ = make_node("hostA:10101", suspect_after=1,
+                     suspect_timeout=0.6)
+    b, _ = make_node("hostB:10101", seeds=[a.gossip_host],
+                     suspect_after=1)
+    c, _ = make_node("hostC:10101", seeds=[a.gossip_host],
+                     suspect_after=1)
+    try:
+        assert wait_until(lambda: len(a.nodes()) == 3
+                          and len(c.nodes()) == 3)
+        # Cut ONLY A's direct pings to B (pingreq to C still flows,
+        # C's relayed ping to B is its own socket — unaffected).
+        orig_send = a._udp_send
+        b_addr = b.gossip_host
+
+        def lossy_send(addr, pkt, _orig=orig_send):
+            if addr == b_addr and pkt.get("t") == "ping":
+                return  # drop
+            _orig(addr, pkt)
+
+        a._udp_send = lossy_send
+        # Many probe rounds at 0.1s cadence: B must stay a member of A's
+        # view the whole time (indirect acks through C).
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline:
+            assert len(a.nodes()) == 3, "B was condemned despite relays"
+            time.sleep(0.05)
+        assert a._member_snapshot("hostB:10101").state == STATE_ALIVE
+        # Sanity check of the whole suspect lifecycle: when B actually
+        # dies (no process left to refute), A's suspicion must expire
+        # into death — even with A's direct path still lossy.
+        b.close()
+        assert wait_until(
+            lambda: "hostB:10101" not in [n.host for n in a.nodes()],
+            timeout=10.0)
+    finally:
+        for ns in (a, b, c):
+            ns.close()
+
+
+def test_suspect_refuted_before_window_expires():
+    """A suspect rumor reaching the victim is refuted with a bumped
+    incarnation and the accuser returns it to alive (no death)."""
+    from pilosa_tpu.cluster.gossip import Member, STATE_SUSPECT
+    a, _ = make_node("hostA:10101", suspect_timeout=30.0)
+    b, _ = make_node("hostB:10101", seeds=[a.gossip_host],
+                     suspect_timeout=30.0)
+    try:
+        assert wait_until(lambda: len(a.nodes()) == 2)
+        inc = a._member_snapshot("hostB:10101").incarnation
+        a._merge_member(Member("hostB:10101", b.gossip_host, inc,
+                               STATE_SUSPECT))
+        # Still a member while suspect (memberlist semantics)...
+        assert len(a.nodes()) == 2
+        # ...and the refutation (via rumor/push-pull) bumps it back.
+        assert wait_until(
+            lambda: a._member_snapshot("hostB:10101").incarnation > inc,
+            timeout=10.0)
+        assert a._member_snapshot("hostB:10101").state == "alive"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_hmac_rejects_spoofed_datagram():
+    """With a shared key, an unauthenticated datagram must not poison
+    membership; with a matching key the same packet is absorbed."""
+    import json as json_mod
+    import socket as socket_mod
+
+    a, _ = make_node("hostA:10101", secret_key=b"k1")
+    try:
+        spoofed = {"t": "update", "from": "evil",
+                   "updates": [{"name": "evil:10101",
+                                "addr": "127.0.0.1:9", "inc": 5,
+                                "state": "alive"}]}
+        raw = json_mod.dumps(spoofed).encode()
+        with socket_mod.socket(socket_mod.AF_INET,
+                               socket_mod.SOCK_DGRAM) as s:
+            from pilosa_tpu.cluster.gossip import _split_addr
+            s.sendto(raw, _split_addr(a.gossip_host))
+        time.sleep(0.5)
+        assert [n.host for n in a.nodes()] == ["hostA:10101"]
+        # The same bytes sealed with the right key DO get absorbed.
+        sealed = a._seal(raw)
+        with socket_mod.socket(socket_mod.AF_INET,
+                               socket_mod.SOCK_DGRAM) as s:
+            from pilosa_tpu.cluster.gossip import _split_addr
+            s.sendto(sealed, _split_addr(a.gossip_host))
+        assert wait_until(
+            lambda: "evil:10101" in [n.host for n in a.nodes()],
+            timeout=5.0)
+    finally:
+        a.close()
+
+
+def test_hmac_cluster_converges_and_syncs():
+    """Two nodes sharing a key join and exchange sync broadcasts
+    (sealed TCP frames end-to-end)."""
+    a, ha = make_node("hostA:10101", secret_key="swordfish")
+    b, hb = make_node("hostB:10101", seeds=[a.gossip_host],
+                      secret_key="swordfish")
+    try:
+        assert wait_until(lambda: len(a.nodes()) == 2
+                          and len(b.nodes()) == 2)
+        from pilosa_tpu.proto import internal_pb2 as pb
+        a.send_sync(pb.CreateIndexMessage(Index="idx"))
+        assert wait_until(lambda: any(
+            getattr(m, "Index", "") == "idx" for m in hb.messages))
+    finally:
+        a.close()
+        b.close()

@@ -134,3 +134,56 @@ class TestCountTotal:
         a = np.full((70000 // 8, 8 * 1024), 0xFFFFFFFF, dtype=np.uint32)
         total = kernels.op_count_total("or", a, a)
         assert total == a.size * 32
+
+
+class TestSparseWords:
+    """Host-side sparse (word idx, word value) extraction — the upload
+    payload of the device densify kernel (cold-path sparse uploads)."""
+
+    def _storage(self):
+        import numpy as np
+        from pilosa_tpu import SLICE_WIDTH
+        from pilosa_tpu.storage.roaring import Bitmap
+        rng = np.random.default_rng(1)
+        st = Bitmap()
+        rows = rng.integers(0, 6, 30000).astype(np.uint64)
+        cols = rng.integers(0, SLICE_WIDTH, 30000).astype(np.uint64)
+        # row 0 also gets a dense run -> bitmap containers
+        dense = np.sort(rng.choice(SLICE_WIDTH // 4, 150000,
+                                   replace=False)).astype(np.uint64)
+        st.add_many(np.unique(np.concatenate(
+            [rows * SLICE_WIDTH + cols, dense])))
+        return st
+
+    def test_sparse_rows_matches_dense_pack(self):
+        import numpy as np
+        from pilosa_tpu.ops import packed
+        st = self._storage()
+        ids = [0, 1, 2, 3, 4, 5]
+        dense = packed.pack_rows(st, ids)
+        idx, val = packed.sparse_rows(st, ids, pad_to=256)
+        assert idx.shape == val.shape and idx.shape[1] % 256 == 0
+        got = np.zeros_like(dense)
+        for t in range(len(ids)):
+            # padding entries are (0, 0): OR no-ops
+            nz = val[t] != 0
+            got[t, idx[t][nz]] = val[t][nz]
+        assert (got == dense).all()
+
+    def test_sparse_then_densify_kernel(self):
+        import numpy as np
+        from pilosa_tpu.ops import packed
+        from pilosa_tpu.ops.pallas_kernels import densify_pallas
+        st = self._storage()
+        ids = [0, 1, 5]
+        dense = packed.pack_rows(st, ids)
+        idx, val = packed.sparse_rows(st, ids, pad_to=128)
+        got = np.asarray(densify_pallas(
+            idx, val, packed.WORDS_PER_SLICE, True))
+        assert (got == dense).all()
+
+    def test_sparse_words_empty(self):
+        from pilosa_tpu.ops import packed
+        from pilosa_tpu.storage.roaring import Bitmap
+        idx, val = packed.sparse_words(Bitmap(), 32768)
+        assert len(idx) == 0 and len(val) == 0

@@ -129,10 +129,17 @@ class TestMeshPallasDispatch:
             assert got == want, (threshold, tanimoto)
 
     def test_mode_selection(self, monkeypatch):
+        # Default (and "auto", and "0") = XLA: the recorded round-4 A/B
+        # (benchmarks/PALLAS_AB.json) has XLA equal-or-faster on 5/6
+        # serving shapes; Pallas is an explicit opt-in now.
+        monkeypatch.delenv("PILOSA_TPU_PALLAS", raising=False)
+        assert pk.pallas_mode("tpu") is None
         monkeypatch.setenv("PILOSA_TPU_PALLAS", "0")
+        assert pk.pallas_mode("tpu") is None
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "auto")
         assert pk.pallas_mode("tpu") is None
         monkeypatch.setenv("PILOSA_TPU_PALLAS", "interpret")
         assert pk.pallas_mode("cpu") == "interpret"
-        monkeypatch.setenv("PILOSA_TPU_PALLAS", "auto")
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "1")
         assert pk.pallas_mode("tpu") == "compiled"
         assert pk.pallas_mode("cpu") is None

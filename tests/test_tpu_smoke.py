@@ -62,7 +62,7 @@ def test_real_chip_serving_kernels():
     env["XLA_FLAGS"] = " ".join(
         f for f in env.get("XLA_FLAGS", "").split()
         if not f.startswith("--xla_force_host_platform_device_count"))
-    env.pop("PILOSA_TPU_PALLAS", None)  # auto → compiled on TPU
+    env["PILOSA_TPU_PALLAS"] = "1"  # opt in: smoke the compiled Pallas path
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     # Prepend the repo, preserving the ambient PYTHONPATH — the axon
     # plugin's sitecustomize lives there and must load at startup.
