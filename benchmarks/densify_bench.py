@@ -2,12 +2,14 @@
 
 The round-3 cold-path numbers (c5 first src-TopN 2378 ms vs 86-126 ms
 repeat) are transfer-bound: candidate blocks ship as dense words at the
-~1.1 GB/s tunnel rate. The sparse path ships (word idx, word value)
-pairs and densifies on device (ops.pallas_kernels.densify_pallas).
-This harness measures, at a c5-scale block shape:
+~1.1 GB/s tunnel rate. The sparse path ships set words bucketed by
+128-lane group ([T, 256, G] lane/value slots — ops.packed.bucket_rows)
+and densifies on device with G vectorized one-hot OR passes
+(ops.pallas_kernels.densify_pallas). This harness measures, at c5-scale
+block shapes:
 
-- dense leg:   pack host → device_put [T, 32768] u32      (the status quo)
-- sparse leg:  device_put idx/val [T, P] + densify kernel (the new path)
+- dense leg:   pack host → device_put [T, 32768] u32      (status quo)
+- sparse leg:  device_put lane/val [T, 256, G] + densify  (new path)
 
 plus the kernel-only dispatch time and first-call compile cost, and
 writes benchmarks/DENSIFY.json. Run on the real chip.
@@ -37,31 +39,38 @@ def main() -> None:
     platform = jax.devices()[0].platform
     rng = np.random.default_rng(5)
     W = packed.WORDS_PER_SLICE  # 32768
+    subs = W // 128
 
     out = {"platform": platform, "cases": []}
-    # (tiles, set bits per row) — c5-ish: 256 slices x 64 candidates,
-    # ~2000 bits/row (the suite's ranked-frame density), and a denser
-    # variant to find the crossover.
+    # (tiles, set bits per row): c5-ish 256 slices x 64 candidates at
+    # ~2000 and ~30 bits/row, and a denser 16K-bit variant for the
+    # crossover. Every row reuses one synthetic sparse pattern.
     for t_rows, bits_per_row in ((256 * 64, 2000), (256 * 64, 30),
                                  (2048, 16000)):
-        # synth sparse rows: bits_per_row distinct positions per row
         pos = np.sort(
             rng.choice(W * 32, size=bits_per_row, replace=False))
-        widx = (pos >> 5).astype(np.int32)
-        vals = (np.uint32(1) << (pos & 31).astype(np.uint32))
+        widx = (pos >> 5).astype(np.int64)
+        bitv = (np.uint32(1) << (pos & 31).astype(np.uint32))
         starts = np.concatenate(([0], np.flatnonzero(np.diff(widx)) + 1))
         uidx = widx[starts]
-        uval = np.bitwise_or.reduceat(vals, starts)
-        p_pad = -(-len(uidx) // 512) * 512
-        idx = np.zeros((t_rows, p_pad), np.int32)
-        val = np.zeros((t_rows, p_pad), np.uint32)
-        idx[:, :len(uidx)] = uidx
-        val[:, :len(uval)] = uval
+        uval = np.bitwise_or.reduceat(bitv, starts)
+        # bucket one row, then broadcast to T rows
+        groups = uidx >> 7
+        counts = np.bincount(groups, minlength=subs)
+        g_pad = 1 << (max(1, int(counts.max())) - 1).bit_length()
+        st = np.zeros(subs + 1, np.int64)
+        np.cumsum(counts, out=st[1:])
+        rank = np.arange(len(uidx)) - st[groups]
+        lane1 = np.zeros((subs, g_pad), np.uint32)
+        val1 = np.zeros((subs, g_pad), np.uint32)
+        lane1[groups, rank] = (uidx & 127).astype(np.uint32)
+        val1[groups, rank] = uval
+        lanes = np.broadcast_to(lane1, (t_rows, subs, g_pad)).copy()
+        vals = np.broadcast_to(val1, (t_rows, subs, g_pad)).copy()
 
         dense = np.zeros((t_rows, W), np.uint32)
         dense[:, uidx] = uval
 
-        # dense leg: transfer the packed words
         jax.device_put(dense[:64]).block_until_ready()  # warm path
         t0 = time.perf_counter()
         d = jax.device_put(dense)
@@ -69,29 +78,27 @@ def main() -> None:
         dense_s = time.perf_counter() - t0
         del d
 
-        # sparse leg: transfer pairs + densify
         t0 = time.perf_counter()
-        di, dv = jax.device_put(idx), jax.device_put(val)
-        jax.block_until_ready((di, dv))
+        dl, dv = jax.device_put(lanes), jax.device_put(vals)
+        jax.block_until_ready((dl, dv))
         upload_s = time.perf_counter() - t0
         t0 = time.perf_counter()
-        got = densify_pallas(di, dv, W)
+        got = densify_pallas(dl, dv, W)
         got.block_until_ready()
         first_kernel_s = time.perf_counter() - t0  # includes compile
         ok = bool((np.asarray(got[:2]) == dense[:2]).all())
-        # kernel-only, chained
         t0 = time.perf_counter()
         for _ in range(8):
-            got = densify_pallas(di, dv, W)
+            got = densify_pallas(dl, dv, W)
         got.block_until_ready()
         kernel_ms = (time.perf_counter() - t0) / 8 * 1e3
-        del di, dv, got
+        del dl, dv, got
 
         case = {
             "tiles": t_rows, "bits_per_row": bits_per_row,
-            "pairs_per_row": int(len(uidx)), "p_padded": int(p_pad),
+            "g_slots": int(g_pad),
             "dense_mb": round(dense.nbytes / 1e6, 1),
-            "sparse_mb": round((idx.nbytes + val.nbytes) / 1e6, 1),
+            "sparse_mb": round((lanes.nbytes + vals.nbytes) / 1e6, 1),
             "dense_put_s": round(dense_s, 3),
             "sparse_put_s": round(upload_s, 3),
             "densify_first_s": round(first_kernel_s, 3),

@@ -535,10 +535,19 @@ class Executor:
             return self._bitmap_call_slice(index, c.children[0],
                                            slice).count()
 
-        local_fn = self._count_local_device_fn(index, c.children[0], opt)
+        # Per-query routing note: a vetoed local_fn stamps the
+        # predicted host cost here (it runs on a _map_reduce pool
+        # worker, so a shared dict — not a threading.local — carries it
+        # back); this site closes the loop by recording
+        # (predicted, actual) into the cost model.
+        note: dict = {}
+        local_fn = self._count_local_device_fn(index, c.children[0],
+                                               opt, note=note)
+        t0 = time.perf_counter()
         result = self._map_reduce(index, slices, c, opt, map_fn,
                                   lambda prev, v: (prev or 0) + v,
                                   local_fn=local_fn)
+        self._record_host_leg(note, time.perf_counter() - t0)
         return result or 0
 
     # -- device-batched Count (TPU fast path) --------------------------------
@@ -776,7 +785,7 @@ class Executor:
         return local_fn
 
     def _count_local_device_fn(self, index: str, child: Call,
-                               opt: ExecOptions):
+                               opt: ExecOptions, note: dict | None = None):
         """Batched local-leg Count: all slices in ONE mesh program.
 
         Returns a ``local_fn(slices) -> int`` for _map_reduce, or None
@@ -819,26 +828,33 @@ class Executor:
             mesh = self._mesh_or_none()  # backend init only past threshold
             if mesh is None:
                 return NotImplemented
-            if not self._device_pays(
-                    mesh, len(leaves), len(slices),
-                    cold_rows=self._cold_leaves(mesh, index, leaves,
-                                                slices)):
+            cold = self._cold_leaves(mesh, index, leaves, slices)
+            if not self._device_pays(mesh, len(leaves), len(slices),
+                                     cold_rows=cold, note=note):
                 return NotImplemented  # calibrated: host clearly faster
             shard, budget = self._count_budget(slices)
             if self._leaf_block_bytes(len(leaves), shard) > budget:
                 return NotImplemented  # oversized leaf set: host path
             from .parallel import mesh as mesh_mod
             try:
-                if len(slices) <= mesh_mod.slice_chunk_bound(
-                        mesh.shape[mesh_mod.AXIS_SLICES]):
-                    # Residency fast path: leaf slabs stay device-
-                    # resident across queries (budgeted HBM cache).
-                    arrs = [self._leaf_device_array(mesh, index, leaf,
-                                                    tuple(slices))
+                def run():
+                    if len(slices) <= mesh_mod.slice_chunk_bound(
+                            mesh.shape[mesh_mod.AXIS_SLICES]):
+                        # Residency fast path: leaf slabs stay device-
+                        # resident across queries (budgeted HBM cache).
+                        arrs = [self._leaf_device_array(
+                            mesh, index, leaf, tuple(slices))
                             for leaf in leaves]
-                    return mesh_mod.count_expr_sharded(mesh, expr, arrs)
-                block = self._pack_leaf_block(index, leaves, slices)
-                return mesh_mod.count_expr(mesh, expr, block)
+                        return mesh_mod.count_expr_sharded(mesh, expr,
+                                                           arrs)
+                    block = self._pack_leaf_block(index, leaves, slices)
+                    return mesh_mod.count_expr(mesh, expr, block)
+                # Feed the SAME cold-row estimate into the drift
+                # prediction — omitting it made every cold query look
+                # like drift and inflated device_scale (review finding).
+                return self._timed_device_leg(run, len(leaves),
+                                              len(slices),
+                                              cold_rows=cold)
             except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
                 self._note_device_fallback("count_expr", e)
                 return NotImplemented
@@ -846,7 +862,7 @@ class Executor:
         return local_fn
 
     def _device_pays(self, mesh, n_rows: int, n_slices: int,
-                     cold_rows: int = 0) -> bool:
+                     cold_rows: int = 0, note: dict | None = None) -> bool:
         """Calibrated routing veto: False when the host path clearly
         wins for a block of ``n_rows × n_slices`` packed rows on this
         hardware (round 2's c4 showed the static threshold sending
@@ -870,7 +886,34 @@ class Executor:
             n_rows * row_bytes, cold_bytes=cold_rows * row_bytes)
         if not pays:
             self.cost_vetoes += 1
+            if note is not None:
+                # Stamp the host leg's prediction for this query; the
+                # _map_reduce caller records actual-vs-predicted.
+                note["host_pred"] = self.cost_model.predict(
+                    "host", n_rows * row_bytes)
         return pays
+
+    def _timed_device_leg(self, fn, n_rows: int, n_slices: int,
+                          cold_rows: int = 0):
+        """Run a device leg and feed (predicted, actual) back into the
+        cost model's drift loop (no-op when the model is off)."""
+        model = self.cost_model
+        if model is None:
+            return fn()
+        from .ops.packed import WORDS_PER_SLICE
+        row_bytes = n_slices * WORDS_PER_SLICE * 4
+        pred = model.predict("device", n_rows * row_bytes,
+                             cold_rows * row_bytes)
+        t0 = time.perf_counter()
+        out = fn()
+        model.record("device", pred, time.perf_counter() - t0)
+        return out
+
+    def _record_host_leg(self, note: dict, elapsed_s: float) -> None:
+        """Close the loop for a query the model routed to the host."""
+        pred = note.get("host_pred")
+        if pred is not None and self.cost_model is not None:
+            self.cost_model.record("host", pred, elapsed_s)
 
     def _leaf_cache_key(self, mesh, index: str, leaf: tuple,
                         slices: tuple[int, ...]) -> tuple:
@@ -927,12 +970,24 @@ class Executor:
         key = self._leaf_cache_key(mesh, index, leaf, slices)
 
         def build():
+            from .ops import packed
             from .ops.packed import WORDS_PER_SLICE
             n = len(slices) + (-len(slices) % n_dev)
-            block = np.zeros((n, WORDS_PER_SLICE), dtype=np.uint32)
-            for si, frag in enumerate(frags):
-                if frag is not None:
-                    frag.pack_row(row_id, out=block[si])
+            mode = mesh_mod.densify_mode()
+            pairs = [frag.sparse_row_pairs(row_id)
+                     if frag is not None else None for frag in frags]
+            pairs += [None] * (n - len(pairs))
+            if mode is not None:
+                use_sparse, plan = packed.sparse_gate(pairs,
+                                                      WORDS_PER_SLICE)
+                if use_sparse:
+                    subs = WORDS_PER_SLICE // 128
+                    lanes, vals = packed.bucket_prepared(pairs, subs,
+                                                         plan=plan)
+                    return mesh_mod.densify_sharded(
+                        mesh, lanes, vals,
+                        interpret=(mode == "interpret"))
+            block = packed.densify_host(pairs, WORDS_PER_SLICE)
             return mesh_mod.shard_slices(mesh, block)
 
         return device_cache().get_or_build(key, build)
@@ -1176,16 +1231,33 @@ class Executor:
             mesh, index, frame_name, row_ids, slices)
 
         def build():
+            from .ops import packed
             from .ops.packed import WORDS_PER_SLICE
             n = len(slices) + (-len(slices) % n_dev)
-            rows = np.zeros((n, len(row_ids), WORDS_PER_SLICE),
-                            dtype=np.uint32)
-            for si, frag in enumerate(frags):
-                if frag is None:
-                    continue
-                cached = len(row_ids) <= frag.device.max_rows
-                for ri, rid in enumerate(row_ids):
-                    frag.pack_row(rid, out=rows[si, ri], cached=cached)
+            # Extract once as sparse (word idx, value) pairs; the gate
+            # then picks the transfer representation — bucketed sparse
+            # + device densify (3-6x cold-upload win at sparse shapes,
+            # benchmarks/DENSIFY.json) or host dense scatter.
+            mode = mesh_mod.densify_mode()
+            pairs: list = []
+            for si in range(n):
+                frag = frags[si] if si < len(frags) else None
+                for rid in row_ids:
+                    pairs.append(None if frag is None
+                                 else frag.sparse_row_pairs(rid))
+            if mode is not None:
+                use_sparse, plan = packed.sparse_gate(pairs,
+                                                      WORDS_PER_SLICE)
+                if use_sparse:
+                    subs = WORDS_PER_SLICE // 128
+                    lanes, vals = packed.bucket_prepared(pairs, subs,
+                                                         plan=plan)
+                    shp = (n, len(row_ids)) + lanes.shape[1:]
+                    return mesh_mod.densify_sharded(
+                        mesh, lanes.reshape(shp), vals.reshape(shp),
+                        interpret=(mode == "interpret"))
+            rows = packed.densify_host(pairs, WORDS_PER_SLICE).reshape(
+                n, len(row_ids), WORDS_PER_SLICE)
             return mesh_mod.shard_slices(mesh, rows)
 
         rows_arr = device_cache().get_or_build(key, build)

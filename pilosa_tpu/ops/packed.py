@@ -150,20 +150,93 @@ def sparse_row_words(storage: Bitmap, row_id: int
     return sparse_words(row_bm, WORDS_PER_SLICE)
 
 
-def sparse_rows(storage: Bitmap, row_ids, pad_to: int | None = None
+# 128 words per bucket group - must match pallas_kernels._DENSIFY_LANES.
+_DENSIFY_LANES = 128
+
+
+def bucket_rows(storage: Bitmap, row_ids,
+                n_words: int = WORDS_PER_SLICE
                 ) -> tuple[np.ndarray, np.ndarray]:
-    """Padded sparse form of a row block: ``([n, P] i32 idx, [n, P] u32
-    val)`` with ``val == 0`` padding (a densify no-op). ``P`` is the max
-    set-word count over the rows, rounded up to ``pad_to`` granularity
-    (shape-bucketing keeps the device kernel's compile cache small)."""
+    """Bucketed sparse form of a row block for the device densify
+    kernel (ops.pallas_kernels.densify_pallas): ``([T, n_words/128, G]
+    u32 lanes, same-shape u32 values)``, where slot g of 128-word group
+    s of row t is one set word (its lane 0-127 and value); ``val == 0``
+    slots are padding. G is the max set-word count in any row's group,
+    rounded up to a power of two (shape-bucketing keeps the kernel's
+    compile cache small). Transfer size is ``T * n_words/16 * G`` bytes
+    vs ``4 * T * n_words`` dense — the win whenever G stays small,
+    which is exactly the sparse/clustered case the cost model routes
+    here."""
+    subs = n_words // _DENSIFY_LANES
     rows = [sparse_row_words(storage, r) for r in row_ids]
-    p = max((len(i) for i, _ in rows), default=0)
-    if pad_to:
-        p = max(pad_to, -(-p // pad_to) * pad_to)
-    p = max(p, 1)
-    idx = np.zeros((len(rows), p), dtype=np.int32)
-    val = np.zeros((len(rows), p), dtype=np.uint32)
-    for n, (i, v) in enumerate(rows):
-        idx[n, :len(i)] = i
-        val[n, :len(v)] = v
-    return idx, val
+    return bucket_prepared(rows, subs)
+
+
+def _bucket_plan(rows: list, subs: int) -> tuple[int, list]:
+    """One bincount pass over pre-extracted pairs: (g_pad, metas) —
+    shared by sparse_gate (the decision) and bucket_prepared (the
+    fill), so the cold path pays the grouping exactly once."""
+    g_max = 1
+    metas = []
+    for pair in rows:
+        if pair is None or not len(pair[0]):
+            metas.append(None)
+            continue
+        idx, val = pair
+        groups = (idx >> 7).astype(np.int64)
+        counts = np.bincount(groups, minlength=subs)
+        g_max = max(g_max, int(counts.max()))
+        starts = np.zeros(subs + 1, dtype=np.int64)
+        np.cumsum(counts, out=starts[1:])
+        rank = np.arange(len(idx), dtype=np.int64) - starts[groups]
+        metas.append((groups, rank, idx, val))
+    return 1 << (g_max - 1).bit_length(), metas
+
+
+def bucket_prepared(rows: list, subs: int, plan=None
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """bucket_rows over pre-extracted ``(idx, val)`` pairs (None for
+    absent rows) — the shared form for multi-fragment blocks, where
+    extraction happens once and feeds either the sparse upload or the
+    host dense scatter (ops.packed.densify_host). ``plan`` is the
+    (g_pad, metas) a prior sparse_gate computed."""
+    g_pad, metas = plan if plan is not None else _bucket_plan(rows, subs)
+    lanes = np.zeros((len(rows), subs, g_pad), dtype=np.uint32)
+    vals = np.zeros((len(rows), subs, g_pad), dtype=np.uint32)
+    for t, meta in enumerate(metas):
+        if meta is None:
+            continue
+        groups, rank, idx, val = meta
+        lanes[t, groups, rank] = (idx & 127).astype(np.uint32)
+        vals[t, groups, rank] = val
+    return lanes, vals
+
+
+def densify_host(rows: list, n_words: int) -> np.ndarray:
+    """Pre-extracted ``(idx, val)`` pairs → dense ``[T, n_words]`` u32
+    host-side (the dense-upload leg when the sparse gate says no —
+    reuses the extraction instead of re-walking containers)."""
+    out = np.zeros((len(rows), n_words), dtype=np.uint32)
+    for t, pair in enumerate(rows):
+        if pair is None or not len(pair[0]):
+            continue
+        out[t, pair[0]] = pair[1]
+    return out
+
+
+def sparse_gate(rows: list, n_words: int,
+                margin: float = 2.0) -> tuple[bool, tuple]:
+    """Should a block of pre-extracted rows ship sparse? Returns
+    (use_sparse, plan) — pass ``plan`` to bucket_prepared to reuse the
+    grouping pass. Sparse pays when the bucketed payload —
+    ``T * n_words/16 * G`` bytes — is under ``dense/margin`` and G is
+    within the kernel's VMEM envelope; the measured crossover
+    (benchmarks/DENSIFY.json) shows 3-6x wins at G<=16 and a 0.5x LOSS
+    at G=128, so the gate is deliberately conservative."""
+    subs = n_words // _DENSIFY_LANES
+    plan = _bucket_plan(rows, subs)
+    g_pad = plan[0]
+    sparse_bytes = len(rows) * subs * g_pad * 8
+    dense_bytes = len(rows) * n_words * 4
+    return (g_pad <= 32
+            and sparse_bytes * margin <= dense_bytes), plan

@@ -258,44 +258,82 @@ def op_count_rows_pallas(op: str, a: jax.Array, b: jax.Array,
 #
 # First queries used to ship DENSE words through the ~1.1 GB/s tunnel
 # (128 KB per slice row regardless of density). The sparse path ships
-# (word index, word value) pairs — bounded by set words, typically
-# 25-1000x smaller — and densifies ON DEVICE with this kernel: per
-# output row tile, zero the 32768-word VMEM block and OR each pair in.
-# XLA's scatter lowering made this a loss (benchmarks/RESULTS.md
-# negative result #2: 14.6 s sparse vs 3.1 s dense for a 256 MB block);
-# the Pallas loop writes VMEM directly. This is the device analogue of
-# the reference materializing a row in O(containers), not O(row width)
-# (roaring.go:253-285).
+# set words bucketed by 128-lane group — ``[T, 256, G]`` (lane, value)
+# slots, G = max set words in any row's 128-word group — and densifies
+# ON DEVICE with this kernel: G fully-vectorized one-hot OR passes over
+# the VMEM-resident output tile. No scatter, no dynamic indexing: XLA's
+# scatter lowering made the sparse path a loss (benchmarks/RESULTS.md
+# negative result #2), and Mosaic forbids scalar/dynamic-lane VMEM
+# access, so the layout is arranged host-side to make the kernel a pure
+# vector computation (ops.packed.bucket_rows). This is the device
+# analogue of the reference materializing a row in O(containers), not
+# O(row width) (roaring.go:253-285).
 
-def _densify_kernel(idx_ref, val_ref, out_ref):
-    out_ref[:] = jnp.zeros_like(out_ref)
+_DENSIFY_TILE_R = 8  # TPU block sublane minimum: 8 rows per grid step
+_DENSIFY_LANES = 128  # output tile: words viewed as [sublanes, 128 lanes]
+_DENSIFY_TILE_S = 32  # 128-word groups per grid step (bounds the VMEM
+                      # stack: each unrolled G pass holds one
+                      # [8, 32, 128] u32 temp = 128 KB)
 
-    def body(j, carry):
-        k = idx_ref[0, j]
-        out_ref[0, k] |= val_ref[0, j]
-        return carry
 
-    jax.lax.fori_loop(0, idx_ref.shape[1], body, 0, unroll=8)
+def _densify_kernel(lane_ref, val_ref, out_ref):
+    lanes = jax.lax.broadcasted_iota(
+        jnp.uint32, (1, 1, _DENSIFY_LANES), 2)
+    acc = jnp.zeros(out_ref.shape, jnp.uint32)
+    for g in range(lane_ref.shape[2]):  # static: one vector pass per slot
+        lane_g = lane_ref[:, :, g][:, :, None]   # [8, tile_s, 1]
+        val_g = val_ref[:, :, g][:, :, None]
+        acc = acc | jnp.where(lanes == lane_g, val_g, jnp.uint32(0))
+    out_ref[:] = acc
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
-def densify_pallas(idx: jax.Array, val: jax.Array, n_words: int,
+def densify_pallas(lane: jax.Array, val: jax.Array, n_words: int,
                    interpret: bool = False) -> jax.Array:
-    """``[T, P]`` i32 word indices + u32 word values (``val == 0``
-    padding entries are OR no-ops) → ``[T, n_words]`` u32 dense rows.
+    """Bucketed sparse rows → dense u32 rows.
 
-    Each grid step owns one output row: indices must lie in
-    ``[0, n_words)``; duplicate indices OR together (callers pre-OR
-    duplicates host-side, ops.packed.sparse_row_words)."""
-    t_rows, _ = idx.shape
-    return pl.pallas_call(
+    ``lane``/``val`` are ``[T, n_words/128, G]``: slot g of group s of
+    row t holds a word value and its lane (0-127) within the group;
+    ``val == 0`` slots are padding (OR no-ops, any lane). Returns
+    ``[T, n_words]``. Produced by ops.packed.bucket_rows."""
+    t_rows, subs, g_slots = lane.shape
+    if subs * _DENSIFY_LANES != n_words:
+        raise ValueError("lane/val buckets do not match n_words")
+    pr = (-t_rows) % _DENSIFY_TILE_R
+    if pr:
+        lane = jnp.pad(lane, ((0, pr), (0, 0), (0, 0)))
+        val = jnp.pad(val, ((0, pr), (0, 0), (0, 0)))
+    t_pad = t_rows + pr
+    # Mosaic's stack model keeps every unrolled G pass's temp alive
+    # concurrently (G x [8, tile_s, 128] u32), so the sublane tile
+    # shrinks as G grows to stay inside the ~16 MB scoped-VMEM limit:
+    # G * tile_s * 4 KB <= 8 MB. Beyond G=256 the data is dense enough
+    # that callers must take the dense path (cost gate enforces this).
+    if g_slots > 256:
+        raise ValueError("densify_pallas: G > 256 — block too dense "
+                         "for the sparse path; pack dense instead")
+    tile_s = min(_DENSIFY_TILE_S, subs, max(8, 2048 // g_slots))
+    while tile_s > 1 and subs % tile_s:
+        tile_s //= 2
+    if subs % tile_s or (tile_s < 8 and tile_s != subs):
+        # grid = subs//tile_s must cover every group exactly, and the
+        # block sublane dim must divide 8 or equal subs (Mosaic rule).
+        raise ValueError(f"densify_pallas: no legal sublane tile for "
+                         f"subs={subs}, G={g_slots}")
+    out = pl.pallas_call(
         _densify_kernel,
-        out_shape=jax.ShapeDtypeStruct((t_rows, n_words), jnp.uint32),
-        grid=(t_rows,),
+        out_shape=jax.ShapeDtypeStruct(
+            (t_pad, subs, _DENSIFY_LANES), jnp.uint32),
+        grid=(t_pad // _DENSIFY_TILE_R, subs // tile_s),
         in_specs=[
-            pl.BlockSpec((1, idx.shape[1]), lambda i: (i, 0)),
-            pl.BlockSpec((1, val.shape[1]), lambda i: (i, 0)),
+            pl.BlockSpec((_DENSIFY_TILE_R, tile_s, g_slots),
+                         lambda i, j: (i, j, 0)),
+            pl.BlockSpec((_DENSIFY_TILE_R, tile_s, g_slots),
+                         lambda i, j: (i, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, n_words), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec(
+            (_DENSIFY_TILE_R, tile_s, _DENSIFY_LANES),
+            lambda i, j: (i, j, 0)),
         interpret=interpret,
-    )(idx, val)
+    )(lane, val)
+    return out.reshape(t_pad, n_words)[:t_rows]

@@ -85,6 +85,53 @@ def shard_slices(mesh: Mesh, arr: np.ndarray) -> jax.Array:
     return jax.device_put(arr, _slice_sharding(mesh))
 
 
+def densify_mode() -> str | None:
+    """Sparse-upload dispatch: "compiled" on real TPU (the measured
+    3-6x cold-upload win, benchmarks/DENSIFY.json), "interpret" when
+    forced for CPU tests (PILOSA_TPU_SPARSE_UPLOAD=interpret), None =
+    dense uploads only (=0, or non-TPU backends where device_put does
+    not cross a tunnel)."""
+    import os
+    v = os.environ.get("PILOSA_TPU_SPARSE_UPLOAD", "auto")
+    if v == "0":
+        return None
+    if v == "interpret":
+        return "interpret"
+    return "compiled" if jax.devices()[0].platform == "tpu" else None
+
+
+@functools.lru_cache(maxsize=64)
+def _densify_sharded_fn(mesh: Mesh, lead_shape: tuple, subs: int,
+                        g_slots: int, interpret: bool):
+    from ..ops import pallas_kernels as pk
+    n_words = subs * 128
+
+    def per_shard(lanes, vals):  # [..., subs, G] slice-sharded axis 0
+        flat_l = lanes.reshape((-1, subs, g_slots))
+        flat_v = vals.reshape((-1, subs, g_slots))
+        out = pk.densify_pallas(flat_l, flat_v, n_words, interpret)
+        return out.reshape(lanes.shape[:-2] + (n_words,))
+
+    return jax.jit(jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(AXIS_SLICES), P(AXIS_SLICES)),
+        out_specs=P(AXIS_SLICES), check_vma=False))
+
+
+def densify_sharded(mesh: Mesh, lanes: np.ndarray, vals: np.ndarray,
+                    interpret: bool = False) -> jax.Array:
+    """Upload bucketed sparse rows (ops.packed.bucket_prepared) and
+    densify per shard: ``[S, (R,) subs, G]`` → slice-sharded
+    ``[S, (R,) subs*128]`` dense words. The cold-path replacement for
+    packing dense host-side and shipping 4 bytes per word through the
+    tunnel (the round-3 c5 first-query tax)."""
+    dl = shard_slices(mesh, lanes)
+    dv = shard_slices(mesh, vals)
+    fn = _densify_sharded_fn(mesh, lanes.shape[:-2], lanes.shape[-2],
+                             lanes.shape[-1], interpret)
+    return fn(dl, dv)
+
+
 def pad_to_multiple(arr: np.ndarray, n: int) -> np.ndarray:
     """Pad axis 0 with zero slices to a multiple of n (zero slices are
     identity for every count/TopN reduction)."""

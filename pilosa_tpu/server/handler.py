@@ -343,7 +343,8 @@ class Handler:
         if model is not None:
             snap["costModel"] = {"syncS": model.cal.sync_s,
                                  "hostBps": model.cal.host_bps,
-                                 "margin": model.margin}
+                                 "margin": model.margin,
+                                 "drift": model.drift_snapshot()}
         return Response.json(snap)
 
     # -- profiling (reference handler.go:30,99 mounts net/http/pprof) --------

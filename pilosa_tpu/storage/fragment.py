@@ -85,6 +85,15 @@ class PairSet:
 # exercise the multi-partial merge.
 _SRC_FOLD_POSITIONS = 1 << 20
 
+# Fragments at or under this many set bits take the all-positions
+# vectorized src-count pass (8 B/bit peak -> <=128 MB); bigger ones
+# keep the bounded chunked walk.
+_SRC_VECTOR_BITS = 16 << 20
+
+# Largest position vector kept resident per fragment (8 B each ->
+# 32 MB); larger ones are rebuilt per pass instead of pinned.
+_POSITIONS_CACHE_BITS = 4 << 20
+
 # Entries kept in the incremental per-row count map before a reset
 # (bounds memory on fragments with millions of distinct rows).
 _ROW_COUNT_CAP = 1 << 16
@@ -436,6 +445,41 @@ class Fragment:
     _EMPTY_COUNTS = (np.empty(0, dtype=np.int64),
                      np.empty(0, dtype=np.int64))
 
+    def sparse_row_pairs(self, row_id: int):
+        """(word idx, word value) pairs for one row, under the
+        fragment lock — the extraction feeding sparse device uploads
+        (ops.packed); lockless storage walks race with concurrent
+        mutations (review finding, round 4)."""
+        from ..ops import packed
+        with self._mu:
+            return packed.sparse_row_words(self.storage, row_id)
+
+    def _cached_total_bits(self) -> int:
+        """storage.count() walks every container in Python (~115 ms
+        across 256 c5 fragments); cache per mutation epoch."""
+        hit = getattr(self, "_total_bits", None)
+        if hit is not None and hit[0] == self._epoch:
+            return hit[1]
+        n = self.storage.count()
+        self._total_bits = (self._epoch, n)
+        return n
+
+    def _cached_positions(self) -> np.ndarray:
+        """all_positions per mutation epoch: every src's first count
+        map (and any other whole-fragment pass) shares one walk. Only
+        cached up to _POSITIONS_CACHE_BITS (32 MB resident); bigger
+        fragments rebuild per pass rather than pinning hundreds of MB
+        across a read-mostly fleet of fragments."""
+        hit = getattr(self, "_positions", None)
+        if hit is not None and hit[0] == self._epoch:
+            return hit[1]
+        pos = self.storage.all_positions()
+        if len(pos) <= _POSITIONS_CACHE_BITS:
+            self._positions = (self._epoch, pos)
+        else:
+            self._positions = None
+        return pos
+
     def _host_src_count_map(self, src: Bitmap
                             ) -> tuple[np.ndarray, np.ndarray]:
         """src ∩ row intersection counts for EVERY row of this fragment
@@ -457,6 +501,24 @@ class Fragment:
         hit = self._src_counts.get(key)
         if hit is not None and hit[0] == self._epoch:
             return hit[1]
+        total_bits = self._cached_total_bits()
+        if total_bits <= _SRC_VECTOR_BITS:
+            # One fully vectorized pass: the per-container chunked walk
+            # below costs ~4 us of Python per container, which IS the
+            # first-query latency on ultra-sparse fragments (c5: 1.7 K
+            # near-empty containers per fragment x 256 fragments).
+            positions = self._cached_positions()
+            hits = positions[np.isin(positions % w, src_cols)]
+            if len(hits):
+                out = np.unique((hits // w).astype(np.int64),
+                                return_counts=True)
+            else:
+                z = np.empty(0, dtype=np.int64)
+                out = (z, z)
+            self._src_counts[key] = (self._epoch, out)
+            while len(self._src_counts) > 4:
+                self._src_counts.pop(next(iter(self._src_counts)))
+            return out
         # Partial (ids, counts) maps, folded every ~1 M matched
         # positions: peak memory is bounded by DISTINCT row ids, not by
         # matched bits (a broad src over 100 M matched bits would

@@ -116,3 +116,103 @@ class TestExecutorVeto:
         finally:
             ex.close()
             holder.close()
+
+
+class TestFeedbackLoop:
+    def test_injected_drift_reconverges_without_restart(self):
+        """A model calibrated with a wildly wrong host rate initially
+        routes to the host; feeding it real observations (host 100x
+        slower than predicted) recalibrates the host scale in-process
+        until the device wins the prediction again — no restart."""
+        from pilosa_tpu.parallel.costmodel import (
+            Calibration, CostModel, DRIFT_MIN_SAMPLES)
+        # Bogus probe: host believed to run at 1 TB/s (off ~100x);
+        # device pays 10 ms sync. For a 100 MB query the model predicts
+        # host 0.1 ms vs device >= 10 ms -> routes host.
+        cal = Calibration(sync_s=0.010, host_bps=1e12, upload_bps=1e9)
+        m = CostModel(cal, margin=0.5)
+        nbytes = 100 << 20
+        assert not m.device_pays(nbytes)  # mis-routed to host
+        # Reality: the host does ~10 GB/s -> each query takes ~10 ms.
+        recals = 0
+        for _ in range(5 * DRIFT_MIN_SAMPLES):
+            if m.device_pays(nbytes):
+                break
+            pred = m.predict("host", nbytes)
+            actual = nbytes / 1e10
+            m.record("host", pred, actual)
+        else:
+            raise AssertionError("model never re-converged")
+        assert m.recalibrations >= 1
+        # After convergence the host cost is priced ~100x higher and
+        # the device serves the query.
+        assert m.device_pays(nbytes)
+
+    def test_scales_clamped_and_persisted(self, tmp_path, monkeypatch):
+        import json
+        from pilosa_tpu.parallel import costmodel as cm
+        monkeypatch.setenv("PILOSA_TPU_CACHE", str(tmp_path))
+        cal = cm.Calibration(sync_s=0.001, host_bps=1e9)
+        m = cm.CostModel(cal, persist_key="testnode-cpu")
+        for _ in range(cm.DRIFT_MIN_SAMPLES):
+            m.record("host", 0.001, 1000.0)  # drift 1e6 -> clamped
+        assert cal.host_scale <= cm._SCALE_CLAMP
+        data = json.loads(
+            (tmp_path / "costcal-testnode-cpu.json").read_text())
+        assert data["host_scale"] == cal.host_scale
+
+    def test_persisted_calibration_reloads(self, tmp_path, monkeypatch):
+        from pilosa_tpu.parallel import costmodel as cm
+        monkeypatch.setenv("PILOSA_TPU_CACHE", str(tmp_path))
+        cal = cm.Calibration(sync_s=0.123, host_bps=5e8,
+                             upload_bps=2e9, host_scale=3.0)
+        cm._persist_calibration("n-p", cal)
+        got = cm._load_calibration("n-p")
+        assert got == cal
+
+
+class TestExecutorFeedbackWiring:
+    def test_vetoed_count_records_host_leg(self, tmp_path):
+        """The veto stamps a per-query note (set on a _map_reduce pool
+        worker) and the query site must record the host leg — a
+        threading.local here silently dropped every record (round-4
+        review finding)."""
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.models.holder import Holder
+        from pilosa_tpu import SLICE_WIDTH
+
+        h = Holder(str(tmp_path / "d"))
+        h.open()
+        try:
+            idx = h.create_index_if_not_exists("i")
+            f = idx.create_frame_if_not_exists("f")
+            for col in (1, SLICE_WIDTH + 2, 2 * SLICE_WIDTH + 3):
+                f.set_bit("standard", 1, col)
+                f.set_bit("standard", 2, col)
+            ex = Executor(h, host="local", use_mesh=True,
+                          mesh_min_slices=1)
+
+            recorded = []
+
+            class VetoModel:
+                margin = 0.5
+
+                def device_pays(self, total_bytes, cold_bytes=0):
+                    return False
+
+                def predict(self, leg, total_bytes, cold_bytes=0):
+                    return 0.001
+
+                def record(self, leg, pred, actual):
+                    recorded.append((leg, pred, actual))
+
+            ex.cost_model = VetoModel()
+            ex._cost_model_enabled = True
+            got = ex.execute(
+                "i", 'Count(Intersect(Bitmap(rowID=1, frame=f),'
+                     ' Bitmap(rowID=2, frame=f)))')
+            assert got == [3]
+            legs = [r[0] for r in recorded]
+            assert "host" in legs, recorded
+        finally:
+            h.close()

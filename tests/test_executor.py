@@ -1009,3 +1009,61 @@ class TestMeshBackendRecovery:
         ex._mesh_failed_until = 0.0
         assert ex.execute("i", q)[0] == 64
         assert ex._mesh is not None
+
+
+class TestSparseUploadPath:
+    """Cold device blocks may ship as bucketed sparse words + device
+    densify (PILOSA_TPU_SPARSE_UPLOAD; round-4 cold-path work). Forced
+    interpret mode must produce byte-identical results to the dense
+    upload on both the Count-leaf and TopN-candidate builders."""
+
+    def _fill(self, holder, slices=3):
+        import numpy as np
+        rng = np.random.default_rng(21)
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("f")
+        for row in range(5):
+            cols = rng.choice(slices * SLICE_WIDTH, size=150,
+                              replace=False)
+            for col in cols:
+                f.set_bit("standard", row, int(col))
+
+    def test_sparse_and_dense_uploads_agree(self, holder, monkeypatch):
+        self._fill(holder)
+        queries = [
+            'Count(Intersect(Bitmap(rowID=0, frame=f),'
+            ' Bitmap(rowID=1, frame=f)))',
+            'TopN(frame=f, n=3)',
+            'TopN(Bitmap(rowID=0, frame=f), frame=f, n=4)',
+        ]
+        host = Executor(holder, host="local", use_mesh=False)
+        want = [host.execute("i", q) for q in queries]
+
+        from pilosa_tpu.parallel.residency import device_cache
+        monkeypatch.setenv("PILOSA_TPU_SPARSE_UPLOAD", "interpret")
+        device_cache().clear()
+        sparse_ex = Executor(holder, host="local", use_mesh=True,
+                             mesh_min_slices=1)
+        got_sparse = [sparse_ex.execute("i", q) for q in queries]
+
+        monkeypatch.setenv("PILOSA_TPU_SPARSE_UPLOAD", "0")
+        device_cache().clear()
+        dense_ex = Executor(holder, host="local", use_mesh=True,
+                            mesh_min_slices=1)
+        got_dense = [dense_ex.execute("i", q) for q in queries]
+        assert got_sparse == want
+        assert got_dense == want
+
+    def test_gate_rejects_dense_blocks(self):
+        """A block with a dense row must take the dense path (the
+        measured 0.5x sparse LOSS at G=128, benchmarks/DENSIFY.json)."""
+        import numpy as np
+        from pilosa_tpu.ops import packed
+        dense_row = (np.arange(0, 32768, dtype=np.int32),
+                     np.full(32768, 7, dtype=np.uint32))
+        sparse_row = (np.array([5, 300], dtype=np.int32),
+                      np.array([1, 2], dtype=np.uint32))
+        use, plan = packed.sparse_gate([dense_row, sparse_row], 32768)
+        assert not use and plan[0] > 32
+        use2, plan2 = packed.sparse_gate([sparse_row, None], 32768)
+        assert use2 and plan2[0] == 1

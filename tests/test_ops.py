@@ -155,31 +155,33 @@ class TestSparseWords:
             [rows * SLICE_WIDTH + cols, dense])))
         return st
 
-    def test_sparse_rows_matches_dense_pack(self):
+    def test_bucket_rows_matches_dense_pack(self):
         import numpy as np
         from pilosa_tpu.ops import packed
         st = self._storage()
         ids = [0, 1, 2, 3, 4, 5]
         dense = packed.pack_rows(st, ids)
-        idx, val = packed.sparse_rows(st, ids, pad_to=256)
-        assert idx.shape == val.shape and idx.shape[1] % 256 == 0
+        lanes, vals = packed.bucket_rows(st, ids)
+        assert lanes.shape == vals.shape
+        assert lanes.shape[1] == packed.WORDS_PER_SLICE // 128
         got = np.zeros_like(dense)
         for t in range(len(ids)):
-            # padding entries are (0, 0): OR no-ops
-            nz = val[t] != 0
-            got[t, idx[t][nz]] = val[t][nz]
+            for s_grp in range(lanes.shape[1]):
+                nz = vals[t, s_grp] != 0
+                got[t, s_grp * 128 + lanes[t, s_grp][nz]] = \
+                    vals[t, s_grp][nz]
         assert (got == dense).all()
 
-    def test_sparse_then_densify_kernel(self):
+    def test_bucket_then_densify_kernel(self):
         import numpy as np
         from pilosa_tpu.ops import packed
         from pilosa_tpu.ops.pallas_kernels import densify_pallas
         st = self._storage()
         ids = [0, 1, 5]
         dense = packed.pack_rows(st, ids)
-        idx, val = packed.sparse_rows(st, ids, pad_to=128)
+        lanes, vals = packed.bucket_rows(st, ids)
         got = np.asarray(densify_pallas(
-            idx, val, packed.WORDS_PER_SLICE, True))
+            lanes, vals, packed.WORDS_PER_SLICE, True))
         assert (got == dense).all()
 
     def test_sparse_words_empty(self):
