@@ -762,6 +762,58 @@ def _measure_sync_floor() -> None:
          host_gbps=round(model.cal.host_bps / 1e9, 2))
 
 
+def config_topn1000_1024slices() -> None:
+    """Plain TopN(1000) p50 at 1024 slices (the 1 B-column shape) —
+    round-3 verdict item 7: the candidate/refetch curve past 256
+    slices was uncharacterized; the vectorized rank-array host leg
+    (executor._topn_local_host_fn + fragment.present_rows) replaced a
+    ~2.4 s per-Pair walk with a ~0.3 s merge. Host path (the rank
+    caches ARE the candidate source; no device leg exists for the
+    sourceless form)."""
+    import tempfile
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models.holder import Holder
+
+    n_slices = max(16, int(1024 * SCALE))
+    n_rows = max(100, int(2000 * SCALE))
+    rng = np.random.default_rng(13)
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(d)
+        holder.open()
+        try:
+            frame = holder.create_index_if_not_exists("t1024") \
+                .create_frame_if_not_exists("f")
+            counts = np.maximum(
+                20, 3000 - 2 * np.arange(n_rows)).astype(np.int64)
+            rows = np.repeat(np.arange(n_rows, dtype=np.uint64), counts)
+            cols = rng.integers(0, n_slices * SLICE_WIDTH,
+                                size=len(rows), dtype=np.uint64)
+            order = np.argsort(cols // np.uint64(SLICE_WIDTH),
+                               kind="stable")
+            rows, cols = rows[order], cols[order]
+            step = max(1, len(rows) // 32)
+            for i in range(0, len(rows), step):
+                frame.import_bits(rows[i:i + step], cols[i:i + step])
+            ex = Executor(holder, host="local", use_mesh=False)
+            q = "TopN(frame=f, n=1000)"
+            t0 = time.perf_counter()
+            ex.execute("t1024", q)
+            first_ms = (time.perf_counter() - t0) * 1e3
+            lat = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                ex.execute("t1024", q)
+                lat.append(time.perf_counter() - t0)
+            emit("topn1000_1024slices_p50", sorted(lat)[2] * 1e3, "ms",
+                 slices=n_slices, rows=n_rows,
+                 first_ms=round(first_ms, 1))
+            ex.close()
+        finally:
+            holder.close()
+
+
 def main() -> None:
     for fn in (_measure_sync_floor,
                config1_fragment_intersect_count,
@@ -773,6 +825,7 @@ def main() -> None:
                config4_executor_routing,
                config5_cluster_topn,
                config5_executor_cluster_topn,
+               config_topn1000_1024slices,
                config_residency_repeat_latency,
                config_host_write_and_import):
         try:

@@ -290,14 +290,36 @@ class Pod:
                     " work-item broadcast"
                     + (" — pod collective path disabled" if delivered
                        else ""))
-            try:
-                mine = self.run_item(item)
-            except Exception:
+            # Run our own leg BOUNDED by the pod timeout: a worker
+            # that dies after receiving the item leaves the collective
+            # stalled, and gloo would park this thread indefinitely —
+            # the timeout converts the stall into a poisoned pod with
+            # the host fan-out still serving (the reference's analogue
+            # is a TPU pod job failing as one unit).
+            box: dict = {}
+
+            def run_leg():
+                try:
+                    box["out"] = self.run_item(item)
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    box["err"] = e
+
+            leg = threading.Thread(target=run_leg, daemon=True)
+            leg.start()
+            leg.join(self.timeout)
+            if leg.is_alive():
+                self._poisoned = True
+                raise PodError(
+                    f"pod collective stalled past {self.timeout:.0f}s "
+                    "(worker died mid-collective?) — pod collective "
+                    "path disabled")
+            if "err" in box:
                 # The collective itself failed (e.g. a worker died after
                 # receiving the item) — remaining processes may be parked
                 # in it; nothing further can safely pair up.
                 self._poisoned = True
-                raise
+                raise box["err"]
+            mine = box["out"]
             for t in threads:
                 t.join()
         if errs:

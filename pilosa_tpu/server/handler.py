@@ -203,6 +203,7 @@ class Handler:
         # Route table (reference handler.go:82-120).
         r = self._route
         r("GET", "/", self._handle_webui)
+        r("GET", "/assets/{file}", self._handle_asset)
         r("GET", "/index", self._handle_get_schema)
         r("GET", "/index/{index}", self._handle_get_index)
         r("POST", "/index/{index}", self._handle_post_index)
@@ -246,6 +247,11 @@ class Handler:
     def __call__(self, environ, start_response):
         method = environ.get("REQUEST_METHOD", "GET")
         path = environ.get("PATH_INFO", "/")
+        # HEAD serves through GET handlers with the body dropped —
+        # net/http gives the reference this for free.
+        head = method == "HEAD"
+        if head:
+            method = "GET"
         matched_path = False
         for m, regex, fn in self._routes:
             match = regex.match(path)
@@ -278,7 +284,7 @@ class Handler:
             start_response(status_line,
                            [("Content-Type", resp.content_type),
                             ("Content-Length", str(len(resp.body)))])
-            return [resp.body]
+            return [] if head else [resp.body]
         # Streamed body: file object (chunked reads) or a generator of
         # byte chunks (CSV export) — either way, never buffered whole.
         start_response(status_line,
@@ -293,6 +299,15 @@ class Handler:
         # Embedded console (reference webui/ + statik, handler.go:132-145).
         from .webui import page_bytes
         return Response(200, page_bytes(), "text/html; charset=utf-8")
+
+    def _handle_asset(self, req: Request) -> Response:
+        # Static console assets (reference handler.go:84 /assets/{file}).
+        from .webui import asset
+        got = asset(req.vars["file"])
+        if got is None:
+            raise HTTPError(404, "asset not found")
+        body, ctype = got
+        return Response(200, body, ctype)
 
     def _handle_get_version(self, req: Request) -> Response:
         return Response.json({"version": self.version})

@@ -1,161 +1,65 @@
-"""Embedded browser console.
+"""Embedded browser console, served from a static asset set.
 
-Reference: webui/index.html + webui/assets/main.js, compiled into the
-binary via statik (handler.go:15,43-44,132-145). Feature parity: a PQL
-query box targeting ``POST /index/{index}/query`` with an index dropdown
-populated from ``/schema``, per-query wall-time display and a result
-history, a cluster-status view over ``/status``, and the server version
-from ``/version``. Re-implemented as one dependency-free page embedded in
-this module (the Python analogue of statik embedding).
+Reference: webui/index.html + webui/assets/{main.js,style.css},
+compiled into the binary via statik and served at ``/`` and
+``/assets/{file}`` (handler.go:15,43-44,84,132-145). Same shape here:
+``pilosa_tpu/server/assets/`` ships with the package (the Python
+analogue of statik embedding — package data instead of a generated Go
+file), and the handler mounts ``GET /`` → index.html plus
+``GET /assets/{file}``.
+
+Feature parity with the reference console (webui/assets/main.js):
+a PQL REPL targeting ``POST /index/{index}/query`` with an index
+dropdown from ``/schema``, per-query wall-time + result history +
+ArrowUp/ArrowDown keyboard recall, a cluster-status view over
+``/status``, the server version from ``/version`` — plus a schema
+browser (indexes → frames → options) the reference links out for.
 """
 
-PAGE = """<!doctype html>
-<html lang="en">
-<head>
-<meta charset="utf-8">
-<title>pilosa-tpu console</title>
-<style>
-  :root { --fg:#1a1c1e; --mut:#6b7075; --line:#d8dbde; --accent:#0b57d0;
-          --ok:#1e7e34; --bad:#b3261e; --code:#f4f5f6; }
-  * { box-sizing:border-box; }
-  body { margin:0; font:14px/1.5 system-ui,sans-serif; color:var(--fg); }
-  header { display:flex; align-items:baseline; gap:1rem; padding:.7rem 1.2rem;
-           border-bottom:1px solid var(--line); }
-  header h1 { font-size:1rem; margin:0; }
-  header nav a { margin-right:.8rem; color:var(--accent); cursor:pointer;
-                 text-decoration:none; }
-  header nav a.active { font-weight:600; text-decoration:underline; }
-  #version { margin-left:auto; color:var(--mut); }
-  main { padding:1rem 1.2rem; max-width:70rem; }
-  .pane { display:none; } .pane.active { display:block; }
-  .row { display:flex; gap:.6rem; margin-bottom:.6rem; }
-  select,textarea,button { font:inherit; padding:.35rem .5rem;
-    border:1px solid var(--line); border-radius:4px; }
-  textarea { flex:1; height:4.2rem; font-family:ui-monospace,monospace; }
-  button { background:var(--accent); color:#fff; border:none;
-           cursor:pointer; align-self:flex-start; }
-  .entry { border:1px solid var(--line); border-radius:4px;
-           margin-bottom:.8rem; }
-  .entry .q { padding:.4rem .6rem; font-family:ui-monospace,monospace;
-              background:var(--code); display:flex; }
-  .entry .q em { margin-left:auto; color:var(--mut); font-style:normal; }
-  .entry pre { margin:0; padding:.4rem .6rem; overflow-x:auto;
-               font-size:.85rem; }
-  .entry.err .q { color:var(--bad); }
-  table { border-collapse:collapse; }
-  td,th { border:1px solid var(--line); padding:.3rem .7rem;
-          text-align:left; }
-  .UP,.OK { color:var(--ok); } .DOWN { color:var(--bad); }
-</style>
-</head>
-<body>
-<header>
-  <h1>pilosa-tpu</h1>
-  <nav>
-    <a id="nav-query" class="active">Query</a>
-    <a id="nav-cluster">Cluster</a>
-  </nav>
-  <span id="version"></span>
-</header>
-<main>
-  <section id="pane-query" class="pane active">
-    <div class="row">
-      <select id="index"></select>
-      <textarea id="pql" placeholder='Count(Bitmap(frame="f", rowID=1))'
-        ></textarea>
-      <button id="run">Run &#9166;</button>
-    </div>
-    <div id="history"></div>
-  </section>
-  <section id="pane-cluster" class="pane">
-    <table><thead><tr><th>Host</th><th>State</th><th>Indexes</th></tr>
-    </thead><tbody id="status"></tbody></table>
-  </section>
-</main>
-<script>
-"use strict";
-const $ = id => document.getElementById(id);
-const getJSON = (path, cb) =>
-  fetch(path).then(r => r.json()).then(cb).catch(() => {});
+from __future__ import annotations
 
-function show(pane) {
-  for (const p of ["query", "cluster"]) {
-    $("pane-" + p).classList.toggle("active", p === pane);
-    $("nav-" + p).classList.toggle("active", p === pane);
-  }
-  if (pane === "cluster") refreshStatus();
-}
-$("nav-query").onclick = () => show("query");
-$("nav-cluster").onclick = () => show("cluster");
+import os
 
-function refreshSchema() {
-  getJSON("/schema", s => {
-    const sel = $("index"), cur = sel.value;
-    sel.innerHTML = "";
-    for (const ix of (s.indexes || []))
-      sel.add(new Option(ix.name, ix.name, false, ix.name === cur));
-  });
-}
-function refreshStatus() {
-  getJSON("/status", s => {
-    const tbody = $("status");
-    tbody.replaceChildren();
-    for (const n of ((s.status || {}).nodes || [])) {
-      const tr = document.createElement("tr");
-      const st = n.state || "?";
-      for (const text of [n.host, st,
-                          (n.indexes || []).map(i => i.name).join(", ")]) {
-        const td = document.createElement("td");
-        td.textContent = text;
-        tr.appendChild(td);
-      }
-      tr.children[1].className = st;
-      tbody.appendChild(tr);
-    }
-  });
-}
-function run() {
-  const index = $("index").value, q = $("pql").value.trim();
-  if (!index || !q) return;
-  const t0 = performance.now();
-  fetch("/index/" + encodeURIComponent(index) + "/query",
-        {method: "POST", body: q})
-    .then(r => r.json().then(body => ({ok: r.ok, body})))
-    .then(({ok, body}) => record(q, body, ok, performance.now() - t0))
-    .catch(e => record(q, {error: String(e)}, false,
-                       performance.now() - t0));
-  refreshSchema();
-}
-function record(q, body, ok, ms) {
-  const div = document.createElement("div");
-  div.className = "entry" + (ok ? "" : " err");
-  const head = document.createElement("div");
-  head.className = "q";
-  head.textContent = q;
-  const t = document.createElement("em");
-  t.textContent = ms.toFixed(1) + " ms";
-  head.appendChild(t);
-  const pre = document.createElement("pre");
-  pre.textContent = JSON.stringify(body, null, 2);
-  div.append(head, pre);
-  $("history").prepend(div);
-}
-$("run").onclick = run;
-$("pql").addEventListener("keydown", e => {
-  if (e.key === "Enter" && !e.shiftKey) { e.preventDefault(); run(); }
-});
+_ASSET_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "assets")
 
-getJSON("/version", v => $("version").textContent =
-  "v" + (v.version || "?"));
-refreshSchema();
-setInterval(() => {
-  if ($("pane-cluster").classList.contains("active")) refreshStatus();
-}, 5000);
-</script>
-</body>
-</html>
-"""
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".css": "text/css; charset=utf-8",
+    ".js": "application/javascript; charset=utf-8",
+    ".svg": "image/svg+xml",
+    ".png": "image/png",
+}
+
+
+_cache: dict[str, tuple[bytes, str] | None] = {}
+
+
+def asset(name: str) -> tuple[bytes, str] | None:
+    """(bytes, content type) for one asset, or None when unknown;
+    read once and served from memory after (the statik-embedding
+    behavior this module mirrors).
+
+    Names are single path segments only — the route pattern forbids
+    ``/`` and this re-checks, so traversal cannot escape the dir."""
+    if name in _cache:
+        return _cache[name]
+    if not name or "/" in name or "\\" in name or name.startswith("."):
+        return None  # don't cache hostile names unboundedly
+    path = os.path.join(_ASSET_DIR, name)
+    if not os.path.isfile(path):
+        return None
+    ext = os.path.splitext(name)[1]
+    ctype = _CONTENT_TYPES.get(ext, "application/octet-stream")
+    with open(path, "rb") as f:
+        got = (f.read(), ctype)
+    _cache[name] = got
+    return got
 
 
 def page_bytes() -> bytes:
-    return PAGE.encode()
+    """The console page (GET /)."""
+    got = asset("index.html")
+    if got is None:  # packaging error — fail loud, not blank
+        raise FileNotFoundError("webui assets missing: index.html")
+    return got[0]

@@ -1,0 +1,138 @@
+"""Child process for the real-worker-death pod test (test_pod.py).
+
+An 8-process CPU pod (1 virtual device each). The coordinator builds
+data in slices 0-6 (worker 7 owns no data slice), proves the
+device-collective path works, then signals the LAUNCHER to SIGKILL
+worker 7 and waits for the sentinel file. The next collective stalls —
+workers 0-6 enter it, 7 never joins — and the coordinator must:
+
+1. time the stalled collective out via PILOSA_TPU_POD_TIMEOUT (set low
+   by the launcher; the round-3 gap was that this path had never been
+   induced by an actual death),
+2. poison the device path, and
+3. keep serving correct results under concurrent load through the
+   podLocal host fan-out (whose legs only touch live owners).
+
+Style mirror: reference whole-process cluster tests
+(server/server_test.go:375-496).
+
+Usage: python pod_kill_child.py <proc_id> <data_dir>
+"""
+
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+from podenv import child_main, http, query  # noqa: E402
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.server.server import Server  # noqa: E402
+
+
+def main() -> None:
+    proc_id = int(sys.argv[1])
+    data_dir = sys.argv[2]
+    host = os.environ["PILOSA_TPU_POD_PEERS"].split(",")[proc_id]
+
+    srv = Server(data_dir, host=host, anti_entropy_interval=0,
+                 polling_interval=0)
+    srv.open()
+    print(f"pod process {proc_id} serving on {srv.host}", flush=True)
+
+    if proc_id != 0:
+        while True:  # worker: serve pod legs until killed
+            time.sleep(0.5)
+
+    coord = srv.host
+    http("POST", coord, "/index/i", b"{}")
+    http("POST", coord, "/index/i/frame/f", b"{}")
+
+    # Bits in slices 0..6 only: worker 7 owns slice 7 (empty), so the
+    # post-death host fan-out never needs the dead process's data —
+    # but the COLLECTIVE still spans all 8 processes and must stall.
+    n_slices = 7
+    for s in range(n_slices):
+        for j in range(3):
+            query(coord, "i", f"SetBit(frame=f, rowID=1,"
+                              f" columnID={s * SLICE_WIDTH + j})")
+        for j in range(2):
+            query(coord, "i", f"SetBit(frame=f, rowID=2,"
+                              f" columnID={s * SLICE_WIDTH + j})")
+
+    # Collective path alive pre-kill (8-way psum over gloo). The warm
+    # collective compiles 8 programs on ONE time-shared core, so the
+    # tight kill-phase timeout would false-trip here: warm with a
+    # generous bound, then arm the configured (low) timeout for the
+    # death phase — the mechanism under test is the same.
+    tight = srv.pod.timeout
+    srv.pod.timeout = 240.0
+    got = query(coord, "i", "Count(Bitmap(frame=f, rowID=1))")[0]
+    assert got == 3 * n_slices, got
+    assert srv.pod.dispatch_counts.get("count_expr", 0) >= 1
+    srv.pod.timeout = tight
+
+    # Hand control to the launcher: it SIGKILLs worker 7, then writes
+    # the sentinel file.
+    sentinel = os.environ["POD_KILL_SENTINEL"]
+    print("READY_FOR_KILL", flush=True)
+    deadline = time.time() + 60
+    while not os.path.exists(sentinel):
+        if time.time() > deadline:
+            raise RuntimeError("launcher never wrote the kill sentinel")
+        time.sleep(0.1)
+
+    # The next collective must STALL (workers 0-6 enter, 7 never does)
+    # and the coordinator must exit it via PILOSA_TPU_POD_TIMEOUT.
+    from pilosa_tpu.parallel.pod import PodError
+    t0 = time.time()
+    try:
+        srv.pod.count_expr("i", ("leaf", 0),
+                           [("f", "standard", 1)],
+                           list(range(n_slices + 1)))
+        raise AssertionError("collective with a dead worker must fail")
+    except PodError as e:
+        elapsed = time.time() - t0
+        budget = float(os.environ["PILOSA_TPU_POD_TIMEOUT"])
+        # Reachability pre-checks may catch the death first (fast); a
+        # stall must be cut at ~the timeout, not hang forever.
+        assert elapsed < budget + 30, (elapsed, str(e))
+    assert srv.pod._poisoned, "dead worker must poison the pod"
+
+    # Poisoned pod + dead worker: correct results via the host fan-out,
+    # under concurrent load (legs only touch live owners).
+    import concurrent.futures
+
+    def check(_):
+        got = query(coord, "i", "Count(Bitmap(frame=f, rowID=1))")[0]
+        assert got == 3 * n_slices, got
+        got = query(coord, "i",
+                    "Count(Intersect(Bitmap(frame=f, rowID=1),"
+                    " Bitmap(frame=f, rowID=2)))")[0]
+        assert got == 2 * n_slices, got
+        pairs = query(coord, "i", "TopN(frame=f, n=2)")[0]
+        tops = [(p["id"], p["count"]) for p in pairs]
+        assert tops == [(1, 3 * n_slices), (2, 2 * n_slices)], tops
+        return True
+
+    with concurrent.futures.ThreadPoolExecutor(8) as ex:
+        assert all(ex.map(check, range(24)))
+
+    # And a further collective attempt fails FAST (poisoned guard),
+    # not by re-stalling for another timeout.
+    t0 = time.time()
+    try:
+        srv.pod._dispatch({"kind": "count_expr", "index": "i",
+                           "expr": ["leaf", 0],
+                           "leaves": [["f", "standard", 1]],
+                           "slices": [0]})
+        raise AssertionError("poisoned dispatch must raise")
+    except PodError:
+        assert time.time() - t0 < 5
+    print("POD_KILL_TEST_OK", flush=True)
+
+
+child_main(main)

@@ -1067,3 +1067,68 @@ class TestSparseUploadPath:
         assert not use and plan[0] > 32
         use2, plan2 = packed.sparse_gate([sparse_row, None], 32768)
         assert use2 and plan2[0] == 1
+
+
+class TestVectorizedHostTopN:
+    def test_matches_per_slice_path(self, holder, monkeypatch):
+        """The rank-array host leg (one dict per local batch) must
+        reproduce the per-slice map path exactly, for plain,
+        thresholded, and ids forms."""
+        import numpy as np
+        rng = np.random.default_rng(31)
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("f")
+        for row in range(30):
+            cols = rng.choice(6 * SLICE_WIDTH,
+                              size=int(rng.integers(5, 120)),
+                              replace=False)
+            for col in cols:
+                f.set_bit("standard", row, int(col))
+        fast = Executor(holder, host="local", use_mesh=False)
+        slow = Executor(holder, host="local", use_mesh=False)
+        monkeypatch.setattr(slow, "_topn_local_host_fn",
+                            lambda *a, **k: None)
+        queries = [
+            'TopN(frame=f, n=5)',
+            'TopN(frame=f, n=31)',
+            'TopN(frame=f)',
+            'TopN(frame=f, n=6, threshold=40)',
+            'TopN(frame=f, n=4, ids=[0,3,7,29])',
+            'TopN(frame=f, ids=[1,2,99], threshold=10)',
+        ]
+        for q in queries:
+            assert fast.execute("i", q) == slow.execute("i", q), q
+
+    def test_ranked_cache_falls_back_to_fresh_counts(self, holder,
+                                                     monkeypatch):
+        """RankCache rankings are rate-limited; the ids-form fast path
+        must defer to the per-slice cache.get path there (round-4
+        review: stale ranked counts)."""
+        import numpy as np
+        idx = holder.create_index_if_not_exists("r")
+        f = idx.create_frame_if_not_exists(
+            "rf", FrameOptions(cache_type="ranked"))
+        for col in range(5):
+            f.set_bit("standard", 0, col)
+        ex = Executor(holder, host="local", use_mesh=False)
+        got = ex.execute("r", 'TopN(frame=rf, n=5, ids=[0])')
+        assert [(p.id, p.count) for p in got[0]] == [(0, 5)]
+        # mutate within the rank-limiter window; counts must be fresh
+        for col in range(5, 9):
+            f.set_bit("standard", 0, col)
+        got = ex.execute("r", 'TopN(frame=rf, n=5, ids=[0])')
+        assert [(p.id, p.count) for p in got[0]] == [(0, 9)]
+
+    def test_ids_form_survives_empty_cache(self, holder):
+        """A lost .cache sidecar (empty rank cache) must take the
+        recount fallback, not IndexError (round-4 review)."""
+        idx = holder.create_index_if_not_exists("e")
+        f = idx.create_frame_if_not_exists("ef")
+        for col in range(4):
+            f.set_bit("standard", 2, col)
+        frag = holder.fragment("e", "ef", "standard", 0)
+        frag.cache._od.clear()           # simulate lost sidecar
+        frag.cache._ranked = None
+        ex = Executor(holder, host="local", use_mesh=False)
+        got = ex.execute("e", 'TopN(frame=ef, n=5, ids=[2, 7])')
+        assert [(p.id, p.count) for p in got[0]] == [(2, 4)]

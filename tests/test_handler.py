@@ -79,11 +79,14 @@ class TestMeta:
         assert status == 200
         assert headers["Content-Type"].startswith("text/html")
         page = body.decode()
-        # The console drives the same public API surface as the
-        # reference's webui (query POST, /schema, /status, /version).
+        assert "textarea" in page and "/assets/main.js" in page
+        # The console logic (now a static asset) drives the same public
+        # API surface as the reference's webui.
+        _, _, js = call(handler, "GET", "/assets/main.js")
+        script = js.decode()
         for needle in ("/index/", "/query", "/schema", "/status",
-                       "/version", "textarea"):
-            assert needle in page, needle
+                       "/version"):
+            assert needle in script, needle
 
     def test_method_not_allowed(self, handler):
         status, _, _ = call(handler, "GET", "/index/i/query")
@@ -355,3 +358,24 @@ class TestExpvar:
         assert {"entries", "usedBytes", "budgetBytes", "hits",
                 "misses", "evictions"} <= set(cache)
         assert snap["deviceFallback"] == 0
+
+
+class TestWebUIAssets:
+    def test_assets_served_with_content_types(self, handler):
+        for name, ctype, marker in (
+                ("main.js", "application/javascript", b"refreshStatus"),
+                ("style.css", "text/css", b"--accent"),
+                ("index.html", "text/html", b"pane-schema")):
+            status, headers, body = call(handler, "GET",
+                                         f"/assets/{name}")
+            assert status == 200, name
+            assert ctype in headers["Content-Type"], name
+            assert marker in body, name
+
+    def test_assets_unknown_and_traversal_404(self, handler):
+        for path in ("/assets/nope.js", "/assets/.hidden"):
+            assert call(handler, "GET", path)[0] == 404, path
+        # a literal ../ segment cannot even match the route pattern
+        from pilosa_tpu.server.webui import asset
+        assert asset("../webui.py") is None
+        assert asset("..\\webui.py") is None

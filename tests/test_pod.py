@@ -55,3 +55,69 @@ def test_pod_three_process_poisoned_serves_host_path(tmp_path):
     poisoned pod must keep serving correct results under concurrent
     load via the host fan-out (pod_child.poison_phase)."""
     run_pod(tmp_path, 3, {"POD_TEST_POISON": "1"})
+
+
+def test_pod_eight_process_worker_sigkill(tmp_path):
+    """8 whole processes (1 virtual device each); worker 7 is SIGKILLed
+    between collectives. The coordinator must exit the stalled next
+    collective via PILOSA_TPU_POD_TIMEOUT, poison the device path, and
+    serve correct host-fan-out results under concurrent load — the
+    poison flag's primary real-world trigger, induced by an actual
+    death rather than an injected dispatch failure (round-4 verdict
+    item 4)."""
+    import signal
+    import time as time_mod
+
+    n_procs = 8
+    jax_port = free_port()
+    peers = [f"localhost:{free_port()}" for _ in range(n_procs)]
+    script = os.path.join(_HERE, "pod_kill_child.py")
+    sentinel = tmp_path / "killed.sentinel"
+
+    children = ChildSet(tmp_path)
+    try:
+        for pid in range(n_procs):
+            data_dir = tmp_path / f"node{pid}"
+            data_dir.mkdir()
+            env = pod_env(pid, jax_port, peers, cpu_devices=1)
+            env["PILOSA_TPU_POD_TIMEOUT"] = "10"
+            env["POD_KILL_SENTINEL"] = str(sentinel)
+            children.spawn(
+                f"worker{pid}",
+                [sys.executable, script, str(pid), str(data_dir)],
+                env, pipe=(pid == 0))
+        coord = children.procs["worker0"]
+
+        # Read coordinator stdout until it says the data is built and
+        # the pre-kill collective verified.
+        lines = []
+        deadline = time_mod.time() + 240
+        while time_mod.time() < deadline:
+            line = coord.stdout.readline()
+            if not line:
+                break
+            lines.append(line)
+            if "READY_FOR_KILL" in line:
+                break
+        else:
+            raise AssertionError("timed out waiting for READY_FOR_KILL")
+        assert any("READY_FOR_KILL" in ln for ln in lines), (
+            "".join(lines) + children.logs_tail())
+
+        victim = children.procs[f"worker{n_procs - 1}"]
+        victim.send_signal(signal.SIGKILL)
+        victim.wait()
+        sentinel.write_text("killed")
+
+        # communicate() (not sequential reads) so a regression that
+        # re-parks the coordinator in the stalled collective fails the
+        # test at the timeout instead of wedging it, and a full stderr
+        # pipe cannot deadlock the reads.
+        out, err = coord.communicate(timeout=240)
+        assert coord.returncode == 0, (
+            f"coordinator rc={coord.returncode}\nstdout:\n"
+            f"{''.join(lines)}{out}\nstderr:\n{err[-4000:]}\n"
+            f"{children.logs_tail()}")
+        assert "POD_KILL_TEST_OK" in out, out
+    finally:
+        children.cleanup()
