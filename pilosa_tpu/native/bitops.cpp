@@ -51,23 +51,6 @@ uint64_t popcnt(const uint64_t* a, int64_t n) {
 // Standard two-pointer merges; out must have room for the worst case
 // (min(na,nb) for intersect, na+nb for union, na for difference).
 
-// Copy-insert v into sorted a[0..n) -> out[0..n+1); returns new length,
-// or -1 when v is already present (out untouched). One call replaces a
-// searchsorted + three slice copies on the Python write hot path.
-int64_t insert_sorted_u32(const uint32_t* a, int64_t n, uint32_t v,
-                          uint32_t* out) {
-    int64_t lo = 0, hi = n;
-    while (lo < hi) {
-        int64_t mid = (lo + hi) / 2;
-        if (a[mid] < v) lo = mid + 1; else hi = mid;
-    }
-    if (lo < n && a[lo] == v) return -1;
-    memcpy(out, a, lo * 4);
-    out[lo] = v;
-    memcpy(out + lo + 1, a + lo, (n - lo) * 4);
-    return n + 1;
-}
-
 int64_t intersect_sorted_u32(const uint32_t* a, int64_t na,
                              const uint32_t* b, int64_t nb, uint32_t* out) {
     int64_t i = 0, j = 0, k = 0;

@@ -80,8 +80,6 @@ def _declare(lib):
     lib.difference_sorted_u32.restype = i64
     lib.pack_positions_u32.argtypes = [u64p, i64, ctypes.c_uint64, i64, u32p]
     lib.pack_positions_u32.restype = None
-    lib.insert_sorted_u32.argtypes = [u32p, i64, ctypes.c_uint32, u32p]
-    lib.insert_sorted_u32.restype = i64
     lib.bench_setbit.argtypes = [ctypes.c_char_p, u64p, i64, i64]
     lib.bench_setbit.restype = i64
     lib.unpack_words_u32.argtypes = [u32p, i64, u64p]
@@ -229,23 +227,6 @@ def unpack_words(words: np.ndarray) -> np.ndarray:
             np.uint32(1)).astype(bool)
     w, b = np.nonzero(bits)
     return w.astype(np.uint64) * np.uint64(32) + b.astype(np.uint64)
-
-
-def insert_sorted_u32_into(a: np.ndarray, v: int,
-                           out: np.ndarray) -> int:
-    """Copy-insert v into sorted u32 ``a`` producing ``out`` (len+1);
-    returns the new length or -1 when already present (native), or
-    falls back to numpy. One call on the SetBit hot path."""
-    lib = _load()
-    if lib is None:
-        i = int(np.searchsorted(a, v))
-        if i < len(a) and a[i] == v:
-            return -1
-        out[:i] = a[:i]
-        out[i] = v
-        out[i + 1:] = a[i:]
-        return len(a) + 1
-    return lib.insert_sorted_u32(_u32p(a), len(a), v, _u32p(out))
 
 
 def bench_setbit(path: str, positions: np.ndarray,

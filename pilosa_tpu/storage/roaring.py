@@ -138,10 +138,18 @@ class Container:
         # detaches from a mapped buffer, so no _unmap() copy on the
         # array branch.
         if self.bitmap is None:
+            # Manual numpy copy-insert: the ctypes pointer prep for the
+            # native kernel costs ~4 us/call (arr.ctypes construction +
+            # cast) — more than the whole insert at container sizes, so
+            # the C path only pays for bulk ops, not point adds.
             a = self.array
-            grown = np.empty(len(a) + 1, dtype=np.uint32)
-            if native.insert_sorted_u32_into(a, v, grown) < 0:
+            i = int(np.searchsorted(a, v))
+            if i < len(a) and a[i] == v:
                 return False
+            grown = np.empty(len(a) + 1, dtype=np.uint32)
+            grown[:i] = a[:i]
+            grown[i] = v
+            grown[i + 1:] = a[i:]
             self.array = grown
             self.mapped = False
             self.n += 1
@@ -815,17 +823,27 @@ class Bitmap:
         offsets = data_start + np.concatenate(
             ([0], np.cumsum(sizes[:-1], dtype=np.int64))) \
             if n_cont else np.empty(0, np.int64)
-        parts = [COOKIE.to_bytes(4, "little"),
-                 n_cont.to_bytes(4, "little"),
-                 hdr.tobytes(), offsets.astype("<u4").tobytes()]
-        parts += [(np.ascontiguousarray(c.array, dtype="<u4")
-                   if c.is_array()
-                   else np.ascontiguousarray(c.bitmap, dtype="<u8"))
-                  .tobytes()
-                  for _, c in live]
-        blob = b"".join(parts)
-        w.write(blob)
-        return len(blob)
+        # One preallocated buffer, one write: per-container tobytes()
+        # plus a join re-copy was ~half the snapshot cost at 13 K+
+        # containers. Little-endian byte views are free on LE hosts;
+        # the rare BE or non-contiguous container falls back to a cast.
+        head = (COOKIE.to_bytes(4, "little")
+                + n_cont.to_bytes(4, "little")
+                + hdr.tobytes() + offsets.astype("<u4").tobytes())
+        total = data_start + int(sizes.sum()) if n_cont else HEADER_SIZE
+        blob = np.empty(total, dtype=np.uint8)
+        blob[:len(head)] = np.frombuffer(head, dtype=np.uint8)
+        pos = len(head)
+        for _, c in live:
+            arr = c.array if c.bitmap is None else c.bitmap
+            dt = "<u4" if c.bitmap is None else "<u8"
+            if arr.dtype.str != dt or not arr.flags.c_contiguous:
+                arr = np.ascontiguousarray(arr, dtype=dt)
+            b = arr.view(np.uint8)
+            blob[pos:pos + b.nbytes] = b
+            pos += b.nbytes
+        w.write(memoryview(blob))  # FileIO takes the buffer, no copy
+        return total
 
     def marshal(self) -> bytes:
         buf = io.BytesIO()
