@@ -640,7 +640,17 @@ def _write_topn1000_artifact(p50_ms, p95_ms, first_ms, rows, slices):
         "device_p50_ms": round(p50_ms, 1),
         "device_p95_ms": round(p95_ms, 1),
         "device_first_ms": round(first_ms, 1),
-        "sync_floor_ms": round(_SYNC_FLOOR_MS, 1),
+        # None when this run skipped the floor probe — never report a
+        # fake 0 (review finding: a p50 below the tunnel floor needs
+        # the note below to be interpretable).
+        "sync_floor_ms": (round(_SYNC_FLOOR_MS, 1)
+                          if _SYNC_FLOOR_MS > 0 else None),
+        "note": "plain TopN's candidate walk reads host rank caches on"
+                " every leg (no device dispatch exists for the"
+                " sourceless form); 'device' = the device-enabled"
+                " executor, whose router correctly keeps this query"
+                " host-side — that is why the p50 can sit below the"
+                " ~65 ms tunnel sync floor",
     }
     try:
         with open(path, "w") as f:

@@ -8,10 +8,12 @@ down. Set PILOSA_TPU_SMOKE=1 to run — the chip work happens in a
 bounded subprocess with the conftest's CPU pin stripped, so a wedged
 tunnel fails the test instead of hanging the suite.
 
-Covers the three kernels the serving path dispatches on TPU: the fused
-op_count (bench.py's kernel), the Pallas expression-count program, and
-the Pallas TopN block program (compiled lowering — interpret-mode CI
-cannot catch Mosaic tiling rejections, see the round-2 BlockSpec fix).
+Covers the kernels the serving path dispatches on TPU: the fused
+op_count (bench.py's kernel), the Pallas expression-count program, the
+Pallas TopN block program, and the sparse-upload densify kernel
+(compiled lowering — interpret-mode CI cannot catch Mosaic tiling or
+scalar-store rejections; three round-4 densify designs died only at
+compile time on the real chip).
 """
 
 import os
@@ -43,6 +45,20 @@ got = mesh_mod.topn_exact(m, ("leaf", 0), rows, leaves[:1])
 want_t = np.bitwise_count(rows & leaves[0][:, None, :]) \
     .sum(axis=(0, 2)).tolist()
 assert got == want_t, ("topn", got, want_t)
+
+# Compiled densify (the sparse-upload kernel): odd T, G=2 buckets.
+from pilosa_tpu.ops.pallas_kernels import densify_pallas
+T, subs = 11, 2048 // 128
+lane = rng.integers(0, 128, (T, subs, 2)).astype(np.uint32)
+val = rng.integers(0, 2**32, (T, subs, 2), dtype=np.uint32)
+dense = np.asarray(densify_pallas(lane, val, 2048))
+want_d = np.zeros((T, 2048), np.uint32)
+for t in range(T):
+    for sb in range(subs):
+        for g in range(2):
+            if val[t, sb, g]:
+                want_d[t, sb * 128 + lane[t, sb, g]] |= val[t, sb, g]
+assert (dense == want_d).all(), "densify"
 print("TPU_SMOKE_OK", jax.devices()[0])
 """
 
