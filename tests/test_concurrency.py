@@ -52,12 +52,18 @@ def test_writers_vs_device_readers(holder):
                     ex.execute("i", f"SetBit(frame=f, rowID={row},"
                                     f" columnID={col})")
                 else:
-                    q = ("Count(Intersect(Bitmap(frame=f, rowID=1),"
-                         " Bitmap(frame=f, rowID=2)))"
-                         if k % 3 else
-                         "TopN(Bitmap(frame=f, rowID=1), frame=f,"
-                         " ids=[1, 2])")
-                    ex.execute("i", q)
+                    # Rotate through every TopN serving path that
+                    # round 4 vectorized (plain rank-array leg, src
+                    # candidate arrays, ids refetch) plus Count — all
+                    # racing the writers on the same fragments.
+                    qs = ("Count(Intersect(Bitmap(frame=f, rowID=1),"
+                          " Bitmap(frame=f, rowID=2)))",
+                          "TopN(Bitmap(frame=f, rowID=1), frame=f,"
+                          " ids=[1, 2])",
+                          "TopN(frame=f, n=2)",
+                          "TopN(Bitmap(frame=f, rowID=2), frame=f,"
+                          " n=2)")
+                    ex.execute("i", qs[k % 4])
         except Exception as e:  # noqa: BLE001 - surfaced below
             errs.append((tid, repr(e)))
 
