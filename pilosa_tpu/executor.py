@@ -1153,18 +1153,31 @@ class Executor:
                                      len(slices), cold_rows=cold):
                 return NotImplemented  # calibrated: host clearly faster
             try:
-                if resident_ok:
-                    counts = self._topn_exact_resident(
-                        mesh, index, frame_name, expr, leaves,
-                        tuple(ids), tuple(slices), threshold, tanimoto,
-                        rows_key=rows_key)
-                else:
-                    counts = mesh_mod.topn_exact(
+                def run():
+                    if resident_ok:
+                        return self._topn_exact_resident(
+                            mesh, index, frame_name, expr, leaves,
+                            tuple(ids), tuple(slices), threshold,
+                            tanimoto, rows_key=rows_key)
+                    return mesh_mod.topn_exact(
                         mesh, expr,
                         self._pack_candidate_rows(index, frame_name,
                                                   ids, slices),
                         self._pack_leaf_block(index, leaves, slices),
                         threshold=threshold, tanimoto=tanimoto)
+                if resident_ok:
+                    # Same drift feedback the Count device leg gets —
+                    # the TopN exact phase is the other big routed
+                    # surface. Only the resident form records: the
+                    # streaming form's window includes host-side block
+                    # packing the prediction doesn't price, which
+                    # would one-sidedly inflate device_scale (review
+                    # finding, round 4).
+                    counts = self._timed_device_leg(
+                        run, len(ids) + len(leaves), len(slices),
+                        cold_rows=cold)
+                else:
+                    counts = run()
             except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
                 self._note_device_fallback("topn_exact", e)
                 return NotImplemented
