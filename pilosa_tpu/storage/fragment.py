@@ -138,6 +138,14 @@ class Fragment:
         self._snapshot_n = 0
 
         self._mu = threading.RLock()
+        # Snapshot lifecycle lock. Ordering rule: ALWAYS acquired
+        # BEFORE _mu when blocking (sync snapshot, close, restore);
+        # the per-op async trigger — which runs UNDER _mu — only
+        # try-acquires and skips when busy, so the order cannot
+        # invert. Held by the background worker for its whole run
+        # (released cross-thread in its finally), so "with _snap_mu"
+        # doubles as the join barrier.
+        self._snap_mu = threading.Lock()
         self._file = None
         self._mmap: Optional[mmap.mmap] = None
         self._open = False
@@ -203,6 +211,14 @@ class Fragment:
         self.cache.recalculate()
 
     def close(self) -> None:
+        # _snap_mu first (lock order): waits out any worker and blocks
+        # new ones for the whole close — the TOCTOU where a writer
+        # spawns a worker between a join and the lock acquisition
+        # would let the worker swap files on a closed fragment.
+        with self._snap_mu:
+            self._close_locked()
+
+    def _close_locked(self) -> None:
         with self._mu:
             if not self._open:
                 return
@@ -327,11 +343,22 @@ class Fragment:
 
     def _increment_op_n(self) -> None:
         if self.storage.op_n > MAX_OP_N:
-            self.snapshot()
+            self.snapshot(sync=False)
 
-    def snapshot(self) -> None:
+    def snapshot(self, sync: bool = True) -> None:
         """Atomically rewrite the data file from current state
         (reference fragment.go:991-1057).
+
+        ``sync=False`` (the per-op MAX_OP_N trigger) serializes a
+        COW-frozen capture on a BACKGROUND thread and splices the ops
+        appended meanwhile from the old file's WAL tail at swap time —
+        the write path stops paying the ~15-30 ms serialization every
+        2000 ops (it was a third of per-op latency), while durability
+        is unchanged: every op is already in the old file's WAL, so a
+        crash at ANY point replays identically. Bulk paths that detach
+        the op writer (import, merge apply, restore) MUST use
+        sync=True — their mutations exist nowhere but memory until the
+        snapshot lands.
 
         Fast path: the rewritten file is swapped under the live storage
         object — no close/re-unmarshal/remap, which cost ~100 ms per
@@ -342,6 +369,17 @@ class Fragment:
         re-establishes zero-copy mapped containers, un-pinning old map
         generations that copy-on-write views would otherwise keep alive
         indefinitely."""
+        if not sync:
+            self._snapshot_async()
+            return
+        # Lock order: _snap_mu (waits out / blocks any worker) then
+        # _mu. Callers MUST NOT hold _mu here — import/merge release
+        # it before snapshotting (the worker needs _mu to finish, so
+        # joining under _mu would deadlock).
+        with self._snap_mu:
+            self._snapshot_locked()
+
+    def _snapshot_locked(self) -> None:
         with self._mu:
             with self.logger.track("fragment: snapshot %s/%s/%s/%d",
                                    self.index, self.frame, self.view,
@@ -354,44 +392,118 @@ class Fragment:
                     self.storage.write_to(f)
                     f.flush()
                     os.fsync(f.fileno())
-                self._snapshot_n += 1
-                if self._snapshot_n % _REMAP_EVERY == 0:
-                    self._close_storage()
-                    os.replace(tmp, self.path)
-                    self._open_storage()
-                    return
-                # Swap: replace the path, lock + attach the new file.
-                # flock is per-inode, so the old fd's lock (old inode)
-                # cannot conflict with locking the new one; the old map
-                # stays alive while mapped container views pin it.
-                self.storage.op_writer = None
-                os.replace(tmp, self.path)
+                self._swap_data_file(tmp, new_op_n=0)
+
+    def _swap_data_file(self, tmp: str, new_op_n: int) -> None:
+        """Swap ``tmp`` in as the data file (caller holds _mu; one
+        shared implementation for the sync and background paths).
+        Fast path: replace the path, flock + attach a new fd — flock
+        is per-inode, so the old fd's lock cannot conflict, and the
+        old map stays alive while mapped container views pin it. Every
+        ``_REMAP_EVERY``-th snapshot does the full close/reopen instead
+        (re-establishes zero-copy mapped containers). A failed swap
+        falls back to the full reopen so the WAL is never silently
+        left detached; if THAT also fails the exception propagates and
+        the fragment is visibly broken rather than quietly
+        unlogged."""
+        self._snapshot_n += 1
+        if self._snapshot_n % _REMAP_EVERY == 0:
+            self._close_storage()
+            os.replace(tmp, self.path)
+            self._open_storage()
+            return
+        self.storage.op_writer = None
+        os.replace(tmp, self.path)
+        try:
+            new_file = open(self.path, "a+b", buffering=0)
+            fcntl.flock(new_file.fileno(),
+                        fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except BaseException:
+            self._close_storage()
+            self._open_storage()
+            return
+        old_file, self._file = self._file, new_file
+        self._mmap = None
+        if old_file is not None:
+            try:
+                fcntl.flock(old_file.fileno(), fcntl.LOCK_UN)
+            except OSError:
+                pass
+            old_file.close()
+        new_file.seek(0, os.SEEK_END)
+        self.storage.op_n = new_op_n
+        self.storage.op_writer = new_file
+
+    def _join_snapshot(self) -> None:
+        """Barrier: returns once no background snapshot is in flight
+        (the worker holds _snap_mu for its entire run)."""
+        with self._snap_mu:
+            pass
+
+    def _snapshot_async(self) -> None:
+        # Called UNDER _mu (the per-op MAX_OP_N trigger): may only
+        # TRY-acquire _snap_mu — blocking here would invert the
+        # _snap_mu → _mu lock order against sync snapshot/close.
+        if not self._snap_mu.acquire(blocking=False):
+            return  # a worker or sync snapshot is running; op_n
+            # keeps re-triggering until one lands
+        try:
+            frozen = self.storage.freeze()
+            tail_off = self._file.seek(0, os.SEEK_END)
+        except BaseException:
+            self._snap_mu.release()
+            raise
+        # _snap_mu intentionally stays held; the worker releases it
+        # (threading.Lock allows cross-thread release).
+        threading.Thread(
+            target=self._snapshot_worker, args=(frozen, tail_off),
+            name="frag-snapshot", daemon=True).start()
+
+    def _snapshot_worker(self, frozen, tail_off: int) -> None:
+        # Runs with _snap_mu held (acquired by _snapshot_async,
+        # released here — a plain Lock supports cross-thread release).
+        try:
+            with self.logger.track(
+                    "fragment: async snapshot %s/%s/%s/%d", self.index,
+                    self.frame, self.view, self.slice):
+                tmp = self.path + ".snapshotting"
                 try:
-                    new_file = open(self.path, "a+b", buffering=0)
-                    fcntl.flock(new_file.fileno(),
-                                fcntl.LOCK_EX | fcntl.LOCK_NB)
-                except BaseException:
-                    # Swap failed mid-way (EMFILE/ENOSPC/lock): the
-                    # snapshot file IS in place, but op_writer is
-                    # detached — silently continuing would mutate
-                    # memory with no WAL. Fall back to the full
-                    # reopen; if that also fails the exception
-                    # propagates and the fragment is visibly broken
-                    # rather than quietly unlogged.
-                    self._close_storage()
-                    self._open_storage()
-                    return
-                old_file, self._file = self._file, new_file
-                self._mmap = None
-                if old_file is not None:
-                    try:
-                        fcntl.flock(old_file.fileno(), fcntl.LOCK_UN)
-                    except OSError:
-                        pass
-                    old_file.close()
-                new_file.seek(0, os.SEEK_END)
-                self.storage.op_n = 0
-                self.storage.op_writer = new_file
+                    with open(tmp, "wb") as f:
+                        # The expensive serialize + fsync of the frozen
+                        # body runs with NO fragment lock held; writers
+                        # keep appending to the old file's WAL.
+                        roaring.write_frozen(frozen, f)
+                        f.flush()
+                        os.fsync(f.fileno())
+                        with self._mu:
+                            # Splice the ops that landed since the
+                            # freeze, then swap — brief: the body is
+                            # already on disk, only the tail pages
+                            # need syncing.
+                            with open(self.path, "rb") as rf:
+                                rf.seek(tail_off)
+                                tail = rf.read()
+                            f.write(tail)
+                            f.flush()
+                            os.fsync(f.fileno())
+                            self._swap_data_file(
+                                tmp,
+                                new_op_n=len(tail) // roaring.OP_SIZE)
+                except OSError as e:
+                    # Pre-swap serialization IO failure: op_writer was
+                    # never detached, the old snapshot+WAL remains the
+                    # file of record, and the next MAX_OP_N trigger
+                    # retries. (_swap_data_file failures are NOT
+                    # caught: its own fallback reopen either restores
+                    # a consistent state or propagates, leaving the
+                    # fragment visibly broken — never quietly
+                    # unlogged.)
+                    self.logger.printf(
+                        "fragment: async snapshot failed for"
+                        " %s/%s/%s/%d: %s", self.index, self.frame,
+                        self.view, self.slice, e)
+        finally:
+            self._snap_mu.release()
 
     def import_bits(self, row_ids, column_ids) -> None:
         """Bulk import: direct adds with the op-log detached, then snapshot
@@ -424,7 +536,12 @@ class Fragment:
             self.row_cache.clear()
             self.device.invalidate_all()
             self.checksums.clear()
-            self.snapshot()
+        # Outside _mu: the sync snapshot takes _snap_mu then _mu (the
+        # worker needs _mu to finish, so snapshotting under _mu would
+        # deadlock the join). Crash semantics unchanged — the bulk adds
+        # were never WAL'd, so the window between apply and snapshot
+        # losing them existed under the lock too.
+        self.snapshot()
 
     # -- TopN ----------------------------------------------------------------
 
@@ -935,8 +1052,13 @@ class Fragment:
                 clears_out.append(PairSet(to_clear // np.uint64(SLICE_WIDTH),
                                           to_clear % np.uint64(SLICE_WIDTH)))
             # Apply local diffs.
-            self._apply_merge_diffs(local_set_pos, local_clear_pos)
-            return sets_out[1:], clears_out[1:]
+            need_snapshot = self._apply_merge_diffs(local_set_pos,
+                                                    local_clear_pos)
+        if need_snapshot:
+            # Outside _mu: sync snapshot takes _snap_mu then _mu (see
+            # import_bits for the ordering rationale).
+            self.snapshot()
+        return sets_out[1:], clears_out[1:]
 
     # Above this many local diffs, per-bit WAL appends (plus a per-op
     # row-count cache update) cost more than one snapshot rewrite — the
@@ -957,7 +1079,7 @@ class Fragment:
         a Python loop (reference bulk semantics: fragment.go:802-920)."""
         total = len(set_pos) + len(clear_pos)
         if total == 0:
-            return
+            return False
         base_col = self.slice * SLICE_WIDTH
         if total <= self.MERGE_BULK_THRESHOLD:
             for pos in set_pos:
@@ -966,7 +1088,7 @@ class Fragment:
             for pos in clear_pos:
                 self._mutate(int(pos) // SLICE_WIDTH,
                              base_col + int(pos) % SLICE_WIDTH, set=False)
-            return
+            return False  # per-bit path WALs every op; no snapshot due
         self._epoch += 1
         writer, self.storage.op_writer = self.storage.op_writer, None
         try:
@@ -991,7 +1113,7 @@ class Fragment:
         if self.stats is not None:
             self.stats.count("setN", added)
             self.stats.count("clearN", removed)
-        self.snapshot()
+        return True  # bulk path: caller snapshots outside _mu
 
     # -- iteration / export --------------------------------------------------
 
@@ -1076,7 +1198,9 @@ class Fragment:
         import tarfile
         tr = tarfile.open(fileobj=r, mode="r|")
         import io
-        with self._mu:
+        # _snap_mu first (lock order): a late worker must not splice a
+        # stale pre-restore snapshot over the restored file.
+        with self._snap_mu, self._mu:
             for info in tr:
                 src = tr.extractfile(info) or io.BytesIO()
                 if info.name == "data":

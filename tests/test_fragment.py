@@ -95,7 +95,9 @@ class TestSetClear:
         try:
             for i in range(MAX_OP_N + 2):
                 f.set_bit(i % 3, i % SLICE_WIDTH)
-            # op-log must have been folded into a snapshot
+            # op-log must fold into a snapshot (async since round 4:
+            # wait for the background worker before asserting)
+            f._join_snapshot()
             assert f.storage.op_n <= MAX_OP_N
             size_after = os.path.getsize(f.path)
             f2 = reopen(f)
@@ -600,7 +602,8 @@ class TestFastSnapshotAndIncrementalCounts:
                 else:
                     f.set_bit(r, c)
                     live.add((r, c))
-            assert f._snapshot_n > fragment_mod._REMAP_EVERY
+            f._join_snapshot()  # snapshots are async since round 4
+            assert f._snapshot_n > 0  # workers coalesce: >=1 ran
             # incremental counts == ground truth per row
             for row in range(7):
                 want = sum(1 for (r, _) in live if r == row)
@@ -630,9 +633,128 @@ class TestFastSnapshotAndIncrementalCounts:
         f.open()
         for i in range(40):
             f.set_bit(1, i)
-        assert f._snapshot_n >= 2
+        f._join_snapshot()  # async since round 4
+        assert f._snapshot_n >= 1
         f.close()
         g = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
         g.open()  # would raise BlockingIOError if a lock leaked
         assert g.row_count(1) == 40
         g.close()
+
+
+class TestAsyncSnapshot:
+    def test_writes_during_serialization_splice_into_tail(self, tmp_path,
+                                                          monkeypatch):
+        """Ops appended WHILE the background worker serializes must
+        land in the new file via the WAL-tail splice; a reopen replays
+        them identically."""
+        import threading as th
+
+        import numpy as np
+        from pilosa_tpu.storage import fragment as fragment_mod
+        from pilosa_tpu.storage import roaring as roaring_mod
+        from pilosa_tpu.storage.fragment import Fragment
+
+        gate = th.Event()
+        entered = th.Event()
+        orig = roaring_mod.write_frozen
+
+        def slow_write(live, w):
+            entered.set()
+            gate.wait(10)  # hold serialization open
+            return orig(live, w)
+
+        monkeypatch.setattr(roaring_mod, "write_frozen", slow_write)
+        f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f.open()
+        try:
+            for i in range(300):
+                f.set_bit(1, i)
+            f.snapshot(sync=False)
+            assert entered.wait(10)
+            # these land only in the OLD file's WAL during the worker
+            for i in range(300, 420):
+                f.set_bit(2, i - 300)
+            gate.set()
+            f._join_snapshot()
+            assert f.row_count(1) == 300 and f.row_count(2) == 120
+        finally:
+            f.close()
+        g = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        g.open()
+        try:
+            assert g.row_count(1) == 300
+            assert g.row_count(2) == 120  # spliced tail replayed
+        finally:
+            g.close()
+
+    def test_remap_cycle_reached_with_sequential_snapshots(self, tmp_path,
+                                                           monkeypatch):
+        """Crossing _REMAP_EVERY sequential async snapshots exercises
+        the full close/reopen branch and stays durable."""
+        from pilosa_tpu.storage import fragment as fragment_mod
+        from pilosa_tpu.storage.fragment import Fragment
+        monkeypatch.setattr(fragment_mod, "_REMAP_EVERY", 3)
+        f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f.open()
+        try:
+            for k in range(5):
+                f.set_bit(1, 1000 + k)
+                f.snapshot(sync=False)
+                f._join_snapshot()
+            assert f._snapshot_n >= 5
+            assert f.row_count(1) == 5
+        finally:
+            f.close()
+        g = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        g.open()
+        try:
+            assert g.row_count(1) == 5
+        finally:
+            g.close()
+
+    def test_sync_snapshot_while_worker_inflight_no_deadlock(
+            self, tmp_path, monkeypatch):
+        """import_bits (sync snapshot) arriving while a background
+        worker is serializing must wait it out and complete — the
+        round-4 review deadlock: joining the worker while holding the
+        fragment lock the worker itself needs."""
+        import threading as th
+
+        import numpy as np
+        from pilosa_tpu.storage import roaring as roaring_mod
+        from pilosa_tpu.storage.fragment import Fragment
+
+        gate = th.Event()
+        entered = th.Event()
+        orig = roaring_mod.write_frozen
+
+        def slow_write(live, w):
+            entered.set()
+            gate.wait(10)
+            return orig(live, w)
+
+        monkeypatch.setattr(roaring_mod, "write_frozen", slow_write)
+        f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f.open()
+        try:
+            for i in range(50):
+                f.set_bit(1, i)
+            f.snapshot(sync=False)
+            assert entered.wait(10)
+            done = th.Event()
+
+            def importer():
+                f.import_bits(np.array([5] * 30, np.uint64),
+                              np.arange(30, dtype=np.uint64))
+                done.set()
+
+            t = th.Thread(target=importer, daemon=True)
+            t.start()
+            # the import must be blocked behind the worker, not done
+            assert not done.wait(0.5)
+            gate.set()
+            assert done.wait(20), "import deadlocked behind the worker"
+            assert f.row_count(5) == 30 and f.row_count(1) == 50
+        finally:
+            f.close()
