@@ -487,7 +487,7 @@ def _write_denominator(setbit_exec: float) -> None:
     from pilosa_tpu.storage.fragment import MAX_OP_N, Fragment
 
     rng = np.random.default_rng(9)
-    n = int(100_000 * SCALE)
+    n = max(1, int(100_000 * SCALE))
     rows = rng.integers(0, 1000, n).astype(np.uint64)
     cols = rng.integers(0, 1 << 20, n).astype(np.uint64)
     pos = (rows << np.uint64(20)) + cols
@@ -508,13 +508,22 @@ def _write_denominator(setbit_exec: float) -> None:
                         "standard", 0)
         frag.open()
         try:
+            lat = np.empty(n)
             t0 = time.perf_counter()
-            for r, c in zip(rows.tolist(), cols.tolist()):
+            for i, (r, c) in enumerate(zip(rows.tolist(),
+                                           cols.tolist())):
+                t1 = time.perf_counter()
                 frag.set_bit(r, c)
+                lat[i] = time.perf_counter() - t1
+            frag._join_snapshot()
             frag_ops = n / (time.perf_counter() - t0)
+            lat.sort()
+            p999_ms = float(lat[int(n * 0.999)]) * 1e3
+            max_ms = float(lat[-1]) * 1e3
         finally:
             frag.close()
-    emit("host_setbit_fragment", frag_ops, "ops/sec")
+    emit("host_setbit_fragment", frag_ops, "ops/sec",
+         p999_ms=round(p999_ms, 2), max_ms=round(max_ms, 1))
 
     # Key carries the op count: snapshot amortization scales with run
     # length, so a short smoke run must not pin the canonical shape.
@@ -523,6 +532,7 @@ def _write_denominator(setbit_exec: float) -> None:
     art = {"setbit_native_ops": round(native_ops, 1) if native_ops else None,
            "setbit_native_pinned_ops": round(pinned, 1) if pinned else None,
            "setbit_fragment_ops": round(frag_ops, 1),
+           "setbit_fragment_p999_ms": round(p999_ms, 2),
            "setbit_executor_ops": round(setbit_exec, 1),
            "fragment_vs_native_pinned": (
                round(pinned / frag_ops, 2) if pinned else None)}
