@@ -52,6 +52,17 @@ _EMPTY_U64 = np.empty(0, dtype=np.uint64)
 _FNV_OFFSET = np.uint32(2166136261)
 _FNV_PRIME = np.uint32(16777619)
 
+# Snapshot container-header record (key u64 LE + n-1 u32 LE, packed) —
+# one definition shared by the reader (unmarshal) and the writer
+# (_write_snapshot) so a format tweak cannot desynchronize them.
+_HDR_DTYPE = np.dtype([("key", "<u8"), ("n", "<u4")])
+
+
+def _container_sizes(ns: np.ndarray) -> np.ndarray:
+    """On-disk payload bytes per container from its value count
+    (n<=4096 ⇒ u32 array block, else 1024 u64 words)."""
+    return np.where(ns <= ARRAY_MAX_SIZE, ns * 4, BITMAP_N * 8)
+
 
 def fnv1a32(data: bytes) -> int:
     h = int(_FNV_OFFSET)
@@ -857,34 +868,46 @@ class Bitmap:
             raise ValueError(
                 f"header out of bounds: keyN={key_n}, len={len(buf)}")
         b = Bitmap()
-        hdr = HEADER_SIZE
-        ns = []
-        for i in range(key_n):
-            b.keys.append(int.from_bytes(buf[hdr:hdr + 8], "little"))
-            ns.append(int.from_bytes(buf[hdr + 8:hdr + 12], "little") + 1)
-            hdr += 12
-        ops_offset = HEADER_SIZE + key_n * 12
-        for i in range(key_n):
-            off = int.from_bytes(buf[ops_offset:ops_offset + 4], "little")
-            ops_offset += 4
-            if off >= len(buf):
-                raise ValueError(
-                    f"offset out of bounds: off={off}, len={len(buf)}")
-            n = ns[i]
-            if n <= ARRAY_MAX_SIZE:
-                arr = np.frombuffer(buf, dtype="<u4", count=n, offset=off)
-                c = Container.from_array(arr if mapped else arr.copy(),
-                                         mapped=mapped)
-                end = off + n * 4
+        # Vectorized header/offset parse: the per-container
+        # int.from_bytes loop cost ~100 ms on a 15 K-container
+        # fragment — the bulk of every open() and of the synchronous
+        # remap reopen (the write path's worst per-op outlier).
+        hdr_arr = np.frombuffer(buf, dtype=_HDR_DTYPE, count=key_n,
+                                offset=HEADER_SIZE)
+        ns = (hdr_arr["n"].astype(np.int64) + 1)
+        offs = np.frombuffer(buf, dtype="<u4", count=key_n,
+                             offset=HEADER_SIZE + key_n * 12
+                             ).astype(np.int64)
+        is_arr_mask = ns <= ARRAY_MAX_SIZE
+        sizes = _container_sizes(ns)
+        if key_n and int((offs + sizes).max()) > len(buf):
+            bad = int(offs[np.argmax(offs + sizes)])
+            raise ValueError(
+                f"offset out of bounds: off={bad}, len={len(buf)}")
+        b.keys = hdr_arr["key"].tolist()
+        ops_offset = HEADER_SIZE + key_n * 16
+        end = HEADER_SIZE
+        containers = b.containers
+        for off, n, is_arr in zip(offs.tolist(), ns.tolist(),
+                                  is_arr_mask.tolist()):
+            c = Container.__new__(Container)
+            if is_arr:
+                arr = np.frombuffer(buf, dtype="<u4", count=n,
+                                    offset=off)
+                c.array = arr if mapped else arr.copy()
+                c.bitmap = None
             else:
                 words = np.frombuffer(buf, dtype="<u8", count=BITMAP_N,
                                       offset=off)
-                c = Container.from_bitmap(words if mapped else words.copy(),
-                                          n=n, mapped=mapped)
-                end = off + BITMAP_N * 8
-            b.containers.append(c)
+                c.array = None
+                c.bitmap = words if mapped else words.copy()
+            c.n = n
+            c.mapped = mapped
+            containers.append(c)
+        if key_n:
+            end = int(offs[-1] + sizes[-1])
         # Trailing op-log (bytes after the last container block).
-        ops_end = max(ops_offset, end if key_n else HEADER_SIZE)
+        ops_end = max(ops_offset, end)
         rest = buf[ops_end:]
         while len(rest):
             if tolerate_torn_tail and len(rest) < OP_SIZE:
@@ -920,12 +943,11 @@ def _write_snapshot(live: list[tuple], w) -> int:
     # used to issue one write() per container (16 K syscalls for a
     # 200 K-bit fragment) and pack headers int-by-int — together
     # most of the snapshot cost on the write path's MAX_OP_N cadence.
-    hdr = np.empty(n_cont, dtype=np.dtype([("key", "<u8"),
-                                           ("n", "<u4")]))
+    hdr = np.empty(n_cont, dtype=_HDR_DTYPE)
     hdr["key"] = np.fromiter((t[0] for t in live), np.uint64, n_cont)
     ns = np.fromiter((t[3] for t in live), np.uint32, n_cont)
     hdr["n"] = ns - 1
-    sizes = np.where(ns <= ARRAY_MAX_SIZE, ns * 4, BITMAP_N * 8)
+    sizes = _container_sizes(ns)
     data_start = HEADER_SIZE + n_cont * 12 + n_cont * 4
     offsets = data_start + np.concatenate(
         ([0], np.cumsum(sizes[:-1], dtype=np.int64))) \
