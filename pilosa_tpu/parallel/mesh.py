@@ -156,12 +156,12 @@ def _count_fn(mesh: Mesh, op: str):
         row = jnp.sum(pc, axis=-1).ravel()  # ≤ 2^15 counts of ≤ 2^20 each
         hi = jax.lax.psum(jnp.sum(row >> 16), AXIS_SLICES)
         lo = jax.lax.psum(jnp.sum(row & 0xFFFF), AXIS_SLICES)
-        return hi, lo
+        return jnp.stack([hi, lo])  # one output = one host fetch
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES), P(AXIS_SLICES)),
-        out_specs=(P(), P())))
+        out_specs=P()))
 
 
 def count_op(mesh: Mesh, op: str, a: jax.Array, b: jax.Array) -> int:
@@ -174,28 +174,29 @@ def count_op(mesh: Mesh, op: str, a: jax.Array, b: jax.Array) -> int:
     """
     if a.ndim > 1 and a.shape[0] > (1 << 15):
         raise ValueError("count_op: more than 2^15 slice-rows per call")
-    hi, lo = _count_fn(mesh, op)(a, b)
-    return (int(hi) << 16) + int(lo)
+    hilo = np.asarray(_count_fn(mesh, op)(a, b))
+    return (int(hilo[0]) << 16) + int(hilo[1])
 
 
 @functools.lru_cache(maxsize=256)  # keyed on query-shaped exprs: bound it
 def _count_expr_fn_cached(mesh: Mesh, expr: tuple, mode: str | None):
     def per_shard(leaves):  # leaves: [L, S/n, W]
         his, los = _exprs_hi_lo((expr,), leaves, mode)
-        return (jax.lax.psum(his[0], AXIS_SLICES),
-                jax.lax.psum(los[0], AXIS_SLICES))
+        return jnp.stack([jax.lax.psum(his[0], AXIS_SLICES),
+                          jax.lax.psum(los[0], AXIS_SLICES)])
 
     # check_vma off when Pallas is in the shard body: pallas_call's
     # out_shape carries no varying-axis info, which trips the inference.
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(None, AXIS_SLICES),), out_specs=(P(), P()),
+        in_specs=(P(None, AXIS_SLICES),), out_specs=P(),
         check_vma=(mode is None)))
 
 
 def count_expr_fn(mesh: Mesh, expr: tuple):
-    """[L, S, W] leaf blocks → (hi, lo) 16-bit halves of the expression
-    bitmap's count (recombine as ``(hi << 16) + lo``).
+    """[L, S, W] leaf blocks → stacked [2] (hi, lo) 16-bit halves of
+    the expression bitmap's count (decode via hilo_combine — ONE
+    output array = one host fetch).
 
     ``expr`` is a hashable tree: ``("leaf", i)`` selects leaf block i,
     ``(op, a, b)`` combines subtrees with a bitwise op from kernels._BITWISE.
@@ -236,19 +237,19 @@ def _exprs_hi_lo(exprs, leaves, mode):
 def _count_exprs_fn_cached(mesh: Mesh, exprs: tuple, mode: str | None):
     def per_shard(leaves):  # leaves: [L, S/n, W]
         his, los = _exprs_hi_lo(exprs, leaves, mode)
-        return (jax.lax.psum(his, AXIS_SLICES),
-                jax.lax.psum(los, AXIS_SLICES))
+        return jnp.stack([jax.lax.psum(his, AXIS_SLICES),
+                          jax.lax.psum(los, AXIS_SLICES)])
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(None, AXIS_SLICES),), out_specs=(P(), P()),
+        in_specs=(P(None, AXIS_SLICES),), out_specs=P(),
         check_vma=(mode is None)))
 
 
 def count_exprs_fn(mesh: Mesh, exprs: tuple):
     """K-expression batch form of count_expr_fn: ``[L, S, W]`` shared
-    leaf block → per-expression (hi, lo) 16-bit halves, one program.
-    Public for the pod layer (parallel.multihost)."""
+    leaf block → stacked [2, K] (hi, lo) 16-bit halves, one program =
+    one host fetch. Public for the pod layer (parallel.multihost)."""
     return _count_exprs_fn_cached(mesh, exprs, _mesh_pallas_mode(mesh))
 
 
@@ -276,8 +277,7 @@ def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
         if rem:
             pad = [(0, 0), (0, n_dev - rem), (0, 0)]
             chunk = np.pad(chunk, pad)
-        hi, lo = fn(shard_slices_axis1(mesh, chunk))
-        total += (int(hi) << 16) + int(lo)
+        total += hilo_combine(fn(shard_slices_axis1(mesh, chunk)))[0]
     return total
 
 
@@ -324,12 +324,12 @@ def _count_exprs_sharded_fn(mesh: Mesh, exprs: tuple, n_leaves: int,
                             mode: str | None):
     def per_shard(*leaf_shards):  # each [S/n, W]
         his, los = _exprs_hi_lo(exprs, jnp.stack(leaf_shards), mode)
-        return (jax.lax.psum(his, AXIS_SLICES),
-                jax.lax.psum(los, AXIS_SLICES))
+        return jnp.stack([jax.lax.psum(his, AXIS_SLICES),
+                          jax.lax.psum(los, AXIS_SLICES)])
 
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
-        in_specs=(P(AXIS_SLICES),) * n_leaves, out_specs=(P(), P()),
+        in_specs=(P(AXIS_SLICES),) * n_leaves, out_specs=P(),
         check_vma=(mode is None)))
 
 
@@ -348,9 +348,7 @@ def count_exprs_sharded(mesh: Mesh, exprs: tuple,
                          " int32 hi/lo bound")
     fn = _count_exprs_sharded_fn(mesh, exprs, len(leaf_arrays),
                                  _mesh_pallas_mode(mesh))
-    hi, lo = fn(*leaf_arrays)
-    hi, lo = np.asarray(hi), np.asarray(lo)
-    return [(int(hi[k]) << 16) + int(lo[k]) for k in range(len(exprs))]
+    return hilo_combine(fn(*leaf_arrays))
 
 
 def count_expr_sharded(mesh: Mesh, expr: tuple,
@@ -378,7 +376,7 @@ def _topn_exact_sharded_fn(mesh: Mesh, expr, n_leaves: int,
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES),) * (n_leaves + 1),
-        out_specs=(P(), P()), check_vma=(mode is None)))
+        out_specs=P(), check_vma=(mode is None)))
 
 
 def _shard_topn_inter(expr, rows, leaves, mode):
@@ -398,12 +396,23 @@ def _shard_topn_inter(expr, rows, leaves, mode):
                    axis=-1)
 
 
+def hilo_combine(hilo) -> list[int]:
+    """Decode one stacked [2, ...] (hi, lo) device output into exact
+    Python ints: ``(hi << 16) + lo`` vectorized, one host fetch."""
+    arr = np.asarray(hilo).astype(np.int64)
+    return ((arr[0] << 16) + arr[1]).ravel().tolist()
+
+
 def _psum_hi_lo_rows(per_slice):
-    """[S/n, R] per-slice counts → per-row (hi, lo) 16-bit halves,
-    psum'd over the slice axis (the int32-safe reduction split)."""
+    """[S/n, R] per-slice counts → stacked [2, R] (hi, lo) 16-bit
+    halves, psum'd over the slice axis (the int32-safe reduction
+    split). ONE output array: each separate device output fetched
+    host-side costs its own ~65 ms tunnel round trip — returning
+    (hi, lo) as two arrays doubled every count/TopN query's sync
+    cost (round-4 finding, c4 repeat p50 ≈ 2x the sync floor)."""
     hi = jax.lax.psum(jnp.sum(per_slice >> 16, axis=0), AXIS_SLICES)
     lo = jax.lax.psum(jnp.sum(per_slice & 0xFFFF, axis=0), AXIS_SLICES)
-    return hi, lo
+    return jnp.stack([hi, lo])
 
 
 def _filtered_counts(expr, rows, leaves, threshold, tanimoto, mode):
@@ -445,7 +454,7 @@ def _topn_filtered_sharded_fn(mesh: Mesh, expr, n_leaves: int,
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(), P()) + (P(AXIS_SLICES),) * (n_leaves + 1),
-        out_specs=(P(), P()), check_vma=(mode is None)))
+        out_specs=P(), check_vma=(mode is None)))
 
 
 def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
@@ -461,10 +470,8 @@ def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
     fn = _topn_filtered_sharded_fn(mesh, expr, len(leaf_arrays),
                                    _mesh_pallas_mode(mesh))
     threshold = min(threshold, 2**31 - 1)  # counts never exceed 2^31
-    hi, lo = fn(jnp.int32(threshold), jnp.int32(tanimoto),
-                rows, *leaf_arrays)
-    hi, lo = np.asarray(hi), np.asarray(lo)
-    return [(int(hi[r]) << 16) + int(lo[r]) for r in range(rows.shape[1])]
+    return hilo_combine(fn(jnp.int32(threshold), jnp.int32(tanimoto),
+                           rows, *leaf_arrays))[:rows.shape[1]]
 
 
 def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
@@ -479,9 +486,7 @@ def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
                          " int32 hi/lo bound — use topn_exact")
     fn = _topn_exact_sharded_fn(mesh, expr, len(leaf_arrays),
                                 _mesh_pallas_mode(mesh))
-    hi, lo = fn(rows, *leaf_arrays)
-    hi, lo = np.asarray(hi), np.asarray(lo)
-    return [(int(hi[r]) << 16) + int(lo[r]) for r in range(rows.shape[1])]
+    return hilo_combine(fn(rows, *leaf_arrays))[:rows.shape[1]]
 
 
 def shard_slices_axis1(mesh: Mesh, arr: np.ndarray) -> jax.Array:
@@ -549,7 +554,7 @@ def _topn_exact_fn_cached(mesh: Mesh, expr, mode: str | None):
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES), P(None, AXIS_SLICES)),
-        out_specs=(P(), P()), check_vma=(mode is None)))
+        out_specs=P(), check_vma=(mode is None)))
 
 
 @functools.lru_cache(maxsize=256)
@@ -561,12 +566,13 @@ def _topn_filtered_fn_cached(mesh: Mesh, expr, mode: str | None):
     return jax.jit(jax.shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(), P(), P(AXIS_SLICES), P(None, AXIS_SLICES)),
-        out_specs=(P(), P()), check_vma=(mode is None)))
+        out_specs=P(), check_vma=(mode is None)))
 
 
 def topn_filtered_fn(mesh: Mesh, expr):
     """The streaming-layout filtered TopN program: ``(threshold,
-    tanimoto, rows [S, R, W], leaves [L, S, W]) → per-row (hi, lo)``,
+    tanimoto, rows [S, R, W], leaves [L, S, W]) → stacked [2, R]
+    per-row (hi, lo)`` (decode via hilo_combine),
     with per-slice threshold/Tanimoto pruning before the psum. Public
     for the pod layer (parallel.multihost), like topn_exact_fn."""
     return _topn_filtered_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
@@ -575,7 +581,8 @@ def topn_filtered_fn(mesh: Mesh, expr):
 def topn_exact_fn(mesh: Mesh, expr):
     """Exact candidate counts across slices, one psum-reduced program.
 
-    rows [S, R, W] (candidate row blocks per slice) → per-row (hi, lo)
+    rows [S, R, W] (candidate row blocks per slice) → stacked [2, R]
+    per-row (hi, lo) — decode via hilo_combine
     16-bit halves of ``popcount(row ∩ expr)`` (or plain row popcount
     when expr is None), summed over every slice — the device form of
     the executor's TopN exact-count re-query (executor.go:273-310
@@ -654,11 +661,10 @@ def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
             if rem:
                 rc = np.pad(rc, [(0, n_dev - rem), (0, 0), (0, 0)])
                 lcc = np.pad(lcc, [(0, 0), (0, n_dev - rem), (0, 0)])
-            hi, lo = fn(shard_slices(mesh, rc),
-                        shard_slices_axis1(mesh, lcc))
-            hi, lo = np.asarray(hi), np.asarray(lo)
+            counts = hilo_combine(fn(shard_slices(mesh, rc),
+                                     shard_slices_axis1(mesh, lcc)))
             for r in range(rc.shape[1]):
-                totals[r_off + r] += (int(hi[r]) << 16) + int(lo[r])
+                totals[r_off + r] += counts[r]
     return totals
 
 

@@ -179,10 +179,9 @@ def count_exprs(mesh: Mesh, exprs: tuple,
     for off in range(0, max(local_leaves.shape[1], 1), step):
         chunk = _pad_local(local_leaves[:, off:off + step], 1)
         arr = _global_from_local(mesh, chunk, 1)
-        hi, lo = fn(arr)
-        hi, lo = np.asarray(hi), np.asarray(lo)
+        counts = mesh_mod.hilo_combine(fn(arr))  # [2, K]: one fetch
         for k in range(len(exprs)):
-            totals[k] += (int(hi[k]) << 16) + int(lo[k])
+            totals[k] += counts[k]
     return totals
 
 
@@ -222,8 +221,7 @@ def topn_exact(mesh: Mesh, expr, local_rows: np.ndarray,
             lc = _pad_local(local_leaves[:, s_off:s_off + s_step], 1)
             rows = _global_from_local(mesh, rc, 0)
             leaves = _global_from_local(mesh, lc, 1)
-            hi, lo = fn(rows, leaves)
-            hi, lo = np.asarray(hi), np.asarray(lo)
+            counts = mesh_mod.hilo_combine(fn(rows, leaves))  # 1 fetch
             for r in range(rc.shape[1]):
-                totals[r_off + r] += (int(hi[r]) << 16) + int(lo[r])
+                totals[r_off + r] += counts[r]
     return totals
