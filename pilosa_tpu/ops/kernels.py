@@ -26,6 +26,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _BITWISE = {
     "and": jnp.bitwise_and,
@@ -52,7 +53,9 @@ def _op_count_total_parts(op: str, a: jax.Array, b: jax.Array):
     # Split per-row counts into 16-bit halves before the cross-row reduce:
     # int64 is unavailable without x64, and a plain int32 sum overflows past
     # 2^31 total bits. Exact for ≤ 2^15 rows (lo ≤ 65535·2^15 < 2^31).
-    return jnp.sum(row >> 16), jnp.sum(row & 0xFFFF)
+    # Stacked into ONE output: separate outputs each pay a host-fetch
+    # round trip (~65 ms through a tunnel).
+    return jnp.stack([jnp.sum(row >> 16), jnp.sum(row & 0xFFFF)])
 
 
 def op_count_total(op: str, a: jax.Array, b: jax.Array) -> int:
@@ -65,8 +68,8 @@ def op_count_total(op: str, a: jax.Array, b: jax.Array) -> int:
     """
     if a.ndim > 1 and a.shape[0] > (1 << 15):
         raise ValueError("op_count_total: more than 2^15 rows per call")
-    hi, lo = _op_count_total_parts(op, a, b)
-    return (int(hi) << 16) + int(lo)
+    hilo = np.asarray(_op_count_total_parts(op, a, b))
+    return (int(hilo[0]) << 16) + int(hilo[1])
 
 
 @jax.jit
