@@ -232,9 +232,8 @@ _cache_mu = threading.Lock()
 
 
 def _cal_path(key: str) -> str:
-    cache = os.environ.get("PILOSA_TPU_CACHE") or os.path.join(
-        os.path.expanduser("~"), ".cache", "pilosa_tpu")
-    return os.path.join(cache, f"costcal-{key}.json")
+    from ..utils import cache_dir
+    return cache_dir(f"costcal-{key}.json")
 
 
 def _persist_calibration(key: str, cal: Calibration) -> None:

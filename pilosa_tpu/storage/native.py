@@ -31,8 +31,8 @@ def _so_path() -> str:
     # SIGILL. Never ship the artifact, always rebuild per (machine, source).
     with open(_SRC, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    cache = os.environ.get("PILOSA_TPU_CACHE") or os.path.join(
-        os.path.expanduser("~"), ".cache", "pilosa_tpu")
+    from ..utils import cache_dir
+    cache = cache_dir()
     os.makedirs(cache, exist_ok=True)
     return os.path.join(cache, f"libbitops-{digest}.so")
 

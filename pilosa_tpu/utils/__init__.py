@@ -1,0 +1,11 @@
+
+
+def cache_dir(*parts: str) -> str:
+    """The per-machine cache base (PILOSA_TPU_CACHE overrides
+    ~/.cache/pilosa_tpu) joined with ``parts`` — one definition for
+    the native-lib build dir, cost-model calibrations, and the XLA
+    persistent compile cache."""
+    import os
+    base = os.environ.get("PILOSA_TPU_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "pilosa_tpu")
+    return os.path.join(base, *parts)

@@ -91,3 +91,39 @@ class TestQueryStep:
             _popcount(np.bitwise_and(rows[:, r, :], inter))
             for r in range(R)])
         assert list(vals) == sorted(want, reverse=True)[:3]
+
+
+class TestCompileCache:
+    def test_arm_respects_disable_and_override(self, monkeypatch,
+                                               tmp_path):
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        import jax
+        prior_dir = jax.config.jax_compilation_cache_dir
+        prior_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        try:
+            # disabled: config untouched
+            monkeypatch.setattr(mesh_mod, "_compile_cache_armed", False)
+            monkeypatch.setenv("PILOSA_TPU_COMPILE_CACHE", "0")
+            mesh_mod._arm_compile_cache()
+            assert (jax.config.jax_compilation_cache_dir
+                    == prior_dir)
+            # explicit dir: set + created (even off-TPU — explicit
+            # opt-in overrides the platform gate)
+            monkeypatch.setattr(mesh_mod, "_compile_cache_armed", False)
+            target = str(tmp_path / "xla")
+            monkeypatch.setenv("PILOSA_TPU_COMPILE_CACHE", target)
+            mesh_mod._arm_compile_cache()
+            assert jax.config.jax_compilation_cache_dir == target
+            import os
+            assert os.path.isdir(target)
+            # idempotent: second call is a no-op even with env changed
+            monkeypatch.setenv("PILOSA_TPU_COMPILE_CACHE", "0")
+            mesh_mod._arm_compile_cache()
+            assert jax.config.jax_compilation_cache_dir == target
+        finally:
+            # jax.config is process-global: restore so later tests are
+            # order-independent (review finding).
+            jax.config.update("jax_compilation_cache_dir", prior_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                prior_min)
