@@ -230,6 +230,22 @@ def main() -> None:
                 line["write_path"] = json.load(f)
         except (OSError, ValueError, KeyError):
             pass
+        # Roofline accounting (VERDICT r4 item 4): effective HBM GB/s of
+        # THIS run's number (arithmetic, a measurement) + the untunneled
+        # v5e-8 projections for configs 4-5 (labeled projections, from
+        # recorded kernel times — benchmarks/roofline.py).
+        try:
+            from benchmarks import roofline
+            roof = roofline.compute(metric_ops_s=line["value"])
+            line["effective_hbm_gbps"] = \
+                roof["metric_of_record"]["effective_hbm_gbps"]
+            line["hbm_fraction_of_v5e_peak"] = \
+                roof["metric_of_record"]["fraction_of_v5e_peak"]
+            with open(os.path.join(os.path.dirname(_BASELINE_PATH),
+                                   "ROOFLINE.json"), "w") as f:
+                json.dump(roof, f, indent=1)
+        except Exception:  # noqa: BLE001 - accounting must not kill the line
+            pass
         print(json.dumps(line))
     else:
         # Fail-soft: record the host-C++ denominator so the round still

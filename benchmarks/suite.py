@@ -464,6 +464,19 @@ def config_host_write_and_import() -> None:
                            f' columnID={i * 13 % (1 << 20)})')
             setbit_exec = k / (time.perf_counter() - t0)
             emit("host_setbit_inprocess", setbit_exec, "ops/sec")
+            # Batched bodies (1000 SetBits per query): the executor's
+            # mutate-batch run + fast-path parse (round 5).
+            kb = max(1000, int(100_000 * SCALE))
+            queries = ["\n".join(
+                f'SetBit(frame="f", rowID={i % 50},'
+                f' columnID={i * 13 % (1 << 20)})'
+                for i in range(s, min(s + 1000, kb)))
+                for s in range(0, kb, 1000)]
+            t0 = time.perf_counter()
+            for q in queries:
+                ex.execute("bench", q)
+            emit("host_setbit_inprocess_batched",
+                 kb / (time.perf_counter() - t0), "ops/sec")
             ex.close()
         finally:
             holder.close()
@@ -525,6 +538,26 @@ def _write_denominator(setbit_exec: float) -> None:
     emit("host_setbit_fragment", frag_ops, "ops/sec",
          p999_ms=round(p999_ms, 2), max_ms=round(max_ms, 1))
 
+    # The batched serving path (round-5: one native crossing + one WAL
+    # group-commit per batch — how query fan-outs and pipelined bodies
+    # actually hit the fragment). Same workload, same durability.
+    batch_ops = {}
+    for B in (1000, 4000):
+        with tempfile.TemporaryDirectory() as d:
+            frag = Fragment(os.path.join(d, "frag"), "bench", "f",
+                            "standard", 0)
+            frag.open()
+            try:
+                t0 = time.perf_counter()
+                for s in range(0, n, B):
+                    frag.set_bits(rows[s:s + B], cols[s:s + B])
+                frag._join_snapshot()
+                batch_ops[B] = n / (time.perf_counter() - t0)
+            finally:
+                frag.close()
+        emit(f"host_setbit_fragment_batched_b{B}", batch_ops[B],
+             "ops/sec")
+
     # Key carries the op count: snapshot amortization scales with run
     # length, so a short smoke run must not pin the canonical shape.
     pinned = (pin_best(f"setbit_native,n={n}", native_ops)
@@ -532,10 +565,14 @@ def _write_denominator(setbit_exec: float) -> None:
     art = {"setbit_native_ops": round(native_ops, 1) if native_ops else None,
            "setbit_native_pinned_ops": round(pinned, 1) if pinned else None,
            "setbit_fragment_ops": round(frag_ops, 1),
+           "setbit_fragment_batched_b1000_ops": round(batch_ops[1000], 1),
+           "setbit_fragment_batched_b4000_ops": round(batch_ops[4000], 1),
            "setbit_fragment_p999_ms": round(p999_ms, 2),
            "setbit_executor_ops": round(setbit_exec, 1),
            "fragment_vs_native_pinned": (
-               round(pinned / frag_ops, 2) if pinned else None)}
+               round(pinned / frag_ops, 2) if pinned else None),
+           "batched_vs_native_pinned": (
+               round(pinned / batch_ops[4000], 2) if pinned else None)}
     emit("write_denominator", art["fragment_vs_native_pinned"] or 0.0,
          "x_native_over_fragment", **art)
     with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),

@@ -64,7 +64,8 @@ STATE_DEAD = "dead"
 # beats alive; an alive claim only un-suspects with a HIGHER incarnation).
 _STATE_RANK = {STATE_ALIVE: 0, STATE_SUSPECT: 1, STATE_DEAD: 2}
 
-_HMAC_TAG = b"PGS1"  # sealed-frame magic
+_HMAC_TAG = b"PGS1"     # sealed-frame magic
+_HMAC_TS_TAG = b"PGS2"  # sealed frame with replay-bound timestamp
 _HMAC_LEN = 32
 
 
@@ -128,6 +129,7 @@ class GossipNodeSet:
                  retransmit_mult: int = 3, indirect_probes: int = 3,
                  suspect_timeout: Optional[float] = None,
                  secret_key: Optional[bytes] = None,
+                 replay_window: Optional[float] = None,
                  logger=logger_mod.NOP):
         self.host = host
         self.logger = logger
@@ -139,13 +141,29 @@ class GossipNodeSet:
         self.suspect_after = suspect_after
         self.retransmit_mult = retransmit_mult
         self.indirect_probes = indirect_probes
-        # Refutation window before a suspect is declared dead
-        # (memberlist's SuspicionMult scaled to the probe cadence).
-        self.suspect_timeout = (suspect_timeout if suspect_timeout
-                                is not None else 4.0 * probe_interval)
+        # Refutation window before a suspect is declared dead. None =
+        # auto-scale with cluster size, memberlist's SuspicionMult
+        # policy: bigger clusters need more protocol periods for the
+        # rumor to reach the suspect and the refutation to travel back
+        # (advisor r4: a fixed 4-period window made refutation a no-op
+        # under loss in clusters > 4 nodes).
+        self.suspect_timeout = suspect_timeout
         if isinstance(secret_key, str):
             secret_key = secret_key.encode()
+        # NOTE (replay): the HMAC tag authenticates frame CONTENTS only.
+        # Without ``replay_window``, a captured frame (an old suspect
+        # rumor, a stale push/pull) can be replayed verbatim by an
+        # on-path attacker; incarnation rules bound the resulting churn
+        # but do not eliminate it. Set ``replay_window`` (seconds) to
+        # bind a timestamp under the MAC and reject frames older than
+        # the window — requires member clocks within the window of each
+        # other, which is why it is opt-in.
         self.secret_key = secret_key
+        self.replay_window = replay_window
+        # Test hook: loss_filter(dest_addr, pkt) -> True drops the
+        # datagram (deterministic loss/asymmetry injection; UDP loss on
+        # send is indistinguishable from loss on the wire).
+        self.loss_filter = None
 
         self._handler = None          # server: BroadcastHandler+StatusHandler
         self._mu = threading.Lock()
@@ -382,18 +400,37 @@ class GossipNodeSet:
             return Member(m.name, m.addr, m.incarnation, m.state)
 
     def _gossip_update(self, m: Member) -> None:
-        """Spread a membership rumor to a few random peers immediately."""
+        """Spread a membership rumor to a few random peers immediately.
+
+        One-shot sends can die out under loss, but state rumors are NOT
+        fire-and-forget overall: every probe/ack/push-pull piggybacks
+        the full membership table (_packet), and every state-CHANGING
+        merge re-triggers this spread — memberlist's retransmit-queue
+        effect without a second queue. A non-alive rumor is ALSO sent
+        straight to its subject, so the suspect learns of its suspicion
+        in one hop and can refute within the window (advisor r4)."""
         pkt = self._packet("update", updates=[m.to_wire()])
         peers = self._alive_peers()
         for peer in random.sample(peers, min(3, len(peers))):
             self._udp_send(peer.addr, pkt)
+        if m.state != STATE_ALIVE and m.name != self.host:
+            self._udp_send(m.addr, pkt)
 
     # -- frame auth ----------------------------------------------------------
 
     def _seal(self, payload: bytes) -> bytes:
-        """Tag a frame with HMAC-SHA256 when a secret key is set."""
+        """Tag a frame with HMAC-SHA256 when a secret key is set. With
+        ``replay_window`` enabled, an 8-byte wall-clock timestamp is
+        bound under the MAC so stale captures can be rejected (see the
+        replay NOTE in __init__)."""
         if self.secret_key is None:
             return payload
+        if self.replay_window is not None:
+            ts = struct.pack(">d", time.time())
+            body = ts + payload
+            mac = hmac_mod.new(self.secret_key, body,
+                               hashlib.sha256).digest()
+            return _HMAC_TS_TAG + mac + body
         mac = hmac_mod.new(self.secret_key, payload,
                            hashlib.sha256).digest()
         return _HMAC_TAG + mac + payload
@@ -404,6 +441,20 @@ class GossipNodeSet:
         parser (the spoofed-datagram hole in round 3's SWIM-lite)."""
         if self.secret_key is None:
             return data
+        if self.replay_window is not None:
+            if (len(data) < len(_HMAC_TS_TAG) + _HMAC_LEN + 8
+                    or not data.startswith(_HMAC_TS_TAG)):
+                return None
+            mac = data[len(_HMAC_TS_TAG):len(_HMAC_TS_TAG) + _HMAC_LEN]
+            body = data[len(_HMAC_TS_TAG) + _HMAC_LEN:]
+            want = hmac_mod.new(self.secret_key, body,
+                                hashlib.sha256).digest()
+            if not hmac_mod.compare_digest(mac, want):
+                return None
+            (ts,) = struct.unpack(">d", body[:8])
+            if abs(time.time() - ts) > self.replay_window:
+                return None  # stale capture (or clocks beyond window)
+            return body[8:]
         if (len(data) < len(_HMAC_TAG) + _HMAC_LEN
                 or not data.startswith(_HMAC_TAG)):
             return None
@@ -437,6 +488,8 @@ class GossipNodeSet:
                 "updates": updates, "bcasts": bcasts, **kw}
 
     def _udp_send(self, addr: str, pkt: dict) -> None:
+        if self.loss_filter is not None and self.loss_filter(addr, pkt):
+            return  # injected datagram loss (tests)
         try:
             self._udp.sendto(self._seal(json.dumps(pkt).encode()),
                              _split_addr(addr))
@@ -687,21 +740,32 @@ class GossipNodeSet:
                 " marking suspect", suspect.name, self.suspect_after)
             self._gossip_update(suspect)
 
+    def _suspect_window(self, n_members: int) -> float:
+        """Seconds a suspect has to refute. Explicit override wins;
+        otherwise memberlist's SuspicionMult shape — protocol periods
+        scaled by log(cluster size), so the rumor can reach the suspect
+        and the refutation can travel back even with loss."""
+        if self.suspect_timeout is not None:
+            return self.suspect_timeout
+        return 4.0 * self.probe_interval * max(
+            1.0, math.log2(n_members + 1))
+
     def _expire_suspects(self) -> None:
         """Suspects whose refutation window lapsed are declared dead."""
         now = time.monotonic()
         dead = []
         with self._mu:
+            window = self._suspect_window(len(self._members))
             for m in self._members.values():
                 if (m.state == STATE_SUSPECT
-                        and now - m.suspect_at > self.suspect_timeout):
+                        and now - m.suspect_at > window):
                     m.state = STATE_DEAD
                     dead.append(Member(m.name, m.addr, m.incarnation,
                                        STATE_DEAD))
         for d in dead:
             self.logger.printf(
                 "gossip: suspect %s not refuted in %.1fs, declaring"
-                " dead", d.name, self.suspect_timeout)
+                " dead", d.name, window)
             self._gossip_update(d)
 
 

@@ -187,6 +187,17 @@ class Frame:
                   t: Optional[dt.datetime] = None) -> bool:
         return self._mutate(view_name, row_id, col_id, t, set=False)
 
+    def mutate_bits(self, view_name: str, row_ids, col_ids,
+                    set: bool) -> "np.ndarray":
+        """Batched timestamp-free set/clear on one view (the executor's
+        SetBit-run fast path; timestamped ops stay per-op because the
+        time-view fan-out is per-quantum). Returns per-op changed
+        bools."""
+        if not is_valid_view(view_name):
+            raise PilosaError(f"invalid view: {view_name!r}")
+        view = self.create_view_if_not_exists(view_name)
+        return view.mutate_bits(row_ids, col_ids, set)
+
     def _mutate(self, view_name: str, row_id: int, col_id: int,
                 t: Optional[dt.datetime], set: bool) -> bool:
         if not is_valid_view(view_name):

@@ -121,3 +121,35 @@ class View:
     def clear_bit(self, row_id: int, column_id: int) -> bool:
         frag = self.create_fragment_if_not_exists(column_id // SLICE_WIDTH)
         return frag.clear_bit(row_id, column_id)
+
+    def mutate_bits(self, row_ids: np.ndarray, column_ids: np.ndarray,
+                    set: bool) -> np.ndarray:
+        """Batched set/clear through the fragments' native batch engine:
+        one stable argsort groups the ops by slice, one batched mutation
+        per touched fragment. Returns a per-op changed bool array (WAL'd
+        durability identical to the per-op path — fragment.set_bits)."""
+        import numpy as _np
+        rows = _np.asarray(row_ids, dtype=_np.uint64)
+        cols = _np.asarray(column_ids, dtype=_np.uint64)
+        changed = _np.zeros(len(rows), dtype=bool)
+        if not len(rows):
+            return changed
+        slices = cols // _np.uint64(SLICE_WIDTH)
+        order = _np.argsort(slices, kind="stable")
+        srt = slices[order]
+        bounds = _np.flatnonzero(srt[1:] != srt[:-1]) + 1
+        starts = _np.concatenate(([0], bounds, [len(srt)]))
+        w = _np.uint64(SLICE_WIDTH)
+        for s, e in zip(starts[:-1].tolist(), starts[1:].tolist()):
+            idx = order[s:e]
+            frag = self.create_fragment_if_not_exists(int(srt[s]))
+            op = frag.set_bits if set else frag.clear_bits
+            ch_pos = op(rows[idx], cols[idx])
+            if len(ch_pos):
+                pos = rows[idx] * w + cols[idx] % w
+                # Only the FIRST occurrence of a duplicated op changed
+                # (per-op semantics: the repeat is an idempotent no-op).
+                uniq, first = _np.unique(pos, return_index=True)
+                hit = _np.isin(uniq, ch_pos, assume_unique=True)
+                changed[idx[first[hit]]] = True
+        return changed

@@ -129,7 +129,272 @@ int64_t unpack_words_u32(const uint32_t* words, int64_t n_words,
     return k;
 }
 
+// ---- batched write engine ---------------------------------------------------
+// ONE crossing per mutation batch: container merges, changed-value
+// detection, and WAL record construction all happen here, so the serving
+// write path runs at compiled speed with ctypes overhead amortized over
+// the whole batch (per-op ctypes was measured a loss; see
+// storage/native.py). The reference's equivalent per-op loop is
+// fragment.go:369-459; this is its batch-grouped native form.
+//
+// Group layout (caller = roaring.Bitmap.apply_batch): one group per
+// touched container, in key order. types[g]: 0 = array container
+// (sorted u32 values at arr_ptrs[g], count arr_ns[g]); 1 = bitmap
+// container (u64[1024] at arr_ptrs[g], mutated IN PLACE — caller
+// guarantees copy-on-write happened). chunk values are sorted, unique,
+// < 65536.
+//
+// Outputs per group:
+//   out_kind[g]: 0 = merged array written at out_vals[out_offsets[g]]
+//                1 = converted to bitmap at out_bitmaps[out_bm_idx[g]*1024]
+//                2 = existing bitmap mutated in place
+//   out_ns[g]:   new container cardinality
+// Changed (newly set / newly cleared) global positions (keys[g]<<16 | v)
+// are appended to `changed`; when wal_op_type >= 0 a 13-byte WAL record
+// (type, u64 LE value, FNV-1a32 of the first 9 bytes) per changed value
+// is appended to `wal`. Returns total changed count.
+
+namespace {
+
+const int64_t kWordsPerContainer = 1024;  // u64 words per bitmap container
+
+inline void wal_record(uint8_t* rec, uint8_t typ, uint64_t pos) {
+    rec[0] = typ;
+    memcpy(rec + 1, &pos, 8);
+    uint32_t h = 2166136261u;
+    for (int i = 0; i < 9; i++) h = (h ^ rec[i]) * 16777619u;
+    memcpy(rec + 9, &h, 4);
+}
+
+}  // namespace
+
+extern "C" int64_t batch_add(
+        int64_t n_groups, const uint64_t* keys, const uint8_t* types,
+        const uint64_t* arr_ptrs, const int64_t* arr_ns,
+        const uint32_t* chunk_vals, const int64_t* chunk_starts,
+        uint32_t* out_vals, int64_t* out_offsets, int64_t* out_ns,
+        uint8_t* out_kind, uint64_t* out_bitmaps, int64_t* out_bm_idx,
+        uint64_t* changed, uint8_t* wal, int64_t wal_op_type) {
+    int64_t n_changed = 0, out_off = 0, bm_count = 0;
+    for (int64_t g = 0; g < n_groups; g++) {
+        const uint32_t* b = chunk_vals + chunk_starts[g];
+        int64_t nb = chunk_starts[g + 1] - chunk_starts[g];
+        uint64_t base = keys[g] << 16;
+        int64_t before_changed = n_changed;
+        if (types[g] == 1) {  // bitmap container, in-place
+            uint64_t* bm = (uint64_t*)arr_ptrs[g];
+            int64_t n = arr_ns[g];
+            for (int64_t i = 0; i < nb; i++) {
+                uint32_t v = b[i];
+                uint64_t bit = 1ULL << (v & 63);
+                if (bm[v >> 6] & bit) continue;
+                bm[v >> 6] |= bit;
+                n++;
+                changed[n_changed++] = base | v;
+            }
+            out_kind[g] = 2;
+            out_ns[g] = n;
+            out_bm_idx[g] = -1;
+            out_offsets[g] = -1;
+        } else {  // array container: two-pointer union into out_vals
+            const uint32_t* a = (const uint32_t*)arr_ptrs[g];
+            int64_t na = arr_ns[g];
+            uint32_t* out = out_vals + out_off;
+            int64_t i = 0, j = 0, k = 0;
+            while (i < na && j < nb) {
+                if (a[i] < b[j]) out[k++] = a[i++];
+                else if (a[i] > b[j]) out[k++] = b[j++];
+                else { out[k++] = a[i]; i++; j++; }
+            }
+            while (i < na) out[k++] = a[i++];
+            while (j < nb) out[k++] = b[j++];
+            // changed = chunk values not present in the existing array
+            // (second pass keeps the union loop branch-light).
+            i = 0; j = 0;
+            while (j < nb) {
+                while (i < na && a[i] < b[j]) i++;
+                if (i >= na || a[i] != b[j]) changed[n_changed++] = base | b[j];
+                j++;
+            }
+            if (k > 4096) {  // convert to bitmap container
+                uint64_t* bm = out_bitmaps + bm_count * kWordsPerContainer;
+                memset(bm, 0, kWordsPerContainer * 8);
+                for (int64_t t = 0; t < k; t++)
+                    bm[out[t] >> 6] |= 1ULL << (out[t] & 63);
+                out_kind[g] = 1;
+                out_bm_idx[g] = bm_count++;
+                out_offsets[g] = -1;
+            } else {
+                out_kind[g] = 0;
+                out_offsets[g] = out_off;
+                out_bm_idx[g] = -1;
+                out_off += k;
+            }
+            out_ns[g] = k;
+        }
+        if (wal_op_type >= 0) {
+            for (int64_t t = before_changed; t < n_changed; t++)
+                wal_record(wal + t * 13, (uint8_t)wal_op_type, changed[t]);
+        }
+    }
+    return n_changed;
+}
+
+// Batched remove. Same group layout as batch_add. Array groups write the
+// difference to out_vals (kind 0). Bitmap groups clear in place; if the
+// result drops to <=4096 values it is UNPACKED to an array in out_vals
+// (kind 0) to restore the serialization invariant, else kind 2.
+extern "C" int64_t batch_remove(
+        int64_t n_groups, const uint64_t* keys, const uint8_t* types,
+        const uint64_t* arr_ptrs, const int64_t* arr_ns,
+        const uint32_t* chunk_vals, const int64_t* chunk_starts,
+        uint32_t* out_vals, int64_t* out_offsets, int64_t* out_ns,
+        uint8_t* out_kind, uint64_t* changed, uint8_t* wal,
+        int64_t wal_op_type) {
+    int64_t n_changed = 0, out_off = 0;
+    for (int64_t g = 0; g < n_groups; g++) {
+        const uint32_t* b = chunk_vals + chunk_starts[g];
+        int64_t nb = chunk_starts[g + 1] - chunk_starts[g];
+        uint64_t base = keys[g] << 16;
+        int64_t before_changed = n_changed;
+        if (types[g] == 1) {
+            uint64_t* bm = (uint64_t*)arr_ptrs[g];
+            int64_t n = arr_ns[g];
+            for (int64_t i = 0; i < nb; i++) {
+                uint32_t v = b[i];
+                uint64_t bit = 1ULL << (v & 63);
+                if (!(bm[v >> 6] & bit)) continue;
+                bm[v >> 6] &= ~bit;
+                n--;
+                changed[n_changed++] = base | v;
+            }
+            if (n <= 4096) {  // unpack to array (serialization invariant)
+                uint32_t* out = out_vals + out_off;
+                int64_t k = 0;
+                for (int64_t w = 0; w < kWordsPerContainer; w++) {
+                    uint64_t word = bm[w];
+                    while (word) {
+                        int bit = __builtin_ctzll(word);
+                        out[k++] = (uint32_t)(w * 64 + bit);
+                        word &= word - 1;
+                    }
+                }
+                out_kind[g] = 0;
+                out_offsets[g] = out_off;
+                out_off += k;
+            } else {
+                out_kind[g] = 2;
+                out_offsets[g] = -1;
+            }
+            out_ns[g] = n;
+        } else {
+            const uint32_t* a = (const uint32_t*)arr_ptrs[g];
+            int64_t na = arr_ns[g];
+            uint32_t* out = out_vals + out_off;
+            int64_t i = 0, j = 0, k = 0;
+            while (i < na) {
+                while (j < nb && b[j] < a[i]) j++;
+                if (j < nb && b[j] == a[i]) {
+                    changed[n_changed++] = base | a[i];
+                    i++;
+                } else {
+                    out[k++] = a[i++];
+                }
+            }
+            out_kind[g] = 0;
+            out_offsets[g] = out_off;
+            out_ns[g] = k;
+            out_off += k;
+        }
+        if (wal_op_type >= 0) {
+            for (int64_t t = before_changed; t < n_changed; t++)
+                wal_record(wal + t * 13, (uint8_t)wal_op_type, changed[t]);
+        }
+    }
+    return n_changed;
+}
+
 }  // extern "C"
+
+// ---- native snapshot writer -------------------------------------------------
+// Serializes a whole roaring snapshot (cookie/keyN/headers/offsets/container
+// blocks — the reference format, roaring.go:475-533) straight from a table of
+// container buffer pointers, using writev batches that point INTO the
+// container buffers (zero copy, no GIL held during the call). The table is
+// maintained incrementally by the batched write path, so the MAX_OP_N
+// snapshot cadence stops costing O(all containers) of Python per rewrite.
+
+#include <cstdlib>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+bool writev_full(int fd, struct iovec* iov, int n) {
+    while (n > 0) {
+        ssize_t w = writev(fd, iov, n);
+        if (w < 0) return false;
+        while (n > 0 && (size_t)w >= iov[0].iov_len) {
+            w -= iov[0].iov_len;
+            iov++;
+            n--;
+        }
+        if (n > 0) {  // partial iovec
+            iov[0].iov_base = (uint8_t*)iov[0].iov_base + w;
+            iov[0].iov_len -= w;
+        }
+    }
+    return true;
+}
+
+}  // namespace
+
+extern "C" int64_t write_snapshot_fd(
+        int fd, int64_t n_cont, const uint64_t* keys, const int64_t* ns,
+        const uint8_t* types, const uint64_t* ptrs) {
+    int64_t live = 0, body = 0;
+    for (int64_t i = 0; i < n_cont; i++) {
+        if (ns[i] == 0) continue;
+        live++;
+        body += types[i] ? kWordsPerContainer * 8 : ns[i] * 4;
+    }
+    int64_t head_len = 8 + live * 12 + live * 4;
+    uint8_t* head = (uint8_t*)malloc(head_len ? head_len : 1);
+    if (!head) return -1;
+    uint32_t cookie = 12346, nl = (uint32_t)live;
+    memcpy(head, &cookie, 4);
+    memcpy(head + 4, &nl, 4);
+    uint8_t* hp = head + 8;
+    uint32_t* offp = (uint32_t*)(head + 8 + live * 12);
+    uint32_t off = (uint32_t)head_len;
+    for (int64_t i = 0; i < n_cont; i++) {
+        if (ns[i] == 0) continue;
+        memcpy(hp, &keys[i], 8);
+        uint32_t nm1 = (uint32_t)(ns[i] - 1);
+        memcpy(hp + 8, &nm1, 4);
+        hp += 12;
+        *offp++ = off;
+        off += types[i] ? kWordsPerContainer * 8 : (uint32_t)(ns[i] * 4);
+    }
+    struct iovec hv = {head, (size_t)head_len};
+    if (!writev_full(fd, &hv, 1)) { free(head); return -1; }
+    free(head);
+    // Container blocks via writev, IOV_MAX-sized batches, zero copy.
+    const int kBatch = 1024;
+    struct iovec iov[kBatch];
+    int in = 0;
+    for (int64_t i = 0; i < n_cont; i++) {
+        if (ns[i] == 0) continue;
+        iov[in].iov_base = (void*)ptrs[i];
+        iov[in].iov_len = types[i] ? kWordsPerContainer * 8 : ns[i] * 4;
+        if (++in == kBatch) {
+            if (!writev_full(fd, iov, in)) return -1;
+            in = 0;
+        }
+    }
+    if (in && !writev_full(fd, iov, in)) return -1;
+    return head_len + body;
+}
 
 // ---- native write-path micro-engine ----------------------------------------
 // The measured host denominator for the SetBit path (the reference's is

@@ -82,7 +82,8 @@ class Calibration:
 
 # Feedback-loop tuning: recalibrate a leg once it has DRIFT_MIN_SAMPLES
 # observations whose median actual/predicted ratio leaves
-# [1/DRIFT_BOUND, DRIFT_BOUND]; scales clamp to [1/16, 16].
+# [1/DRIFT_BOUND, DRIFT_BOUND]; scales clamp to
+# [1/_SCALE_CLAMP, _SCALE_CLAMP].
 DRIFT_MIN_SAMPLES = 12
 DRIFT_BOUND = 2.0
 # Wide clamp: startup probes on shared VMs have been observed ~100x off

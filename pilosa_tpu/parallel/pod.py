@@ -215,6 +215,16 @@ class Pod:
                 if conn is None:
                     conn = http.client.HTTPConnection(
                         self.peers[pid], timeout=self.timeout)
+                else:
+                    # Apply the CURRENT pod timeout to the pooled
+                    # socket: a connection created during a tight phase
+                    # (schema replication, kill detection) must not pin
+                    # its old deadline onto a phase that legitimately
+                    # allows longer legs (8-way cold-compile warm-up),
+                    # nor the reverse.
+                    conn.timeout = self.timeout
+                    if conn.sock is not None:
+                        conn.sock.settimeout(self.timeout)
                 try:
                     # Accept mirrors Content-Type: the /import route
                     # negotiates strictly on both (handler 406/415).

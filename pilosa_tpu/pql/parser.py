@@ -320,5 +320,58 @@ class Parser:
                 raise ParseError(pos, f"expected comma, found {lit!r}")
 
 
+# Fast path for flat call lists — the serving hot shapes
+# (SetBit/ClearBit/Bitmap/TopN streams of key=value args, no children,
+# no escapes): one anchored regex per call instead of ~17 scanner
+# tokens. Strings are restricted to charset-safe bodies (no quotes,
+# escapes, or separators) so the arg split is unambiguous; ANY mismatch
+# falls back to the full parser, which keeps exact reference error
+# semantics (pql/parser.go:66-260).
+_FAST_ARG = (r"[A-Za-z][A-Za-z0-9_\-.]*\s*=\s*"
+             r"(?:-?[0-9]+|\"[A-Za-z0-9 _\-.:]*\"|'[A-Za-z0-9 _\-.:]*')")
+_FAST_CALL_RE = re.compile(
+    r"\s*([A-Za-z][A-Za-z0-9_\-.]*)\(\s*(?:(" + _FAST_ARG
+    + r"(?:\s*,\s*" + _FAST_ARG + r")*))?\s*\)\s*")
+_FAST_ARG_RE = re.compile(
+    r"([A-Za-z][A-Za-z0-9_\-.]*)\s*=\s*"
+    r"(?:(-?[0-9]+)|\"([A-Za-z0-9 _\-.:]*)\"|'([A-Za-z0-9 _\-.:]*)')")
+
+
+def _parse_fast(text: str):
+    """Query for a flat call list, or None when any call needs the full
+    grammar (children, lists, floats, escapes, bool/null idents)."""
+    query = Query()
+    i = 0
+    n = len(text)
+    while i < n:
+        m = _FAST_CALL_RE.match(text, i)
+        if m is None:
+            return None if text[i:].strip() else query
+        call = Call()
+        call.name = m.group(1)
+        body = m.group(2)
+        if body:
+            args = call.args
+            count = 0
+            for am in _FAST_ARG_RE.finditer(body):
+                key, intv, dq, sq = am.groups()
+                if intv is not None:
+                    v = int(intv)
+                    if not -(1 << 63) <= v < 1 << 63:
+                        return None  # full parser raises the bound error
+                    args[key] = v
+                else:
+                    args[key] = dq if dq is not None else sq
+                count += 1
+            if len(args) != count:
+                return None  # duplicate key: full parser raises
+        query.calls.append(call)
+        i = m.end()
+    return query
+
+
 def parse(text: str) -> Query:
+    fast = _parse_fast(text)
+    if fast is not None:
+        return fast
     return Parser(text).parse()

@@ -10,11 +10,10 @@ the five schema-mutation broadcasts (server.go:255-300).
 
 from __future__ import annotations
 
+import json
 import threading
 import time
-from socketserver import ThreadingMixIn
 from typing import Optional
-from wsgiref.simple_server import WSGIRequestHandler, WSGIServer, make_server
 
 from ..cluster.broadcast import NOP_BROADCASTER, StaticNodeSet
 from ..cluster.client import Client
@@ -29,20 +28,11 @@ from ..proto import internal_pb2 as pb
 from ..utils import logger as logger_mod
 from ..utils.stats import NOP
 from .handler import Handler
+from .httpd import HTTPServer
 
 DEFAULT_ANTI_ENTROPY_INTERVAL = 600.0   # seconds (server.go:37)
 DEFAULT_POLLING_INTERVAL = 60.0         # max-slice poll (server.go:33)
 CACHE_FLUSH_INTERVAL = 60.0             # holder.go:31
-
-
-class _ThreadingWSGIServer(ThreadingMixIn, WSGIServer):
-    daemon_threads = True
-    allow_reuse_address = True
-
-
-class _QuietHandler(WSGIRequestHandler):
-    def log_message(self, format, *args):  # noqa: A002 - WSGI signature
-        pass
 
 
 class Server:
@@ -129,9 +119,9 @@ class Server:
             stats=self.stats, client_factory=Client, pod=self.pod,
             logger=self.logger)
 
-        self._httpd = make_server(bind_host, port, self.handler,
-                                  server_class=_ThreadingWSGIServer,
-                                  handler_class=_QuietHandler)
+        self._httpd = HTTPServer(self.handler, bind_host, port,
+                                 logger=self.logger,
+                                 query_batcher=self._query_batcher)
         # Re-resolve the port for ":0" binds (server.go:98-106).
         actual_port = self._httpd.server_address[1]
         if actual_port != port:
@@ -185,7 +175,86 @@ class Server:
         self._threads.append(t)
 
     def _serve(self) -> None:
-        self._httpd.serve_forever(poll_interval=0.2)
+        self._httpd.serve_forever()
+
+    def _query_batcher(self, index: str, bodies: list[str]):
+        """Combine pipelined plain-PQL query bodies into one executor
+        call (the httpd batch lane); None falls back to per-request
+        dispatch. Partial-failure semantics are IDENTICAL to sequential
+        dispatch: execute_partial reports how far the combined call
+        stream got — requests fully covered get their results, the
+        request holding the failing call gets the error response, and
+        requests after it re-execute individually (none of their calls
+        ran). Never re-executes an applied mutation (a re-run SetBit
+        would report changed=false to the client that set the bit)."""
+        from ..errors import PilosaError
+        from ..pql import parser as pql
+        from ..pql.ast import Query
+        from . import codec
+        if self.executor is None:
+            return None
+        try:
+            queries = [pql.parse(b) for b in bodies]
+        except PilosaError:
+            return None
+        calls = [c for q in queries for c in q.calls]
+        if not calls or all(c.name == "SetRowAttrs" for c in calls):
+            return None  # bulk-attrs path applies non-positionally
+        results, err = self.executor.execute_partial(index,
+                                                     Query(calls))
+
+        def ok_payload(rs):
+            payload = codec.query_response_json(rs, [])
+            return (json.dumps(payload) + "\n").encode()
+
+        if err is None:
+            out = []
+            pos = 0
+            for q in queries:
+                n = len(q.calls)
+                out.append(ok_payload(results[pos:pos + n]))
+                pos += n
+            return out
+        out = []
+        pos = 0
+        failed = False
+        for q in queries:
+            n = len(q.calls)
+            if not failed and len(results) >= pos + n:
+                out.append(ok_payload(results[pos:pos + n]))
+            elif not failed:
+                # This request holds the failing call: the same error
+                # response sequential dispatch would produce.
+                status = 400 if isinstance(err, PilosaError) else 500
+                body = (json.dumps({"error": str(err)}) + "\n").encode()
+                out.append(self._error_payload(body, status))
+                failed = True
+            else:
+                # After the error: none of these calls ran — execute
+                # the request normally (per-request error semantics).
+                out.append(self._single_query_payload(index, q))
+            pos += n
+        return out
+
+    def _single_query_payload(self, index: str, q) -> bytes:
+        from ..errors import PilosaError
+        from . import codec
+        try:
+            rs = self.executor.execute(index, q)
+        except PilosaError as e:
+            return self._error_payload(
+                (json.dumps({"error": str(e)}) + "\n").encode(), 400)
+        except Exception as e:  # noqa: BLE001 - surfaced as 500
+            return self._error_payload(
+                (json.dumps({"error": str(e)}) + "\n").encode(), 500)
+        payload = codec.query_response_json(rs, [])
+        return (json.dumps(payload) + "\n").encode()
+
+    @staticmethod
+    def _error_payload(body: bytes, status: int) -> bytes:
+        """A non-200 batch-lane entry: the httpd renders 200 for plain
+        bytes, so error entries carry their own status marker."""
+        return (status, body)
 
     # -- slice announcements (view.go:236-246) -------------------------------
 

@@ -84,6 +84,18 @@ def _declare(lib):
     lib.bench_setbit.restype = i64
     lib.unpack_words_u32.argtypes = [u32p, i64, u64p]
     lib.unpack_words_u32.restype = i64
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(i64)
+    lib.batch_add.argtypes = [i64, u64p, u8p, u64p, i64p, u32p, i64p,
+                              u32p, i64p, i64p, u8p, u64p, i64p,
+                              u64p, u8p, i64]
+    lib.batch_add.restype = i64
+    lib.batch_remove.argtypes = [i64, u64p, u8p, u64p, i64p, u32p, i64p,
+                                 u32p, i64p, i64p, u8p, u64p, u8p, i64]
+    lib.batch_remove.restype = i64
+    lib.write_snapshot_fd.argtypes = [ctypes.c_int, i64, u64p, i64p,
+                                      u8p, u64p]
+    lib.write_snapshot_fd.restype = i64
 
 
 def _u64p(a: np.ndarray):
@@ -227,6 +239,60 @@ def unpack_words(words: np.ndarray) -> np.ndarray:
             np.uint32(1)).astype(bool)
     w, b = np.nonzero(bits)
     return w.astype(np.uint64) * np.uint64(32) + b.astype(np.uint64)
+
+
+def _u8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def batch_add(keys, types, arr_ptrs, arr_ns, chunk_vals, chunk_starts,
+              out_vals, out_offsets, out_ns, out_kind, out_bitmaps,
+              out_bm_idx, changed, wal, wal_op_type: int) -> int:
+    """One native crossing applying a whole add batch across touched
+    containers (see bitops.cpp batch_add). Caller guarantees sizing and
+    copy-on-write of in-place bitmap groups; raises if the native
+    library is unavailable (roaring.apply_batch has the numpy
+    fallback)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return int(lib.batch_add(
+        len(keys), _u64p(keys), _u8p(types), _u64p(arr_ptrs),
+        _i64p(arr_ns), _u32p(chunk_vals), _i64p(chunk_starts),
+        _u32p(out_vals), _i64p(out_offsets), _i64p(out_ns),
+        _u8p(out_kind), _u64p(out_bitmaps), _i64p(out_bm_idx),
+        _u64p(changed), _u8p(wal), wal_op_type))
+
+
+def batch_remove(keys, types, arr_ptrs, arr_ns, chunk_vals, chunk_starts,
+                 out_vals, out_offsets, out_ns, out_kind, changed, wal,
+                 wal_op_type: int) -> int:
+    """One native crossing applying a whole remove batch (bitops.cpp
+    batch_remove)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return int(lib.batch_remove(
+        len(keys), _u64p(keys), _u8p(types), _u64p(arr_ptrs),
+        _i64p(arr_ns), _u32p(chunk_vals), _i64p(chunk_starts),
+        _u32p(out_vals), _i64p(out_offsets), _i64p(out_ns),
+        _u8p(out_kind), _u64p(changed), _u8p(wal), wal_op_type))
+
+
+def write_snapshot_fd(fd: int, keys, ns, types, ptrs) -> int:
+    """Write a whole roaring snapshot from a serialization-table capture
+    via batched writev straight out of the container buffers (bitops.cpp
+    write_snapshot_fd). Returns bytes written, or -1 on IO error; raises
+    if the native library is unavailable (write_frozen falls back)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native library unavailable")
+    return int(lib.write_snapshot_fd(fd, len(keys), _u64p(keys),
+                                     _i64p(ns), _u8p(types), _u64p(ptrs)))
 
 
 def bench_setbit(path: str, positions: np.ndarray,

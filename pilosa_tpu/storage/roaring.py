@@ -90,13 +90,19 @@ class Container:
     (roaring.go:536-614) and BitmapSegment.writable (bitmap.go:384-392).
     """
 
-    __slots__ = ("array", "bitmap", "n", "mapped")
+    __slots__ = ("array", "bitmap", "n", "mapped", "cow")
 
     def __init__(self):
         self.array: Optional[np.ndarray] = _EMPTY_U32  # sorted u32, or None
         self.bitmap: Optional[np.ndarray] = None       # u64[1024], or None
         self.n: int = 0
         self.mapped: bool = False
+        # Copy-on-write token for frozen-snapshot captures: when this
+        # lags the owning Bitmap's _cow_epoch, an in-place bitmap-word
+        # mutation must copy the buffer first (a background snapshot
+        # serializes the captured buffer by pointer). Array buffers are
+        # replaced, never mutated in place, so they need no token check.
+        self.cow: int = 0
 
     # -- representation management
 
@@ -375,6 +381,21 @@ def _xor(a: Container, b: Container) -> Container:
 _OP_BODY = struct.Struct("<BQ")  # op type + u64 value (13-byte record w/ checksum)
 
 
+def _wal_blob(values: np.ndarray, typ: int) -> bytes:
+    """13-byte op records for a value vector, checksummed, vectorized —
+    the group-commit form of Op.marshal (verified byte-identical in
+    tests; 0.1 us/record vs ~2 us through the scalar path)."""
+    n = len(values)
+    rec = np.zeros((n, OP_SIZE), dtype=np.uint8)
+    rec[:, 0] = typ
+    rec[:, 1:9] = values.astype("<u8").view(np.uint8).reshape(n, 8)
+    h = np.full(n, int(_FNV_OFFSET), dtype=np.uint32)
+    for i in range(9):
+        h = (h ^ rec[:, i].astype(np.uint32)) * _FNV_PRIME
+    rec[:, 9:13] = h.astype("<u4").view(np.uint8).reshape(n, 4)
+    return rec.tobytes()
+
+
 class Op:
     """One op-log record (roaring.go:1560-1626)."""
 
@@ -423,8 +444,24 @@ class Bitmap:
         self.op_writer = None
         self.op_n = 0      # ops appended/replayed since last snapshot
         self.torn_bytes = 0  # dangling tail bytes found during unmarshal
+        # Frozen-capture COW epoch (see Container.cow) and the
+        # incrementally-maintained serialization table (see _SerTable).
+        self._cow_epoch = 0
+        self._table: Optional[_SerTable] = None
         for v in values:
             self._add(v)
+
+    def _guard_inplace(self, c: Container) -> None:
+        """Make c's bitmap words safe to mutate in place: copy out of an
+        mmap (the mapped flag) or out of a frozen snapshot capture (the
+        cow token)."""
+        if c.mapped:
+            c._unmap()
+            c.cow = self._cow_epoch
+        elif c.cow != self._cow_epoch:
+            if c.bitmap is not None:
+                c.bitmap = c.bitmap.copy()
+            c.cow = self._cow_epoch
 
     # -- container lookup
 
@@ -456,7 +493,11 @@ class Bitmap:
         return changed
 
     def _add(self, v: int) -> bool:
-        return self._container_or_create(highbits(v)).add(lowbits(v))
+        c = self._container_or_create(highbits(v))
+        if c.bitmap is not None:
+            self._guard_inplace(c)
+        self._table = None
+        return c.add(lowbits(v))
 
     def remove(self, v: int) -> bool:
         changed = self._remove(v)
@@ -466,7 +507,12 @@ class Bitmap:
 
     def _remove(self, v: int) -> bool:
         c = self.container(highbits(v))
-        return c.remove(lowbits(v)) if c is not None else False
+        if c is None:
+            return False
+        if c.bitmap is not None:
+            self._guard_inplace(c)
+        self._table = None
+        return c.remove(lowbits(v))
 
     def contains(self, v: int) -> bool:
         c = self.container(highbits(v))
@@ -499,6 +545,7 @@ class Bitmap:
             np.not_equal(values[1:], values[:-1], out=keep[1:])
             if not keep.all():
                 values = values[keep]
+        self._table = None
         highs = values >> np.uint64(16)
         bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1
         starts = np.concatenate(([0], bounds))
@@ -519,7 +566,7 @@ class Bitmap:
             else:
                 # OR-scatter straight into the word vector: O(chunk + words),
                 # no representation churn for the dense-import hot path.
-                c._unmap()
+                self._guard_inplace(c)
                 np.bitwise_or.at(
                     c.bitmap, chunk >> np.uint32(6),
                     np.uint64(1) << (chunk.astype(np.uint64) & np.uint64(63)))
@@ -540,6 +587,7 @@ class Bitmap:
             return 0
         if len(values) > 1 and not bool(np.all(values[:-1] <= values[1:])):
             values = np.sort(values)
+        self._table = None
         highs = values >> np.uint64(16)
         bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1
         starts = np.concatenate(([0], bounds))
@@ -561,7 +609,7 @@ class Bitmap:
             else:
                 # AND-NOT scatter; duplicate words in chunk compose fine
                 # because each element clears only its own bit.
-                c._unmap()
+                self._guard_inplace(c)
                 np.bitwise_and.at(
                     c.bitmap, chunk >> np.uint32(6),
                     ~(np.uint64(1) << (chunk.astype(np.uint64)
@@ -576,6 +624,317 @@ class Bitmap:
         b = Bitmap()
         b.add_many(values)
         return b
+
+    # -- batched mutation engine (native write path) --------------------------
+
+    def _keys_np(self) -> np.ndarray:
+        """Sorted keys as u64 for vectorized container lookup. Cached
+        by key-list length: keys are only ever inserted (empty
+        containers persist), so any structural change grows the list
+        and invalidates the cache."""
+        kc = getattr(self, "_keys_np_cache", None)
+        if kc is not None and kc[0] == len(self.keys):
+            return kc[1]
+        arr = np.array(self.keys, dtype=np.uint64)
+        self._keys_np_cache = (len(self.keys), arr)
+        return arr
+
+    def _insert_containers(self, new_keys: list[int]) -> None:
+        """Insert fresh empty containers for the given (sorted, absent)
+        keys. Few keys take bisect inserts; a storm (cold fragment's
+        first batches) merges wholesale — one vectorized key merge that
+        also refreshes the _keys_np cache in place (rebuilding it from
+        the Python list each batch was most of the cold-write cost)."""
+        new_arr = np.array(new_keys, dtype=np.uint64)
+        old_arr = self._keys_np()
+        pos = np.searchsorted(old_arr, new_arr)
+        merged = np.insert(old_arr, pos, new_arr)
+        if len(new_keys) <= 64:
+            # Positions are original-list-relative; each earlier insert
+            # shifts later ones by one.
+            for j, (k, p) in enumerate(zip(new_keys, pos.tolist())):
+                self.keys.insert(p + j, k)
+                self.containers.insert(p + j, Container())
+        else:
+            out: list[Container] = []
+            prev = 0
+            conts = self.containers
+            for p in pos.tolist():
+                out.extend(conts[prev:p])
+                out.append(Container())
+                prev = p
+            out.extend(conts[prev:])
+            self.keys = merged.tolist()
+            self.containers = out
+        self._keys_np_cache = (len(self.keys), merged)
+        if self._table is not None:
+            self._table = self._table.insert(pos.astype(np.int64),
+                                             len(new_keys))
+
+    def apply_batch(self, values: np.ndarray, set: bool = True,
+                    wal: bool = True) -> np.ndarray:
+        """Apply a whole batch of adds (or removes) in ONE native
+        crossing: container merges, changed-value detection, and WAL
+        record construction all happen in bitops.cpp, then the op-log
+        gets a single group-commit append covering exactly the changed
+        values (idempotent re-sets never hit the WAL, same as the
+        per-op path, roaring.go:1560-1626).
+
+        Returns the sorted changed positions. ``wal=False`` (bulk
+        import / merge-apply contract, fragment.go:924-989) skips
+        record construction entirely; callers snapshot afterwards.
+        """
+        values = np.asarray(values, dtype=np.uint64)
+        if len(values) > 1:
+            if not bool(np.all(values[:-1] <= values[1:])):
+                values = np.sort(values)
+            keep = np.empty(len(values), dtype=bool)
+            keep[0] = True
+            np.not_equal(values[1:], values[:-1], out=keep[1:])
+            if not keep.all():
+                values = values[keep]
+        if not len(values):
+            return _EMPTY_U64
+
+        highs = values >> np.uint64(16)
+        bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1
+        starts = np.concatenate(([0], bounds, [len(values)]))
+        group_keys = highs[starts[:-1]]
+        chunk_vals = (values & np.uint64(0xFFFF)).astype(np.uint32)
+
+        keys_np = self._keys_np()
+        idx = np.searchsorted(keys_np, group_keys)
+        present = ((idx < len(keys_np))
+                   & (keys_np[np.minimum(idx, len(keys_np) - 1)]
+                      == group_keys)) if len(keys_np) else \
+            np.zeros(len(group_keys), dtype=bool)
+        if set:
+            if not present.all():
+                self._insert_containers(
+                    group_keys[~present].tolist())
+                keys_np = self._keys_np()
+                idx = np.searchsorted(keys_np, group_keys)
+        else:
+            if not present.all():
+                # Removes against absent containers are no-ops; drop
+                # those groups (and their chunk spans).
+                keep_g = present
+                if not keep_g.any():
+                    return _EMPTY_U64
+                keep_vals = np.repeat(keep_g,
+                                      np.diff(starts).astype(np.int64))
+                values = values[keep_vals]
+                chunk_vals = chunk_vals[keep_vals]
+                highs = values >> np.uint64(16)
+                bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1
+                starts = np.concatenate(([0], bounds, [len(values)]))
+                group_keys = highs[starts[:-1]]
+                idx = np.searchsorted(keys_np, group_keys)
+
+        changed = self._apply_groups(group_keys, idx.tolist(),
+                                     chunk_vals, starts, set, wal)
+        if wal and len(changed):
+            self.op_n += len(changed)
+        return changed
+
+    def _apply_groups(self, group_keys, idx_list, chunk_vals, starts,
+                      set: bool, wal: bool) -> np.ndarray:
+        from . import native
+        n_g = len(group_keys)
+        chunk_ns = np.diff(starts).astype(np.int64)
+        containers = self.containers
+        conts: list[Container] = [containers[i] for i in idx_list]
+        if not native.available():
+            # The fallback neither uses nor maintains the table; prep
+            # work (rebuild, pointer gather) would be pure waste here.
+            return self._apply_groups_python(conts, group_keys,
+                                             chunk_vals, starts, set,
+                                             wal)
+        if self._table is None and n_g * 4 >= len(containers):
+            # Rebuilding once makes this and every later batch's prep
+            # fully vectorized; below the ratio a point-op-heavy mix
+            # would thrash O(all containers) rebuilds, so fall through
+            # to the per-group prep instead.
+            self._rebuild_table()
+        table = self._table
+        if table is not None:
+            # Vectorized prep: the serialization table already tracks
+            # (type, ptr, n) per container — gather instead of walking
+            # groups in Python. Only mapped/frozen bitmap containers
+            # need per-group attention (in-place mutation safety).
+            gi = np.asarray(idx_list, dtype=np.int64)
+            types = table.types[gi]
+            ptrs = table.ptrs[gi].copy()
+            ns = table.ns[gi].copy()
+            epoch = self._cow_epoch
+            for g in np.flatnonzero(types == 1).tolist():
+                c = conts[g]
+                if c.mapped or c.cow != epoch:
+                    self._guard_inplace(c)
+                    p = c.bitmap.__array_interface__["data"][0]
+                    ptrs[g] = p
+                    table.ptrs[gi[g]] = p
+                    table.bufs[gi[g]] = c.bitmap
+        else:
+            types = np.empty(n_g, dtype=np.uint8)
+            ptrs = np.empty(n_g, dtype=np.uint64)
+            ns = np.empty(n_g, dtype=np.int64)
+            for g in range(n_g):
+                c = conts[g]
+                if c.bitmap is not None:
+                    # native mutates bitmap words in place: copy out of
+                    # an mmap or a frozen capture first
+                    self._guard_inplace(c)
+                    types[g] = 1
+                    ptrs[g] = c.bitmap.__array_interface__["data"][0]
+                    ns[g] = c.n
+                else:
+                    a = c.array
+                    types[g] = 0
+                    ptrs[g] = a.__array_interface__["data"][0]
+                    ns[g] = len(a)
+
+        arr_mask = types == 0
+        total_chunk = len(chunk_vals)
+        changed = np.empty(total_chunk, dtype=np.uint64)
+        wal_buf = (np.empty(total_chunk * OP_SIZE, dtype=np.uint8)
+                   if wal else np.empty(0, dtype=np.uint8))
+        wal_type = ((OP_ADD if set else OP_REMOVE) if wal else -1)
+        out_offsets = np.empty(n_g, dtype=np.int64)
+        out_ns = np.empty(n_g, dtype=np.int64)
+        out_kind = np.empty(n_g, dtype=np.uint8)
+        gk = np.ascontiguousarray(group_keys, dtype=np.uint64)
+        cstarts = starts.astype(np.int64)
+        if set:
+            cap = int((ns[arr_mask] + chunk_ns[arr_mask]).sum())
+            out_vals = np.empty(max(cap, 1), dtype=np.uint32)
+            n_conv = int((arr_mask
+                          & (ns + chunk_ns > ARRAY_MAX_SIZE)).sum())
+            out_bitmaps = np.empty((max(n_conv, 1), BITMAP_N),
+                                   dtype=np.uint64)
+            out_bm_idx = np.empty(n_g, dtype=np.int64)
+            n_changed = native.batch_add(
+                gk, types, ptrs, ns, chunk_vals, cstarts, out_vals,
+                out_offsets, out_ns, out_kind, out_bitmaps, out_bm_idx,
+                changed, wal_buf, wal_type)
+        else:
+            cap = int(ns[arr_mask].sum()) + \
+                int((~arr_mask).sum()) * ARRAY_MAX_SIZE
+            out_vals = np.empty(max(cap, 1), dtype=np.uint32)
+            out_bitmaps = out_bm_idx = None
+            n_changed = native.batch_remove(
+                gk, types, ptrs, ns, chunk_vals, cstarts, out_vals,
+                out_offsets, out_ns, out_kind, changed, wal_buf,
+                wal_type)
+
+        offs = out_offsets.tolist()
+        kinds = out_kind.tolist()
+        new_ns = out_ns.tolist()
+        bm_idx = out_bm_idx.tolist() if out_bm_idx is not None else None
+        table = self._table
+        epoch = self._cow_epoch
+        for g, c in enumerate(conts):
+            kind = kinds[g]
+            if kind == 0:
+                off = offs[g]
+                # Copy out of the shared batch buffer: a view would pin
+                # the WHOLE out_vals allocation for as long as any one
+                # container from this batch survives (review r5) —
+                # per-slice memcpy of <=16 KB is noise next to that.
+                c.array = out_vals[off:off + new_ns[g]].copy()
+                c.bitmap = None
+                c.mapped = False
+            elif kind == 1:
+                c.bitmap = out_bitmaps[bm_idx[g]].copy()
+                c.array = None
+                c.mapped = False
+                c.cow = epoch
+            c.n = new_ns[g]
+            if table is not None:
+                buf = c.bitmap if c.bitmap is not None else c.array
+                table.bufs[idx_list[g]] = buf
+                # Pointer taken from the attached buffer itself (the
+                # copies above own fresh allocations; an offset into
+                # the dead batch buffer would dangle once it's GC'd).
+                ptrs[g] = buf.__array_interface__["data"][0]
+        if table is not None:
+            gi = np.asarray(idx_list, dtype=np.int64)
+            table.ns[gi] = out_ns
+            table.types[gi] = (out_kind != 0).astype(np.uint8)
+            table.ptrs[gi] = ptrs
+        if wal and n_changed and self.op_writer is not None:
+            self.op_writer.write(
+                wal_buf[:n_changed * OP_SIZE].tobytes())
+        return changed[:n_changed]
+
+    def _apply_groups_python(self, conts, group_keys, chunk_vals,
+                             starts, set: bool, wal: bool) -> np.ndarray:
+        """Numpy fallback for apply_batch when the native library is
+        unavailable — identical semantics, per-group vectorized ops."""
+        self._table = None
+        changed_parts: list[np.ndarray] = []
+        starts_l = starts.tolist()
+        for g, c in enumerate(conts):
+            chunk = chunk_vals[starts_l[g]:starts_l[g + 1]]
+            base = np.uint64(int(group_keys[g]) << 16)
+            if set:
+                if c.bitmap is not None:
+                    hit = ((c.bitmap[chunk >> np.uint32(6)]
+                            >> (chunk.astype(np.uint64) & np.uint64(63)))
+                           & np.uint64(1)).astype(bool)
+                    new = chunk[~hit]
+                    if len(new):
+                        self._guard_inplace(c)
+                        np.bitwise_or.at(
+                            c.bitmap, new >> np.uint32(6),
+                            np.uint64(1) << (new.astype(np.uint64)
+                                             & np.uint64(63)))
+                        c.n += len(new)
+                else:
+                    new = chunk[~np.isin(chunk, c.array,
+                                         assume_unique=True)]
+                    if len(new):
+                        merged = np.empty(c.n + len(new),
+                                          dtype=np.uint32)
+                        merged[:c.n] = c.array
+                        merged[c.n:] = new
+                        merged.sort()
+                        c.array = merged
+                        c.n = len(merged)
+                        c.mapped = False
+                        c._maybe_convert()
+                if len(new):
+                    changed_parts.append(base + new.astype(np.uint64))
+            else:
+                if c.bitmap is not None:
+                    hit = ((c.bitmap[chunk >> np.uint32(6)]
+                            >> (chunk.astype(np.uint64) & np.uint64(63)))
+                           & np.uint64(1)).astype(bool)
+                    gone = chunk[hit]
+                    if len(gone):
+                        self._guard_inplace(c)
+                        np.bitwise_and.at(
+                            c.bitmap, gone >> np.uint32(6),
+                            ~(np.uint64(1) << (gone.astype(np.uint64)
+                                               & np.uint64(63))))
+                        c.n -= len(gone)
+                        c._maybe_convert()
+                else:
+                    hit = np.isin(c.array, chunk, assume_unique=True)
+                    gone = c.array[hit]
+                    if len(gone):
+                        c._unmap()
+                        c.array = c.array[~hit]
+                        c.n = len(c.array)
+                if len(gone):
+                    changed_parts.append(base + gone.astype(np.uint64))
+        if not changed_parts:
+            return _EMPTY_U64
+        changed = np.concatenate(changed_parts)
+        if wal and self.op_writer is not None:
+            self.op_writer.write(
+                _wal_blob(changed, OP_ADD if set else OP_REMOVE))
+        return changed
 
     def values(self) -> np.ndarray:
         """All set positions as a sorted u64 vector."""
@@ -797,6 +1156,7 @@ class Bitmap:
         mapping alive, and a copy-out would pay a whole-fragment heap
         copy for nothing (fragment._close_storage).
         """
+        self._table = None  # copies move every mapped buffer
         for c in self.containers:
             c._unmap()
 
@@ -816,27 +1176,50 @@ class Bitmap:
     def write_to(self, w) -> int:
         # Normalize representation so the n<=4096⇒array load rule holds even
         # for bitmaps produced by set algebra.
+        self._table = None  # normalization may swap representations
         for c in self.containers:
             c._maybe_convert()
         live = [(k, c.array, c.bitmap, c.n)
                 for k, c in zip(self.keys, self.containers) if c.n > 0]
         return _write_snapshot(live, w)
 
-    def freeze(self) -> list[tuple]:
-        """Consistent point-in-time view for ASYNC serialization:
-        normalize representations, mark every container mapped (the
-        next mutation copies before touching, the existing COW rule),
-        and capture (key, array, bitmap, n) rows. write_frozen
-        serializes the capture with no lock held — every mutator
-        replaces or _unmap-copies buffers, never writes the captured
-        ones (fragment.snapshot's background path)."""
-        live = []
-        for k, c in zip(self.keys, self.containers):
-            c._maybe_convert()
-            if c.n > 0:
-                c.mapped = True
-                live.append((k, c.array, c.bitmap, c.n))
-        return live
+    def _rebuild_table(self) -> "_SerTable":
+        """Full rebuild of the serialization table (one pass; after this
+        the batched write path keeps it current incrementally and
+        freeze() is O(1))."""
+        n = len(self.containers)
+        ns = np.empty(n, dtype=np.int64)
+        types = np.empty(n, dtype=np.uint8)
+        ptrs = np.empty(n, dtype=np.uint64)
+        bufs: list = [None] * n
+        for i, c in enumerate(self.containers):
+            if c.n and (c.bitmap is not None) != (c.n > ARRAY_MAX_SIZE):
+                c._maybe_convert()
+            b = c.bitmap if c.bitmap is not None else c.array
+            bufs[i] = b
+            ns[i] = c.n
+            types[i] = 0 if c.bitmap is None else 1
+            ptrs[i] = b.__array_interface__["data"][0]
+        self._table = _SerTable(ns, types, ptrs, bufs)
+        return self._table
+
+    def freeze(self) -> "_Frozen":
+        """Consistent point-in-time capture for ASYNC serialization,
+        O(1) when the serialization table is current (the batched write
+        path maintains it; point mutations invalidate it and the next
+        freeze rebuilds once). Instead of marking every container
+        mapped, freezing bumps the COW epoch: any later in-place
+        bitmap-word mutation copies its buffer first (Container.cow),
+        and array buffers are replaced, never mutated — so the captured
+        pointers stay valid with no per-container work. write_frozen
+        serializes the capture with no lock held
+        (fragment.snapshot's background path)."""
+        t = self._table
+        if t is None:
+            t = self._rebuild_table()
+        self._cow_epoch += 1
+        return _Frozen(self._keys_np().copy(), t.ns.copy(),
+                       t.types.copy(), t.ptrs.copy(), list(t.bufs))
 
 
     def marshal(self) -> bytes:
@@ -903,6 +1286,7 @@ class Bitmap:
                 c.bitmap = words if mapped else words.copy()
             c.n = n
             c.mapped = mapped
+            c.cow = 0
             containers.append(c)
         if key_n:
             end = int(offs[-1] + sizes[-1])
@@ -932,9 +1316,86 @@ def _shared_copy(c: Container) -> Container:
     return _shared_view(c)
 
 
-def write_frozen(live: list[tuple], w) -> int:
-    """Serialize a Bitmap.freeze() capture (no locks needed)."""
-    return _write_snapshot(live, w)
+class _SerTable:
+    """Serialization table aligned with Bitmap.containers: per-container
+    (n, type, buffer pointer, buffer ref), maintained incrementally by
+    apply_batch so the MAX_OP_N snapshot freeze is O(1) instead of
+    O(all containers). Point-mutation paths invalidate it; the next
+    freeze rebuilds once."""
+
+    __slots__ = ("ns", "types", "ptrs", "bufs")
+
+    def __init__(self, ns, types, ptrs, bufs):
+        self.ns = ns          # int64: container cardinality
+        self.types = types    # uint8: 0=array, 1=bitmap
+        self.ptrs = ptrs      # uint64: buffer data pointers
+        self.bufs = bufs      # the buffer objects (keep pointers alive)
+
+    def insert(self, pos: np.ndarray, empties: int) -> "_SerTable":
+        """New table with empty-array entries inserted at ``pos``
+        (aligned with Bitmap._insert_containers)."""
+        z64 = np.zeros(len(pos), dtype=np.int64)
+        ns = np.insert(self.ns, pos, z64)
+        types = np.insert(self.types, pos, z64.astype(np.uint8))
+        empty_ptr = _EMPTY_U32.__array_interface__["data"][0]
+        ptrs = np.insert(self.ptrs, pos,
+                         np.full(len(pos), empty_ptr, dtype=np.uint64))
+        bufs: list = []
+        prev = 0
+        old = self.bufs
+        for p in pos.tolist():
+            bufs.extend(old[prev:p])
+            bufs.append(_EMPTY_U32)
+            prev = p
+        bufs.extend(old[prev:])
+        return _SerTable(ns, types, ptrs, bufs)
+
+
+class _Frozen:
+    """Point-in-time snapshot capture (keys + serialization table copy).
+    Buffer refs pin the captured arrays; the COW epoch bump taken at
+    freeze() time guarantees no in-place mutation of them."""
+
+    __slots__ = ("keys", "ns", "types", "ptrs", "bufs")
+
+    def __init__(self, keys, ns, types, ptrs, bufs):
+        self.keys = keys
+        self.ns = ns
+        self.types = types
+        self.ptrs = ptrs
+        self.bufs = bufs
+
+    def as_live_tuples(self) -> list[tuple]:
+        """(key, array, bitmap, n) rows — the Python-serializer form."""
+        out = []
+        for k, n, t, b in zip(self.keys.tolist(), self.ns.tolist(),
+                              self.types.tolist(), self.bufs):
+            if n:
+                out.append((k, None if t else b, b if t else None, n))
+        return out
+
+
+def write_frozen(frozen, w) -> int:
+    """Serialize a Bitmap.freeze() capture (no locks needed). Real
+    files take the native writev path (zero copy, no GIL during the
+    write); BytesIO targets and native-less hosts serialize via the
+    Python writer."""
+    if isinstance(frozen, list):  # legacy tuple-list form
+        return _write_snapshot(frozen, w)
+    fileno = getattr(w, "fileno", None)
+    if fileno is not None and native.available():
+        try:
+            fd = w.fileno()
+        except (OSError, io.UnsupportedOperation):
+            fd = None
+        if fd is not None:
+            w.flush()
+            total = native.write_snapshot_fd(fd, frozen.keys, frozen.ns,
+                                             frozen.types, frozen.ptrs)
+            if total < 0:
+                raise OSError("write_snapshot_fd failed")
+            return total
+    return _write_snapshot(frozen.as_live_tuples(), w)
 
 
 def _write_snapshot(live: list[tuple], w) -> int:

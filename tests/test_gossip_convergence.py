@@ -1,0 +1,148 @@
+"""Many-node SWIM convergence under injected datagram loss.
+
+The regime SWIM exists for (memberlist gets this hardening free,
+reference gossip/gossip.go:48-54): with real packet loss and asymmetry,
+indirect probes + the suspicion window must prevent false deaths, a
+real death must still be detected in bounded time, and a wrong
+suspicion must clear via refutation. Deterministic seeds, loopback
+sockets, HMAC (with replay binding) on across the whole harness.
+"""
+
+import random
+import time
+
+from test_gossip import wait_until
+
+from pilosa_tpu.cluster.gossip import (GossipNodeSet, Member,
+                                       STATE_ALIVE, STATE_SUSPECT)
+
+KEY = b"convergence-harness-key"
+
+
+def make_cluster(n: int, loss: float, seed: int, probe: float = 0.08,
+                 **kw):
+    """n gossip nodes on loopback, each datagram dropped with
+    probability ``loss`` (deterministic per-node RNG)."""
+    nodes: list[GossipNodeSet] = []
+    first_addr = None
+    for i in range(n):
+        g = GossipNodeSet(
+            f"host{i:02d}:10101", gossip_host="127.0.0.1:0",
+            seeds=[first_addr] if first_addr else [],
+            probe_interval=probe, probe_timeout=probe * 2,
+            push_pull_interval=0.5, suspect_after=2,
+            secret_key=KEY, replay_window=30.0, **kw)
+        rng = random.Random(seed * 1000 + i)
+        g.loss_filter = (lambda addr, pkt, _rng=rng:
+                         _rng.random() < loss)
+        g.open()
+        if first_addr is None:
+            first_addr = g.gossip_host
+        nodes.append(g)
+    return nodes
+
+
+def alive_view(g: GossipNodeSet) -> set[str]:
+    return {n.host for n in g.nodes()}
+
+
+def test_no_false_deaths_at_20pct_loss_then_real_death_converges():
+    """Phase A: 12 nodes at 20% symmetric loss — nobody may be declared
+    dead while everybody is alive (indirect probes + suspicion window
+    doing their job). Phase B: one node actually dies; every survivor
+    must converge on its absence in bounded time despite the loss."""
+    nodes = make_cluster(12, loss=0.20, seed=7)
+    try:
+        want = {g.host for g in nodes}
+        assert wait_until(
+            lambda: all(alive_view(g) == want for g in nodes),
+            timeout=20.0), "full membership did not converge"
+
+        # Phase A: hold for ~50 probe periods, sampling continuously.
+        deadline = time.monotonic() + 4.0
+        while time.monotonic() < deadline:
+            for g in nodes:
+                missing = want - alive_view(g)
+                assert not missing, (
+                    f"{g.host} falsely declared {missing} dead at 20%"
+                    " loss")
+            time.sleep(0.2)
+
+        # Phase B: node 11 really dies.
+        victim = nodes[-1]
+        victim_name = victim.host
+        victim.close()
+        survivors = nodes[:-1]
+        want_b = want - {victim_name}
+        assert wait_until(
+            lambda: all(alive_view(g) == want_b for g in survivors),
+            timeout=20.0), (
+            "survivors did not converge on the real death: " + repr(
+                [sorted(alive_view(g)) for g in survivors
+                 if alive_view(g) != want_b][:3]))
+    finally:
+        for g in nodes:
+            g.close()
+
+
+def test_wrong_suspicion_refuted_under_loss():
+    """A live node wrongly suspected (rumor injected at several peers)
+    must clear via refutation — never progressing to dead — even at 20%
+    loss. The refutation is visible as an incarnation bump."""
+    nodes = make_cluster(6, loss=0.20, seed=11)
+    try:
+        want = {g.host for g in nodes}
+        assert wait_until(
+            lambda: all(alive_view(g) == want for g in nodes),
+            timeout=20.0)
+        target = nodes[3]
+        inc0 = target._member_snapshot(target.host).incarnation
+        rumor = Member(target.host, target.gossip_host, inc0,
+                       STATE_SUSPECT)
+        for accuser in (nodes[0], nodes[1], nodes[5]):
+            accuser._merge_member(Member(rumor.name, rumor.addr,
+                                         rumor.incarnation,
+                                         rumor.state))
+        # Refutation: the target re-announces alive with a bumped
+        # incarnation and every accuser flips it back.
+        assert wait_until(
+            lambda: all(
+                g._member_snapshot(target.host).state == STATE_ALIVE
+                for g in nodes), timeout=15.0), (
+            "wrong suspicion did not clear")
+        assert target._member_snapshot(target.host).incarnation > inc0
+        # And nobody ever dropped it from membership.
+        for g in nodes:
+            assert target.host in alive_view(g)
+    finally:
+        for g in nodes:
+            g.close()
+
+
+def test_asymmetric_partition_does_not_kill_at_scale():
+    """One node's DIRECT outbound probes are fully cut to half the
+    cluster; ping-req relays through the unaffected half must keep
+    everyone alive (no false deaths) for many probe periods."""
+    nodes = make_cluster(8, loss=0.0, seed=3)
+    try:
+        want = {g.host for g in nodes}
+        assert wait_until(
+            lambda: all(alive_view(g) == want for g in nodes),
+            timeout=20.0)
+        cut_addrs = {g.gossip_host for g in nodes[4:]}
+        base_filter = nodes[0].loss_filter
+
+        def asym(addr, pkt, _base=base_filter):
+            if addr in cut_addrs and pkt.get("t") == "ping":
+                return True  # direct pings dropped; pingreq flows
+            return _base(addr, pkt)
+
+        nodes[0].loss_filter = asym
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            assert alive_view(nodes[0]) == want, (
+                "asymmetric direct loss killed a reachable node")
+            time.sleep(0.2)
+    finally:
+        for g in nodes:
+            g.close()

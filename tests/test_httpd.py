@@ -1,0 +1,186 @@
+"""The native-threaded HTTP/1.1 front door (server/httpd.py): keep-alive,
+pipelining, the query batch lane's partial-failure semantics, restart
+rebinding, and streamed (close-delimited) responses.
+
+Reference analogue: net/http serving per-connection goroutines
+(server.go:146)."""
+
+import json
+import socket
+import tempfile
+import time
+
+import pytest
+
+from pilosa_tpu.server.server import Server
+
+
+def _req(method: str, path: str, body: bytes = b"") -> bytes:
+    return (f"{method} {path} HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+
+
+def _read_responses(sock: socket.socket, n: int, timeout=5.0) -> list[str]:
+    """Read exactly n HTTP responses (Content-Length framed)."""
+    sock.settimeout(timeout)
+    buf = b""
+    out = []
+    while len(out) < n:
+        while True:
+            head_end = buf.find(b"\r\n\r\n")
+            if head_end < 0:
+                break
+            head = buf[:head_end].decode("latin-1")
+            length = 0
+            for ln in head.split("\r\n")[1:]:
+                k, _, v = ln.partition(":")
+                if k.lower() == "content-length":
+                    length = int(v)
+            total = head_end + 4 + length
+            if len(buf) < total:
+                break
+            out.append(buf[:total].decode("latin-1"))
+            buf = buf[total:]
+            if len(out) == n:
+                return out
+        data = sock.recv(1 << 20)
+        if not data:
+            raise ConnectionError(f"short: got {len(out)}/{n}")
+        buf += data
+    return out
+
+
+@pytest.fixture
+def server():
+    with tempfile.TemporaryDirectory() as d:
+        srv = Server(d, host="127.0.0.1:0", anti_entropy_interval=0,
+                     polling_interval=0)
+        srv.open()
+        yield srv
+        srv.close()
+
+
+def _conn(srv) -> socket.socket:
+    host, port = srv.host.split(":")
+    s = socket.create_connection((host, int(port)))
+    return s
+
+
+def _setup_schema(s: socket.socket) -> None:
+    s.sendall(_req("POST", "/index/i") + _req("POST", "/index/i/frame/f"))
+    _read_responses(s, 2)
+
+
+def test_keepalive_many_requests_one_connection(server):
+    s = _conn(server)
+    try:
+        _setup_schema(s)
+        for i in range(20):
+            s.sendall(_req("POST", "/index/i/query",
+                           f'SetBit(frame="f", rowID=1, columnID={i})'
+                           .encode()))
+            (resp,) = _read_responses(s, 1)
+            assert resp.startswith("HTTP/1.1 200")
+            assert '"results": [true]' in resp
+    finally:
+        s.close()
+
+
+def test_pipelined_batch_lane_results_align(server):
+    s = _conn(server)
+    try:
+        _setup_schema(s)
+        blob = b"".join(
+            _req("POST", "/index/i/query",
+                 f'SetBit(frame="f", rowID=2, columnID={i})'.encode())
+            for i in range(50))
+        blob += _req("POST", "/index/i/query",
+                     b'Count(Bitmap(frame="f", rowID=2))')
+        s.sendall(blob)
+        resps = _read_responses(s, 51)
+        for r in resps[:50]:
+            assert '"results": [true]' in r
+        assert '"results": [50]' in resps[50]
+    finally:
+        s.close()
+
+
+def test_batch_lane_partial_failure_semantics(server):
+    """q1 sets a NEW bit, q2 hits a missing frame, q3 sets another new
+    bit. The batch lane must report q1 true (never re-executed — a
+    re-run would say false), q2 the same 400 the per-request path
+    gives, q3 true."""
+    s = _conn(server)
+    try:
+        _setup_schema(s)
+        s.sendall(
+            _req("POST", "/index/i/query",
+                 b'SetBit(frame="f", rowID=5, columnID=1)')
+            + _req("POST", "/index/i/query",
+                   b'SetBit(frame="nope", rowID=1, columnID=1)')
+            + _req("POST", "/index/i/query",
+                   b'SetBit(frame="f", rowID=5, columnID=2)'))
+        r1, r2, r3 = _read_responses(s, 3)
+        assert r1.startswith("HTTP/1.1 200") and '[true]' in r1
+        assert r2.startswith("HTTP/1.1 400")
+        assert json.loads(r2[r2.find("\r\n\r\n") + 4:])["error"] == "nope"
+        assert r3.startswith("HTTP/1.1 200") and '[true]' in r3
+    finally:
+        s.close()
+
+
+def test_rebind_same_port_after_close(server):
+    host, port = server.host.split(":")
+    s = _conn(server)
+    _setup_schema(s)  # leave a keep-alive connection dangling
+    data_dir = server.data_dir
+    server.close()
+    srv2 = Server(data_dir, host=f"{host}:{port}",
+                  anti_entropy_interval=0, polling_interval=0)
+    srv2.open()  # must not raise EADDRINUSE
+    try:
+        s2 = _conn(srv2)
+        try:
+            s2.sendall(_req("POST", "/index/i/query",
+                            b'Count(Bitmap(frame="f", rowID=1))'))
+            (resp,) = _read_responses(s2, 1)
+            assert resp.startswith("HTTP/1.1 200")
+        finally:
+            s2.close()
+    finally:
+        srv2.close()
+        s.close()
+
+
+def test_streamed_export_close_delimited(server):
+    s = _conn(server)
+    _setup_schema(s)
+    s.sendall(_req("POST", "/index/i/query",
+                   b'SetBit(frame="f", rowID=9, columnID=3)'))
+    _read_responses(s, 1)
+    s.sendall((b"GET /export?index=i&frame=f&view=standard&slice=0"
+               b" HTTP/1.1\r\nHost: x\r\nAccept: text/csv\r\n"
+               b"Content-Length: 0\r\n\r\n"))
+    s.settimeout(5.0)
+    buf = b""
+    while True:
+        data = s.recv(65536)
+        if not data:
+            break  # close-delimited
+        buf += data
+    text = buf.decode()
+    assert text.startswith("HTTP/1.1 200")
+    assert "Connection: close" in text
+    assert "9,3" in text
+    s.close()
+
+
+def test_malformed_request_gets_400(server):
+    s = _conn(server)
+    try:
+        s.sendall(b"NONSENSE\r\n\r\n")
+        s.settimeout(5.0)
+        data = s.recv(65536).decode("latin-1")
+        assert data.startswith("HTTP/1.1 400")
+    finally:
+        s.close()

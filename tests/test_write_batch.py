@@ -1,0 +1,371 @@
+"""Batched write engine: native batch_add/batch_remove crossings, WAL
+group commit, frozen-capture COW, and the executor SetBit/ClearBit
+batch run (reference per-op loop: fragment.go:369-459,
+executor.go:664-797 — the batch path must be observationally
+identical)."""
+
+import io
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.models.frame import FrameOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.storage import native, roaring
+from pilosa_tpu.storage.fragment import Fragment
+
+
+def _rand_vals(rng, n=30000):
+    sparse = rng.integers(0, 1 << 24, n).astype(np.uint64)
+    dense = (np.uint64(7 << 16)
+             + rng.integers(0, 60000, n // 2).astype(np.uint64))
+    return np.concatenate([sparse, dense])
+
+
+class TestApplyBatch:
+    def test_add_remove_parity_with_per_op(self):
+        rng = np.random.default_rng(3)
+        for _ in range(3):
+            vals = _rand_vals(rng)
+            ref = roaring.Bitmap()
+            for v in vals.tolist():
+                ref._add(v)
+            b = roaring.Bitmap()
+            for s in range(0, len(vals), 1000):
+                b.apply_batch(vals[s:s + 1000], set=True, wal=False)
+            assert np.array_equal(ref.values(), b.values())
+            b.check()
+            rem = np.concatenate(
+                [vals[::2],
+                 rng.integers(0, 1 << 24, 5000).astype(np.uint64)])
+            for v in rem.tolist():
+                ref._remove(v)
+            for s in range(0, len(rem), 1000):
+                b.apply_batch(rem[s:s + 1000], set=False, wal=False)
+            assert np.array_equal(ref.values(), b.values())
+            b.check()
+
+    def test_wal_group_commit_replays(self):
+        rng = np.random.default_rng(5)
+        buf = io.BytesIO()
+        b = roaring.Bitmap()
+        b.write_to(buf)
+        b.op_writer = buf
+        vals = rng.integers(0, 1 << 22, 5000).astype(np.uint64)
+        ch = b.apply_batch(vals, set=True, wal=True)
+        ch2 = b.apply_batch(vals[::3], set=False, wal=True)
+        assert b.op_n == len(ch) + len(ch2)
+        b.op_writer = None
+        loaded = roaring.Bitmap.unmarshal(buf.getvalue())
+        assert np.array_equal(loaded.values(), b.values())
+
+    def test_wal_records_byte_identical_to_scalar(self):
+        vals = np.array([0, 7, 1 << 33, (1 << 63) + 5], dtype=np.uint64)
+        blob = roaring._wal_blob(vals, roaring.OP_ADD)
+        for i, v in enumerate(vals.tolist()):
+            assert blob[i * 13:(i + 1) * 13] == \
+                roaring.Op(roaring.OP_ADD, v).marshal()
+
+    def test_changed_excludes_idempotent_resets(self):
+        b = roaring.Bitmap()
+        first = b.apply_batch(np.array([1, 2, 3], dtype=np.uint64),
+                              wal=False)
+        assert len(first) == 3
+        again = b.apply_batch(np.array([2, 3, 4], dtype=np.uint64),
+                              wal=False)
+        assert again.tolist() == [4]
+        gone = b.apply_batch(np.array([3, 99], dtype=np.uint64),
+                             set=False, wal=False)
+        assert gone.tolist() == [3]
+
+    def test_array_bitmap_conversions_both_ways(self):
+        b = roaring.Bitmap()
+        # fill one container past ARRAY_MAX_SIZE in two batches
+        b.apply_batch(np.arange(3000, dtype=np.uint64), wal=False)
+        assert b.containers[0].is_array()
+        b.apply_batch(np.arange(3000, 6000, dtype=np.uint64), wal=False)
+        assert not b.containers[0].is_array()
+        b.check()
+        # remove back below the boundary: container must unpack
+        b.apply_batch(np.arange(4000, 6000, dtype=np.uint64),
+                      set=False, wal=False)
+        assert b.containers[0].is_array()
+        assert b.count() == 4000
+        b.check()
+
+    def test_frozen_capture_is_immutable_under_writes(self):
+        rng = np.random.default_rng(11)
+        b = roaring.Bitmap()
+        b.apply_batch(rng.integers(0, 1 << 22, 50000).astype(np.uint64),
+                      wal=False)
+        want = b.values().copy()
+        frozen = b.freeze()
+        # batch, bulk, and point mutations all land after the capture
+        b.apply_batch(rng.integers(0, 1 << 22, 50000).astype(np.uint64),
+                      wal=False)
+        b.add_many(rng.integers(0, 1 << 22, 1000).astype(np.uint64))
+        for v in rng.integers(0, 1 << 22, 200).tolist():
+            b._add(int(v))
+            b._remove(int(rng.integers(0, 1 << 22)))
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "snap")
+            with open(p, "wb") as f:
+                roaring.write_frozen(frozen, f)
+            loaded = roaring.Bitmap.unmarshal(open(p, "rb").read())
+            loaded.check()
+            assert np.array_equal(loaded.values(), want)
+
+    def test_write_frozen_bytesio_fallback_matches_native(self):
+        rng = np.random.default_rng(13)
+        b = roaring.Bitmap()
+        b.apply_batch(_rand_vals(rng, 20000), wal=False)
+        frozen = b.freeze()
+        buf = io.BytesIO()
+        roaring.write_frozen(frozen, buf)  # non-fd target: Python path
+        loaded = roaring.Bitmap.unmarshal(buf.getvalue())
+        assert np.array_equal(loaded.values(), b.values())
+        if native.available():
+            with tempfile.TemporaryDirectory() as d:
+                p = os.path.join(d, "snap")
+                with open(p, "wb") as f:
+                    roaring.write_frozen(b.freeze(), f)
+                assert open(p, "rb").read() == buf.getvalue()
+
+    def test_fallback_python_groups_match_native(self):
+        rng = np.random.default_rng(17)
+        vals = _rand_vals(rng, 15000)
+        via_native = roaring.Bitmap()
+        via_python = roaring.Bitmap()
+        for s in range(0, len(vals), 900):
+            chunk = vals[s:s + 900]
+            via_native.apply_batch(chunk, wal=False)
+            # force the fallback path regardless of toolchain
+            highs = np.sort(np.unique(chunk)) >> np.uint64(16)
+            srt = np.sort(np.unique(chunk))
+            bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1
+            starts = np.concatenate(([0], bounds, [len(srt)]))
+            gk = highs[starts[:-1]]
+            keys_np = via_python._keys_np()
+            missing = gk[~np.isin(gk, keys_np)]
+            if len(missing):
+                via_python._insert_containers(missing.tolist())
+            idx = np.searchsorted(via_python._keys_np(), gk)
+            conts = [via_python.containers[i] for i in idx.tolist()]
+            via_python._apply_groups_python(
+                conts, gk, (srt & np.uint64(0xFFFF)).astype(np.uint32),
+                starts, True, False)
+        assert np.array_equal(via_native.values(), via_python.values())
+
+
+class TestFragmentBatch:
+    def test_batch_matches_per_op_fragment(self):
+        rng = np.random.default_rng(9)
+        n = 20000
+        rows = rng.integers(0, 200, n).astype(np.uint64)
+        cols = rng.integers(0, 1 << 20, n).astype(np.uint64)
+        with tempfile.TemporaryDirectory() as d:
+            fa = Fragment(os.path.join(d, "a"), "i", "f", "standard", 0)
+            fb = Fragment(os.path.join(d, "b"), "i", "f", "standard", 0)
+            fa.open()
+            fb.open()
+            for r, c in zip(rows.tolist(), cols.tolist()):
+                fa.set_bit(r, c)
+            for s in range(0, n, 700):
+                fb.set_bits(rows[s:s + 700], cols[s:s + 700])
+            fa._join_snapshot()
+            fb._join_snapshot()
+            assert np.array_equal(fa.storage.values(),
+                                  fb.storage.values())
+            for rid in np.unique(rows)[:40].tolist():
+                assert fa.row_count(rid) == fb.row_count(rid)
+                assert fa.cache.get(rid) == fb.cache.get(rid)
+            fb.clear_bits(rows[::3], cols[::3])
+            for r, c in zip(rows[::3].tolist(), cols[::3].tolist()):
+                fa.clear_bit(r, c)
+            fa._join_snapshot()
+            fb._join_snapshot()
+            assert np.array_equal(fa.storage.values(),
+                                  fb.storage.values())
+            fa.close()
+            fb.close()
+
+    def test_batch_survives_crash_reopen(self):
+        """Kill the file mid-life: batch-written WAL records replay
+        identically on reopen (snapshot + tail)."""
+        rng = np.random.default_rng(21)
+        n = 30000
+        rows = rng.integers(0, 300, n).astype(np.uint64)
+        cols = rng.integers(0, 1 << 20, n).astype(np.uint64)
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "frag")
+            frag = Fragment(p, "i", "f", "standard", 0)
+            frag.open()
+            for s in range(0, n, 1000):
+                frag.set_bits(rows[s:s + 1000], cols[s:s + 1000])
+            frag._join_snapshot()
+            want = frag.storage.values().copy()
+            # simulate crash: no close(), just drop and reopen
+            frag.storage.op_writer = None
+            frag._file.close()
+            frag2 = Fragment(p, "i", "f", "standard", 0)
+            frag2.__init__(p, "i", "f", "standard", 0)
+            frag2.open()
+            assert np.array_equal(frag2.storage.values(), want)
+            frag2.storage.check()
+            frag2.close()
+
+    def test_torn_batch_tail_trimmed(self):
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "frag")
+            frag = Fragment(p, "i", "f", "standard", 0)
+            frag.open()
+            frag.set_bits(np.arange(100, dtype=np.uint64),
+                          np.arange(100, dtype=np.uint64) * 7)
+            frag._join_snapshot()
+            want = frag.storage.count()
+            frag.close()
+            # tear the last record mid-write
+            with open(p, "ab") as f:
+                f.write(roaring.Op(roaring.OP_ADD, 12345).marshal()[:7])
+            frag2 = Fragment(p, "i", "f", "standard", 0)
+            frag2.open()
+            assert frag2.storage.count() == want
+            frag2.close()
+
+    def test_duplicate_ops_report_first_only(self):
+        with tempfile.TemporaryDirectory() as d:
+            h = Holder(d)
+            h.open()
+            frame = h.create_index("i").create_frame("f")
+            changed = frame.mutate_bits(
+                "standard",
+                np.array([1, 1, 2], dtype=np.uint64),
+                np.array([5, 5, 9], dtype=np.uint64), True)
+            assert changed.tolist() == [True, False, True]
+            h.close()
+
+
+class TestExecutorMutateBatch:
+    def _run(self, qcalls, inverse=False):
+        outs = []
+        for batched in (False, True):
+            with tempfile.TemporaryDirectory() as d:
+                h = Holder(d)
+                h.open()
+                frame = h.create_index("i").create_frame(
+                    "f", FrameOptions(inverse_enabled=inverse))
+                ex = Executor(h, host="local", use_mesh=False)
+                if batched:
+                    res = ex.execute("i", "\n".join(qcalls))
+                else:
+                    res = []
+                    for q in qcalls:
+                        res.extend(ex.execute("i", q))
+                views = {}
+                for vname in (["standard", "inverse"] if inverse
+                              else ["standard"]):
+                    v = frame.view(vname)
+                    if v:
+                        views[vname] = {
+                            s: f.storage.values().copy()
+                            for s, f in v.fragments.items()}
+                outs.append((res, views))
+                ex.close()
+                h.close()
+        (res_a, views_a), (res_b, views_b) = outs
+        assert res_a == res_b
+        assert views_a.keys() == views_b.keys()
+        for vname in views_a:
+            assert views_a[vname].keys() == views_b[vname].keys()
+            for s in views_a[vname]:
+                assert np.array_equal(views_a[vname][s],
+                                      views_b[vname][s])
+
+    def test_setbit_run_parity(self):
+        import random
+        random.seed(4)
+        calls = [f'SetBit(frame="f", rowID={random.randrange(40)},'
+                 f' columnID={random.randrange(1 << 21)})'
+                 for _ in range(300)]
+        calls += calls[:15]  # duplicates: only the first changes
+        self._run(calls)
+
+    def test_setbit_run_parity_inverse(self):
+        import random
+        random.seed(7)
+        calls = [f'SetBit(frame="f", rowID={random.randrange(40)},'
+                 f' columnID={random.randrange(1 << 21)})'
+                 for _ in range(200)]
+        self._run(calls, inverse=True)
+
+    def test_mixed_runs_and_reads(self):
+        """Batch runs interleave with reads and short runs; results
+        stay positionally aligned."""
+        with tempfile.TemporaryDirectory() as d:
+            h = Holder(d)
+            h.open()
+            h.create_index("i").create_frame("f")
+            ex = Executor(h, host="local", use_mesh=False)
+            sets = "\n".join(
+                f'SetBit(frame="f", rowID=1, columnID={c})'
+                for c in range(20))
+            q = (sets + '\nCount(Bitmap(frame="f", rowID=1))\n'
+                 'SetBit(frame="f", rowID=1, columnID=3)')
+            res = ex.execute("i", q)
+            assert res[:20] == [True] * 20
+            assert res[20] == 20
+            assert res[21] is False  # idempotent re-set
+            ex.close()
+            h.close()
+
+    def test_timestamped_calls_fall_back(self):
+        """Timestamped SetBits never enter the batch run (time-view
+        fan-out is per-op) but still work mid-stream."""
+        with tempfile.TemporaryDirectory() as d:
+            h = Holder(d)
+            h.open()
+            idx = h.create_index("i")
+            idx.create_frame("f", FrameOptions(time_quantum="YMD"))
+            ex = Executor(h, host="local", use_mesh=False)
+            calls = ["\n".join(
+                f'SetBit(frame="f", rowID=1, columnID={c},'
+                f' timestamp="2017-01-0{1 + c % 3}T00:00")'
+                for c in range(9))]
+            res = ex.execute("i", calls[0])
+            assert res == [True] * 9
+            assert idx.frame("f").view("standard_2017") is not None
+            ex.close()
+            h.close()
+
+
+class TestFastParse:
+    def test_fast_and_full_agree(self):
+        from pilosa_tpu.pql.parser import Parser, parse
+        cases = [
+            'SetBit(frame="f", rowID=3, columnID=77)',
+            'TopN(frame="f", n=5)',
+            'Bitmap(frame=\'x-y.z\', rowID=0)'
+            'Count(Bitmap(frame="a", rowID=1))',
+            'SetBit(frame="f", rowID=1, columnID=2,'
+            ' timestamp="2017-01-02T15:04")',
+            'Union(Bitmap(frame="a", rowID=1), Bitmap(frame="a",'
+            ' rowID=2))',
+            'TopN(frame="f", n=2, ids=[1,2,3])',
+            'SetRowAttrs(frame="f", rowID=1, x=true, y=null, z=1.5)',
+            'Count()',
+            '',
+        ]
+        for c in cases:
+            assert str(parse(c)) == str(Parser(c).parse()), c
+
+    def test_fast_rejects_what_full_rejects(self):
+        from pilosa_tpu.errors import PilosaError
+        from pilosa_tpu.pql.parser import parse
+        for bad in ('SetBit(frame="f", frame="g")',   # duplicate key
+                    'SetBit(rowID=99999999999999999999)',  # > int64
+                    'SetBit(frame="f"'):              # unterminated
+            with pytest.raises(PilosaError):
+                parse(bad)
