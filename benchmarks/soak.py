@@ -3,9 +3,13 @@
 Not a pytest (it runs for minutes by design) — a reproducible harness
 whose results land in RESULTS.md. It exercises, at once, the surfaces
 that only misbehave over time: WAL growth + snapshotting under a write
-storm, anti-entropy sweeps against live writes, gossip probes across a
-mid-soak node restart, the device residency cache under a changing
-working set, and the Python heap (sampled via /debug/pprof/heap).
+storm (MAX_OP_N forced low -> snapshot storms), anti-entropy sweeps
+against live writes, gossip probes across BOTH a mid-soak clean restart
+AND a mid-soak SIGKILL of node B (WAL replay + torn-tail recovery under
+load), the batched write path (one writer issues 100-call pipelined
+bodies), and the Python heap (sampled via /debug/pprof/heap). Per-op
+write latencies are collected for p50/p99/p999; the verdict also fails
+on RSS growth (leak detection over the run).
 
 Usage: python benchmarks/soak.py [minutes]   (default 10)
 
@@ -67,6 +71,7 @@ class Node:
         env = cpu_env()
         env["PILOSA_TPU_MESH"] = "0"  # device-free children: a kill or
         # crash here must never touch the shared accelerator state
+        env["PILOSA_TPU_MAX_OP_N"] = "200"  # snapshot storm cadence
         argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
                 "-d", self.data_dir, "-b", self.host,
                 "--cluster.type", "gossip",
@@ -134,6 +139,9 @@ def main():
     stop = threading.Event()
     stats = {"writes": 0, "reads": 0, "errors": 0, "restarts": 0}
 
+    write_lat = []
+    lat_mu = threading.Lock()
+
     def writer(seed):
         rng = random.Random(seed)
         while not stop.is_set():
@@ -142,6 +150,7 @@ def main():
             setbit = rng.random() < 0.9
             host = nodes[rng.randrange(2)].host
             verb = "SetBit" if setbit else "ClearBit"
+            t0 = time.perf_counter()
             try:
                 query(host, f'{verb}(frame="sf", rowID={r},'
                             f' columnID={c})', timeout=30)
@@ -151,10 +160,41 @@ def main():
                     uncertain[r].add(c)
                 time.sleep(0.5)
                 continue
+            el = time.perf_counter() - t0
+            with lat_mu:
+                write_lat.append(el)
+                if len(write_lat) > 2_000_000:
+                    del write_lat[:1_000_000]
             with model_mu:
                 (model[r].add if setbit else model[r].discard)(c)
                 uncertain[r].discard(c)
             stats["writes"] += 1
+
+    def batch_writer(seed):
+        """Round-5 batched write path: 100-call bodies through the
+        executor mutate-batch run + the fragments' native batch
+        engine."""
+        rng = random.Random(seed)
+        while not stop.is_set():
+            r = rng.randrange(ROWS)
+            cols = [rng.randrange(SLICE_SPAN) for _ in range(100)]
+            host = nodes[rng.randrange(2)].host
+            body = "\n".join(
+                f'SetBit(frame="sf", rowID={r}, columnID={c})'
+                for c in cols)
+            try:
+                query(host, body, timeout=60)
+            except Exception:
+                stats["errors"] += 1
+                with model_mu:
+                    uncertain[r].update(cols)
+                time.sleep(0.5)
+                continue
+            with model_mu:
+                model[r].update(cols)
+                for c in cols:
+                    uncertain[r].discard(c)
+            stats["writes"] += 100
 
     def reader(seed):
         rng = random.Random(seed)
@@ -176,6 +216,8 @@ def main():
 
     threads = [threading.Thread(target=writer, args=(i,), daemon=True)
                for i in range(2)]
+    threads += [threading.Thread(target=batch_writer, args=(5,),
+                                 daemon=True)]
     threads += [threading.Thread(target=reader, args=(10 + i,),
                                  daemon=True) for i in range(2)]
     for t in threads:
@@ -184,26 +226,39 @@ def main():
     t0 = time.monotonic()
     deadline = t0 + minutes * 60
     restarted = False
+    killed = False
     minute = 0
+    rss_curve = []
     http("GET", na.host, "/debug/pprof/heap")  # arm tracing on A
     while time.monotonic() < deadline:
         time.sleep(min(60, max(1, deadline - time.monotonic())))
         minute += 1
         heap = http("GET", na.host,
                     "/debug/pprof/heap?n=1").decode().splitlines()[0]
+        rss_curve.append((round(na.rss_mb(), 1), round(nb.rss_mb(), 1)))
         print(json.dumps({
             "minute": minute, **stats,
-            "rss_a_mb": round(na.rss_mb(), 1),
-            "rss_b_mb": round(nb.rss_mb(), 1),
+            "rss_a_mb": rss_curve[-1][0],
+            "rss_b_mb": rss_curve[-1][1],
             "heap_a": heap}), flush=True)
-        if not restarted and time.monotonic() - t0 > minutes * 30:
-            # Mid-soak: clean-restart node B under load.
+        if not restarted and time.monotonic() - t0 > minutes * 20:
+            # Mid-soak (1/3): clean-restart node B under load.
             restarted = True
             stats["restarts"] += 1
             nb.stop()
             time.sleep(2)
             nb.start()
             print(json.dumps({"event": "restarted b"}), flush=True)
+        elif killed is False and time.monotonic() - t0 > minutes * 40:
+            # Mid-soak (2/3): SIGKILL node B — WAL replay + torn-tail
+            # trim under load, the crash-durability path at soak scale.
+            killed = True
+            stats["restarts"] += 1
+            nb.stop(sig=signal.SIGKILL, timeout=10)
+            time.sleep(2)
+            nb.start()
+            print(json.dumps({"event": "sigkilled+revived b"}),
+                  flush=True)
 
     stop.set()
     for t in threads:
@@ -224,8 +279,29 @@ def main():
             if not (base <= got <= upper):
                 failures.append((node.name, r, len(got - upper),
                                  len(base - got)))
+    # Latency percentiles over the whole run (tail = snapshot storms,
+    # restarts, anti-entropy interference).
+    with lat_mu:
+        lats = sorted(write_lat)
+    pct = {}
+    if lats:
+        for name, q in (("p50", 0.5), ("p99", 0.99), ("p999", 0.999)):
+            pct[name + "_ms"] = round(
+                lats[min(len(lats) - 1, int(q * len(lats)))] * 1e3, 2)
+    # RSS flatness: compare each node's median RSS over the first vs
+    # last quarter of the run; a leak shows as unbounded growth.
+    rss_verdict = "flat"
+    if len(rss_curve) >= 8:
+        qn = len(rss_curve) // 4
+        for side, name in ((0, "a"), (1, "b")):
+            first = sorted(c[side] for c in rss_curve[:qn])[qn // 2]
+            last = sorted(c[side] for c in rss_curve[-qn:])[qn // 2]
+            if last > 2.0 * first + 200:
+                rss_verdict = f"LEAK:{name} {first}->{last}MB"
+                failures.append(("rss", name, first, last))
     verdict = "PASS" if not failures else f"FAIL: {failures[:4]}"
-    print(json.dumps({"verdict": verdict, **stats,
+    print(json.dumps({"verdict": verdict, **stats, **pct,
+                      "rss": rss_verdict,
                       "minutes": minutes}), flush=True)
     na.stop()
     nb.stop()

@@ -26,6 +26,7 @@ from __future__ import annotations
 import datetime as dt
 import os
 import threading
+from collections import OrderedDict
 import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from dataclasses import dataclass
@@ -40,7 +41,7 @@ from . import SLICE_WIDTH
 from .models.view import VIEW_INVERSE, VIEW_STANDARD
 from .pql.ast import Call, Query
 from .pql.parser import parse as parse_pql
-from .storage.bitmap import Bitmap
+from .storage.bitmap import Bitmap, BitmapSegment
 from .storage.cache import Pair, pairs_sort
 from .storage.fragment import TopOptions
 from .utils import timequantum as tq
@@ -153,6 +154,9 @@ class Executor:
         # pod legs → slice map); a single shared pool could deadlock.
         self._pools: dict[str, ThreadPoolExecutor] = {}
         self._pools_mu = threading.Lock()
+        # Materialized bitmap-result residency (see _bitmap_result_key).
+        self._bitmap_results: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._bitmap_results_mu = threading.Lock()
 
     def _pool(self, tier: str) -> ThreadPoolExecutor:
         with self._pools_mu:
@@ -356,8 +360,82 @@ class Executor:
 
     # -- bitmap expressions (executor.go:192-570) ----------------------------
 
+    # Materialized-result residency (VERDICT r4 item 5): completed
+    # Union/Intersect/Difference results stay cached keyed by
+    # (expression, per-fragment generations), so a repeated chain pays
+    # zero re-fold and zero repack — the reference's own
+    # lazy-materialization trick is its COW segments (bitmap.go:384-392);
+    # this is the same idea one level up. Bounded by entries AND total
+    # cached bits.
+    _RESULT_CACHE_ENTRIES = 8
+    _RESULT_CACHE_BITS = 32 << 20
+
+    def _bitmap_result_key(self, index: str, c: Call,
+                           slices: list[int],
+                           compiled_out: Optional[list] = None):
+        """Cache key embedding every input fragment's mutation
+        generation, or None when the call/topology isn't cacheable
+        (single local node only: remote/pod peers' data generations
+        are invisible here, so a key could go stale silently). The
+        compiled (expr, leaves) is appended to ``compiled_out`` so the
+        device fold reuses it instead of re-walking the call tree
+        (1000-child Unions pay the walk once, review r5)."""
+        if c.name not in ("Union", "Intersect", "Difference"):
+            return None
+        if self.pod is not None or len(self.cluster.nodes) != 1:
+            return None
+        leaves: list[tuple] = []
+        expr = self._compile_device_expr(index, c, leaves)
+        if expr is None or not leaves:
+            return None
+        if compiled_out is not None:
+            compiled_out.append((expr, leaves))
+        if len(leaves) * len(slices) > (1 << 16):
+            return None  # key construction would outweigh the win
+        gens = []
+        for frame, view, _row in leaves:
+            for s in slices:
+                f = self.holder.fragment(index, frame, view, s)
+                gens.append((f.device.uid, f.device.generation)
+                            if f is not None else (0, 0))
+        return (index, expr, tuple(slices), tuple(gens))
+
+    def _share_result(self, bm: Bitmap) -> Bitmap:
+        """COW handout of a cached result (mutating callers copy,
+        never the cached object)."""
+        out = Bitmap()
+        out.attrs = dict(bm.attrs)
+        for seg in bm.segments:
+            out.segments.append(BitmapSegment(seg.data.shared(),
+                                              seg.slice, False))
+        return out
+
+    def _result_cache_put(self, key, bm: Bitmap) -> None:
+        bits = bm.count()
+        if bits > self._RESULT_CACHE_BITS:
+            return
+        with self._bitmap_results_mu:
+            cache = self._bitmap_results
+            cache[key] = (bm, bits)
+            cache.move_to_end(key)
+            total = sum(b for _, b in cache.values())
+            while (len(cache) > self._RESULT_CACHE_ENTRIES
+                   or total > self._RESULT_CACHE_BITS) and len(cache) > 1:
+                _, (_, evicted) = cache.popitem(last=False)
+                total -= evicted
+
     def _execute_bitmap_call(self, index: str, c: Call, slices: list[int],
                              opt: ExecOptions) -> Bitmap:
+        compiled: list = []
+        key = self._bitmap_result_key(index, c, slices, compiled)
+        if key is not None:
+            with self._bitmap_results_mu:
+                hit = self._bitmap_results.get(key)
+                if hit is not None:
+                    self._bitmap_results.move_to_end(key)
+            if hit is not None:
+                return self._share_result(hit[0])
+
         def map_fn(slice):
             return self._bitmap_call_slice(index, c, slice)
 
@@ -367,13 +445,17 @@ class Executor:
             prev.merge(v)
             return prev
 
-        local_fn = self._bitmap_local_device_fn(index, c, opt)
+        local_fn = self._bitmap_local_device_fn(
+            index, c, opt, compiled=compiled[0] if compiled else None)
         bm = self._map_reduce(index, slices, c, opt, map_fn, reduce_fn,
                               local_fn=local_fn)
         if bm is None:
             bm = Bitmap()
         if c.name == "Bitmap":
             self._attach_bitmap_attrs(index, c, bm)
+        if key is not None:
+            self._result_cache_put(key, bm)
+            return self._share_result(bm)
         return bm
 
     def _attach_bitmap_attrs(self, index: str, c: Call, bm: Bitmap) -> None:
@@ -780,7 +862,7 @@ class Executor:
         return expr
 
     def _bitmap_local_device_fn(self, index: str, c: Call,
-                                opt: ExecOptions):
+                                opt: ExecOptions, compiled=None):
         """Materializing Union/Intersect/Difference on device for WIDE
         fan-outs (BASELINE config 2: Union over 1 K rows): fold the
         packed leaf slabs in one sharded program (the leaf axis reduces
@@ -794,8 +876,11 @@ class Executor:
             return None  # pod host legs own pod materialization
         if c.name not in ("Union", "Intersect", "Difference"):
             return None
-        leaves: list[tuple] = []
-        expr = self._compile_device_expr(index, c, leaves)
+        if compiled is not None:
+            expr, leaves = compiled
+        else:
+            leaves = []
+            expr = self._compile_device_expr(index, c, leaves)
         if expr is None or len(leaves) < self.mesh_min_leaves:
             return None
 
@@ -913,7 +998,8 @@ class Executor:
         return local_fn
 
     def _device_pays(self, mesh, n_rows: int, n_slices: int,
-                     cold_rows: int = 0, note: dict | None = None) -> bool:
+                     cold_rows: int = 0, note: dict | None = None,
+                     streaming: bool = False) -> bool:
         """Calibrated routing veto: False when the host path clearly
         wins for a block of ``n_rows × n_slices`` packed rows on this
         hardware (round 2's c4 showed the static threshold sending
@@ -934,7 +1020,8 @@ class Executor:
         from .ops.packed import WORDS_PER_SLICE
         row_bytes = n_slices * WORDS_PER_SLICE * 4
         pays = self.cost_model.device_pays(
-            n_rows * row_bytes, cold_bytes=cold_rows * row_bytes)
+            n_rows * row_bytes, cold_bytes=cold_rows * row_bytes,
+            streaming=streaming)
         if not pays:
             self.cost_vetoes += 1
             if note is not None:
@@ -945,19 +1032,24 @@ class Executor:
         return pays
 
     def _timed_device_leg(self, fn, n_rows: int, n_slices: int,
-                          cold_rows: int = 0):
+                          cold_rows: int = 0, streaming: bool = False):
         """Run a device leg and feed (predicted, actual) back into the
-        cost model's drift loop (no-op when the model is off)."""
+        cost model's drift loop (no-op when the model is off).
+        Streaming legs (block re-packed every query) record under
+        their own leg — the prediction prices the packing via
+        pack_bps, so they participate in drift correction instead of
+        being excluded (VERDICT r4 item 6)."""
         model = self.cost_model
         if model is None:
             return fn()
         from .ops.packed import WORDS_PER_SLICE
+        leg = "device_stream" if streaming else "device"
         row_bytes = n_slices * WORDS_PER_SLICE * 4
-        pred = model.predict("device", n_rows * row_bytes,
+        pred = model.predict(leg, n_rows * row_bytes,
                              cold_rows * row_bytes)
         t0 = time.perf_counter()
         out = fn()
-        model.record("device", pred, time.perf_counter() - t0)
+        model.record(leg, pred, time.perf_counter() - t0)
         return out
 
     def _record_host_leg(self, note: dict, elapsed_s: float) -> None:
@@ -1050,6 +1142,10 @@ class Executor:
         row_ids, _ = c.uint_slice_arg("ids")
         n, _ = c.uint_arg("n")
 
+        fast = self._topn_host_single_pass(index, c, slices, opt)
+        if fast is not None:
+            return fast
+
         pairs = self._top_n_slices(index, c, slices, opt)
         # Only the originating node refetches exact counts for candidates.
         if not pairs or row_ids or opt.remote:
@@ -1060,6 +1156,99 @@ class Executor:
         if n and n < len(trimmed):
             trimmed = trimmed[:n]
         return trimmed
+
+    def _topn_host_single_pass(self, index: str, c: Call,
+                               slices: list[int],
+                               opt: ExecOptions) -> Optional[list[Pair]]:
+        """The plain sourceless TopN form on a single local node in ONE
+        pass over the rank caches, or None for the general path.
+
+        The reference runs two per-slice phases: local tops merged into
+        a candidate union, then an exact-count refetch of every
+        candidate on every slice (executor.go:273-310). With complete
+        per-fragment LRU count caches both phases read the SAME arrays,
+        so one walk yields both: the ≥floor prefix feeds a dense
+        accumulator (the phase-2 exact sums — per-slice floor applied,
+        per reference semantics) and its n-trim marks candidates (the
+        phase-1 union). At 1024 slices the two-phase path's second walk
+        — per-slice locks, id sorts, membership probes, recounts — was
+        the whole superlinear term (VERDICT r4 item 3: 282 ms at 1024
+        slices vs 21 ms at 256); this leg is ~linear in slices.
+
+        Safety gates: LRU caches only (RankCache rankings are
+        rate-limited-stale and threshold-trimmed; the per-slice path
+        reads them with its own staleness rules), caches must not have
+        evicted (an evicted row's exact count needs the phase-2
+        recount), and any distribution (cluster peers, pod, remote
+        legs) keeps the fan-out path."""
+        (frame_name, n, field, row_ids, min_threshold, filters,
+         tanimoto) = self._topn_args(c)
+        if (opt.remote or row_ids or len(c.children) > 0
+                or (field and filters) or tanimoto > 0
+                or self.pod is not None
+                or len(self.cluster.nodes) != 1):
+            return None
+        from .storage.cache import LRUCache
+        floor = max(min_threshold, 1)
+        acc_parts: list[tuple[np.ndarray, np.ndarray, int]] = []
+        max_id = 0
+        for slice in slices:
+            frag = self.holder.fragment(index, frame_name,
+                                        VIEW_STANDARD, slice)
+            if frag is None:
+                continue
+            cache = frag.cache
+            if (not isinstance(cache, LRUCache)
+                    or len(cache) >= cache.max_entries
+                    or not frag._cache_complete):
+                # Incomplete cache (eviction, or a crash-recovered
+                # fragment too big to repair on open): exact counts
+                # need the recounting two-phase path.
+                return None
+            with frag._mu:
+                ids, counts = cache.top_arrays()
+            if not len(ids):
+                continue
+            # counts are rank-sorted descending: the ≥floor set is a
+            # prefix (same binary-search cut as fragment.top).
+            cut = len(counts) - int(np.searchsorted(
+                counts[::-1], floor, side="left"))
+            if not cut:
+                continue
+            ids, counts = ids[:cut], counts[:cut]
+            acc_parts.append((ids, counts, min(n, cut) if n else cut))
+            m = int(ids.max())
+            if m > max_id:
+                max_id = m
+        if not acc_parts:
+            return []
+        if max_id < (1 << 24):
+            # Dense accumulate: per-slice ids are unique, so fancy
+            # assignment sums safely slice by slice; candidate marks
+            # come from each slice's n-trimmed prefix.
+            sums = np.zeros(max_id + 1, dtype=np.int64)
+            cand_mark = np.zeros(max_id + 1, dtype=bool)
+            for ids, counts, trim in acc_parts:
+                idx = ids.astype(np.int64)
+                sums[idx] += counts
+                cand_mark[idx[:trim]] = True
+            cand = np.flatnonzero(cand_mark)
+            cand_sums = sums[cand]
+        else:
+            all_ids = np.concatenate([p[0] for p in acc_parts])
+            all_counts = np.concatenate([p[1] for p in acc_parts])
+            uids, inv = np.unique(all_ids, return_inverse=True)
+            usums = np.bincount(inv,
+                                weights=all_counts).astype(np.int64)
+            cand = np.unique(np.concatenate(
+                [p[0][:p[2]] for p in acc_parts]))
+            cand_sums = usums[np.searchsorted(uids, cand)]
+        order = np.lexsort((cand, -cand_sums))
+        cand, cand_sums = cand[order], cand_sums[order]
+        if n:
+            cand, cand_sums = cand[:n], cand_sums[:n]
+        return [Pair(i, cnt) for i, cnt in zip(cand.tolist(),
+                                               cand_sums.tolist())]
 
     def _top_n_slices(self, index: str, c: Call, slices: list[int],
                       opt: ExecOptions) -> list[Pair]:
@@ -1201,7 +1390,8 @@ class Executor:
             if not (resident_ok and device_cache().contains(rows_key)):
                 cold += len(ids)
             if not self._device_pays(mesh, len(ids) + len(leaves),
-                                     len(slices), cold_rows=cold):
+                                     len(slices), cold_rows=cold,
+                                     streaming=not resident_ok):
                 return NotImplemented  # calibrated: host clearly faster
             try:
                 def run():
@@ -1216,19 +1406,16 @@ class Executor:
                                                   ids, slices),
                         self._pack_leaf_block(index, leaves, slices),
                         threshold=threshold, tanimoto=tanimoto)
-                if resident_ok:
-                    # Same drift feedback the Count device leg gets —
-                    # the TopN exact phase is the other big routed
-                    # surface. Only the resident form records: the
-                    # streaming form's window includes host-side block
-                    # packing the prediction doesn't price, which
-                    # would one-sidedly inflate device_scale (review
-                    # finding, round 4).
-                    counts = self._timed_device_leg(
-                        run, len(ids) + len(leaves), len(slices),
-                        cold_rows=cold)
-                else:
-                    counts = run()
+                # Same drift feedback the Count device leg gets — the
+                # TopN exact phase is the other big routed surface.
+                # The streaming form records under its own leg: the
+                # prediction now prices the per-query host-side block
+                # packing (Calibration.pack_bps), so its samples feed
+                # correction instead of being excluded (r4 review
+                # finding superseded by VERDICT r4 item 6).
+                counts = self._timed_device_leg(
+                    run, len(ids) + len(leaves), len(slices),
+                    cold_rows=cold, streaming=not resident_ok)
             except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
                 self._note_device_fallback("topn_exact", e)
                 return NotImplemented

@@ -92,11 +92,43 @@ def unpack_words(words: np.ndarray) -> np.ndarray:
 
 def unpack_to_bitmap(words: np.ndarray, base_word: int = 0) -> Bitmap:
     """Dense u32 word vector → roaring bitmap with positions offset by
-    ``base_word * 32``."""
-    pos = unpack_words(words)
-    if base_word:
-        pos = pos + np.uint64(base_word * WORD_BITS)
-    return Bitmap.from_sorted(pos)
+    ``base_word * 32``.
+
+    Container-direct build: the dense vector IS the container layout
+    (2048 u32 words per 2^16-value container), so dense containers
+    become zero-copy u64 views of the fetched array and only sparse
+    ones expand to value arrays — the expand-every-position
+    ``from_sorted`` path cost ~8 B/bit plus a full re-merge, which was
+    most of the device materialize leg's repack time (VERDICT r4 item
+    5). Requires container alignment (base_word and len multiples of
+    2048), which every device block satisfies; anything else falls
+    back to the general path."""
+    from ..storage.roaring import (ARRAY_MAX_SIZE, Container,
+                                   bitmap_words_to_values)
+    per_container = _WORDS_PER_CONTAINER  # 2048 u32 words
+    if (base_word % per_container or len(words) % per_container
+            or words.dtype != np.uint32 or not words.flags.c_contiguous):
+        pos = unpack_words(words)
+        if base_word:
+            pos = pos + np.uint64(base_word * WORD_BITS)
+        return Bitmap.from_sorted(pos)
+    counts = np.bitwise_count(words).astype(np.int64) \
+        .reshape(-1, per_container).sum(axis=1)
+    b = Bitmap()
+    base_key = base_word // per_container
+    w64 = words.view("<u8").reshape(-1, _WORDS_PER_CONTAINER // 2)
+    for ci in np.flatnonzero(counts).tolist():
+        n = int(counts[ci])
+        span64 = w64[ci]
+        if n > ARRAY_MAX_SIZE:
+            # Zero-copy view into the fetched block, COW-marked: the
+            # block outlives the bitmap via the view references.
+            c = Container.from_bitmap(span64, n=n, mapped=True)
+        else:
+            c = Container.from_array(bitmap_words_to_values(span64))
+        b.keys.append(base_key + ci)
+        b.containers.append(c)
+    return b
 
 
 def sparse_words(b: Bitmap, n_words: int, base_word: int = 0

@@ -49,8 +49,10 @@ from . import roaring
 from .bitmap import Bitmap
 from .cache import Pair
 
-# Number of operations before a snapshot rewrite (reference fragment.go:63-65).
-MAX_OP_N = 2000
+# Number of operations before a snapshot rewrite (reference
+# fragment.go:63-65). Env-overridable so longevity harnesses can force
+# snapshot storms (benchmarks/soak.py) without patching the module.
+MAX_OP_N = int(os.environ.get("PILOSA_TPU_MAX_OP_N", "2000"))
 
 # Rows per checksum block (reference fragment.go:59).
 HASH_BLOCK_SIZE = 100
@@ -136,6 +138,10 @@ class Fragment:
         self._row_counts: dict[int, int] = {}
         self._epoch = 0
         self._snapshot_n = 0
+        # True once the count cache provably covers every present row
+        # (set by _repair_cache_completeness on open; mutations maintain
+        # coverage, LRU eviction is gated by consumers on len>=max).
+        self._cache_complete = False
 
         self._mu = threading.RLock()
         # Snapshot lifecycle lock. Ordering rule: ALWAYS acquired
@@ -197,18 +203,49 @@ class Fragment:
     def _open_cache(self) -> None:
         # Re-rank persisted ids with counts from storage
         # (reference fragment.go:236-274).
+        ids = []
         try:
             with open(self.cache_path, "rb") as f:
                 ids = pb.Cache.FromString(f.read()).IDs
         except FileNotFoundError:
-            return
+            pass
         except Exception:
             # The cache is advisory and reconstructible; a corrupt sidecar
             # (e.g. torn by a crash) must not brick the fragment.
-            return
+            pass
         for rid in ids:
             self.cache.bulk_add(rid, self.row_count(rid))
+        self._repair_cache_completeness()
         self.cache.recalculate()
+
+    def _repair_cache_completeness(self) -> None:
+        """The sidecar lags reality by up to one flush interval: rows
+        first written after the last flush exist in the replayed WAL
+        but not in the persisted id list, so after a crash the count
+        cache silently misses them (review r5 — the single-pass TopN
+        sums only cache entries, and would under-rank those rows).
+        Detect by cardinality (exact per-row counts must sum to the
+        storage total), repair from present_rows when the fragment is
+        small enough to dump positions, else leave the cache flagged
+        incomplete — consumers needing completeness (the single-pass
+        TopN leg) then fall back to the recounting path."""
+        total = self.storage.count()
+        cached = 0
+        if hasattr(self.cache, "_od"):
+            cached = sum(self.cache._od.values())
+        elif hasattr(self.cache, "entries"):
+            cached = sum(self.cache.entries.values())
+        if cached == total:
+            self._cache_complete = True
+            return
+        if total <= _POSITIONS_CACHE_BITS:
+            present = self.present_rows()
+            if present is not None:
+                for rid in present.tolist():
+                    self.cache.bulk_add(rid, self.row_count(rid))
+                self._cache_complete = True
+                return
+        self._cache_complete = False
 
     def close(self) -> None:
         # _snap_mu first (lock order): waits out any worker and blocks

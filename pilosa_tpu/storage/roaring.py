@@ -1145,6 +1145,15 @@ class Bitmap:
             for v in vals:
                 yield base + int(v)
 
+    def shared(self) -> "Bitmap":
+        """A bitmap sharing this one's containers copy-on-write (both
+        sides are marked; whichever mutates first copies). O(containers)
+        — the executor's result-cache handout."""
+        out = Bitmap()
+        out.keys = list(self.keys)
+        out.containers = [_shared_copy(c) for c in self.containers]
+        return out
+
     def unmap(self) -> None:
         """Copy all mapped container data out of the backing buffer.
 
