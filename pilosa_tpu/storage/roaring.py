@@ -446,8 +446,14 @@ class Bitmap:
         self.torn_bytes = 0  # dangling tail bytes found during unmarshal
         # Frozen-capture COW epoch (see Container.cow) and the
         # incrementally-maintained serialization table (see _SerTable).
+        # Point mutations record their container key in _table_dirty
+        # instead of invalidating the table — the entries are patched
+        # in bulk before any table read (_flush_table_dirty), keeping
+        # the MAX_OP_N freeze O(dirty) instead of O(all containers)
+        # for per-op write workloads.
         self._cow_epoch = 0
         self._table: Optional[_SerTable] = None
+        self._table_dirty: set[int] = set()
         for v in values:
             self._add(v)
 
@@ -493,10 +499,18 @@ class Bitmap:
         return changed
 
     def _add(self, v: int) -> bool:
-        c = self._container_or_create(highbits(v))
+        key = highbits(v)
+        if self._table is not None:
+            n0 = len(self.keys)
+            c = self._container_or_create(key)
+            if len(self.keys) != n0:
+                self._table = None  # new container: indices shifted
+            else:
+                self._table_dirty.add(key)
+        else:
+            c = self._container_or_create(key)
         if c.bitmap is not None:
             self._guard_inplace(c)
-        self._table = None
         return c.add(lowbits(v))
 
     def remove(self, v: int) -> bool:
@@ -506,12 +520,14 @@ class Bitmap:
         return changed
 
     def _remove(self, v: int) -> bool:
-        c = self.container(highbits(v))
+        key = highbits(v)
+        c = self.container(key)
         if c is None:
             return False
+        if self._table is not None:
+            self._table_dirty.add(key)
         if c.bitmap is not None:
             self._guard_inplace(c)
-        self._table = None
         return c.remove(lowbits(v))
 
     def contains(self, v: int) -> bool:
@@ -750,6 +766,11 @@ class Bitmap:
             return self._apply_groups_python(conts, group_keys,
                                              chunk_vals, starts, set,
                                              wal)
+        # Point mutations since the last table read are parked in the
+        # dirty set; their entries MUST be patched before the gather
+        # below trusts table pointers/counts (stale entries feed the
+        # native engine wrong buffers).
+        self._flush_table_dirty()
         if self._table is None and n_g * 4 >= len(containers):
             # Rebuilding once makes this and every later batch's prep
             # fully vectorized; below the ratio a point-op-heavy mix
@@ -1192,10 +1213,45 @@ class Bitmap:
                 for k, c in zip(self.keys, self.containers) if c.n > 0]
         return _write_snapshot(live, w)
 
+    def _flush_table_dirty(self) -> None:
+        """Patch point-mutated containers' entries into the
+        serialization table — MUST run before any table read (freeze,
+        the batch gather prep). A dirty set rivaling the table size
+        falls back to wholesale invalidation (rebuild costs the
+        same)."""
+        t = self._table
+        dirty = self._table_dirty
+        if not dirty:
+            return
+        if t is None:
+            dirty.clear()
+            return
+        if len(dirty) * 2 >= len(self.keys):
+            # Patching costs ~1 us/key (bisect + 4 field stores) vs
+            # ~1.2 us/container for a wholesale rebuild — only punt to
+            # the rebuild when most of the table is dirty anyway.
+            self._table = None
+            dirty.clear()
+            return
+        keys = self.keys
+        conts = self.containers
+        for key in dirty:
+            i = bisect.bisect_left(keys, key)
+            if i >= len(keys) or keys[i] != key:
+                continue
+            c = conts[i]
+            b = c.bitmap if c.bitmap is not None else c.array
+            t.bufs[i] = b
+            t.ns[i] = c.n
+            t.types[i] = 0 if c.bitmap is None else 1
+            t.ptrs[i] = b.__array_interface__["data"][0]
+        dirty.clear()
+
     def _rebuild_table(self) -> "_SerTable":
         """Full rebuild of the serialization table (one pass; after this
         the batched write path keeps it current incrementally and
         freeze() is O(1))."""
+        self._table_dirty.clear()
         n = len(self.containers)
         ns = np.empty(n, dtype=np.int64)
         types = np.empty(n, dtype=np.uint8)
@@ -1214,15 +1270,19 @@ class Bitmap:
 
     def freeze(self) -> "_Frozen":
         """Consistent point-in-time capture for ASYNC serialization,
-        O(1) when the serialization table is current (the batched write
-        path maintains it; point mutations invalidate it and the next
-        freeze rebuilds once). Instead of marking every container
+        O(1)+O(point-dirtied entries) when the serialization table is
+        current (the batched write path maintains it in place; point
+        mutations park their container key in _table_dirty and
+        _flush_table_dirty patches just those entries here — only
+        structural changes from point ops, i.e. new containers,
+        invalidate wholesale). Instead of marking every container
         mapped, freezing bumps the COW epoch: any later in-place
         bitmap-word mutation copies its buffer first (Container.cow),
         and array buffers are replaced, never mutated — so the captured
         pointers stay valid with no per-container work. write_frozen
         serializes the capture with no lock held
         (fragment.snapshot's background path)."""
+        self._flush_table_dirty()
         t = self._table
         if t is None:
             t = self._rebuild_table()
@@ -1329,8 +1389,10 @@ class _SerTable:
     """Serialization table aligned with Bitmap.containers: per-container
     (n, type, buffer pointer, buffer ref), maintained incrementally by
     apply_batch so the MAX_OP_N snapshot freeze is O(1) instead of
-    O(all containers). Point-mutation paths invalidate it; the next
-    freeze rebuilds once."""
+    O(all containers). Point mutations record their container key in
+    Bitmap._table_dirty for bulk patching before any table read; only
+    structural changes (new containers from point ops, bulk rewrites)
+    invalidate wholesale."""
 
     __slots__ = ("ns", "types", "ptrs", "bufs")
 

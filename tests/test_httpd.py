@@ -184,3 +184,47 @@ def test_malformed_request_gets_400(server):
         assert data.startswith("HTTP/1.1 400")
     finally:
         s.close()
+
+
+def test_concurrent_connections_mixed_load(server):
+    """Many connections driving reads and writes at once: the
+    thread-per-connection server must keep responses framed per
+    connection with no cross-talk (each connection writes rows only it
+    writes, then reads its own count back)."""
+    import threading
+
+    s0 = _conn(server)
+    _setup_schema(s0)
+    s0.close()
+    errs: list = []
+
+    def worker(wid: int):
+        try:
+            s = _conn(server)
+            try:
+                for i in range(30):
+                    s.sendall(_req(
+                        "POST", "/index/i/query",
+                        f'SetBit(frame="f", rowID={100 + wid},'
+                        f' columnID={i})'.encode()))
+                    (r,) = _read_responses(s, 1)
+                    assert '"results": [true]' in r, r[-120:]
+                s.sendall(_req(
+                    "POST", "/index/i/query",
+                    f'Count(Bitmap(frame="f", rowID={100 + wid}))'
+                    .encode()))
+                (r,) = _read_responses(s, 1)
+                assert '"results": [30]' in r, (wid, r[-120:])
+            finally:
+                s.close()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errs.append((wid, e))
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "worker hung"
+    assert not errs, errs[:3]

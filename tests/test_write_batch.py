@@ -413,3 +413,49 @@ class TestCacheCompletenessAfterCrash:
             assert ids[1] == (1, 50), ids
             ex.close()
             h3.close()
+
+
+class TestTableDirtyPatching:
+    def test_interleaved_point_batch_freeze_conversions(self):
+        """Point mutations interleaved with batches, freezes, and
+        container conversions (array<->bitmap boundary crossings): the
+        serialization table must never serve stale types/pointers to
+        the batch engine or to frozen captures."""
+        rng = np.random.default_rng(42)
+        ref = roaring.Bitmap()
+        b = roaring.Bitmap()
+        snaps = []
+        for rounds in range(30):
+            # batch adds clustered into few containers (drives some
+            # past the 4096 array boundary over time)
+            chunk = (np.uint64((rounds % 4) << 16)
+                     + rng.integers(0, 50000, 600).astype(np.uint64))
+            b.apply_batch(chunk, set=True, wal=False)
+            for v in chunk.tolist():
+                ref._add(v)
+            # point ops on the SAME containers (stale-entry hazard)
+            for _ in range(20):
+                v = int((rounds % 4) << 16) + int(rng.integers(0, 50000))
+                b._add(v)
+                ref._add(v)
+            v = int((rounds % 4) << 16) + int(rng.integers(0, 50000))
+            b._remove(v)
+            ref._remove(v)
+            # freeze mid-stream; serialize later and compare
+            if rounds % 3 == 0:
+                snaps.append((b.freeze(), b.count()))
+            # batch removes
+            rm = chunk[::5]
+            b.apply_batch(rm, set=False, wal=False)
+            for v in rm.tolist():
+                ref._remove(v)
+        assert np.array_equal(ref.values(), b.values())
+        b.check()
+        with tempfile.TemporaryDirectory() as d:
+            for k, (fr, cnt) in enumerate(snaps):
+                p = os.path.join(d, f"s{k}")
+                with open(p, "wb") as f:
+                    roaring.write_frozen(fr, f)
+                loaded = roaring.Bitmap.unmarshal(open(p, "rb").read())
+                loaded.check()
+                assert loaded.count() == cnt, k
