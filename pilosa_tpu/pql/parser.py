@@ -328,18 +328,24 @@ class Parser:
 # falls back to the full parser, which keeps exact reference error
 # semantics (pql/parser.go:66-260).
 _FAST_ARG = (r"[A-Za-z][A-Za-z0-9_\-.]*\s*=\s*"
-             r"(?:-?[0-9]+|\"[A-Za-z0-9 _\-.:]*\"|'[A-Za-z0-9 _\-.:]*')")
+             r"(?:-?[0-9]+(?![0-9.])|\"[A-Za-z0-9 _\-.:]*\""
+             r"|'[A-Za-z0-9 _\-.:]*'"
+             r"|\[\s*-?[0-9]+\s*(?:,\s*-?[0-9]+\s*)*\])")
 _FAST_CALL_RE = re.compile(
     r"\s*([A-Za-z][A-Za-z0-9_\-.]*)\(\s*(?:(" + _FAST_ARG
     + r"(?:\s*,\s*" + _FAST_ARG + r")*))?\s*\)\s*")
 _FAST_ARG_RE = re.compile(
     r"([A-Za-z][A-Za-z0-9_\-.]*)\s*=\s*"
-    r"(?:(-?[0-9]+)|\"([A-Za-z0-9 _\-.:]*)\"|'([A-Za-z0-9 _\-.:]*)')")
+    r"(?:(-?[0-9]+)(?![0-9.])|\"([A-Za-z0-9 _\-.:]*)\""
+    r"|'([A-Za-z0-9 _\-.:]*)'"
+    r"|\[\s*(-?[0-9]+\s*(?:,\s*-?[0-9]+\s*)*)\])")
 
 
 def _parse_fast(text: str):
     """Query for a flat call list, or None when any call needs the full
-    grammar (children, lists, floats, escapes, bool/null idents)."""
+    grammar (children, non-integer lists, floats, escapes, bool/null
+    idents). Integer lists — the TopN exact-phase forwarding shape —
+    stay on the fast path."""
     query = Query()
     i = 0
     n = len(text)
@@ -354,12 +360,20 @@ def _parse_fast(text: str):
             args = call.args
             count = 0
             for am in _FAST_ARG_RE.finditer(body):
-                key, intv, dq, sq = am.groups()
+                key, intv, dq, sq, lst = am.groups()
                 if intv is not None:
                     v = int(intv)
                     if not -(1 << 63) <= v < 1 << 63:
                         return None  # full parser raises the bound error
                     args[key] = v
+                elif lst is not None:
+                    # Empty lists are a grammar error (the full parser
+                    # requires >=1 value), so the regex requires one.
+                    vals = [int(x) for x in lst.split(",")]
+                    if any(not -(1 << 63) <= v < 1 << 63
+                           for v in vals):
+                        return None
+                    args[key] = vals
                 else:
                     args[key] = dq if dq is not None else sq
                 count += 1

@@ -62,8 +62,15 @@ def test_writers_vs_device_readers(holder):
                           " ids=[1, 2])",
                           "TopN(frame=f, n=2)",
                           "TopN(Bitmap(frame=f, rowID=2), frame=f,"
-                          " n=2)")
-                    ex.execute("i", qs[k % 4])
+                          " n=2)",
+                          # round 5: the materialized-result residency
+                          # cache (generation-keyed hits/puts/evictions
+                          # racing the writers' invalidating bumps)
+                          "Union(Bitmap(frame=f, rowID=1),"
+                          " Bitmap(frame=f, rowID=2))",
+                          "Difference(Bitmap(frame=f, rowID=1),"
+                          " Bitmap(frame=f, rowID=2))")
+                    ex.execute("i", qs[k % 6])
         except Exception as e:  # noqa: BLE001 - surfaced below
             errs.append((tid, repr(e)))
 
@@ -99,3 +106,14 @@ def test_writers_vs_device_readers(holder):
                             " ids=[1, 2])")[0]
     assert {p.id: p.count for p in pairs} == \
         {1: len(t1 & t2), 2: len(t2)}
+    # The result cache must serve FRESH unions post-storm (every write
+    # bumped the input fragments' generations, so any cached entry
+    # still being served must correspond to the final state).
+    got = set(ex.execute("i", "Union(Bitmap(frame=f, rowID=1),"
+                              " Bitmap(frame=f, rowID=2))")[0]
+              .bits().tolist())
+    assert got == (t1 | t2)
+    got = set(ex.execute("i", "Union(Bitmap(frame=f, rowID=1),"
+                              " Bitmap(frame=f, rowID=2))")[0]
+              .bits().tolist())  # repeat: a cache hit, same answer
+    assert got == (t1 | t2)

@@ -228,3 +228,69 @@ def test_concurrent_connections_mixed_load(server):
         t.join(timeout=30)
     assert not any(t.is_alive() for t in threads), "worker hung"
     assert not errs, errs[:3]
+
+
+def test_fuzz_pipelined_equals_sequential(server):
+    """Property test: a random pipelined stream of valid and invalid
+    queries over one connection must produce byte-for-byte the same
+    (status, results) sequence as the same stream sent one request at
+    a time on a fresh server — batching is an invisible optimization."""
+    import random
+
+    rng = random.Random(1234)
+
+    def rand_stream(n):
+        out = []
+        for _ in range(n):
+            kind = rng.random()
+            if kind < 0.55:
+                out.append(f'SetBit(frame="f", rowID={rng.randrange(6)},'
+                           f' columnID={rng.randrange(2000)})')
+            elif kind < 0.65:
+                out.append(f'ClearBit(frame="f", rowID={rng.randrange(6)},'
+                           f' columnID={rng.randrange(2000)})')
+            elif kind < 0.75:
+                out.append(f'Count(Bitmap(frame="f",'
+                           f' rowID={rng.randrange(6)}))')
+            elif kind < 0.85:
+                out.append('TopN(frame="f", n=3)')
+            elif kind < 0.93:
+                out.append(f'SetBit(frame="missing",'
+                           f' rowID=1, columnID={rng.randrange(99)})')
+            else:
+                out.append("Union(")  # parse error
+        return out
+
+    def normalize(resp: str) -> tuple:
+        status = resp.split(" ", 2)[1]
+        body = resp[resp.find("\r\n\r\n") + 4:]
+        return (status, body)
+
+    stream = rand_stream(120)
+    s = _conn(server)
+    _setup_schema(s)
+    # pipelined: all at once
+    s.sendall(b"".join(_req("POST", "/index/i/query", q.encode())
+                       for q in stream))
+    piped = [normalize(r) for r in _read_responses(s, len(stream),
+                                                   timeout=30.0)]
+    s.close()
+
+    # sequential on a fresh server (same data dir shape)
+    with tempfile.TemporaryDirectory() as d2:
+        srv2 = Server(d2, host="127.0.0.1:0", anti_entropy_interval=0,
+                      polling_interval=0)
+        srv2.open()
+        try:
+            s2 = _conn(srv2)
+            _setup_schema(s2)
+            seq = []
+            for q in stream:
+                s2.sendall(_req("POST", "/index/i/query", q.encode()))
+                (r,) = _read_responses(s2, 1, timeout=30.0)
+                seq.append(normalize(r))
+            s2.close()
+        finally:
+            srv2.close()
+    assert piped == seq, next(
+        (i, a, b) for i, (a, b) in enumerate(zip(piped, seq)) if a != b)
