@@ -1,0 +1,95 @@
+"""Pipelined single-connection HTTP SetBit client (suite leg
+config_http_pipelined_setbit drives this as a subprocess so the
+server-side measurement is GIL-clean).
+
+Responses are parsed with proper Content-Length framing (a substring
+count would miscount across recv boundaries); any non-200 response or
+early close aborts with rc=1 so the suite records an error instead of
+an inflated number.
+
+Usage: http_pipeline_client.py <host> <port> <n_ops>
+"""
+
+import select
+import socket
+import sys
+import time
+
+host, port, N = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+
+def req(path: str, body: bytes) -> bytes:
+    return (f"POST {path} HTTP/1.1\r\nHost: x\r\n"
+            f"Content-Length: {len(body)}\r\n\r\n").encode() + body
+
+
+def drain_responses(buf: bytearray) -> tuple[int, bool]:
+    """(complete responses consumed from buf, saw_error)."""
+    n = 0
+    while True:
+        end = buf.find(b"\r\n\r\n")
+        if end < 0:
+            return n, False
+        head = bytes(buf[:end]).decode("latin-1")
+        status = head.split(" ", 2)[1]
+        length = 0
+        for ln in head.split("\r\n")[1:]:
+            k, _, v = ln.partition(":")
+            if k.lower() == "content-length":
+                length = int(v)
+        total = end + 4 + length
+        if len(buf) < total:
+            return n, False
+        if status != "200":
+            sys.stderr.write(f"non-200 response: {status}\n")
+            return n, True
+        del buf[:total]
+        n += 1
+
+
+def main() -> int:
+    s = socket.create_connection((host, port))
+    s.sendall(req("/index/i", b"{}"))
+    time.sleep(0.2)
+    s.recv(65536)
+    s.sendall(req("/index/i/frame/f", b"{}"))
+    time.sleep(0.2)
+    s.recv(65536)
+
+    blob = b"".join(
+        req("/index/i/query",
+            f'SetBit(frame="f", rowID={i % 50},'
+            f' columnID={i * 13 % (1 << 20)})'.encode())
+        for i in range(N))
+    s.setblocking(False)
+    sent = 0
+    done = 0
+    buf = bytearray()
+    view = memoryview(blob)
+    t0 = time.perf_counter()
+    deadline = t0 + 180
+    while done < N:
+        if time.perf_counter() > deadline:
+            sys.stderr.write(f"timed out at {done}/{N}\n")
+            return 1
+        r, w, _ = select.select([s], [s] if sent < len(blob) else [],
+                                [], 5)
+        if w:
+            sent += s.send(view[sent:sent + (1 << 20)])
+        if r:
+            data = s.recv(1 << 20)
+            if not data:
+                sys.stderr.write(f"early close at {done}/{N}\n")
+                return 1
+            buf += data
+            got, bad = drain_responses(buf)
+            done += got
+            if bad:
+                return 1
+    el = time.perf_counter() - t0
+    print(f"RESULT {done / el:.0f} op/s responses={done}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -293,4 +293,27 @@ def test_fuzz_pipelined_equals_sequential(server):
         finally:
             srv2.close()
     assert piped == seq, next(
-        (i, a, b) for i, (a, b) in enumerate(zip(piped, seq)) if a != b)
+        ((i, a, b) for i, (a, b) in enumerate(zip(piped, seq))
+         if a != b), ("len", len(piped), len(seq)))
+
+
+def test_batch_lane_count_of_one_stays_numeric(server):
+    """Regression: Python's [1] == [True], so a naive cached-payload
+    fast path would rewrite a Count result of exactly 1 into JSON
+    `true` on the batch lane (review r5). Counts must stay numbers."""
+    s = _conn(server)
+    try:
+        _setup_schema(s)
+        s.sendall(_req("POST", "/index/i/query",
+                       b'SetBit(frame="f", rowID=4, columnID=9)'))
+        _read_responses(s, 1)
+        # Two pipelined requests so the batch lane engages.
+        s.sendall(_req("POST", "/index/i/query",
+                       b'Count(Bitmap(frame="f", rowID=4))')
+                  + _req("POST", "/index/i/query",
+                         b'Count(Bitmap(frame="f", rowID=99))'))
+        r1, r2 = _read_responses(s, 2)
+        assert '"results": [1]' in r1, r1[-80:]
+        assert '"results": [0]' in r2, r2[-80:]
+    finally:
+        s.close()
