@@ -47,14 +47,31 @@ def drain_responses(buf: bytearray) -> tuple[int, bool]:
         n += 1
 
 
+def read_n(s: socket.socket, buf: bytearray, n: int) -> None:
+    """Consume exactly n framed responses (setup handshake — a bare
+    recv could leave a split response's tail to be miscounted later)."""
+    got = 0
+    while got < n:
+        inc, bad = drain_responses(buf)
+        got += inc
+        if bad:
+            sys.exit(1)
+        if got < n:
+            data = s.recv(65536)
+            if not data:
+                sys.stderr.write("closed during setup\n")
+                sys.exit(1)
+            buf += data
+
+
 def main() -> int:
     s = socket.create_connection((host, port))
-    s.sendall(req("/index/i", b"{}"))
-    time.sleep(0.2)
-    s.recv(65536)
-    s.sendall(req("/index/i/frame/f", b"{}"))
-    time.sleep(0.2)
-    s.recv(65536)
+    setup_buf = bytearray()
+    s.sendall(req("/index/i", b"{}") + req("/index/i/frame/f", b"{}"))
+    read_n(s, setup_buf, 2)
+    if setup_buf:
+        sys.stderr.write("unexpected bytes after setup\n")
+        return 1
 
     blob = b"".join(
         req("/index/i/query",

@@ -644,7 +644,23 @@ class Executor:
         if len(c.children) > 1:
             raise PilosaError("Count() only accepts a single bitmap input")
 
+        # Count(Intersect(A, B)) host legs count WITHOUT materializing
+        # the intersection — the reference's IntersectionCount shortcut
+        # (bitmap.go:69-82, roaring.go:328-343); with the native
+        # whole-bitmap count one crossing covers a slice.
+        child = c.children[0]
+        pairwise = (child.name == "Intersect"
+                    and len(child.children) == 2
+                    and all(gc.name == "Bitmap"
+                            for gc in child.children))
+
         def map_fn(slice):
+            if pairwise:
+                a = self._bitmap_call_slice(index, child.children[0],
+                                            slice)
+                b = self._bitmap_call_slice(index, child.children[1],
+                                            slice)
+                return a.intersection_count(b)
             return self._bitmap_call_slice(index, c.children[0],
                                            slice).count()
 

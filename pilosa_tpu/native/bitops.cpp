@@ -129,6 +129,52 @@ int64_t unpack_words_u32(const uint32_t* words, int64_t n_words,
     return k;
 }
 
+// ---- whole-bitmap intersection count ---------------------------------------
+// One crossing for an entire two-level intersection count: zip both
+// bitmaps' container tables (sorted keys + per-container type/ptr/n)
+// and dispatch per pair kind — the reference's intersectionCount
+// container dispatch (roaring.go:1192-1268) with the Python walk
+// removed. Tables are the serialization tables the batch engine
+// already maintains (roaring._SerTable).
+
+extern "C" int64_t bitmap_intersection_count(
+        int64_t na, const uint64_t* keys_a, const uint8_t* types_a,
+        const uint64_t* ptrs_a, const int64_t* ns_a,
+        int64_t nb, const uint64_t* keys_b, const uint8_t* types_b,
+        const uint64_t* ptrs_b, const int64_t* ns_b) {
+    int64_t i = 0, j = 0;
+    int64_t total = 0;
+    while (i < na && j < nb) {
+        if (keys_a[i] < keys_b[j]) { i++; continue; }
+        if (keys_a[i] > keys_b[j]) { j++; continue; }
+        if (ns_a[i] && ns_b[j]) {
+            bool bm_a = types_a[i] != 0, bm_b = types_b[j] != 0;
+            if (!bm_a && !bm_b) {
+                total += intersection_count_sorted_u32(
+                    (const uint32_t*)ptrs_a[i], ns_a[i],
+                    (const uint32_t*)ptrs_b[j], ns_b[j]);
+            } else if (bm_a && bm_b) {
+                total += (int64_t)popcnt_and(
+                    (const uint64_t*)ptrs_a[i],
+                    (const uint64_t*)ptrs_b[j], 1024);
+            } else {
+                const uint32_t* arr = (const uint32_t*)(
+                    bm_a ? ptrs_b[j] : ptrs_a[i]);
+                int64_t n_arr = bm_a ? ns_b[j] : ns_a[i];
+                const uint64_t* bm = (const uint64_t*)(
+                    bm_a ? ptrs_a[i] : ptrs_b[j]);
+                for (int64_t t = 0; t < n_arr; t++) {
+                    uint32_t v = arr[t];
+                    total += (bm[v >> 6] >> (v & 63)) & 1ULL;
+                }
+            }
+        }
+        i++;
+        j++;
+    }
+    return total;
+}
+
 // ---- batched write engine ---------------------------------------------------
 // ONE crossing per mutation batch: container merges, changed-value
 // detection, and WAL record construction all happen here, so the serving
