@@ -187,6 +187,7 @@ def main() -> None:
     if device_s is not None:
         line = {
             "metric": metric,
+            "bits": bits,
             "value": round(1.0 / device_s, 3),
             "unit": "ops/sec",
             # vs_baseline uses the PINNED (best-ever, i.e. fastest) host
@@ -233,19 +234,24 @@ def main() -> None:
         # Roofline accounting (VERDICT r4 item 4): effective HBM GB/s of
         # THIS run's number (arithmetic, a measurement) + the untunneled
         # v5e-8 projections for configs 4-5 (labeled projections, from
-        # recorded kernel times — benchmarks/roofline.py).
-        try:
-            from benchmarks import roofline
-            roof = roofline.compute(metric_ops_s=line["value"])
-            line["effective_hbm_gbps"] = \
-                roof["metric_of_record"]["effective_hbm_gbps"]
-            line["hbm_fraction_of_v5e_peak"] = \
-                roof["metric_of_record"]["fraction_of_v5e_peak"]
-            with open(os.path.join(os.path.dirname(_BASELINE_PATH),
-                                   "ROOFLINE.json"), "w") as f:
-                json.dump(roof, f, indent=1)
-        except Exception:  # noqa: BLE001 - accounting must not kill the line
-            pass
+        # recorded kernel times — benchmarks/roofline.py). Only at the
+        # canonical 2^30-bit shape: roofline.compute's bytes/op assumes
+        # it, and smaller smoke shapes under-amortize the dispatch so
+        # their GB/s is not the metric of record (a reduced smoke once
+        # overwrote ROOFLINE.json with a wrong-arithmetic number).
+        if bits == (1 << 30):
+            try:
+                from benchmarks import roofline
+                roof = roofline.compute(metric_ops_s=line["value"])
+                line["effective_hbm_gbps"] = \
+                    roof["metric_of_record"]["effective_hbm_gbps"]
+                line["hbm_fraction_of_v5e_peak"] = \
+                    roof["metric_of_record"]["fraction_of_v5e_peak"]
+                with open(os.path.join(os.path.dirname(_BASELINE_PATH),
+                                       "ROOFLINE.json"), "w") as f:
+                    json.dump(roof, f, indent=1)
+            except Exception:  # noqa: BLE001 - must not kill the line
+                pass
         print(json.dumps(line))
     else:
         # Fail-soft: record the host-C++ denominator so the round still

@@ -68,7 +68,13 @@ def compute(metric_ops_s: float | None = None) -> dict:
             with open(os.path.join(HERE, "..", bench_files[-1])) as f:
                 rec = json.load(f)
             line = json.loads(rec["tail"]) if "tail" in rec else rec
-            metric_ops_s = line["value"]
+            # Only the canonical 2^30-bit shape matches the hardcoded
+            # bytes/op below; older lines without a "bits" field are
+            # all canonical (the field arrived with the guard).
+            if line.get("bits", 1 << 30) != (1 << 30):
+                metric_ops_s = None
+            else:
+                metric_ops_s = line["value"]
         except (OSError, ValueError, KeyError, IndexError):
             metric_ops_s = None
     if metric_ops_s:
@@ -76,6 +82,9 @@ def compute(metric_ops_s: float | None = None) -> dict:
         eff = metric_ops_s * bytes_per_op / 1e9
         out["metric_of_record"] = {
             "kind": "measurement",
+            "note": "computed from the quoted run's ops/s; shared-VM "
+                    "slots swing ops/s (and thus GB/s) ~±10% run to "
+                    "run — compare same-run canaries, not absolutes",
             "ops_per_s": metric_ops_s,
             "bytes_per_op": bytes_per_op,
             "arithmetic": f"{metric_ops_s:.0f} ops/s x {bytes_per_op}"
