@@ -245,15 +245,44 @@ class Frame:
         do_inverse = self.inverse_enabled and views in (None, "inverse")
 
         def put_arrays(view_names, rids_a, cids_a):
-            # One stable argsort groups the bits by slice, shared by
-            # every view name that receives them (time fan-out sends
-            # the same arrays to up to 5 views) — this is the
-            # bulk-import hot lane (per-bit grouping cost more than
-            # the roaring adds it fed).
-            for slice, rs, cs in group_by_key(
-                    cids_a // np.uint64(SLICE_WIDTH), rids_a, cids_a):
+            # The bulk-import hot lane, shared by every view name that
+            # receives the arrays (time fan-out sends the same bits to
+            # up to 5 views). Fast path: pack (slice, position) into
+            # one u64 key — ONE np.sort + dedupe then orders every
+            # fragment's positions at once, so neither a group argsort
+            # here nor a per-fragment re-sort in add_many happens.
+            # Applies whenever rows fit 24 bits and slices 20 bits
+            # (position < 2^44); wider ids take the generic group-by.
+            if not len(rids_a):
+                return
+            W = np.uint64(SLICE_WIDTH)
+            slices_a = cids_a // W
+            if (int(rids_a.max()) < (1 << 24)
+                    and int(slices_a.max()) < (1 << 20)):
+                packed = ((slices_a << np.uint64(44))
+                          | (rids_a * W + cids_a % W))
+                packed = np.sort(packed)
+                if len(packed) > 1:
+                    keep = np.empty(len(packed), dtype=bool)
+                    keep[0] = True
+                    np.not_equal(packed[1:], packed[:-1], out=keep[1:])
+                    if not keep.all():
+                        packed = packed[keep]
+                positions_all = packed & np.uint64((1 << 44) - 1)
+                sl = packed >> np.uint64(44)
+                b = np.flatnonzero(sl[1:] != sl[:-1]) + 1
+                for s, e in zip(
+                        np.concatenate(([0], b)).tolist(),
+                        np.concatenate((b, [len(sl)])).tolist()):
+                    pos_v = positions_all[s:e]
+                    for vn in view_names:
+                        data.setdefault((vn, int(sl[s])), []).append(
+                            pos_v)
+                return
+            for slice, rs, cs in group_by_key(slices_a, rids_a, cids_a):
+                pos_v = rs * W + cs % W
                 for vn in view_names:
-                    data.setdefault((vn, slice), []).append((rs, cs))
+                    data.setdefault((vn, slice), []).append(pos_v)
 
         if timestamps is None:
             plain = np.ones(len(rows), dtype=bool)
@@ -298,9 +327,5 @@ class Frame:
         for (view_name, slice), chunks in sorted(data.items()):
             view = self.create_view_if_not_exists(view_name)
             frag = view.create_fragment_if_not_exists(slice)
-            if len(chunks) == 1:
-                rs, cs = chunks[0]
-            else:
-                rs = np.concatenate([c[0] for c in chunks])
-                cs = np.concatenate([c[1] for c in chunks])
-            frag.import_bits(rs, cs)
+            frag.import_positions(
+                chunks[0] if len(chunks) == 1 else np.concatenate(chunks))

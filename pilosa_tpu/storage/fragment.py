@@ -409,6 +409,10 @@ class Fragment:
             raise ValueError("column out of bounds")
         positions = rows * np.uint64(SLICE_WIDTH) + (
             cols % np.uint64(SLICE_WIDTH))
+        return self._mutate_batch_positions(positions, set)
+
+    def _mutate_batch_positions(self, positions: np.ndarray,
+                                set: bool) -> np.ndarray:
         row_shift = np.uint64(SLICE_WIDTH.bit_length() - 1)
         with self._mu:
             changed = self.storage.apply_batch(positions, set=set,
@@ -618,6 +622,26 @@ class Fragment:
             raise ValueError("column out of bounds")
         positions = rows * np.uint64(SLICE_WIDTH) + (
             cols % np.uint64(SLICE_WIDTH))
+        self.import_positions(positions)
+
+    def import_positions(self, positions: np.ndarray) -> None:
+        """Bulk import of slice-local bit positions (row*SLICE_WIDTH +
+        col%SLICE_WIDTH) — the frame-level packed-sort import lane
+        feeds each fragment its span of ONE globally sorted position
+        vector, so no per-fragment re-sort happens here (add_many's
+        is-sorted check passes on that lane)."""
+        positions = np.asarray(positions, dtype=np.uint64)
+        if (len(positions) * 16 < len(self.storage.keys)
+                and self.storage.op_writer is not None):
+            # Small import into a large fragment: the WAL'd batch engine
+            # is strictly cheaper than the detach-then-full-snapshot
+            # import contract (a 3-bit /import into a 400 K-container
+            # fragment paid ~0.9 s of snapshot serialization), and
+            # strictly MORE durable — the bits are group-commit WAL'd
+            # before return instead of living only in memory until the
+            # snapshot lands.
+            self._mutate_batch_positions(positions, set=True)
+            return
         with self._mu:
             self._epoch += 1
             writer, self.storage.op_writer = self.storage.op_writer, None
@@ -625,12 +649,60 @@ class Fragment:
                 self.storage.add_many(positions)
             finally:
                 self.storage.op_writer = writer
-            for rid in np.unique(rows):
-                rid = int(rid)
-                cnt = self.row_count(rid)
-                if (rid in self._row_counts
-                        or len(self._row_counts) < _ROW_COUNT_CAP):
+            # Post-import row counts in ONE pass over the container
+            # table: positions are row*SLICE_WIDTH + col, so a
+            # container's row is its key >> log2(SLICE_WIDTH/65536) and
+            # a row's count is the sum of its containers' cardinalities
+            # (slice rows align exactly on container boundaries). The
+            # per-row count_range walk this replaces was the bulk-import
+            # long pole at 10^5 distinct rows (~230 us/row).
+            shift = np.uint64((SLICE_WIDTH // 65536).bit_length() - 1)
+            key_arr = self.storage._keys_np()
+            prow = positions // np.uint64(SLICE_WIDTH)
+            if len(prow) > 1 and bool(np.all(prow[:-1] <= prow[1:])):
+                # Packed-lane positions arrive sorted: linear dedupe
+                # instead of np.unique's re-sort.
+                m = np.empty(len(prow), dtype=bool)
+                m[0] = True
+                np.not_equal(prow[1:], prow[:-1], out=m[1:])
+                uniq_rows = prow[m]
+            else:
+                uniq_rows = np.unique(prow)
+            conts = self.storage.containers
+            if len(uniq_rows) * 32 < len(key_arr):
+                # Small import into a large fragment: sum only each
+                # touched row's <=16-container key span instead of
+                # walking the whole container table (review finding:
+                # the full pass made every tiny /import request pay
+                # O(all containers)).
+                lo = np.searchsorted(key_arr, uniq_rows << shift)
+                hi = np.searchsorted(key_arr,
+                                     (uniq_rows + np.uint64(1)) << shift)
+                cnts = np.fromiter(
+                    (sum(conts[i].n for i in range(l, h))
+                     for l, h in zip(lo.tolist(), hi.tolist())),
+                    np.int64, len(uniq_rows))
+            else:
+                cards = np.fromiter((c.n for c in conts), np.int64,
+                                    len(key_arr))
+                crows = key_arr >> shift
+                gb = np.flatnonzero(crows[1:] != crows[:-1]) + 1
+                gstarts = np.concatenate(([0], gb)) if len(crows) else gb
+                present_rows = crows[gstarts] if len(crows) else crows
+                row_sums = (np.add.reduceat(cards, gstarts)
+                            if len(crows) else cards)
+                pos = np.searchsorted(present_rows, uniq_rows)
+                cnts = row_sums[np.minimum(pos, max(len(row_sums) - 1,
+                                                    0))]
+                cnts[(pos >= len(present_rows))
+                     | (present_rows[np.minimum(
+                         pos, max(len(present_rows) - 1, 0))]
+                        != uniq_rows)] = 0
+            under_cap = len(self._row_counts) < _ROW_COUNT_CAP
+            for rid, cnt in zip(uniq_rows.tolist(), cnts.tolist()):
+                if rid in self._row_counts or under_cap:
                     self._row_counts[rid] = cnt
+                    under_cap = len(self._row_counts) < _ROW_COUNT_CAP
                 self.cache.bulk_add(rid, cnt)
             self.cache.recalculate()
             self.row_cache.clear()
