@@ -974,6 +974,36 @@ def config_http_pipelined_setbit() -> None:
             srv.close()
 
 
+def config_wire_import() -> None:
+    """Bulk import over the real wire: client-side protobuf encode +
+    concurrent per-slice POSTs + server-side decode and apply (the
+    round-5 packed-sort lanes). Complements host_import_apply, which
+    measures only the in-process apply."""
+    import tempfile
+
+    from pilosa_tpu.cluster.client import Client
+    from pilosa_tpu.server.server import Server
+
+    n = int(1_000_000 * SCALE)
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 300, n).astype(np.uint64)
+    cols = rng.integers(0, 1 << 22, n).astype(np.uint64)
+    with tempfile.TemporaryDirectory() as d:
+        srv = Server(d, host="127.0.0.1:0", anti_entropy_interval=0,
+                     polling_interval=0)
+        srv.open()
+        try:
+            client = Client(srv.host)
+            client.create_index("wi")
+            client.create_frame("wi", "f")
+            t0 = time.perf_counter()
+            client.import_arrays("wi", "f", rows, cols)
+            emit("wire_import", n / (time.perf_counter() - t0),
+                 "bits/sec", n=n)
+        finally:
+            srv.close()
+
+
 def main() -> None:
     for fn in (_measure_sync_floor,
                config1_fragment_intersect_count,
@@ -988,7 +1018,8 @@ def main() -> None:
                config_topn1000_1024slices,
                config_residency_repeat_latency,
                config_host_write_and_import,
-               config_http_pipelined_setbit):
+               config_http_pipelined_setbit,
+               config_wire_import):
         try:
             fn()
         except Exception as e:  # noqa: BLE001 - report and continue

@@ -21,7 +21,7 @@ import numpy as np
 from ..errors import PilosaError
 from ..proto import internal_pb2 as pb
 from ..storage import cache as cache_mod
-from ..utils.arrays import group_by_key
+from ..utils.arrays import group_by_key, sort_dedupe
 from ..storage.attrs import AttrStore
 from ..utils import logger as logger_mod
 from ..utils import timequantum as tq
@@ -259,15 +259,8 @@ class Frame:
             slices_a = cids_a // W
             if (int(rids_a.max()) < (1 << 24)
                     and int(slices_a.max()) < (1 << 20)):
-                packed = ((slices_a << np.uint64(44))
-                          | (rids_a * W + cids_a % W))
-                packed = np.sort(packed)
-                if len(packed) > 1:
-                    keep = np.empty(len(packed), dtype=bool)
-                    keep[0] = True
-                    np.not_equal(packed[1:], packed[:-1], out=keep[1:])
-                    if not keep.all():
-                        packed = packed[keep]
+                packed = sort_dedupe((slices_a << np.uint64(44))
+                                     | (rids_a * W + cids_a % W))
                 positions_all = packed & np.uint64((1 << 44) - 1)
                 sl = packed >> np.uint64(44)
                 b = np.flatnonzero(sl[1:] != sl[:-1]) + 1

@@ -43,6 +43,7 @@ from ..errors import PilosaError
 from ..parallel.residency import DeviceRowCache
 from ..proto import internal_pb2 as pb
 from ..utils import logger as logger_mod
+from ..utils.arrays import sort_dedupe
 from ..utils.streams import CappedReader
 from . import cache as cache_mod
 from . import roaring
@@ -658,16 +659,9 @@ class Fragment:
             # long pole at 10^5 distinct rows (~230 us/row).
             shift = np.uint64((SLICE_WIDTH // 65536).bit_length() - 1)
             key_arr = self.storage._keys_np()
-            prow = positions // np.uint64(SLICE_WIDTH)
-            if len(prow) > 1 and bool(np.all(prow[:-1] <= prow[1:])):
-                # Packed-lane positions arrive sorted: linear dedupe
-                # instead of np.unique's re-sort.
-                m = np.empty(len(prow), dtype=bool)
-                m[0] = True
-                np.not_equal(prow[1:], prow[:-1], out=m[1:])
-                uniq_rows = prow[m]
-            else:
-                uniq_rows = np.unique(prow)
+            # Packed-lane positions arrive sorted: sort_dedupe's linear
+            # pass replaces np.unique's re-sort.
+            uniq_rows = sort_dedupe(positions // np.uint64(SLICE_WIDTH))
             conts = self.storage.containers
             if len(uniq_rows) * 32 < len(key_arr):
                 # Small import into a large fragment: sum only each

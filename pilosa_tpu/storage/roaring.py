@@ -33,6 +33,7 @@ from typing import Callable, Iterator as TIterator, Optional
 import numpy as np
 
 from . import native
+from ..utils.arrays import sort_dedupe
 
 # --- constants (match reference wire format) ---------------------------------
 
@@ -550,17 +551,7 @@ class Bitmap:
         values = np.asarray(values, dtype=np.uint64)
         if not len(values):
             return 0
-        # from_sorted callers (row unpacks, golden loads, offset_range
-        # repacks) feed pre-sorted positions; skip the O(n log n)
-        # re-sort for that case and dedupe with one linear pass.
-        if len(values) > 1 and not bool(np.all(values[:-1] <= values[1:])):
-            values = np.sort(values)
-        if len(values) > 1:
-            keep = np.empty(len(values), dtype=bool)
-            keep[0] = True
-            np.not_equal(values[1:], values[:-1], out=keep[1:])
-            if not keep.all():
-                values = values[keep]
+        values = sort_dedupe(values)
         self._table = None
         highs = values >> np.uint64(16)
         lows = (values & np.uint64(0xFFFF)).astype(np.uint32)
@@ -610,11 +601,14 @@ class Bitmap:
                 c = conts[gi]
                 before = c.n
                 if c.n == 0:
-                    # Zero-copy: the chunk is a slice of the sorted+deduped
-                    # ``lows`` vector; array buffers are replaced on
-                    # mutation, never edited in place, so sharing the
-                    # base is safe.
-                    c.array, c.bitmap, c.n = chunk, None, len(chunk)
+                    # Copy the chunk out of the batch vector: a view
+                    # would pin the WHOLE batch buffer for the
+                    # container's lifetime (review finding — a few tiny
+                    # surviving containers must not hold a 10 M-value
+                    # batch's 80 MB alive). The global-merge path keeps
+                    # views because there the base is collectively
+                    # covered by its containers.
+                    c.array, c.bitmap, c.n = chunk.copy(), None, len(chunk)
                     c.mapped = False
                 else:
                     merged = np.union1d(c.array, chunk).astype(np.uint32)
@@ -779,15 +773,7 @@ class Bitmap:
         import / merge-apply contract, fragment.go:924-989) skips
         record construction entirely; callers snapshot afterwards.
         """
-        values = np.asarray(values, dtype=np.uint64)
-        if len(values) > 1:
-            if not bool(np.all(values[:-1] <= values[1:])):
-                values = np.sort(values)
-            keep = np.empty(len(values), dtype=bool)
-            keep[0] = True
-            np.not_equal(values[1:], values[:-1], out=keep[1:])
-            if not keep.all():
-                values = values[keep]
+        values = sort_dedupe(np.asarray(values, dtype=np.uint64))
         if not len(values):
             return _EMPTY_U64
 

@@ -5,6 +5,22 @@ from __future__ import annotations
 import numpy as np
 
 
+def sort_dedupe(values: np.ndarray) -> np.ndarray:
+    """Sorted-unique form of ``values``: skips the O(n log n) sort when
+    the input is already ordered (bulk lanes feed pre-sorted vectors)
+    and dedupes with one linear mask pass — the shared idiom of the
+    import/batch-write hot paths."""
+    if len(values) > 1 and not bool(np.all(values[:-1] <= values[1:])):
+        values = np.sort(values)
+    if len(values) > 1:
+        keep = np.empty(len(values), dtype=bool)
+        keep[0] = True
+        np.not_equal(values[1:], values[:-1], out=keep[1:])
+        if not keep.all():
+            values = values[keep]
+    return values
+
+
 def group_by_key(keys: np.ndarray, *arrays: np.ndarray):
     """Yield ``(key, sub_array, ...)`` groups of ``arrays`` split by
     equal values of ``keys``, via one stable argsort — the vector form
