@@ -268,7 +268,10 @@ def main() -> None:
                 record = max(pinned, line["value"])
                 if (pinned > line["value"] and len(recent) >= 3
                         and all(r < 0.8 * pinned for r in recent[-3:])):
-                    record = max(recent)  # regression acknowledged
+                    # regression acknowledged: adopt the recent level
+                    # (NOT max over the full window, which could still
+                    # contain the stale pin-setting run)
+                    record = max(recent[-3:])
                 if record != line["value"]:
                     roof = roofline.compute(metric_ops_s=record)
                     roof["metric_of_record"]["latest_run_ops_per_s"] = \

@@ -668,39 +668,104 @@ class Bitmap:
         values = np.asarray(values, dtype=np.uint64)
         if not len(values):
             return 0
-        if len(values) > 1 and not bool(np.all(values[:-1] <= values[1:])):
-            values = np.sort(values)
+        values = sort_dedupe(values)
         self._table = None
         highs = values >> np.uint64(16)
         bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1
         starts = np.concatenate(([0], bounds))
         ends = np.concatenate((bounds, [len(values)]))
+        # Vectorized key probe, dropping groups with no (live) container
+        # — the same shape as add_many's (a sparse anti-entropy repair
+        # touches 10^5+ containers; per-group bisect was the long pole).
+        uniq = highs[starts]
+        key_arr = self._keys_np()
+        idx = np.searchsorted(key_arr, uniq)
+        present = idx < len(key_arr)
+        if present.any():
+            hit = np.flatnonzero(present)
+            present[hit] = key_arr[idx[hit]] == uniq[hit]
         removed = 0
-        for s, e in zip(starts, ends):
-            c = self.container(int(highs[s]))
-            if c is None or c.n == 0:
-                continue
-            chunk = (values[s:e] & np.uint64(0xFFFF)).astype(np.uint32)
+        live_gis = []
+        containers = self.containers
+        for gi in np.flatnonzero(present).tolist():
+            if containers[int(idx[gi])].n:
+                live_gis.append(gi)
+        bm_gis, arr_gis = [], []
+        for gi in live_gis:
+            (bm_gis if containers[int(idx[gi])].bitmap is not None
+             else arr_gis).append(gi)
+        for gi in bm_gis:
+            c = containers[int(idx[gi])]
+            chunk = (values[starts[gi]:ends[gi]]
+                     & np.uint64(0xFFFF)).astype(np.uint32)
             before = c.n
-            if c.is_array():
+            # AND-NOT scatter; duplicate words in chunk compose fine
+            # because each element clears only its own bit.
+            self._guard_inplace(c)
+            np.bitwise_and.at(
+                c.bitmap, chunk >> np.uint32(6),
+                ~(np.uint64(1) << (chunk.astype(np.uint64)
+                                   & np.uint64(63))))
+            c.n = int(np.bitwise_count(c.bitmap).sum())
+            c._maybe_convert()
+            removed += before - c.n
+        if len(arr_gis) > 256:
+            removed += self._remove_array_groups_global(
+                [containers[int(idx[g])] for g in arr_gis],
+                uniq[arr_gis], values, starts, ends, arr_gis)
+        else:
+            for gi in arr_gis:
+                c = containers[int(idx[gi])]
+                chunk = (values[starts[gi]:ends[gi]]
+                         & np.uint64(0xFFFF)).astype(np.uint32)
                 keep = ~np.isin(c.array, chunk, assume_unique=False)
                 if keep.all():
                     continue
                 c._unmap()
+                before = c.n
                 c.array = c.array[keep]
                 c.n = len(c.array)
-            else:
-                # AND-NOT scatter; duplicate words in chunk compose fine
-                # because each element clears only its own bit.
-                self._guard_inplace(c)
-                np.bitwise_and.at(
-                    c.bitmap, chunk >> np.uint32(6),
-                    ~(np.uint64(1) << (chunk.astype(np.uint64)
-                                       & np.uint64(63))))
-                c.n = int(np.bitwise_count(c.bitmap).sum())
-            c._maybe_convert()
-            removed += before - c.n
+                removed += before - c.n
         return removed
+
+    def _remove_array_groups_global(self, sel_conts, key_sel, values,
+                                    starts, ends, arr_gis) -> int:
+        """Global-pass removal from array containers: gather all target
+        containers' values into one u64 vector, drop members of the
+        incoming batch with ONE searchsorted membership test, and
+        re-slice the survivors back per container (spans recovered by
+        key-boundary searchsorted, so fully-emptied containers come out
+        naturally empty). The remove-side twin of
+        _merge_array_groups_global."""
+        lens = np.fromiter((c.n for c in sel_conts), np.int64,
+                           len(sel_conts))
+        old_low = np.concatenate([c.array for c in sel_conts if c.n])
+        old_vals = ((np.repeat(key_sel, lens) << np.uint64(16))
+                    | old_low.astype(np.uint64))
+        take = np.zeros(len(values), dtype=bool)
+        for gi in arr_gis:
+            take[starts[gi]:ends[gi]] = True
+        new_vals = values[take]
+        pos = np.searchsorted(new_vals, old_vals)
+        hit = pos < len(new_vals)
+        if hit.any():
+            h = np.flatnonzero(hit)
+            hit[h] = new_vals[pos[h]] == old_vals[h]
+        merged = old_vals[~hit]
+        ml = (merged & np.uint64(0xFFFF)).astype(np.uint32)
+        # Survivor spans derived from the gather layout itself (count
+        # of hits per original container span), NOT from key
+        # arithmetic: (key+1)<<16 would wrap u64 for the max container
+        # key 2^48-1 (review finding). Every selected container has
+        # n>0 (live_gis filter), so reduceat's index vector is strictly
+        # increasing.
+        gstarts = np.concatenate(([0], np.cumsum(lens)[:-1]))
+        surv = lens - np.add.reduceat(hit.astype(np.int64), gstarts)
+        e2 = np.cumsum(surv)
+        s2 = e2 - surv
+        for c, s, e in zip(sel_conts, s2.tolist(), e2.tolist()):
+            c.array, c.bitmap, c.n, c.mapped = ml[s:e], None, e - s, False
+        return len(old_vals) - len(merged)
 
     @staticmethod
     def from_sorted(values: np.ndarray) -> "Bitmap":

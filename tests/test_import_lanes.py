@@ -81,6 +81,62 @@ class TestAddManyGlobalMerge:
         assert bm.count() == 60_500
 
 
+class TestRemoveManyGlobal:
+    def test_matches_per_op_mixed_kinds(self):
+        rng = np.random.default_rng(11)
+        base = np.unique(rng.integers(0, 1 << 26, 100_000)
+                         .astype(np.uint64))
+        dense = np.arange(1 << 26, (1 << 26) + 70_000, dtype=np.uint64)
+        allv = np.concatenate([base, dense])
+        to_remove = np.concatenate(
+            [base[::3], dense[::2],
+             rng.integers(0, 1 << 27, 3000).astype(np.uint64)])
+        ref = roaring.Bitmap()
+        ref.add_many(allv)
+        got = roaring.Bitmap()
+        got.add_many(allv)
+        n_ref = sum(ref._remove(int(v))
+                    for v in np.unique(to_remove).tolist())
+        n_got = got.remove_many(to_remove)
+        assert n_got == n_ref
+        assert got.marshal() == ref.marshal()
+
+    def test_max_key_container_no_overflow(self):
+        # Regression: span ends derived via (key+1)<<16 wrapped u64 at
+        # container key 2^48-1, corrupting the top container's count.
+        top = np.uint64(0xFFFFFFFFFFFF0000)
+        vals = np.concatenate(
+            [np.arange(10, dtype=np.uint64) + top,
+             *[np.arange(3, dtype=np.uint64) + np.uint64(k << 16)
+               for k in range(300)]])
+        bm = roaring.Bitmap()
+        bm.add_many(vals)
+        ref = roaring.Bitmap()
+        ref.add_many(vals)
+        to_rm = np.concatenate(
+            [np.arange(5, dtype=np.uint64) + top,
+             *[np.arange(1, dtype=np.uint64) + np.uint64(k << 16)
+               for k in range(300)]])
+        n = bm.remove_many(to_rm)
+        assert n == sum(ref._remove(int(v)) for v in to_rm.tolist())
+        assert bm.marshal() == ref.marshal()
+        assert bm.container(0xFFFFFFFFFFFF).n == 5
+
+    def test_emptied_containers_come_out_empty(self):
+        # >256 array groups forces the global path; removing every
+        # value must leave each container empty (n=0) but present.
+        vals = np.concatenate(
+            [np.arange(3, dtype=np.uint64) + np.uint64(k << 16)
+             for k in range(300)])
+        bm = roaring.Bitmap()
+        bm.add_many(vals)
+        assert bm.remove_many(vals) == len(vals)
+        assert bm.count() == 0
+        assert bm.container(5) is not None and bm.container(5).n == 0
+        # still serializes and round-trips (empty containers skipped)
+        assert roaring.Bitmap.unmarshal(bm.marshal()).count() == 0
+
+
 class TestSnapshotCoalescing:
     def test_mixed_bases_round_trip(self):
         # Containers from one bulk import (shared base), then some
