@@ -637,11 +637,18 @@ class Handler:
     def _pod_import(self, ireq, idx, frame, timestamps) -> None:
         """Split an import within the pod (parallel.pod placement):
         standard + time views live on the owner of the column slice;
-        inverse views group by row slice, one leg per owning process."""
+        inverse views group by row slice, one leg per owning process.
+        Owner resolution runs per UNIQUE row slice (a few jump-hash
+        calls) and the bits group by owner in one vectorized pass —
+        this was the last per-bit Python loop on an import path."""
         from .. import SLICE_WIDTH
+        from ..utils.arrays import group_by_key
         pod = self.pod
-        rows, cols = list(ireq.RowIDs), list(ireq.ColumnIDs)
-        ts_ns = list(ireq.Timestamps) if ireq.Timestamps else [0] * len(rows)
+        n = len(ireq.RowIDs)
+        rows = np.fromiter(ireq.RowIDs, np.uint64, n)
+        cols = np.fromiter(ireq.ColumnIDs, np.uint64, n)
+        ts_ns = (np.fromiter(ireq.Timestamps, np.int64, n)
+                 if ireq.Timestamps else np.zeros(n, dtype=np.int64))
 
         owner = pod.owner_pid(ireq.Slice)
         if owner == pod.pid:
@@ -652,35 +659,34 @@ class Handler:
                                      "standard")
             idx.set_remote_max_slice(ireq.Slice)
 
-        if not frame.inverse_enabled:
+        if not frame.inverse_enabled or not n:
             return
-        groups: dict[int, tuple[list, list, list]] = {}
-        for i, (r, c) in enumerate(zip(rows, cols)):
-            pid = pod.owner_pid(r // SLICE_WIDTH)
-            g = groups.setdefault(pid, ([], [], []))
-            g[0].append(r)
-            g[1].append(c)
-            g[2].append(i)
-        for pid, (rs, cs, idxs) in sorted(groups.items()):
+        rslice = rows // np.uint64(SLICE_WIDTH)
+        uniq_slices = np.unique(rslice)
+        pid_arr = np.fromiter(
+            (pod.owner_pid(int(s)) for s in uniq_slices.tolist()),
+            np.int64, len(uniq_slices))
+        pids = pid_arr[np.searchsorted(uniq_slices, rslice)]
+        for pid, rs, cs, ii, sl in group_by_key(
+                pids, rows, cols, np.arange(n), rslice):
             if pid == pod.pid:
-                sub_ts = ([timestamps[i] for i in idxs]
+                sub_ts = ([timestamps[i] for i in ii.tolist()]
                           if timestamps else None)
                 frame.import_bits(rs, cs, sub_ts, views="inverse")
             else:
                 self._pod_forward_import(
                     pid, ireq.Index, frame.name, ireq.Slice, rs, cs,
-                    [ts_ns[i] for i in idxs], "inverse")
-                idx.set_remote_max_inverse_slice(
-                    max(r // SLICE_WIDTH for r in rs))
+                    ts_ns[ii], "inverse")
+                idx.set_remote_max_inverse_slice(int(sl.max()))
 
     def _pod_forward_import(self, pid: int, index: str, frame: str,
                             slice: int, rows, cols, ts_ns,
                             view: str) -> None:
         body = pb.ImportRequest(
             Index=index, Frame=frame, Slice=slice,
-            RowIDs=[int(r) for r in rows],
-            ColumnIDs=[int(c) for c in cols],
-            Timestamps=[int(t) for t in ts_ns]).SerializeToString()
+            RowIDs=np.asarray(rows).tolist(),
+            ColumnIDs=np.asarray(cols).tolist(),
+            Timestamps=np.asarray(ts_ns).tolist()).SerializeToString()
         self.pod.forward_raw(pid, "POST", f"/import?podView={view}",
                              body, _PROTOBUF)
 

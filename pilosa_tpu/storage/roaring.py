@@ -33,7 +33,7 @@ from typing import Callable, Iterator as TIterator, Optional
 import numpy as np
 
 from . import native
-from ..utils.arrays import sort_dedupe
+from ..utils.arrays import searchsorted_membership, sort_dedupe
 
 # --- constants (match reference wire format) ---------------------------------
 
@@ -563,11 +563,7 @@ class Bitmap:
         # list.insert was quadratic in the table size).
         uniq = highs[starts]
         key_arr = self._keys_np()
-        idx = np.searchsorted(key_arr, uniq)
-        exists = idx < len(key_arr)
-        if exists.any():
-            hit = np.flatnonzero(exists)
-            exists[hit] = key_arr[idx[hit]] == uniq[hit]
+        exists, idx = searchsorted_membership(key_arr, uniq)
         if not exists.all():
             self._insert_containers(uniq[~exists].tolist())
             idx = np.searchsorted(self._keys_np(), uniq)
@@ -679,21 +675,17 @@ class Bitmap:
         # touches 10^5+ containers; per-group bisect was the long pole).
         uniq = highs[starts]
         key_arr = self._keys_np()
-        idx = np.searchsorted(key_arr, uniq)
-        present = idx < len(key_arr)
-        if present.any():
-            hit = np.flatnonzero(present)
-            present[hit] = key_arr[idx[hit]] == uniq[hit]
+        present, idx = searchsorted_membership(key_arr, uniq)
         removed = 0
-        live_gis = []
         containers = self.containers
-        for gi in np.flatnonzero(present).tolist():
-            if containers[int(idx[gi])].n:
-                live_gis.append(gi)
-        bm_gis, arr_gis = [], []
-        for gi in live_gis:
-            (bm_gis if containers[int(idx[gi])].bitmap is not None
-             else arr_gis).append(gi)
+        pres = np.flatnonzero(present)
+        pres_conts = [containers[int(i)] for i in idx[pres]]
+        n_p = len(pres_conts)
+        live = np.fromiter((c.n > 0 for c in pres_conts), bool, n_p)
+        is_bm = np.fromiter((c.bitmap is not None for c in pres_conts),
+                            bool, n_p)
+        bm_gis = pres[live & is_bm].tolist()
+        arr_gis = pres[live & ~is_bm].tolist()
         for gi in bm_gis:
             c = containers[int(idx[gi])]
             chunk = (values[starts[gi]:ends[gi]]
@@ -742,15 +734,11 @@ class Bitmap:
         old_low = np.concatenate([c.array for c in sel_conts if c.n])
         old_vals = ((np.repeat(key_sel, lens) << np.uint64(16))
                     | old_low.astype(np.uint64))
-        take = np.zeros(len(values), dtype=bool)
-        for gi in arr_gis:
-            take[starts[gi]:ends[gi]] = True
-        new_vals = values[take]
-        pos = np.searchsorted(new_vals, old_vals)
-        hit = pos < len(new_vals)
-        if hit.any():
-            h = np.flatnonzero(hit)
-            hit[h] = new_vals[pos[h]] == old_vals[h]
+        g_arr = np.zeros(len(ends), dtype=bool)
+        g_arr[arr_gis] = True
+        new_vals = values[np.repeat(g_arr,
+                                    (ends - starts).astype(np.int64))]
+        hit, _ = searchsorted_membership(new_vals, old_vals)
         merged = old_vals[~hit]
         ml = (merged & np.uint64(0xFFFF)).astype(np.uint32)
         # Survivor spans derived from the gather layout itself (count

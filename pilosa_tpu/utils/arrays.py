@@ -21,6 +21,21 @@ def sort_dedupe(values: np.ndarray) -> np.ndarray:
     return values
 
 
+def searchsorted_membership(haystack: np.ndarray,
+                            needles: np.ndarray):
+    """``(mask, idx)``: which ``needles`` occur in the SORTED
+    ``haystack``, plus their searchsorted insertion points. The
+    out-of-bounds guard runs before the equality fixup — the subtle
+    part of the idiom, kept in one place (it was hand-rolled at three
+    bulk-lane call sites)."""
+    idx = np.searchsorted(haystack, needles)
+    mask = idx < len(haystack)
+    if mask.any():
+        h = np.flatnonzero(mask)
+        mask[h] = haystack[idx[h]] == needles[h]
+    return mask, idx
+
+
 def group_by_key(keys: np.ndarray, *arrays: np.ndarray):
     """Yield ``(key, sub_array, ...)`` groups of ``arrays`` split by
     equal values of ``keys``, via one stable argsort — the vector form

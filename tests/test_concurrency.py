@@ -117,3 +117,80 @@ def test_writers_vs_device_readers(holder):
                               " Bitmap(frame=f, rowID=2))")[0]
               .bits().tolist())  # repeat: a cache hit, same answer
     assert got == (t1 | t2)
+
+
+def test_imports_vs_readers_and_writers(holder):
+    """Round-5 bulk-import lanes racing point writers and readers on
+    the SAME fragments: the packed-sort frame lane, the global array
+    merge (container-table rebuilds under the fragment lock), the
+    WAL'd small-import lane, and snapshot coalescing — none may tear a
+    reader or lose a write. Final state is re-checked against a
+    single-threaded model."""
+    import queue
+
+    frame = holder.create_index_if_not_exists("imp") \
+        .create_frame_if_not_exists("f")
+    ex = Executor(holder, host="local", use_mesh=False)
+    n_rounds = 6
+    errs = []
+    applied = queue.Queue()  # (kind, payload) log for the model
+    barrier = threading.Barrier(3)
+
+    def importer():
+        rng = np.random.default_rng(100)
+        try:
+            barrier.wait()
+            for k in range(n_rounds):
+                n = 4000 if k % 2 == 0 else 3  # bulk + small lanes
+                rows = rng.integers(0, 50, n).astype(np.uint64)
+                cols = rng.integers(0, 2 * SLICE_WIDTH, n) \
+                    .astype(np.uint64)
+                frame.import_bits(rows, cols)
+                applied.put(("import", (rows, cols)))
+        except Exception as e:  # noqa: BLE001
+            errs.append(("importer", repr(e)))
+
+    def writer():
+        rng = np.random.default_rng(200)
+        try:
+            barrier.wait()
+            for _ in range(120):
+                row = int(rng.integers(0, 50))
+                col = int(rng.integers(0, 2 * SLICE_WIDTH))
+                ex.execute("imp", f"SetBit(frame=f, rowID={row},"
+                                  f" columnID={col})")
+                applied.put(("set", (row, col)))
+        except Exception as e:  # noqa: BLE001
+            errs.append(("writer", repr(e)))
+
+    def reader():
+        try:
+            barrier.wait()
+            for _ in range(120):
+                ex.execute("imp", "Count(Bitmap(frame=f, rowID=7))")
+                ex.execute("imp", "TopN(frame=f, n=3)")
+        except Exception as e:  # noqa: BLE001
+            errs.append(("reader", repr(e)))
+
+    threads = [threading.Thread(target=f)
+               for f in (importer, writer, reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+
+    # Model: the union of every applied mutation, single-threaded.
+    want: set[tuple[int, int]] = set()
+    while not applied.empty():
+        kind, payload = applied.get()
+        if kind == "import":
+            rows, cols = payload
+            want.update(zip(rows.tolist(), cols.tolist()))
+        else:
+            want.add(payload)
+    for rid in range(50):
+        want_n = len({c for (r, c) in want if r == rid})
+        got = ex.execute("imp",
+                         f"Count(Bitmap(frame=f, rowID={rid}))")[0]
+        assert got == want_n, (rid, got, want_n)
