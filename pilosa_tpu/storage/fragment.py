@@ -43,6 +43,7 @@ from ..errors import PilosaError
 from ..parallel.residency import DeviceRowCache
 from ..proto import internal_pb2 as pb
 from ..utils import logger as logger_mod
+from ..utils import arrays as arrays_mod
 from ..utils.arrays import sort_dedupe
 from ..utils.streams import CappedReader
 from . import cache as cache_mod
@@ -789,17 +790,51 @@ class Fragment:
         rows) costs seconds through per-row Python calls and ~10 ms
         here (reference does per-row counts, fragment.go:529-560, but
         its per-call cost is nanoseconds; ours is not)."""
-        seg = src._segment(self.slice, False)
-        if seg is None:
+        src_cols, key = self._src_cols_key(src)
+        if src_cols is None or not len(src_cols):
             return self._EMPTY_COUNTS
-        w = np.uint64(SLICE_WIDTH)
-        src_cols = seg.data.values() % w   # absolute → slice-local
-        if not len(src_cols):
-            return self._EMPTY_COUNTS
-        key = hashlib.sha1(src_cols.tobytes()).digest()
         hit = self._src_counts.get(key)
         if hit is not None and hit[0] == self._epoch:
             return hit[1]
+        return self._compute_src_count_map(src_cols,
+                                           np.uint64(SLICE_WIDTH), key)
+
+    def _src_cols_key(self, src: Bitmap):
+        """(slice-local src columns, sha1 key) for the src-count cache,
+        memoized on the segment's roaring data: row() hands out the
+        SAME cached Bitmap object across repeat queries (row_cache),
+        and result bitmaps are COW — so the values walk + sha1 runs
+        once per materialized object instead of twice per slice per
+        query (both TopN phases key the same map)."""
+        seg = src._segment(self.slice, False)
+        if seg is None:
+            return None, None
+        data = seg.data
+        memo = getattr(data, "_src_cols_key_memo", None)
+        if memo is not None and memo[0] == data.version:
+            return memo[1], memo[2]
+        src_cols = data.values() % np.uint64(SLICE_WIDTH)
+        key = (hashlib.sha1(src_cols.tobytes()).digest()
+               if len(src_cols) else None)
+        data._src_cols_key_memo = (data.version, src_cols, key)
+        return src_cols, key
+
+    def _host_src_count_map_cached(self, src: Bitmap):
+        """The cached (ids, counts) map for this src if one is already
+        current — NO compute. TopN's exact phase (few re-queried
+        candidates per slice) probes this: the candidate phase of the
+        same query built the map moments earlier, so the per-candidate
+        roaring intersections it would otherwise do are free gathers."""
+        src_cols, key = self._src_cols_key(src)
+        if src_cols is None or not len(src_cols):
+            return self._EMPTY_COUNTS
+        hit = self._src_counts.get(key)
+        if hit is not None and hit[0] == self._epoch:
+            return hit[1]
+        return None
+
+    def _compute_src_count_map(self, src_cols, w, key
+                               ) -> tuple[np.ndarray, np.ndarray]:
         total_bits = self._cached_total_bits()
         if total_bits <= _SRC_VECTOR_BITS:
             # One fully vectorized pass: the per-container chunked walk
@@ -1051,12 +1086,37 @@ class Fragment:
             # EXECUTOR's device path (_topn_exact_resident), where the
             # cost model routes them.
             count_ids = count_vals = None
-            if opt.src is not None and len(cand_ids) > self.SRC_MAP_MIN:
-                count_ids, count_vals = self._host_src_count_map(opt.src)
+            if opt.src is not None:
+                if len(cand_ids) > self.SRC_MAP_MIN:
+                    count_ids, count_vals = \
+                        self._host_src_count_map(opt.src)
+                else:
+                    # Small candidate set (the exact phase's ids= form,
+                    # point lookups): never WORTH computing the map,
+                    # but if one is already cached — the candidate
+                    # phase of this very query built it — gathers beat
+                    # per-candidate roaring intersections.
+                    cached = self._host_src_count_map_cached(opt.src)
+                    if cached is not None:
+                        count_ids, count_vals = cached
+            if count_ids is not None:
+                scnt = None
                 if len(cand_ids):
-                    keep = np.isin(cand_ids, count_ids)
+                    # count_ids is sorted: membership via searchsorted
+                    # beats np.isin's hash/sort machinery at rank-cache
+                    # scale (up to 50 K candidates x 256 slices/query);
+                    # the same probe's indices serve as the src-count
+                    # gather, so the vectorized replay never re-probes.
+                    keep, at = arrays_mod.searchsorted_membership(
+                        count_ids, cand_ids)
                     cand_ids = cand_ids[keep]
                     cand_counts = cand_counts[keep]
+                    scnt = count_vals[at[keep]]
+                if (filters is None and tanimoto == 0 and n > 0
+                        and len(cand_ids)):
+                    return self._top_src_vectorized(
+                        cand_ids, cand_counts, scnt, n,
+                        opt.min_threshold)
 
             def src_count_of(rid: int) -> int:
                 if count_ids is None:
@@ -1117,6 +1177,47 @@ class Fragment:
                 out.append(Pair(-neg_id, cnt))
             out.reverse()
             return out
+
+    @staticmethod
+    def _top_src_vectorized(cand_ids, cand_counts, scnt, n: int,
+                            min_threshold: int) -> list[Pair]:
+        """Vectorized replay of the heap walk for the plain-src shape
+        (gathered src counts in hand, no tanimoto, no attr filter,
+        n>0). Exactly reproduces the loop's visit-order semantics,
+        including the SUPERSET it returns for the cross-slice fill:
+        phase A pushes the first n valid candidates (cache count and
+        src count both >= max(min_threshold, 1)); t = their min src
+        count; the walk then breaks at the first later candidate whose
+        CACHE count drops below t, and pushes every candidate before
+        that whose src count >= t. Output sorted (count desc, id asc),
+        like the heap drain. Equivalence is pinned against a verbatim
+        port of the loop by randomized parity in
+        tests/test_fragment.py::TestTopSrcVectorizedParity."""
+        floor = max(min_threshold, 1)
+        scnt = np.asarray(scnt, dtype=np.int64)
+        cache_ok = cand_counts >= floor
+        valid = cache_ok & (scnt >= floor)
+        valid_idx = np.flatnonzero(valid)
+        if len(valid_idx) <= n:
+            take = valid_idx
+        else:
+            first_n = valid_idx[:n]
+            t = int(scnt[first_n].min())
+            # Break at the first cache-valid candidate AFTER phase A
+            # whose cache count < t (invalid-by-cache candidates are
+            # skipped by `continue`, not `break`).
+            later = np.flatnonzero(cache_ok)
+            later = later[later > first_n[-1]]
+            brk = later[cand_counts[later] < t]
+            stop = int(brk[0]) if len(brk) else len(cand_ids)
+            phase_b = later[(later < stop) & (scnt[later] >= t)]
+            take = np.concatenate((first_n, phase_b))
+        ids = cand_ids[take]
+        cnts = scnt[take]
+        order = np.lexsort((ids, -cnts))
+        return [Pair(int(i), int(c))
+                for i, c in zip(ids[order].tolist(),
+                                cnts[order].tolist())]
 
     def recalculate_cache(self) -> None:
         """Rebuild the rank cache regardless of the invalidate rate limit

@@ -445,6 +445,11 @@ class Bitmap:
         self.op_writer = None
         self.op_n = 0      # ops appended/replayed since last snapshot
         self.torn_bytes = 0  # dangling tail bytes found during unmarshal
+        # Monotonic mutation counter: bumped by every mutating entry
+        # point so derived-value memos (e.g. the fragment src-key
+        # cache) can validate against in-place mutation instead of
+        # trusting object identity.
+        self.version = 0
         # Frozen-capture COW epoch (see Container.cow) and the
         # incrementally-maintained serialization table (see _SerTable).
         # Point mutations record their container key in _table_dirty
@@ -500,6 +505,7 @@ class Bitmap:
         return changed
 
     def _add(self, v: int) -> bool:
+        self.version += 1
         key = highbits(v)
         if self._table is not None:
             n0 = len(self.keys)
@@ -521,6 +527,7 @@ class Bitmap:
         return changed
 
     def _remove(self, v: int) -> bool:
+        self.version += 1
         key = highbits(v)
         c = self.container(key)
         if c is None:
@@ -552,6 +559,7 @@ class Bitmap:
         if not len(values):
             return 0
         values = sort_dedupe(values)
+        self.version += 1
         self._table = None
         highs = values >> np.uint64(16)
         lows = (values & np.uint64(0xFFFF)).astype(np.uint32)
@@ -665,6 +673,7 @@ class Bitmap:
         if not len(values):
             return 0
         values = sort_dedupe(values)
+        self.version += 1
         self._table = None
         highs = values >> np.uint64(16)
         bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1
@@ -829,6 +838,7 @@ class Bitmap:
         values = sort_dedupe(np.asarray(values, dtype=np.uint64))
         if not len(values):
             return _EMPTY_U64
+        self.version += 1
 
         highs = values >> np.uint64(16)
         bounds = np.flatnonzero(highs[1:] != highs[:-1]) + 1

@@ -215,6 +215,22 @@ class TestTopN:
         res = executor.execute(
             "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=2)")
         assert res[0] == [Pair(0, 4), Pair(5, 2)]
+        # Staleness regression (round 5: src-cols memo + count-map
+        # cache): mutating a CANDIDATE row must refresh its count on
+        # the next query...
+        f.set_bit("standard", 5, 3)
+        f.view("standard").fragment(0).recalculate_cache()
+        res = executor.execute(
+            "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=2)")
+        assert res[0] == [Pair(0, 4), Pair(5, 3)]
+        # ...and mutating the SRC row must invalidate the memoized
+        # src key (fresh row object) and the map.
+        f.set_bit("standard", 0, 9)
+        f.set_bit("standard", 7, 9)
+        f.view("standard").fragment(0).recalculate_cache()
+        res = executor.execute(
+            "i", "TopN(Bitmap(rowID=0, frame=f), frame=f, n=3)")
+        assert res[0] == [Pair(0, 5), Pair(5, 3), Pair(7, 2)]
 
     def test_top_n_fill(self, holder, executor):
         """executor_test.go:300-322: the global winner's count must

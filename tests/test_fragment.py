@@ -758,3 +758,63 @@ class TestAsyncSnapshot:
             assert f.row_count(5) == 30 and f.row_count(1) == 50
         finally:
             f.close()
+
+
+class TestTopSrcVectorizedParity:
+    """Randomized parity between Fragment._top_src_vectorized and a
+    verbatim port of the heap-walk it replaces (round-5 src-TopN fast
+    path): visit-order semantics, the phase-A threshold, the
+    break-on-cache-count, and the cross-slice fill SUPERSET must all
+    match bit for bit."""
+
+    @staticmethod
+    def _loop_reference(cand_ids, cand_counts, scnt_map, n,
+                        min_threshold):
+        import heapq
+        results, out = [], []
+        for i, (rid, cnt) in enumerate(zip(cand_ids.tolist(),
+                                           cand_counts.tolist())):
+            if cnt <= 0:
+                continue
+            if cnt < min_threshold:
+                continue
+            if len(results) < n:
+                count = int(scnt_map[i])
+                if count == 0:
+                    continue
+                if count < min_threshold:
+                    continue
+                heapq.heappush(results, (count, -rid))
+                continue
+            threshold = results[0][0]
+            if threshold < min_threshold or cnt < threshold:
+                break
+            count = int(scnt_map[i])
+            if count < threshold:
+                continue
+            heapq.heappush(results, (count, -rid))
+        while results:
+            cnt, neg_id = heapq.heappop(results)
+            out.append((-neg_id, cnt))
+        out.reverse()
+        return out
+
+    def test_randomized_parity(self):
+        rng = np.random.default_rng(321)
+        for trial in range(2000):
+            n_cand = int(rng.integers(1, 60))
+            cand_counts = np.sort(
+                rng.integers(0, 50, n_cand))[::-1].astype(np.int64)
+            cand_ids = rng.permutation(1000)[:n_cand].astype(np.int64)
+            # src counts <= cache counts (|row ∩ src| <= |row|),
+            # including zeros (candidates absent from the src)
+            scnt = np.array(
+                [rng.integers(0, c + 1) for c in cand_counts],
+                dtype=np.int64)
+            n = int(rng.integers(1, 12))
+            min_th = int(rng.integers(0, 6))
+            want = self._loop_reference(cand_ids, cand_counts, scnt,
+                                        n, min_th)
+            got = Fragment._top_src_vectorized(cand_ids, cand_counts,
+                                               scnt, n, min_th)
+            assert [(p.id, p.count) for p in got] == want, trial
