@@ -85,6 +85,10 @@ class Client:
         self.timeout = timeout
         self._pool: dict[str, list[http.client.HTTPConnection]] = {}
         self._pool_mu = threading.Lock()
+        # Hosts that 415'd the raw-array import format (reference-
+        # shaped servers): remembered so every later slice goes
+        # straight to protobuf.
+        self._no_raw_import: set[str] = set()
 
     # -- low-level -----------------------------------------------------------
 
@@ -267,23 +271,44 @@ class Client:
     def _import_slice(self, index: str, frame: str, slice: int,
                       rows: np.ndarray, cols: np.ndarray,
                       ts: np.ndarray) -> None:
-        # All-zero timestamps encode as an absent field: the server
-        # treats empty Timestamps as None (handler _handle_post_import),
-        # and skipping them saves a third of the wire bytes plus the
-        # per-bit timestamp listcomp on both ends.
-        req = pb.ImportRequest(
-            Index=index, Frame=frame, Slice=slice,
-            RowIDs=rows.tolist(), ColumnIDs=cols.tolist(),
-            Timestamps=ts.tolist() if ts.any() else [])
-        body = req.SerializeToString()
+        # Raw-array wire format first (proto/rawimport.py — protobuf's
+        # per-u64 varint decode was the measured wire bound), falling
+        # back to protobuf per host on 415 so reference-shaped servers
+        # keep working. All-zero timestamps stay off the wire in both
+        # forms (the server treats absent as None).
+        from ..proto import rawimport
+        raw_body = pb_body = None
         nodes = self.fragment_nodes(index, slice)
         if not nodes:
             raise ClientError(f"no owner for slice {slice}")
         for node in nodes:
+            host = node["host"]
+            if host not in self._no_raw_import:
+                if raw_body is None:
+                    raw_body = rawimport.encode(
+                        index, frame, slice, rows, cols,
+                        ts if ts.any() else None)
+                status, raw = self._do(
+                    "POST", "/import", raw_body,
+                    {"Content-Type": rawimport.CONTENT_TYPE,
+                     "Accept": _PROTOBUF}, host=host)
+                if status != 415:
+                    self._ok(status, raw, f"import slice {slice}")
+                    resp = pb.ImportResponse.FromString(raw)
+                    if resp.Err:
+                        raise ClientError(resp.Err)
+                    continue
+                self._no_raw_import.add(host)
+            if pb_body is None:
+                pb_body = pb.ImportRequest(
+                    Index=index, Frame=frame, Slice=slice,
+                    RowIDs=rows.tolist(), ColumnIDs=cols.tolist(),
+                    Timestamps=ts.tolist() if ts.any() else []
+                ).SerializeToString()
             status, raw = self._do(
-                "POST", "/import", body,
+                "POST", "/import", pb_body,
                 {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF},
-                host=node["host"])
+                host=host)
             self._ok(status, raw, f"import slice {slice}")
             resp = pb.ImportResponse.FromString(raw)
             if resp.Err:

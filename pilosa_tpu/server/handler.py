@@ -601,40 +601,74 @@ class Handler:
     # -- import / export -----------------------------------------------------
 
     def _handle_post_import(self, req: Request) -> Response:
-        # Protobuf-only endpoint (handler.go:896-906).
-        if req.content_type != _PROTOBUF:
+        # Protobuf endpoint at reference parity (handler.go:896-906),
+        # plus the raw-array sidecar format our own client negotiates
+        # (proto/rawimport.py): protobuf varint-decodes every u64,
+        # which was the measured wire-import bound; raw decodes as
+        # np.frombuffer views.
+        from ..proto import rawimport
+        if req.content_type not in (_PROTOBUF, rawimport.CONTENT_TYPE):
             raise HTTPError(415, "Unsupported media type")
-        if req.accept != _PROTOBUF:
+        # Strict 406 BEFORE body parsing, at reference parity for
+        # protobuf callers; the raw sidecar also tolerates its own
+        # type as Accept (pod-internal requests mirror Content-Type
+        # into Accept) — the response is protobuf either way.
+        if req.accept != _PROTOBUF and not (
+                req.content_type == rawimport.CONTENT_TYPE
+                and req.accept == rawimport.CONTENT_TYPE):
             raise HTTPError(406, "Not acceptable")
-        ireq = pb.ImportRequest.FromString(req.body())
+        if req.content_type == rawimport.CONTENT_TYPE:
+            try:
+                (index_name, frame_name, slice, rows, cols,
+                 ts_ns) = rawimport.decode(req.body())
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+        elif req.content_type == _PROTOBUF:
+            ireq = pb.ImportRequest.FromString(req.body())
+            index_name, frame_name, slice = \
+                ireq.Index, ireq.Frame, ireq.Slice
+            n = len(ireq.RowIDs)
+            rows = np.fromiter(ireq.RowIDs, np.uint64, n)
+            cols = np.fromiter(ireq.ColumnIDs, np.uint64,
+                               len(ireq.ColumnIDs))
+            ts_ns = (np.fromiter(ireq.Timestamps, np.int64,
+                                 len(ireq.Timestamps))
+                     if ireq.Timestamps else None)
+        if len(rows) != len(cols) or (
+                ts_ns is not None and len(ts_ns) != len(rows)):
+            raise HTTPError(400, "import array length mismatch")
         if self.cluster is not None and not self.cluster.owns_fragment(
-                self.host, ireq.Index, ireq.Slice):
+                self.host, index_name, slice):
             raise HTTPError(412, f"host does not own slice"
-                                 f" {self.host}-{ireq.Index}"
-                                 f" slice:{ireq.Slice}")
-        idx = self.holder.index(ireq.Index)
+                                 f" {self.host}-{index_name}"
+                                 f" slice:{slice}")
+        idx = self.holder.index(index_name)
         if idx is None:
             raise HTTPError(404, "index not found")
-        frame = idx.frame(ireq.Frame)
+        frame = idx.frame(frame_name)
         if frame is None:
             raise HTTPError(404, "frame not found")
         import datetime as dt
-        timestamps = [
-            dt.datetime.fromtimestamp(ts / 1e9, dt.timezone.utc)
-            .replace(tzinfo=None) if ts else None
-            for ts in ireq.Timestamps] if ireq.Timestamps else None
+        if ts_ns is not None and ts_ns.any():
+            timestamps = [
+                dt.datetime.fromtimestamp(ts / 1e9, dt.timezone.utc)
+                .replace(tzinfo=None) if ts else None
+                for ts in ts_ns.tolist()]
+        else:
+            timestamps = None
         pod_view = req.query.get("podView")
         if pod_view is not None and pod_view not in ("standard", "inverse"):
             raise HTTPError(400, f"invalid podView: {pod_view}")
         if (self.pod is not None and self.pod.is_coordinator
                 and pod_view is None):
-            self._pod_import(ireq, idx, frame, timestamps)
+            self._pod_import(index_name, frame_name, slice, rows, cols,
+                             ts_ns, idx, frame, timestamps)
         else:
-            frame.import_bits(list(ireq.RowIDs), list(ireq.ColumnIDs),
-                              timestamps, views=pod_view)
+            frame.import_bits(rows, cols, timestamps, views=pod_view)
         return Response.proto(pb.ImportResponse())
 
-    def _pod_import(self, ireq, idx, frame, timestamps) -> None:
+    def _pod_import(self, index_name, frame_name, slice, rows, cols,
+                    ts_ns, idx, frame, timestamps) -> None:
         """Split an import within the pod (parallel.pod placement):
         standard + time views live on the owner of the column slice;
         inverse views group by row slice, one leg per owning process.
@@ -644,20 +678,18 @@ class Handler:
         from .. import SLICE_WIDTH
         from ..utils.arrays import group_by_key
         pod = self.pod
-        n = len(ireq.RowIDs)
-        rows = np.fromiter(ireq.RowIDs, np.uint64, n)
-        cols = np.fromiter(ireq.ColumnIDs, np.uint64, n)
-        ts_ns = (np.fromiter(ireq.Timestamps, np.int64, n)
-                 if ireq.Timestamps else np.zeros(n, dtype=np.int64))
+        n = len(rows)
+        if ts_ns is None:
+            ts_ns = np.zeros(n, dtype=np.int64)
 
-        owner = pod.owner_pid(ireq.Slice)
+        owner = pod.owner_pid(slice)
         if owner == pod.pid:
             frame.import_bits(rows, cols, timestamps, views="standard")
         else:
-            self._pod_forward_import(owner, ireq.Index, frame.name,
-                                     ireq.Slice, rows, cols, ts_ns,
+            self._pod_forward_import(owner, index_name, frame_name,
+                                     slice, rows, cols, ts_ns,
                                      "standard")
-            idx.set_remote_max_slice(ireq.Slice)
+            idx.set_remote_max_slice(slice)
 
         if not frame.inverse_enabled or not n:
             return
@@ -675,20 +707,23 @@ class Handler:
                 frame.import_bits(rs, cs, sub_ts, views="inverse")
             else:
                 self._pod_forward_import(
-                    pid, ireq.Index, frame.name, ireq.Slice, rs, cs,
+                    pid, index_name, frame_name, slice, rs, cs,
                     ts_ns[ii], "inverse")
                 idx.set_remote_max_inverse_slice(int(sl.max()))
 
     def _pod_forward_import(self, pid: int, index: str, frame: str,
                             slice: int, rows, cols, ts_ns,
                             view: str) -> None:
-        body = pb.ImportRequest(
-            Index=index, Frame=frame, Slice=slice,
-            RowIDs=np.asarray(rows).tolist(),
-            ColumnIDs=np.asarray(cols).tolist(),
-            Timestamps=np.asarray(ts_ns).tolist()).SerializeToString()
+        # Pod-internal legs are always us-to-us: raw arrays, no
+        # negotiation needed.
+        from ..proto import rawimport
+        ts = np.asarray(ts_ns)
+        body = rawimport.encode(index, frame, slice,
+                                np.asarray(rows, dtype=np.uint64),
+                                np.asarray(cols, dtype=np.uint64),
+                                ts if ts.any() else None)
         self.pod.forward_raw(pid, "POST", f"/import?podView={view}",
-                             body, _PROTOBUF)
+                             body, rawimport.CONTENT_TYPE)
 
     def _handle_get_export(self, req: Request) -> Response:
         if req.accept != "text/csv":

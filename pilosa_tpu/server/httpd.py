@@ -134,9 +134,27 @@ class HTTPServer:
     def _serve_conn(self, conn: socket.socket) -> None:
         conn.settimeout(self.IDLE_TIMEOUT_S)
         buf = bytearray()
+        need = 0
         try:
             while not self._closing.is_set():
-                reqs, bad = self._drain_requests(buf)
+                if need and len(buf) < need:
+                    # A partial request with known total size: keep
+                    # receiving without re-parsing (re-scanning the
+                    # buffer per 64 KB recv made multi-MB bodies
+                    # quadratic in header finds).
+                    try:
+                        data = conn.recv(1 << 20)
+                    except TimeoutError:
+                        return
+                    if not data:
+                        return
+                    buf += data
+                    if len(buf) > _MAX_REQUEST:
+                        conn.sendall(self._plain_response(
+                            400, "request too large", close=True))
+                        return
+                    continue
+                reqs, bad, need = self._drain_requests(buf)
                 if bad:
                     # Serve the valid requests already parsed FIRST —
                     # the client must not read the 400 as the response
@@ -188,17 +206,20 @@ class HTTPServer:
 
     def _drain_requests(self, buf: bytearray):
         """Parse every complete request in ``buf`` (consuming them).
-        Returns (requests, malformed)."""
+        Returns (requests, malformed, need): ``need`` is the total
+        buffered size required to complete the trailing PARTIAL request
+        (0 when unknown), so the receive loop can fill large bodies
+        without re-parsing per recv."""
         reqs: list[_Request] = []
         while True:
             end = buf.find(b"\r\n\r\n")
             if end < 0:
-                return reqs, False
+                return reqs, False, 0
             head = bytes(buf[:end]).decode("latin-1")
             lines = head.split("\r\n")
             parts = lines[0].split(" ")
             if len(parts) != 3 or not parts[2].startswith("HTTP/1"):
-                return reqs, True
+                return reqs, True, 0
             method, target, proto = parts
             headers = {}
             for ln in lines[1:]:
@@ -206,14 +227,16 @@ class HTTPServer:
                 if sep:
                     headers[k.lower()] = v.strip()
             if "chunked" in headers.get("transfer-encoding", ""):
-                return reqs, True  # like wsgiref: no chunked uploads
+                return reqs, True, 0  # like wsgiref: no chunked uploads
             try:
                 length = int(headers.get("content-length") or 0)
             except ValueError:
-                return reqs, True
+                return reqs, True, 0
             total = end + 4 + length
-            if length > _MAX_REQUEST or total > len(buf):
-                return reqs, False  # body not fully buffered yet
+            if length > _MAX_REQUEST:
+                return reqs, False, 0  # rejected by the size guard
+            if total > len(buf):
+                return reqs, False, total  # body not fully buffered
             body = bytes(buf[end + 4:total])
             del buf[:total]
             path, _, qs = target.partition("?")
@@ -221,7 +244,7 @@ class HTTPServer:
                      or proto == "HTTP/1.0")
             reqs.append(_Request(method, path, qs, headers, body, close))
             if close:
-                return reqs, False
+                return reqs, False, 0
 
     # -- request processing --------------------------------------------------
 

@@ -790,34 +790,42 @@ class Fragment:
         rows) costs seconds through per-row Python calls and ~10 ms
         here (reference does per-row counts, fragment.go:529-560, but
         its per-call cost is nanoseconds; ours is not)."""
-        src_cols, key = self._src_cols_key(src)
-        if src_cols is None or not len(src_cols):
+        key = self._src_key(src)
+        if key is None:
             return self._EMPTY_COUNTS
         hit = self._src_counts.get(key)
         if hit is not None and hit[0] == self._epoch:
             return hit[1]
+        # Cache miss: NOW materialize the slice-local columns (the key
+        # memo deliberately does not retain them — pinning the
+        # uncompressed u64 vector per cached row object would dwarf
+        # the roaring data it came from).
+        seg = src._segment(self.slice, False)
+        src_cols = seg.data.values() % np.uint64(SLICE_WIDTH)
         return self._compute_src_count_map(src_cols,
                                            np.uint64(SLICE_WIDTH), key)
 
-    def _src_cols_key(self, src: Bitmap):
-        """(slice-local src columns, sha1 key) for the src-count cache,
-        memoized on the segment's roaring data: row() hands out the
-        SAME cached Bitmap object across repeat queries (row_cache),
-        and result bitmaps are COW — so the values walk + sha1 runs
-        once per materialized object instead of twice per slice per
-        query (both TopN phases key the same map)."""
+    def _src_key(self, src: Bitmap):
+        """sha1 key of the slice-local src columns for the src-count
+        cache (None = absent/empty segment), memoized on the segment's
+        roaring data: row() hands out the SAME cached Bitmap object
+        across repeat queries (row_cache), and result bitmaps are COW
+        — so the values walk + sha1 runs once per materialized object
+        instead of twice per slice per query (both TopN phases key the
+        same map). Guarded by Bitmap.version against in-place
+        mutation; only the 20-byte digest is retained."""
         seg = src._segment(self.slice, False)
         if seg is None:
-            return None, None
+            return None
         data = seg.data
-        memo = getattr(data, "_src_cols_key_memo", None)
+        memo = getattr(data, "_src_key_memo", None)
         if memo is not None and memo[0] == data.version:
-            return memo[1], memo[2]
+            return memo[1]
         src_cols = data.values() % np.uint64(SLICE_WIDTH)
         key = (hashlib.sha1(src_cols.tobytes()).digest()
                if len(src_cols) else None)
-        data._src_cols_key_memo = (data.version, src_cols, key)
-        return src_cols, key
+        data._src_key_memo = (data.version, key)
+        return key
 
     def _host_src_count_map_cached(self, src: Bitmap):
         """The cached (ids, counts) map for this src if one is already
@@ -825,8 +833,8 @@ class Fragment:
         candidates per slice) probes this: the candidate phase of the
         same query built the map moments earlier, so the per-candidate
         roaring intersections it would otherwise do are free gathers."""
-        src_cols, key = self._src_cols_key(src)
-        if src_cols is None or not len(src_cols):
+        key = self._src_key(src)
+        if key is None:
             return self._EMPTY_COUNTS
         hit = self._src_counts.get(key)
         if hit is not None and hit[0] == self._epoch:

@@ -305,3 +305,80 @@ class TestBulkLaneFuzz:
         assert np.array_equal(bm.values(), want)
         back = roaring.Bitmap.unmarshal(bm.marshal())
         assert np.array_equal(back.values(), want)
+
+
+class TestRawImportWire:
+    """The raw-array /import sidecar (proto/rawimport.py): round trip,
+    alignment, the 415-fallback negotiation, and the strict error
+    matrix (406 before body parse at reference parity; truncated raw
+    bodies are 400, never 500)."""
+
+    def test_codec_round_trip_aligned(self):
+        from pilosa_tpu.proto import rawimport
+        rng = np.random.default_rng(1)
+        rows = rng.integers(0, 1 << 40, 1000).astype(np.uint64)
+        cols = rng.integers(0, 1 << 40, 1000).astype(np.uint64)
+        ts = rng.integers(0, 1 << 50, 1000).astype(np.int64)
+        for t in (None, ts):
+            body = rawimport.encode("idx", "frm", 7, rows, cols, t)
+            i, f, s, r, c, tt = rawimport.decode(body)
+            assert (i, f, s) == ("idx", "frm", 7)
+            assert np.array_equal(r, rows) and np.array_equal(c, cols)
+            assert (tt is None) == (t is None)
+            assert r.__array_interface__["data"][0] % 8 == 0
+
+    def test_truncated_bodies_raise_value_error(self):
+        from pilosa_tpu.proto import rawimport
+        for bad in (b"", b"PRAW", b"PRAW\x01\x00", b"PRAW\x09\x00",
+                    b"XXXX\x01\x00" + b"\0" * 64,
+                    rawimport.encode("i", "f", 0,
+                                     np.arange(4, dtype=np.uint64),
+                                     np.arange(4, dtype=np.uint64),
+                                     None)[:-3]):
+            with pytest.raises(ValueError):
+                rawimport.decode(bad)
+
+    def test_server_error_matrix_and_import(self):
+        import tempfile
+        import urllib.error
+        import urllib.request
+
+        from pilosa_tpu.proto import rawimport
+        from pilosa_tpu.server.server import Server
+        RAW = rawimport.CONTENT_TYPE
+        PB = "application/x-protobuf"
+        with tempfile.TemporaryDirectory() as d:
+            srv = Server(d, host="127.0.0.1:0",
+                         anti_entropy_interval=0, polling_interval=0)
+            srv.open()
+            try:
+                def post(path, ct, accept, body):
+                    req = urllib.request.Request(
+                        f"http://{srv.host}{path}", data=body,
+                        method="POST", headers={"Content-Type": ct,
+                                                "Accept": accept})
+                    try:
+                        urllib.request.urlopen(req)
+                        return 200
+                    except urllib.error.HTTPError as e:
+                        return e.code
+                assert post("/import", "text/plain", PB, b"x") == 415
+                assert post("/import", PB, "application/json",
+                            b"garbage") == 406
+                assert post("/import", RAW, PB, b"PRAW\x01\x00") == 400
+                assert post("/import", RAW, RAW, b"PRAW\x01\x00") == 400
+                # real raw import end to end
+                post("/index/ri", "application/json", "*/*", b"{}")
+                post("/index/ri/frame/f", "application/json", "*/*",
+                     b"{}")
+                rows = np.array([3, 3, 9], dtype=np.uint64)
+                cols = np.array([1, 2, 3], dtype=np.uint64)
+                body = rawimport.encode("ri", "f", 0, rows, cols, None)
+                assert post("/import", RAW, PB, body) == 200
+                q = urllib.request.Request(
+                    f"http://{srv.host}/index/ri/query",
+                    data=b'Count(Bitmap(rowID=3, frame="f"))',
+                    method="POST")
+                assert b"[2]" in urllib.request.urlopen(q).read()
+            finally:
+                srv.close()
