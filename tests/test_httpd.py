@@ -317,3 +317,43 @@ def test_batch_lane_count_of_one_stays_numeric(server):
         assert '"results": [0]' in r2, r2[-80:]
     finally:
         s.close()
+
+
+def test_large_body_in_small_chunks(server):
+    """Round-5 regression: the parser re-scanned the whole receive
+    buffer for the header terminator on every recv — quadratic on
+    multi-MB bodies. A large raw-format import delivered in small
+    chunks by a slow client must parse once, apply, and stay fast."""
+    import numpy as np
+
+    from pilosa_tpu.proto import rawimport
+
+    with _conn(server) as s:
+        s.sendall(_req("POST", "/index/big", b"{}"))
+        _read_responses(s, 1)
+        s.sendall(_req("POST", "/index/big/frame/f", b"{}"))
+        _read_responses(s, 1)
+        rows = np.arange(200_000, dtype=np.uint64) % np.uint64(50)
+        cols = np.arange(200_000, dtype=np.uint64) * np.uint64(5) \
+            % np.uint64(1 << 20)
+        payload = rawimport.encode("big", "f", 0, rows, cols, None)
+        head = (f"POST /import HTTP/1.1\r\nHost: x\r\n"
+                f"Content-Type: {rawimport.CONTENT_TYPE}\r\n"
+                f"Accept: application/x-protobuf\r\n"
+                f"Content-Length: {len(payload)}\r\n\r\n").encode()
+        blob = head + payload
+        t0 = time.time()
+        # 64 KB chunks with a yield between sends: the server's fill
+        # loop sees many partial reads of the one request.
+        for i in range(0, len(blob), 1 << 16):
+            s.sendall(blob[i:i + (1 << 16)])
+            time.sleep(0)
+        resp = _read_responses(s, 1, timeout=30.0)[0]
+        assert "200" in resp.split("\r\n")[0]
+        assert time.time() - t0 < 20.0
+        s.sendall(_req("POST", "/index/big/query",
+                       b'Count(Bitmap(rowID=7, frame="f"))'))
+        body = _read_responses(s, 1)[0]
+        want = len({int(c) for r, c in zip(rows.tolist(), cols.tolist())
+                    if r == 7})
+        assert f"[{want}]" in body
