@@ -927,24 +927,22 @@ class Fragment:
         return out
 
     def fold_scan_pays(self, row_ids) -> bool:
-        """Should a fold over these rows take the one-pass fragment
-        scan (fold_rows) over per-row roaring reads? The scan walks
-        EVERY bit in the fragment, so it only pays when the selected
-        rows are a meaningful share of it — a handful of small rows in
-        a 100 M-bit fragment must stay on per-row reads. Selected size
-        comes from the row cache (missing entries under-count, which
-        errs toward the safe per-row path)."""
-        with self._mu:
-            total = self.storage.count()
-            sel = sum(self.cache.get(int(r)) for r in row_ids)
-            return total <= 16 * (sel + 4096 * len(row_ids))
+        """Should a fold over these rows take fold_rows over per-row
+        roaring reads? Since fold_rows switched from a whole-fragment
+        scan to gathering only the target rows' container key spans,
+        its cost is O(selected bits) — the same data the per-row path
+        reads, minus a Bitmap wrapper and merge per row — so at the
+        many-leaf shapes that reach this gate it always pays. (The old
+        heuristic modeled the retired whole-fragment walk AND paid an
+        O(all containers) count() per decision to do it.)"""
+        return True
 
     def fold_rows(self, op: str, row_ids: list[int]) -> np.ndarray:
         """Slice-local columns of a left-fold of ``op`` over the given
-        rows, in ONE vectorized pass over the fragment instead of one
-        roaring merge per row (the reference folds per row,
-        executor.go:253-268; at 1000-row fan-outs that is the whole
-        query cost on the host path).
+        rows, gathered from the rows' container key spans in one
+        vectorized pass instead of one roaring merge per row (the
+        reference folds per row, executor.go:253-268; at 1000-row
+        fan-outs that is the whole query cost on the host path).
 
         Semantics match the sequential fold: ``or`` = union of all;
         ``and`` = columns present in every distinct row; ``andnot`` =
@@ -956,34 +954,19 @@ class Fragment:
         with self._mu:
             w = np.uint64(SLICE_WIDTH)
             ids = np.unique(np.asarray(row_ids, dtype=np.uint64))
-            hit_rows: list[np.ndarray] = []
-            hit_cols: list[np.ndarray] = []
-            batch: list[np.ndarray] = []
-            batch_len = 0
-
-            def flush() -> None:
-                nonlocal batch, batch_len
-                if not batch:
-                    return
-                vals = (batch[0] if len(batch) == 1
-                        else np.concatenate(batch))
-                batch, batch_len = [], 0
-                keep = np.isin(vals // w, ids)
-                if keep.any():
-                    kept = vals[keep]
-                    hit_rows.append(kept // w)
-                    hit_cols.append(kept % w)
-
-            for vals in self.storage.value_chunks():
-                batch.append(vals)
-                batch_len += len(vals)
-                if batch_len >= (1 << 20):
-                    flush()
-            flush()
-            if not hit_cols:
+            # Gather ONLY the target rows' container key spans (each
+            # row covers exactly SLICE_WIDTH/65536 consecutive keys)
+            # instead of walking the whole fragment through
+            # value_chunks and masking with np.isin — at c2 scale
+            # (1000 rows over a wide fragment) the whole-fragment walk
+            # was most of the host fold's cost.
+            shift = np.uint64((SLICE_WIDTH // 65536).bit_length() - 1)
+            positions = self.storage.positions_for_key_ranges(
+                ids << shift, (ids + np.uint64(1)) << shift)
+            if not len(positions):
                 return np.empty(0, dtype=np.uint64)
-            rows = np.concatenate(hit_rows)
-            cols = np.concatenate(hit_cols)
+            rows = positions // w
+            cols = positions % w
             if op == "or":
                 return np.unique(cols)
             if op == "and":

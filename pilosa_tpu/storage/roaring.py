@@ -1123,6 +1123,36 @@ class Bitmap:
             np.array(ns_l, dtype=np.int64))
         return bases + vals
 
+    def positions_for_key_ranges(self, key_lo: np.ndarray,
+                                 key_hi: np.ndarray) -> np.ndarray:
+        """Set positions from every container whose key falls in any
+        [key_lo[i], key_hi[i]) range, as one sorted u64 vector —
+        all_positions restricted to key spans (fragment.fold_rows
+        gathers the target rows' spans through this instead of
+        duplicating the container-decoding walk)."""
+        key_arr = self._keys_np()
+        lo = np.searchsorted(key_arr, key_lo)
+        hi = np.searchsorted(key_arr, key_hi)
+        conts = self.containers
+        skeys = self.keys
+        keys_l: list = []
+        vals_l: list = []
+        ns_l: list = []
+        for s, e in zip(lo.tolist(), hi.tolist()):
+            for i in range(s, e):
+                c = conts[i]
+                if c.n:
+                    keys_l.append(skeys[i])
+                    vals_l.append(c.array if c.bitmap is None
+                                  else bitmap_words_to_values(c.bitmap))
+                    ns_l.append(c.n)
+        if not keys_l:
+            return _EMPTY_U64
+        return (np.repeat(np.array(keys_l, dtype=np.uint64)
+                          << np.uint64(16),
+                          np.array(ns_l, dtype=np.int64))
+                | np.concatenate(vals_l, dtype=np.uint64))
+
     def value_chunks(self):
         """Sorted set positions as one u64 array per container — the
         streaming form of values() for exports that must not
