@@ -44,7 +44,7 @@ def test_two_node_cluster_matches_model(tmp_path):
 
     def spawn(name, port, internal, seed=""):
         d = tmp_path / name
-        d.mkdir()
+        d.mkdir(exist_ok=True)  # restart reuses the original data dir
         env = cpu_env()
         env["PILOSA_TPU_MESH"] = "0"
         log = open(tmp_path / f"{name}.log", "w")
@@ -129,6 +129,20 @@ def test_two_node_cluster_matches_model(tmp_path):
                 qd = (f'Count(Difference(Bitmap(rowID={a}, frame="f"),'
                       f' Bitmap(rowID={b}, frame="f")))')
                 assert _query(node, qd)[0] == len(sa - sb), (step, a, b)
+
+        # Restart node A and re-verify (the reference's
+        # TestMain_Set_Quick cross-checks rows after a restart,
+        # server_test.go:42-121): every row must still be model-exact
+        # on BOTH nodes — WAL replay + snapshot load + replica state.
+        pa_proc = procs[0]
+        pa_proc.send_signal(signal.SIGINT)
+        pa_proc.wait(timeout=30)
+        host_a = spawn("a", pa, ga)
+        for r in sorted(bits):
+            q = f'Count(Bitmap(rowID={r}, frame="f"))'
+            want = len(bits[r])
+            assert _query(host_a, q)[0] == want, ("post-restart-a", r)
+            assert _query(host_b, q)[0] == want, ("post-restart-b", r)
     finally:
         for p in procs:
             try:
