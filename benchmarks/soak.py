@@ -142,6 +142,38 @@ def main():
     write_lat = []
     lat_mu = threading.Lock()
 
+    # In-flight op registry per cell: (set_count, clear_count). A SET
+    # overlapping an in-flight CLEAR on the same cell (or vice versa)
+    # is order-ambiguous — the server linearizes by arrival, the model
+    # by response order, and they can disagree. Any such overlap marks
+    # the cell uncertain (monotone). A 60-min run once failed its
+    # check by exactly ONE bit this way (~1-in-10^6 writes at this
+    # cell-space, which is why shorter soaks never saw it); both nodes
+    # agreed with each other, proving the storage converged and only
+    # the harness model was ambiguous.
+    inflight: dict = {}
+
+    def _begin(r, c, is_set):
+        with model_mu:
+            s, cl = inflight.get((r, c), (0, 0))
+            if (cl if is_set else s):
+                uncertain[r].add(c)
+            inflight[(r, c)] = (s + (1 if is_set else 0),
+                                cl + (0 if is_set else 1))
+
+    def _end(r, c, is_set, conflicted_ok):
+        with model_mu:
+            s, cl = inflight[(r, c)]
+            if (cl if is_set else s):
+                uncertain[r].add(c)
+            s, cl = (s - 1, cl) if is_set else (s, cl - 1)
+            if s or cl:
+                inflight[(r, c)] = (s, cl)
+            else:
+                del inflight[(r, c)]
+            if conflicted_ok:
+                (model[r].add if is_set else model[r].discard)(c)
+
     def writer(seed):
         rng = random.Random(seed)
         while not stop.is_set():
@@ -150,6 +182,7 @@ def main():
             setbit = rng.random() < 0.9
             host = nodes[rng.randrange(2)].host
             verb = "SetBit" if setbit else "ClearBit"
+            _begin(r, c, setbit)
             t0 = time.perf_counter()
             try:
                 query(host, f'{verb}(frame="sf", rowID={r},'
@@ -158,6 +191,7 @@ def main():
                 stats["errors"] += 1  # restart window errors tolerated
                 with model_mu:
                     uncertain[r].add(c)
+                _end(r, c, setbit, conflicted_ok=False)
                 time.sleep(0.5)
                 continue
             el = time.perf_counter() - t0
@@ -165,15 +199,14 @@ def main():
                 write_lat.append(el)
                 if len(write_lat) > 2_000_000:
                     del write_lat[:1_000_000]
-            with model_mu:
-                (model[r].add if setbit else model[r].discard)(c)
-                # NOTE: uncertain is MONOTONE — a cell touched by an
-                # errored request stays unverifiable: the timed-out
-                # request's bytes can still be sitting in a server
-                # connection buffer and apply AFTER this success
-                # (at-least-once, same as the reference's replicated
-                # writes). Round-5's first 60-min run failed its
-                # consistency check by exactly one such zombie bit.
+            # NOTE: uncertain is MONOTONE — a cell touched by an
+            # errored request stays unverifiable: the timed-out
+            # request's bytes can still be sitting in a server
+            # connection buffer and apply AFTER this success
+            # (at-least-once, same as the reference's replicated
+            # writes). Round-5's first 60-min run failed its
+            # consistency check by exactly one such zombie bit.
+            _end(r, c, setbit, conflicted_ok=True)
             stats["writes"] += 1
 
     def batch_writer(seed):
@@ -188,16 +221,20 @@ def main():
             body = "\n".join(
                 f'SetBit(frame="sf", rowID={r}, columnID={c})'
                 for c in cols)
+            for c in cols:
+                _begin(r, c, True)
             try:
                 query(host, body, timeout=60)
             except Exception:
                 stats["errors"] += 1
                 with model_mu:
                     uncertain[r].update(cols)
+                for c in cols:
+                    _end(r, c, True, conflicted_ok=False)
                 time.sleep(0.5)
                 continue
-            with model_mu:
-                model[r].update(cols)
+            for c in cols:
+                _end(r, c, True, conflicted_ok=True)
             stats["writes"] += 100
 
     def reader(seed):

@@ -47,7 +47,7 @@ def test_two_node_cluster_matches_model(tmp_path):
         d.mkdir(exist_ok=True)  # restart reuses the original data dir
         env = cpu_env()
         env["PILOSA_TPU_MESH"] = "0"
-        log = open(tmp_path / f"{name}.log", "w")
+        log = open(tmp_path / f"{name}.log", "a")  # "a": restarts must not truncate the first incarnation's log
         logs.append(log)
         argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
                 "-d", str(d), "-b", f"127.0.0.1:{port}",
@@ -137,7 +137,7 @@ def test_two_node_cluster_matches_model(tmp_path):
         pa_proc = procs[0]
         pa_proc.send_signal(signal.SIGINT)
         pa_proc.wait(timeout=30)
-        host_a = spawn("a", pa, ga)
+        host_a = spawn("a", pa, ga, seed=f"127.0.0.1:{gb}")
         for r in sorted(bits):
             q = f'Count(Bitmap(rowID={r}, frame="f"))'
             want = len(bits[r])

@@ -107,3 +107,97 @@ def test_sigkill_mid_write_storm_recovers(tmp_path):
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait()
+
+
+def test_single_fragment_storm_exact_model(tmp_path):
+    """Mixed per-op set/clear + batched sets under forced snapshot-storm
+    cadence, ops serialized so model order == apply order: the final
+    storage must equal the model EXACTLY, live and after reopen. This
+    is the single-node half of the 60-min soak's consistency argument —
+    when a cluster soak diverges by a bit, this pins whether the
+    storage engine (WAL, async snapshot splice, batch engine) can lose
+    or invent ops at all (round 5: it could not; the soak event was an
+    opposing-op linearization ambiguity across replica fan-outs)."""
+    import random
+    import threading
+    import time
+
+    import numpy as np
+
+    import pilosa_tpu.storage.fragment as fragmod
+    from pilosa_tpu.storage.fragment import Fragment
+
+    old_maxop = fragmod.MAX_OP_N
+    fragmod.MAX_OP_N = 200
+    try:
+        f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f.open()
+        model: dict[int, set] = {}
+        mu = threading.Lock()
+        stop = threading.Event()
+        errs: list = []
+
+        def worker(seed):
+            rng = random.Random(seed)
+            try:
+                while not stop.is_set():
+                    r = rng.randrange(16)
+                    c = rng.randrange(1 << 18)
+                    if rng.random() < 0.85:
+                        with mu:
+                            f.set_bit(r, c)
+                            model.setdefault(r, set()).add(c)
+                    else:
+                        with mu:
+                            f.clear_bit(r, c)
+                            model.setdefault(r, set()).discard(c)
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        def batch_worker(seed):
+            rng = random.Random(seed)
+            try:
+                while not stop.is_set():
+                    r = rng.randrange(16)
+                    cols = np.array(
+                        [rng.randrange(1 << 18) for _ in range(100)],
+                        dtype=np.uint64)
+                    with mu:
+                        f.set_bits(np.full(100, r, dtype=np.uint64),
+                                   cols)
+                        model.setdefault(r, set()).update(cols.tolist())
+            except Exception as e:  # noqa: BLE001
+                errs.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(2)]
+        threads += [threading.Thread(target=batch_worker, args=(9,))]
+        for t in threads:
+            t.start()
+        time.sleep(8)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+
+        def rows_equal(frag):
+            from pilosa_tpu import SLICE_WIDTH
+            for r, want in model.items():
+                # offset_range rebases to 0, so values ARE the cols
+                pos = frag.storage.offset_range(
+                    0, r * SLICE_WIDTH, (r + 1) * SLICE_WIDTH)
+                got = set(pos.values().tolist())
+                if got != want:
+                    return False, r
+            return True, None
+
+        ok, bad = rows_equal(f)
+        assert ok, f"live mismatch in row {bad}"
+        f.close()
+        f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f2.open()
+        ok, bad = rows_equal(f2)
+        assert ok, f"reopen mismatch in row {bad}"
+        f2.close()
+    finally:
+        fragmod.MAX_OP_N = old_maxop
