@@ -615,3 +615,46 @@ class TestTwoNodeCluster:
         finally:
             src.close()
             dst.close()
+
+
+def test_anti_entropy_resurrects_clear_racing_the_sweep(tmp_path):
+    """Documents the engine's (reference-faithful) eventual-consistency
+    wart that round 5's 60-minute soaks kept hitting: a ClearBit whose
+    replica fan-out is mid-flight when the anti-entropy sweep reads the
+    block gets UNDONE. With 2 copies the MergeBlock majority is
+    (2+1)//2 = 1, so a bit present on EITHER node counts as consensus
+    SET (fragment.go:802-920 has the same arithmetic) — the sweep
+    re-sets the cleared replica and the next sweep spreads it back.
+    Simulated deterministically: clear on one replica only (the
+    mid-fan-out state), then run the syncer."""
+    from pilosa_tpu.server.syncer import HolderSyncer
+
+    s1 = make_server(tmp_path, "rz1")
+    s2 = make_server(tmp_path, "rz2")
+    s1.open()
+    s2.open()
+    try:
+        cross_wire(s1, s2)
+        for s in (s1, s2):
+            s.cluster.replica_n = 2
+            http_post(s.host, "/index/i", b"{}")
+            http_post(s.host, "/index/i/frame/f", b"{}")
+        # Set fans out to both replicas.
+        http_post(s1.host, "/index/i/query",
+                  b'SetBit(frame="f", rowID=3, columnID=7)')
+        for s in (s1, s2):
+            _, body = http_post(s.host, "/index/i/query",
+                                b'Count(Bitmap(frame="f", rowID=3))')
+            assert json.loads(body)["results"][0] == 1
+        # Mid-fan-out snapshot of a clear: applied at s1, not yet s2.
+        s1.holder.fragment("i", "f", "standard", 0).clear_bit(3, 7)
+        # The sweep observes the divergence and resolves SET-biased.
+        HolderSyncer(s1.holder, s1.host, s1.cluster).sync_holder()
+        for s in (s1, s2):
+            _, body = http_post(s.host, "/index/i/query",
+                                b'Count(Bitmap(frame="f", rowID=3))')
+            assert json.loads(body)["results"][0] == 1, \
+                f"{s.host}: expected the set-biased resurrection"
+    finally:
+        s2.close()
+        s1.close()
