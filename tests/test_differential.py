@@ -212,3 +212,69 @@ def test_range_stream_matches_model(tmp_path, seed):
             assert got == len(want_cols), (step, got, len(want_cols))
     finally:
         holder.close()
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_attrs_stream_matches_model(tmp_path, seed):
+    """Differential row-attribute coverage: random SetRowAttrs streams
+    (typed values, null deletion) against a dict model, checked through
+    Bitmap(...)'s attrs payload and TopN attribute filters (reference
+    executor.go SetRowAttrs / fragment.go Top filter semantics)."""
+    rng = np.random.default_rng(seed)
+    holder = Holder(str(tmp_path))
+    holder.open()
+    try:
+        idx = holder.create_index("a")
+        from pilosa_tpu.models.frame import FrameOptions
+        idx.create_frame("f", options=FrameOptions(cache_type="ranked"))
+        ex = Executor(holder, host="local", use_mesh=False)
+        frame = holder.frame("a", "f")
+        attrs_model: dict[int, dict] = {}
+        n_rows = 12
+        # seed bits so TopN has candidates; counts descend by row
+        for r in range(n_rows):
+            for c in range(2 * (n_rows - r)):
+                frame.set_bit("standard", r, c)
+        for fr in frame.view("standard").fragments.values():
+            fr.recalculate_cache()
+
+        cats = [100, 200, 300]
+        for step in range(60):
+            r = int(rng.integers(0, n_rows))
+            kind = int(rng.integers(0, 4))
+            if kind == 0:  # int attr
+                v = int(cats[int(rng.integers(0, 3))])
+                ex.execute("a", f"SetRowAttrs(rowID={r}, frame=f,"
+                                f" category={v})")
+                attrs_model.setdefault(r, {})["category"] = v
+            elif kind == 1:  # string attr
+                v = f"s{int(rng.integers(0, 3))}"
+                ex.execute("a", f'SetRowAttrs(rowID={r}, frame=f,'
+                                f' tag="{v}")')
+                attrs_model.setdefault(r, {})["tag"] = v
+            elif kind == 2:  # null deletes
+                ex.execute("a", f"SetRowAttrs(rowID={r}, frame=f,"
+                                f" category=null)")
+                attrs_model.setdefault(r, {}).pop("category", None)
+            else:  # read attrs through Bitmap
+                got = ex.execute(
+                    "a", f"Bitmap(frame=f, rowID={r})")[0]
+                want = {k: v for k, v in
+                        attrs_model.get(r, {}).items()}
+                assert got.attrs == want, (step, r, got.attrs, want)
+            if step % 10 == 9:
+                # TopN filtered by category: exact per reference
+                # semantics (candidates from the rank cache; all rows
+                # cached here, counts descend by row id)
+                v = cats[int(rng.integers(0, 3))]
+                got = ex.execute(
+                    "a", f"TopN(frame=f, n={n_rows},"
+                         f' field="category", filters=[{v}])')[0]
+                want_rows = sorted(
+                    (r for r, a in attrs_model.items()
+                     if a.get("category") == v))
+                got_rows = sorted(p.id for p in got)
+                assert got_rows == want_rows, (step, got_rows,
+                                               want_rows)
+    finally:
+        holder.close()
