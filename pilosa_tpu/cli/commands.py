@@ -67,15 +67,16 @@ def _parse_csv_bits(stream, stderr, start_rnum: int = 1):
 def _parse_csv_arrays(stream, stderr, chunk_lines: int):
     """CSV → (rows u64, cols u64, ts i64|None) array chunks.
 
-    Fast path: numpy's C CSV parser (np.loadtxt) on each chunk — ~30x
-    the per-record Python loop for the plain ``row,col`` form that bulk
-    imports are. The gate is a single digits-only regex pass over the
-    chunk: numpy's parser is laxer than the reference's ParseUint
-    (negatives wrap under u64, floats truncate, '#' starts a comment),
-    so only chunks that are provably ``digits,digits`` take it. Any
-    other chunk (timestamps, malformed rows) re-parses through
-    _parse_csv_bits, which owns the exact per-row error messages (and
-    their absolute row numbers).
+    Fast path: ONE native pass (bitops.cpp parse_csv_u64_pairs,
+    ~10 M bits/s) that parses and validates in the same loop — strict
+    two-field ``digits,digits`` lines, exact u64 bounds, ParseUint
+    semantics; any other shape falls through. Without the native
+    toolchain, the fallback is numpy's C CSV parser (np.loadtxt)
+    behind a bytes-level gate, since loadtxt is laxer than ParseUint
+    (negatives wrap under u64, floats truncate, '#' starts a comment).
+    Chunks both parsers reject (timestamps, malformed rows) re-parse
+    through _parse_csv_bits, which owns the exact per-row error
+    messages (and their absolute row numbers).
 
     Known limit: chunking is by physical lines, so a quoted CSV field
     containing a newline can straddle a chunk boundary, and row numbers
@@ -92,6 +93,11 @@ def _parse_csv_arrays(stream, stderr, chunk_lines: int):
     # re-parses through the exact path).
     def parse_clean(text: str):
         data = text.encode()
+        from ..storage import native
+        got = native.parse_csv_pairs(data)
+        if got is not None:
+            return got
+        # numpy fallback (no native toolchain): gate, then loadtxt.
         if data.translate(None, b"0123456789,\r\n"):
             return None
         u8 = np.frombuffer(data, np.uint8)

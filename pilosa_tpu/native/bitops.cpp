@@ -571,3 +571,44 @@ extern "C" int64_t bench_setbit(const char* path, const uint64_t* positions,
     free(conts);
     return changed;
 }
+
+// Parse a "digits,digits\n"* byte buffer into u64 row/col arrays in one
+// pass (the CSV import fast lane; ~6x numpy's general text parser).
+// Strict: exactly two fields per line, CRLF tolerated, any other shape
+// (blank line, third field, non-digit, value past 2^64-1) returns -1
+// and the caller falls back to the exact per-row Python path that owns
+// the error messages. Returns the number of parsed pairs.
+extern "C" int64_t parse_csv_u64_pairs(
+        const uint8_t* buf, int64_t n, uint64_t* rows, uint64_t* cols,
+        int64_t max_pairs) {
+    int64_t out = 0;
+    int64_t i = 0;
+    while (i < n) {
+        if (out >= max_pairs) return -1;
+        for (int field = 0; field < 2; field++) {
+            if (i >= n || buf[i] < '0' || buf[i] > '9') return -1;
+            unsigned __int128 v = 0;
+            int digits = 0;
+            while (i < n && buf[i] >= '0' && buf[i] <= '9') {
+                v = v * 10 + (uint64_t)(buf[i] - '0');
+                if (++digits > 20) return -1;
+                i++;
+            }
+            if (v > (unsigned __int128)UINT64_MAX) return -1;
+            if (field == 0) {
+                if (i >= n || buf[i] != ',') return -1;
+                i++;
+                rows[out] = (uint64_t)v;
+            } else {
+                cols[out] = (uint64_t)v;
+            }
+        }
+        out++;
+        if (i < n) {
+            if (buf[i] == '\r') i++;
+            if (i >= n || buf[i] != '\n') return -1;
+            i++;
+        }
+    }
+    return out;
+}

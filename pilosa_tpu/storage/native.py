@@ -105,6 +105,9 @@ def _declare(lib):
     lib.bitmap_intersection_count.argtypes = [
         i64, u64p, u8p, u64p, i64p, i64, u64p, u8p, u64p, i64p]
     lib.bitmap_intersection_count.restype = i64
+    lib.parse_csv_u64_pairs.argtypes = [ctypes.c_char_p, i64, u64p,
+                                        u64p, i64]
+    lib.parse_csv_u64_pairs.restype = i64
 
 
 def _u64p(a: np.ndarray):
@@ -362,6 +365,25 @@ def bench_setbit(path: str, positions: np.ndarray,
     if rc < 0:
         raise OSError("bench_setbit IO error")
     return rc
+
+
+def parse_csv_pairs(data: bytes):
+    """One-pass native parse of a ``digits,digits\\n`` byte buffer →
+    (rows u64, cols u64), or None when the library is unavailable OR
+    the buffer has any other shape (blank/3-field/non-digit lines,
+    values past 2^64-1) — the caller's exact per-row path owns the
+    error messages. Strictness matches the reference's ParseUint."""
+    lib = _load()
+    if lib is None or not data:
+        return None
+    cap = data.count(b"\n") + 1
+    rows = np.empty(cap, dtype=np.uint64)
+    cols = np.empty(cap, dtype=np.uint64)
+    n = lib.parse_csv_u64_pairs(data, len(data), _u64p(rows),
+                                _u64p(cols), cap)
+    if n < 0:
+        return None
+    return rows[:n], cols[:n]
 
 
 def available() -> bool:
