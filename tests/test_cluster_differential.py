@@ -130,6 +130,26 @@ def test_two_node_cluster_matches_model(tmp_path):
                       f' Bitmap(rowID={b}, frame="f")))')
                 assert _query(node, qd)[0] == len(sa - sb), (step, a, b)
 
+        # Export the frame from node B and compare to the model: the
+        # full CSV export path (snapshot stream per slice, owner
+        # failover) must reproduce every (row, col) exactly.
+        import io as _io
+
+        from pilosa_tpu.cluster.client import Client as _C
+        exported = set()
+        cb = _C(host_b)
+        max_slice = max((c // SLICE_WIDTH for s in bits.values()
+                         for c in s), default=0)
+        for sl in range(max_slice + 1):
+            w = _io.StringIO()
+            cb.export_csv_to(w, "cd", "f", "standard", sl)
+            for line in w.getvalue().splitlines():
+                r, c = line.split(",")
+                exported.add((int(r), int(c)))
+        want_pairs = {(r, c) for r, s in bits.items() for c in s}
+        assert exported == want_pairs, (
+            len(exported - want_pairs), len(want_pairs - exported))
+
         # Restart node A and re-verify (the reference's
         # TestMain_Set_Quick cross-checks rows after a restart,
         # server_test.go:42-121): every row must still be model-exact
@@ -143,6 +163,36 @@ def test_two_node_cluster_matches_model(tmp_path):
             want = len(bits[r])
             assert _query(host_a, q)[0] == want, ("post-restart-a", r)
             assert _query(host_b, q)[0] == want, ("post-restart-b", r)
+
+        # Backup the frame from the cluster, restore into a FRESH
+        # single-node server, and re-verify the model there — the tar
+        # stream (client.go:463-674 semantics) must carry every bit.
+        import io as _io2
+        buf = _io2.BytesIO()
+        client.backup_to(buf, "cd", "f", "standard")
+        pc = free_port()
+        hosts_c = f"127.0.0.1:{pc}"
+        env = cpu_env()
+        env["PILOSA_TPU_MESH"] = "0"
+        logc = open(tmp_path / "c.log", "a")
+        logs.append(logc)
+        pcproc = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", str(tmp_path / "c"), "-b", hosts_c],
+            env=env, stdout=logc, stderr=logc,
+            cwd=os.path.dirname(_HERE))
+        procs.append(pcproc)
+        wait_up(hosts_c)
+        _post(hosts_c, "/index/cd", b"{}")
+        _post(hosts_c, "/index/cd/frame/f", b"{}")
+        cc = Client(hosts_c)
+        buf.seek(0)
+        cc.restore_from(buf, "cd", "f", "standard")
+        for r in sorted(bits):
+            got = json.loads(_post(
+                hosts_c, "/index/cd/query",
+                f'Count(Bitmap(rowID={r}, frame="f"))'.encode()))
+            assert got["results"][0] == len(bits[r]), ("restore", r)
     finally:
         for p in procs:
             try:
