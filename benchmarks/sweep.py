@@ -1,0 +1,172 @@
+"""Extended differential + fuzz sweeps (one-off confidence runs).
+
+Bigger and longer than the CI-sized versions in tests/: a differential
+stream of mixed mutations and queries against a Python set model
+through the full executor, and a bulk/batch/point mutation fuzz over
+the roaring engine with exact value-set equality and serialized round
+trips. Round 5 ran 10x1500 differential steps and 8x60 fuzz steps
+(~370 K containers/bitmap) clean; rerun after storage or executor
+changes.
+
+Usage: python benchmarks/sweep.py [diff_seeds] [diff_steps] [fuzz_seeds]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.executor import Executor  # noqa: E402
+from pilosa_tpu.models.holder import Holder  # noqa: E402
+from pilosa_tpu.storage import roaring  # noqa: E402
+
+
+def differential(seed: int, steps: int) -> None:
+    rng = np.random.default_rng(seed)
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(d)
+        holder.open()
+        try:
+            holder.create_index("d").create_frame("f")
+            ex = Executor(holder, host="local", use_mesh=False)
+            frame = holder.frame("d", "f")
+            bits: dict[int, set] = {}
+            n_rows, n_cols = 60, 3 * SLICE_WIDTH
+            for step in range(steps):
+                kind = int(rng.integers(0, 9))
+                if kind < 3:
+                    r = int(rng.integers(0, n_rows))
+                    c = int(rng.integers(0, n_cols))
+                    got = ex.execute(
+                        "d", f"SetBit(frame=f, rowID={r},"
+                             f" columnID={c})")[0]
+                    s = bits.setdefault(r, set())
+                    assert got == (c not in s), (seed, step)
+                    s.add(c)
+                elif kind == 3:
+                    r = int(rng.integers(0, n_rows))
+                    c = int(rng.integers(0, n_cols))
+                    got = ex.execute(
+                        "d", f"ClearBit(frame=f, rowID={r},"
+                             f" columnID={c})")[0]
+                    s = bits.get(r, set())
+                    assert got == (c in s), (seed, step)
+                    s.discard(c)
+                elif kind == 4:
+                    k = int(rng.integers(1, 3000))
+                    rows = rng.integers(0, n_rows, k).astype(np.uint64)
+                    cols = rng.integers(0, n_cols, k).astype(np.uint64)
+                    frame.import_bits(rows, cols)
+                    for r, c in zip(rows.tolist(), cols.tolist()):
+                        bits.setdefault(r, set()).add(c)
+                elif kind == 5:
+                    r = int(rng.integers(0, n_rows))
+                    got = ex.execute(
+                        "d", f"Count(Bitmap(frame=f, rowID={r}))")[0]
+                    assert got == len(bits.get(r, set())), (seed, step)
+                elif kind == 6:
+                    ids = rng.integers(
+                        0, n_rows, int(rng.integers(2, 20))).tolist()
+                    q = "Count(Union(" + ", ".join(
+                        f"Bitmap(frame=f, rowID={r})"
+                        for r in ids) + "))"
+                    want = len(set().union(
+                        *(bits.get(r, set()) for r in ids)))
+                    assert ex.execute("d", q)[0] == want, (seed, step)
+                elif kind == 7:
+                    a, b = rng.integers(0, n_rows, 2).tolist()
+                    sa = bits.get(a, set())
+                    sb = bits.get(b, set())
+                    gi = ex.execute(
+                        "d", f"Count(Intersect(Bitmap(frame=f,"
+                             f" rowID={a}), Bitmap(frame=f,"
+                             f" rowID={b})))")[0]
+                    assert gi == len(sa & sb), (seed, step)
+                    gd = ex.execute(
+                        "d", f"Count(Difference(Bitmap(frame=f,"
+                             f" rowID={a}), Bitmap(frame=f,"
+                             f" rowID={b})))")[0]
+                    assert gd == len(sa - sb), (seed, step)
+                else:
+                    src = int(rng.integers(0, n_rows))
+                    got = ex.execute(
+                        "d", f"TopN(Bitmap(frame=f, rowID={src}),"
+                             f" frame=f, n=5)")[0]
+                    ssrc = bits.get(src, set())
+                    for p in got:
+                        assert p.count == len(
+                            bits.get(p.id, set()) & ssrc), (seed, step)
+        finally:
+            holder.close()
+
+
+def fuzz(seed: int, steps: int = 60) -> tuple[int, int]:
+    rng = np.random.default_rng(seed)
+    bm = roaring.Bitmap()
+    model: set = set()
+    universes = [
+        lambda n: rng.integers(0, 1 << 20, n),
+        lambda n: rng.integers(0, 1 << 36, n),
+        lambda n: (np.uint64(0xFFFFFFFFFFFF0000)
+                   + rng.integers(0, 1 << 15, n).astype(np.uint64)),
+        lambda n: rng.integers(0, 1 << 44, n),
+    ]
+    for step in range(steps):
+        u = universes[int(rng.integers(0, 4))]
+        kind = int(rng.integers(0, 5))
+        n = int(rng.integers(1, 40000))
+        vals = np.asarray(u(n), dtype=np.uint64)
+        before = len(model)
+        if kind <= 1:
+            added = bm.add_many(vals)
+            model.update(vals.tolist())
+            assert added == len(model) - before, (seed, step)
+        elif kind == 2:
+            removed = bm.remove_many(vals)
+            model.difference_update(vals.tolist())
+            assert removed == before - len(model), (seed, step)
+        elif kind == 3:
+            do_set = bool(rng.integers(0, 2))
+            ch = bm.apply_batch(vals, set=do_set, wal=False)
+            if do_set:
+                model.update(vals.tolist())
+                assert len(ch) == len(model) - before, (seed, step)
+            else:
+                model.difference_update(vals.tolist())
+                assert len(ch) == before - len(model), (seed, step)
+        else:
+            v = int(vals[0])
+            assert bm._add(v) == (v not in model)
+            model.add(v)
+    want = (np.sort(np.fromiter(model, np.uint64, len(model)))
+            if model else np.empty(0, np.uint64))
+    assert np.array_equal(bm.values(), want), (seed, "value set")
+    back = roaring.Bitmap.unmarshal(bm.marshal())
+    assert np.array_equal(back.values(), want), (seed, "round trip")
+    return len(model), len(bm.keys)
+
+
+def main() -> None:
+    diff_seeds = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    diff_steps = int(sys.argv[2]) if len(sys.argv) > 2 else 1500
+    fuzz_seeds = int(sys.argv[3]) if len(sys.argv) > 3 else 8
+    for seed in range(20, 20 + diff_seeds):
+        differential(seed, diff_steps)
+        print(f"differential seed {seed}: {diff_steps} steps ok",
+              flush=True)
+    for seed in range(50, 50 + fuzz_seeds):
+        nvals, nconts = fuzz(seed)
+        print(f"fuzz seed {seed}: exact ({nvals} values,"
+              f" {nconts} containers)", flush=True)
+    print("SWEEP CLEAN")
+
+
+if __name__ == "__main__":
+    main()
