@@ -41,9 +41,66 @@ SCALE = float(os.environ.get("PILOSA_BENCH_SCALE", "1.0"))
 USE_DEVICE = os.environ.get("PILOSA_BENCH_DEVICE", "1") != "0"
 
 
+# Every emit of this pass, in order — main() folds them into
+# benchmarks/MANIFEST.json so "which run wrote this artifact" is
+# answerable (VERDICT r5 weak #7).
+_EMITTED: list[dict] = []
+
+
 def emit(metric: str, value: float, unit: str, **extra) -> None:
-    print(json.dumps({"metric": metric, "value": round(value, 4),
-                      "unit": unit, **extra}), flush=True)
+    line = {"metric": metric, "value": round(value, 4),
+            "unit": unit, **extra}
+    _EMITTED.append(line)
+    print(json.dumps(line), flush=True)
+
+
+# Canonical artifact file per metric family: the one JSON a consumer
+# should read for that number (everything else is a historical or
+# intermediate record). bench.py owns ROOFLINE.json; this suite owns
+# the rest.
+_CANONICAL_ARTIFACTS = {
+    "intersect_count": "ROOFLINE.json",
+    "write_path": "WRITEPATH.json",
+    "topn1000": "TOPN1000.json",
+    "pallas_ab": "PALLAS_AB.json",
+    "densify": "DENSIFY.json",
+    "host_baselines": "HOST_BASELINE.json",
+}
+
+
+def write_manifest() -> None:
+    """benchmarks/MANIFEST.json: THE index of benchmark truth — which
+    artifact file is canonical per metric family, plus this pass's
+    metrics with their same-pass canary (the measured tunnel sync
+    floor) and canary-normalized ratios. Cross-round comparisons
+    should compare vs_canary, not absolute values: the shared VM slot
+    swings absolutes ~±10%, and "whichever run last wrote
+    WRITEPATH.json" is no longer the provenance story — the manifest
+    records the writing pass and its canary alongside."""
+    floor_ms = _SYNC_FLOOR_MS
+    metrics = {}
+    for line in _EMITTED:
+        entry = dict(line)
+        entry.pop("metric", None)
+        if floor_ms > 0 and line.get("unit") == "ms":
+            # Device latencies scale with the slot's sync floor; the
+            # ratio transfers across passes (and to direct-attached
+            # hardware) where the absolute ms does not.
+            entry["vs_canary_sync_floor"] = round(
+                line["value"] / floor_ms, 3)
+        metrics[line["metric"]] = entry
+    out = {
+        "written_by": "benchmarks/suite.py",
+        "scale": SCALE,
+        "device": USE_DEVICE,
+        "canary": {"sync_floor_ms": round(floor_ms, 3) or None},
+        "canonical_artifacts": _CANONICAL_ARTIFACTS,
+        "metrics": metrics,
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "MANIFEST.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
 
 
 def _timed_chain(fn, iters: int) -> float:
@@ -1024,6 +1081,10 @@ def main() -> None:
             fn()
         except Exception as e:  # noqa: BLE001 - report and continue
             emit(fn.__name__, -1, "error", error=str(e)[:200])
+    try:
+        write_manifest()
+    except Exception as e:  # noqa: BLE001 - manifest must not kill runs
+        print(f"manifest write failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

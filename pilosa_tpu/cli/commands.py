@@ -290,6 +290,43 @@ def cmd_server(args, stdout, stderr) -> int:
     return 0
 
 
+def _parse_csv_field_values(stream, chunk_lines: int):
+    """``column,value`` CSV → (cols u64, vals i64) array chunks for the
+    BSI field-import lane (values may be negative, so the bit-import
+    fast parsers don't apply)."""
+    cols: list[int] = []
+    vals: list[int] = []
+    for rnum, record in enumerate(csv.reader(stream), 1):
+        if not record or record[0] == "":
+            continue
+        if len(record) != 2:
+            raise PilosaError(
+                f"bad column count on row {rnum}: col={len(record)}")
+        try:
+            col = int(record[0])
+            if not 0 <= col < 1 << 64:
+                raise ValueError
+        except ValueError:
+            raise PilosaError(
+                f"invalid column id on row {rnum}: {record[0]!r}")
+        try:
+            val = int(record[1])
+            if not -(1 << 63) <= val < 1 << 63:
+                raise ValueError
+        except ValueError:
+            raise PilosaError(
+                f"invalid value on row {rnum}: {record[1]!r}")
+        cols.append(col)
+        vals.append(val)
+        if len(cols) >= chunk_lines:
+            yield (np.array(cols, dtype=np.uint64),
+                   np.array(vals, dtype=np.int64))
+            cols, vals = [], []
+    if cols:
+        yield (np.array(cols, dtype=np.uint64),
+               np.array(vals, dtype=np.int64))
+
+
 def cmd_import(args, stdout, stderr) -> int:
     from ..cluster.client import Client
     client = Client(args.host)
@@ -297,6 +334,14 @@ def cmd_import(args, stdout, stderr) -> int:
     def import_stream(stream):
         # One array chunk per IMPORT_BUFFER_SIZE lines so memory stays
         # flat on multi-GB files (ctl/import.go:166-171).
+        if getattr(args, "field", ""):
+            # BSI value lane: column,value rows into the named field.
+            for cols, vals in _parse_csv_field_values(
+                    stream, IMPORT_BUFFER_SIZE):
+                print(f"importing {len(cols)} values", file=stderr)
+                client.import_field_values(args.index, args.frame,
+                                           args.field, cols, vals)
+            return
         for rows, cols, ts in _parse_csv_arrays(stream, stderr,
                                                 IMPORT_BUFFER_SIZE):
             print(f"importing {len(rows)} bits", file=stderr)
@@ -510,6 +555,9 @@ def build_parser() -> argparse.ArgumentParser:
         return c
 
     c = client_cmd("import", "bulk-import CSV bits", cmd_import)
+    c.add_argument("--field", default="",
+                   help="import column,value rows into this BSI"
+                        " integer field instead of bits")
     c.add_argument("paths", nargs="+", help="CSV files ('-' for stdin)")
 
     c = client_cmd("export", "export frame as CSV", cmd_export)

@@ -41,6 +41,7 @@ from . import SLICE_WIDTH
 from .models.view import VIEW_INVERSE, VIEW_STANDARD
 from .pql.ast import Call, Query
 from .pql.parser import parse as parse_pql
+from .storage import bsi
 from .storage.bitmap import Bitmap, BitmapSegment
 from .storage.cache import Pair, pairs_sort
 from .storage.fragment import TopOptions
@@ -52,7 +53,8 @@ DEFAULT_FRAME = "general"
 # Lowest count used in a TopN when no threshold is given (executor.go:39).
 MIN_THRESHOLD = 1
 
-_WRITE_CALLS = ("SetBit", "ClearBit", "SetRowAttrs", "SetColumnAttrs")
+_WRITE_CALLS = ("SetBit", "ClearBit", "SetFieldValue", "SetRowAttrs",
+                "SetColumnAttrs")
 
 
 @dataclass
@@ -356,6 +358,10 @@ class Executor:
             return None
         if c.name == "TopN":
             return self._execute_top_n(index, c, slices, opt)
+        if c.name in ("Sum", "Min", "Max"):
+            return self._execute_field_aggregate(index, c, slices, opt)
+        if c.name == "SetFieldValue":
+            return self._execute_set_field_value(index, c, opt)
         return self._execute_bitmap_call(index, c, slices, opt)
 
     # -- bitmap expressions (executor.go:192-570) ----------------------------
@@ -623,8 +629,236 @@ class Executor:
         return (frame_name, row_id,
                 tq.views_by_time_range(VIEW_STANDARD, start_t, end_t, q))
 
+    # -- BSI field ranges / aggregates (storage.bsi) -------------------------
+
+    def _field_range_parse(self, index: str, c: Call, strict: bool):
+        """Resolve a Range call carrying a ``field OP value`` condition
+        to ``(frame_name, Field, Condition)``; None when it carries no
+        condition or (non-strict) when the frame/field is missing —
+        the strict form owns the host path's errors, like
+        _range_views."""
+        pair = c.condition_arg()
+        if pair is None:
+            return None
+        field_name, cond = pair
+        frame_name = c.args.get("frame") or DEFAULT_FRAME
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            if not strict:
+                return None
+            raise FrameNotFoundError(frame_name)
+        field = frame.field(field_name)
+        if field is None:
+            if not strict:
+                return None
+            raise PilosaError(f"field not found: {field_name}")
+        return frame_name, field, cond
+
+    @staticmethod
+    def _bsi_plane_row(plane: int) -> int:
+        """Circuit plane index (bsi.EXISTS_PLANE or value-bit i) → the
+        field view's row id."""
+        if plane == bsi.EXISTS_PLANE:
+            return bsi.EXISTS_ROW
+        return bsi.PLANE_ROW_OFFSET + plane
+
+    def _field_range_slice(self, index: str, c: Call,
+                           slice: int) -> Bitmap:
+        """Host leg of Range(field OP value): the O(depth) bit-plane
+        circuit over the fragment's rows in roaring algebra."""
+        frame_name, field, cond = self._field_range_parse(index, c,
+                                                          strict=True)
+        frag = self.holder.fragment(index, frame_name, field.view_name,
+                                    slice)
+        if frag is None:
+            return Bitmap()
+        bm = bsi.range_bitmap(
+            cond.op, cond.value, field.min, field.max,
+            lambda plane: frag.row(self._bsi_plane_row(plane)))
+        return bm if bm is not None else Bitmap()
+
+    def _execute_field_aggregate(self, index: str, c: Call,
+                                 slices: list[int],
+                                 opt: ExecOptions) -> bsi.ValCount:
+        """Sum / Min / Max over a BSI field, with an optional filter
+        bitmap child: per-slice popcount-weighted plane folds, merged
+        as (sum, count) addition / min-max combine across slices and
+        nodes (the mapReduce partial-aggregate contract)."""
+        name = c.name
+        frame_name = c.args.get("frame") or DEFAULT_FRAME
+        field_name = c.args.get("field")
+        if not field_name or not isinstance(field_name, str):
+            raise PilosaError(f"{name}() field required")
+        frame = self.holder.frame(index, frame_name)
+        if frame is None:
+            raise FrameNotFoundError(frame_name)
+        field = frame.field(field_name)
+        if field is None:
+            raise PilosaError(f"field not found: {field_name}")
+        if len(c.children) > 1:
+            raise PilosaError(
+                f"{name}() only accepts a single bitmap input")
+        child = c.children[0] if c.children else None
+        want_min = name == "Min"
+
+        def map_fn(slice):
+            frag = self.holder.fragment(index, frame_name,
+                                        field.view_name, slice)
+            if frag is None:
+                return bsi.ValCount(0, 0)
+            filt = (self._bitmap_call_slice(index, child, slice)
+                    if child is not None else None)
+
+            def row(plane):
+                return frag.row(self._bsi_plane_row(plane))
+            if name == "Sum":
+                return bsi.sum_count(field.min, field.max, row,
+                                     filter=filt)
+            return bsi.min_max(field.min, field.max, row, filter=filt,
+                               want_min=want_min)
+
+        def reduce_fn(prev, v):
+            if v is None:
+                return prev
+            if prev is None:
+                return v
+            if name == "Sum":
+                return bsi.combine_sum(prev, v)
+            return bsi.combine_min_max(prev, v, want_min=want_min)
+
+        local_fn = (self._sum_local_device_fn(index, frame_name, field,
+                                              child, opt)
+                    if name == "Sum" else None)
+        result = self._map_reduce(index, slices, c, opt, map_fn,
+                                  reduce_fn, local_fn=local_fn)
+        return result or bsi.ValCount(0, 0)
+
+    def _sum_local_device_fn(self, index: str, frame_name: str, field,
+                             child: Optional[Call], opt: ExecOptions):
+        """Device Sum: ONE mesh program computes every plane's
+        popcount against the (compiled) filter — K = depth+1 fused
+        counts through the existing batched-count machinery
+        (mesh.count_exprs_sharded) over residency-cached plane slabs;
+        the weighted fold Σ 2^i·count_i happens host-side in Python
+        ints (no device overflow at any depth)."""
+        if (not self.use_mesh or self.pod is not None
+                or self._mesh_backoff_active()):
+            return None
+        leaves: list[tuple] = []
+        filter_expr = None
+        if child is not None:
+            filter_expr = self._compile_device_expr(index, child, leaves)
+            if filter_expr is None:
+                return None
+        exprs = []
+        for plane in range(bsi.EXISTS_PLANE, field.bit_depth):
+            leaves.append((frame_name, field.view_name,
+                           self._bsi_plane_row(plane)))
+            leaf = ("leaf", len(leaves) - 1)
+            exprs.append(leaf if filter_expr is None
+                         else ("and", leaf, filter_expr))
+        exprs = tuple(exprs)
+
+        def local_fn(slices: list[int]):
+            if len(slices) < self.mesh_min_slices:
+                return NotImplemented
+            mesh = self._mesh_or_none()
+            if mesh is None:
+                return NotImplemented
+            from .parallel import mesh as mesh_mod
+            if len(slices) > mesh_mod.slice_chunk_bound(
+                    mesh.shape[mesh_mod.AXIS_SLICES]):
+                return NotImplemented
+            shard, budget = self._count_budget(slices)
+            if self._leaf_block_bytes(len(leaves), shard) > budget:
+                return NotImplemented
+            cold = self._cold_leaves(mesh, index, leaves, slices)
+            if not self._device_pays(mesh, len(leaves), len(slices),
+                                     cold_rows=cold):
+                return NotImplemented
+            try:
+                arrs = [self._leaf_device_array(mesh, index, leaf,
+                                                tuple(slices))
+                        for leaf in leaves]
+                counts = mesh_mod.count_exprs_sharded(mesh, exprs, arrs)
+            except Exception as e:  # noqa: BLE001 - device trouble
+                self._note_device_fallback("sum_exprs", e)
+                return NotImplemented
+            count = counts[0]
+            total = field.min * count + sum(
+                n << i for i, n in enumerate(counts[1:]))
+            return bsi.ValCount(total, count)
+
+        return local_fn
+
+    def _execute_set_field_value(self, index: str, c: Call,
+                                 opt: ExecOptions) -> bool:
+        """SetFieldValue(frame=f, <col>=N, <field>=V): route to every
+        replica owner of the column's slice, like SetBit
+        (executor.go:664-691); the local apply is the frame's
+        per-plane read-modify write."""
+        frame_name = c.args.get("frame")
+        if not frame_name:
+            raise PilosaError("SetFieldValue() frame required")
+        idx = self.holder.index(index)
+        if idx is None:
+            raise IndexNotFoundError(index)
+        frame = idx.frame(frame_name)
+        if frame is None:
+            raise FrameNotFoundError(frame_name)
+        col_id, ok = c.uint_arg(idx.column_label)
+        if not ok:
+            raise PilosaError(f"SetFieldValue() column field"
+                              f" '{idx.column_label}' required")
+        pairs = [(k, v) for k, v in c.args.items()
+                 if k not in ("frame", idx.column_label)]
+        if len(pairs) != 1:
+            raise PilosaError(
+                "SetFieldValue() requires exactly one field=value")
+        field_name, value = pairs[0]
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise PilosaError(
+                f"SetFieldValue() value must be an integer: {value!r}")
+        slice = col_id // SLICE_WIDTH
+        ret = False
+        for node in self.cluster.fragment_nodes(index, slice):
+            if node.host == self.host:
+                if (self.pod is not None and not opt.pod_local
+                        and self.pod.owner_pid(slice) != self.pod.pid):
+                    if self._pod_forward_field_value(index, c, slice):
+                        ret = True
+                    continue
+                if frame.set_field_value(field_name, col_id, value):
+                    ret = True
+                continue
+            if opt.remote:
+                continue
+            res = self._exec_remote(node, index, Query([c]), None, opt)
+            if res and res[0]:
+                ret = True
+        return ret
+
+    def _pod_forward_field_value(self, index: str, c: Call,
+                                 slice: int) -> bool:
+        """Forward a field-value write to the owning pod process (field
+        views are column-sharded, so placement follows the column
+        slice like standard views)."""
+        pid = self.pod.owner_pid(slice)
+        if self.client is None:
+            raise SliceUnavailableError(
+                f"no client to reach pod process {pid}")
+        res = self.client.execute_query(
+            Node(self.pod.peers[pid]), index, str(Query([c])), None,
+            remote=True, pod_local=True)
+        idx = self.holder.index(index)
+        if idx is not None:
+            idx.set_remote_max_slice(slice)
+        return bool(res and res[0])
+
     def _range_slice(self, index: str, c: Call, slice: int) -> Bitmap:
         # executor.go:490-546: union the minimal time-view cover.
+        if c.condition_arg() is not None:
+            return self._field_range_slice(index, c, slice)
         frame_name, row_id, views = self._range_views(index, c,
                                                       strict=True)
         bm = Bitmap()
@@ -838,6 +1072,8 @@ class Executor:
         per-slice path, which owns the error semantics.
         """
         if c.name == "Range":
+            if c.condition_arg() is not None:
+                return self._compile_field_range_expr(index, c, leaves)
             parsed = self._range_views(index, c, strict=False)
             if parsed is None or not parsed[2]:
                 return None  # malformed or empty cover: host path owns it
@@ -877,6 +1113,37 @@ class Executor:
             expr = (op, expr, p)
         return expr
 
+    def _compile_field_range_expr(self, index: str, c: Call,
+                                  leaves: list):
+        """Compile Range(field OP value) into the comparison circuit
+        over bit-plane leaves (storage.bsi.compare_expr — the SAME
+        circuit the host path evaluates in roaring algebra), so field
+        ranges compose with Count fusion, fold materialization, and
+        plane-slab residency exactly like plain Bitmap leaves. Trivial
+        clamps and provably-empty circuits decline (None): the host
+        path computes those without a device round trip."""
+        parsed = self._field_range_parse(index, c, strict=False)
+        if parsed is None:
+            return None
+        frame_name, field, cond = parsed
+        clamped = bsi.clamp(cond.op, cond.value, field.min, field.max)
+        if clamped == "none":
+            return None
+        leaf_ids: dict[tuple, int] = {}
+
+        def leaf(plane: int):
+            key = (frame_name, field.view_name,
+                   self._bsi_plane_row(plane))
+            if key not in leaf_ids:
+                leaves.append(key)
+                leaf_ids[key] = len(leaves) - 1
+            return ("leaf", leaf_ids[key])
+
+        if clamped == "all":
+            return leaf(bsi.EXISTS_PLANE)
+        cop, upred = clamped
+        return bsi.compare_expr(cop, upred, field.bit_depth, leaf)
+
     def _bitmap_local_device_fn(self, index: str, c: Call,
                                 opt: ExecOptions, compiled=None):
         """Materializing Union/Intersect/Difference on device for WIDE
@@ -890,6 +1157,8 @@ class Executor:
         if (not self.use_mesh or self.pod is not None
                 or self._mesh_backoff_active()):
             return None  # pod host legs own pod materialization
+        if c.name == "Range" and c.condition_arg() is not None:
+            return self._field_range_local_device_fn(index, c)
         if c.name not in ("Union", "Intersect", "Difference"):
             return None
         if compiled is not None:
@@ -931,6 +1200,61 @@ class Executor:
                     continue
                 data = packed.unpack_to_bitmap(
                     w, base_word=slice * (packed.WORDS_PER_SLICE))
+                out.add_segment(data, slice, writable=True)
+            return out
+
+        return local_fn
+
+    def _field_range_local_device_fn(self, index: str, c: Call):
+        """Materializing device leg of Range(field OP value): the whole
+        comparison circuit over stacked bit-plane slabs runs as ONE
+        XLA program (parallel.mesh.bsi_range_sharded — exists row plus
+        depth value planes, sharded over the slice axis), the dense
+        matched words fetch once, and the host repacks to roaring —
+        replacing O(depth) per-slice roaring circuit passes with one
+        HBM pass. Trivial clamps ("all"/"none") stay host-side."""
+        parsed = self._field_range_parse(index, c, strict=False)
+        if parsed is None:
+            return None
+        frame_name, field, cond = parsed
+        clamped = bsi.clamp(cond.op, cond.value, field.min, field.max)
+        if clamped in ("none", "all"):
+            return None
+        cop, upred = clamped
+        depth = field.bit_depth
+        leaves = [(frame_name, field.view_name,
+                   self._bsi_plane_row(p))
+                  for p in range(bsi.EXISTS_PLANE, depth)]
+
+        def local_fn(slices: list[int]):
+            if len(slices) < self.mesh_min_slices:
+                return NotImplemented
+            from .ops import packed
+            slab = len(slices) * packed.WORDS_PER_SLICE * 4
+            if (2 * slab > self._TOPN_HOST_BLOCK_BYTES
+                    or (len(leaves) + 1) * slab
+                    > self._MATERIALIZE_DEVICE_BYTES):
+                return NotImplemented
+            mesh = self._mesh_or_none()
+            if mesh is None:
+                return NotImplemented
+            from .parallel import mesh as mesh_mod
+            try:
+                arrs = [self._leaf_device_array(mesh, index, leaf,
+                                                tuple(slices))
+                        for leaf in leaves]
+                words = mesh_mod.bsi_range_sharded(mesh, cop, upred,
+                                                   depth, arrs)
+            except Exception as e:  # noqa: BLE001 - device trouble
+                self._note_device_fallback("bsi_range", e)
+                return NotImplemented
+            out = Bitmap()
+            for si, slice in enumerate(slices):
+                w = words[si]
+                if not w.any():
+                    continue
+                data = packed.unpack_to_bitmap(
+                    w, base_word=slice * packed.WORDS_PER_SLICE)
                 out.add_segment(data, slice, writable=True)
             return out
 

@@ -18,18 +18,55 @@ from typing import Optional
 
 import numpy as np
 
-from ..errors import PilosaError
+from .. import SLICE_WIDTH
+from ..errors import PilosaError, validate_label
 from ..proto import internal_pb2 as pb
+from ..storage import bsi
 from ..storage import cache as cache_mod
 from ..utils.arrays import group_by_key, sort_dedupe
 from ..storage.attrs import AttrStore
 from ..utils import logger as logger_mod
 from ..utils import timequantum as tq
 from ..utils.stats import NOP
-from .view import (VIEW_INVERSE, VIEW_STANDARD, View, is_inverse_view,
-                   is_valid_view)
+from .view import (VIEW_INVERSE, VIEW_STANDARD, View, field_view_name,
+                   is_field_view, is_inverse_view, is_valid_view)
 
 DEFAULT_ROW_LABEL = "rowID"
+
+
+@dataclass
+class Field:
+    """A BSI integer field of a frame: values in [min, max] stored as
+    bit-plane rows in the ``field_<name>`` view (storage.bsi)."""
+    name: str
+    min: int = 0
+    max: int = 0
+
+    def __post_init__(self):
+        validate_label(self.name)
+        if self.max < self.min:
+            raise PilosaError(
+                f"field max ({self.max}) must be >= min ({self.min})")
+        if bsi.bit_depth(self.min, self.max) > bsi.MAX_BIT_DEPTH:
+            raise PilosaError("field range too wide (max 63 bits)")
+
+    @property
+    def bit_depth(self) -> int:
+        return bsi.bit_depth(self.min, self.max)
+
+    @property
+    def view_name(self) -> str:
+        return field_view_name(self.name)
+
+    def encode(self) -> pb.FieldMeta:
+        return pb.FieldMeta(Name=self.name, Min=self.min, Max=self.max)
+
+    @staticmethod
+    def decode(meta: pb.FieldMeta) -> "Field":
+        return Field(name=meta.Name, min=meta.Min, max=meta.Max)
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "min": self.min, "max": self.max}
 
 
 @dataclass
@@ -39,13 +76,15 @@ class FrameOptions:
     cache_type: str = cache_mod.DEFAULT_CACHE_TYPE
     cache_size: int = cache_mod.DEFAULT_CACHE_SIZE
     time_quantum: str = ""
+    fields: Optional[list[Field]] = None
 
     def encode(self) -> pb.FrameMeta:
         return pb.FrameMeta(RowLabel=self.row_label,
                             InverseEnabled=self.inverse_enabled,
                             CacheType=self.cache_type,
                             CacheSize=self.cache_size,
-                            TimeQuantum=self.time_quantum)
+                            TimeQuantum=self.time_quantum,
+                            Fields=[f.encode() for f in self.fields or []])
 
     @staticmethod
     def decode(meta: pb.FrameMeta) -> "FrameOptions":
@@ -55,7 +94,9 @@ class FrameOptions:
                             or cache_mod.DEFAULT_CACHE_TYPE,
                             cache_size=meta.CacheSize
                             or cache_mod.DEFAULT_CACHE_SIZE,
-                            time_quantum=meta.TimeQuantum)
+                            time_quantum=meta.TimeQuantum,
+                            fields=[Field.decode(f)
+                                    for f in meta.Fields] or None)
 
 
 class Frame:
@@ -135,6 +176,137 @@ class Frame:
             self.options.time_quantum = tq.parse_time_quantum(q)
             self._save_meta()
 
+    # -- BSI integer fields (storage.bsi row layout) -------------------------
+
+    def fields(self) -> list[Field]:
+        with self._mu:
+            return list(self.options.fields or [])
+
+    def field(self, name: str) -> Optional[Field]:
+        with self._mu:
+            for f in self.options.fields or []:
+                if f.name == name:
+                    return f
+            return None
+
+    def create_field(self, field: Field) -> Field:
+        """Register a field and persist it in the ``.meta`` protobuf.
+        Idempotent when the (name, min, max) triple matches; a schema
+        CHANGE for an existing name is an error (the stored planes
+        would silently decode against the wrong base/depth)."""
+        with self._mu:
+            existing = self.field(field.name)
+            if existing is not None:
+                if (existing.min, existing.max) != (field.min, field.max):
+                    raise PilosaError(
+                        f"field already exists with different range:"
+                        f" {field.name}")
+                return existing
+            if self.options.fields is None:
+                self.options.fields = []
+            self.options.fields.append(field)
+            self._save_meta()
+            return field
+
+    def _field_view(self, field: Field) -> View:
+        return self.create_view_if_not_exists(field.view_name)
+
+    def set_field_value(self, field_name: str, column_id: int,
+                        value: int) -> bool:
+        """Point write of one column's integer value: existence bit +
+        per-plane set/clear (a re-set value clears stale 1-planes).
+        Returns whether any bit changed."""
+        field = self.field(field_name)
+        if field is None:
+            raise PilosaError(f"field not found: {field_name}")
+        if not field.min <= value <= field.max:
+            raise PilosaError(
+                f"value {value} out of range for field {field_name}"
+                f" [{field.min}, {field.max}]")
+        view = self._field_view(field)
+        u = value - field.min
+        changed = view.set_bit(bsi.EXISTS_ROW, column_id)
+        for i in range(field.bit_depth):
+            row = bsi.PLANE_ROW_OFFSET + i
+            if (u >> i) & 1:
+                if view.set_bit(row, column_id):
+                    changed = True
+            else:
+                if view.clear_bit(row, column_id):
+                    changed = True
+        return changed
+
+    def field_value(self, field_name: str, column_id: int
+                    ) -> tuple[int, bool]:
+        """(value, exists) readback of one column (debug/tests; queries
+        go through the executor's bit-plane circuits)."""
+        field = self.field(field_name)
+        if field is None:
+            raise PilosaError(f"field not found: {field_name}")
+        view = self.view(field.view_name)
+        if view is None:
+            return 0, False
+        frag = view.fragment(column_id // SLICE_WIDTH)
+        if frag is None:
+            return 0, False
+        col = np.uint64(column_id)
+        if col not in frag.row(bsi.EXISTS_ROW).bits():
+            return 0, False
+        u = 0
+        for i in range(field.bit_depth):
+            if col in frag.row(bsi.PLANE_ROW_OFFSET + i).bits():
+                u |= 1 << i
+        return u + field.min, True
+
+    def import_field_values(self, field_name: str, column_ids,
+                            values) -> None:
+        """Bulk value import: group columns by slice, then per fragment
+        batch-clear the zero planes of re-imported columns and bulk-add
+        the existence row plus the one planes (an import is an absolute
+        assignment, like SetFieldValue, not an OR)."""
+        field = self.field(field_name)
+        if field is None:
+            raise PilosaError(f"field not found: {field_name}")
+        cols = np.asarray(column_ids, dtype=np.uint64)
+        vals = np.asarray(values, dtype=np.int64)
+        if len(cols) != len(vals):
+            raise ValueError("column/value length mismatch")
+        if not len(cols):
+            return
+        if (int(vals.min()) < field.min
+                or int(vals.max()) > field.max):
+            raise PilosaError(
+                f"value out of range for field {field_name}"
+                f" [{field.min}, {field.max}]")
+        # Duplicate columns: last occurrence wins (assignment
+        # semantics) — np.unique keeps the FIRST, so reverse first.
+        if len(cols) > 1:
+            _, first_of_rev = np.unique(cols[::-1], return_index=True)
+            keep = np.sort(len(cols) - 1 - first_of_rev)
+            cols, vals = cols[keep], vals[keep]
+        u = (vals - field.min).astype(np.uint64)
+        depth = field.bit_depth
+        view = self._field_view(field)
+        W = np.uint64(SLICE_WIDTH)
+        for slice, cs, us in group_by_key(cols // W, cols, u):
+            frag = view.create_fragment_if_not_exists(slice)
+            local = cs % W
+            set_parts = [np.uint64(bsi.EXISTS_ROW) * W + local]
+            clear_parts = []
+            for i in range(depth):
+                row = np.uint64(bsi.PLANE_ROW_OFFSET + i)
+                on = (us >> np.uint64(i)) & np.uint64(1) == 1
+                set_parts.append(row * W + local[on])
+                clear_parts.append(row * W + local[~on])
+            if clear_parts:
+                clear = np.concatenate(clear_parts)
+                if len(clear):
+                    # Clear BEFORE the bulk add: import_positions ends
+                    # with a snapshot, which then captures the clears.
+                    frag.clear_positions(clear)
+            frag.import_positions(
+                sort_dedupe(np.concatenate(set_parts)))
+
     # -- views ---------------------------------------------------------------
 
     def _new_view(self, name: str) -> View:
@@ -170,8 +342,17 @@ class Frame:
             return v
 
     def max_slice(self) -> int:
-        v = self.views.get(VIEW_STANDARD)
-        return v.max_slice() if v else 0
+        # Field views are column-sharded like standard, and a pure
+        # integer frame may hold bits ONLY there — the query slice
+        # enumeration must cover both. (Time views fan out alongside
+        # standard, so the standard view already bounds them.)
+        best = 0
+        # Snapshot: concurrent writers insert views under _mu and a
+        # live dict iteration here would raise RuntimeError mid-query.
+        for name, v in list(self.views.items()):
+            if name == VIEW_STANDARD or is_field_view(name):
+                best = max(best, v.max_slice())
+        return best
 
     def max_inverse_slice(self) -> int:
         v = self.views.get(VIEW_INVERSE)

@@ -130,11 +130,15 @@ class Holder:
                 frames = []
                 for fname in sorted(idx.frames):
                     frame = idx.frames[fname]
-                    frames.append({
+                    entry = {
                         "name": fname,
                         "views": [{"name": vn}
                                   for vn in sorted(frame.views)],
-                    })
+                    }
+                    fields = frame.fields()
+                    if fields:
+                        entry["fields"] = [f.to_json() for f in fields]
+                    frames.append(entry)
                 out.append({"name": name, "frames": frames})
             return out
 

@@ -21,14 +21,27 @@ from ..utils.stats import NOP
 
 VIEW_STANDARD = "standard"
 VIEW_INVERSE = "inverse"
+# BSI integer-field views: one per field, column-sharded like standard
+# (pilosa 1.0's viewFieldPrefix).
+VIEW_FIELD_PREFIX = "field_"
 
 
 def is_inverse_view(name: str) -> bool:
     return name.startswith(VIEW_INVERSE)
 
 
+def is_field_view(name: str) -> bool:
+    return name.startswith(VIEW_FIELD_PREFIX)
+
+
+def field_view_name(field: str) -> str:
+    return VIEW_FIELD_PREFIX + field
+
+
 def is_valid_view(name: str) -> bool:
-    return name.startswith(VIEW_STANDARD) or name.startswith(VIEW_INVERSE)
+    return (name.startswith(VIEW_STANDARD)
+            or name.startswith(VIEW_INVERSE)
+            or is_field_view(name))
 
 
 class View:

@@ -103,3 +103,97 @@ def op_count(op: str, a: jax.Array, b: jax.Array) -> jax.Array:
             op, a, b, interpret=(mode == "interpret"))
     return op_count_rows(op, a, b)
 
+
+# -- BSI bit-plane comparison circuit (storage.bsi row layout) ----------------
+
+# Supported comparison operators; "><" (between) composes two circuits
+# at the caller (>= low AND <= high).
+BSI_OPS = ("==", "!=", "<", "<=", ">", ">=")
+
+
+def _bsi_eq_lt_gt(pbits, planes):
+    """One MSB→LSB pass of the bit-sliced comparison over stacked
+    planes ``[depth+1, ..., W]`` (planes[0] = existence, planes[1+i] =
+    offset-value bit i): (eq, lt, gt) matched-word triples. ``pbits``
+    is the predicate's bits LSB-first (``[depth]`` u32 of 0/1) and is
+    TRACED — one compiled program serves every predicate at a given
+    depth. Plain jnp body: usable inside jit/shard_map contexts."""
+    depth = planes.shape[0] - 1
+    eq = planes[0]
+    lt = jnp.zeros_like(eq)
+    gt = jnp.zeros_like(eq)
+    for i in reversed(range(depth)):
+        plane = planes[1 + i]
+        bit = pbits[i] != 0
+        not_plane = jnp.bitwise_not(plane)
+        lt = jnp.where(bit, lt | (eq & not_plane), lt)
+        gt = jnp.where(bit, gt, gt | (eq & plane))
+        eq = jnp.where(bit, eq & plane, eq & not_plane)
+    return eq, lt, gt
+
+
+def bsi_compare_select(op: str, pbits, planes):
+    """Matched words of ``value OP predicate`` from the circuit triple
+    (``op`` static; see _bsi_eq_lt_gt for the layout)."""
+    eq, lt, gt = _bsi_eq_lt_gt(pbits, planes)
+    if op == "==":
+        return eq
+    if op == "!=":
+        return planes[0] & jnp.bitwise_not(eq)
+    if op == "<":
+        return lt
+    if op == "<=":
+        return lt | eq
+    if op == ">":
+        return gt
+    if op == ">=":
+        return gt | eq
+    raise ValueError(f"invalid BSI op: {op!r}")
+
+
+@functools.partial(jax.jit, static_argnums=0)
+def bsi_compare_words(op: str, pbits: jax.Array,
+                      planes: jax.Array) -> jax.Array:
+    """The whole comparison circuit as ONE XLA program: stacked
+    bit-plane words in, matched words out — the single-device form of
+    parallel.mesh.bsi_range_sharded. Compiles once per (op, depth,
+    shape); the predicate rides in as data."""
+    return bsi_compare_select(op, pbits, planes)
+
+
+def bsi_predicate_bits(upred: int, depth: int) -> np.ndarray:
+    """LSB-first u32 bit vector of an offset-space predicate."""
+    return np.array([(upred >> i) & 1 for i in range(depth)],
+                    dtype=np.uint32)
+
+
+def bsi_compare_words_host(op: str, upred: int,
+                           planes: np.ndarray) -> np.ndarray:
+    """Pure-numpy twin of bsi_compare_words (the no-device fallback;
+    also the differential oracle for the XLA program)."""
+    depth = planes.shape[0] - 1
+    eq = planes[0].copy()
+    lt = np.zeros_like(eq)
+    gt = np.zeros_like(eq)
+    for i in reversed(range(depth)):
+        plane = planes[1 + i]
+        if (upred >> i) & 1:
+            lt |= eq & ~plane
+            eq &= plane
+        else:
+            gt |= eq & plane
+            eq &= ~plane
+    if op == "==":
+        return eq
+    if op == "!=":
+        return planes[0] & ~eq
+    if op == "<":
+        return lt
+    if op == "<=":
+        return lt | eq
+    if op == ">":
+        return gt
+    if op == ">=":
+        return gt | eq
+    raise ValueError(f"invalid BSI op: {op!r}")
+

@@ -21,6 +21,7 @@ All entry points compile once per (mesh, shape, op) and cache.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +33,43 @@ from ..ops.kernels import _BITWISE
 
 AXIS_SLICES = "slices"
 AXIS_ROWS = "rows"
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+    """shard_map across jax versions: the stable ``jax.shard_map``
+    (check_vma) when this jax has it, else the 0.4-era
+    ``jax.experimental.shard_map.shard_map``, whose equivalent knob is
+    ``check_rep`` — without the fallback every device program dies at
+    trace time on 0.4.x containers and the whole mesh layer silently
+    demotes to the host path."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as legacy_sm
+    return legacy_sm(f, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_rep=check_vma)
+
+
+_LEGACY_DISPATCH_LOCK = threading.Lock()
+
+
+def _legacy_locked(fn):
+    """Serialize a compiled collective program on legacy jax (no
+    ``jax.shard_map``): the 0.4 CPU backend deadlocks when two
+    collective programs are in flight at once — each program's
+    per-device threads park in the AllReduce rendezvous of a
+    different RunId and neither set can complete (observed: concurrent
+    executor queries on the 8-virtual-device test mesh). One
+    process-wide lock held dispatch-to-completion fixes it; modern
+    jax handles concurrent collectives itself, so the stable path
+    pays nothing."""
+    if hasattr(jax, "shard_map"):
+        return fn
+
+    def locked(*args, **kwargs):
+        with _LEGACY_DISPATCH_LOCK:
+            return jax.block_until_ready(fn(*args, **kwargs))
+    return locked
 
 
 def _mesh_pallas_mode(mesh: Mesh) -> str | None:
@@ -147,10 +185,10 @@ def _densify_sharded_fn(mesh: Mesh, lead_shape: tuple, subs: int,
         out = pk.densify_pallas(flat_l, flat_v, n_words, interpret)
         return out.reshape(lanes.shape[:-2] + (n_words,))
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES), P(AXIS_SLICES)),
-        out_specs=P(AXIS_SLICES), check_vma=False))
+        out_specs=P(AXIS_SLICES), check_vma=False)))
 
 
 def densify_sharded(mesh: Mesh, lanes: np.ndarray, vals: np.ndarray,
@@ -193,10 +231,10 @@ def _count_fn(mesh: Mesh, op: str):
         lo = jax.lax.psum(jnp.sum(row & 0xFFFF), AXIS_SLICES)
         return jnp.stack([hi, lo])  # one output = one host fetch
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES), P(AXIS_SLICES)),
-        out_specs=P()))
+        out_specs=P())))
 
 
 def count_op(mesh: Mesh, op: str, a: jax.Array, b: jax.Array) -> int:
@@ -222,10 +260,10 @@ def _count_expr_fn_cached(mesh: Mesh, expr: tuple, mode: str | None):
 
     # check_vma off when Pallas is in the shard body: pallas_call's
     # out_shape carries no varying-axis info, which trips the inference.
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(None, AXIS_SLICES),), out_specs=P(),
-        check_vma=(mode is None)))
+        check_vma=(mode is None))))
 
 
 def count_expr_fn(mesh: Mesh, expr: tuple):
@@ -275,10 +313,10 @@ def _count_exprs_fn_cached(mesh: Mesh, exprs: tuple, mode: str | None):
         return jnp.stack([jax.lax.psum(his, AXIS_SLICES),
                           jax.lax.psum(los, AXIS_SLICES)])
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(None, AXIS_SLICES),), out_specs=P(),
-        check_vma=(mode is None)))
+        check_vma=(mode is None))))
 
 
 def count_exprs_fn(mesh: Mesh, exprs: tuple):
@@ -362,10 +400,10 @@ def _count_exprs_sharded_fn(mesh: Mesh, exprs: tuple, n_leaves: int,
         return jnp.stack([jax.lax.psum(his, AXIS_SLICES),
                           jax.lax.psum(los, AXIS_SLICES)])
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES),) * n_leaves, out_specs=P(),
-        check_vma=(mode is None)))
+        check_vma=(mode is None))))
 
 
 def count_exprs_sharded(mesh: Mesh, exprs: tuple,
@@ -408,10 +446,10 @@ def _topn_exact_sharded_fn(mesh: Mesh, expr, n_leaves: int,
         return _psum_hi_lo_rows(
             _shard_topn_inter(expr, rows, leaves, mode))
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES),) * (n_leaves + 1),
-        out_specs=P(), check_vma=(mode is None)))
+        out_specs=P(), check_vma=(mode is None))))
 
 
 def _shard_topn_inter(expr, rows, leaves, mode):
@@ -486,10 +524,10 @@ def _topn_filtered_sharded_fn(mesh: Mesh, expr, n_leaves: int,
             expr, rows, jnp.stack(leaf_shards), threshold, tanimoto,
             mode))
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(), P()) + (P(AXIS_SLICES),) * (n_leaves + 1),
-        out_specs=P(), check_vma=(mode is None)))
+        out_specs=P(), check_vma=(mode is None))))
 
 
 def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
@@ -586,10 +624,10 @@ def _topn_exact_fn_cached(mesh: Mesh, expr, mode: str | None):
         return _psum_hi_lo_rows(
             _shard_topn_inter(expr, rows, leaves, mode))
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES), P(None, AXIS_SLICES)),
-        out_specs=P(), check_vma=(mode is None)))
+        out_specs=P(), check_vma=(mode is None))))
 
 
 @functools.lru_cache(maxsize=256)
@@ -598,10 +636,10 @@ def _topn_filtered_fn_cached(mesh: Mesh, expr, mode: str | None):
         return _psum_hi_lo_rows(_filtered_counts(
             expr, rows, leaves, threshold, tanimoto, mode))
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(), P(), P(AXIS_SLICES), P(None, AXIS_SLICES)),
-        out_specs=P(), check_vma=(mode is None)))
+        out_specs=P(), check_vma=(mode is None))))
 
 
 def topn_filtered_fn(mesh: Mesh, expr):
@@ -635,10 +673,10 @@ def _materialize_fn(mesh: Mesh, expr, n_leaves: int):
     def per_shard(*leaf_shards):  # each [S/n, W]
         return _eval_expr(expr, jnp.stack(leaf_shards))
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES),) * n_leaves,
-        out_specs=P(AXIS_SLICES)))
+        out_specs=P(AXIS_SLICES))))
 
 
 def materialize_expr_sharded(mesh: Mesh, expr,
@@ -651,6 +689,48 @@ def materialize_expr_sharded(mesh: Mesh, expr,
     """
     fn = _materialize_fn(mesh, expr, len(leaf_arrays))
     return np.asarray(fn(*leaf_arrays))
+
+
+@functools.lru_cache(maxsize=256)
+def _bsi_range_fn(mesh: Mesh, op: str, n_leaves: int):
+    from ..ops import kernels
+
+    def per_shard(pbits, pbits2, *plane_shards):  # each [S/n, W]
+        planes = jnp.stack(plane_shards)  # [depth+1, S/n, W]
+        if op == "><":
+            ge = kernels.bsi_compare_select(">=", pbits, planes)
+            le = kernels.bsi_compare_select("<=", pbits2, planes)
+            return jnp.bitwise_and(ge, le)
+        return kernels.bsi_compare_select(op, pbits, planes)
+
+    return _legacy_locked(jax.jit(_shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P(), P()) + (P(AXIS_SLICES),) * n_leaves,
+        out_specs=P(AXIS_SLICES))))
+
+
+def bsi_range_sharded(mesh: Mesh, op: str, upred, depth: int,
+                      plane_arrays: list[jax.Array]) -> np.ndarray:
+    """[S, W] dense matched words of a BSI comparison: the whole
+    bit-plane circuit (storage.bsi semantics, ops.kernels circuit
+    body) over device-resident plane slabs — ``plane_arrays[0]`` the
+    existence row, ``plane_arrays[1+i]`` offset-value bit i, each
+    ``[n_slices, W]`` sharded over the slice axis — as ONE compiled
+    SPMD program per (mesh, op, depth). The predicate travels as a
+    traced LSB-first bit vector, so repeated range queries at one
+    depth reuse the compilation. ``op`` "><" takes ``upred = (lo,
+    hi)`` in offset space; everything else a single offset predicate.
+    """
+    from ..ops import kernels
+    if op == "><":
+        lo, hi = upred
+        pbits = kernels.bsi_predicate_bits(lo, depth)
+        pbits2 = kernels.bsi_predicate_bits(hi, depth)
+    else:
+        pbits = kernels.bsi_predicate_bits(upred, depth)
+        pbits2 = np.zeros(depth, dtype=np.uint32)
+    fn = _bsi_range_fn(mesh, op, len(plane_arrays))
+    return np.asarray(fn(pbits, pbits2, *plane_arrays))
 
 
 # Device-block budget for one topn_exact call (mirrors the 256 MB
@@ -726,10 +806,10 @@ def _topn_fn(mesh: Mesh, op: str, k: int):
 
     # check_vma off: the all_gather over ``rows`` makes counts replicated,
     # but the varying-axis inference can't prove it.
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES, AXIS_ROWS), P(AXIS_SLICES)),
-        out_specs=(P(), P()), check_vma=False))
+        out_specs=(P(), P()), check_vma=False)))
 
 
 def topn_counts(mesh: Mesh, op: str, rows: jax.Array, src: jax.Array,
@@ -767,11 +847,11 @@ def _query_step_fn(mesh: Mesh, k: int):
         top_vals, top_ids = jax.lax.top_k(counts, k)
         return n_inter, n_union, top_vals, top_ids
 
-    return jax.jit(jax.shard_map(
+    return _legacy_locked(jax.jit(_shard_map(
         per_shard, mesh=mesh,
         in_specs=(P(AXIS_SLICES), P(AXIS_SLICES),
                   P(AXIS_SLICES, AXIS_ROWS)),
-        out_specs=(P(), P(), P(), P()), check_vma=False))
+        out_specs=(P(), P(), P(), P()), check_vma=False)))
 
 
 def query_step(mesh: Mesh, a: jax.Array, b: jax.Array, rows: jax.Array,

@@ -13,6 +13,38 @@ from typing import Any, Optional
 
 from ..errors import TIME_FORMAT
 
+# Comparison operators accepted in a BSI field condition, e.g.
+# ``Range(frame=f, age >= 20)`` (pql/token.go ASSIGN..BETWEEN set).
+CONDITION_OPS = ("==", "!=", "<", "<=", ">", ">=", "><")
+
+
+class Condition:
+    """A ``field OP value`` argument (pilosa 1.0's range syntax): the
+    parser stores it under the field name in ``Call.args``, so a call
+    carries at most one condition per field. ``op`` is one of
+    CONDITION_OPS; ``value`` is an int, except ``><`` (between), whose
+    value is a two-int [low, high] list."""
+
+    __slots__ = ("op", "value")
+
+    def __init__(self, op: str, value: Any):
+        if op not in CONDITION_OPS:
+            raise ValueError(f"invalid condition op: {op!r}")
+        self.op = op
+        self.value = value
+
+    def __repr__(self):
+        return f"Condition({self.op} {self.value!r})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Condition) and self.op == other.op
+                and self.value == other.value)
+
+    def __hash__(self):
+        v = tuple(self.value) if isinstance(self.value, list) else \
+            self.value
+        return hash((self.op, v))
+
 
 def _fmt_value(v: Any) -> str:
     if isinstance(v, str):
@@ -87,9 +119,26 @@ class Call:
 
     # -- canonical serialization (ast.go:121-171)
 
+    def condition_arg(self) -> Optional[tuple[str, "Condition"]]:
+        """The (field_name, condition) pair of a BSI range call, or
+        None. At most one condition per call is meaningful — the
+        first in key order wins (parse keeps keys unique)."""
+        for k in self.keys():
+            v = self.args[k]
+            if isinstance(v, Condition):
+                return k, v
+        return None
+
     def __str__(self) -> str:
         parts = [c.__str__() for c in self.children]
-        parts += [f"{k}={_fmt_value(self.args[k])}" for k in self.keys()]
+        for k in self.keys():
+            v = self.args[k]
+            if isinstance(v, Condition):
+                # Wire form must re-parse on peer nodes (executor.go
+                # forwards the canonical serialization).
+                parts.append(f"{k} {v.op} {_fmt_value(v.value)}")
+            else:
+                parts.append(f"{k}={_fmt_value(v)}")
         return f"{self.name or '!UNNAMED'}({', '.join(parts)})"
 
     def __repr__(self):
@@ -108,8 +157,8 @@ class Query:
     def write_calls(self) -> list[Call]:
         """Calls that mutate state (ast.go WriteCalls)."""
         return [c for c in self.calls
-                if c.name in ("SetBit", "ClearBit", "SetRowAttrs",
-                              "SetColumnAttrs")]
+                if c.name in ("SetBit", "ClearBit", "SetFieldValue",
+                              "SetRowAttrs", "SetColumnAttrs")]
 
     def __str__(self) -> str:
         return "\n".join(str(c) for c in self.calls)

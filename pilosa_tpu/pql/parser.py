@@ -19,7 +19,7 @@ from __future__ import annotations
 import re
 
 from ..errors import PilosaError
-from .ast import Call, Query
+from .ast import Call, Condition, Query
 
 EOF = "EOF"
 WS = "WS"
@@ -29,6 +29,7 @@ BADSTRING = "BADSTRING"
 INTEGER = "INTEGER"
 FLOAT = "FLOAT"
 EQ = "EQ"
+COND = "COND"  # comparison operator of a BSI field condition
 COMMA = "COMMA"
 LPAREN = "LPAREN"
 RPAREN = "RPAREN"
@@ -115,6 +116,17 @@ class Scanner:
                 body = _ESCAPE_RE.sub(
                     lambda mm: _ESCAPES[mm.group(1)], body)
             return STRING, pos, body
+        if ch in "<>!=":
+            # Comparison operators of the BSI condition syntax
+            # (``age >= 20``): two-char forms first, then the single-
+            # char ones; '=' alone stays the assignment token.
+            two = s[i:i + 2]
+            if two in ("==", "!=", "<=", ">=", "><"):
+                self._advance(i + 2)
+                return COND, pos, two
+            if ch in "<>":
+                self._advance(i + 1)
+                return COND, pos, ch
         self._advance(i + 1)
         return _SIMPLE_TOKENS.get(ch, ILLEGAL), pos, ch
 
@@ -260,9 +272,12 @@ class Parser:
                 raise ParseError(pos, f"expected argument key, found {lit!r}")
             key = lit
             tok, pos, lit = self._scan_skip_ws()
-            if tok != EQ:
+            if tok == COND:
+                value = self._parse_condition(lit, pos)
+            elif tok == EQ:
+                value = self._parse_value()
+            else:
                 raise ParseError(pos, f"expected equals sign, found {lit!r}")
-            value = self._parse_value()
             if key in args:
                 raise ParseError(pos, f"argument key already used: {key}")
             args[key] = value
@@ -273,6 +288,22 @@ class Parser:
             if tok != COMMA:
                 raise ParseError(
                     pos, f"expected comma or right paren, found {lit!r}")
+
+    def _parse_condition(self, op: str, pos) -> Condition:
+        """``field OP value``: the value must be an integer, except
+        ``><`` (between), which takes a two-int [low, high] list."""
+        value = self._parse_value()
+        if op == "><":
+            if (not isinstance(value, list) or len(value) != 2
+                    or not all(isinstance(v, int)
+                               and not isinstance(v, bool)
+                               for v in value)):
+                raise ParseError(
+                    pos, "between requires a two-integer list")
+        elif isinstance(value, bool) or not isinstance(value, int):
+            raise ParseError(
+                pos, f"condition value must be an integer: {value!r}")
+        return Condition(op, value)
 
     def _parse_value(self, in_list: bool = False):
         tok, pos, lit = self._scan_skip_ws()

@@ -20,6 +20,7 @@ from ..utils.arrays import group_by_key
 from ..storage.attrs import (ATTR_TYPE_BOOL, ATTR_TYPE_FLOAT, ATTR_TYPE_INT,
                              ATTR_TYPE_STRING)
 from ..storage.bitmap import Bitmap
+from ..storage.bsi import ValCount
 from ..storage.cache import Pair
 
 
@@ -99,6 +100,9 @@ def encode_query_result(result) -> pb.QueryResult:
     out = pb.QueryResult()
     if isinstance(result, Bitmap):
         out.Bitmap.CopyFrom(encode_bitmap(result))
+    elif isinstance(result, ValCount):
+        out.ValCount.Val = result.value
+        out.ValCount.Count = result.count
     elif isinstance(result, list):
         out.Pairs.extend(encode_pairs(result))
     elif isinstance(result, bool):
@@ -128,8 +132,11 @@ def decode_query_results(resp: pb.QueryResponse, call_names: list[str]
             out.append(decode_pairs(res.Pairs))
         elif name == "Count":
             out.append(int(res.N))
-        elif name in ("SetBit", "ClearBit"):
+        elif name in ("SetBit", "ClearBit", "SetFieldValue"):
             out.append(bool(res.Changed))
+        elif name in ("Sum", "Min", "Max"):
+            out.append(ValCount(int(res.ValCount.Val),
+                                int(res.ValCount.Count)))
         elif name in ("SetRowAttrs", "SetColumnAttrs"):
             out.append(None)
         else:
@@ -142,6 +149,8 @@ def decode_query_results(resp: pb.QueryResponse, call_names: list[str]
 def result_to_json(result):
     if isinstance(result, Bitmap):
         return result.to_json()
+    if isinstance(result, ValCount):
+        return result.to_json()  # {"value": ..., "count": ...}
     if isinstance(result, list):  # pairs
         return [{"id": p.id, "count": p.count} for p in result]
     return result  # int, bool, or None

@@ -24,7 +24,7 @@ from .. import __version__
 from ..cluster.broadcast import NOP_BROADCASTER, unmarshal_message
 from ..errors import (FrameExistsError, IndexExistsError, PilosaError,
                       validate_label)
-from ..models.frame import FrameOptions
+from ..models.frame import Field, FrameOptions
 from ..models.index import IndexOptions
 from ..pql import parser as pql
 from ..proto import internal_pb2 as pb
@@ -40,7 +40,7 @@ _PROTOBUF = "application/x-protobuf"
 # (handler.go:299-351 validates against the Go struct tags).
 _VALID_INDEX_OPTIONS = {"columnLabel", "timeQuantum"}
 _VALID_FRAME_OPTIONS = {"rowLabel", "inverseEnabled", "cacheType",
-                        "cacheSize", "timeQuantum"}
+                        "cacheSize", "timeQuantum", "fields"}
 
 
 class HTTPError(Exception):
@@ -221,6 +221,12 @@ class Handler:
           self._handle_patch_frame_time_quantum)
         r("GET", "/index/{index}/frame/{frame}/views",
           self._handle_get_frame_views)
+        r("GET", "/index/{index}/frame/{frame}/fields",
+          self._handle_get_frame_fields)
+        r("POST", "/index/{index}/frame/{frame}/field/{field}",
+          self._handle_post_frame_field)
+        r("POST", "/index/{index}/frame/{frame}/field/{field}/import",
+          self._handle_post_field_import)
         r("PATCH", "/index/{index}/time-quantum",
           self._handle_patch_index_time_quantum)
         r("GET", "/debug/vars", self._handle_expvar)
@@ -477,7 +483,8 @@ class Handler:
             inverse_enabled=bool(opts.get("inverseEnabled", False)),
             cache_type=opts.get("cacheType", "lru"),
             cache_size=int(opts.get("cacheSize", 50000)),
-            time_quantum=tq.parse_time_quantum(opts.get("timeQuantum", "")))
+            time_quantum=tq.parse_time_quantum(opts.get("timeQuantum", "")),
+            fields=self._parse_fields_option(opts.get("fields")))
         try:
             idx.create_frame(frame_name, options)
         except FrameExistsError as e:
@@ -509,6 +516,108 @@ class Handler:
         if frame is None:
             raise HTTPError(404, "frame not found")
         return Response.json({"views": sorted(frame.views)})
+
+    # -- BSI integer fields --------------------------------------------------
+
+    @staticmethod
+    def _parse_fields_option(raw) -> Optional[list[Field]]:
+        if raw is None:
+            return None
+        if not isinstance(raw, list):
+            raise HTTPError(400, "fields is not a list")
+        out = []
+        for o in raw:
+            if not isinstance(o, dict) or "name" not in o:
+                raise HTTPError(400, f"invalid field: {o!r}")
+            for k in o:
+                if k not in ("name", "min", "max"):
+                    raise HTTPError(400, f"Unknown key: {k}:{o[k]}")
+            try:
+                out.append(Field(name=o["name"],
+                                 min=int(o.get("min", 0)),
+                                 max=int(o.get("max", 0))))
+            except (TypeError, ValueError) as e:
+                raise HTTPError(400, str(e))
+        return out
+
+    def _handle_get_frame_fields(self, req: Request) -> Response:
+        frame = self.holder.frame(req.vars["index"], req.vars["frame"])
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        return Response.json(
+            {"fields": [f.to_json() for f in frame.fields()]})
+
+    def _handle_post_frame_field(self, req: Request) -> Response:
+        """Create one BSI field on an existing frame (body:
+        {"min": N, "max": M}); re-broadcasts the frame meta so peers
+        register it too."""
+        index_name, frame_name = req.vars["index"], req.vars["frame"]
+        frame = self.holder.frame(index_name, frame_name)
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        body = req.json()
+        for k in body:
+            if k not in ("min", "max"):
+                raise HTTPError(400, f"Unknown key: {k}:{body[k]}")
+        try:
+            field = Field(name=req.vars["field"],
+                          min=int(body.get("min", 0)),
+                          max=int(body.get("max", 0)))
+        except (TypeError, ValueError) as e:
+            raise HTTPError(400, str(e))
+        frame.create_field(field)
+        self.broadcaster.send_sync(pb.CreateFrameMessage(
+            Index=index_name, Frame=frame_name,
+            Meta=frame.options.encode()))
+        return Response.json({})
+
+    def _handle_post_field_import(self, req: Request) -> Response:
+        """Bulk field-value import: protobuf ImportValueRequest (one
+        slice, owner-checked like /import) or the JSON convenience
+        form {"columns": [...], "values": [...]}. The JSON form
+        requires EVERY touched slice to be owned by this host (412
+        otherwise, nothing applied) — clients spanning owners must
+        split per slice like cluster.client.import_field_values."""
+        index_name, frame_name = req.vars["index"], req.vars["frame"]
+        field_name = req.vars["field"]
+        if req.content_type == _PROTOBUF:
+            ireq = pb.ImportValueRequest.FromString(req.body())
+            if (ireq.Index, ireq.Frame, ireq.Field) != (
+                    index_name, frame_name, field_name):
+                raise HTTPError(400, "import target mismatch")
+            cols = np.fromiter(ireq.ColumnIDs, np.uint64,
+                               len(ireq.ColumnIDs))
+            vals = np.fromiter(ireq.Values, np.int64, len(ireq.Values))
+            if self.cluster is not None and not self.cluster.owns_fragment(
+                    self.host, index_name, ireq.Slice):
+                raise HTTPError(412, f"host does not own slice"
+                                     f" {self.host}-{index_name}"
+                                     f" slice:{ireq.Slice}")
+        else:
+            body = req.json()
+            cols = np.asarray(body.get("columns", []), dtype=np.uint64)
+            vals = np.asarray(body.get("values", []), dtype=np.int64)
+            if self.cluster is not None and len(cols):
+                from .. import SLICE_WIDTH
+                for slice in np.unique(cols // np.uint64(
+                        SLICE_WIDTH)).tolist():
+                    if not self.cluster.owns_fragment(
+                            self.host, index_name, slice):
+                        raise HTTPError(
+                            412, f"host does not own slice"
+                                 f" {self.host}-{index_name}"
+                                 f" slice:{slice}")
+        if len(cols) != len(vals):
+            raise HTTPError(400, "import array length mismatch")
+        frame = self.holder.frame(index_name, frame_name)
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        if frame.field(field_name) is None:
+            raise HTTPError(404, "field not found")
+        frame.import_field_values(field_name, cols, vals)
+        if req.content_type == _PROTOBUF:
+            return Response.proto(pb.ImportResponse())
+        return Response.json({})
 
     # -- query ---------------------------------------------------------------
 
@@ -655,6 +764,12 @@ class Handler:
                 .replace(tzinfo=None) if ts else None
                 for ts in ts_ns.tolist()]
         else:
+            # A non-empty ALL-ZERO Timestamps list collapses to
+            # timestamps=None here, where the reference (handler.go)
+            # builds a per-bit slice of nils. End state is identical —
+            # frame.import_bits treats a per-bit None exactly like no
+            # timestamp — but any future PER-BIT timestamp semantics
+            # must re-check this edge (ADVICE r5 #4).
             timestamps = None
         pod_view = req.query.get("podView")
         if pod_view is not None and pod_view not in ("standard", "inverse"):

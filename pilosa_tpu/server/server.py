@@ -337,8 +337,13 @@ class Server:
         elif isinstance(m, pb.CreateFrameMessage):
             idx = self.holder.index(m.Index)
             if idx is not None:
-                idx.create_frame_if_not_exists(
-                    m.Frame, FrameOptions.decode(m.Meta))
+                opts = FrameOptions.decode(m.Meta)
+                frame = idx.create_frame_if_not_exists(m.Frame, opts)
+                # Field creation on an existing frame re-broadcasts the
+                # full meta: register any fields this node lacks
+                # (create_field is idempotent on a matching range).
+                for fld in opts.fields or []:
+                    frame.create_field(fld)
         elif isinstance(m, pb.DeleteFrameMessage):
             idx = self.holder.index(m.Index)
             if idx is not None:

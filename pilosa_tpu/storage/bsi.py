@@ -1,0 +1,274 @@
+"""Bit-sliced indexing (BSI) engine: integer fields as bit-plane rows.
+
+A frame *field* (name, min, max) stores one integer per column in a
+dedicated ``field_<name>`` view: row 0 is the existence (not-null) row
+and rows 1..depth hold the binary planes of ``value - min`` (bit i of
+the offset value lives in row ``1 + i``), with
+``depth = ceil(log2(max - min + 1))``. Comparison queries are the
+classic O(depth) bit-plane boolean circuit (O'Neil/Quass bit-sliced
+range evaluation; pilosa 1.0 fragment.go fieldRange*), and Sum/Min/Max
+aggregate by popcount-weighted plane folds.
+
+This module is backend-agnostic on purpose: ``compare_expr`` builds the
+circuit once as the executor's ``("and"|"or"|"andnot", a, b)`` /
+``("leaf", i)`` expression tuples, which evaluate identically over
+host roaring bitmaps (``eval_bitmap_expr``), over packed words in
+numpy (ops.packed / kernels fallback), and as ONE XLA program on the
+device mesh (parallel.mesh + executor._compile_device_expr) — the same
+tree, three backends, so their semantics cannot drift.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..errors import PilosaError
+
+# Row layout within a field_<name> view (pilosa 1.0's bsiExistsBit /
+# bsiOffsetBit layout): row 0 = existence, row 1+i = offset-value bit i.
+EXISTS_ROW = 0
+PLANE_ROW_OFFSET = 1
+
+# Offset values are unsigned 63-bit at most (predicates travel as PQL
+# int64; a wider range would not round-trip the wire form).
+MAX_BIT_DEPTH = 63
+
+# The existence row as a circuit plane index (compare_expr leaf space).
+EXISTS_PLANE = -1
+
+
+def bit_depth(min_v: int, max_v: int) -> int:
+    """Value-plane count for the inclusive range [min, max]."""
+    if max_v < min_v:
+        raise PilosaError("field max must be >= min")
+    return (max_v - min_v).bit_length()
+
+
+@dataclass
+class ValCount:
+    """A Sum/Min/Max aggregate result: ``value`` plus how many columns
+    contributed (for Min/Max: how many columns hold the extreme).
+    ``count == 0`` means no column matched (value is meaningless)."""
+    value: int = 0
+    count: int = 0
+
+    def to_json(self) -> dict:
+        return {"value": self.value, "count": self.count}
+
+
+def clamp(op: str, predicate, min_v: int, max_v: int):
+    """Normalize a comparison against the field's [min, max] domain.
+
+    Returns ``"none"`` (no column can match), ``"all"`` (every column
+    with a value matches — the existence row), or ``(op, upred)`` with
+    the predicate shifted into unsigned offset space. ``><`` returns
+    ``("><", (ulo, uhi))`` with both bounds clamped into the domain.
+    """
+    if op == "><":
+        lo, hi = predicate
+        if lo > hi or hi < min_v or lo > max_v:
+            return "none"
+        if lo <= min_v and hi >= max_v:
+            return "all"
+        return op, (max(lo, min_v) - min_v, min(hi, max_v) - min_v)
+    p = predicate
+    if op == "<":
+        if p <= min_v:
+            return "none"
+        if p > max_v:
+            return "all"
+    elif op == "<=":
+        if p < min_v:
+            return "none"
+        if p >= max_v:
+            return "all"
+    elif op == ">":
+        if p >= max_v:
+            return "none"
+        if p < min_v:
+            return "all"
+    elif op == ">=":
+        if p > max_v:
+            return "none"
+        if p <= min_v:
+            return "all"
+    elif op == "==":
+        if p < min_v or p > max_v:
+            return "none"
+    elif op == "!=":
+        if p < min_v or p > max_v:
+            return "all"
+    else:
+        raise PilosaError(f"invalid range operator: {op!r}")
+    return op, p - min_v
+
+
+def _eq_lt_exprs(upred: int, depth: int, leaf) -> tuple:
+    """(eq, lt) circuit pair: eq = columns whose offset value equals
+    ``upred``; lt = columns strictly below it. One MSB→LSB pass — the
+    classic bit-sliced comparison (fragment.go fieldRangeLT shape)."""
+    eq = leaf(EXISTS_PLANE)
+    lt = None
+    for i in reversed(range(depth)):
+        plane = leaf(i)
+        if (upred >> i) & 1:
+            term = ("andnot", eq, plane)  # equal so far, bit 0 < 1
+            lt = term if lt is None else ("or", lt, term)
+            eq = ("and", eq, plane)
+        else:
+            eq = ("andnot", eq, plane)  # a 1 here exceeds the predicate
+    return eq, lt
+
+
+def _eq_gt_exprs(upred: int, depth: int, leaf) -> tuple:
+    eq = leaf(EXISTS_PLANE)
+    gt = None
+    for i in reversed(range(depth)):
+        plane = leaf(i)
+        if (upred >> i) & 1:
+            eq = ("and", eq, plane)
+        else:
+            term = ("and", eq, plane)  # equal so far, bit 1 > 0
+            gt = term if gt is None else ("or", gt, term)
+            eq = ("andnot", eq, plane)
+    return eq, gt
+
+
+def compare_expr(op: str, upred, depth: int,
+                 leaf: Callable[[int], tuple]) -> Optional[tuple]:
+    """The comparison circuit as an executor expression tree.
+
+    ``op``/``upred`` must already be clamped into offset space (see
+    ``clamp``; "none"/"all" never reach here). ``leaf(i)`` yields the
+    leaf expression of value plane ``i`` (``EXISTS_PLANE`` for the
+    existence row); it is called at most once per plane per side, so a
+    plain list-appending closure stays linear. Returns None for a
+    provably-empty circuit (e.g. ``< 0`` in offset space).
+    """
+    if op == "==":
+        return _eq_lt_exprs(upred, depth, leaf)[0]
+    if op == "!=":
+        eq = _eq_lt_exprs(upred, depth, leaf)[0]
+        return ("andnot", leaf(EXISTS_PLANE), eq)
+    if op == "<":
+        return _eq_lt_exprs(upred, depth, leaf)[1]
+    if op == "<=":
+        eq, lt = _eq_lt_exprs(upred, depth, leaf)
+        return eq if lt is None else ("or", lt, eq)
+    if op == ">":
+        return _eq_gt_exprs(upred, depth, leaf)[1]
+    if op == ">=":
+        eq, gt = _eq_gt_exprs(upred, depth, leaf)
+        return eq if gt is None else ("or", gt, eq)
+    if op == "><":
+        ulo, uhi = upred
+        ge = compare_expr(">=", ulo, depth, leaf)
+        le = compare_expr("<=", uhi, depth, leaf)
+        if ge is None or le is None:
+            return None
+        return ("and", ge, le)
+    raise PilosaError(f"invalid range operator: {op!r}")
+
+
+def eval_bitmap_expr(expr: tuple, leaf_fn: Callable[[int], object]):
+    """Evaluate a circuit over result Bitmaps (storage.bitmap.Bitmap —
+    or anything with intersect/union/difference): the host per-slice
+    backend. ``leaf_fn(i)`` materializes leaf ``i``."""
+    op = expr[0]
+    if op == "leaf":
+        return leaf_fn(expr[1])
+    a = eval_bitmap_expr(expr[1], leaf_fn)
+    b = eval_bitmap_expr(expr[2], leaf_fn)
+    if op == "and":
+        return a.intersect(b)
+    if op == "or":
+        return a.union(b)
+    return a.difference(b)
+
+
+def range_bitmap(op: str, predicate, min_v: int, max_v: int,
+                 row: Callable[[int], object]):
+    """One slice's Range(field OP predicate) result Bitmap.
+
+    ``row(i)`` returns the Bitmap of circuit plane ``i``
+    (``EXISTS_PLANE`` = existence). Returns None for a provably-empty
+    result (the caller supplies its empty-Bitmap type).
+    """
+    clamped = clamp(op, predicate, min_v, max_v)
+    if clamped == "none":
+        return None
+    if clamped == "all":
+        return row(EXISTS_PLANE)
+    cop, upred = clamped
+    expr = compare_expr(cop, upred, bit_depth(min_v, max_v),
+                        lambda i: ("leaf", i))
+    if expr is None:
+        return None
+    return eval_bitmap_expr(expr, row)
+
+
+def sum_count(min_v: int, max_v: int, row: Callable[[int], object],
+              filter=None) -> ValCount:
+    """Popcount-weighted plane fold: Sum = min*count + Σ 2^i · |plane_i
+    ∩ filter| (plane bits are subsets of the existence row, so no
+    explicit existence intersect is needed per plane)."""
+    exists = row(EXISTS_PLANE)
+    if filter is None:
+        count = exists.count()
+    else:
+        count = exists.intersection_count(filter)
+    if count == 0:
+        return ValCount(0, 0)
+    total = min_v * count
+    for i in range(bit_depth(min_v, max_v)):
+        plane = row(i)
+        n = plane.count() if filter is None \
+            else plane.intersection_count(filter)
+        total += n << i
+    return ValCount(total, count)
+
+
+def min_max(min_v: int, max_v: int, row: Callable[[int], object],
+            filter=None, want_min: bool = True) -> ValCount:
+    """Extreme value among columns with a value (∩ filter), plus how
+    many columns hold it: MSB→LSB, keep the sub-population that can
+    still be extreme at each plane."""
+    b = row(EXISTS_PLANE)
+    if filter is not None:
+        b = b.intersect(filter)
+    if b.count() == 0:
+        return ValCount(0, 0)
+    value = 0
+    for i in reversed(range(bit_depth(min_v, max_v))):
+        plane = row(i)
+        if want_min:
+            z = b.difference(plane)
+            if z.count():
+                b = z  # someone has bit 0: the minimum does too
+            else:
+                value |= 1 << i  # every candidate has this bit set
+        else:
+            z = b.intersect(plane)
+            if z.count():
+                b = z
+                value |= 1 << i
+    return ValCount(value + min_v, b.count())
+
+
+def combine_sum(a: ValCount, b: ValCount) -> ValCount:
+    return ValCount(a.value + b.value, a.count + b.count)
+
+
+def combine_min_max(a: ValCount, b: ValCount,
+                    want_min: bool = True) -> ValCount:
+    """Cluster mapReduce merge of per-slice Min/Max partials: empty
+    sides (count == 0) are identity; equal extremes sum their counts."""
+    if a.count == 0:
+        return b
+    if b.count == 0:
+        return a
+    if a.value == b.value:
+        return ValCount(a.value, a.count + b.count)
+    keep_a = a.value < b.value if want_min else a.value > b.value
+    return a if keep_a else b

@@ -626,6 +626,13 @@ class Fragment:
             cols % np.uint64(SLICE_WIDTH))
         self.import_positions(positions)
 
+    def clear_positions(self, positions: np.ndarray) -> np.ndarray:
+        """Batched clear of slice-local bit positions through the WAL'd
+        batch engine (the BSI value-import lane clears stale planes of
+        re-imported columns with it). Returns the changed positions."""
+        return self._mutate_batch_positions(
+            np.asarray(positions, dtype=np.uint64), set=False)
+
     def import_positions(self, positions: np.ndarray) -> None:
         """Bulk import of slice-local bit positions (row*SLICE_WIDTH +
         col%SLICE_WIDTH) — the frame-level packed-sort import lane
@@ -633,8 +640,15 @@ class Fragment:
         vector, so no per-fragment re-sort happens here (add_many's
         is-sorted check passes on that lane)."""
         positions = np.asarray(positions, dtype=np.uint64)
-        if (len(positions) * 16 < len(self.storage.keys)
-                and self.storage.op_writer is not None):
+        # Gate read under _mu: op_writer is swapped by snapshot/restore
+        # code, and although every such path restores it under the same
+        # _mu hold today, that invariant is one refactor away from
+        # breaking silently (ADVICE r5 #3) — the lock is noise next to
+        # the import itself.
+        with self._mu:
+            small = (len(positions) * 16 < len(self.storage.keys)
+                     and self.storage.op_writer is not None)
+        if small:
             # Small import into a large fragment: the WAL'd batch engine
             # is strictly cheaper than the detach-then-full-snapshot
             # import contract (a 3-bit /import into a 400 K-container

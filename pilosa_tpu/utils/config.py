@@ -11,8 +11,20 @@ from __future__ import annotations
 
 import os
 import re
-import tomllib
 from dataclasses import dataclass, field
+
+try:
+    import tomllib  # Python 3.11+
+except ModuleNotFoundError:  # pragma: no cover - version-dependent
+    try:
+        import tomli as tomllib  # the 3.10 backport, if installed
+    except ModuleNotFoundError:
+        # No TOML parser on this interpreter: everything except
+        # --config (defaults, env, flags) still works — fail only if a
+        # config FILE is actually requested, not at import time (the
+        # unconditional import broke every CLI/server entry point on
+        # 3.10 containers).
+        tomllib = None
 
 DEFAULT_HOST = "localhost"
 DEFAULT_PORT = "10101"
@@ -92,6 +104,10 @@ def load(path: str = "", env: dict | None = None) -> Config:
     """Defaults ← TOML file ← PILOSA_* env (cmd/root.go:99-153)."""
     cfg = Config()
     if path:
+        if tomllib is None:
+            raise RuntimeError(
+                "config file given but no TOML parser is available"
+                " (needs Python 3.11+ tomllib or the tomli package)")
         with open(path, "rb") as f:
             data = tomllib.load(f)
         cfg.data_dir = data.get("data-dir", cfg.data_dir)

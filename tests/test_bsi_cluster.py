@@ -1,0 +1,157 @@
+"""Distributed BSI: a REAL 2-node gossip cluster (replicas=1, so
+slices — and therefore field bit-planes — split across the nodes) must
+answer Range and Sum/Min/Max with per-slice partial aggregates merged
+across nodes, matching a dict-of-ints model from EITHER node. Covers
+the ImportValue owner fan-out, the Range/aggregate remote legs and
+their ValCount wire form, and SetFieldValue write forwarding."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+from podenv import cpu_env, free_port, wait_up  # noqa: E402
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+
+
+def _post(host: str, path: str, body: bytes) -> bytes:
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    return urllib.request.urlopen(req, timeout=30).read()
+
+
+def _query(host: str, body: str):
+    return json.loads(_post(host, "/index/bc/query",
+                            body.encode()))["results"]
+
+
+def test_two_node_range_and_aggregate_merge(tmp_path):
+    pa, pb = free_port(), free_port()
+    ga, gb = free_port(), free_port()
+    hosts = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+    procs = []
+    logs = []
+
+    def spawn(name, port, internal, seed=""):
+        d = tmp_path / name
+        d.mkdir(exist_ok=True)
+        env = cpu_env()
+        env["PILOSA_TPU_MESH"] = "0"
+        log = open(tmp_path / f"{name}.log", "a")
+        logs.append(log)
+        argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                "-d", str(d), "-b", f"127.0.0.1:{port}",
+                "--cluster.type", "gossip",
+                "--cluster.hosts", hosts,
+                "--cluster.replicas", "1",
+                "--cluster.internal-port", str(internal),
+                "--anti-entropy.interval", "300s"]
+        if seed:
+            argv += ["--cluster.gossip-seed", seed]
+        p = subprocess.Popen(argv, env=env, stdout=log, stderr=log,
+                             cwd=os.path.dirname(_HERE))
+        procs.append(p)
+        wait_up(f"127.0.0.1:{port}")
+        return f"127.0.0.1:{port}"
+
+    try:
+        host_a = spawn("a", pa, ga)
+        host_b = spawn("b", pb, gb, seed=f"127.0.0.1:{ga}")
+        _post(host_a, "/index/bc", b"{}")
+        _post(host_a, "/index/bc/frame/f", b"{}")
+        _post(host_a, "/index/bc/frame/f/field/v",
+              json.dumps({"min": -100, "max": 1000}).encode())
+
+        from pilosa_tpu.cluster.client import Client
+        client = Client(host_a)
+
+        # Values spanning 4 slices: with replicas=1 the owner fan-out
+        # necessarily lands planes on BOTH nodes.
+        rng = np.random.default_rng(17)
+        n_cols = 4 * SLICE_WIDTH
+        cols = rng.choice(n_cols, size=400, replace=False) \
+            .astype(np.uint64)
+        vals = rng.integers(-100, 1001, len(cols)).astype(np.int64)
+        client.import_field_values("bc", "f", "v", cols, vals)
+        model = dict(zip(cols.tolist(), vals.tolist()))
+
+        # Both nodes hold SOME of the field's fragments but not all
+        # (otherwise the merge below proves nothing).
+        def field_slices(host):
+            d = tmp_path / ("a" if host == host_a else "b")
+            frag_dir = d / "bc" / "f" / "views" / "field_v" / "fragments"
+            return (sorted(int(p) for p in os.listdir(frag_dir))
+                    if frag_dir.exists() else [])
+        sa, sb = field_slices(host_a), field_slices(host_b)
+        assert sa and sb, (sa, sb)
+        assert set(sa) | set(sb) == {0, 1, 2, 3}
+        assert set(sa) != {0, 1, 2, 3} and set(sb) != {0, 1, 2, 3}
+
+        # Cross-node slice discovery is an async broadcast: wait until
+        # node-side Sum counts converge before exact assertions.
+        want_count = len(model)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            got = [_query(h, 'Sum(frame="f", field="v")')[0]["count"]
+                   for h in (host_a, host_b)]
+            if got == [want_count, want_count]:
+                break
+            time.sleep(0.3)
+
+        for host in (host_a, host_b):
+            s = _query(host, 'Sum(frame="f", field="v")')[0]
+            assert s == {"value": sum(model.values()),
+                         "count": len(model)}, host
+            m = _query(host, 'Min(frame="f", field="v")')[0]
+            assert m["value"] == min(model.values()), host
+            m = _query(host, 'Max(frame="f", field="v")')[0]
+            assert m["value"] == max(model.values()), host
+            got = _query(host, 'Range(frame="f", v >= 500)')[0]["bits"]
+            assert sorted(got) == sorted(
+                c for c, v in model.items() if v >= 500), host
+            n = _query(host, 'Count(Range(frame="f", v < 0))')[0]
+            assert n == sum(1 for v in model.values() if v < 0), host
+
+        # SetFieldValue through node B for a column node A owns (and
+        # vice versa): the write must forward to the owner, and both
+        # nodes must see the new value in every aggregate.
+        for host in (host_a, host_b):
+            c = int(cols[0])
+            res = _query(host, f'SetFieldValue(frame="f",'
+                               f' columnID={c}, v=777)')
+            model[c] = 777
+            assert res[0] in (True, False)
+            c = int(cols[1])
+            res = _query(host, f'SetFieldValue(frame="f",'
+                               f' columnID={c}, v=-100)')
+            model[c] = -100
+        for host in (host_a, host_b):
+            s = _query(host, 'Sum(frame="f", field="v")')[0]
+            assert s == {"value": sum(model.values()),
+                         "count": len(model)}, host
+            got = _query(host, 'Range(frame="f", v == 777)')[0]["bits"]
+            assert sorted(got) == sorted(
+                c for c, v in model.items() if v == 777), host
+    finally:
+        for p in procs:
+            try:
+                p.send_signal(signal.SIGINT)
+            except OSError:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()
