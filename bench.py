@@ -231,6 +231,19 @@ def main() -> None:
                 line["write_path"] = json.load(f)
         except (OSError, ValueError, KeyError):
             pass
+        # Serving-quality artifact (sched subsystem): open-loop
+        # latency under load vs the admission cap
+        # (benchmarks/latency_under_load.py → LATENCY.json).
+        try:
+            with open(os.path.join(os.path.dirname(_BASELINE_PATH),
+                                   "LATENCY.json")) as f:
+                lat = json.load(f)
+                line["latency_under_load"] = {
+                    "below_cap_p99_ms": lat["below_cap"]["p99_ms"],
+                    "above_cap_p99_ms": lat["above_cap"]["p99_ms"],
+                    "above_cap_rejected": lat["above_cap"]["rejected"]}
+        except (OSError, ValueError, KeyError):
+            pass
         # Roofline accounting (VERDICT r4 item 4): effective HBM GB/s of
         # THIS run's number (arithmetic, a measurement) + the untunneled
         # v5e-8 projections for configs 4-5 (labeled projections, from
@@ -325,5 +338,11 @@ def _pin_host_baseline(bits: int, k_rows: int, host_s: float) -> float:
 if __name__ == "__main__":
     if "--device-worker" in sys.argv[1:]:
         device_worker()
+    elif "--latency-under-load" in sys.argv[1:]:
+        # Open-loop latency-under-load benchmark (sched subsystem):
+        # fixed arrival rates below/above the admission cap, p50/p99 +
+        # rejected count into benchmarks/LATENCY.json + MANIFEST.json.
+        from benchmarks import latency_under_load
+        latency_under_load.main()
     else:
         main()

@@ -1,0 +1,253 @@
+"""Open-loop latency under load: p50/p99 + rejected count vs the
+admission cap.
+
+Serving quality under overload is decided by admission policy, not
+kernel speed: a server without a bounded queue answers saturating
+load with unbounded queueing (latency grows without limit), while the
+sched subsystem's admission controller answers it with fast 429s and
+keeps the admitted requests' latency flat. This benchmark measures
+exactly that contract against an in-process server:
+
+1. measure the server's closed-loop service rate for the probe query,
+2. run two OPEN-LOOP phases at fixed arrival rates — below (~0.4×)
+   and above (~3×) the measured capacity — where requests fire on a
+   fixed schedule regardless of completions (so queueing delay shows
+   up as latency, the open-loop property closed-loop benchmarks hide),
+3. record per-phase p50/p99 of successful requests, the 429 count,
+   and throughput into benchmarks/LATENCY.json, and fold the headline
+   numbers into benchmarks/MANIFEST.json alongside the roofline
+   artifacts.
+
+Latency is measured from the SCHEDULED send time (open-loop
+accounting: sender-pool delay counts as latency). Run directly
+(``python -m benchmarks.latency_under_load``) or via ``python
+bench.py --latency-under-load``.
+
+Env knobs: PILOSA_LUL_CONCURRENCY (admission cap, default 4),
+PILOSA_LUL_QUEUE_DEPTH (default 8), PILOSA_LUL_PHASE_S (seconds per
+phase, default 3), PILOSA_LUL_MAX_RPS (arrival-rate clamp, default
+250).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_DIR))
+
+# The benchmark measures the admission/queueing layer, not the device:
+# keep the serving path deterministic and CPU-local.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PILOSA_TPU_MESH"] = "0"
+os.environ["PILOSA_TPU_WARMUP"] = "0"
+
+PROBE_QUERY = ("Count(Union(" + ", ".join(
+    f'Bitmap(frame="f", rowID={r})' for r in range(32)) + "))").encode()
+
+
+def _post(host: str, path: str, body: bytes = b"",
+          timeout: float = 30.0):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _percentile(sorted_vals: list[float], p: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1,
+            max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def _start_server(tmp_dir: str, concurrency: int, queue_depth: int):
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.server.server import Server
+    from pilosa_tpu.utils.config import QueryConfig
+    import numpy as np
+
+    server = Server(tmp_dir, host="127.0.0.1:0",
+                    anti_entropy_interval=0, polling_interval=0,
+                    query_config=QueryConfig(concurrency=concurrency,
+                                             queue_depth=queue_depth))
+    server.open()
+    _post(server.host, "/index/i", b"{}")
+    _post(server.host, "/index/i/frame/f", b"{}")
+    # 32 rows × 4 slices of bits: enough per-query work that the probe
+    # exercises a real fold, small enough to build instantly.
+    idx = server.holder.index("i")
+    frame = idx.frame("f")
+    rng = np.random.default_rng(7)
+    for r in range(32):
+        cols = rng.choice(4 * SLICE_WIDTH, size=2000,
+                          replace=False).astype(np.uint64)
+        frame.import_bits(np.full(len(cols), r, np.uint64), cols, None)
+    return server
+
+
+def _measure_capacity_rps(host: str, seconds: float = 1.0) -> float:
+    """Closed-loop sequential service rate of the probe query."""
+    _post(host, "/index/i/query", PROBE_QUERY)  # warm
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        _post(host, "/index/i/query", PROBE_QUERY)
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def _run_phase(host: str, rate_rps: float, duration_s: float,
+               n_senders: int = 64) -> dict:
+    """Fixed-arrival-rate open loop: one request every 1/rate seconds,
+    fired by a sender pool; latency counts from the SCHEDULED time."""
+    n_requests = max(1, int(rate_rps * duration_s))
+    interval = 1.0 / rate_rps
+    latencies: list[float] = []
+    rejected = 0
+    errors = 0
+    mu = threading.Lock()
+    ticket = {"i": 0}
+    t0 = time.perf_counter() + 0.05  # let senders reach the gate
+
+    def sender():
+        nonlocal rejected, errors
+        while True:
+            with mu:
+                i = ticket["i"]
+                if i >= n_requests:
+                    return
+                ticket["i"] = i + 1
+            scheduled = t0 + i * interval
+            delay = scheduled - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                _post(host, "/index/i/query", PROBE_QUERY)
+                lat = time.perf_counter() - scheduled
+                with mu:
+                    latencies.append(lat)
+            except urllib.error.HTTPError as e:
+                with mu:
+                    if e.code == 429:
+                        rejected += 1
+                    else:
+                        errors += 1
+                e.read()
+            except OSError:
+                with mu:
+                    errors += 1
+
+    threads = [threading.Thread(target=sender)
+               for _ in range(min(n_senders, n_requests))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    latencies.sort()
+    return {
+        "rate_rps": round(rate_rps, 1),
+        "duration_s": duration_s,
+        "offered": n_requests,
+        "completed": len(latencies),
+        "rejected": rejected,
+        "errors": errors,
+        "p50_ms": round(_percentile(latencies, 50) * 1e3, 3),
+        "p99_ms": round(_percentile(latencies, 99) * 1e3, 3),
+    }
+
+
+def run() -> dict:
+    concurrency = int(os.environ.get("PILOSA_LUL_CONCURRENCY", "4"))
+    queue_depth = int(os.environ.get("PILOSA_LUL_QUEUE_DEPTH", "8"))
+    phase_s = float(os.environ.get("PILOSA_LUL_PHASE_S", "3"))
+    max_rps = float(os.environ.get("PILOSA_LUL_MAX_RPS", "250"))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        server = _start_server(tmp, concurrency, queue_depth)
+        try:
+            capacity = _measure_capacity_rps(server.host)
+            below_rate = min(max_rps, max(2.0, 0.4 * capacity))
+            above_rate = min(max_rps, max(below_rate * 2, 3.0 * capacity))
+            below = _run_phase(server.host, below_rate, phase_s)
+            time.sleep(0.5)  # drain between phases
+            above = _run_phase(server.host, above_rate, phase_s)
+            admission = server.admission.snapshot()
+        finally:
+            server.close()
+
+    out = {
+        "written_by": "benchmarks/latency_under_load.py",
+        "note": "Open-loop fixed-arrival-rate latency through the full"
+                " HTTP + admission stack (sched subsystem). Latency is"
+                " measured from the scheduled send time; 'rejected'"
+                " counts 429 answers. Above the cap the server must"
+                " reject, not queue unboundedly: p99 of ADMITTED"
+                " requests stays bounded while 'rejected' absorbs the"
+                " overload.",
+        "config": {"concurrency": concurrency,
+                   "queue_depth": queue_depth,
+                   "probe": "Count(Union over 32 rows, 4 slices)",
+                   "closed_loop_capacity_rps": round(capacity, 1)},
+        "below_cap": below,
+        "above_cap": above,
+        "admission": admission,
+    }
+    path = os.path.join(_DIR, "LATENCY.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    _fold_into_manifest(out)
+    return out
+
+
+def _fold_into_manifest(result: dict) -> None:
+    """Record the headline numbers in benchmarks/MANIFEST.json next to
+    the roofline artifacts (LATENCY.json stays the canonical file)."""
+    path = os.path.join(_DIR, "MANIFEST.json")
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        manifest = {"canonical_artifacts": {}, "metrics": {}}
+    manifest.setdefault("canonical_artifacts", {})[
+        "latency_under_load"] = "LATENCY.json"
+    metrics = manifest.setdefault("metrics", {})
+    for phase in ("below_cap", "above_cap"):
+        r = result[phase]
+        metrics[f"latency_{phase}_p50"] = {
+            "value": r["p50_ms"], "unit": "ms",
+            "rate_rps": r["rate_rps"]}
+        metrics[f"latency_{phase}_p99"] = {
+            "value": r["p99_ms"], "unit": "ms",
+            "rate_rps": r["rate_rps"]}
+        metrics[f"latency_{phase}_rejected"] = {
+            "value": r["rejected"], "unit": "requests",
+            "offered": r["offered"]}
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def main() -> None:
+    out = run()
+    print(json.dumps({
+        "metric": "latency_under_load",
+        "below_cap_p50_ms": out["below_cap"]["p50_ms"],
+        "below_cap_p99_ms": out["below_cap"]["p99_ms"],
+        "below_cap_rejected": out["below_cap"]["rejected"],
+        "above_cap_p50_ms": out["above_cap"]["p50_ms"],
+        "above_cap_p99_ms": out["above_cap"]["p99_ms"],
+        "above_cap_rejected": out["above_cap"]["rejected"],
+        "capacity_rps": out["config"]["closed_loop_capacity_rps"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,6 +65,7 @@ _CANONICAL_ARTIFACTS = {
     "pallas_ab": "PALLAS_AB.json",
     "densify": "DENSIFY.json",
     "host_baselines": "HOST_BASELINE.json",
+    "latency_under_load": "LATENCY.json",
 }
 
 

@@ -219,6 +219,14 @@ def load_server_config(args, env=None):
         cfg.cluster.polling_interval = args.cluster_poll_interval
     if getattr(args, "anti_entropy_interval", None) is not None:
         cfg.anti_entropy_interval = args.anti_entropy_interval
+    if getattr(args, "query_concurrency", None) is not None:
+        cfg.query.concurrency = args.query_concurrency
+    if getattr(args, "query_queue_depth", None) is not None:
+        cfg.query.queue_depth = args.query_queue_depth
+    if getattr(args, "query_default_timeout", None) is not None:
+        cfg.query.default_timeout = args.query_default_timeout
+    if getattr(args, "query_slow_threshold", None) is not None:
+        cfg.query.slow_threshold = args.query_slow_threshold
     return cfg
 
 
@@ -262,7 +270,7 @@ def cmd_server(args, stdout, stderr) -> int:
                     cluster=cluster, broadcast_receiver=broadcast_receiver,
                     anti_entropy_interval=cfg.anti_entropy_interval,
                     polling_interval=cfg.cluster.polling_interval,
-                    logger=logger)
+                    logger=logger, query_config=cfg.query)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -531,6 +539,25 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--cluster.poll-interval", dest="cluster_poll_interval",
                    type=parse_duration, default=None, metavar="DUR",
                    help="max-slice polling interval (e.g. 60s)")
+    # Query lifecycle flags (sched subsystem; docs/SCHEDULING.md).
+    s.add_argument("--query.concurrency", dest="query_concurrency",
+                   type=int, default=None, metavar="N",
+                   help="max queries executing concurrently"
+                        " (admission cap, default 16)")
+    s.add_argument("--query.queue-depth", dest="query_queue_depth",
+                   type=int, default=None, metavar="N",
+                   help="max queries waiting for a slot before the"
+                        " server answers 429 (default 64)")
+    s.add_argument("--query.default-timeout",
+                   dest="query_default_timeout", type=parse_duration,
+                   default=None, metavar="DUR",
+                   help="deadline applied to queries that carry no"
+                        " ?timeout= or X-Pilosa-Deadline (0 = none)")
+    s.add_argument("--query.slow-threshold",
+                   dest="query_slow_threshold", type=parse_duration,
+                   default=None, metavar="DUR",
+                   help="log queries slower than this with per-stage"
+                        " timings (0 = disabled)")
     s.add_argument("--anti-entropy.interval", dest="anti_entropy_interval",
                    type=parse_duration, default=None, metavar="DUR",
                    help="anti-entropy sweep interval (e.g. 10m)")

@@ -1,7 +1,8 @@
 """Broadcast abstraction: cluster membership + schema-mutation messaging.
 
 Reference: broadcast.go + httpbroadcast/messenger.go. The control plane
-carries five message kinds (create-slice/index/frame, delete-index/frame)
+carries the reference's five message kinds (create-slice/index/frame,
+delete-index/frame) plus the sched subsystem's query-cancel message
 as a 1-byte type tag + protobuf envelope (broadcast.go:109-166). Backends:
 ``static`` (fixed node list, no messaging), ``http`` (direct POST of the
 envelope to each peer's internal port). The data plane (queries, imports,
@@ -27,6 +28,31 @@ MESSAGE_TYPE_CREATE_INDEX = 2
 MESSAGE_TYPE_DELETE_INDEX = 3
 MESSAGE_TYPE_CREATE_FRAME = 4
 MESSAGE_TYPE_DELETE_FRAME = 5
+MESSAGE_TYPE_CANCEL_QUERY = 6
+
+
+class CancelQueryMessage:
+    """Cluster-wide query cancellation (sched subsystem): the envelope
+    body is the raw query id, so this rides the same 1-byte-tag wire
+    format as the protobuf control messages without a schema change —
+    it duck-types the SerializeToString/FromString pair
+    marshal/unmarshal use."""
+
+    __slots__ = ("id",)
+
+    def __init__(self, id: str = ""):
+        self.id = id
+
+    def SerializeToString(self) -> bytes:  # noqa: N802 - protobuf parity
+        return self.id.encode()
+
+    @classmethod
+    def FromString(cls, raw: bytes) -> "CancelQueryMessage":  # noqa: N802
+        return cls(raw.decode())
+
+    def __repr__(self) -> str:
+        return f"CancelQueryMessage(id={self.id!r})"
+
 
 _TYPE_BY_CLASS = {
     pb.CreateSliceMessage: MESSAGE_TYPE_CREATE_SLICE,
@@ -34,6 +60,7 @@ _TYPE_BY_CLASS = {
     pb.DeleteIndexMessage: MESSAGE_TYPE_DELETE_INDEX,
     pb.CreateFrameMessage: MESSAGE_TYPE_CREATE_FRAME,
     pb.DeleteFrameMessage: MESSAGE_TYPE_DELETE_FRAME,
+    CancelQueryMessage: MESSAGE_TYPE_CANCEL_QUERY,
 }
 _CLASS_BY_TYPE = {v: k for k, v in _TYPE_BY_CLASS.items()}
 

@@ -12,6 +12,7 @@ import http.client
 import json
 import random
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Optional
@@ -20,7 +21,8 @@ import numpy as np
 
 from .. import SLICE_WIDTH
 from ..utils.arrays import group_by_key
-from ..errors import FragmentNotFoundError, PilosaError
+from ..errors import (FragmentNotFoundError, PilosaError,
+                      QueryDeadlineError)
 from ..pql import parser as pql
 from ..proto import internal_pb2 as pb
 from .topology import Node
@@ -118,19 +120,40 @@ class Client:
 
     def _do(self, method: str, path: str, body: Optional[bytes] = None,
             headers: Optional[dict] = None, host: Optional[str] = None,
-            idempotent: Optional[bool] = None) -> tuple[int, bytes]:
+            idempotent: Optional[bool] = None,
+            deadline_s: Optional[float] = None) -> tuple[int, bytes]:
         """``idempotent`` overrides the per-method default for POST
         endpoints that are safe to replay (queries, attr diffs, create-
         if-not-exists) — those keep the transparent stale-keep-alive
-        retry; everything else (e.g. /import op-log appends) does not."""
+        retry; everything else (e.g. /import op-log appends) does not.
+
+        ``deadline_s`` is the query's remaining budget (sched
+        subsystem): every attempt's socket timeout is clamped to what
+        is left, and NO attempt — in particular no retry — starts once
+        the budget is exhausted (an attempt whose timeout exceeded the
+        remaining budget would overrun the caller's deadline). Budget
+        exhaustion surfaces as QueryDeadlineError, distinct from
+        ClientError so failover loops don't retry a dead query on a
+        replica."""
         target = host or self.host
         if idempotent is None:
             idempotent = method in self._IDEMPOTENT
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
         # File-like bodies (streaming restore) must rewind between
         # attempts — http.client reads them destructively.
         body_start = body.tell() if hasattr(body, "seek") else None
         last_err = None
         for attempt in range(2):
+            timeout = self.timeout
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise QueryDeadlineError(
+                        f"{method} http://{target}{path}: deadline"
+                        f" exceeded"
+                        + (f" (after {last_err})" if last_err else ""))
+                timeout = min(timeout, remaining)
             if body_start is not None:
                 body.seek(body_start)
             conn = None if attempt else self._conn_get(target)
@@ -138,9 +161,17 @@ class Client:
             if conn is None:
                 try:
                     conn = http.client.HTTPConnection(
-                        target, timeout=self.timeout)
+                        target, timeout=timeout)
                 except Exception as e:  # bad host string
                     raise ClientError(f"{method} http://{target}{path}: {e}")
+            else:
+                # Pooled sockets carry whatever timeout their LAST use
+                # armed (possibly a tiny clamped budget); re-arm every
+                # attempt — both to clamp to this request's budget and
+                # to restore the default for deadline-free requests.
+                conn.timeout = timeout
+                if conn.sock is not None:
+                    conn.sock.settimeout(timeout)
             sent = False
             try:
                 conn.request(method, path, body=body, headers=headers or {})
@@ -155,6 +186,14 @@ class Client:
             except (http.client.HTTPException, OSError) as e:
                 conn.close()
                 last_err = e
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    # The attempt consumed the rest of the budget (e.g.
+                    # a stalled peer ate the clamped socket timeout):
+                    # this is a deadline expiry, not a node failure.
+                    raise QueryDeadlineError(
+                        f"{method} http://{target}{path}: deadline"
+                        f" exceeded (after {e})")
                 if fresh:  # a fresh connection failing is a real error
                     break
                 if sent and not idempotent:
@@ -193,11 +232,21 @@ class Client:
 
     # -- queries (client.go:216-269) -----------------------------------------
 
+    # Marker the executor checks before passing lifecycle kwargs —
+    # scripted test fakes without the kwargs keep the plain call shape.
+    deadline_aware = True
+
     def execute_query(self, node, index: str, query: str,
                       slices: Optional[list[int]] = None,
                       remote: bool = True,
                       column_attrs: bool = False,
-                      pod_local: bool = False) -> list:
+                      pod_local: bool = False,
+                      deadline_s: Optional[float] = None,
+                      query_id: Optional[str] = None) -> list:
+        """``deadline_s``/``query_id`` propagate the coordinator's
+        REMAINING budget and query identity to the peer (sched wire
+        contract: X-Pilosa-Deadline / X-Pilosa-Query-Id), and clamp
+        this leg's socket timeouts + retry budget to the deadline."""
         from ..server import codec
         body = codec.encode_query_request(query, slices,
                                           column_attrs=column_attrs,
@@ -205,17 +254,36 @@ class Client:
         path = f"/index/{index}/query"
         if pod_local:  # pod-internal leg (parallel.pod)
             path += "?podLocal=true"
+        headers = {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF}
+        if deadline_s is not None:
+            headers["X-Pilosa-Deadline"] = f"{deadline_s:.6f}"
+        if query_id:
+            headers["X-Pilosa-Query-Id"] = query_id
         status, raw = self._do(
-            "POST", path, body,
-            {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF},
+            "POST", path, body, headers,
             host=_host_of(node) if node is not None else None,
-            idempotent=True)  # PQL writes set absolute state — replayable
+            idempotent=True,  # PQL writes set absolute state — replayable
+            deadline_s=deadline_s)
         self._ok(status, raw, "execute query")
         resp = pb.QueryResponse.FromString(raw)
         if resp.Err:
             raise ClientError(resp.Err)
         call_names = [c.name for c in pql.parse(query).calls]
         return codec.decode_query_results(resp, call_names)
+
+    def queries(self, host: Optional[str] = None) -> dict:
+        """GET /debug/queries: this node's in-flight queries + slow
+        log (sched.registry)."""
+        status, raw = self._do("GET", "/debug/queries", host=host)
+        return json.loads(self._ok(status, raw, "debug queries"))
+
+    def cancel_query(self, query_id: str,
+                     host: Optional[str] = None) -> dict:
+        """DELETE /debug/queries/{id}: cancel a query on this node;
+        the node re-broadcasts the cancel cluster-wide."""
+        status, raw = self._do("DELETE",
+                               f"/debug/queries/{query_id}", host=host)
+        return json.loads(self._ok(status, raw, "cancel query"))
 
     # -- schema / slices (client.go:63-136) ----------------------------------
 

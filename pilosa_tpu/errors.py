@@ -43,6 +43,20 @@ class SliceUnavailableError(PilosaError):
     (reference: executor.go:1239)."""
 
 
+class QueryDeadlineError(PilosaError):
+    """Raised when a query's deadline budget is exhausted — by the
+    executor's fan-out loops, the device dispatch layer, or the
+    cluster client's socket/retry machinery (sched.context). Maps to
+    HTTP 504; never triggers replica re-mapping (the query is dead,
+    not the node)."""
+
+
+class QueryCancelledError(PilosaError):
+    """Raised when a query is cancelled through the lifecycle API
+    (DELETE /debug/queries/{id}, propagated cluster-wide). Maps to
+    HTTP 409; never triggers replica re-mapping."""
+
+
 # Name/label rules (reference: pilosa.go:50-53).
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,64}$")
 _LABEL_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]{0,64}$")

@@ -36,7 +36,9 @@ import numpy as np
 
 from .cluster.topology import Cluster, Node, new_cluster
 from .errors import (TIME_FORMAT, FrameNotFoundError, IndexNotFoundError,
-                     PilosaError, QueryRequiredError, SliceUnavailableError)
+                     PilosaError, QueryCancelledError, QueryDeadlineError,
+                     QueryRequiredError, SliceUnavailableError)
+from .sched import context as sched_context
 from . import SLICE_WIDTH
 from .models.view import VIEW_INVERSE, VIEW_STANDARD
 from .pql.ast import Call, Query
@@ -63,9 +65,13 @@ class ExecOptions:
     local slices and don't re-forward (executor.go:1290-1292).
     pod_local=True marks a pod-internal leg (parallel.pod): run the
     plain local path over the given slices — no pod dispatch, no
-    pod-global collectives."""
+    pod-global collectives. ctx carries the query's lifecycle state
+    (sched.context.QueryContext: deadline budget + cancel flag) — every
+    fan-out layer checks it, remote legs inherit the REMAINING budget,
+    and None (internal/maintenance callers) means unbounded."""
     remote: bool = False
     pod_local: bool = False
+    ctx: Optional[object] = None
 
 
 def _needs_slices(calls: list[Call]) -> bool:
@@ -279,13 +285,26 @@ class Executor:
     def execute(self, index: str, query, slices: Optional[list[int]] = None,
                 opt: Optional[ExecOptions] = None,
                 _partial_out: Optional[list] = None) -> list:
+        opt = opt or ExecOptions()
+        if opt.ctx is None:
+            return self._execute(index, query, slices, opt, _partial_out)
+        # Lifecycle-bound query: check the budget up front and bind the
+        # context to this thread so layers without a ctx argument (the
+        # mesh device dispatch reached from this thread, e.g. the
+        # batched-Count lane) can check it too.
+        opt.ctx.check()
+        with sched_context.use(opt.ctx):
+            return self._execute(index, query, slices, opt, _partial_out)
+
+    def _execute(self, index: str, query, slices: Optional[list[int]],
+                 opt: ExecOptions,
+                 _partial_out: Optional[list] = None) -> list:
         if not index:
             raise PilosaError("index required")
         if isinstance(query, str):
             query = parse_pql(query)
         if not isinstance(query, Query):
             raise QueryRequiredError("query required")
-        opt = opt or ExecOptions()
 
         needs = _needs_slices(query.calls)
         inverse_slices: list[int] = []
@@ -310,6 +329,8 @@ class Executor:
         results = _partial_out if _partial_out is not None else []
         i = 0
         while i < len(query.calls):
+            if opt.ctx is not None:
+                opt.ctx.check()  # between calls of a multi-call query
             # Consecutive device-compilable Count calls fuse into ONE
             # mesh program — K counts, one dispatch (one sync).
             batch = self._count_batch_run(index, query.calls, i, slices,
@@ -364,6 +385,24 @@ class Executor:
             return self._execute_set_field_value(index, c, opt)
         return self._execute_bitmap_call(index, c, slices, opt)
 
+    def _owns_all_slices(self, index: str, slices: list[int]) -> bool:
+        """True when THIS node holds a replica of every slice the query
+        touches — the ownership gate that keeps the single-node fast
+        paths (materialized-result residency, the fused device count
+        fold, single-pass TopN) live on multi-node clusters for
+        locally-owned work (round-5 VERDICT: the old ``nodes != 1``
+        gates disabled them the moment a second node joined, even with
+        replica_n covering everything). Correctness rests on the write
+        path: every SetBit/import/anti-entropy leg applies to EVERY
+        replica owner, so an owned slice's local fragment (and its
+        mutation generation, for the residency keys) tracks all
+        writes."""
+        if len(self.cluster.nodes) == 1:
+            return True
+        host = self.host
+        owns = self.cluster.owns_fragment
+        return all(owns(host, index, s) for s in slices)
+
     # -- bitmap expressions (executor.go:192-570) ----------------------------
 
     # Materialized-result residency (VERDICT r4 item 5): completed
@@ -380,15 +419,18 @@ class Executor:
                            slices: list[int],
                            compiled_out: Optional[list] = None):
         """Cache key embedding every input fragment's mutation
-        generation, or None when the call/topology isn't cacheable
-        (single local node only: remote/pod peers' data generations
-        are invisible here, so a key could go stale silently). The
+        generation, or None when the call/topology isn't cacheable.
+        Multi-node clusters cache when this node OWNS every touched
+        slice (its local generations then see every replica-fanned
+        write); slices owned elsewhere have invisible generations, so
+        a key could go stale silently — those stay uncached. The
         compiled (expr, leaves) is appended to ``compiled_out`` so the
         device fold reuses it instead of re-walking the call tree
         (1000-child Unions pay the walk once, review r5)."""
         if c.name not in ("Union", "Intersect", "Difference"):
             return None
-        if self.pod is not None or len(self.cluster.nodes) != 1:
+        if self.pod is not None or not self._owns_all_slices(index,
+                                                             slices):
             return None
         leaves: list[tuple] = []
         expr = self._compile_device_expr(index, c, leaves)
@@ -942,15 +984,17 @@ class Executor:
         one mesh program over shared (deduplicated) leaf slabs — or
         None to fall back to per-call execution.
 
-        Only for the single-node serving shape (a pod counts as one
-        node: its coordinator dispatches the batch as ONE pod work
-        item): cluster map-reduce fans out per call, so batching there
-        would bypass its remote legs. Count calls never take the
-        inverse slice list (only Bitmap does), so every call in the
-        run shares ``slices``.
+        Requires every touched slice to be locally owned (a pod counts
+        as one node: its coordinator dispatches the batch as ONE pod
+        work item): cluster map-reduce fans out per call, so batching
+        a query with remote-only slices would bypass its remote legs —
+        but a node owning a replica of everything (the common
+        replica_n == nodes shape) answers the whole batch from local
+        fragments and keeps the fused device fold. Count calls never
+        take the inverse slice list (only Bitmap does), so every call
+        in the run shares ``slices``.
         """
-        if (not self.use_mesh or len(self.cluster.nodes) != 1
-                or len(slices) < self.mesh_min_slices):
+        if not self.use_mesh or len(slices) < self.mesh_min_slices:
             return None
         if self.pod is not None and (not self.pod.is_coordinator
                                      or opt.pod_local):
@@ -959,9 +1003,12 @@ class Executor:
             return None
         # Cheap necessary condition before any compile work: a run
         # needs ≥2 Counts, so a lone Count (the common query shape)
-        # must not pay a discarded device-expr compilation here.
+        # must not pay a discarded device-expr compilation (or the
+        # per-slice ownership walk below) here.
         if (start + 1 >= len(calls) or calls[start].name != "Count"
                 or calls[start + 1].name != "Count"):
+            return None
+        if not self._owns_all_slices(index, slices):
             return None
         from .parallel import mesh as mesh_mod
         shard, budget = self._count_budget(slices)
@@ -1519,14 +1566,17 @@ class Executor:
         rate-limited-stale and threshold-trimmed; the per-slice path
         reads them with its own staleness rules), caches must not have
         evicted (an evicted row's exact count needs the phase-2
-        recount), and any distribution (cluster peers, pod, remote
-        legs) keeps the fan-out path."""
+        recount), and pod / remote legs keep the fan-out path. On a
+        multi-node cluster the gate is OWNERSHIP, not cluster size:
+        when this node holds a replica of every slice, its local rank
+        caches cover the whole query (writes fan to every replica
+        owner) and the single-pass answer stands."""
         (frame_name, n, field, row_ids, min_threshold, filters,
          tanimoto) = self._topn_args(c)
         if (opt.remote or row_ids or len(c.children) > 0
                 or (field and filters) or tanimoto > 0
                 or self.pod is not None
-                or len(self.cluster.nodes) != 1):
+                or not self._owns_all_slices(index, slices)):
             return None
         from .storage.cache import LRUCache
         floor = max(min_threshold, 1)
@@ -2355,6 +2405,19 @@ class Executor:
         if self.client is None:
             raise SliceUnavailableError(
                 f"no client to reach remote node {node.host}")
+        ctx = opt.ctx
+        if ctx is not None and getattr(self.client, "deadline_aware",
+                                       False):
+            # The peer inherits the REMAINING budget (not the original)
+            # and the query id, so its leg registers under the same
+            # query and a cluster cancel finds it; the client clamps
+            # socket timeouts + its idempotent retry to the budget.
+            # Scripted test fakes without the marker keep the plain
+            # call shape.
+            ctx.check()
+            return self.client.execute_query(
+                node, index, str(query), slices, remote=True,
+                deadline_s=ctx.remaining(), query_id=ctx.id)
         return self.client.execute_query(node, index, str(query), slices,
                                          remote=True)
 
@@ -2372,6 +2435,18 @@ class Executor:
                 raise SliceUnavailableError(str(slice))
         return list(m.values())
 
+    # Wake tick of the fan-out wait loop for lifecycle-bound queries:
+    # bounds how long a cancellation or deadline expiry can go unseen
+    # while every leg is still in flight.
+    _CTX_POLL_S = 0.25
+    # Grace given to in-flight legs of a DEAD (expired/cancelled)
+    # query before abandoning them: each leg is ctx-checked per slice
+    # and its remote socket timeouts are clamped to the (now exhausted)
+    # budget, so abandoned legs self-terminate promptly — holding the
+    # caller (and its admission slot) for a stalled peer would defeat
+    # the deadline.
+    _DEAD_DRAIN_S = 0.5
+
     def _map_reduce(self, index: str, slices: list[int], c: Call,
                     opt: ExecOptions, map_fn: Callable,
                     reduce_fn: Callable, local_fn: Callable = None):
@@ -2381,6 +2456,23 @@ class Executor:
             nodes = [self.cluster.node_by_host(self.host)]
         else:
             nodes = list(self.cluster.nodes)
+
+        ctx = opt.ctx
+        if ctx is not None:
+            ctx.check()
+            # Every slice leg re-checks the budget on entry, so an
+            # expiry stops the per-slice map mid-fan-out instead of
+            # draining the whole slice list.
+            inner_map, inner_local = map_fn, local_fn
+
+            def map_fn(slice, _m=inner_map):
+                ctx.check()
+                return _m(slice)
+
+            if inner_local is not None:
+                def local_fn(batch, _l=inner_local):
+                    ctx.check()
+                    return _l(batch)
 
         result = None
         processed = 0
@@ -2394,15 +2486,31 @@ class Executor:
                                   node_slices, opt, map_fn, reduce_fn,
                                   local_fn)
                 futures[fut] = (node, node_slices)
+                if ctx is not None:
+                    ctx.add_leg(node.host, len(node_slices))
 
         submit(nodes, slices)
         try:
             while processed < len(slices):
-                done, _ = wait(list(futures), return_when=FIRST_COMPLETED)
+                if ctx is None:
+                    done, _ = wait(list(futures),
+                                   return_when=FIRST_COMPLETED)
+                else:
+                    # Deadline-driven cancellation: wake periodically
+                    # so an expiry or DELETE-cancel interrupts the
+                    # fan-out even while every leg is still running.
+                    ctx.check()
+                    done, _ = wait(list(futures),
+                                   timeout=self._CTX_POLL_S,
+                                   return_when=FIRST_COMPLETED)
                 for fut in done:
                     node, node_slices = futures.pop(fut)
                     try:
                         r = fut.result()
+                    except (QueryDeadlineError, QueryCancelledError):
+                        # The QUERY died, not the node: no replica
+                        # re-map — surface it (handler maps to 504/409).
+                        raise
                     except Exception as e:  # noqa: BLE001 - retry replicas
                         # Filter the failed node; re-map its slices onto
                         # surviving replicas (executor.go:1137-1151).
@@ -2418,27 +2526,42 @@ class Executor:
             # On an error path, drain what we started: the pool is
             # shared with other queries, and the old per-query pool's
             # exit joined its legs — keep that (cancel what hasn't
-            # started, wait out what has).
+            # started, wait out what has). A DEAD query's in-flight
+            # legs get a bounded grace instead: they are cooperatively
+            # cancelled (per-slice ctx checks, budget-clamped socket
+            # timeouts) and waiting a stalled peer out here would hold
+            # the executor slot past the deadline the caller paid for.
             pending = [f for f in futures if not f.cancel()]
             if pending:
-                wait(pending)
+                if ctx is not None and (ctx.cancelled()
+                                        or ctx.expired()):
+                    wait(pending, timeout=self._DEAD_DRAIN_S)
+                else:
+                    wait(pending)
         return result
 
     def _mapper_node(self, node: Node, index: str, c: Call,
                      slices: list[int], opt: ExecOptions, map_fn, reduce_fn,
                      local_fn=None):
-        if node.host == self.host:
-            if local_fn is not None:
-                r = local_fn(slices)
-                if r is not NotImplemented:
-                    return r
-            if (self.pod is not None and self.pod.is_coordinator
-                    and not opt.pod_local):
-                return self._pod_host_mapper(index, c, slices, opt,
-                                             map_fn, reduce_fn)
-            return self._mapper_local(slices, map_fn, reduce_fn)
-        results = self._exec_remote(node, index, Query([c]), slices, opt)
-        return results[0] if results else None
+        # Bind the query context to this worker thread so the device
+        # dispatch layer (parallel.mesh) and nested pool legs reached
+        # from here can check the budget without a ctx argument.
+        with sched_context.use(opt.ctx):
+            if opt.ctx is not None:
+                opt.ctx.check()
+            if node.host == self.host:
+                if local_fn is not None:
+                    r = local_fn(slices)
+                    if r is not NotImplemented:
+                        return r
+                if (self.pod is not None and self.pod.is_coordinator
+                        and not opt.pod_local):
+                    return self._pod_host_mapper(index, c, slices, opt,
+                                                 map_fn, reduce_fn)
+                return self._mapper_local(slices, map_fn, reduce_fn)
+            results = self._exec_remote(node, index, Query([c]), slices,
+                                        opt)
+            return results[0] if results else None
 
     def _pod_host_mapper(self, index: str, c: Call, slices: list[int],
                          opt: ExecOptions, map_fn, reduce_fn):
@@ -2458,7 +2581,7 @@ class Executor:
                                         map_fn, reduce_fn))
             else:
                 futs.append(pool.submit(self._exec_pod_remote, pid,
-                                        index, c, group))
+                                        index, c, group, opt.ctx))
         try:
             for fut in futs:
                 result = reduce_fn(result, fut.result())
@@ -2472,13 +2595,18 @@ class Executor:
         return result
 
     def _exec_pod_remote(self, pid: int, index: str, c: Call,
-                         slices: list[int]):
+                         slices: list[int], ctx=None):
         if self.client is None:
             raise SliceUnavailableError(
                 f"no client to reach pod process {pid}")
+        kwargs = {}
+        if ctx is not None and getattr(self.client, "deadline_aware",
+                                       False):
+            ctx.check()
+            kwargs = {"deadline_s": ctx.remaining(), "query_id": ctx.id}
         results = self.client.execute_query(
             Node(self.pod.peers[pid]), index, str(Query([c])), slices,
-            remote=True, pod_local=True)
+            remote=True, pod_local=True, **kwargs)
         return results[0] if results else None
 
     def _mapper_local(self, slices: list[int], map_fn, reduce_fn):

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from ..ops.kernels import _BITWISE
+from ..sched import context as sched_context
 
 AXIS_SLICES = "slices"
 AXIS_ROWS = "rows"
@@ -198,6 +199,7 @@ def densify_sharded(mesh: Mesh, lanes: np.ndarray, vals: np.ndarray,
     ``[S, (R,) subs*128]`` dense words. The cold-path replacement for
     packing dense host-side and shipping 4 bytes per word through the
     tunnel (the round-3 c5 first-query tax)."""
+    sched_context.check_current()
     dl = shard_slices(mesh, lanes)
     dv = shard_slices(mesh, vals)
     fn = _densify_sharded_fn(mesh, lanes.shape[:-2], lanes.shape[-2],
@@ -340,6 +342,7 @@ def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
     to the mesh and chunked at the hi/lo int32 bound, so any slice
     count works.
     """
+    sched_context.check_current()
     n_dev = mesh.shape[AXIS_SLICES]
     fn = count_expr_fn(mesh, expr)
     total = 0
@@ -415,6 +418,7 @@ def count_exprs_sharded(mesh: Mesh, exprs: tuple,
     (executor.go:135-142); the counts are independent, so fusing them
     is observationally identical. Same bounds as count_expr_sharded.
     """
+    sched_context.check_current()  # deadline gate before compile/dispatch
     if leaf_arrays[0].shape[0] > slice_chunk_bound(
             mesh.shape[AXIS_SLICES]):
         raise ValueError("count_exprs_sharded: slice count above the"
@@ -537,6 +541,7 @@ def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
     """TopN counts with per-slice threshold/Tanimoto pruning on device
     (see _topn_filtered_sharded_fn). Same residency contract as
     topn_exact_sharded."""
+    sched_context.check_current()
     if rows.shape[0] > slice_chunk_bound(mesh.shape[AXIS_SLICES]):
         raise ValueError("topn_filtered_sharded: slice count above the"
                          " int32 hi/lo bound")
@@ -554,6 +559,7 @@ def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
     slice axis, e.g. from the residency cache). Single program — the
     caller bounds n_slices (slice_chunk_bound) and the block bytes.
     """
+    sched_context.check_current()
     if rows.shape[0] > slice_chunk_bound(mesh.shape[AXIS_SLICES]):
         raise ValueError("topn_exact_sharded: slice count above the"
                          " int32 hi/lo bound — use topn_exact")
@@ -687,6 +693,7 @@ def materialize_expr_sharded(mesh: Mesh, expr,
     host for roaring repack. No psum → no slice-count bound; wide folds
     reduce associatively on device (_eval_expr's lax.reduce path).
     """
+    sched_context.check_current()
     fn = _materialize_fn(mesh, expr, len(leaf_arrays))
     return np.asarray(fn(*leaf_arrays))
 
@@ -721,6 +728,7 @@ def bsi_range_sharded(mesh: Mesh, op: str, upred, depth: int,
     depth reuse the compilation. ``op`` "><" takes ``upred = (lo,
     hi)`` in offset space; everything else a single offset predicate.
     """
+    sched_context.check_current()
     from ..ops import kernels
     if op == "><":
         lo, hi = upred
@@ -750,6 +758,7 @@ def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
     row, additive per slice, and the pruning masks are per-slice, so
     any tiling is exact.
     """
+    sched_context.check_current()
     n_dev = mesh.shape[AXIS_SLICES]
     filtered = threshold > 1 or tanimoto > 0
     if filtered:

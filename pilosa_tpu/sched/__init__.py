@@ -1,0 +1,22 @@
+"""Query lifecycle subsystem: admission control, deadlines + budgets,
+and cluster-wide cancellation + visibility.
+
+- ``sched.context`` — QueryContext (id, deadline, cancel flag, stage
+  timings) and its thread-local propagation into layers that do not
+  take a ctx argument (the mesh device dispatch).
+- ``sched.admission`` — the weighted (read/write/admin) bounded queue
+  in front of the executor; overflow surfaces as HTTP 429.
+- ``sched.registry`` — in-flight query visibility (/debug/queries),
+  cancellation, and the slow-query log.
+- ``sched.warmup`` — cold-start compilation of the hot XLA programs.
+
+See docs/SCHEDULING.md for the lifecycle diagram and wire contract.
+"""
+
+from .admission import (AdmissionController, AdmissionFullError,  # noqa: F401
+                        Slot)
+from .context import (DEADLINE_HEADER, LANE_ADMIN, LANE_READ,  # noqa: F401
+                      LANE_WRITE, QUERY_ID_HEADER, QueryContext,
+                      check_current, current, use)
+from .registry import QueryRegistry  # noqa: F401
+from .warmup import Warmup, warmup_enabled  # noqa: F401

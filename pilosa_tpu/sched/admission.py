@@ -1,0 +1,198 @@
+"""Admission control: a weighted queue in front of the executor.
+
+The serving-quality contract under overload: a bounded number of
+queries execute concurrently (``concurrency``), a bounded number wait
+(``queue_depth``), and everything past that is rejected **immediately**
+with enough information for the client to back off (AdmissionFullError
+carries a Retry-After estimate) — the HTTP layer renders it as
+``429 Too Many Requests`` instead of queueing unboundedly.
+
+Waiting queries are scheduled between three lanes — ``read``,
+``write``, ``admin`` — by stride scheduling (each lane has a virtual
+clock advancing at 1/weight per grant), so a write burst cannot starve
+reads and admin traffic always trickles through. Within a lane, FIFO.
+
+Deadlines compose: a waiter whose QueryContext expires or is cancelled
+while queued leaves the queue with the matching error — a query that
+died waiting never occupies an execution slot.
+
+Remote (forwarded) legs bypass admission at the receiving node: they
+were admitted once at their coordinator, and admitting them again
+could deadlock a saturated cluster (every node holding a slot while
+waiting for a peer's slot). Cluster-wide concurrency is therefore
+bounded by the sum of coordinator caps.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Optional
+
+from ..errors import PilosaError
+
+DEFAULT_CONCURRENCY = 16
+DEFAULT_QUEUE_DEPTH = 64
+# Lane weights: reads dominate a healthy serving mix, writes matter,
+# admin must never starve. Overridable per controller.
+DEFAULT_WEIGHTS = {"read": 4, "write": 2, "admin": 1}
+
+# Poll tick while queued: bounds how stale a cancel/deadline can go
+# unnoticed without a dedicated timer thread per waiter.
+_WAIT_TICK_S = 0.05
+
+
+class AdmissionFullError(PilosaError):
+    """Queue depth exhausted; ``retry_after_s`` is the server's own
+    estimate of when capacity frees (rendered as Retry-After)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class _Waiter:
+    __slots__ = ("granted",)
+
+    def __init__(self):
+        self.granted = False
+
+
+class Slot:
+    """An execution slot; release() is idempotent (also a context
+    manager, releasing on exit)."""
+
+    __slots__ = ("_ac", "lane", "_t0", "_released")
+
+    def __init__(self, ac: "AdmissionController", lane: str):
+        self._ac = ac
+        self.lane = lane
+        self._t0 = time.monotonic()
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._ac._release(self.lane, time.monotonic() - self._t0)
+
+    def __enter__(self) -> "Slot":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+class AdmissionController:
+    def __init__(self, concurrency: int = DEFAULT_CONCURRENCY,
+                 queue_depth: int = DEFAULT_QUEUE_DEPTH,
+                 weights: Optional[dict[str, int]] = None):
+        self.concurrency = max(1, int(concurrency))
+        self.queue_depth = max(0, int(queue_depth))
+        self.weights = dict(weights or DEFAULT_WEIGHTS)
+        self._mu = threading.Lock()
+        self._cond = threading.Condition(self._mu)
+        self._in_flight = 0
+        self._queues: dict[str, list[_Waiter]] = {}
+        # Stride scheduling state: lane virtual clocks.
+        self._vtime: dict[str, float] = {}
+        self._served: dict[str, int] = {}
+        self._rejected = 0
+        # EWMA of slot hold seconds, feeding the Retry-After estimate.
+        self._hold_ewma = 0.05
+
+    # -- acquire / release ---------------------------------------------------
+
+    def acquire(self, lane: str, ctx=None) -> Slot:
+        """Block until a slot frees (respecting ``ctx``'s deadline and
+        cancellation), or raise AdmissionFullError when the wait queue
+        is already at depth."""
+        with self._cond:
+            queued = sum(len(q) for q in self._queues.values())
+            if self._in_flight < self.concurrency and queued == 0:
+                self._grant_locked(lane)
+                return Slot(self, lane)
+            if queued >= self.queue_depth:
+                self._rejected += 1
+                raise AdmissionFullError(
+                    f"admission queue full ({queued} waiting,"
+                    f" {self._in_flight} in flight)",
+                    retry_after_s=self._retry_after_locked())
+            w = _Waiter()
+            self._queues.setdefault(lane, []).append(w)
+            try:
+                while not w.granted:
+                    if ctx is not None:
+                        ctx.check()  # raises on cancel/expiry
+                    self._cond.wait(_WAIT_TICK_S)
+            except BaseException:
+                # Left the queue without the slot: if a grant raced in,
+                # hand it to the next waiter instead of leaking it.
+                if w.granted:
+                    self._in_flight -= 1
+                    self._wake_locked()
+                else:
+                    self._queues[lane].remove(w)
+                raise
+            return Slot(self, lane)
+
+    def _release(self, lane: str, held_s: float) -> None:
+        with self._cond:
+            self._in_flight -= 1
+            self._hold_ewma = 0.8 * self._hold_ewma + 0.2 * held_s
+            self._wake_locked()
+
+    def _grant_locked(self, lane: str) -> None:
+        self._in_flight += 1
+        self._served[lane] = self._served.get(lane, 0) + 1
+        w = self.weights.get(lane, 1) or 1
+        # A lane idle for a while re-enters near the current clock
+        # rather than spending banked credit starving everyone else.
+        base = max(self._vtime.values(), default=0.0)
+        self._vtime[lane] = max(self._vtime.get(lane, 0.0), base - 1.0) \
+            + 1.0 / w
+
+    def _wake_locked(self) -> None:
+        """Grant freed capacity to waiters, picking the nonempty lane
+        with the smallest virtual time (stride scheduling)."""
+        granted = False
+        while self._in_flight < self.concurrency:
+            lanes = [ln for ln, q in self._queues.items() if q]
+            if not lanes:
+                break
+            lane = min(lanes, key=lambda ln: self._vtime.get(ln, 0.0))
+            waiter = self._queues[lane].pop(0)
+            waiter.granted = True
+            self._grant_locked(lane)
+            granted = True
+        if granted:
+            self._cond.notify_all()
+
+    # -- introspection -------------------------------------------------------
+
+    def _retry_after_locked(self) -> float:
+        """Seconds until the backlog likely drains enough to admit one
+        more query: backlog size × EWMA hold time / parallelism."""
+        backlog = self._in_flight + sum(
+            len(q) for q in self._queues.values())
+        est = self._hold_ewma * backlog / self.concurrency
+        return float(max(1, math.ceil(est)))
+
+    @property
+    def in_flight(self) -> int:
+        with self._mu:
+            return self._in_flight
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            return {
+                "concurrency": self.concurrency,
+                "queueDepth": self.queue_depth,
+                "inFlight": self._in_flight,
+                "queued": {ln: len(q)
+                           for ln, q in self._queues.items() if q},
+                "served": dict(self._served),
+                "rejected": self._rejected,
+                "weights": dict(self.weights),
+                "holdEwmaS": round(self._hold_ewma, 4),
+            }

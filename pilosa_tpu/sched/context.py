@@ -1,0 +1,185 @@
+"""QueryContext: per-query identity, deadline budget, and cancellation.
+
+One QueryContext rides a query from the HTTP front door through the
+executor's map-reduce fan-out, the device dispatch layer
+(parallel.mesh), and the cluster client's remote legs. It carries
+
+- an **id** (propagated to peers as ``X-Pilosa-Query-Id``, so every
+  node's /debug/queries lists the same query and a cluster-wide cancel
+  can find its legs),
+- a **deadline** parsed from ``X-Pilosa-Deadline`` (remaining seconds —
+  the fan-out form: peers inherit the *remaining* budget, not the
+  original) or ``?timeout=`` (Go-style duration on the entry request),
+- a **cancel flag** set by DELETE /debug/queries/{id} (locally or via
+  the cluster broadcast), and
+- **stage timings** (parse/admission/execute/encode) for the
+  slow-query log.
+
+Checks are cooperative: every layer that can block or loop calls
+``ctx.check()`` (or module-level ``check_current()`` from code that
+does not take a ctx argument, e.g. the mesh dispatch functions) and
+gets a QueryDeadlineError / QueryCancelledError the moment the budget
+is gone. The context travels between executor worker threads via
+``use()``'s thread-local, set by the executor around each leg.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Optional
+
+from ..errors import QueryCancelledError, QueryDeadlineError
+
+# Lanes the admission controller schedules between.
+LANE_READ = "read"
+LANE_WRITE = "write"
+LANE_ADMIN = "admin"
+
+# Wire headers for cluster fan-out propagation.
+DEADLINE_HEADER = "X-Pilosa-Deadline"
+QUERY_ID_HEADER = "X-Pilosa-Query-Id"
+
+
+class QueryContext:
+    """Lifecycle state of one in-flight query."""
+
+    def __init__(self, pql: str = "", index: str = "",
+                 lane: str = LANE_READ,
+                 timeout_s: Optional[float] = None,
+                 id: Optional[str] = None, remote: bool = False,
+                 node: str = ""):
+        self.id = id or uuid.uuid4().hex[:16]
+        self.pql = pql
+        self.index = index
+        self.lane = lane
+        self.remote = remote
+        self.node = node
+        self.started = time.monotonic()
+        self.started_wall = time.time()
+        self.deadline = (self.started + timeout_s
+                         if timeout_s else None)
+        self.state = "queued"
+        self.cancel_reason = ""
+        self._cancelled = threading.Event()
+        self._mu = threading.Lock()
+        self.stages: dict[str, float] = {}
+        self.legs: list[dict] = []
+
+    # -- budget --------------------------------------------------------------
+
+    def remaining(self) -> Optional[float]:
+        """Seconds of budget left; None means no deadline. Can go
+        negative once expired (callers clamp as needed)."""
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
+    def expired(self) -> bool:
+        return (self.deadline is not None
+                and time.monotonic() >= self.deadline)
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.started
+
+    # -- cancellation --------------------------------------------------------
+
+    def cancelled(self) -> bool:
+        return self._cancelled.is_set()
+
+    def cancel(self, reason: str = "cancelled") -> None:
+        with self._mu:
+            if not self._cancelled.is_set():
+                self.cancel_reason = reason
+                self.state = "cancelled"
+            self._cancelled.set()
+
+    def check(self) -> None:
+        """Raise if this query must stop. The single cooperative
+        cancellation point every lifecycle-aware layer calls."""
+        if self._cancelled.is_set():
+            raise QueryCancelledError(
+                f"query {self.id} cancelled"
+                + (f": {self.cancel_reason}" if self.cancel_reason
+                   else ""))
+        if self.expired():
+            self.state = "expired"
+            raise QueryDeadlineError(
+                f"query {self.id}: deadline exceeded after"
+                f" {self.elapsed():.3f}s")
+
+    # -- bookkeeping ---------------------------------------------------------
+
+    @contextmanager
+    def stage(self, name: str):
+        """Record wall time of one pipeline stage (accumulating —
+        a stage may run more than once, e.g. per-leg encode)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            with self._mu:
+                self.stages[name] = (self.stages.get(name, 0.0)
+                                     + time.perf_counter() - t0)
+
+    def add_leg(self, host: str, n_slices: int) -> None:
+        """Record a map-reduce leg (node host + slice count) for
+        /debug/queries visibility."""
+        with self._mu:
+            self.legs.append({"host": host, "slices": n_slices})
+
+    def to_json(self) -> dict:
+        rem = self.remaining()
+        with self._mu:
+            legs = list(self.legs)
+            stages = dict(self.stages)
+        return {
+            "id": self.id,
+            "pql": self.pql[:200],
+            "index": self.index,
+            "lane": self.lane,
+            "state": self.state,
+            "remote": self.remote,
+            "node": self.node,
+            "startedAt": self.started_wall,
+            "elapsedS": round(self.elapsed(), 4),
+            "remainingS": None if rem is None else round(rem, 4),
+            "legs": legs,
+            "stages": {k: round(v, 4) for k, v in stages.items()},
+        }
+
+
+# -- thread-local propagation ------------------------------------------------
+
+_tls = threading.local()
+
+
+def current() -> Optional[QueryContext]:
+    """The QueryContext bound to this thread, or None."""
+    return getattr(_tls, "ctx", None)
+
+
+@contextmanager
+def use(ctx: Optional[QueryContext]):
+    """Bind ``ctx`` as this thread's current query for the duration.
+    Used by the executor around each worker leg so layers without a
+    ctx argument (mesh dispatch) can still check the budget. ``None``
+    is allowed (binds nothing-current, e.g. internal maintenance
+    queries)."""
+    prev = getattr(_tls, "ctx", None)
+    _tls.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _tls.ctx = prev
+
+
+def check_current() -> None:
+    """check() on the thread's current query; no-op when none bound.
+    The hook the device dispatch layer calls before compiling or
+    dispatching a program on behalf of a query."""
+    ctx = getattr(_tls, "ctx", None)
+    if ctx is not None:
+        ctx.check()

@@ -1,0 +1,118 @@
+"""Cold-start warmup: background-compile the hot XLA programs.
+
+The first real device query otherwise pays the whole cold chain —
+backend init through the tunnel, mesh construction, and the
+trace+compile of each serving program — measured in seconds (round-5
+VERDICT standing complaint). At server start this lane compiles the
+three hot programs against dummy (all-zero) slabs on a daemon thread:
+
+- the fused count fold (``mesh.count_expr_sharded`` — Count and the
+  batched multi-Count lane share its cache),
+- the TopN exact-count program (``mesh.topn_exact_sharded``), and
+- the BSI comparison circuit (``mesh.bsi_range_sharded`` over
+  ``ops.kernels.bsi_compare_select``).
+
+XLA compiles are shape-keyed, so an unusual query shape can still
+compile later — the warmup removes the dominant cold cost (backend +
+mesh init + the base program set), not every possible trace.
+
+State is exposed at ``/status`` (``pending → running → done``;
+``disabled`` when the mesh is off or unavailable, ``failed`` carries
+the error). Gated by PILOSA_TPU_WARMUP (default on; tests disable it
+the way they disable the cost model).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+
+def warmup_enabled() -> bool:
+    return os.environ.get("PILOSA_TPU_WARMUP", "1") != "0"
+
+
+class Warmup:
+    """Compile the hot serving programs on a background thread."""
+
+    PROGRAMS = ("count_fold", "topn_exact", "bsi_compare_select")
+
+    def __init__(self, executor, logger=None):
+        from ..utils import logger as logger_mod
+        self.executor = executor
+        self.logger = logger or logger_mod.NOP
+        self.state = "pending"
+        self.error = ""
+        self.compiled: list[str] = []
+        self.elapsed_s: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="pilosa-warmup",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def to_json(self) -> dict:
+        return {"state": self.state, "compiled": list(self.compiled),
+                "error": self.error or None,
+                "elapsedS": (round(self.elapsed_s, 3)
+                             if self.elapsed_s is not None else None)}
+
+    # -- worker --------------------------------------------------------------
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        self.state = "running"
+        try:
+            mesh = self.executor._mesh_or_none()
+            if mesh is None:
+                self.state = "disabled"
+                return
+            import numpy as np
+
+            from ..ops.packed import WORDS_PER_SLICE
+            from ..parallel import mesh as mesh_mod
+            n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
+
+            def slab():
+                return mesh_mod.shard_slices(
+                    mesh, np.zeros((n_dev, WORDS_PER_SLICE), np.uint32))
+
+            a, b = slab(), slab()
+            if not self._stop.is_set():
+                mesh_mod.count_expr_sharded(
+                    mesh, ("and", ("leaf", 0), ("leaf", 1)), [a, b])
+                self.compiled.append("count_fold")
+            if not self._stop.is_set():
+                rows = mesh_mod.shard_slices(
+                    mesh, np.zeros((n_dev, 4, WORDS_PER_SLICE),
+                                   np.uint32))
+                mesh_mod.topn_exact_sharded(mesh, ("leaf", 0), rows,
+                                            [a])
+                self.compiled.append("topn_exact")
+            if not self._stop.is_set():
+                depth = 8  # exists row + 8 value planes
+                planes = [a] + [slab() for _ in range(depth)]
+                mesh_mod.bsi_range_sharded(mesh, "<", 5, depth, planes)
+                self.compiled.append("bsi_compare_select")
+            self.state = "done"
+            self.elapsed_s = time.monotonic() - t0
+            self.logger.printf(
+                "warmup: compiled %s in %.2fs",
+                ",".join(self.compiled), self.elapsed_s)
+        except Exception as e:  # noqa: BLE001 - warmup must never kill serving
+            self.state = "failed"
+            self.error = f"{type(e).__name__}: {e}"
+            self.elapsed_s = time.monotonic() - t0
+            self.logger.printf("warmup failed: %s", self.error)

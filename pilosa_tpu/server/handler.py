@@ -12,6 +12,7 @@ server — the Python analogue of the reference's net/http.
 
 from __future__ import annotations
 
+import contextlib
 import io
 import json
 import re
@@ -21,9 +22,13 @@ from urllib.parse import parse_qs
 import numpy as np
 
 from .. import __version__
-from ..cluster.broadcast import NOP_BROADCASTER, unmarshal_message
+from ..cluster.broadcast import (NOP_BROADCASTER, CancelQueryMessage,
+                                 unmarshal_message)
 from ..errors import (FrameExistsError, IndexExistsError, PilosaError,
+                      QueryCancelledError, QueryDeadlineError,
                       validate_label)
+from ..sched import (LANE_ADMIN, LANE_READ, LANE_WRITE, AdmissionFullError,
+                     QueryContext, QueryRegistry)
 from ..models.frame import Field, FrameOptions
 from ..models.index import IndexOptions
 from ..pql import parser as pql
@@ -44,10 +49,11 @@ _VALID_FRAME_OPTIONS = {"rowLabel", "inverseEnabled", "cacheType",
 
 
 class HTTPError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str, headers=None):
         super().__init__(message)
         self.status = status
         self.message = message
+        self.headers = headers or []
 
 
 class Request:
@@ -109,20 +115,25 @@ class Request:
 
 class Response:
     def __init__(self, status: int = 200, body=b"",
-                 content_type: str = "application/json"):
+                 content_type: str = "application/json",
+                 headers=None):
         # body: bytes, or a readable file object (streamed in chunks —
-        # used for fragment backups, which can be 128 MB+).
+        # used for fragment backups, which can be 128 MB+). headers:
+        # extra (name, value) pairs (Retry-After, X-Pilosa-Query-Id).
         self.status = status
         self.body = body
         self.content_type = content_type
+        self.headers = headers or []
 
     @staticmethod
-    def json(obj, status: int = 200) -> "Response":
-        return Response(status, (json.dumps(obj) + "\n").encode())
+    def json(obj, status: int = 200, headers=None) -> "Response":
+        return Response(status, (json.dumps(obj) + "\n").encode(),
+                        headers=headers)
 
     @staticmethod
-    def proto(msg, status: int = 200) -> "Response":
-        return Response(status, msg.SerializeToString(), _PROTOBUF)
+    def proto(msg, status: int = 200, headers=None) -> "Response":
+        return Response(status, msg.SerializeToString(), _PROTOBUF,
+                        headers=headers)
 
 
 def _export_csv_chunks(frag):
@@ -161,7 +172,9 @@ _STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
                 405: "Method Not Allowed", 406: "Not Acceptable",
                 409: "Conflict", 412: "Precondition Failed",
                 415: "Unsupported Media Type",
-                500: "Internal Server Error"}
+                429: "Too Many Requests",
+                500: "Internal Server Error", 503: "Service Unavailable",
+                504: "Gateway Timeout"}
 
 
 class Handler:
@@ -173,7 +186,8 @@ class Handler:
     def __init__(self, holder, executor, cluster=None, host: str = "",
                  broadcaster=NOP_BROADCASTER, broadcast_handler=None,
                  status_handler=None, stats=None, client_factory=None,
-                 pod=None, logger=None):
+                 pod=None, logger=None, admission=None, registry=None,
+                 warmup=None, default_timeout_s: float = 0.0):
         from ..utils import logger as logger_mod
         self.logger = logger or logger_mod.NOP
         self.holder = holder
@@ -188,16 +202,28 @@ class Handler:
         # client_factory(host) -> cluster.client.Client; injected to keep
         # handler importable without the client (and mockable in tests).
         self.client_factory = client_factory
+        # Query lifecycle (sched subsystem): admission=None means no
+        # admission control (bare test handlers); the registry always
+        # exists so /debug/queries works on any handler.
+        self.admission = admission
+        self.registry = registry if registry is not None \
+            else QueryRegistry(logger=self.logger)
+        self.warmup = warmup
+        self.default_timeout_s = default_timeout_s or 0.0
         self.version = __version__
         self._routes: list[tuple[str, re.Pattern, Callable]] = []
         self._add_routes()
 
     # -- routing -------------------------------------------------------------
 
-    def _route(self, method: str, pattern: str, fn: Callable) -> None:
+    def _route(self, method: str, pattern: str, fn: Callable,
+               lane: Optional[str] = None) -> None:
         # {name} segments become named groups matching one path segment.
+        # ``lane`` routes the whole handler through that admission lane
+        # (the query handler manages its own slot — deadline-aware, and
+        # remote legs bypass — so it stays lane=None here).
         regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
-        self._routes.append((method, re.compile(f"^{regex}$"), fn))
+        self._routes.append((method, re.compile(f"^{regex}$"), fn, lane))
 
     def _add_routes(self) -> None:
         # Route table (reference handler.go:82-120).
@@ -206,29 +232,34 @@ class Handler:
         r("GET", "/assets/{file}", self._handle_asset)
         r("GET", "/index", self._handle_get_schema)
         r("GET", "/index/{index}", self._handle_get_index)
-        r("POST", "/index/{index}", self._handle_post_index)
-        r("DELETE", "/index/{index}", self._handle_delete_index)
+        r("POST", "/index/{index}", self._handle_post_index,
+          lane=LANE_ADMIN)
+        r("DELETE", "/index/{index}", self._handle_delete_index,
+          lane=LANE_ADMIN)
         r("POST", "/index/{index}/attr/diff", self._handle_index_attr_diff)
-        r("POST", "/index/{index}/frame/{frame}", self._handle_post_frame)
+        r("POST", "/index/{index}/frame/{frame}", self._handle_post_frame,
+          lane=LANE_ADMIN)
         r("DELETE", "/index/{index}/frame/{frame}",
-          self._handle_delete_frame)
+          self._handle_delete_frame, lane=LANE_ADMIN)
         r("POST", "/index/{index}/query", self._handle_post_query)
         r("POST", "/index/{index}/frame/{frame}/attr/diff",
           self._handle_frame_attr_diff)
         r("POST", "/index/{index}/frame/{frame}/restore",
-          self._handle_post_frame_restore)
+          self._handle_post_frame_restore, lane=LANE_ADMIN)
         r("PATCH", "/index/{index}/frame/{frame}/time-quantum",
-          self._handle_patch_frame_time_quantum)
+          self._handle_patch_frame_time_quantum, lane=LANE_ADMIN)
         r("GET", "/index/{index}/frame/{frame}/views",
           self._handle_get_frame_views)
         r("GET", "/index/{index}/frame/{frame}/fields",
           self._handle_get_frame_fields)
         r("POST", "/index/{index}/frame/{frame}/field/{field}",
-          self._handle_post_frame_field)
+          self._handle_post_frame_field, lane=LANE_ADMIN)
         r("POST", "/index/{index}/frame/{frame}/field/{field}/import",
-          self._handle_post_field_import)
+          self._handle_post_field_import, lane=LANE_WRITE)
         r("PATCH", "/index/{index}/time-quantum",
-          self._handle_patch_index_time_quantum)
+          self._handle_patch_index_time_quantum, lane=LANE_ADMIN)
+        r("GET", "/debug/queries", self._handle_debug_queries)
+        r("DELETE", "/debug/queries/{qid}", self._handle_delete_query)
         r("GET", "/debug/vars", self._handle_expvar)
         r("GET", "/debug/pprof", self._handle_pprof_index)
         r("GET", "/debug/pprof/", self._handle_pprof_index)
@@ -241,7 +272,7 @@ class Handler:
         r("GET", "/fragment/data", self._handle_get_fragment_data)
         r("POST", "/fragment/data", self._handle_post_fragment_data)
         r("GET", "/fragment/nodes", self._handle_fragment_nodes)
-        r("POST", "/import", self._handle_post_import)
+        r("POST", "/import", self._handle_post_import, lane=LANE_WRITE)
         r("GET", "/hosts", self._handle_get_hosts)
         r("GET", "/schema", self._handle_get_schema)
         r("GET", "/slices/max", self._handle_slice_max)
@@ -259,7 +290,7 @@ class Handler:
         if head:
             method = "GET"
         matched_path = False
-        for m, regex, fn in self._routes:
+        for m, regex, fn, lane in self._routes:
             match = regex.match(path)
             if match is None:
                 continue
@@ -267,10 +298,15 @@ class Handler:
             if m != method:
                 continue
             try:
-                resp = fn(Request(environ, match.groupdict()))
+                if lane is None:
+                    resp = fn(Request(environ, match.groupdict()))
+                else:
+                    with self._admitted(lane):
+                        resp = fn(Request(environ, match.groupdict()))
             except HTTPError as e:
                 resp = Response(e.status, (e.message + "\n").encode(),
-                                "text/plain; charset=utf-8")
+                                "text/plain; charset=utf-8",
+                                headers=e.headers)
             except PilosaError as e:
                 resp = Response(400, (str(e) + "\n").encode(),
                                 "text/plain; charset=utf-8")
@@ -286,15 +322,17 @@ class Handler:
                             "text/plain; charset=utf-8")
         status_line = (
             f"{resp.status} {_STATUS_TEXT.get(resp.status, 'Unknown')}")
+        extra = list(getattr(resp, "headers", ()) or ())
         if isinstance(resp.body, bytes):
             start_response(status_line,
                            [("Content-Type", resp.content_type),
-                            ("Content-Length", str(len(resp.body)))])
+                            ("Content-Length", str(len(resp.body)))]
+                           + extra)
             return [] if head else [resp.body]
         # Streamed body: file object (chunked reads) or a generator of
         # byte chunks (CSV export) — either way, never buffered whole.
         start_response(status_line,
-                       [("Content-Type", resp.content_type)])
+                       [("Content-Type", resp.content_type)] + extra)
         if hasattr(resp.body, "read"):
             return _stream_chunks(resp.body)
         return resp.body
@@ -325,11 +363,13 @@ class Handler:
                               for n in nodes])
 
     def _handle_get_status(self, req: Request) -> Response:
+        # Cold-start warmup state (sched.warmup) rides the JSON forms.
+        warm = self.warmup.to_json() if self.warmup is not None else None
         if self.status_handler is not None:
             cs = self.status_handler.cluster_status()  # pb.ClusterStatus
             if _PROTOBUF in req.accept:
                 return Response.proto(cs)
-            return Response.json({"status": {"nodes": [
+            out = {"status": {"nodes": [
                 {"host": ns.Host, "state": ns.State,
                  "indexes": [{"name": ix.Name,
                               "maxSlice": ix.MaxSlice,
@@ -337,10 +377,16 @@ class Handler:
                               "frames": [{"name": f.Name}
                                          for f in ix.Frames]}
                              for ix in ns.Indexes]}
-                for ns in cs.Nodes]}})
+                for ns in cs.Nodes]}}
+            if warm is not None:
+                out["warmup"] = warm
+            return Response.json(out)
         states = self.cluster.node_states() if self.cluster else {}
-        return Response.json({"status": {"Nodes": [
-            {"Host": h, "State": s} for h, s in sorted(states.items())]}})
+        out = {"status": {"Nodes": [
+            {"Host": h, "State": s} for h, s in sorted(states.items())]}}
+        if warm is not None:
+            out["warmup"] = warm
+        return Response.json(out)
 
     def _handle_expvar(self, req: Request) -> Response:
         snap = self.stats.snapshot() if hasattr(self.stats, "snapshot") \
@@ -619,16 +665,97 @@ class Handler:
             return Response.proto(pb.ImportResponse())
         return Response.json({})
 
+    # -- query lifecycle (sched subsystem; docs/SCHEDULING.md) ---------------
+
+    def _query_timeout_s(self, req: Request) -> Optional[float]:
+        """Deadline budget for this request: ``X-Pilosa-Deadline``
+        (remaining seconds — the cluster fan-out form, so a peer
+        inherits what is LEFT of the coordinator's budget) wins over
+        ``?timeout=`` (Go-style duration, the client-facing form),
+        which wins over the configured default. None = unbounded."""
+        hdr = self.environ_header(req, "HTTP_X_PILOSA_DEADLINE")
+        if hdr:
+            try:
+                return max(float(hdr), 0.001)
+            except ValueError:
+                raise HTTPError(400, f"invalid X-Pilosa-Deadline: {hdr}")
+        arg = req.query.get("timeout")
+        if arg:
+            from ..utils.config import parse_duration
+            try:
+                return max(parse_duration(arg), 0.001)
+            except ValueError:
+                raise HTTPError(400, f"invalid timeout: {arg}")
+        return self.default_timeout_s or None
+
+    @staticmethod
+    def environ_header(req: Request, key: str) -> str:
+        return req.environ.get(key, "")
+
+    def _admit(self, lane: str, ctx=None):
+        """Acquire an execution slot (None admission = unlimited, for
+        bare test handlers). AdmissionFullError maps to 429 with the
+        controller's Retry-After estimate; a deadline that expires
+        while QUEUED maps like any other expiry (504) — the query
+        never occupied a slot."""
+        if self.admission is None:
+            return None
+        try:
+            return self.admission.acquire(lane, ctx)
+        except AdmissionFullError as e:
+            if self.stats is not None:
+                self.stats.count("queriesRejected", 1)
+            raise HTTPError(
+                429, f"too many requests: {e}",
+                headers=[("Retry-After",
+                          str(int(e.retry_after_s)))])
+
+    @contextlib.contextmanager
+    def _admitted(self, lane: str):
+        """Slot-scoped admission for the non-query lanes (imports ride
+        ``write``, schema mutations ``admin``)."""
+        slot = self._admit(lane)
+        try:
+            yield
+        finally:
+            if slot is not None:
+                slot.release()
+
+    def _handle_debug_queries(self, req: Request) -> Response:
+        out = {"queries": self.registry.active(),
+               "slow": self.registry.slow_queries()}
+        if self.admission is not None:
+            out["admission"] = self.admission.snapshot()
+        return Response.json(out)
+
+    def _handle_delete_query(self, req: Request) -> Response:
+        """Cancel one query CLUSTER-WIDE: flip the local cancel flag
+        (every executor layer checks it cooperatively) and broadcast a
+        CancelQueryMessage so peers cancel the legs registered under
+        the same id. ``?local=true`` limits to this node (the form the
+        broadcast receiver itself applies, and a debugging escape
+        hatch)."""
+        qid = req.vars["qid"]
+        n = self.registry.cancel_local(qid)
+        if req.query.get("local") != "true":
+            try:
+                self.broadcaster.send_async(CancelQueryMessage(qid))
+            except Exception as e:  # noqa: BLE001 - best-effort fan-out
+                self.logger.printf("cancel broadcast failed: %s", e)
+        return Response.json({"id": qid, "cancelled": n})
+
     # -- query ---------------------------------------------------------------
 
     def _handle_post_query(self, req: Request) -> Response:
         index_name = req.vars["index"]
         proto_out = _PROTOBUF in req.accept
 
-        def error_resp(status, msg):
+        def error_resp(status, msg, headers=None):
             if proto_out:
-                return Response.proto(pb.QueryResponse(Err=msg), status)
-            return Response.json({"error": msg}, status)
+                return Response.proto(pb.QueryResponse(Err=msg), status,
+                                      headers=headers)
+            return Response.json({"error": msg}, status,
+                                 headers=headers)
 
         # Read request (handler.go:811-870).
         if req.content_type == _PROTOBUF:
@@ -653,18 +780,63 @@ class Handler:
         except PilosaError as e:
             return error_resp(400, str(e))
 
-        from ..executor import ExecOptions
+        # Lifecycle: classify the lane, build the QueryContext (remote
+        # legs inherit the coordinator's id + remaining budget via
+        # headers), admit, register for /debug/queries visibility.
+        from ..executor import _WRITE_CALLS, ExecOptions
+        lane = (LANE_WRITE
+                if any(c.name in _WRITE_CALLS for c in query.calls)
+                else LANE_READ)
+        ctx = QueryContext(
+            pql=query_str, index=index_name, lane=lane,
+            timeout_s=self._query_timeout_s(req),
+            id=self.environ_header(req, "HTTP_X_PILOSA_QUERY_ID") or None,
+            remote=remote, node=self.host)
+        # Register BEFORE admission so queued queries are visible at
+        # /debug/queries and cancellable while they wait (a DELETE or
+        # an expiring deadline dequeues them without ever holding a
+        # slot). Forwarded legs were admitted once at their
+        # coordinator; re-admitting them here could deadlock a
+        # saturated cluster (every node holding a slot while waiting
+        # on a peer's slot).
+        slot = None
+        err: Optional[BaseException] = None
+        self.registry.register(ctx)
         try:
-            results = self.executor.execute(
-                index_name, query, slices or None,
-                ExecOptions(remote=remote,
-                            pod_local=req.query.get("podLocal") == "true"))
+            if not remote:
+                with ctx.stage("admission"):
+                    slot = self._admit(lane, ctx)
+            ctx.state = "running"
+            with ctx.stage("execute"):
+                results = self.executor.execute(
+                    index_name, query, slices or None,
+                    ExecOptions(
+                        remote=remote,
+                        pod_local=req.query.get("podLocal") == "true",
+                        ctx=ctx))
+        except HTTPError as e:  # 429 from _admit
+            err = e
+            raise
+        except QueryDeadlineError as e:
+            err = e
+            return error_resp(504, str(e),
+                              headers=[("X-Pilosa-Query-Id", ctx.id)])
+        except QueryCancelledError as e:
+            err = e
+            return error_resp(409, str(e),
+                              headers=[("X-Pilosa-Query-Id", ctx.id)])
         except PilosaError as e:
+            err = e
             return error_resp(400, str(e))
         except Exception as e:  # noqa: BLE001 - surfaced in response
+            err = e
             self.logger.printf("query error: index=%s query=%.120s: %s",
                                index_name, query_str, e)
             return error_resp(500, str(e))
+        finally:
+            if slot is not None:
+                slot.release()
+            self.registry.finish(ctx, error=err)
 
         # Optional column-attribute join (handler.go:208-227).
         attr_sets = []
@@ -678,11 +850,17 @@ class Handler:
                 if attrs:
                     attr_sets.append((id, attrs))
 
-        if proto_out:
-            return Response.proto(
-                codec.encode_query_response(results, attr_sets))
-        return Response.json(
-            codec.query_response_json(results, attr_sets))
+        # The id rides every response so clients can correlate with
+        # /debug/queries (and DELETE a long-running follow-up).
+        qid_hdr = [("X-Pilosa-Query-Id", ctx.id)]
+        with ctx.stage("encode"):
+            if proto_out:
+                return Response.proto(
+                    codec.encode_query_response(results, attr_sets),
+                    headers=qid_hdr)
+            return Response.json(
+                codec.query_response_json(results, attr_sets),
+                headers=qid_hdr)
 
     # -- attr diff (anti-entropy) --------------------------------------------
 

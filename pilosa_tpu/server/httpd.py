@@ -39,8 +39,11 @@ _QUERY_PATH_RE = re.compile(r"^/index/([^/]+)/query$")
 _MAX_REQUEST = 1 << 28
 
 _STATUS_REASON = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  405: "Method Not Allowed", 500: "Internal Server Error",
-                  501: "Not Implemented"}
+                  405: "Method Not Allowed", 409: "Conflict",
+                  429: "Too Many Requests",
+                  500: "Internal Server Error",
+                  501: "Not Implemented", 503: "Service Unavailable",
+                  504: "Gateway Timeout"}
 
 
 class _Request:

@@ -15,7 +15,8 @@ import threading
 import time
 from typing import Optional
 
-from ..cluster.broadcast import NOP_BROADCASTER, StaticNodeSet
+from ..cluster.broadcast import (NOP_BROADCASTER, CancelQueryMessage,
+                                 StaticNodeSet)
 from ..cluster.client import Client
 from ..cluster.topology import (NODE_STATE_DOWN, NODE_STATE_UP, Cluster,
                                 Node)
@@ -25,7 +26,10 @@ from ..models.frame import FrameOptions
 from ..models.holder import Holder
 from ..models.index import IndexOptions
 from ..proto import internal_pb2 as pb
+from ..sched import (AdmissionController, QueryRegistry, Warmup,
+                     warmup_enabled)
 from ..utils import logger as logger_mod
+from ..utils.config import QueryConfig
 from ..utils.stats import NOP
 from .handler import Handler
 from .httpd import HTTPServer
@@ -44,7 +48,8 @@ class Server:
                  anti_entropy_interval: float
                  = DEFAULT_ANTI_ENTROPY_INTERVAL,
                  polling_interval: float = DEFAULT_POLLING_INTERVAL,
-                 logger=logger_mod.NOP):
+                 logger=logger_mod.NOP,
+                 query_config: Optional[QueryConfig] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -55,6 +60,19 @@ class Server:
         self.stats = stats
         self.anti_entropy_interval = anti_entropy_interval
         self.polling_interval = polling_interval
+
+        # Query lifecycle subsystem (sched; docs/SCHEDULING.md): the
+        # weighted admission queue in front of the executor, the
+        # in-flight registry behind /debug/queries, and (from open())
+        # the cold-start warmup lane.
+        self.query_config = query_config or QueryConfig()
+        self.admission = AdmissionController(
+            concurrency=self.query_config.concurrency,
+            queue_depth=self.query_config.queue_depth)
+        self.query_registry = QueryRegistry(
+            slow_threshold_s=self.query_config.slow_threshold or None,
+            stats=stats, logger=logger)
+        self.warmup: Optional[Warmup] = None
 
         self.holder = Holder(data_dir, on_create_slice=self._on_create_slice,
                              stats=stats, logger=logger)
@@ -112,12 +130,21 @@ class Server:
         self.executor = Executor(self.holder, host=self.host,
                                  cluster=self.cluster, client=client,
                                  pod=self.pod)
+        # Cold-start warmup: background-compile the hot XLA programs so
+        # the first real device query doesn't pay the multi-second
+        # trace+compile (state surfaces at /status; PILOSA_TPU_WARMUP=0
+        # or a disabled mesh skips it).
+        if warmup_enabled() and self.executor.use_mesh:
+            self.warmup = Warmup(self.executor, logger=self.logger)
+            self.warmup.start()
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
             broadcast_handler=self, status_handler=self,
             stats=self.stats, client_factory=Client, pod=self.pod,
-            logger=self.logger)
+            logger=self.logger, admission=self.admission,
+            registry=self.query_registry, warmup=self.warmup,
+            default_timeout_s=self.query_config.default_timeout)
 
         self._httpd = HTTPServer(self.handler, bind_host, port,
                                  logger=self.logger,
@@ -156,6 +183,8 @@ class Server:
     def close(self) -> None:
         self.logger.printf("server closing: %s", self.host)
         self._closing.set()
+        if self.warmup is not None:
+            self.warmup.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -186,10 +215,19 @@ class Server:
         request holding the failing call gets the error response, and
         requests after it re-execute individually (none of their calls
         ran). Never re-executes an applied mutation (a re-run SetBit
-        would report changed=false to the client that set the bit)."""
+        would report changed=false to the client that set the bit).
+
+        Lifecycle: the combined run occupies ONE admission slot (its
+        lane classified over all calls) and registers ONE QueryContext;
+        if admission is full the lane declines (None) and the requests
+        fall back to per-request dispatch through the handler, which
+        produces the proper per-request 429 + Retry-After."""
         from ..errors import PilosaError
+        from ..executor import _WRITE_CALLS, ExecOptions
         from ..pql import parser as pql
         from ..pql.ast import Query
+        from ..sched import (LANE_READ, LANE_WRITE, AdmissionFullError,
+                             QueryContext)
         from . import codec
         if self.executor is None:
             return None
@@ -200,8 +238,22 @@ class Server:
         calls = [c for q in queries for c in q.calls]
         if not calls or all(c.name == "SetRowAttrs" for c in calls):
             return None  # bulk-attrs path applies non-positionally
-        results, err = self.executor.execute_partial(index,
-                                                     Query(calls))
+        lane = (LANE_WRITE if any(c.name in _WRITE_CALLS for c in calls)
+                else LANE_READ)
+        try:
+            slot = self.admission.acquire(lane)
+        except AdmissionFullError:
+            return None  # per-request dispatch answers the 429s
+        ctx = QueryContext(pql=f"<pipelined batch: {len(calls)} calls>",
+                           index=index, lane=lane,
+                           timeout_s=self.query_config.default_timeout
+                           or None, node=self.host)
+        try:
+            with self.query_registry.track(ctx):
+                results, err = self.executor.execute_partial(
+                    index, Query(calls), opt=ExecOptions(ctx=ctx))
+        finally:
+            slot.release()
 
         def ok_payload(rs):
             # Write-heavy pipelined streams answer [true]/[false] for
@@ -231,7 +283,16 @@ class Server:
             elif not failed:
                 # This request holds the failing call: the same error
                 # response sequential dispatch would produce.
-                status = 400 if isinstance(err, PilosaError) else 500
+                from ..errors import (QueryCancelledError,
+                                      QueryDeadlineError)
+                if isinstance(err, QueryDeadlineError):
+                    status = 504
+                elif isinstance(err, QueryCancelledError):
+                    status = 409
+                elif isinstance(err, PilosaError):
+                    status = 400
+                else:
+                    status = 500
                 body = (json.dumps({"error": str(err)}) + "\n").encode()
                 out.append(self._error_payload(body, status))
                 failed = True
@@ -348,6 +409,16 @@ class Server:
             idx = self.holder.index(m.Index)
             if idx is not None:
                 idx.delete_frame(m.Frame)
+        elif isinstance(m, CancelQueryMessage):
+            # Cluster-wide cancellation (sched subsystem): kill every
+            # leg registered under this id on THIS node — the
+            # coordinator's entry query and forwarded remote legs both
+            # carry the same id.
+            n = self.query_registry.cancel_local(
+                m.id, reason="cancelled cluster-wide")
+            if n:
+                self.logger.printf("cancelled query %s (%d context%s)",
+                                   m.id, n, "" if n == 1 else "s")
         else:
             raise ValueError(f"unexpected message: {m!r}")
 
@@ -407,13 +478,19 @@ class Server:
 
 class _RoutingClient:
     """Executor transport that routes to whatever node is asked for
-    (the executor passes the target node per call)."""
+    (the executor passes the target node per call). deadline_aware:
+    lifecycle kwargs (remaining budget + query id) pass straight
+    through to the underlying pooled Client, which clamps socket
+    timeouts/retries and stamps the fan-out headers."""
+
+    deadline_aware = True
 
     def __init__(self, server: Server):
         self.server = server
 
     def execute_query(self, node, index, query, slices, remote,
-                      pod_local=False):
+                      pod_local=False, deadline_s=None, query_id=None):
         return self.server.client_for(node.host).execute_query(
             node, index, query, slices, remote=remote,
-            pod_local=pod_local)
+            pod_local=pod_local, deadline_s=deadline_s,
+            query_id=query_id)

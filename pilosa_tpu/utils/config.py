@@ -63,11 +63,30 @@ class ClusterConfig:
     gossip_secret: str = ""                     # HMAC key for gossip frames
 
 
+# Query lifecycle defaults (sched subsystem; docs/SCHEDULING.md).
+DEFAULT_QUERY_CONCURRENCY = 16
+DEFAULT_QUERY_QUEUE_DEPTH = 64
+
+
+@dataclass
+class QueryConfig:
+    """[query] section: the sched subsystem's knobs. concurrency/
+    queue_depth bound the admission controller (overflow answers 429);
+    default_timeout (seconds, 0 = none) applies when a request carries
+    neither ?timeout= nor X-Pilosa-Deadline; slow_threshold (seconds,
+    0 = disabled) arms the slow-query log."""
+    concurrency: int = DEFAULT_QUERY_CONCURRENCY
+    queue_depth: int = DEFAULT_QUERY_QUEUE_DEPTH
+    default_timeout: float = 0.0
+    slow_threshold: float = 0.0
+
+
 @dataclass
 class Config:
     data_dir: str = "~/.pilosa"
     host: str = f"{DEFAULT_HOST}:{DEFAULT_PORT}"
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    query: QueryConfig = field(default_factory=QueryConfig)
     anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
     log_path: str = ""
     # Accepted and persisted but inert, exactly like the reference at
@@ -78,6 +97,12 @@ class Config:
     def to_toml(self) -> str:
         hosts = ", ".join(f'"{h}"' for h in self.cluster.hosts)
         internal = ", ".join(f'"{h}"' for h in self.cluster.internal_hosts)
+
+        def dur(v: float) -> str:
+            # Sub-second values must survive the round trip ("0.5s"
+            # parses back to 0.5; int-truncation would write "0s",
+            # silently disabling the knob).
+            return f"{int(v)}s" if v == int(v) else f"{v}s"
         return f"""data-dir = "{self.data_dir}"
 host = "{self.host}"
 log-path = "{self.log_path}"
@@ -91,6 +116,12 @@ polling-interval = "{int(self.cluster.polling_interval)}s"
 internal-port = "{self.cluster.internal_port}"
 gossip-seed = "{self.cluster.gossip_seed}"
 gossip-secret = "{self.cluster.gossip_secret}"
+
+[query]
+concurrency = {self.query.concurrency}
+queue-depth = {self.query.queue_depth}
+default-timeout = "{dur(self.query.default_timeout)}"
+slow-threshold = "{dur(self.query.slow_threshold)}"
 
 [plugins]
 path = "{self.plugins_path}"
@@ -132,6 +163,17 @@ def load(path: str = "", env: dict | None = None) -> Config:
         ae = data.get("anti-entropy", {})
         if "interval" in ae:
             cfg.anti_entropy_interval = parse_duration(ae["interval"])
+        q = data.get("query", {})
+        cfg.query.concurrency = int(q.get("concurrency",
+                                          cfg.query.concurrency))
+        cfg.query.queue_depth = int(q.get("queue-depth",
+                                          cfg.query.queue_depth))
+        if "default-timeout" in q:
+            cfg.query.default_timeout = parse_duration(
+                q["default-timeout"])
+        if "slow-threshold" in q:
+            cfg.query.slow_threshold = parse_duration(
+                q["slow-threshold"])
         cfg.plugins_path = data.get("plugins", {}).get(
             "path", cfg.plugins_path)
     env = os.environ if env is None else env
@@ -165,6 +207,16 @@ def load(path: str = "", env: dict | None = None) -> Config:
     if env.get("PILOSA_ANTI_ENTROPY_INTERVAL"):
         cfg.anti_entropy_interval = parse_duration(
             env["PILOSA_ANTI_ENTROPY_INTERVAL"])
+    if env.get("PILOSA_QUERY_CONCURRENCY"):
+        cfg.query.concurrency = int(env["PILOSA_QUERY_CONCURRENCY"])
+    if env.get("PILOSA_QUERY_QUEUE_DEPTH"):
+        cfg.query.queue_depth = int(env["PILOSA_QUERY_QUEUE_DEPTH"])
+    if env.get("PILOSA_QUERY_DEFAULT_TIMEOUT"):
+        cfg.query.default_timeout = parse_duration(
+            env["PILOSA_QUERY_DEFAULT_TIMEOUT"])
+    if env.get("PILOSA_QUERY_SLOW_THRESHOLD"):
+        cfg.query.slow_threshold = parse_duration(
+            env["PILOSA_QUERY_SLOW_THRESHOLD"])
     if env.get("PILOSA_PLUGINS_PATH"):
         cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
     return cfg

@@ -21,6 +21,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses
 # tests assert engage. Cost-model behavior is tested explicitly with
 # injected calibrations (tests/test_costmodel.py).
 os.environ.setdefault("PILOSA_TPU_COST_MODEL", "0")
+# Cold-start warmup compiles XLA programs on every Server.open — fine
+# for one real server, a tax on the dozens the suite spawns. Warmup
+# behavior is tested explicitly (tests/test_sched.py enables it).
+os.environ.setdefault("PILOSA_TPU_WARMUP", "0")
 
 import jax  # noqa: E402
 

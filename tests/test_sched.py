@@ -1,0 +1,797 @@
+"""Query lifecycle subsystem tests (pilosa_tpu.sched): admission
+control, deadlines + budgets, cancellation + visibility, ownership-
+gated fast paths, and the client's deadline-honoring retry loop."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.cluster.broadcast import CancelQueryMessage
+from pilosa_tpu.cluster.topology import new_cluster
+from pilosa_tpu.errors import QueryCancelledError, QueryDeadlineError
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.sched import (AdmissionController, AdmissionFullError,
+                              QueryContext, QueryRegistry)
+from pilosa_tpu.sched import context as sched_context
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.utils.config import QueryConfig
+
+
+# ---------------------------------------------------------------------------
+# QueryContext
+
+
+class TestQueryContext:
+    def test_no_deadline_never_expires(self):
+        ctx = QueryContext(pql="Count()")
+        assert ctx.remaining() is None
+        assert not ctx.expired()
+        ctx.check()  # no raise
+
+    def test_deadline_expiry(self):
+        ctx = QueryContext(timeout_s=0.02)
+        assert 0 < ctx.remaining() <= 0.02
+        ctx.check()
+        time.sleep(0.03)
+        assert ctx.expired()
+        with pytest.raises(QueryDeadlineError, match=ctx.id):
+            ctx.check()
+        assert ctx.state == "expired"
+
+    def test_cancel(self):
+        ctx = QueryContext()
+        ctx.cancel("operator said so")
+        with pytest.raises(QueryCancelledError, match="operator"):
+            ctx.check()
+        assert ctx.state == "cancelled"
+
+    def test_stage_timings_and_json(self):
+        ctx = QueryContext(pql="Bitmap(rowID=1)", index="i",
+                           lane="read", timeout_s=30)
+        with ctx.stage("execute"):
+            time.sleep(0.01)
+        ctx.add_leg("peer:10101", 7)
+        j = ctx.to_json()
+        assert j["index"] == "i" and j["lane"] == "read"
+        assert j["stages"]["execute"] >= 0.01
+        assert j["legs"] == [{"host": "peer:10101", "slices": 7}]
+        assert 0 < j["remainingS"] <= 30
+
+    def test_thread_local_propagation(self):
+        ctx = QueryContext()
+        assert sched_context.current() is None
+        with sched_context.use(ctx):
+            assert sched_context.current() is ctx
+            ctx.cancel()
+            with pytest.raises(QueryCancelledError):
+                sched_context.check_current()
+        assert sched_context.current() is None
+        sched_context.check_current()  # unbound: no raise
+
+
+# ---------------------------------------------------------------------------
+# AdmissionController
+
+
+class TestAdmission:
+    def test_cap_and_release(self):
+        ac = AdmissionController(concurrency=2, queue_depth=4)
+        s1, s2 = ac.acquire("read"), ac.acquire("read")
+        assert ac.in_flight == 2
+        s1.release()
+        s1.release()  # idempotent
+        assert ac.in_flight == 1
+        s2.release()
+        assert ac.in_flight == 0
+
+    def test_full_queue_rejects_with_retry_after(self):
+        ac = AdmissionController(concurrency=1, queue_depth=0)
+        slot = ac.acquire("read")
+        with pytest.raises(AdmissionFullError) as ei:
+            ac.acquire("read")
+        assert ei.value.retry_after_s >= 1
+        assert ac.snapshot()["rejected"] == 1
+        slot.release()
+        ac.acquire("read").release()  # capacity came back
+
+    def test_waiter_gets_slot_on_release(self):
+        ac = AdmissionController(concurrency=1, queue_depth=2)
+        slot = ac.acquire("read")
+        got = []
+
+        def waiter():
+            with ac.acquire("read"):
+                got.append(True)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.1)
+        assert not got  # queued behind the held slot
+        slot.release()
+        t.join(timeout=5)
+        assert got and ac.in_flight == 0
+
+    def test_deadline_expires_while_queued(self):
+        ac = AdmissionController(concurrency=1, queue_depth=2)
+        slot = ac.acquire("read")
+        ctx = QueryContext(timeout_s=0.1)
+        t0 = time.monotonic()
+        with pytest.raises(QueryDeadlineError):
+            ac.acquire("read", ctx)
+        assert time.monotonic() - t0 < 2
+        # The dead waiter left the queue; the slot is intact.
+        assert ac.snapshot()["queued"] == {}
+        slot.release()
+        assert ac.in_flight == 0
+
+    def test_cancel_while_queued(self):
+        ac = AdmissionController(concurrency=1, queue_depth=2)
+        slot = ac.acquire("read")
+        ctx = QueryContext()
+        threading.Timer(0.05, ctx.cancel).start()
+        with pytest.raises(QueryCancelledError):
+            ac.acquire("read", ctx)
+        slot.release()
+        assert ac.in_flight == 0
+
+    def test_weighted_lanes_share_under_contention(self):
+        """A write burst must not starve the admin lane: with
+        weights read:4/write:2/admin:1 and one slot, queued admin work
+        is granted interleaved with writes, not after all of them."""
+        ac = AdmissionController(concurrency=1, queue_depth=16)
+        gate = ac.acquire("read")
+        order = []
+        mu = threading.Lock()
+
+        def worker(lane):
+            with ac.acquire(lane):
+                with mu:
+                    order.append(lane)
+
+        threads = []
+        for _ in range(6):
+            threads.append(threading.Thread(target=worker,
+                                            args=("write",)))
+        threads.append(threading.Thread(target=worker, args=("admin",)))
+        for t in threads:
+            t.start()
+            time.sleep(0.02)  # deterministic FIFO arrival
+        time.sleep(0.1)
+        gate.release()
+        for t in threads:
+            t.join(timeout=10)
+        # Stride scheduling: admin (weight 1) lands before the write
+        # backlog fully drains (pure FIFO would put it last).
+        assert order.index("admin") < len(order) - 1
+        assert ac.in_flight == 0
+
+
+# ---------------------------------------------------------------------------
+# QueryRegistry
+
+
+class TestRegistry:
+    def test_track_and_active(self):
+        reg = QueryRegistry()
+        ctx = QueryContext(pql="Count(Bitmap(rowID=1))", index="i")
+        with reg.track(ctx):
+            assert len(reg) == 1
+            assert reg.active()[0]["id"] == ctx.id
+            assert reg.get(ctx.id) is ctx
+        assert len(reg) == 0 and ctx.state == "done"
+
+    def test_finish_records_error_state(self):
+        reg = QueryRegistry()
+        ctx = QueryContext()
+        with pytest.raises(RuntimeError):
+            with reg.track(ctx):
+                raise RuntimeError("boom")
+        assert ctx.state == "error" and len(reg) == 0
+
+    def test_cancel_local_cancels_whole_id_group(self):
+        reg = QueryRegistry()
+        a = QueryContext(id="q1")
+        b = QueryContext(id="q1")  # a leg registered under the same id
+        reg.register(a)
+        reg.register(b)
+        assert reg.cancel_local("q1") == 2
+        assert a.cancelled() and b.cancelled()
+        assert reg.cancel_local("missing") == 0
+
+    def test_slow_query_log(self):
+        reg = QueryRegistry(slow_threshold_s=0.01)
+        ctx = QueryContext(pql="TopN(frame=f, n=10)", index="i")
+        with reg.track(ctx), ctx.stage("execute"):
+            time.sleep(0.02)
+        slow = reg.slow_queries()
+        assert len(slow) == 1
+        assert slow[0]["pql"] == "TopN(frame=f, n=10)"
+        assert slow[0]["elapsedS"] >= 0.01
+        assert "execute" in slow[0]["stages"]
+
+    def test_fast_queries_stay_out_of_slow_log(self):
+        reg = QueryRegistry(slow_threshold_s=10)
+        with reg.track(QueryContext()):
+            pass
+        assert reg.slow_queries() == []
+
+
+# ---------------------------------------------------------------------------
+# Client: deadline-budget socket timeouts + retry loop
+
+
+class _BlackHole:
+    """Accepts TCP connections and never responds — a stalled peer."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(8)
+        self.host = "127.0.0.1:%d" % self.sock.getsockname()[1]
+
+    def close(self):
+        self.sock.close()
+
+
+class TestClientDeadline:
+    def test_stalled_peer_surfaces_deadline_not_double_timeout(self):
+        """The attempt's socket timeout is clamped to the remaining
+        budget, and the idempotent retry must NOT start once the
+        budget is gone — total elapsed ≈ the budget, not N × the
+        30s default client timeout."""
+        from pilosa_tpu.cluster.client import Client
+        hole = _BlackHole()
+        try:
+            client = Client(hole.host, timeout=30.0)
+            t0 = time.monotonic()
+            with pytest.raises(QueryDeadlineError):
+                client.execute_query(None, "i", "Count(Bitmap(rowID=1))",
+                                     deadline_s=0.4)
+            elapsed = time.monotonic() - t0
+            assert elapsed < 3, elapsed  # nowhere near 30s or 60s
+        finally:
+            hole.close()
+
+    def test_exhausted_budget_never_starts_an_attempt(self):
+        from pilosa_tpu.cluster.client import Client
+        hole = _BlackHole()
+        try:
+            client = Client(hole.host)
+            t0 = time.monotonic()
+            with pytest.raises(QueryDeadlineError):
+                client._do("GET", "/version", deadline_s=-1.0)
+            assert time.monotonic() - t0 < 0.5
+        finally:
+            hole.close()
+
+    def test_no_deadline_keeps_plain_client_error(self):
+        from pilosa_tpu.cluster.client import Client, ClientError
+        client = Client("127.0.0.1:1", timeout=0.2)  # nothing listens
+        with pytest.raises(ClientError):
+            client.execute_query(None, "i", "Count(Bitmap(rowID=1))")
+
+    def test_pooled_connection_timeout_restored_after_clamp(self):
+        """A budget-clamped request must not leave its tiny socket
+        timeout armed on the pooled connection — the next deadline-
+        free request re-arms the default (review finding)."""
+        from pilosa_tpu.cluster.client import Client
+        delay = {"s": 0.0}
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        host = "127.0.0.1:%d" % srv.getsockname()[1]
+        stop = threading.Event()
+
+        def serve():
+            while not stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                while not stop.is_set():
+                    try:
+                        if not conn.recv(65536):
+                            break
+                    except OSError:
+                        break
+                    time.sleep(delay["s"])
+                    conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                                 b"Content-Length: 2\r\n\r\n{}")
+                conn.close()
+
+        t = threading.Thread(target=serve, daemon=True)
+        t.start()
+        try:
+            client = Client(host, timeout=30.0)
+            # Fast request under a small budget: succeeds, and its
+            # connection (armed at ~0.5s) returns to the pool.
+            status, _ = client._do("GET", "/x", deadline_s=0.5)
+            assert status == 200
+            # Slow response on the SAME pooled connection with no
+            # deadline: must succeed under the restored 30s default
+            # (the leaked 0.5s timeout would raise mid-response).
+            delay["s"] = 0.8
+            status, _ = client._do("GET", "/x", idempotent=False)
+            assert status == 200
+        finally:
+            stop.set()
+            srv.close()
+
+    def test_routing_client_propagates_lifecycle_kwargs(self):
+        """The REAL server wiring (executor → _RoutingClient → pooled
+        Client) must carry deadline_s/query_id — without the marker the
+        whole fan-out propagation is dead code (review finding)."""
+        from pilosa_tpu.server.server import _RoutingClient
+        assert _RoutingClient.deadline_aware
+        seen = {}
+
+        class FakeClient:
+            def execute_query(self, node, index, query, slices,
+                              remote, pod_local=False, deadline_s=None,
+                              query_id=None):
+                seen.update(deadline_s=deadline_s, query_id=query_id)
+                return []
+
+        class FakeServer:
+            def client_for(self, host):
+                return FakeClient()
+
+        rc = _RoutingClient(FakeServer())
+        from pilosa_tpu.cluster.topology import Node
+        rc.execute_query(Node("peer:1"), "i", "Count(Bitmap(rowID=1))",
+                         None, remote=True, deadline_s=1.5,
+                         query_id="q77")
+        assert seen == {"deadline_s": 1.5, "query_id": "q77"}
+
+
+# ---------------------------------------------------------------------------
+# Ownership-gated fast paths (multi-node clusters keep device/host fast
+# paths for locally-owned work)
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+class TestOwnershipGates:
+    def _fill(self, holder, rows=3, slices=2):
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("general")
+        for r in range(rows):
+            for s in range(slices):
+                f.set_bit("standard", r, s * SLICE_WIDTH + r)
+
+    def test_owns_all_slices(self, holder):
+        # replica_n == cluster size: every node owns every slice.
+        full = Executor(holder, host="a",
+                        cluster=new_cluster(["a", "b"], replica_n=2))
+        assert full._owns_all_slices("i", list(range(16)))
+        # replica_n=1 splits ownership: some slice lands only on b.
+        split = Executor(holder, host="a",
+                         cluster=new_cluster(["a", "b"], replica_n=1))
+        assert not split._owns_all_slices("i", list(range(16)))
+        single = Executor(holder, host="only",
+                          cluster=new_cluster(["only"]))
+        assert single._owns_all_slices("i", list(range(16)))
+
+    def test_result_cache_engages_on_fully_replicated_cluster(self,
+                                                              holder):
+        self._fill(holder)
+        from pilosa_tpu.pql.parser import parse
+        ex = Executor(holder, host="a",
+                      cluster=new_cluster(["a", "b"], replica_n=2))
+        call = parse("Union(Bitmap(rowID=0), Bitmap(rowID=1))").calls[0]
+        assert ex._bitmap_result_key("i", call, [0, 1]) is not None
+
+    def test_result_cache_stays_off_on_split_ownership(self, holder):
+        self._fill(holder)
+        from pilosa_tpu.pql.parser import parse
+        ex = Executor(holder, host="a",
+                      cluster=new_cluster(["a", "b"], replica_n=1))
+        call = parse("Union(Bitmap(rowID=0), Bitmap(rowID=1))").calls[0]
+        assert ex._bitmap_result_key("i", call, list(range(4))) is None
+
+    def test_single_pass_topn_engages_on_fully_replicated_cluster(
+            self, holder):
+        self._fill(holder, rows=5, slices=2)
+        from pilosa_tpu.pql.parser import parse
+        ex = Executor(holder, host="a",
+                      cluster=new_cluster(["a", "b"], replica_n=2))
+        call = parse('TopN(frame="general", n=3)').calls[0]
+        fast = ex._topn_host_single_pass("i", call, [0, 1],
+                                         ExecOptions())
+        assert fast is not None
+        # And it matches the general (fan-out) path's answer.
+        general = ex._top_n_slices("i", call, [0, 1], ExecOptions())
+        assert [(p.id, p.count) for p in fast[:3]] == \
+            [(p.id, p.count) for p in general[:3]]
+
+
+# ---------------------------------------------------------------------------
+# In-process server: end-to-end lifecycle over real HTTP
+
+
+def make_server(tmp_path, name="s", **qc):
+    s = Server(str(tmp_path / name), host="127.0.0.1:0",
+               anti_entropy_interval=0, polling_interval=0,
+               query_config=QueryConfig(**qc))
+    s.open()
+    return s
+
+
+def http_post(host, path, body=b"", headers=None):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST", headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def http_get(host, path):
+    with urllib.request.urlopen(f"http://{host}{path}", timeout=30) as r:
+        return json.loads(r.read())
+
+
+class _SlowExecutor:
+    """Delegating wrapper that busy-waits (cooperatively checking the
+    query context) — a stand-in for a genuinely long query."""
+
+    def __init__(self, real, seconds=30.0):
+        self._real = real
+        self._seconds = seconds
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+    def execute(self, index, query, slices=None, opt=None, **kw):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < self._seconds:
+            if opt is not None and opt.ctx is not None:
+                opt.ctx.check()
+            time.sleep(0.005)
+        return self._real.execute(index, query, slices, opt, **kw)
+
+
+class TestServerLifecycle:
+    @pytest.fixture
+    def server(self, tmp_path):
+        s = make_server(tmp_path, concurrency=2, queue_depth=1,
+                        slow_threshold=0.0)
+        http_post(s.host, "/index/i")
+        http_post(s.host, "/index/i/frame/f")
+        http_post(s.host, "/index/i/query",
+                  b'SetBit(frame="f", rowID=1, columnID=3)')
+        yield s
+        s.close()
+
+    def test_query_id_header_and_debug_queries_empty(self, server):
+        st, _, hdrs = http_post(server.host, "/index/i/query",
+                                b'Bitmap(frame="f", rowID=1)')
+        assert st == 200 and hdrs.get("X-Pilosa-Query-Id")
+        dq = http_get(server.host, "/debug/queries")
+        assert dq["queries"] == []
+        assert dq["admission"]["inFlight"] == 0
+
+    def test_timeout_param_returns_504_within_budget(self, server):
+        server.handler.executor = _SlowExecutor(server.executor)
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_post(server.host, "/index/i/query?timeout=200ms",
+                      b'Bitmap(frame="f", rowID=1)')
+        assert ei.value.code == 504
+        assert time.monotonic() - t0 < 5
+        assert b"deadline" in ei.value.read()
+
+    def test_deadline_header_wins_and_propagates_form(self, server):
+        server.handler.executor = _SlowExecutor(server.executor)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_post(server.host, "/index/i/query",
+                      b'Bitmap(frame="f", rowID=1)',
+                      headers={"X-Pilosa-Deadline": "0.2"})
+        assert ei.value.code == 504
+
+    def test_saturation_answers_429_with_retry_after(self, server):
+        server.handler.executor = _SlowExecutor(server.executor)
+        threads = [threading.Thread(
+            target=lambda: self._swallow(server, "timeout=3s"))
+            for _ in range(3)]  # 2 slots + 1 queue seat
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_post(server.host, "/index/i/query",
+                          b'Bitmap(frame="f", rowID=1)')
+            assert ei.value.code == 429
+            assert int(ei.value.headers["Retry-After"]) >= 1
+        finally:
+            for ctx in [server.query_registry.get(q["id"])
+                        for q in server.query_registry.active()]:
+                if ctx is not None:
+                    ctx.cancel()
+            for t in threads:
+                t.join(timeout=10)
+
+    @staticmethod
+    def _swallow(server, qs=""):
+        try:
+            http_post(server.host, f"/index/i/query?{qs}",
+                      b'Bitmap(frame="f", rowID=1)')
+        except urllib.error.HTTPError:
+            pass
+
+    def test_debug_queries_lists_in_flight_and_delete_cancels(
+            self, server):
+        server.handler.executor = _SlowExecutor(server.executor)
+        res = {}
+
+        def bg():
+            try:
+                http_post(server.host, "/index/i/query",
+                          b'Count(Bitmap(frame="f", rowID=1))')
+            except urllib.error.HTTPError as e:
+                res["code"] = e.code
+                res["body"] = e.read()
+
+        t = threading.Thread(target=bg)
+        t.start()
+        deadline = time.monotonic() + 5
+        qs = []
+        while time.monotonic() < deadline and not qs:
+            qs = http_get(server.host, "/debug/queries")["queries"]
+            time.sleep(0.02)
+        assert qs, "query never appeared in /debug/queries"
+        q = qs[0]
+        assert q["pql"].startswith("Count(") and q["state"] == "running"
+        assert q["index"] == "i" and q["lane"] == "read"
+        req = urllib.request.Request(
+            f"http://{server.host}/debug/queries/{q['id']}",
+            method="DELETE")
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert out == {"id": q["id"], "cancelled": 1}
+        t.join(timeout=10)
+        assert res["code"] == 409 and b"cancelled" in res["body"]
+        assert http_get(server.host, "/debug/queries")["queries"] == []
+        assert server.admission.in_flight == 0
+
+    def test_queued_deadline_maps_to_504_not_400(self, server):
+        """A deadline expiring while the query WAITS in admission must
+        surface as 504, same as any other expiry (review finding: the
+        generic PilosaError catch used to turn it into a 400)."""
+        server.handler.executor = _SlowExecutor(server.executor)
+        # Fill both slots (cap 2) with long-deadline queries.
+        threads = [threading.Thread(
+            target=lambda: self._swallow(server, "timeout=5s"))
+            for _ in range(2)]
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                http_post(server.host, "/index/i/query?timeout=300ms",
+                          b'Bitmap(frame="f", rowID=1)')
+            assert ei.value.code == 504
+        finally:
+            for q in server.query_registry.active():
+                server.query_registry.cancel_local(q["id"])
+            for t in threads:
+                t.join(timeout=10)
+        assert server.admission.in_flight == 0
+
+    def test_queued_query_visible_and_cancellable(self, server):
+        """Queries waiting in admission appear at /debug/queries (state
+        'queued') and DELETE cancels them out of the queue → 409
+        (review finding: they used to register only after admission)."""
+        server.handler.executor = _SlowExecutor(server.executor)
+        runners = [threading.Thread(
+            target=lambda: self._swallow(server, "timeout=5s"))
+            for _ in range(2)]
+        for t in runners:
+            t.start()
+        time.sleep(0.4)
+        res = {}
+
+        def queued():
+            try:
+                http_post(server.host, "/index/i/query",
+                          b'Count(Bitmap(frame="f", rowID=9))')
+            except urllib.error.HTTPError as e:
+                res["code"] = e.code
+
+        q = threading.Thread(target=queued)
+        q.start()
+        try:
+            deadline = time.monotonic() + 5
+            waiting = []
+            while time.monotonic() < deadline and not waiting:
+                waiting = [x for x in http_get(
+                    server.host, "/debug/queries")["queries"]
+                    if x["state"] == "queued"]
+                time.sleep(0.02)
+            assert waiting, "queued query never became visible"
+            req = urllib.request.Request(
+                f"http://{server.host}/debug/queries/"
+                f"{waiting[0]['id']}", method="DELETE")
+            urllib.request.urlopen(req, timeout=10).read()
+            q.join(timeout=10)
+            assert res["code"] == 409
+        finally:
+            for x in server.query_registry.active():
+                server.query_registry.cancel_local(x["id"])
+            for t in runners:
+                t.join(timeout=10)
+            q.join(timeout=10)
+        assert server.admission.in_flight == 0
+        assert len(server.query_registry) == 0
+
+    def test_delete_unknown_query_is_noop(self, server):
+        req = urllib.request.Request(
+            f"http://{server.host}/debug/queries/deadbeef",
+            method="DELETE")
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert out["cancelled"] == 0
+
+    def test_slow_query_log_through_http(self, tmp_path):
+        s = make_server(tmp_path, "slow", slow_threshold=0.01)
+        try:
+            http_post(s.host, "/index/i")
+            http_post(s.host, "/index/i/frame/f")
+            s.handler.executor = _SlowExecutor(s.executor, seconds=0.05)
+            http_post(s.host, "/index/i/query",
+                      b'Bitmap(frame="f", rowID=1)')
+            slow = http_get(s.host, "/debug/queries")["slow"]
+            assert len(slow) == 1
+            assert slow[0]["pql"] == 'Bitmap(frame="f", rowID=1)'
+            assert "execute" in slow[0]["stages"]
+        finally:
+            s.close()
+
+    def test_receive_message_cancels_registered_query(self, server):
+        """The cluster-wide cancel path: a CancelQueryMessage arriving
+        through the broadcast plane cancels the local legs."""
+        ctx = QueryContext(id="abc123", pql="Count()")
+        server.query_registry.register(ctx)
+        try:
+            server.receive_message(CancelQueryMessage("abc123"))
+            assert ctx.cancelled()
+        finally:
+            server.query_registry.finish(ctx)
+
+    def test_delete_broadcasts_cancel(self, server):
+        sent = []
+
+        class Spy:
+            def send_async(self, m):
+                sent.append(m)
+
+            send_sync = send_async
+
+        server.handler.broadcaster = Spy()
+        req = urllib.request.Request(
+            f"http://{server.host}/debug/queries/xyz", method="DELETE")
+        urllib.request.urlopen(req, timeout=10).read()
+        assert len(sent) == 1 and isinstance(sent[0],
+                                             CancelQueryMessage)
+        assert sent[0].id == "xyz"
+        # ?local=true suppresses the re-broadcast (the form the
+        # receive path uses, avoiding loops).
+        req = urllib.request.Request(
+            f"http://{server.host}/debug/queries/xyz?local=true",
+            method="DELETE")
+        urllib.request.urlopen(req, timeout=10).read()
+        assert len(sent) == 1
+
+
+class TestDeadlineStorm:
+    def test_staggered_expiries_free_every_slot(self, tmp_path):
+        """N concurrent queries with staggered deadlines against a
+        slow executor: every one expires (504), every expiry frees its
+        executor slot and registry entry — none leak."""
+        s = make_server(tmp_path, "storm", concurrency=4,
+                        queue_depth=16)
+        try:
+            http_post(s.host, "/index/i")
+            http_post(s.host, "/index/i/frame/f")
+            s.handler.executor = _SlowExecutor(s.executor)
+            codes = []
+            mu = threading.Lock()
+
+            def one(timeout_ms):
+                try:
+                    http_post(s.host,
+                              f"/index/i/query?timeout={timeout_ms}ms",
+                              b'Count(Bitmap(frame="f", rowID=1))')
+                    code = 200
+                except urllib.error.HTTPError as e:
+                    code = e.code
+                with mu:
+                    codes.append(code)
+
+            threads = [threading.Thread(target=one,
+                                        args=(50 + 25 * k,))
+                       for k in range(12)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            assert time.monotonic() - t0 < 20
+            assert len(codes) == 12
+            assert all(c == 504 for c in codes), codes
+            # Nothing leaked: no slots held, no registry entries.
+            assert s.admission.in_flight == 0
+            assert len(s.query_registry) == 0
+            snap = s.admission.snapshot()
+            assert snap["queued"] == {}
+        finally:
+            s.close()
+
+
+class TestQueryConfig:
+    def test_sub_second_durations_round_trip(self, tmp_path):
+        """to_toml must not truncate 0.5s → "0s" (= disabled) for the
+        [query] durations (review finding)."""
+        from pilosa_tpu.utils import config as config_mod
+        cfg = config_mod.Config()
+        cfg.query.default_timeout = 0.5
+        cfg.query.slow_threshold = 0.25
+        cfg.query.concurrency = 3
+        path = tmp_path / "cfg.toml"
+        path.write_text(cfg.to_toml())
+        if config_mod.tomllib is None:
+            pytest.skip("no TOML parser on this interpreter")
+        got = config_mod.load(str(path), env={})
+        assert got.query.default_timeout == 0.5
+        assert got.query.slow_threshold == 0.25
+        assert got.query.concurrency == 3
+
+    def test_env_overrides(self):
+        from pilosa_tpu.utils import config as config_mod
+        cfg = config_mod.load(env={
+            "PILOSA_QUERY_CONCURRENCY": "7",
+            "PILOSA_QUERY_QUEUE_DEPTH": "9",
+            "PILOSA_QUERY_DEFAULT_TIMEOUT": "2s",
+            "PILOSA_QUERY_SLOW_THRESHOLD": "150ms"})
+        assert cfg.query.concurrency == 7
+        assert cfg.query.queue_depth == 9
+        assert cfg.query.default_timeout == 2.0
+        assert cfg.query.slow_threshold == 0.15
+
+
+class TestWarmup:
+    def test_warmup_compiles_and_reports_done(self, tmp_path,
+                                              monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_WARMUP", "1")
+        s = Server(str(tmp_path / "warm"), host="127.0.0.1:0",
+                   anti_entropy_interval=0, polling_interval=0)
+        s.open()
+        try:
+            assert s.warmup is not None
+            s.warmup.wait(timeout=120)
+            status = http_get(s.host, "/status")
+            assert status["warmup"]["state"] == "done", status["warmup"]
+            assert set(status["warmup"]["compiled"]) == {
+                "count_fold", "topn_exact", "bsi_compare_select"}
+        finally:
+            s.close()
+
+    def test_warmup_absent_when_disabled(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PILOSA_TPU_WARMUP", "0")
+        s = Server(str(tmp_path / "cold"), host="127.0.0.1:0",
+                   anti_entropy_interval=0, polling_interval=0)
+        s.open()
+        try:
+            assert s.warmup is None
+            assert "warmup" not in http_get(s.host, "/status")
+        finally:
+            s.close()
