@@ -227,6 +227,15 @@ def load_server_config(args, env=None):
         cfg.query.default_timeout = args.query_default_timeout
     if getattr(args, "query_slow_threshold", None) is not None:
         cfg.query.slow_threshold = args.query_slow_threshold
+    from ..utils.config import _parse_bool
+    if getattr(args, "metrics_enabled", None) is not None:
+        cfg.metrics.enabled = _parse_bool(args.metrics_enabled)
+    if getattr(args, "metrics_runtime_interval", None) is not None:
+        cfg.metrics.runtime_interval = args.metrics_runtime_interval
+    if getattr(args, "trace_enabled", None) is not None:
+        cfg.trace.enabled = _parse_bool(args.trace_enabled)
+    if getattr(args, "trace_max_traces", None) is not None:
+        cfg.trace.max_traces = args.trace_max_traces
     return cfg
 
 
@@ -270,7 +279,8 @@ def cmd_server(args, stdout, stderr) -> int:
                     cluster=cluster, broadcast_receiver=broadcast_receiver,
                     anti_entropy_interval=cfg.anti_entropy_interval,
                     polling_interval=cfg.cluster.polling_interval,
-                    logger=logger, query_config=cfg.query)
+                    logger=logger, query_config=cfg.query,
+                    metrics_config=cfg.metrics, trace_config=cfg.trace)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -561,6 +571,24 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--anti-entropy.interval", dest="anti_entropy_interval",
                    type=parse_duration, default=None, metavar="DUR",
                    help="anti-entropy sweep interval (e.g. 10m)")
+    # Observability flags (obs subsystem; docs/OBSERVABILITY.md).
+    s.add_argument("--metrics.enabled", dest="metrics_enabled",
+                   default=None, metavar="BOOL",
+                   help="serve Prometheus /metrics + feed the registry"
+                        " from every stats call site (default true)")
+    s.add_argument("--metrics.runtime-interval",
+                   dest="metrics_runtime_interval", type=parse_duration,
+                   default=None, metavar="DUR",
+                   help="runtime collector sampling interval"
+                        " (default 10s)")
+    s.add_argument("--trace.enabled", dest="trace_enabled",
+                   default=None, metavar="BOOL",
+                   help="trace every query (default false; any single"
+                        " request can opt in with ?trace=1)")
+    s.add_argument("--trace.max-traces", dest="trace_max_traces",
+                   type=int, default=None, metavar="N",
+                   help="recent traces kept per node for /debug/traces"
+                        " (default 64)")
     # Profiling flags (reference cmd/server.go:47-62,99-100).
     s.add_argument("--profile.cpu", dest="profile_cpu", default="",
                    metavar="PATH",

@@ -23,8 +23,10 @@ from .. import SLICE_WIDTH
 from ..utils.arrays import group_by_key
 from ..errors import (FragmentNotFoundError, PilosaError,
                       QueryDeadlineError)
+from ..obs.trace import SPANS_HEADER, TRACE_HEADER
 from ..pql import parser as pql
 from ..proto import internal_pb2 as pb
+from ..sched import context as sched_context
 from .topology import Node
 
 _PROTOBUF = "application/x-protobuf"
@@ -121,7 +123,8 @@ class Client:
     def _do(self, method: str, path: str, body: Optional[bytes] = None,
             headers: Optional[dict] = None, host: Optional[str] = None,
             idempotent: Optional[bool] = None,
-            deadline_s: Optional[float] = None) -> tuple[int, bytes]:
+            deadline_s: Optional[float] = None,
+            headers_out: Optional[list] = None) -> tuple[int, bytes]:
         """``idempotent`` overrides the per-method default for POST
         endpoints that are safe to replay (queries, attr diffs, create-
         if-not-exists) — those keep the transparent stale-keep-alive
@@ -178,6 +181,8 @@ class Client:
                 sent = True
                 resp = conn.getresponse()
                 data = resp.read()
+                if headers_out is not None:
+                    headers_out.extend(resp.getheaders())
                 if resp.will_close:
                     conn.close()
                 else:
@@ -259,11 +264,26 @@ class Client:
             headers["X-Pilosa-Deadline"] = f"{deadline_s:.6f}"
         if query_id:
             headers["X-Pilosa-Query-Id"] = query_id
+        # Distributed tracing: when the calling thread carries a traced
+        # query (the executor binds it via sched_context.use), ask the
+        # peer to trace its leg and stitch the spans it piggybacks on
+        # the response header back into the originating trace.
+        ctx = sched_context.current()
+        trace = getattr(ctx, "trace", None) if ctx is not None else None
+        headers_out: Optional[list] = None
+        if trace is not None:
+            headers[TRACE_HEADER] = "1"
+            headers_out = []
         status, raw = self._do(
             "POST", path, body, headers,
             host=_host_of(node) if node is not None else None,
             idempotent=True,  # PQL writes set absolute state — replayable
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, headers_out=headers_out)
+        if trace is not None and headers_out:
+            for hk, hv in headers_out:
+                if hk.lower() == SPANS_HEADER.lower():
+                    trace.add_remote_json(hv)
+                    break
         self._ok(status, raw, "execute query")
         resp = pb.QueryResponse.FromString(raw)
         if resp.Err:

@@ -38,6 +38,8 @@ from .cluster.topology import Cluster, Node, new_cluster
 from .errors import (TIME_FORMAT, FrameNotFoundError, IndexNotFoundError,
                      PilosaError, QueryCancelledError, QueryDeadlineError,
                      QueryRequiredError, SliceUnavailableError)
+from .obs import metrics as obs_metrics
+from .obs import trace as obs_trace
 from .sched import context as sched_context
 from . import SLICE_WIDTH
 from .models.view import VIEW_INVERSE, VIEW_STANDARD
@@ -57,6 +59,16 @@ MIN_THRESHOLD = 1
 
 _WRITE_CALLS = ("SetBit", "ClearBit", "SetFieldValue", "SetRowAttrs",
                 "SetColumnAttrs")
+
+
+def _ctx_span(ctx, name: str, **tags):
+    """A span on ``ctx``'s trace, or the shared no-op when the query
+    is untraced (the default): the fan-out layers instrument through
+    this so an untraced query allocates no Span objects."""
+    trace = getattr(ctx, "trace", None) if ctx is not None else None
+    if trace is None:
+        return obs_trace.NOP_SPAN
+    return trace.span(name, **tags)
 
 
 @dataclass
@@ -2406,20 +2418,30 @@ class Executor:
             raise SliceUnavailableError(
                 f"no client to reach remote node {node.host}")
         ctx = opt.ctx
-        if ctx is not None and getattr(self.client, "deadline_aware",
-                                       False):
-            # The peer inherits the REMAINING budget (not the original)
-            # and the query id, so its leg registers under the same
-            # query and a cluster cancel finds it; the client clamps
-            # socket timeouts + its idempotent retry to the budget.
-            # Scripted test fakes without the marker keep the plain
-            # call shape.
-            ctx.check()
-            return self.client.execute_query(
-                node, index, str(query), slices, remote=True,
-                deadline_s=ctx.remaining(), query_id=ctx.id)
-        return self.client.execute_query(node, index, str(query), slices,
-                                         remote=True)
+        t0 = time.perf_counter()
+        try:
+            with _ctx_span(ctx, "rpc", peer=node.host,
+                           slices=len(slices) if slices else 0):
+                if ctx is not None and getattr(self.client,
+                                               "deadline_aware", False):
+                    # The peer inherits the REMAINING budget (not the
+                    # original) and the query id, so its leg registers
+                    # under the same query and a cluster cancel finds
+                    # it; the client clamps socket timeouts + its
+                    # idempotent retry to the budget. Scripted test
+                    # fakes without the marker keep the plain call
+                    # shape.
+                    ctx.check()
+                    return self.client.execute_query(
+                        node, index, str(query), slices, remote=True,
+                        deadline_s=ctx.remaining(), query_id=ctx.id)
+                return self.client.execute_query(node, index,
+                                                 str(query), slices,
+                                                 remote=True)
+        finally:
+            obs_metrics.RPC_SECONDS.labels(
+                peer=node.host, kind="query").observe(
+                    time.perf_counter() - t0)
 
     # -- map-reduce core (executor.go:1087-1236) -----------------------------
 
@@ -2489,8 +2511,14 @@ class Executor:
                 if ctx is not None:
                     ctx.add_leg(node.host, len(node_slices))
 
-        submit(nodes, slices)
+        # One span covers the whole fan-out INCLUDING the reduce/merge
+        # of completed legs (per-leg detail comes from the leg/rpc
+        # spans recorded inside _mapper_node).
+        span = _ctx_span(ctx, "map_reduce", call=c.name,
+                         slices=len(slices))
+        span.__enter__()
         try:
+            submit(nodes, slices)
             while processed < len(slices):
                 if ctx is None:
                     done, _ = wait(list(futures),
@@ -2520,9 +2548,11 @@ class Executor:
                         except SliceUnavailableError:
                             raise e
                         continue
-                    result = reduce_fn(result, r)
+                    with _ctx_span(ctx, "merge", host=node.host):
+                        result = reduce_fn(result, r)
                     processed += len(node_slices)
         finally:
+            span.__exit__(None, None, None)
             # On an error path, drain what we started: the pool is
             # shared with other queries, and the old per-query pool's
             # exit joined its legs — keep that (cancel what hasn't
@@ -2550,15 +2580,18 @@ class Executor:
             if opt.ctx is not None:
                 opt.ctx.check()
             if node.host == self.host:
-                if local_fn is not None:
-                    r = local_fn(slices)
-                    if r is not NotImplemented:
-                        return r
-                if (self.pod is not None and self.pod.is_coordinator
-                        and not opt.pod_local):
-                    return self._pod_host_mapper(index, c, slices, opt,
-                                                 map_fn, reduce_fn)
-                return self._mapper_local(slices, map_fn, reduce_fn)
+                with _ctx_span(opt.ctx, "leg", host=node.host or "local",
+                               slices=len(slices)):
+                    if local_fn is not None:
+                        r = local_fn(slices)
+                        if r is not NotImplemented:
+                            return r
+                    if (self.pod is not None and self.pod.is_coordinator
+                            and not opt.pod_local):
+                        return self._pod_host_mapper(index, c, slices,
+                                                     opt, map_fn,
+                                                     reduce_fn)
+                    return self._mapper_local(slices, map_fn, reduce_fn)
             results = self._exec_remote(node, index, Query([c]), slices,
                                         opt)
             return results[0] if results else None

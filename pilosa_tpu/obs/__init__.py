@@ -1,0 +1,25 @@
+"""Observability subsystem: metrics, distributed tracing, and the
+runtime collector.
+
+- ``obs.metrics`` — a Prometheus-style registry (labeled counters,
+  gauges, log-bucketed histograms) rendered at ``GET /metrics``; every
+  metric family the server emits is declared there at import, and a
+  ``RegistryStatsClient`` bridge feeds legacy ``StatsClient`` call
+  sites into the same registry so no call site changes twice.
+- ``obs.trace`` — per-query distributed traces: spans opened at parse,
+  admission, executor fan-out, per-leg RPCs, mesh dispatch, and XLA
+  compile; remote legs return their spans piggybacked on the internal
+  query response and the coordinator stitches them under one trace id
+  (the query id riding ``X-Pilosa-Query-Id``). A bounded per-node ring
+  serves ``GET /debug/traces`` and Chrome trace-event export.
+- ``obs.runtime`` — a background collector sampling holder/cache/
+  residency sizes, thread activity, and the XLA compile-cache
+  counters (parallel.mesh.compile_stats) into gauges and ``/status``.
+
+See docs/OBSERVABILITY.md for the metric name reference, the trace
+header contract, and the perfetto how-to.
+"""
+
+from .metrics import (RegistryStatsClient, Registry,  # noqa: F401
+                      default_registry)
+from .trace import Tracer, get_tracer, span_current  # noqa: F401

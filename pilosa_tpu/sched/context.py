@@ -67,6 +67,11 @@ class QueryContext:
         self._mu = threading.Lock()
         self.stages: dict[str, float] = {}
         self.legs: list[dict] = []
+        # Distributed-tracing attachment (obs.trace.Trace), bound by
+        # the tracer when tracing is on. None (the default) is the
+        # no-allocation fast path: stage() and span_current() check it
+        # and record nothing.
+        self.trace = None
 
     # -- budget --------------------------------------------------------------
 
@@ -115,14 +120,18 @@ class QueryContext:
     @contextmanager
     def stage(self, name: str):
         """Record wall time of one pipeline stage (accumulating —
-        a stage may run more than once, e.g. per-leg encode)."""
+        a stage may run more than once, e.g. per-leg encode). When a
+        trace is attached, the stage doubles as a span."""
         t0 = time.perf_counter()
+        t0_wall = time.time() if self.trace is not None else 0.0
         try:
             yield
         finally:
+            dt = time.perf_counter() - t0
             with self._mu:
-                self.stages[name] = (self.stages.get(name, 0.0)
-                                     + time.perf_counter() - t0)
+                self.stages[name] = self.stages.get(name, 0.0) + dt
+            if self.trace is not None:
+                self.trace.add_span(name, t0_wall, dt)
 
     def add_leg(self, host: str, n_slices: int) -> None:
         """Record a map-reduce leg (node host + slice count) for

@@ -27,6 +27,8 @@ from ..cluster.broadcast import (NOP_BROADCASTER, CancelQueryMessage,
 from ..errors import (FrameExistsError, IndexExistsError, PilosaError,
                       QueryCancelledError, QueryDeadlineError,
                       validate_label)
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..sched import (LANE_ADMIN, LANE_READ, LANE_WRITE, AdmissionFullError,
                      QueryContext, QueryRegistry)
 from ..models.frame import Field, FrameOptions
@@ -187,7 +189,8 @@ class Handler:
                  broadcaster=NOP_BROADCASTER, broadcast_handler=None,
                  status_handler=None, stats=None, client_factory=None,
                  pod=None, logger=None, admission=None, registry=None,
-                 warmup=None, default_timeout_s: float = 0.0):
+                 warmup=None, default_timeout_s: float = 0.0,
+                 tracer=None, runtime=None):
         from ..utils import logger as logger_mod
         self.logger = logger or logger_mod.NOP
         self.holder = holder
@@ -210,8 +213,15 @@ class Handler:
             else QueryRegistry(logger=self.logger)
         self.warmup = warmup
         self.default_timeout_s = default_timeout_s or 0.0
+        # Observability (obs subsystem): a per-node tracer (disabled by
+        # default — bare handlers still honor per-request ?trace=1) and
+        # the runtime collector behind /status and /metrics freshness.
+        self.tracer = tracer if tracer is not None \
+            else obs_trace.Tracer(enabled=False)
+        self.runtime = runtime
         self.version = __version__
-        self._routes: list[tuple[str, re.Pattern, Callable]] = []
+        # (method, regex, handler, admission lane, raw pattern)
+        self._routes: list[tuple] = []
         self._add_routes()
 
     # -- routing -------------------------------------------------------------
@@ -221,9 +231,12 @@ class Handler:
         # {name} segments become named groups matching one path segment.
         # ``lane`` routes the whole handler through that admission lane
         # (the query handler manages its own slot — deadline-aware, and
-        # remote legs bypass — so it stays lane=None here).
+        # remote legs bypass — so it stays lane=None here). The raw
+        # pattern is kept for introspection (the README route-table
+        # sweep test walks it).
         regex = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
-        self._routes.append((method, re.compile(f"^{regex}$"), fn, lane))
+        self._routes.append((method, re.compile(f"^{regex}$"), fn, lane,
+                             pattern))
 
     def _add_routes(self) -> None:
         # Route table (reference handler.go:82-120).
@@ -259,8 +272,12 @@ class Handler:
         r("PATCH", "/index/{index}/time-quantum",
           self._handle_patch_index_time_quantum, lane=LANE_ADMIN)
         r("GET", "/debug/queries", self._handle_debug_queries)
+        r("GET", "/debug/queries/slow", self._handle_debug_slow_queries)
         r("DELETE", "/debug/queries/{qid}", self._handle_delete_query)
+        r("GET", "/debug/traces", self._handle_debug_traces)
+        r("GET", "/debug/traces/{qid}", self._handle_debug_trace)
         r("GET", "/debug/vars", self._handle_expvar)
+        r("GET", "/metrics", self._handle_metrics)
         r("GET", "/debug/pprof", self._handle_pprof_index)
         r("GET", "/debug/pprof/", self._handle_pprof_index)
         r("GET", "/debug/pprof/profile", self._handle_pprof_profile)
@@ -290,7 +307,7 @@ class Handler:
         if head:
             method = "GET"
         matched_path = False
-        for m, regex, fn, lane in self._routes:
+        for m, regex, fn, lane, _pattern in self._routes:
             match = regex.match(path)
             if match is None:
                 continue
@@ -363,8 +380,12 @@ class Handler:
                               for n in nodes])
 
     def _handle_get_status(self, req: Request) -> Response:
-        # Cold-start warmup state (sched.warmup) rides the JSON forms.
+        # Cold-start warmup state (sched.warmup) and the runtime
+        # collector sample (obs.runtime — holder/residency sizes,
+        # compile-cache hit/miss counters) ride the JSON forms.
         warm = self.warmup.to_json() if self.warmup is not None else None
+        runtime = (self.runtime.snapshot()
+                   if self.runtime is not None else None)
         if self.status_handler is not None:
             cs = self.status_handler.cluster_status()  # pb.ClusterStatus
             if _PROTOBUF in req.accept:
@@ -380,12 +401,16 @@ class Handler:
                 for ns in cs.Nodes]}}
             if warm is not None:
                 out["warmup"] = warm
+            if runtime is not None:
+                out["runtime"] = runtime
             return Response.json(out)
         states = self.cluster.node_states() if self.cluster else {}
         out = {"status": {"Nodes": [
             {"Host": h, "State": s} for h, s in sorted(states.items())]}}
         if warm is not None:
             out["warmup"] = warm
+        if runtime is not None:
+            out["runtime"] = runtime
         return Response.json(out)
 
     def _handle_expvar(self, req: Request) -> Response:
@@ -661,6 +686,7 @@ class Handler:
         if frame.field(field_name) is None:
             raise HTTPError(404, "field not found")
         frame.import_field_values(field_name, cols, vals)
+        obs_metrics.IMPORT_BITS.labels("field_values").inc(len(cols))
         if req.content_type == _PROTOBUF:
             return Response.proto(pb.ImportResponse())
         return Response.json({})
@@ -705,6 +731,7 @@ class Handler:
         except AdmissionFullError as e:
             if self.stats is not None:
                 self.stats.count("queriesRejected", 1)
+            obs_metrics.ADMISSION_REJECTED.labels(lane).inc()
             raise HTTPError(
                 429, f"too many requests: {e}",
                 headers=[("Retry-After",
@@ -744,6 +771,45 @@ class Handler:
                 self.logger.printf("cancel broadcast failed: %s", e)
         return Response.json({"id": qid, "cancelled": n})
 
+    # -- observability (obs subsystem; docs/OBSERVABILITY.md) ----------------
+
+    def _handle_debug_slow_queries(self, req: Request) -> Response:
+        """The slow-query log over HTTP: recent entries with per-stage
+        timings and the query/trace id (PR 2's log was stderr-only —
+        unusable without grepping server logs)."""
+        return Response.json({"slow": self.registry.slow_queries()})
+
+    def _handle_metrics(self, req: Request) -> Response:
+        """Prometheus text exposition of the process registry. Only
+        the CHEAP admission gauges refresh at scrape time; the heavy
+        samplers (the O(fragments) holder walk, compile/residency
+        snapshots) stay on the runtime collector's background cadence
+        — a scrape must not get slower as the index grows."""
+        if self.admission is not None:
+            adm = self.admission.snapshot()
+            obs_metrics.ADMISSION_IN_FLIGHT.set(adm.get("inFlight", 0))
+            for lane, depth in (adm.get("queued") or {}).items():
+                obs_metrics.ADMISSION_QUEUE_DEPTH.labels(lane).set(depth)
+        body = obs_metrics.default_registry().render().encode()
+        return Response(200, body,
+                        "text/plain; version=0.0.4; charset=utf-8")
+
+    def _handle_debug_traces(self, req: Request) -> Response:
+        return Response.json({"enabled": self.tracer.enabled,
+                              "traces": self.tracer.traces()})
+
+    def _handle_debug_trace(self, req: Request) -> Response:
+        """One trace as Chrome trace-event JSON (open in perfetto);
+        ``?format=spans`` returns the raw span list instead."""
+        trace = self.tracer.get(req.vars["qid"])
+        if trace is None:
+            raise HTTPError(404, "trace not found")
+        if req.query.get("format") == "spans":
+            return Response.json(
+                {"id": trace.id,
+                 "spans": [s.to_json() for s in trace.spans()]})
+        return Response.json(trace.to_chrome())
+
     # -- query ---------------------------------------------------------------
 
     def _handle_post_query(self, req: Request) -> Response:
@@ -775,10 +841,14 @@ class Handler:
             column_attrs = req.query.get("columnAttrs") == "true"
             remote = False
 
+        import time as time_mod
+        parse_wall = time_mod.time()
+        parse_t0 = time_mod.perf_counter()
         try:
             query = pql.parse(query_str)
         except PilosaError as e:
             return error_resp(400, str(e))
+        parse_s = time_mod.perf_counter() - parse_t0
 
         # Lifecycle: classify the lane, build the QueryContext (remote
         # legs inherit the coordinator's id + remaining budget via
@@ -792,6 +862,33 @@ class Handler:
             timeout_s=self._query_timeout_s(req),
             id=self.environ_header(req, "HTTP_X_PILOSA_QUERY_ID") or None,
             remote=remote, node=self.host)
+        ctx.stages["parse"] = parse_s
+        # Distributed tracing (obs.trace): traced when this node's
+        # tracer is on, the request opts in (?trace=1), or a
+        # coordinator asked this forwarded leg to trace itself
+        # (X-Pilosa-Trace) — remote legs piggyback their spans back on
+        # the response for stitching. None (the default) allocates no
+        # spans anywhere below.
+        trace = None
+        if (self.tracer.enabled or req.query.get("trace") == "1"
+                or (remote and self.environ_header(
+                    req, "HTTP_X_PILOSA_TRACE") == "1")):
+            trace = self.tracer.start(ctx, node=self.host)
+            trace.add_span("parse", parse_wall, parse_s)
+        # Query latency label set: one call name when the query is
+        # homogeneous, "multi" otherwise (bounded cardinality).
+        call_names = {c.name for c in query.calls}
+        call_label = call_names.pop() if len(call_names) == 1 else "multi"
+
+        def _resp_headers() -> list:
+            # The id rides every response; a traced REMOTE leg also
+            # piggybacks its spans — on error responses too, since a
+            # failing leg is exactly the one the coordinator's
+            # stitched trace must not be missing.
+            hs = [("X-Pilosa-Query-Id", ctx.id)]
+            if trace is not None and remote:
+                hs.append((obs_trace.SPANS_HEADER, trace.spans_json()))
+            return hs
         # Register BEFORE admission so queued queries are visible at
         # /debug/queries and cancellable while they wait (a DELETE or
         # an expiring deadline dequeues them without ever holding a
@@ -820,23 +917,46 @@ class Handler:
         except QueryDeadlineError as e:
             err = e
             return error_resp(504, str(e),
-                              headers=[("X-Pilosa-Query-Id", ctx.id)])
+                              headers=_resp_headers())
         except QueryCancelledError as e:
             err = e
             return error_resp(409, str(e),
-                              headers=[("X-Pilosa-Query-Id", ctx.id)])
+                              headers=_resp_headers())
         except PilosaError as e:
             err = e
-            return error_resp(400, str(e))
+            return error_resp(400, str(e), headers=_resp_headers())
         except Exception as e:  # noqa: BLE001 - surfaced in response
             err = e
             self.logger.printf("query error: index=%s query=%.120s: %s",
                                index_name, query_str, e)
-            return error_resp(500, str(e))
+            return error_resp(500, str(e), headers=_resp_headers())
         finally:
             if slot is not None:
                 slot.release()
             self.registry.finish(ctx, error=err)
+            # Latency histogram + outcome counter, labeled by call
+            # type / lane / status (obs.metrics) — recorded for every
+            # outcome, including 429/504/409 error returns.
+            if isinstance(err, HTTPError):
+                status = err.status
+            elif isinstance(err, QueryDeadlineError):
+                status = 504
+            elif isinstance(err, QueryCancelledError):
+                status = 409
+            elif isinstance(err, PilosaError):
+                status = 400
+            elif err is not None:
+                status = 500
+            else:
+                status = 200
+            labels = (call_label, ctx.lane, str(status))
+            obs_metrics.QUERY_SECONDS.labels(*labels).observe(
+                ctx.elapsed())
+            obs_metrics.QUERIES_TOTAL.labels(*labels).inc()
+            # The trace lands in the per-node ring whatever the
+            # outcome — failed queries are the ones worth inspecting.
+            if trace is not None:
+                self.tracer.keep(trace)
 
         # Optional column-attribute join (handler.go:208-227).
         attr_sets = []
@@ -851,8 +971,9 @@ class Handler:
                     attr_sets.append((id, attrs))
 
         # The id rides every response so clients can correlate with
-        # /debug/queries (and DELETE a long-running follow-up).
-        qid_hdr = [("X-Pilosa-Query-Id", ctx.id)]
+        # /debug/queries (and DELETE a long-running follow-up); remote
+        # legs piggyback spans (the encode span below is local-only).
+        qid_hdr = _resp_headers()
         with ctx.stage("encode"):
             if proto_out:
                 return Response.proto(
@@ -958,6 +1079,7 @@ class Handler:
                              ts_ns, idx, frame, timestamps)
         else:
             frame.import_bits(rows, cols, timestamps, views=pod_view)
+        obs_metrics.IMPORT_BITS.labels("bits").inc(len(rows))
         return Response.proto(pb.ImportResponse())
 
     def _pod_import(self, index_name, frame_name, slice, rows, cols,

@@ -25,12 +25,15 @@ from ..executor import Executor
 from ..models.frame import FrameOptions
 from ..models.holder import Holder
 from ..models.index import IndexOptions
+from ..obs.metrics import RegistryStatsClient, default_registry
+from ..obs.runtime import RuntimeCollector
+from ..obs.trace import Tracer
 from ..proto import internal_pb2 as pb
 from ..sched import (AdmissionController, QueryRegistry, Warmup,
                      warmup_enabled)
 from ..utils import logger as logger_mod
-from ..utils.config import QueryConfig
-from ..utils.stats import NOP
+from ..utils.config import MetricsConfig, QueryConfig, TraceConfig
+from ..utils.stats import NOP, MultiStatsClient
 from .handler import Handler
 from .httpd import HTTPServer
 
@@ -49,7 +52,9 @@ class Server:
                  = DEFAULT_ANTI_ENTROPY_INTERVAL,
                  polling_interval: float = DEFAULT_POLLING_INTERVAL,
                  logger=logger_mod.NOP,
-                 query_config: Optional[QueryConfig] = None):
+                 query_config: Optional[QueryConfig] = None,
+                 metrics_config: Optional[MetricsConfig] = None,
+                 trace_config: Optional[TraceConfig] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -57,7 +62,20 @@ class Server:
             nodes=[Node(host)], node_set=StaticNodeSet([Node(host)]))
         self.broadcaster = broadcaster or NOP_BROADCASTER
         self.broadcast_receiver = broadcast_receiver
+        # Observability (obs subsystem; docs/OBSERVABILITY.md): when
+        # metrics are on, every legacy StatsClient call site also
+        # feeds the process Prometheus registry (/metrics) through the
+        # bridge — one call site, every backend.
+        self.metrics_config = metrics_config or MetricsConfig()
+        self.trace_config = trace_config or TraceConfig()
+        if self.metrics_config.enabled:
+            stats = MultiStatsClient(
+                [stats, RegistryStatsClient(default_registry())])
         self.stats = stats
+        self.tracer = Tracer(enabled=self.trace_config.enabled,
+                             max_traces=self.trace_config.max_traces,
+                             max_spans=self.trace_config.max_spans)
+        self.runtime: Optional[RuntimeCollector] = None
         self.anti_entropy_interval = anti_entropy_interval
         self.polling_interval = polling_interval
 
@@ -137,6 +155,11 @@ class Server:
         if warmup_enabled() and self.executor.use_mesh:
             self.warmup = Warmup(self.executor, logger=self.logger)
             self.warmup.start()
+        if self.metrics_config.enabled:
+            self.runtime = RuntimeCollector(
+                holder=self.holder, executor=self.executor,
+                admission=self.admission,
+                interval_s=self.metrics_config.runtime_interval)
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
@@ -144,7 +167,8 @@ class Server:
             stats=self.stats, client_factory=Client, pod=self.pod,
             logger=self.logger, admission=self.admission,
             registry=self.query_registry, warmup=self.warmup,
-            default_timeout_s=self.query_config.default_timeout)
+            default_timeout_s=self.query_config.default_timeout,
+            tracer=self.tracer, runtime=self.runtime)
 
         self._httpd = HTTPServer(self.handler, bind_host, port,
                                  logger=self.logger,
@@ -173,6 +197,8 @@ class Server:
             self.cluster.node_set.open()
 
         self.logger.printf("listening as http://%s", self.host)
+        if self.runtime is not None:
+            self.runtime.start()
         self._spawn(self._serve, "http")
         self._spawn(self._monitor_cache_flush, "cache-flush")
         if self.polling_interval > 0:
@@ -183,6 +209,8 @@ class Server:
     def close(self) -> None:
         self.logger.printf("server closing: %s", self.host)
         self._closing.set()
+        if self.runtime is not None:
+            self.runtime.stop()
         if self.warmup is not None:
             self.warmup.stop()
         if self._httpd is not None:
@@ -248,12 +276,24 @@ class Server:
                            index=index, lane=lane,
                            timeout_s=self.query_config.default_timeout
                            or None, node=self.host)
+        err = None  # stays None if execute_partial itself raises —
+        # the finally below must never NameError over the real failure
         try:
             with self.query_registry.track(ctx):
                 results, err = self.executor.execute_partial(
                     index, Query(calls), opt=ExecOptions(ctx=ctx))
         finally:
             slot.release()
+            # The batch lane bypasses the handler's query path, so it
+            # records its own latency sample (obs.metrics).
+            import sys as sys_mod
+
+            from ..obs import metrics as obs_metrics
+            failed = err is not None or sys_mod.exc_info()[1] is not None
+            labels = ("batch", lane, "500" if failed else "200")
+            obs_metrics.QUERY_SECONDS.labels(*labels).observe(
+                ctx.elapsed())
+            obs_metrics.QUERIES_TOTAL.labels(*labels).inc()
 
         def ok_payload(rs):
             # Write-heavy pipelined streams answer [true]/[false] for

@@ -33,6 +33,7 @@ import math
 import mmap
 import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -493,12 +494,20 @@ class Fragment:
                 # No unmap/copy-out: write_to reads the mapped
                 # containers directly, and _close_storage just drops
                 # the map reference (see its comment).
+                t0 = time.perf_counter()
                 tmp = self.path + ".snapshotting"
                 with open(tmp, "wb") as f:
                     self.storage.write_to(f)
                     f.flush()
                     os.fsync(f.fileno())
                 self._swap_data_file(tmp, new_op_n=0)
+                if self.stats is not None:
+                    # Distribution, not last-write-wins: the expvar
+                    # client aggregates count/sum/min/max and the
+                    # registry bridge buckets it (obs.metrics).
+                    self.stats.timing(
+                        "snapshotDurationNs",
+                        (time.perf_counter() - t0) * 1e9)
 
     def _swap_data_file(self, tmp: str, new_op_n: int) -> None:
         """Swap ``tmp`` in as the data file (caller holds _mu; one

@@ -307,8 +307,31 @@ def bitmap_words_to_values(words: np.ndarray) -> np.ndarray:
 
 # --- container set algebra (vectorized; native C++ when available) -----------
 
+# Per-(op, operand-kind) call counters — the per-container-type
+# statistics the Roaring library paper (arXiv:1709.07821) credits for
+# making its optimizations tractable. Pre-seeded plain ints bumped
+# inline (GIL-coarse increments; a rare lost count is acceptable for
+# metrics), published as pilosa_roaring_container_ops_total by the
+# runtime collector (obs.runtime).
+OP_KINDS = ("array_array", "array_bitmap", "bitmap_bitmap")
+_OPS = ("intersect", "intersection_count", "union", "difference", "xor")
+_OP_COUNTS: dict[tuple[str, str], int] = {
+    (op, kind): 0 for op in _OPS for kind in OP_KINDS}
+
+
+def _op_kind(a: Container, b: Container) -> str:
+    if a.is_array():
+        return "array_array" if b.is_array() else "array_bitmap"
+    return "array_bitmap" if b.is_array() else "bitmap_bitmap"
+
+
+def op_counts() -> dict[tuple[str, str], int]:
+    """Snapshot of the container set-algebra op counters."""
+    return dict(_OP_COUNTS)
+
 
 def _intersect(a: Container, b: Container) -> Container:
+    _OP_COUNTS[("intersect", _op_kind(a, b))] += 1
     if a.is_array() and b.is_array():
         out = native.intersect_sorted_u32(a.array, b.array)
         return Container.from_array(out)
@@ -325,6 +348,7 @@ def _intersect(a: Container, b: Container) -> Container:
 
 
 def _intersection_count(a: Container, b: Container) -> int:
+    _OP_COUNTS[("intersection_count", _op_kind(a, b))] += 1
     if a.is_array() and b.is_array():
         return native.intersection_count_sorted_u32(a.array, b.array)
     if a.is_array() != b.is_array():
@@ -337,6 +361,7 @@ def _intersection_count(a: Container, b: Container) -> int:
 
 
 def _union(a: Container, b: Container) -> Container:
+    _OP_COUNTS[("union", _op_kind(a, b))] += 1
     if a.is_array() and b.is_array():
         out = np.union1d(a.array, b.array).astype(np.uint32)
         c = Container.from_array(out)
@@ -349,6 +374,7 @@ def _union(a: Container, b: Container) -> Container:
 
 
 def _difference(a: Container, b: Container) -> Container:
+    _OP_COUNTS[("difference", _op_kind(a, b))] += 1
     if a.is_array():
         av = a.array
         if b.is_array():
@@ -365,6 +391,7 @@ def _difference(a: Container, b: Container) -> Container:
 
 
 def _xor(a: Container, b: Container) -> Container:
+    _OP_COUNTS[("xor", _op_kind(a, b))] += 1
     if a.is_array() and b.is_array():
         out = np.setxor1d(a.array, b.array, assume_unique=True).astype(np.uint32)
         c = Container.from_array(out)

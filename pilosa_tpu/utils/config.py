@@ -82,11 +82,40 @@ class QueryConfig:
 
 
 @dataclass
+class MetricsConfig:
+    """[metrics] section (obs subsystem): ``enabled`` gates the
+    /metrics endpoint, the StatsClient→registry bridge, and the
+    runtime collector; ``runtime_interval`` (seconds) paces the
+    collector's background sampling."""
+    enabled: bool = True
+    runtime_interval: float = 10.0
+
+
+@dataclass
+class TraceConfig:
+    """[trace] section (obs subsystem): ``enabled`` turns on
+    distributed tracing for EVERY query (off by default — the nop
+    path allocates no spans; ``?trace=1`` opts in per request either
+    way); ``max_traces``/``max_spans`` bound the per-node ring."""
+    enabled: bool = False
+    max_traces: int = 64
+    max_spans: int = 512
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() not in ("0", "false", "no", "off", "")
+
+
+@dataclass
 class Config:
     data_dir: str = "~/.pilosa"
     host: str = f"{DEFAULT_HOST}:{DEFAULT_PORT}"
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     query: QueryConfig = field(default_factory=QueryConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    trace: TraceConfig = field(default_factory=TraceConfig)
     anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
     log_path: str = ""
     # Accepted and persisted but inert, exactly like the reference at
@@ -122,6 +151,15 @@ concurrency = {self.query.concurrency}
 queue-depth = {self.query.queue_depth}
 default-timeout = "{dur(self.query.default_timeout)}"
 slow-threshold = "{dur(self.query.slow_threshold)}"
+
+[metrics]
+enabled = {str(self.metrics.enabled).lower()}
+runtime-interval = "{dur(self.metrics.runtime_interval)}"
+
+[trace]
+enabled = {str(self.trace.enabled).lower()}
+max-traces = {self.trace.max_traces}
+max-spans = {self.trace.max_spans}
 
 [plugins]
 path = "{self.plugins_path}"
@@ -174,6 +212,19 @@ def load(path: str = "", env: dict | None = None) -> Config:
         if "slow-threshold" in q:
             cfg.query.slow_threshold = parse_duration(
                 q["slow-threshold"])
+        m = data.get("metrics", {})
+        if "enabled" in m:
+            cfg.metrics.enabled = _parse_bool(m["enabled"])
+        if "runtime-interval" in m:
+            cfg.metrics.runtime_interval = parse_duration(
+                m["runtime-interval"])
+        t = data.get("trace", {})
+        if "enabled" in t:
+            cfg.trace.enabled = _parse_bool(t["enabled"])
+        if "max-traces" in t:
+            cfg.trace.max_traces = int(t["max-traces"])
+        if "max-spans" in t:
+            cfg.trace.max_spans = int(t["max-spans"])
         cfg.plugins_path = data.get("plugins", {}).get(
             "path", cfg.plugins_path)
     env = os.environ if env is None else env
@@ -217,6 +268,17 @@ def load(path: str = "", env: dict | None = None) -> Config:
     if env.get("PILOSA_QUERY_SLOW_THRESHOLD"):
         cfg.query.slow_threshold = parse_duration(
             env["PILOSA_QUERY_SLOW_THRESHOLD"])
+    if env.get("PILOSA_METRICS_ENABLED"):
+        cfg.metrics.enabled = _parse_bool(env["PILOSA_METRICS_ENABLED"])
+    if env.get("PILOSA_METRICS_RUNTIME_INTERVAL"):
+        cfg.metrics.runtime_interval = parse_duration(
+            env["PILOSA_METRICS_RUNTIME_INTERVAL"])
+    if env.get("PILOSA_TRACE_ENABLED"):
+        cfg.trace.enabled = _parse_bool(env["PILOSA_TRACE_ENABLED"])
+    if env.get("PILOSA_TRACE_MAX_TRACES"):
+        cfg.trace.max_traces = int(env["PILOSA_TRACE_MAX_TRACES"])
+    if env.get("PILOSA_TRACE_MAX_SPANS"):
+        cfg.trace.max_spans = int(env["PILOSA_TRACE_MAX_SPANS"])
     if env.get("PILOSA_PLUGINS_PATH"):
         cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
     return cfg

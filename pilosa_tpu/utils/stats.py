@@ -64,18 +64,38 @@ class ExpvarStatsClient(StatsClient):
             self._root[self._key(name)] = value
 
     def histogram(self, name: str, value: float) -> None:
-        self.gauge(name, value)
+        # Aggregate count/sum/min/max/last per key: the old
+        # last-write-wins gauge meant /debug/vars showed whichever
+        # sample landed last, not a distribution — a 10 s outlier in a
+        # thousand 1 ms timings was invisible (or was ALL you saw).
+        with self._lock:
+            k = self._key(name)
+            cur = self._root.get(k)
+            if not isinstance(cur, dict) or "count" not in cur:
+                cur = self._root[k] = {"count": 0, "sum": 0.0,
+                                       "min": value, "max": value,
+                                       "last": value}
+            cur["count"] += 1
+            cur["sum"] += value
+            if value < cur["min"]:
+                cur["min"] = value
+            if value > cur["max"]:
+                cur["max"] = value
+            cur["last"] = value
 
     def set(self, name: str, value: str) -> None:
         with self._lock:
             self._root[self._key(name)] = value
 
     def timing(self, name: str, value_ns: float) -> None:
-        self.gauge(name, value_ns)
+        self.histogram(name, value_ns)
 
     def snapshot(self) -> dict:
         with self._lock:
-            return dict(self._root)
+            # Histogram entries are mutable dicts: copy them so a
+            # caller's snapshot can't tear against live updates.
+            return {k: dict(v) if isinstance(v, dict) else v
+                    for k, v in self._root.items()}
 
 
 class MultiStatsClient(StatsClient):
@@ -106,3 +126,14 @@ class MultiStatsClient(StatsClient):
     def timing(self, name: str, value_ns: float) -> None:
         for c in self._clients:
             c.timing(name, value_ns)
+
+    def snapshot(self) -> dict:
+        """Merged snapshot of every child that has one (the expvar
+        child, behind /debug/vars) — composing the registry bridge in
+        must not silently blank the expvar page."""
+        out: dict = {}
+        for c in self._clients:
+            snap = getattr(c, "snapshot", None)
+            if callable(snap):
+                out.update(snap())
+        return out

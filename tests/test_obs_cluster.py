@@ -1,0 +1,162 @@
+"""Distributed tracing on a REAL 2-node gossip cluster (replicas=1, so
+a cluster-spanning query MUST fan out): one query id yields, via
+``GET /debug/traces/{id}`` on the coordinator, a single Chrome
+trace-event JSON whose spans cover parse → admission → fan-out RPC →
+the REMOTE node's executor leg → merge — i.e. the peer's child spans
+were piggybacked on the internal response and stitched under the
+coordinator's trace id."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+from podenv import cpu_env, free_port, wait_up  # noqa: E402
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+
+
+def _post(host, path, body=b"", timeout=30):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get_json(host, path, timeout=10):
+    with urllib.request.urlopen(f"http://{host}{path}",
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Two gossip-joined nodes with bits spanning 4 slices and
+    tracing ENABLED via env (PILOSA_TRACE_ENABLED — the config-load
+    path the server actually ships with)."""
+    pa, pb = free_port(), free_port()
+    ga, gb = free_port(), free_port()
+    hosts = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+    procs, logs = [], []
+
+    def spawn(name, port, internal, seed=""):
+        d = tmp_path / name
+        d.mkdir(exist_ok=True)
+        env = cpu_env()
+        env["PILOSA_TPU_MESH"] = "0"
+        env["PILOSA_TPU_WARMUP"] = "0"
+        env["PILOSA_TRACE_ENABLED"] = "1"
+        log = open(tmp_path / f"{name}.log", "a")
+        logs.append(log)
+        argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                "-d", str(d), "-b", f"127.0.0.1:{port}",
+                "--cluster.type", "gossip",
+                "--cluster.hosts", hosts,
+                "--cluster.replicas", "1",
+                "--cluster.internal-port", str(internal),
+                "--anti-entropy.interval", "300s"]
+        if seed:
+            argv += ["--cluster.gossip-seed", seed]
+        p = subprocess.Popen(argv, env=env, stdout=log, stderr=log,
+                             cwd=os.path.dirname(_HERE))
+        procs.append(p)
+        wait_up(f"127.0.0.1:{port}")
+        return f"127.0.0.1:{port}"
+
+    host_a = spawn("a", pa, ga)
+    host_b = spawn("b", pb, gb, seed=f"127.0.0.1:{ga}")
+    _post(host_a, "/index/tr", b"{}")
+    _post(host_a, "/index/tr/frame/f", b"{}")
+
+    import numpy as np
+
+    from pilosa_tpu.cluster.client import Client
+    client = Client(host_a)
+    cols = np.arange(0, 4 * SLICE_WIDTH,
+                     SLICE_WIDTH // 8).astype(np.uint64)
+    client.import_arrays("tr", "f", np.ones(len(cols), np.uint64),
+                         cols)
+
+    # Wait until A answers the full count (slice knowledge of B's
+    # slices arrives via broadcast/gossip) — the query that warms
+    # this also proves fan-out works.
+    deadline = time.time() + 30
+    got = None
+    while time.time() < deadline:
+        with _post(host_a, "/index/tr/query",
+                   b'Count(Bitmap(frame="f", rowID=1))') as r:
+            got = json.loads(r.read())["results"][0]
+        if got == len(cols):
+            break
+        time.sleep(0.3)
+    assert got == len(cols), got
+
+    yield {"a": host_a, "b": host_b, "procs": procs,
+           "n_bits": len(cols)}
+
+    for p in procs:
+        try:
+            p.send_signal(signal.SIGINT)
+        except OSError:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for log in logs:
+        log.close()
+
+
+def test_one_trace_id_spans_coordinator_and_remote_legs(cluster):
+    host_a, host_b = cluster["a"], cluster["b"]
+
+    with _post(host_a, "/index/tr/query",
+               b'Count(Bitmap(frame="f", rowID=1))') as r:
+        qid = r.headers["X-Pilosa-Query-Id"]
+        assert json.loads(r.read())["results"][0] == cluster["n_bits"]
+    assert qid
+
+    # The coordinator's ring lists the trace under the query id.
+    listing = _get_json(host_a, "/debug/traces")
+    assert listing["enabled"] is True
+    entry = next(t for t in listing["traces"] if t["id"] == qid)
+    # Stitched: spans from BOTH nodes under one trace id.
+    assert set(entry["nodes"]) == {host_a, host_b}, entry
+
+    chrome = _get_json(host_a, f"/debug/traces/{qid}")
+    assert chrome["otherData"]["traceId"] == qid
+    events = chrome["traceEvents"]
+    names = {e["name"] for e in events if e["name"] != "process_name"}
+    # The acceptance chain: parse → admission → fan-out rpc → remote
+    # executor leg → merge (all under ONE trace id).
+    assert {"parse", "admission", "execute", "map_reduce", "rpc",
+            "leg", "merge"} <= names, names
+
+    # Each node renders as its own perfetto process; the remote leg's
+    # spans carry the peer's pid.
+    pid_names = {e["pid"]: e["args"]["name"] for e in events
+                 if e["name"] == "process_name"}
+    assert set(pid_names.values()) == {host_a, host_b}
+    pid_of = {v: k for k, v in pid_names.items()}
+    remote_spans = {e["name"] for e in events
+                    if e["name"] != "process_name"
+                    and e["pid"] == pid_of[host_b]}
+    # The peer executed its leg: its own execute/map_reduce spans
+    # arrived via the piggyback header.
+    assert {"execute", "map_reduce"} <= remote_spans, remote_spans
+    # And every event is a well-formed complete event.
+    for e in events:
+        if e["name"] != "process_name":
+            assert e["ph"] == "X" and e["dur"] >= 1 and e["ts"] > 0
+
+    # The remote node also kept its own child trace locally.
+    listing_b = _get_json(host_b, "/debug/traces")
+    assert any(t["id"] == qid for t in listing_b["traces"])

@@ -1,0 +1,77 @@
+"""CI sweeps for the observability surface: every emitted metric name
+must follow ``pilosa_<subsystem>_<noun>_<unit>``, and every ``/debug/*``
++ ``/metrics`` route registered in the handler must appear in the
+README route documentation — new endpoints cannot ship undocumented."""
+
+import os
+import re
+
+from pilosa_tpu.obs import metrics as obs_metrics
+from pilosa_tpu.server.handler import Handler
+
+_README = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "README.md")
+
+
+class TestMetricNamingSweep:
+    def test_all_registered_names_follow_convention(self):
+        fams = obs_metrics.default_registry().families()
+        assert fams, "registry empty at import — declarations moved?"
+        for name, fam in fams.items():
+            assert obs_metrics.NAME_RE.match(name), (
+                f"metric {name} outside pilosa_<subsystem>_<noun>_"
+                f"<unit>")
+            if fam.type == "counter":
+                assert name.endswith("_total"), (
+                    f"counter {name} must end in _total")
+            else:
+                assert not name.endswith("_total"), (
+                    f"non-counter {name} must not claim _total")
+
+    def test_rendered_sample_names_follow_convention(self):
+        """The rendered exposition can only emit family names plus the
+        histogram suffixes — validate the actual output lines too."""
+        sample_re = re.compile(
+            r"^(pilosa(?:_[a-z][a-z0-9]*){3,}"
+            r"(?:_bucket|_sum|_count)?)[ {]")
+        for line in obs_metrics.default_registry().render().splitlines():
+            if not line or line.startswith("#"):
+                continue
+            assert sample_re.match(line), f"bad sample line: {line!r}"
+
+    def test_stats_bridge_names_follow_convention(self):
+        """Legacy stats names that flow through the bridge must come
+        out convention-clean for every name style in the codebase."""
+        reg = obs_metrics.Registry()
+        bridge = obs_metrics.RegistryStatsClient(reg)
+        for legacy in ("setN", "clearN", "indexN", "slowQueries",
+                       "queriesRejected", "deviceFallback",
+                       "snapshotDurationNs", "slowQueryNs"):
+            bridge.count(legacy)
+            bridge.gauge(legacy, 1.0)
+            bridge.timing(legacy, 1.0)
+        for name in reg.families():
+            assert obs_metrics.NAME_RE.match(name), name
+
+
+class TestRouteTableDocumented:
+    def test_debug_and_metrics_routes_in_readme(self):
+        handler = Handler(None, None)
+        with open(_README) as f:
+            readme = f.read()
+        swept = []
+        for _method, _regex, _fn, _lane, pattern in handler._routes:
+            if pattern == "/metrics" or pattern.startswith("/debug/"):
+                swept.append(pattern)
+                # Variable segments differ in name between code and
+                # docs ({qid} vs {id}); the static prefix must appear
+                # verbatim in the README.
+                prefix = pattern.split("{")[0]
+                assert prefix in readme, (
+                    f"route {pattern} is registered in handler.py but"
+                    f" its prefix {prefix!r} is not documented in"
+                    f" README.md")
+        # The sweep itself must be seeing the observability routes.
+        assert "/metrics" in swept
+        assert any(p.startswith("/debug/traces") for p in swept)
+        assert "/debug/queries/slow" in swept
