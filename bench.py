@@ -238,11 +238,24 @@ def main() -> None:
         try:
             with open(os.path.join(os.path.dirname(_BASELINE_PATH),
                                    "MANIFEST.json")) as f:
-                cc = json.load(f).get("compile_cache") or {}
+                manifest = json.load(f)
+            cc = manifest.get("compile_cache") or {}
             if "misses" in cc:
                 line["compile_cache"] = {
                     "hits": cc["hits"], "misses": cc["misses"],
                     "compile_seconds": cc.get("compileSeconds")}
+            # Per-config cost ledgers (obs.accounting via
+            # suite.config_query_cost): container-op mix, device
+            # bytes, compile ms — the attribution numbers ride the
+            # line of record next to the throughput they explain.
+            qc = manifest.get("query_cost") or {}
+            if qc:
+                line["query_cost"] = {
+                    name: {"containerOps": sum(
+                               (c.get("containerOps") or {}).values()),
+                           "deviceBytes": c.get("deviceBytes", 0),
+                           "compileMs": c.get("compileMs", 0.0)}
+                    for name, c in qc.items()}
         except (OSError, ValueError, KeyError):
             pass
         # Serving-quality artifact (sched subsystem): open-loop

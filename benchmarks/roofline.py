@@ -21,6 +21,8 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 
@@ -134,13 +136,161 @@ def compute(metric_ops_s: float | None = None) -> dict:
     return out
 
 
+# -- measured projection constants (VERDICT r5 weak #7) -----------------------
+# The 0.3 ms dispatch / 50 us collective numbers above were ASSUMED.
+# measure_constants() times them on this rig: a null-kernel dispatch
+# (jit'd identity on a tiny operand, per-call with a sync) and an
+# 8-device virtual-mesh psum of a tiny payload. On a TPU-tunnel rig
+# the dispatch number IS the tunnel sync floor; on CPU it is the local
+# jit dispatch + sync the projection assumes — either way the value is
+# recorded NEXT TO the assumption with its platform, so the projection
+# is no longer built on unmeasured constants.
+
+_MEASURE_MARK = "MEASURED_CONSTANTS:"
+
+
+def _measure_worker() -> None:
+    """Runs in a subprocess with an 8-device virtual CPU mesh (or the
+    real backend when one is attached); prints one marked JSON line."""
+    import time
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    def per_call_s(fn, arg, n=50):
+        fn(arg).block_until_ready()  # compile
+        best = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = fn(arg)
+            out.block_until_ready()
+            best.append((time.perf_counter() - t0) / n)
+        return sorted(best)[1]
+
+    # Null-kernel dispatch: the fixed per-dispatch cost with no real
+    # compute or transfer behind it.
+    tiny = jax.device_put(np.zeros(8, np.float32))
+    null_s = per_call_s(jax.jit(lambda x: x + 1), tiny)
+
+    # 8-device mesh psum of a tiny payload: the small-collective cost.
+    sys.path.insert(0, os.path.dirname(HERE))
+    from pilosa_tpu.parallel import mesh as mesh_mod
+    mesh = mesh_mod.make_mesh()
+    n_dev = int(mesh.shape[mesh_mod.AXIS_SLICES])
+    fn = jax.jit(mesh_mod._shard_map(
+        lambda x: jax.lax.psum(jnp.sum(x), mesh_mod.AXIS_SLICES),
+        mesh=mesh,
+        in_specs=(mesh_mod.P(mesh_mod.AXIS_SLICES),),
+        out_specs=mesh_mod.P()))
+    shard = mesh_mod.shard_slices(mesh,
+                                  np.zeros((n_dev, 16), np.float32))
+    psum_s = per_call_s(fn, shard)
+
+    print(_MEASURE_MARK + json.dumps({
+        "dispatch_ms": round(null_s * 1e3, 4),
+        "psum_ms": round(psum_s * 1e3, 4),
+        # The collective alone ~= the psum dispatch minus the null
+        # dispatch (both pay the same fixed cost), floored at 0.
+        "ici_collective_us": round(max(0.0, psum_s - null_s) * 1e6, 2),
+        "devices": n_dev,
+        "platform": jax.devices()[0].platform,
+    }), flush=True)
+
+
+def _measure_once(env: dict, timeout_s: float) -> dict | None:
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--measure-worker"],
+            timeout=timeout_s, capture_output=True, text=True,
+            env=env)
+    except subprocess.TimeoutExpired:
+        return None
+    for line in proc.stdout.splitlines():
+        if line.startswith(_MEASURE_MARK):
+            return json.loads(line[len(_MEASURE_MARK):])
+    return None
+
+
+def measure_constants(timeout_s: float = 180.0) -> dict | None:
+    """Measure the projection constants in a bounded subprocess. The
+    first attempt keeps whatever backend the rig attaches (a real TPU
+    measures the actual tunnel dispatch floor — the number the
+    assumption stands in for); only if that fails does a CPU-forced
+    retry run, so a broken tunnel still yields a labeled CPU-platform
+    number instead of nothing. The virtual-device XLA flag only
+    affects the host platform, so it is safe to set either way."""
+    env = dict(os.environ)
+    env.setdefault("XLA_FLAGS",
+                   "--xla_force_host_platform_device_count=8")
+    out = _measure_once(env, timeout_s)
+    if out is None and env.get("JAX_PLATFORMS") != "cpu":
+        env["JAX_PLATFORMS"] = "cpu"
+        out = _measure_once(env, timeout_s)
+    if out is not None:
+        out["method"] = ("null-kernel dispatch (jit identity,"
+                         " per-call sync) and a mesh psum of a tiny"
+                         " payload on this rig's backend (platform/"
+                         "devices recorded); collective = psum - null"
+                         " dispatch")
+    return out
+
+
+def _stamp_measured(out: dict, measured: dict | None) -> None:
+    """Record measured: values NEXT TO the assumed constants."""
+    if not measured:
+        return
+    out["measured_constants"] = measured
+    for key in ("config4_count_256slices_v5e8",
+                "config5_topn_1024slices_v5e8"):
+        assumptions = out.get(key, {}).get("assumptions")
+        if assumptions is not None:
+            assumptions["dispatch_ms_measured"] = measured["dispatch_ms"]
+            assumptions["ici_collective_us_measured"] = \
+                measured["ici_collective_us"]
+            assumptions["measured_platform"] = measured["platform"]
+
+
 def main() -> None:
-    out = compute()
+    # Preserve the fields bench.py owns (recent-run median headline,
+    # best_observed) — a roofline re-run must not reset the metric
+    # history, and the headline recomputes from that history.
     path = os.path.join(HERE, "ROOFLINE.json")
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        prior = {}
+    recent = prior.get("recent_runs") or []
+    metric_ops_s = None
+    if recent:
+        import statistics
+        metric_ops_s = float(statistics.median(recent[-5:]))
+    out = compute(metric_ops_s=metric_ops_s)
+    if recent:
+        out["metric_of_record"]["kind"] = \
+            "measurement (median of recent runs)"
+        latest = prior.get("metric_of_record", {}) \
+            .get("latest_run_ops_per_s")
+        if latest is not None:
+            out["metric_of_record"]["latest_run_ops_per_s"] = latest
+        out["recent_runs"] = recent
+    if "best_observed" in prior:
+        out["best_observed"] = prior["best_observed"]
+    # A failed/timed-out measurement must not erase the last good one
+    # (same carry-forward contract as recent_runs/best_observed).
+    _stamp_measured(out, measure_constants()
+                    or prior.get("measured_constants"))
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps(out, indent=1))
 
 
 if __name__ == "__main__":
-    main()
+    if "--measure-worker" in sys.argv[1:]:
+        _measure_worker()
+    else:
+        main()

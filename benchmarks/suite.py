@@ -105,12 +105,13 @@ def write_manifest() -> None:
                         "MANIFEST.json")
     # The latency_* entries are owned by latency_under_load.py (its
     # _fold_into_manifest); a suite pass must carry them forward, not
-    # clobber them.
+    # clobber them. One read serves every carry-forward below.
     try:
         with open(path) as f:
-            prior = json.load(f).get("metrics", {})
+            prior_doc = json.load(f)
     except (OSError, ValueError):
-        prior = {}
+        prior_doc = {}
+    prior = prior_doc.get("metrics", {})
     for k, v in prior.items():
         if k.startswith("latency_") and k not in metrics:
             metrics[k] = v
@@ -124,8 +125,115 @@ def write_manifest() -> None:
         "first_vs_warm": first_vs_warm,
         "compile_cache": _compile_cache_snapshot(),
     }
+    # Per-config cost ledgers (config_query_cost) and the measured
+    # roofline constants (benchmarks/roofline.py) ride the manifest;
+    # a pass that skipped either carries the prior values forward.
+    out["query_cost"] = _QUERY_COST or prior_doc.get("query_cost", {})
+    measured = _roofline_measured() or prior_doc.get(
+        "roofline_measured_constants")
+    if measured:
+        out["roofline_measured_constants"] = measured
     with open(path, "w") as f:
         json.dump(out, f, indent=1)
+
+
+# Per-config cost ledgers captured by config_query_cost() — folded
+# into MANIFEST.json's query_cost section.
+_QUERY_COST: dict = {}
+
+
+def _roofline_measured() -> dict | None:
+    """The measured projection constants benchmarks/roofline.py
+    records (dispatch/collective next to the 0.3 ms / 50 us
+    assumptions) — carried into MANIFEST.json so both artifacts agree."""
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), "ROOFLINE.json")) as f:
+            return json.load(f).get("measured_constants")
+    except (OSError, ValueError):
+        return None
+
+
+def config_query_cost() -> None:
+    """Per-config query-cost ledgers (obs.accounting): the bench query
+    shapes through the executor with a cost-attached QueryContext, so
+    MANIFEST.json records WHAT each config's query costs (container-op
+    mix by operand kinds, device programs/bytes, compile ms) next to
+    how long it took — the attribution layer's numbers as committed
+    artifacts."""
+    import tempfile
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import ExecOptions, Executor
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.obs import accounting
+    from pilosa_tpu.sched import QueryContext
+
+    rng = np.random.default_rng(21)
+    n_slices = max(2, int(8 * SCALE))
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(d)
+        holder.open()
+        try:
+            frame = holder.create_index_if_not_exists("qc") \
+                .create_frame_if_not_exists("f")
+            for row in range(8):
+                cols = (rng.integers(0, SLICE_WIDTH,
+                                     size=400 * n_slices)
+                        + np.repeat(np.arange(n_slices), 400)
+                        * SLICE_WIDTH)
+                frame.import_bits(
+                    np.full(len(cols), row, dtype=np.uint64),
+                    cols.astype(np.uint64))
+            # Narrow materializing shapes run the roaring container
+            # algebra (the wide-union shape routes to the vectorized
+            # word fold, which by design does no container ops); the
+            # Count shape exercises the fused count path, whose cost
+            # shows up as device programs/bytes on the device leg.
+            shapes = {
+                "c1_intersect_materialize":
+                    "Intersect(Bitmap(frame=f, rowID=0),"
+                    " Bitmap(frame=f, rowID=1))",
+                "c2_union_materialize":
+                    "Union(Bitmap(rowID=0, frame=f),"
+                    " Bitmap(rowID=1, frame=f),"
+                    " Bitmap(rowID=2, frame=f))",
+                "c4_count_intersect":
+                    "Count(Intersect(Bitmap(frame=f, rowID=0),"
+                    " Bitmap(frame=f, rowID=1)))",
+            }
+            legs = [("host", False)]
+            if USE_DEVICE:
+                legs.append(("device", True))
+            for leg, use_mesh in legs:
+                ex = Executor(holder, host="local", use_mesh=use_mesh,
+                              mesh_min_slices=1)
+                if use_mesh:
+                    ex._cost_model_enabled = False
+                for name, q in shapes.items():
+                    ex.execute("qc", q)  # warm (compile outside ledger)
+                    # The ledger run must do the real work: drop the
+                    # materialized-result cache the warm run seeded.
+                    ex._bitmap_results.clear()
+                    ctx = QueryContext(pql=q)
+                    accounting.attach(ctx)
+                    # ctx travels via ExecOptions: the executor binds
+                    # it into every worker leg, where the container
+                    # algebra actually runs.
+                    ex.execute("qc", q, opt=ExecOptions(ctx=ctx))
+                    cost = ctx.cost.to_tree()
+                    cost.pop("node", None)
+                    _QUERY_COST[f"{name}_{leg}"] = cost
+                    emit(f"query_cost_{name}_{leg}",
+                         float(sum(cost["containerOps"].values())),
+                         "container_ops",
+                         device_bytes=cost["deviceBytes"],
+                         device_programs=cost["devicePrograms"],
+                         compile_ms=cost["compileMs"],
+                         words_scanned=cost["wordsScanned"])
+                ex.close()
+        finally:
+            holder.close()
 
 
 def _compile_cache_snapshot() -> dict:
@@ -1124,6 +1232,7 @@ def main() -> None:
                config_host_write_and_import,
                config_http_pipelined_setbit,
                config_wire_import,
+               config_query_cost,
                emit_compile_cache):
         try:
             fn()

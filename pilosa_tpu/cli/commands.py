@@ -236,6 +236,16 @@ def load_server_config(args, env=None):
         cfg.trace.enabled = _parse_bool(args.trace_enabled)
     if getattr(args, "trace_max_traces", None) is not None:
         cfg.trace.max_traces = args.trace_max_traces
+    if getattr(args, "metrics_accounting", None) is not None:
+        cfg.metrics.accounting = _parse_bool(args.metrics_accounting)
+    if getattr(args, "profile_continuous", None) is not None:
+        cfg.profile.continuous = _parse_bool(args.profile_continuous)
+    if getattr(args, "profile_hz", None) is not None:
+        cfg.profile.hz = args.profile_hz
+    if getattr(args, "slo_objective", None) is not None:
+        cfg.slo.objective = args.slo_objective
+    if getattr(args, "slo_target", None) is not None:
+        cfg.slo.target = args.slo_target
     return cfg
 
 
@@ -280,7 +290,8 @@ def cmd_server(args, stdout, stderr) -> int:
                     anti_entropy_interval=cfg.anti_entropy_interval,
                     polling_interval=cfg.cluster.polling_interval,
                     logger=logger, query_config=cfg.query,
-                    metrics_config=cfg.metrics, trace_config=cfg.trace)
+                    metrics_config=cfg.metrics, trace_config=cfg.trace,
+                    profile_config=cfg.profile, slo_config=cfg.slo)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -589,6 +600,26 @@ def build_parser() -> argparse.ArgumentParser:
                    type=int, default=None, metavar="N",
                    help="recent traces kept per node for /debug/traces"
                         " (default 64)")
+    s.add_argument("--metrics.accounting", dest="metrics_accounting",
+                   default=None, metavar="BOOL",
+                   help="per-query cost ledgers (?profile=1,"
+                        " X-Pilosa-Stats; default true)")
+    s.add_argument("--profile.continuous", dest="profile_continuous",
+                   default=None, metavar="BOOL",
+                   help="always-on low-Hz wall profiler behind"
+                        " /debug/pprof/flame (default true)")
+    s.add_argument("--profile.hz", dest="profile_hz", type=float,
+                   default=None, metavar="HZ",
+                   help="continuous-profiler sampling rate"
+                        " (default 10)")
+    s.add_argument("--slo.objective", dest="slo_objective",
+                   type=parse_duration, default=None, metavar="DUR",
+                   help="latency objective for burn-rate gauges"
+                        " (default 250ms)")
+    s.add_argument("--slo.target", dest="slo_target", type=float,
+                   default=None, metavar="FRACTION",
+                   help="fraction of queries that must meet the"
+                        " objective (default 0.99)")
     # Profiling flags (reference cmd/server.go:47-62,99-100).
     s.add_argument("--profile.cpu", dest="profile_cpu", default="",
                    metavar="PATH",

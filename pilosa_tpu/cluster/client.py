@@ -23,6 +23,7 @@ from .. import SLICE_WIDTH
 from ..utils.arrays import group_by_key
 from ..errors import (FragmentNotFoundError, PilosaError,
                       QueryDeadlineError)
+from ..obs.accounting import COST_HEADER
 from ..obs.trace import SPANS_HEADER, TRACE_HEADER
 from ..pql import parser as pql
 from ..proto import internal_pb2 as pb
@@ -264,26 +265,34 @@ class Client:
             headers["X-Pilosa-Deadline"] = f"{deadline_s:.6f}"
         if query_id:
             headers["X-Pilosa-Query-Id"] = query_id
-        # Distributed tracing: when the calling thread carries a traced
-        # query (the executor binds it via sched_context.use), ask the
-        # peer to trace its leg and stitch the spans it piggybacks on
-        # the response header back into the originating trace.
+        # Distributed tracing + cost accounting: when the calling
+        # thread carries a lifecycle-bound query (the executor binds it
+        # via sched_context.use), ask the peer to trace its leg and
+        # stitch the spans AND the cost ledger it piggybacks on the
+        # response headers back into the originating trace/cost tree.
         ctx = sched_context.current()
         trace = getattr(ctx, "trace", None) if ctx is not None else None
+        cost = getattr(ctx, "cost", None) if ctx is not None else None
         headers_out: Optional[list] = None
         if trace is not None:
             headers[TRACE_HEADER] = "1"
+        if trace is not None or cost is not None:
             headers_out = []
+        target = _host_of(node) if node is not None else self.host
         status, raw = self._do(
             "POST", path, body, headers,
             host=_host_of(node) if node is not None else None,
             idempotent=True,  # PQL writes set absolute state — replayable
             deadline_s=deadline_s, headers_out=headers_out)
-        if trace is not None and headers_out:
+        if cost is not None:
+            cost.note_rpc(target, len(body), len(raw))
+        if headers_out:
             for hk, hv in headers_out:
-                if hk.lower() == SPANS_HEADER.lower():
+                lk = hk.lower()
+                if trace is not None and lk == SPANS_HEADER.lower():
                     trace.add_remote_json(hv)
-                    break
+                elif cost is not None and lk == COST_HEADER.lower():
+                    cost.add_remote_json(hv)
         self._ok(status, raw, "execute query")
         resp = pb.QueryResponse.FromString(raw)
         if resp.Err:

@@ -2652,18 +2652,33 @@ class Executor:
         if len(slices) == 1:
             return reduce_fn(None, map_fn(slices[0]))
         pool = self._pool("slice")
+        # Propagate the calling thread's query context into the nested
+        # slice-pool legs: the container algebra (map AND the in-group
+        # pre-reduce) actually runs THERE, and without the binding its
+        # per-query attribution (cost ledger, spans, profiler query
+        # tags) silently lands nowhere.
+        ctx = sched_context.current()
         chunk = max(1, len(slices) // (4 * self.max_workers))
 
         def run_group(group: list[int]):
-            r = None
-            for s in group:
-                r = reduce_fn(r, map_fn(s))
-            return r
+            # One binding covers the whole group — map legs and the
+            # pre-reduce merges between them.
+            with sched_context.use(ctx):
+                r = None
+                for s in group:
+                    r = reduce_fn(r, map_fn(s))
+                return r
 
         if chunk == 1:
             # Narrow fan-out: submit per slice — a single-slice group
             # would pay one extra reduce_fn pass per slice for nothing.
-            futs = [pool.submit(map_fn, s) for s in slices]
+            if ctx is None:
+                futs = [pool.submit(map_fn, s) for s in slices]
+            else:
+                def one(s, _ctx=ctx):
+                    with sched_context.use(_ctx):
+                        return map_fn(s)
+                futs = [pool.submit(one, s) for s in slices]
         else:
             futs = [pool.submit(run_group, slices[i:i + chunk])
                     for i in range(0, len(slices), chunk)]

@@ -12,12 +12,24 @@ runtime collector.
   query response and the coordinator stitches them under one trace id
   (the query id riding ``X-Pilosa-Query-Id``). A bounded per-node ring
   serves ``GET /debug/traces`` and Chrome trace-event export.
+- ``obs.accounting`` — per-query cost ledgers (EXPLAIN ANALYZE for
+  PQL): container ops by operand-kind pair, words scanned, bits
+  written, device programs/bytes, compile ms, RPC bytes per peer;
+  remote legs piggyback their ledger on ``X-Pilosa-Cost`` and the
+  coordinator stitches a per-node cost tree (``?profile=1``,
+  ``X-Pilosa-Stats``, /debug/queries, the slow log, span args).
+- ``obs.profile`` — the always-on low-Hz continuous wall profiler:
+  query-id-tagged folded stacks in a bounded ring, served as
+  speedscope-loadable collapsed-stack text at ``/debug/pprof/flame``.
+- ``obs.slo`` — rolling latency-objective burn rates over the query
+  histograms, OpenMetrics exemplars carrying trace ids, and the
+  ``GET /health`` readiness checks.
 - ``obs.runtime`` — a background collector sampling holder/cache/
   residency sizes, thread activity, and the XLA compile-cache
   counters (parallel.mesh.compile_stats) into gauges and ``/status``.
 
 See docs/OBSERVABILITY.md for the metric name reference, the trace
-header contract, and the perfetto how-to.
+and cost wire contracts, and the perfetto/speedscope how-tos.
 """
 
 from .metrics import (RegistryStatsClient, Registry,  # noqa: F401

@@ -33,6 +33,7 @@ from __future__ import annotations
 import math
 import re
 import threading
+import time
 from bisect import bisect_left
 from typing import Iterable, Optional
 
@@ -107,6 +108,11 @@ class _Family:
     def samples(self) -> list[tuple[str, dict, float]]:
         """(suffix, labels, value) triples for rendering."""
         raise NotImplementedError
+
+    def samples_ex(self):
+        """(suffix, labels, value, exemplar) — the OpenMetrics form;
+        only histograms attach exemplars (they override this)."""
+        return [(s, l, v, None) for s, l, v in self.samples()]
 
     def _label_dicts(self) -> list[tuple[dict, object]]:
         with self._mu:
@@ -195,7 +201,8 @@ class Gauge(_Family):
 
 
 class _HistogramChild:
-    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_mu")
+    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_mu",
+                 "_exemplars")
 
     def __init__(self, bounds: tuple[float, ...]):
         self._bounds = bounds
@@ -203,17 +210,28 @@ class _HistogramChild:
         self._sum = 0.0
         self._count = 0
         self._mu = threading.Lock()
+        # Per-bucket last exemplar: (labels, value, unix_ts) — the
+        # OpenMetrics hook carrying a trace/query id next to the
+        # latency observation that landed in that bucket.
+        self._exemplars: dict[int, tuple[dict, float, float]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float,
+                exemplar: Optional[dict] = None) -> None:
         i = bisect_left(self._bounds, v)
         with self._mu:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar:
+                self._exemplars[i] = (exemplar, v, time.time())
 
     def snapshot(self) -> tuple[list[int], float, int]:
         with self._mu:
             return list(self._counts), self._sum, self._count
+
+    def exemplars(self) -> dict[int, tuple[dict, float, float]]:
+        with self._mu:
+            return dict(self._exemplars)
 
 
 class Histogram(_Family):
@@ -228,21 +246,28 @@ class Histogram(_Family):
     def _new_child(self):
         return _HistogramChild(self.buckets)
 
-    def observe(self, v: float) -> None:
-        self._default().observe(v)
+    def observe(self, v: float, exemplar: Optional[dict] = None) -> None:
+        self._default().observe(v, exemplar=exemplar)
 
     def samples(self):
+        return [s[:3] for s in self.samples_ex()]
+
+    def samples_ex(self):
+        """(suffix, labels, value, exemplar-or-None) — exemplars ride
+        bucket samples only (the OpenMetrics rule)."""
         out = []
         for labels, ch in self._label_dicts():
             counts, total, n = ch.snapshot()
+            exemplars = ch.exemplars()
             cum = 0
-            for bound, c in zip(self.buckets, counts):
+            for i, (bound, c) in enumerate(zip(self.buckets, counts)):
                 cum += c
                 out.append(("_bucket", {**labels, "le": _fmt(bound)},
-                            cum))
-            out.append(("_bucket", {**labels, "le": "+Inf"}, n))
-            out.append(("_sum", labels, total))
-            out.append(("_count", labels, n))
+                            cum, exemplars.get(i)))
+            out.append(("_bucket", {**labels, "le": "+Inf"}, n,
+                        exemplars.get(len(self.buckets))))
+            out.append(("_sum", labels, total, None))
+            out.append(("_count", labels, n, None))
         return out
 
 
@@ -297,22 +322,40 @@ class Registry:
         with self._mu:
             return dict(self._families)
 
-    def render(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def render(self, openmetrics: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4, or (with
+        ``openmetrics=True``) OpenMetrics 1.0: counter families are
+        declared under their ``_total``-stripped name, histogram bucket
+        samples carry their exemplar (``# {trace_id="..."} v ts``), and
+        the body terminates with ``# EOF``."""
         lines = []
         for name in sorted(self.families()):
             fam = self._families[name]
+            om_name = name
+            if (openmetrics and fam.type == "counter"
+                    and name.endswith("_total")):
+                om_name = name[: -len("_total")]
             if fam.help:
-                lines.append(f"# HELP {name} {_escape(fam.help)}")
-            lines.append(f"# TYPE {name} {fam.type}")
-            for suffix, labels, value in fam.samples():
+                lines.append(f"# HELP {om_name} {_escape(fam.help)}")
+            lines.append(f"# TYPE {om_name} {fam.type}")
+            for suffix, labels, value, exemplar in fam.samples_ex():
                 if labels:
                     lab = ",".join(
                         f'{k}="{_escape(str(v))}"'
                         for k, v in labels.items())
-                    lines.append(f"{name}{suffix}{{{lab}}} {_fnum(value)}")
+                    line = f"{name}{suffix}{{{lab}}} {_fnum(value)}"
                 else:
-                    lines.append(f"{name}{suffix} {_fnum(value)}")
+                    line = f"{name}{suffix} {_fnum(value)}"
+                if openmetrics and exemplar is not None:
+                    ex_labels, ex_v, ex_ts = exemplar
+                    exl = ",".join(
+                        f'{k}="{_escape(str(v))}"'
+                        for k, v in ex_labels.items())
+                    line += (f" # {{{exl}}} {_fnum_om(ex_v)}"
+                             f" {_fnum_om(ex_ts)}")
+                lines.append(line)
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 
@@ -320,6 +363,14 @@ def _fnum(v: float) -> str:
     if isinstance(v, int) or v == int(v):
         return str(int(v))
     return repr(v)
+
+
+def _fnum_om(v: float) -> str:
+    """Exemplar value/timestamp: keep floats readable (OpenMetrics
+    allows either form; repr of a perf_counter float is noise)."""
+    if v == int(v):
+        return str(int(v))
+    return f"{v:.6f}".rstrip("0").rstrip(".")
 
 
 _DEFAULT = Registry()
@@ -393,6 +444,24 @@ RESIDENCY_BYTES = _DEFAULT.gauge(
 TRACES_KEPT = _DEFAULT.counter(
     "pilosa_trace_kept_total",
     "Traces retained in the per-node ring buffer")
+IMPORT_STAGE_SECONDS = _DEFAULT.histogram(
+    "pilosa_import_stage_seconds",
+    "Wire-import handler stage timings: decode (wire to arrays),"
+    " apply (fragment mutation), snapshot (storage rewrite) — the"
+    " decode-vs-apply serialization recorded as a metric",
+    labels=("stage",))
+SLO_BURN_RATE = _DEFAULT.gauge(
+    "pilosa_slo_burn_rate_ratio",
+    "Latency-objective error-budget burn rate over a rolling window"
+    " (1.0 = budget burns exactly at the sustainable rate)",
+    labels=("window",))
+SLO_OBJECTIVE = _DEFAULT.gauge(
+    "pilosa_slo_latency_objective_seconds",
+    "The configured latency objective the burn rate is computed"
+    " against")
+PROFILE_SAMPLES = _DEFAULT.counter(
+    "pilosa_profile_samples_total",
+    "Continuous-profiler sampling ticks taken")
 
 
 # -- legacy StatsClient bridge ------------------------------------------------

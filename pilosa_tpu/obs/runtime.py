@@ -36,10 +36,16 @@ DEFAULT_INTERVAL_S = 10.0
 
 class RuntimeCollector:
     def __init__(self, holder=None, executor=None, admission=None,
-                 registry=None, interval_s: float = DEFAULT_INTERVAL_S):
+                 registry=None, interval_s: float = DEFAULT_INTERVAL_S,
+                 slo=None, profiler=None):
         self.holder = holder
         self.executor = executor
         self.admission = admission
+        # SLO burn-rate tracker (obs.slo.SLOTracker) and the continuous
+        # profiler (obs.profile) — sampled/summarized on the same
+        # cadence so /status carries both.
+        self.slo = slo
+        self.profiler = profiler
         self.registry = registry or obs_metrics.default_registry()
         self.interval_s = interval_s
         self._mu = threading.Lock()
@@ -87,6 +93,13 @@ class RuntimeCollector:
                                               "device_fallbacks", 0)
             snap["costModelVetoes"] = getattr(self.executor,
                                               "cost_vetoes", 0)
+        if self.slo is not None:
+            try:
+                snap["slo"] = self.slo.record()
+            except Exception:  # noqa: BLE001 - visibility only
+                pass
+        if self.profiler is not None:
+            snap["profiler"] = self.profiler.snapshot()
         with self._mu:
             self._last = snap
         return snap

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..obs import accounting as _accounting
 from ..obs import trace as obs_trace
 from ..ops.kernels import _BITWISE
 from ..sched import context as sched_context
@@ -110,10 +111,24 @@ def _finalize_program(fn):
             with _COMPILE_MU:
                 _COMPILE_STATS["firstCalls"] += 1
                 _COMPILE_STATS["compileSeconds"] += dt
+            # Attribute the trace+compile to the query that paid it
+            # (obs.accounting: compileMs in its cost ledger).
+            _accounting.note_compile(dt)
             return out
         return fn(*args, **kwargs)
 
     return program
+
+
+def _note_dispatch(*operands) -> None:
+    """Charge one device-program dispatch (+ its operand bytes) to the
+    current query's cost ledger (obs.accounting) — the per-query form
+    of the mesh_dispatch trace span. None-cost fast path: one
+    thread-local read."""
+    cost = _accounting.current_cost()
+    if cost is not None:
+        cost.note_device_dispatch(
+            sum(int(getattr(a, "nbytes", 0)) for a in operands))
 
 
 def compile_stats() -> dict:
@@ -415,6 +430,8 @@ def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
             if rem:
                 pad = [(0, 0), (0, n_dev - rem), (0, 0)]
                 chunk = np.pad(chunk, pad)
+            # Per chunk: each loop pass dispatches one program.
+            _note_dispatch(chunk)
             total += hilo_combine(
                 fn(shard_slices_axis1(mesh, chunk)))[0]
     return total
@@ -488,6 +505,7 @@ def count_exprs_sharded(mesh: Mesh, exprs: tuple,
                          " int32 hi/lo bound")
     fn = _count_exprs_sharded_fn(mesh, exprs, len(leaf_arrays),
                                  _mesh_pallas_mode(mesh))
+    _note_dispatch(*leaf_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="count_exprs",
                                 exprs=len(exprs),
                                 leaves=len(leaf_arrays)):
@@ -614,6 +632,7 @@ def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
     fn = _topn_filtered_sharded_fn(mesh, expr, len(leaf_arrays),
                                    _mesh_pallas_mode(mesh))
     threshold = min(threshold, 2**31 - 1)  # counts never exceed 2^31
+    _note_dispatch(rows, *leaf_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="topn_filtered",
                                 rows=int(rows.shape[1])):
         return hilo_combine(
@@ -634,6 +653,7 @@ def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
                          " int32 hi/lo bound — use topn_exact")
     fn = _topn_exact_sharded_fn(mesh, expr, len(leaf_arrays),
                                 _mesh_pallas_mode(mesh))
+    _note_dispatch(rows, *leaf_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="topn_exact",
                                 rows=int(rows.shape[1])):
         return hilo_combine(fn(rows, *leaf_arrays))[:rows.shape[1]]
@@ -766,6 +786,7 @@ def materialize_expr_sharded(mesh: Mesh, expr,
     """
     sched_context.check_current()
     fn = _materialize_fn(mesh, expr, len(leaf_arrays))
+    _note_dispatch(*leaf_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="materialize",
                                 leaves=len(leaf_arrays)):
         return np.asarray(fn(*leaf_arrays))
@@ -811,6 +832,7 @@ def bsi_range_sharded(mesh: Mesh, op: str, upred, depth: int,
         pbits = kernels.bsi_predicate_bits(upred, depth)
         pbits2 = np.zeros(depth, dtype=np.uint32)
     fn = _bsi_range_fn(mesh, op, len(plane_arrays))
+    _note_dispatch(*plane_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="bsi_range",
                                 depth=depth):
         return np.asarray(fn(pbits, pbits2, *plane_arrays))

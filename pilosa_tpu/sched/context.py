@@ -72,6 +72,11 @@ class QueryContext:
         # no-allocation fast path: stage() and span_current() check it
         # and record nothing.
         self.trace = None
+        # Resource-accounting attachment (obs.accounting.QueryCost),
+        # bound by the serving layer when accounting is on. Same
+        # contract as trace: None means every note_* site records
+        # nothing.
+        self.cost = None
 
     # -- budget --------------------------------------------------------------
 
@@ -144,7 +149,7 @@ class QueryContext:
         with self._mu:
             legs = list(self.legs)
             stages = dict(self.stages)
-        return {
+        out = {
             "id": self.id,
             "pql": self.pql[:200],
             "index": self.index,
@@ -158,16 +163,33 @@ class QueryContext:
             "legs": legs,
             "stages": {k: round(v, 4) for k, v in stages.items()},
         }
+        if self.cost is not None:
+            # The accounting roll-up rides /debug/queries and the slow
+            # log (obs.accounting.QueryCost.summary — totals only).
+            out["cost"] = self.cost.summary()
+        return out
 
 
 # -- thread-local propagation ------------------------------------------------
 
 _tls = threading.local()
 
+# Cross-thread view of the same bindings, for samplers that inspect
+# OTHER threads (the continuous profiler tags each sampled stack with
+# the query id bound to that thread — a thread-local is invisible from
+# the sampler thread). Plain dict ops are atomic under the GIL.
+_by_thread: dict[int, QueryContext] = {}
+
 
 def current() -> Optional[QueryContext]:
     """The QueryContext bound to this thread, or None."""
     return getattr(_tls, "ctx", None)
+
+
+def by_thread() -> dict[int, QueryContext]:
+    """Snapshot of thread-id -> bound QueryContext, for cross-thread
+    samplers (obs.profile)."""
+    return dict(_by_thread)
 
 
 @contextmanager
@@ -179,10 +201,19 @@ def use(ctx: Optional[QueryContext]):
     queries)."""
     prev = getattr(_tls, "ctx", None)
     _tls.ctx = ctx
+    tid = threading.get_ident()
+    if ctx is not None:
+        _by_thread[tid] = ctx
+    else:
+        _by_thread.pop(tid, None)
     try:
         yield ctx
     finally:
         _tls.ctx = prev
+        if prev is not None:
+            _by_thread[tid] = prev
+        else:
+            _by_thread.pop(tid, None)
 
 
 def check_current() -> None:

@@ -25,14 +25,18 @@ from ..executor import Executor
 from ..models.frame import FrameOptions
 from ..models.holder import Holder
 from ..models.index import IndexOptions
+from ..obs import accounting as obs_accounting
 from ..obs.metrics import RegistryStatsClient, default_registry
+from ..obs.profile import ContinuousProfiler
 from ..obs.runtime import RuntimeCollector
+from ..obs.slo import SLOTracker
 from ..obs.trace import Tracer
 from ..proto import internal_pb2 as pb
 from ..sched import (AdmissionController, QueryRegistry, Warmup,
                      warmup_enabled)
 from ..utils import logger as logger_mod
-from ..utils.config import MetricsConfig, QueryConfig, TraceConfig
+from ..utils.config import (MetricsConfig, ProfileConfig, QueryConfig,
+                            SLOConfig, TraceConfig)
 from ..utils.stats import NOP, MultiStatsClient
 from .handler import Handler
 from .httpd import HTTPServer
@@ -54,7 +58,9 @@ class Server:
                  logger=logger_mod.NOP,
                  query_config: Optional[QueryConfig] = None,
                  metrics_config: Optional[MetricsConfig] = None,
-                 trace_config: Optional[TraceConfig] = None):
+                 trace_config: Optional[TraceConfig] = None,
+                 profile_config: Optional[ProfileConfig] = None,
+                 slo_config: Optional[SLOConfig] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -75,6 +81,20 @@ class Server:
         self.tracer = Tracer(enabled=self.trace_config.enabled,
                              max_traces=self.trace_config.max_traces,
                              max_spans=self.trace_config.max_spans)
+        # Continuous profiler + SLO tracker (obs subsystem). The
+        # accounting knob stays PER SERVER (threaded into the handler
+        # and the batch lane) — a process-global flip here would let
+        # the last-constructed in-process server decide accounting for
+        # every other one.
+        self.profile_config = profile_config or ProfileConfig()
+        self.profiler = ContinuousProfiler(
+            hz=self.profile_config.hz, ring=self.profile_config.ring)
+        self.slo_config = slo_config or SLOConfig()
+        self.slo: Optional[SLOTracker] = None
+        if self.metrics_config.enabled:
+            self.slo = SLOTracker(
+                objective_s=self.slo_config.objective,
+                target=self.slo_config.target)
         self.runtime: Optional[RuntimeCollector] = None
         self.anti_entropy_interval = anti_entropy_interval
         self.polling_interval = polling_interval
@@ -159,7 +179,8 @@ class Server:
             self.runtime = RuntimeCollector(
                 holder=self.holder, executor=self.executor,
                 admission=self.admission,
-                interval_s=self.metrics_config.runtime_interval)
+                interval_s=self.metrics_config.runtime_interval,
+                slo=self.slo, profiler=self.profiler)
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
@@ -168,7 +189,9 @@ class Server:
             logger=self.logger, admission=self.admission,
             registry=self.query_registry, warmup=self.warmup,
             default_timeout_s=self.query_config.default_timeout,
-            tracer=self.tracer, runtime=self.runtime)
+            tracer=self.tracer, runtime=self.runtime,
+            profiler=self.profiler,
+            accounting=self.metrics_config.accounting)
 
         self._httpd = HTTPServer(self.handler, bind_host, port,
                                  logger=self.logger,
@@ -199,6 +222,8 @@ class Server:
         self.logger.printf("listening as http://%s", self.host)
         if self.runtime is not None:
             self.runtime.start()
+        if self.profile_config.continuous:
+            self.profiler.start()
         self._spawn(self._serve, "http")
         self._spawn(self._monitor_cache_flush, "cache-flush")
         if self.polling_interval > 0:
@@ -211,6 +236,7 @@ class Server:
         self._closing.set()
         if self.runtime is not None:
             self.runtime.stop()
+        self.profiler.stop()
         if self.warmup is not None:
             self.warmup.stop()
         if self._httpd is not None:
@@ -276,6 +302,8 @@ class Server:
                            index=index, lane=lane,
                            timeout_s=self.query_config.default_timeout
                            or None, node=self.host)
+        if self.metrics_config.accounting:
+            obs_accounting.attach(ctx, node=self.host)
         err = None  # stays None if execute_partial itself raises —
         # the finally below must never NameError over the real failure
         try:

@@ -41,6 +41,8 @@ import numpy as np
 
 from .. import SLICE_WIDTH
 from ..errors import PilosaError
+from ..obs import accounting as _accounting
+from ..obs import metrics as obs_metrics
 from ..parallel.residency import DeviceRowCache
 from ..proto import internal_pb2 as pb
 from ..utils import logger as logger_mod
@@ -363,6 +365,7 @@ class Fragment:
         changed = self.storage.add(pos) if set else self.storage.remove(pos)
         if not changed:
             return False
+        _accounting.note_bits_written(1)
         self._epoch += 1
         self.checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self.row_cache.invalidate(row_id)
@@ -422,6 +425,7 @@ class Fragment:
                                                wal=True)
             if not len(changed):
                 return changed
+            _accounting.note_bits_written(len(changed))
             self._epoch += 1
             ch_rows, deltas = np.unique(changed >> row_shift,
                                         return_counts=True)
@@ -452,7 +456,8 @@ class Fragment:
         if self.storage.op_n > MAX_OP_N:
             self.snapshot(sync=False)
 
-    def snapshot(self, sync: bool = True) -> None:
+    def snapshot(self, sync: bool = True,
+                 reason: str = "storage") -> None:
         """Atomically rewrite the data file from current state
         (reference fragment.go:991-1057).
 
@@ -484,9 +489,9 @@ class Fragment:
         # it before snapshotting (the worker needs _mu to finish, so
         # joining under _mu would deadlock).
         with self._snap_mu:
-            self._snapshot_locked()
+            self._snapshot_locked(reason=reason)
 
-    def _snapshot_locked(self) -> None:
+    def _snapshot_locked(self, reason: str = "storage") -> None:
         with self._mu:
             with self.logger.track("fragment: snapshot %s/%s/%s/%d",
                                    self.index, self.frame, self.view,
@@ -501,13 +506,21 @@ class Fragment:
                     f.flush()
                     os.fsync(f.fileno())
                 self._swap_data_file(tmp, new_op_n=0)
+                snap_s = time.perf_counter() - t0
+                # The snapshot leg of the import-stage breakdown
+                # (decode/apply land in the wire-import handler) —
+                # only for snapshots the IMPORT path forced; op-log
+                # threshold and anti-entropy rewrites would pollute
+                # the import attribution.
+                if reason == "import":
+                    obs_metrics.IMPORT_STAGE_SECONDS.labels(
+                        "snapshot").observe(snap_s)
                 if self.stats is not None:
                     # Distribution, not last-write-wins: the expvar
                     # client aggregates count/sum/min/max and the
                     # registry bridge buckets it (obs.metrics).
                     self.stats.timing(
-                        "snapshotDurationNs",
-                        (time.perf_counter() - t0) * 1e9)
+                        "snapshotDurationNs", snap_s * 1e9)
 
     def _swap_data_file(self, tmp: str, new_op_n: int) -> None:
         """Swap ``tmp`` in as the data file (caller holds _mu; one
@@ -669,6 +682,7 @@ class Fragment:
             return
         with self._mu:
             self._epoch += 1
+            _accounting.note_bits_written(len(positions))
             writer, self.storage.op_writer = self.storage.op_writer, None
             try:
                 self.storage.add_many(positions)
@@ -731,7 +745,7 @@ class Fragment:
         # deadlock the join). Crash semantics unchanged — the bulk adds
         # were never WAL'd, so the window between apply and snapshot
         # losing them existed under the lock too.
-        self.snapshot()
+        self.snapshot(reason="import")
 
     # -- TopN ----------------------------------------------------------------
 

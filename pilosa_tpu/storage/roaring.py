@@ -33,6 +33,7 @@ from typing import Callable, Iterator as TIterator, Optional
 import numpy as np
 
 from . import native
+from ..obs import accounting as _accounting
 from ..utils.arrays import searchsorted_membership, sort_dedupe
 
 # --- constants (match reference wire format) ---------------------------------
@@ -330,8 +331,37 @@ def op_counts() -> dict[tuple[str, str], int]:
     return dict(_OP_COUNTS)
 
 
+# Fixed bitmap-container word count (65536 bits / 64-bit words) — the
+# scan cost a bitmap operand contributes to the per-query ledger.
+_BITMAP_WORDS = 1024
+
+
+def _scan_words(c: Container) -> int:
+    """Word-equivalents one operand contributes: a bitmap container is
+    a full 1024-word scan; an array container counts its elements at
+    64 per word (the comparable memory-traffic unit)."""
+    if c.is_array():
+        return (len(c.array) + 63) >> 6
+    return _BITMAP_WORDS
+
+
+def _bump(op: str, a: Container, b: Container) -> None:
+    """One site feeding BOTH accountings: the process-global counters
+    (pilosa_roaring_container_ops_total via the runtime collector) and
+    the current query's cost ledger (obs.accounting) when one is bound
+    to this thread — per-query container-kind attribution is the whole
+    point of the ledger (arXiv:1709.07821's per-container-type
+    statistics, per query)."""
+    kind = _op_kind(a, b)
+    _OP_COUNTS[(op, kind)] += 1
+    cost = _accounting.current_cost()
+    if cost is not None:
+        cost.note_container_op(op, kind,
+                               _scan_words(a) + _scan_words(b))
+
+
 def _intersect(a: Container, b: Container) -> Container:
-    _OP_COUNTS[("intersect", _op_kind(a, b))] += 1
+    _bump("intersect", a, b)
     if a.is_array() and b.is_array():
         out = native.intersect_sorted_u32(a.array, b.array)
         return Container.from_array(out)
@@ -348,7 +378,7 @@ def _intersect(a: Container, b: Container) -> Container:
 
 
 def _intersection_count(a: Container, b: Container) -> int:
-    _OP_COUNTS[("intersection_count", _op_kind(a, b))] += 1
+    _bump("intersection_count", a, b)
     if a.is_array() and b.is_array():
         return native.intersection_count_sorted_u32(a.array, b.array)
     if a.is_array() != b.is_array():
@@ -361,7 +391,7 @@ def _intersection_count(a: Container, b: Container) -> int:
 
 
 def _union(a: Container, b: Container) -> Container:
-    _OP_COUNTS[("union", _op_kind(a, b))] += 1
+    _bump("union", a, b)
     if a.is_array() and b.is_array():
         out = np.union1d(a.array, b.array).astype(np.uint32)
         c = Container.from_array(out)
@@ -374,7 +404,7 @@ def _union(a: Container, b: Container) -> Container:
 
 
 def _difference(a: Container, b: Container) -> Container:
-    _OP_COUNTS[("difference", _op_kind(a, b))] += 1
+    _bump("difference", a, b)
     if a.is_array():
         av = a.array
         if b.is_array():
@@ -391,7 +421,7 @@ def _difference(a: Container, b: Container) -> Container:
 
 
 def _xor(a: Container, b: Container) -> Container:
-    _OP_COUNTS[("xor", _op_kind(a, b))] += 1
+    _bump("xor", a, b)
     if a.is_array() and b.is_array():
         out = np.setxor1d(a.array, b.array, assume_unique=True).astype(np.uint32)
         c = Container.from_array(out)

@@ -86,9 +86,34 @@ class MetricsConfig:
     """[metrics] section (obs subsystem): ``enabled`` gates the
     /metrics endpoint, the StatsClient→registry bridge, and the
     runtime collector; ``runtime_interval`` (seconds) paces the
-    collector's background sampling."""
+    collector's background sampling; ``accounting`` gates the
+    per-query cost ledger (obs.accounting — on by default, plain-int
+    increments)."""
     enabled: bool = True
     runtime_interval: float = 10.0
+    accounting: bool = True
+
+
+@dataclass
+class ProfileConfig:
+    """[profile] section (obs subsystem): the ALWAYS-ON low-Hz
+    continuous wall profiler behind ``GET /debug/pprof/flame``
+    (obs.profile). ``continuous`` turns it off entirely; ``hz`` is the
+    sampling rate (default 10 — microseconds of work per tick);
+    ``ring`` bounds the retained sample count."""
+    continuous: bool = True
+    hz: float = 10.0
+    ring: int = 8192
+
+
+@dataclass
+class SLOConfig:
+    """[slo] section (obs subsystem): the latency objective the
+    rolling burn rates (obs.slo.SLOTracker) are computed against —
+    fraction ``target`` of queries must finish within ``objective``
+    seconds."""
+    objective: float = 0.25
+    target: float = 0.99
 
 
 @dataclass
@@ -116,6 +141,8 @@ class Config:
     query: QueryConfig = field(default_factory=QueryConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    profile: ProfileConfig = field(default_factory=ProfileConfig)
+    slo: SLOConfig = field(default_factory=SLOConfig)
     anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
     log_path: str = ""
     # Accepted and persisted but inert, exactly like the reference at
@@ -155,11 +182,21 @@ slow-threshold = "{dur(self.query.slow_threshold)}"
 [metrics]
 enabled = {str(self.metrics.enabled).lower()}
 runtime-interval = "{dur(self.metrics.runtime_interval)}"
+accounting = {str(self.metrics.accounting).lower()}
 
 [trace]
 enabled = {str(self.trace.enabled).lower()}
 max-traces = {self.trace.max_traces}
 max-spans = {self.trace.max_spans}
+
+[profile]
+continuous = {str(self.profile.continuous).lower()}
+hz = {self.profile.hz}
+ring = {self.profile.ring}
+
+[slo]
+objective = "{dur(self.slo.objective)}"
+target = {self.slo.target}
 
 [plugins]
 path = "{self.plugins_path}"
@@ -218,6 +255,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
         if "runtime-interval" in m:
             cfg.metrics.runtime_interval = parse_duration(
                 m["runtime-interval"])
+        if "accounting" in m:
+            cfg.metrics.accounting = _parse_bool(m["accounting"])
         t = data.get("trace", {})
         if "enabled" in t:
             cfg.trace.enabled = _parse_bool(t["enabled"])
@@ -225,6 +264,18 @@ def load(path: str = "", env: dict | None = None) -> Config:
             cfg.trace.max_traces = int(t["max-traces"])
         if "max-spans" in t:
             cfg.trace.max_spans = int(t["max-spans"])
+        p = data.get("profile", {})
+        if "continuous" in p:
+            cfg.profile.continuous = _parse_bool(p["continuous"])
+        if "hz" in p:
+            cfg.profile.hz = float(p["hz"])
+        if "ring" in p:
+            cfg.profile.ring = int(p["ring"])
+        s = data.get("slo", {})
+        if "objective" in s:
+            cfg.slo.objective = parse_duration(s["objective"])
+        if "target" in s:
+            cfg.slo.target = float(s["target"])
         cfg.plugins_path = data.get("plugins", {}).get(
             "path", cfg.plugins_path)
     env = os.environ if env is None else env
@@ -273,6 +324,20 @@ def load(path: str = "", env: dict | None = None) -> Config:
     if env.get("PILOSA_METRICS_RUNTIME_INTERVAL"):
         cfg.metrics.runtime_interval = parse_duration(
             env["PILOSA_METRICS_RUNTIME_INTERVAL"])
+    if env.get("PILOSA_METRICS_ACCOUNTING"):
+        cfg.metrics.accounting = _parse_bool(
+            env["PILOSA_METRICS_ACCOUNTING"])
+    if env.get("PILOSA_PROFILE_CONTINUOUS"):
+        cfg.profile.continuous = _parse_bool(
+            env["PILOSA_PROFILE_CONTINUOUS"])
+    if env.get("PILOSA_PROFILE_HZ"):
+        cfg.profile.hz = float(env["PILOSA_PROFILE_HZ"])
+    if env.get("PILOSA_PROFILE_RING"):
+        cfg.profile.ring = int(env["PILOSA_PROFILE_RING"])
+    if env.get("PILOSA_SLO_OBJECTIVE"):
+        cfg.slo.objective = parse_duration(env["PILOSA_SLO_OBJECTIVE"])
+    if env.get("PILOSA_SLO_TARGET"):
+        cfg.slo.target = float(env["PILOSA_SLO_TARGET"])
     if env.get("PILOSA_TRACE_ENABLED"):
         cfg.trace.enabled = _parse_bool(env["PILOSA_TRACE_ENABLED"])
     if env.get("PILOSA_TRACE_MAX_TRACES"):

@@ -41,28 +41,45 @@ def _is_idle_leaf(frame) -> bool:
             code.co_name) in _IDLE_LEAVES
 
 
-def heap_profile(top_n: int = 30, stop: bool = False) -> str:
-    """Python heap allocation report via tracemalloc (the reference gets
-    /debug/pprof/heap free from net/http/pprof, handler.go:30,99).
+# Heap profiling via tracemalloc (the reference gets /debug/pprof/heap
+# free from net/http/pprof, handler.go:30,99). tracemalloc costs ~2× on
+# allocations while tracing, so arming is explicit and removable
+# without a restart — and, since this round, arm/disarm are separate
+# MUTATING operations (POST on the endpoint) while the report is a
+# pure read (GET): a monitoring system GETing the heap endpoint must
+# never toggle interpreter-wide allocation tracing as a side effect.
 
-    tracemalloc costs ~2× on allocations while tracing, so it is armed
-    by the first call, reports on subsequent calls, and is DISARMED
-    with ``stop`` (?off=1 on the endpoint) when the leak hunt is over —
-    demand-driven like Go's heap profile, but the tax is removable
-    without a restart. One frame per allocation is recorded: the report
-    groups by source line and never reads deeper frames."""
+
+def heap_start() -> str:
+    """Arm tracemalloc (idempotent). One frame per allocation is
+    recorded: the report groups by source line and never reads deeper
+    frames."""
     import tracemalloc
-    if stop:
-        if tracemalloc.is_tracing():
-            tracemalloc.stop()
-            return "tracemalloc stopped; allocation tracing disarmed.\n"
-        return "tracemalloc was not tracing.\n"
+    if tracemalloc.is_tracing():
+        return "tracemalloc already tracing.\n"
+    tracemalloc.start(1)
+    return ("tracemalloc started. Allocations are now traced; GET the "
+            "endpoint for the report, POST ?op=stop to disarm (tracing "
+            "costs ~2x on allocation-heavy paths).\n")
+
+
+def heap_stop() -> str:
+    """Disarm tracemalloc (idempotent)."""
+    import tracemalloc
+    if tracemalloc.is_tracing():
+        tracemalloc.stop()
+        return "tracemalloc stopped; allocation tracing disarmed.\n"
+    return "tracemalloc was not tracing.\n"
+
+
+def heap_report(top_n: int = 30) -> str:
+    """Allocation-site report — a pure read; arming state is
+    untouched."""
+    import tracemalloc
     if not tracemalloc.is_tracing():
-        tracemalloc.start(1)
-        return ("tracemalloc started. Allocations are now traced; "
-                "request this endpoint again for the report, and add "
-                "?off=1 to disarm (tracing costs ~2x on allocation-"
-                "heavy paths).\n")
+        return ("tracemalloc is not tracing. POST "
+                "/debug/pprof/heap?op=start to arm it, then GET for "
+                "the report.\n")
     snap = tracemalloc.take_snapshot()
     stats = snap.statistics("lineno")
     total = sum(s.size for s in stats)

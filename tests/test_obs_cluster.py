@@ -53,6 +53,10 @@ def cluster(tmp_path):
         env["PILOSA_TPU_MESH"] = "0"
         env["PILOSA_TPU_WARMUP"] = "0"
         env["PILOSA_TRACE_ENABLED"] = "1"
+        # Slow log at ~0: every finished query's ledger is retained,
+        # so the cost-tree test can read the REMOTE node's own ledger
+        # after the fact and compare it to the stitched child.
+        env["PILOSA_QUERY_SLOW_THRESHOLD"] = "1us"
         log = open(tmp_path / f"{name}.log", "a")
         logs.append(log)
         argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
@@ -160,3 +164,58 @@ def test_one_trace_id_spans_coordinator_and_remote_legs(cluster):
     # The remote node also kept its own child trace locally.
     listing_b = _get_json(host_b, "/debug/traces")
     assert any(t["id"] == qid for t in listing_b["traces"])
+
+
+def test_profile_cost_tree_includes_remote_ledger(cluster):
+    """?profile=1 on the coordinator returns ONE merged cost tree
+    whose remote-leg child is the REMOTE node's own ledger: its
+    container-op counts must equal what that node recorded for its leg
+    (read back from its slow log, armed at ~0 threshold), and the
+    root must carry the RPC bytes of the fan-out leg to that peer."""
+    host_a, host_b = cluster["a"], cluster["b"]
+
+    # Materializing Intersect: every slice leg does real roaring
+    # container algebra on whichever node owns it.
+    q = (b'Intersect(Bitmap(frame="f", rowID=1),'
+         b' Bitmap(frame="f", rowID=1))')
+    with _post(host_a, "/index/tr/query?profile=1", q) as r:
+        qid = r.headers["X-Pilosa-Query-Id"]
+        stats_hdr = r.headers["X-Pilosa-Stats"]
+        resp = json.loads(r.read())
+    assert qid
+
+    tree = resp["profile"]
+    assert tree["node"] == host_a
+    assert {"parse", "admission", "execute"} <= set(tree["stages"])
+    # The coordinator recorded the RPC leg to the peer: request and
+    # response bytes, per peer host.
+    assert host_b in tree["rpc"], tree
+    assert tree["rpc"][host_b]["bytesOut"] > 0
+    assert tree["rpc"][host_b]["bytesIn"] > 0
+    assert tree["rpc"][host_b]["calls"] >= 1
+    # The remote leg's ledger arrived as a stitched child.
+    children = [c for c in tree.get("children", [])
+                if c["node"] == host_b]
+    assert children, tree
+    child = children[0]
+    child_ops = child["containerOps"]
+    assert sum(child_ops.values()) >= 1, child
+    # The child IS the remote node's own accounting: node B's slow log
+    # retained its leg's ledger under the same query id — totals must
+    # match exactly.
+    slow_b = _get_json(host_b, "/debug/queries/slow")["slow"]
+    leg = [e for e in slow_b if e["id"] == qid and e["remote"]]
+    assert leg, slow_b
+    assert leg[-1]["cost"]["containerOps"] == sum(child_ops.values())
+    assert leg[-1]["cost"]["wordsScanned"] == child["wordsScanned"]
+
+    # The compact roll-up header agrees with the inline tree.
+    stats = json.loads(stats_hdr)
+    assert stats["rpcBytesOut"] == tree["rpc"][host_b]["bytesOut"]
+    assert stats["remoteLegs"] == len(tree["children"])
+
+    # And the coordinator's own slow-log entry carries the roll-up
+    # (cost visibility without ?profile=1).
+    slow_a = _get_json(host_a, "/debug/queries/slow")["slow"]
+    entry = [e for e in slow_a if e["id"] == qid and not e["remote"]]
+    assert entry and "cost" in entry[-1]

@@ -61,7 +61,8 @@ class TestRouteTableDocumented:
             readme = f.read()
         swept = []
         for _method, _regex, _fn, _lane, pattern in handler._routes:
-            if pattern == "/metrics" or pattern.startswith("/debug/"):
+            if pattern in ("/metrics", "/health") \
+                    or pattern.startswith("/debug/"):
                 swept.append(pattern)
                 # Variable segments differ in name between code and
                 # docs ({qid} vs {id}); the static prefix must appear
@@ -75,3 +76,61 @@ class TestRouteTableDocumented:
         assert "/metrics" in swept
         assert any(p.startswith("/debug/traces") for p in swept)
         assert "/debug/queries/slow" in swept
+        assert "/debug/pprof/flame" in swept
+        assert "/health" in swept
+
+
+# One OpenMetrics 1.0 metric line, optionally with an exemplar:
+#   name{labels} value [# {exemplar-labels} value timestamp]
+_OM_LINE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"
+    r" (NaN|[-+]?(?:[0-9.eE+-]+|Inf))"
+    r"(?: # \{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\}"
+    r" ([-+]?[0-9.eE+-]+)(?: ([0-9.]+))?)?$")
+
+
+class TestOpenMetricsExemplars:
+    def test_exemplar_output_parses_as_openmetrics(self):
+        """The OpenMetrics rendering must be structurally valid:
+        counter families declared under the _total-stripped name,
+        exemplars only on bucket/counter samples, terminated by
+        # EOF — and the exemplar we recorded must surface with its
+        trace id."""
+        reg = obs_metrics.Registry()
+        c = reg.counter("pilosa_test_events_total")
+        c.inc(3)
+        h = reg.histogram("pilosa_test_latency_seconds",
+                          buckets=(0.1, 1.0))
+        h.observe(0.05, exemplar={"trace_id": "abc123"})
+        h.observe(5.0)
+        text = reg.render(openmetrics=True)
+        lines = text.splitlines()
+        assert lines[-1] == "# EOF"
+        # Counter TYPE under the stripped name; sample keeps _total.
+        assert "# TYPE pilosa_test_events counter" in lines
+        assert any(ln.startswith("pilosa_test_events_total 3")
+                   for ln in lines)
+        saw_exemplar = False
+        for ln in lines:
+            if not ln or ln.startswith("#"):
+                continue
+            m = _OM_LINE.match(ln)
+            assert m, f"unparseable OpenMetrics line: {ln!r}"
+            if m.group(4):  # exemplar present
+                assert "_bucket" in m.group(1), (
+                    "exemplar on a non-bucket sample")
+                if 'trace_id="abc123"' in m.group(4):
+                    saw_exemplar = True
+        assert saw_exemplar, text
+        # The 0.0.4 rendering of the same registry must NOT carry
+        # exemplars (old scrapers would choke).
+        assert " # {" not in reg.render()
+
+    def test_default_registry_openmetrics_renders_clean(self):
+        text = obs_metrics.default_registry().render(openmetrics=True)
+        assert text.rstrip().endswith("# EOF")
+        for ln in text.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            assert _OM_LINE.match(ln), f"bad OpenMetrics line: {ln!r}"

@@ -82,19 +82,35 @@ def test_pprof_http_endpoints(tmp_path):
         assert status == 200 and body.startswith(b"# cpu profile")
         status, _, body = call(handler, "GET", "/debug/pprof/threads")
         assert status == 200 and b"MainThread" in body
-        # Heap: first call arms tracemalloc, second reports top sites,
-        # ?off=1 disarms.
-        status, _, body = call(handler, "GET", "/debug/pprof/heap")
-        assert status == 200
-        if b"started" in body:
-            blob = bytearray(1 << 16)  # some traced allocations
-            status, _, body = call(handler, "GET",
-                                   "/debug/pprof/heap?n=10")
-            del blob
-        assert status == 200 and b"traced memory" in body
-        status, _, body = call(handler, "GET", "/debug/pprof/heap?off=1")
-        assert status == 200 and b"stopped" in body
+        # Heap: GET is READ-ONLY (a monitoring scrape must not toggle
+        # interpreter-wide allocation tracing); POST ?op=start|stop
+        # arm/disarm. The old GET ?off=1 form survives as a
+        # deprecation shim.
         import tracemalloc
+        status, _, body = call(handler, "GET", "/debug/pprof/heap")
+        assert status == 200 and b"not tracing" in body
+        assert not tracemalloc.is_tracing()  # the GET did not arm
+        status, _, body = call(handler, "POST",
+                               "/debug/pprof/heap?op=start")
+        assert status == 200 and b"started" in body
+        blob = bytearray(1 << 16)  # some traced allocations
+        status, _, body = call(handler, "GET",
+                               "/debug/pprof/heap?n=10")
+        del blob
+        assert status == 200 and b"traced memory" in body
+        assert tracemalloc.is_tracing()  # the GET did not disarm
+        status, _, body = call(handler, "POST",
+                               "/debug/pprof/heap?op=stop")
+        assert status == 200 and b"stopped" in body
+        assert not tracemalloc.is_tracing()
+        status, _, body = call(handler, "POST",
+                               "/debug/pprof/heap?op=nope")
+        assert status == 400
+        # Deprecation shim: the old GET ?off=1 still disarms, loudly.
+        call(handler, "POST", "/debug/pprof/heap?op=start")
+        status, _, body = call(handler, "GET",
+                               "/debug/pprof/heap?off=1")
+        assert status == 200 and b"DEPRECATED" in body
         assert not tracemalloc.is_tracing()
     finally:
         h.close()
