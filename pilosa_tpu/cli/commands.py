@@ -291,7 +291,8 @@ def cmd_server(args, stdout, stderr) -> int:
                     polling_interval=cfg.cluster.polling_interval,
                     logger=logger, query_config=cfg.query,
                     metrics_config=cfg.metrics, trace_config=cfg.trace,
-                    profile_config=cfg.profile, slo_config=cfg.slo)
+                    profile_config=cfg.profile, slo_config=cfg.slo,
+                    fault_config=cfg.fault)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()

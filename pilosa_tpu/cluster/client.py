@@ -23,6 +23,7 @@ from .. import SLICE_WIDTH
 from ..utils.arrays import group_by_key
 from ..errors import (FragmentNotFoundError, PilosaError,
                       QueryDeadlineError)
+from ..fault import failpoints as _fp
 from ..obs.accounting import COST_HEADER
 from ..obs.trace import SPANS_HEADER, TRACE_HEADER
 from ..pql import parser as pql
@@ -35,6 +36,12 @@ _PROTOBUF = "application/x-protobuf"
 
 class ClientError(PilosaError):
     pass
+
+
+class CircuitOpenError(ClientError):
+    """Failed fast: the target peer's circuit breaker is open (fault
+    subsystem). Subclasses ClientError so every failover loop treats
+    it exactly like the timeout it replaces — minus the wait."""
 
 
 def _host_of(node) -> str:
@@ -83,11 +90,18 @@ class Client:
     closed an idle socket.
     """
 
-    def __init__(self, host: str, timeout: float = 30.0):
+    def __init__(self, host: str, timeout: float = 30.0, fault=None):
         if not host:
             raise ClientError("host required")
         self.host = host
         self.timeout = timeout
+        # Fault-tolerance hook (fault.FaultManager): when set, every
+        # request consults the target's circuit breaker (open = fail
+        # fast with CircuitOpenError instead of paying the socket
+        # timeout) and every attempt's outcome + latency feeds the
+        # per-peer health EWMA. None (bare clients, tests, CLI) keeps
+        # the plain transport behavior.
+        self.fault = fault
         self._pool: dict[str, list[http.client.HTTPConnection]] = {}
         self._pool_mu = threading.Lock()
         # Hosts that 415'd the raw-array import format (reference-
@@ -142,6 +156,13 @@ class Client:
         target = host or self.host
         if idempotent is None:
             idempotent = method in self._IDEMPOTENT
+        # Circuit breaker (fault subsystem): an open circuit fails
+        # fast — the whole point is to NOT pay the dead peer's socket
+        # timeout again. allow() grants the half-open probe when the
+        # backoff window has lapsed.
+        if self.fault is not None and not self.fault.allow(target):
+            raise CircuitOpenError(
+                f"{method} http://{target}{path}: circuit open")
         deadline = (time.monotonic() + deadline_s
                     if deadline_s is not None else None)
         # File-like bodies (streaming restore) must rewind between
@@ -177,23 +198,44 @@ class Client:
                 if conn.sock is not None:
                     conn.sock.settimeout(timeout)
             sent = False
+            t0 = time.perf_counter()
             try:
+                if _fp.ACTIVE is not None:
+                    _fp.ACTIVE.hit("rpc.send", host=target)
                 conn.request(method, path, body=body, headers=headers or {})
                 sent = True
                 resp = conn.getresponse()
                 data = resp.read()
+                if _fp.ACTIVE is not None:
+                    _fp.ACTIVE.hit("rpc.recv", host=target)
                 if headers_out is not None:
                     headers_out.extend(resp.getheaders())
                 if resp.will_close:
                     conn.close()
                 else:
                     self._conn_put(target, conn)
+                if self.fault is not None:
+                    # Any completed HTTP exchange means the peer is
+                    # alive, whatever the status code says.
+                    self.fault.record_rpc(target, True,
+                                          time.perf_counter() - t0)
                 return resp.status, data
             except (http.client.HTTPException, OSError) as e:
                 conn.close()
                 last_err = e
-                if deadline is not None and \
-                        time.monotonic() >= deadline:
+                deadline_hit = (deadline is not None
+                                and time.monotonic() >= deadline)
+                if self.fault is not None and not (
+                        deadline_hit and isinstance(e, TimeoutError)):
+                    # A timeout that merely exhausted the CALLER'S
+                    # clamped budget says more about the budget than
+                    # the peer — a healthy 80 ms peer serving 50 ms
+                    # deadlines must not trip its breaker. Refused/
+                    # reset/torn responses are real peer failures
+                    # whatever the budget; the breaker-probe loop
+                    # classifies its own timeouts explicitly.
+                    self.fault.record_rpc(target, False)
+                if deadline_hit:
                     # The attempt consumed the rest of the budget (e.g.
                     # a stalled peer ate the clamped socket timeout):
                     # this is a deadline expiry, not a node failure.
@@ -210,6 +252,15 @@ class Client:
                     # so surface the error instead (urllib3 safe-retry
                     # policy).
                     break
+            except BaseException:
+                # Anything else that escapes mid-request — a deadline
+                # raised from a hook, KeyboardInterrupt, an unexpected
+                # protocol error — leaves the socket in an unknown
+                # state: DROP it. A broken connection must never
+                # return to the pool where _conn_get would hand it to
+                # the next request (pool-poisoning).
+                conn.close()
+                raise
         # Unreachable host → ClientError so failover loops can catch
         # and try the next owner.
         raise ClientError(f"{method} http://{target}{path}: {last_err}")
@@ -235,6 +286,53 @@ class Client:
                 f"{what}: invalid status: code={status},"
                 f" err={body.decode(errors='replace').strip()}")
         return body
+
+    # Import-lane 429 handling: base/cap of the capped exponential
+    # backoff (full jitter), and the total-wait ceiling when no query
+    # deadline bounds the retry loop.
+    _RETRY_429_BASE = 0.25
+    _RETRY_429_CAP = 8.0
+
+    def _do_429(self, method: str, path: str, body, headers: dict,
+                host: Optional[str]) -> tuple[int, bytes]:
+        """_do for import legs, honoring admission control's 429 +
+        Retry-After with capped exponential backoff + full jitter
+        instead of surfacing the first rejection. The loop is bounded
+        by the calling query's remaining deadline budget when one is
+        bound to this thread (sched.context), and by ``self.timeout``
+        of total sleep otherwise — an overloaded server sheds load;
+        the client must neither hammer it nor wait forever."""
+        ctx = sched_context.current()
+        budget = ctx.remaining() if ctx is not None else None
+        if budget is None:
+            budget = self.timeout
+        deadline = time.monotonic() + max(budget, 0.0)
+        backoff = self._RETRY_429_BASE
+        while True:
+            headers_out: list = []
+            status, raw = self._do(method, path, body, headers,
+                                   host=host, headers_out=headers_out)
+            if status != 429:
+                return status, raw
+            retry_after = 0.0
+            for hk, hv in headers_out:
+                if hk.lower() == "retry-after":
+                    try:
+                        retry_after = float(hv)
+                    except ValueError:
+                        pass
+            # Full jitter over the exponential window, floored at the
+            # server's own hold estimate.
+            wait = max(retry_after, random.uniform(0.0, backoff))
+            backoff = min(backoff * 2.0, self._RETRY_429_CAP)
+            remaining = deadline - time.monotonic()
+            if wait >= remaining:
+                # Out of budget: surface the rejection (the caller's
+                # _ok turns it into the usual ClientError).
+                return status, raw
+            if ctx is not None:
+                ctx.check()
+            time.sleep(wait)
 
     # -- queries (client.go:216-269) -----------------------------------------
 
@@ -385,10 +483,10 @@ class Client:
                     raw_body = rawimport.encode(
                         index, frame, slice, rows, cols,
                         ts if ts.any() else None)
-                status, raw = self._do(
+                status, raw = self._do_429(
                     "POST", "/import", raw_body,
                     {"Content-Type": rawimport.CONTENT_TYPE,
-                     "Accept": _PROTOBUF}, host=host)
+                     "Accept": _PROTOBUF}, host)
                 if status != 415:
                     self._ok(status, raw, f"import slice {slice}")
                     resp = pb.ImportResponse.FromString(raw)
@@ -402,10 +500,10 @@ class Client:
                     RowIDs=rows.tolist(), ColumnIDs=cols.tolist(),
                     Timestamps=ts.tolist() if ts.any() else []
                 ).SerializeToString()
-            status, raw = self._do(
+            status, raw = self._do_429(
                 "POST", "/import", pb_body,
                 {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF},
-                host=host)
+                host)
             self._ok(status, raw, f"import slice {slice}")
             resp = pb.ImportResponse.FromString(raw)
             if resp.Err:
@@ -475,11 +573,11 @@ class Client:
         if not nodes:
             raise ClientError(f"no owner for slice {slice}")
         for node in nodes:
-            status, raw = self._do(
+            status, raw = self._do_429(
                 "POST", f"/index/{index}/frame/{frame}/field/{field}"
                         f"/import", body,
                 {"Content-Type": _PROTOBUF, "Accept": _PROTOBUF},
-                host=node["host"])
+                node["host"])
             self._ok(status, raw, f"import field slice {slice}")
             resp = pb.ImportResponse.FromString(raw)
             if resp.Err:

@@ -50,6 +50,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..fault import failpoints as _fp
 from ..utils import logger as logger_mod
 from .broadcast import marshal_message, unmarshal_message
 from .topology import Node
@@ -164,6 +165,13 @@ class GossipNodeSet:
         # datagram (deterministic loss/asymmetry injection; UDP loss on
         # send is indistinguishable from loss on the wire).
         self.loss_filter = None
+        # Fault-subsystem hook: on_state_change(member_name, state)
+        # fires on every membership transition (joined/alive/suspect/
+        # dead) so the server can fold gossip liveness into per-peer
+        # health and open a dead peer's circuit breaker BEFORE any
+        # query pays a timeout against it. Exceptions are swallowed —
+        # an observer must never break the membership protocol.
+        self.on_state_change = None
 
         self._handler = None          # server: BroadcastHandler+StatusHandler
         self._mu = threading.Lock()
@@ -392,7 +400,21 @@ class GossipNodeSet:
         if log_line:
             self.logger.printf("%s", log_line)
         if deliver_update:
+            if w.name != self.host:
+                self._notify_state(w.name)
             self._gossip_update(self._member_snapshot(w.name))
+
+    def _notify_state(self, name: str) -> None:
+        cb = self.on_state_change
+        if cb is None:
+            return
+        with self._mu:
+            m = self._members.get(name)
+            state = m.state if m is not None else STATE_DEAD
+        try:
+            cb(name, state)
+        except Exception:  # noqa: BLE001 - observers must not break SWIM
+            pass
 
     def _member_snapshot(self, name: str) -> Member:
         with self._mu:
@@ -565,6 +587,11 @@ class GossipNodeSet:
         self._handle_envelope(data)
 
     def _handle_envelope(self, data: bytes) -> None:
+        if _fp.ACTIVE is not None:
+            try:
+                _fp.ACTIVE.hit("gossip.deliver")
+            except _fp.FailpointError:
+                return  # injected drop: the envelope is lost in transit
         if self._handler is not None:
             try:
                 self._handler.receive_message(unmarshal_message(data))
@@ -738,6 +765,7 @@ class GossipNodeSet:
             self.logger.printf(
                 "gossip: node %s missed %d direct+indirect probes,"
                 " marking suspect", suspect.name, self.suspect_after)
+            self._notify_state(suspect.name)
             self._gossip_update(suspect)
 
     def _suspect_window(self, n_members: int) -> float:
@@ -766,6 +794,7 @@ class GossipNodeSet:
             self.logger.printf(
                 "gossip: suspect %s not refuted in %.1fs, declaring"
                 " dead", d.name, window)
+            self._notify_state(d.name)
             self._gossip_update(d)
 
 

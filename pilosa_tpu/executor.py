@@ -80,10 +80,18 @@ class ExecOptions:
     pod-global collectives. ctx carries the query's lifecycle state
     (sched.context.QueryContext: deadline budget + cancel flag) — every
     fan-out layer checks it, remote legs inherit the REMAINING budget,
-    and None (internal/maintenance callers) means unbounded."""
+    and None (internal/maintenance callers) means unbounded.
+
+    partial=True (the ``?partial=1`` degraded-read contract, fault
+    subsystem): slices with NO reachable replica are skipped instead
+    of failing the whole query; their ids accumulate in
+    ``missing_slices`` (the handler reports them as the
+    ``X-Pilosa-Partial`` response header)."""
     remote: bool = False
     pod_local: bool = False
     ctx: Optional[object] = None
+    partial: bool = False
+    missing_slices: Optional[list] = None
 
 
 def _needs_slices(calls: list[Call]) -> bool:
@@ -122,11 +130,18 @@ class Executor:
     def __init__(self, holder, host: str = "",
                  cluster: Optional[Cluster] = None, client=None,
                  max_workers: int = 16, use_mesh: Optional[bool] = None,
-                 mesh_min_slices: Optional[int] = None, pod=None):
+                 mesh_min_slices: Optional[int] = None, pod=None,
+                 fault=None):
         self.holder = holder
         self.host = host
         self.cluster = cluster or new_cluster([host])
         self.client = client
+        # Fault-tolerance state (fault.FaultManager): _slices_by_node
+        # orders replica owners by health and sinks open circuits, the
+        # re-map path consults it instead of rediscovering a dead peer
+        # per query, and remote legs hedge when configured. None keeps
+        # the plain jump-hash-primary placement.
+        self.fault = fault
         # Multi-host pod membership (parallel.pod.Pod) — None in the
         # ordinary single-process server. On the pod coordinator the
         # local leg fans out pod-wide (collectives for device-batched
@@ -182,6 +197,14 @@ class Executor:
         with self._pools_mu:
             pool = self._pools.get(tier)
             size = self.max_workers
+            if tier == "hedge":
+                # Primaries AND their hedge legs share this tier, and
+                # every node-tier remote leg parks one primary here —
+                # at 1× the node tier's size, hedge legs would queue
+                # behind the very primaries they are racing (and a
+                # queued primary's wait(hedge_s) would expire on queue
+                # delay alone, firing spurious hedges under load).
+                size *= 2
             if tier == "pod" and self.pod is not None:
                 # Pod legs must all run concurrently — latency is
                 # the max over legs, not the sum (the old per-query
@@ -2446,14 +2469,40 @@ class Executor:
     # -- map-reduce core (executor.go:1087-1236) -----------------------------
 
     def _slices_by_node(self, nodes: list[Node], index: str,
-                        slices: list[int]) -> list[tuple[Node, list[int]]]:
+                        slices: list[int],
+                        missing: Optional[list] = None
+                        ) -> list[tuple[Node, list[int]]]:
+        """Group ``slices`` by the replica owner that will serve each.
+        With a fault manager attached, owners are consulted in health
+        order with open circuits sunk to the end (fault subsystem) —
+        so the first query after a peer dies pays one timeout, and
+        every query after it routes around the open circuit without
+        paying anything. ``missing`` (partial mode) collects slices
+        with no owner among ``nodes`` instead of raising."""
+        fault = self.fault
         m: dict[int, tuple[Node, list[int]]] = {}
+        # Placement ordering memo: PARTITION_N bounds the distinct
+        # owner tuples, so a 256-slice query pays ≤16 order_nodes
+        # calls (each is a sort + per-owner breaker/health consults)
+        # instead of one per slice.
+        order_memo: dict[tuple, list[Node]] = {}
         for slice in slices:
-            for node in self.cluster.fragment_nodes(index, slice):
+            owners = self.cluster.fragment_nodes(index, slice)
+            if fault is not None and len(owners) > 1:
+                key = tuple(id(n) for n in owners)
+                ordered = order_memo.get(key)
+                if ordered is None:
+                    ordered = order_memo[key] = fault.order_nodes(
+                        owners, local=self.host)
+                owners = ordered
+            for node in owners:
                 if any(n is node for n in nodes):
                     m.setdefault(id(node), (node, []))[1].append(slice)
                     break
             else:
+                if missing is not None:
+                    missing.append(slice)
+                    continue
                 raise SliceUnavailableError(str(slice))
         return list(m.values())
 
@@ -2500,16 +2549,30 @@ class Executor:
         processed = 0
         pool = self._pool("node")
         futures: dict = {}
+        # Degraded reads (?partial=1): slices with no reachable
+        # replica land here instead of failing the query; the handler
+        # reports them as X-Pilosa-Partial.
+        missing: Optional[list] = None
+        if opt.partial:
+            if opt.missing_slices is None:
+                opt.missing_slices = []
+            missing = opt.missing_slices
 
         def submit(nodes, slices):
+            nonlocal processed
+            before = len(missing) if missing is not None else 0
             for node, node_slices in self._slices_by_node(
-                    nodes, index, slices):
+                    nodes, index, slices, missing=missing):
                 fut = pool.submit(self._mapper_node, node, index, c,
                                   node_slices, opt, map_fn, reduce_fn,
                                   local_fn)
                 futures[fut] = (node, node_slices)
                 if ctx is not None:
                     ctx.add_leg(node.host, len(node_slices))
+            if missing is not None:
+                # Unservable slices still count toward completion —
+                # that is what "partial" means.
+                processed += len(missing) - before
 
         # One span covers the whole fan-out INCLUDING the reduce/merge
         # of completed legs (per-leg detail comes from the leg/rpc
@@ -2542,7 +2605,18 @@ class Executor:
                     except Exception as e:  # noqa: BLE001 - retry replicas
                         # Filter the failed node; re-map its slices onto
                         # surviving replicas (executor.go:1137-1151).
+                        # The client already fed the failure into the
+                        # breaker/health state, so the re-map's
+                        # _slices_by_node consults an open circuit
+                        # instead of rediscovering the failure — and
+                        # the NEXT query skips the peer up front.
                         nodes = [n for n in nodes if n is not node]
+                        obs_metrics.FAILOVER_SLICES.labels(
+                            node.host or "local").inc(len(node_slices))
+                        with _ctx_span(ctx, "failover", peer=node.host,
+                                       slices=len(node_slices),
+                                       error=type(e).__name__):
+                            pass
                         try:
                             submit(nodes, node_slices)
                         except SliceUnavailableError:
@@ -2592,9 +2666,104 @@ class Executor:
                                                      opt, map_fn,
                                                      reduce_fn)
                     return self._mapper_local(slices, map_fn, reduce_fn)
+            hedge_s = (self.fault.hedge_delay_s(node.host)
+                       if self.fault is not None else None)
+            if hedge_s:
+                return self._exec_remote_hedged(node, index, c, slices,
+                                                opt, map_fn, reduce_fn,
+                                                hedge_s)
             results = self._exec_remote(node, index, Query([c]), slices,
                                         opt)
             return results[0] if results else None
+
+    def _exec_remote_hedged(self, node: Node, index: str, c: Call,
+                            slices: list[int], opt: ExecOptions,
+                            map_fn, reduce_fn, hedge_s: float):
+        """Tail-tolerant remote leg (fault subsystem, opt-in): fire the
+        primary replica's RPC; if it hasn't answered within ``hedge_s``
+        (max of the configured floor and the peer's p95-ish latency
+        EWMA), fire the SAME slices at the surviving replica owners and
+        take whichever side completes first — first-response-wins, the
+        loser is cancelled if unstarted and abandoned otherwise (its
+        socket timeout stays bounded by the query budget). Map-reduce
+        legs are pure reads, so a duplicated leg is only spent work,
+        never a double write. A hedge that loses the race is never
+        re-raised; if BOTH sides fail the primary's error surfaces and
+        the outer re-map takes over."""
+        pool = self._pool("hedge")
+        query = Query([c])
+
+        def primary_leg():
+            rs = self._exec_remote(node, index, query, slices, opt)
+            return rs[0] if rs else None
+
+        primary = pool.submit(primary_leg)
+        done, _ = wait([primary], timeout=hedge_s)
+        if done:
+            return primary.result()
+        others = [n for n in self.cluster.nodes if n is not node]
+        try:
+            groups = self._slices_by_node(others, index, slices)
+        except SliceUnavailableError:
+            groups = []
+        if not groups:
+            return primary.result()
+        obs_metrics.HEDGED_REQUESTS.labels("fired").inc()
+        with _ctx_span(opt.ctx, "hedge", peer=node.host,
+                       slices=len(slices)):
+            pass
+
+        def hedge_leg(n2: Node, sl: list[int]):
+            if n2.host == self.host:
+                with sched_context.use(opt.ctx):
+                    return self._mapper_local(sl, map_fn, reduce_fn)
+            rs = self._exec_remote(n2, index, query, sl, opt)
+            return rs[0] if rs else None
+
+        hedges = [pool.submit(hedge_leg, n2, sl) for n2, sl in groups]
+        ctx = opt.ctx
+        primary_err = hedge_err = None
+        primary_res = hedge_res = None
+        primary_done = hedge_done = False
+        while True:
+            if ctx is not None:
+                ctx.check()
+            # Consume completed sides BEFORE blocking: a hedge that
+            # finished while we were submitting must win immediately,
+            # not after the slow primary finally returns.
+            if not primary_done and primary.done():
+                primary_done = True
+                try:
+                    primary_res = primary.result()
+                except (QueryDeadlineError, QueryCancelledError):
+                    raise
+                except Exception as e:  # noqa: BLE001 - hedges cover
+                    primary_err = e
+            if not hedge_done and all(f.done() for f in hedges):
+                hedge_done = True
+                try:
+                    r = None
+                    for f in hedges:
+                        r = reduce_fn(r, f.result())
+                    hedge_res = r
+                except (QueryDeadlineError, QueryCancelledError):
+                    raise
+                except Exception as e:  # noqa: BLE001 - primary covers
+                    hedge_err = e
+            if primary_done and primary_err is None:
+                obs_metrics.HEDGED_REQUESTS.labels("primary_won").inc()
+                for f in hedges:
+                    f.cancel()
+                return primary_res
+            if hedge_done and hedge_err is None:
+                obs_metrics.HEDGED_REQUESTS.labels("hedge_won").inc()
+                primary.cancel()
+                return hedge_res
+            if primary_done and hedge_done:
+                raise primary_err
+            wait([f for f in [primary, *hedges] if not f.done()],
+                 timeout=self._CTX_POLL_S if ctx is not None else None,
+                 return_when=FIRST_COMPLETED)
 
     def _pod_host_mapper(self, index: str, c: Call, slices: list[int],
                          opt: ExecOptions, map_fn, reduce_fn):

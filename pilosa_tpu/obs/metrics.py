@@ -462,6 +462,37 @@ SLO_OBJECTIVE = _DEFAULT.gauge(
 PROFILE_SAMPLES = _DEFAULT.counter(
     "pilosa_profile_samples_total",
     "Continuous-profiler sampling ticks taken")
+PEER_HEALTH = _DEFAULT.gauge(
+    "pilosa_cluster_peer_health",
+    "Blended per-peer health score in [0, 1]: EWMA of RPC outcomes"
+    " scaled by gossip liveness (fault subsystem)",
+    labels=("peer",))
+BREAKER_STATE = _DEFAULT.gauge(
+    "pilosa_fault_breaker_state",
+    "Per-peer circuit-breaker state: 0=closed, 1=half-open, 2=open",
+    labels=("peer",))
+BREAKER_TRANSITIONS = _DEFAULT.counter(
+    "pilosa_fault_breaker_transitions_total",
+    "Circuit-breaker state transitions, by peer and target state",
+    labels=("peer", "state"))
+FAILPOINT_TRIGGERS = _DEFAULT.counter(
+    "pilosa_fault_failpoint_triggers_total",
+    "Armed failpoint injections fired, by site",
+    labels=("site",))
+FAILOVER_SLICES = _DEFAULT.counter(
+    "pilosa_cluster_failover_slices_total",
+    "Slices re-mapped onto surviving replicas after a node leg"
+    " failed mid-query, by failed peer",
+    labels=("peer",))
+HEDGED_REQUESTS = _DEFAULT.counter(
+    "pilosa_cluster_hedged_requests_total",
+    "Hedged-read outcomes: fired (second leg launched), primary_won,"
+    " hedge_won",
+    labels=("outcome",))
+PARTIAL_RESULTS = _DEFAULT.counter(
+    "pilosa_query_partial_results_total",
+    "Queries answered degraded (?partial=1) with at least one"
+    " unreachable slice skipped")
 
 
 # -- legacy StatsClient bridge ------------------------------------------------

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from ..fault import failpoints as _failpoints
 from ..obs import accounting as _accounting
 from ..obs import trace as obs_trace
 from ..ops.kernels import _BITWISE
@@ -37,6 +38,17 @@ from ..sched import context as sched_context
 
 AXIS_SLICES = "slices"
 AXIS_ROWS = "rows"
+
+
+def _dispatch_gate() -> None:
+    """Every device-dispatch entry point passes here before compiling
+    or dispatching a program: the query-budget check (sched) plus the
+    ``mesh.dispatch`` failpoint (fault) — an injected FailpointError
+    is an OSError, so the executor's device-trouble handlers fall back
+    to the host path exactly as they would for a real backend fault."""
+    sched_context.check_current()
+    if _failpoints.ACTIVE is not None:
+        _failpoints.ACTIVE.hit("mesh.dispatch")
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
@@ -274,7 +286,7 @@ def densify_sharded(mesh: Mesh, lanes: np.ndarray, vals: np.ndarray,
     ``[S, (R,) subs*128]`` dense words. The cold-path replacement for
     packing dense host-side and shipping 4 bytes per word through the
     tunnel (the round-3 c5 first-query tax)."""
-    sched_context.check_current()
+    _dispatch_gate()
     dl = shard_slices(mesh, lanes)
     dv = shard_slices(mesh, vals)
     fn = _densify_sharded_fn(mesh, lanes.shape[:-2], lanes.shape[-2],
@@ -417,7 +429,7 @@ def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
     to the mesh and chunked at the hi/lo int32 bound, so any slice
     count works.
     """
-    sched_context.check_current()
+    _dispatch_gate()
     n_dev = mesh.shape[AXIS_SLICES]
     fn = count_expr_fn(mesh, expr)
     total = 0
@@ -498,7 +510,7 @@ def count_exprs_sharded(mesh: Mesh, exprs: tuple,
     (executor.go:135-142); the counts are independent, so fusing them
     is observationally identical. Same bounds as count_expr_sharded.
     """
-    sched_context.check_current()  # deadline gate before compile/dispatch
+    _dispatch_gate()
     if leaf_arrays[0].shape[0] > slice_chunk_bound(
             mesh.shape[AXIS_SLICES]):
         raise ValueError("count_exprs_sharded: slice count above the"
@@ -625,7 +637,7 @@ def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
     """TopN counts with per-slice threshold/Tanimoto pruning on device
     (see _topn_filtered_sharded_fn). Same residency contract as
     topn_exact_sharded."""
-    sched_context.check_current()
+    _dispatch_gate()
     if rows.shape[0] > slice_chunk_bound(mesh.shape[AXIS_SLICES]):
         raise ValueError("topn_filtered_sharded: slice count above the"
                          " int32 hi/lo bound")
@@ -647,7 +659,7 @@ def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
     slice axis, e.g. from the residency cache). Single program — the
     caller bounds n_slices (slice_chunk_bound) and the block bytes.
     """
-    sched_context.check_current()
+    _dispatch_gate()
     if rows.shape[0] > slice_chunk_bound(mesh.shape[AXIS_SLICES]):
         raise ValueError("topn_exact_sharded: slice count above the"
                          " int32 hi/lo bound — use topn_exact")
@@ -784,7 +796,7 @@ def materialize_expr_sharded(mesh: Mesh, expr,
     host for roaring repack. No psum → no slice-count bound; wide folds
     reduce associatively on device (_eval_expr's lax.reduce path).
     """
-    sched_context.check_current()
+    _dispatch_gate()
     fn = _materialize_fn(mesh, expr, len(leaf_arrays))
     _note_dispatch(*leaf_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="materialize",
@@ -822,7 +834,7 @@ def bsi_range_sharded(mesh: Mesh, op: str, upred, depth: int,
     depth reuse the compilation. ``op`` "><" takes ``upred = (lo,
     hi)`` in offset space; everything else a single offset predicate.
     """
-    sched_context.check_current()
+    _dispatch_gate()
     from ..ops import kernels
     if op == "><":
         lo, hi = upred
@@ -855,7 +867,7 @@ def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
     row, additive per slice, and the pruning masks are per-slice, so
     any tiling is exact.
     """
-    sched_context.check_current()
+    _dispatch_gate()
     n_dev = mesh.shape[AXIS_SLICES]
     filtered = threshold > 1 or tanimoto > 0
     if filtered:

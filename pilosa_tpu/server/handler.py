@@ -193,7 +193,7 @@ class Handler:
                  pod=None, logger=None, admission=None, registry=None,
                  warmup=None, default_timeout_s: float = 0.0,
                  tracer=None, runtime=None, profiler=None, health=None,
-                 accounting: bool = True):
+                 accounting: bool = True, fault=None):
         from ..utils import logger as logger_mod
         self.logger = logger or logger_mod.NOP
         self.holder = holder
@@ -235,6 +235,11 @@ class Handler:
         # can differ; obs_accounting.enabled() remains a second,
         # module-wide kill switch.
         self.accounting = accounting
+        # Fault-tolerance state (fault.FaultManager) behind the
+        # /status ``fault`` block; failpoint admin (/debug/failpoints)
+        # talks to the process-global registry and works on bare
+        # handlers too.
+        self.fault = fault
         self.version = __version__
         # (method, regex, handler, admission lane, raw pattern)
         self._routes: list[tuple] = []
@@ -292,6 +297,8 @@ class Handler:
         r("DELETE", "/debug/queries/{qid}", self._handle_delete_query)
         r("GET", "/debug/traces", self._handle_debug_traces)
         r("GET", "/debug/traces/{qid}", self._handle_debug_trace)
+        r("GET", "/debug/failpoints", self._handle_debug_failpoints)
+        r("POST", "/debug/failpoints", self._handle_post_failpoints)
         r("GET", "/debug/vars", self._handle_expvar)
         r("GET", "/metrics", self._handle_metrics)
         r("GET", "/debug/pprof", self._handle_pprof_index)
@@ -405,6 +412,7 @@ class Handler:
         warm = self.warmup.to_json() if self.warmup is not None else None
         runtime = (self.runtime.snapshot()
                    if self.runtime is not None else None)
+        fault = self.fault.snapshot() if self.fault is not None else None
         if self.status_handler is not None:
             cs = self.status_handler.cluster_status()  # pb.ClusterStatus
             if _PROTOBUF in req.accept:
@@ -422,6 +430,8 @@ class Handler:
                 out["warmup"] = warm
             if runtime is not None:
                 out["runtime"] = runtime
+            if fault is not None:
+                out["fault"] = fault
             return Response.json(out)
         states = self.cluster.node_states() if self.cluster else {}
         out = {"status": {"Nodes": [
@@ -430,6 +440,8 @@ class Handler:
             out["warmup"] = warm
         if runtime is not None:
             out["runtime"] = runtime
+        if fault is not None:
+            out["fault"] = fault
         return Response.json(out)
 
     def _handle_expvar(self, req: Request) -> Response:
@@ -886,6 +898,46 @@ class Handler:
         return Response.json({"enabled": self.tracer.enabled,
                               "traces": self.tracer.traces()})
 
+    # -- failpoint admin (fault subsystem; docs/FAULT_TOLERANCE.md) ----------
+
+    def _handle_debug_failpoints(self, req: Request) -> Response:
+        """The armed-failpoint schedule + the seed that replays it."""
+        from ..fault import failpoints as fp
+        return Response.json(fp.default().snapshot())
+
+    def _handle_post_failpoints(self, req: Request) -> Response:
+        """Arm/disarm failpoints at runtime. Body forms:
+        ``{"site": "rpc.send", "spec": "error(0.5)"}`` or the bulk
+        ``{"failpoints": {"rpc.send": "error", "wal.append": "off"}}``.
+        Spec "off" disarms; an unknown site or malformed spec is 400
+        with nothing armed."""
+        from ..fault import failpoints as fp
+        body = req.json()
+        updates: dict = {}
+        if "failpoints" in body:
+            if not isinstance(body["failpoints"], dict):
+                raise HTTPError(400, "failpoints is not a map")
+            updates.update(body["failpoints"])
+        if "site" in body:
+            updates[body["site"]] = body.get("spec", "off")
+        if not updates:
+            raise HTTPError(400, "no failpoints given")
+        reg = fp.default()
+        # Validate everything before arming anything: a bulk update
+        # must not half-apply.
+        for site, spec in updates.items():
+            if site not in fp.SITES:
+                raise HTTPError(400, f"unknown failpoint site: {site}")
+            try:
+                fp.parse_spec(site, str(spec))
+            except ValueError as e:
+                raise HTTPError(400, str(e))
+        for site, spec in updates.items():
+            reg.arm(site, str(spec))
+            self.logger.printf("failpoint %s: %s (seed %d)", site,
+                               spec or "off", reg.seed)
+        return Response.json(reg.snapshot())
+
     def _handle_debug_trace(self, req: Request) -> Response:
         """One trace as Chrome trace-event JSON (open in perfetto);
         ``?format=spans`` returns the raw span list instead."""
@@ -1008,13 +1060,21 @@ class Handler:
                 with ctx.stage("admission"):
                     slot = self._admit(lane, ctx)
             ctx.state = "running"
+            # Degraded reads (?partial=1, fault subsystem): slices
+            # with no reachable replica are skipped and reported in
+            # X-Pilosa-Partial instead of failing the whole query.
+            # Coordinator-only: a forwarded leg answers strictly so
+            # its coordinator decides the degradation policy.
+            exec_opt = ExecOptions(
+                remote=remote,
+                pod_local=req.query.get("podLocal") == "true",
+                ctx=ctx,
+                partial=(req.query.get("partial") == "1"
+                         and not remote),
+                missing_slices=[])
             with ctx.stage("execute"):
                 results = self.executor.execute(
-                    index_name, query, slices or None,
-                    ExecOptions(
-                        remote=remote,
-                        pod_local=req.query.get("podLocal") == "true",
-                        ctx=ctx))
+                    index_name, query, slices or None, exec_opt)
         except HTTPError as e:  # 429 from _admit
             err = e
             raise
@@ -1086,6 +1146,12 @@ class Handler:
         # /debug/queries (and DELETE a long-running follow-up); remote
         # legs piggyback spans (the encode span below is local-only).
         qid_hdr = _resp_headers()
+        if exec_opt.partial and exec_opt.missing_slices:
+            # The degraded-result contract: the client SEES which
+            # slices are missing from these results.
+            qid_hdr.append(("X-Pilosa-Partial", ",".join(
+                str(s) for s in sorted(exec_opt.missing_slices))))
+            obs_metrics.PARTIAL_RESULTS.inc()
         with ctx.stage("encode"):
             if proto_out:
                 return Response.proto(

@@ -22,6 +22,8 @@ from ..cluster.topology import (NODE_STATE_DOWN, NODE_STATE_UP, Cluster,
                                 Node)
 from ..errors import PilosaError
 from ..executor import Executor
+from ..fault import FaultManager
+from ..fault import failpoints as fault_failpoints
 from ..models.frame import FrameOptions
 from ..models.holder import Holder
 from ..models.index import IndexOptions
@@ -35,8 +37,8 @@ from ..proto import internal_pb2 as pb
 from ..sched import (AdmissionController, QueryRegistry, Warmup,
                      warmup_enabled)
 from ..utils import logger as logger_mod
-from ..utils.config import (MetricsConfig, ProfileConfig, QueryConfig,
-                            SLOConfig, TraceConfig)
+from ..utils.config import (FaultConfig, MetricsConfig, ProfileConfig,
+                            QueryConfig, SLOConfig, TraceConfig)
 from ..utils.stats import NOP, MultiStatsClient
 from .handler import Handler
 from .httpd import HTTPServer
@@ -60,7 +62,8 @@ class Server:
                  metrics_config: Optional[MetricsConfig] = None,
                  trace_config: Optional[TraceConfig] = None,
                  profile_config: Optional[ProfileConfig] = None,
-                 slo_config: Optional[SLOConfig] = None):
+                 slo_config: Optional[SLOConfig] = None,
+                 fault_config: Optional[FaultConfig] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -99,6 +102,20 @@ class Server:
         self.anti_entropy_interval = anti_entropy_interval
         self.polling_interval = polling_interval
 
+        # Fault-tolerance subsystem (fault; docs/FAULT_TOLERANCE.md):
+        # per-peer health EWMA + circuit breakers shared by the
+        # executor's placement, every pooled Client, the anti-entropy
+        # syncer, and the gossip liveness callback. Disabled =
+        # None everywhere, the pre-fault behavior.
+        self.fault_config = fault_config or FaultConfig()
+        self.fault: Optional[FaultManager] = None
+        if self.fault_config.enabled:
+            self.fault = FaultManager(
+                breaker_threshold=self.fault_config.breaker_threshold,
+                backoff_base_s=self.fault_config.breaker_backoff,
+                backoff_cap_s=self.fault_config.breaker_backoff_cap,
+                hedge_s=self.fault_config.hedge, node=host)
+
         # Query lifecycle subsystem (sched; docs/SCHEDULING.md): the
         # weighted admission queue in front of the executor, the
         # in-flight registry behind /debug/queries, and (from open())
@@ -132,8 +149,15 @@ class Server:
         with self._clients_mu:
             client = self._clients.get(host)
             if client is None:
-                client = self._clients[host] = Client(host)
+                client = self._clients[host] = Client(
+                    host, fault=self.fault)
             return client
+
+    def _client_factory(self, host: str) -> Client:
+        """client_factory seam for layers that build their own Client
+        (anti-entropy, frame restore): fault-aware like client_for,
+        but a fresh instance per call (the syncer closes its own)."""
+        return Client(host, fault=self.fault)
 
     # -- lifecycle (server.go:89-180) ----------------------------------------
 
@@ -164,10 +188,21 @@ class Server:
             self.broadcaster = pod_mod.PodBroadcaster(self.broadcaster,
                                                       self.pod)
 
+        # Failpoints (fault.failpoints): arm the [fault.failpoints] /
+        # PILOSA_FAULT_* schedule before any serving path runs, seeding
+        # first so the logged seed reproduces the whole schedule.
+        if self.fault_config.seed:
+            fault_failpoints.seed_default(self.fault_config.seed)
+        for site, spec in (self.fault_config.failpoints or {}).items():
+            fault_failpoints.arm(site, spec)
+            self.logger.printf("failpoint armed: %s = %s (seed %d)",
+                               site, spec,
+                               fault_failpoints.default().seed)
+
         client = _RoutingClient(self)
         self.executor = Executor(self.holder, host=self.host,
                                  cluster=self.cluster, client=client,
-                                 pod=self.pod)
+                                 pod=self.pod, fault=self.fault)
         # Cold-start warmup: background-compile the hot XLA programs so
         # the first real device query doesn't pay the multi-second
         # trace+compile (state surfaces at /status; PILOSA_TPU_WARMUP=0
@@ -185,13 +220,15 @@ class Server:
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
             broadcast_handler=self, status_handler=self,
-            stats=self.stats, client_factory=Client, pod=self.pod,
+            stats=self.stats, client_factory=self._client_factory,
+            pod=self.pod,
             logger=self.logger, admission=self.admission,
             registry=self.query_registry, warmup=self.warmup,
             default_timeout_s=self.query_config.default_timeout,
             tracer=self.tracer, runtime=self.runtime,
             profiler=self.profiler,
-            accounting=self.metrics_config.accounting)
+            accounting=self.metrics_config.accounting,
+            fault=self.fault)
 
         self._httpd = HTTPServer(self.handler, bind_host, port,
                                  logger=self.logger,
@@ -211,13 +248,25 @@ class Server:
             self.host = new_host
             self.executor.host = new_host
             self.handler.host = new_host
+            if self.fault is not None:
+                # The self-identity every fault consult skips.
+                self.fault.node = new_host
+                self.fault.health.node = new_host
+                self.fault.breakers.node = new_host
 
         # Receiver first, then membership open — the gossip join's
         # push/pull needs the status handler attached (server.go:118,123).
         if self.broadcast_receiver is not None:
             self.broadcast_receiver.start(self)
         if self.cluster.node_set is not None:
-            self.cluster.node_set.open()
+            ns = self.cluster.node_set
+            if self.fault is not None and hasattr(ns,
+                                                  "on_state_change"):
+                # Gossip liveness feeds the fault layer: a dead rumor
+                # opens the peer's breaker before any query pays a
+                # timeout; an alive refutation re-arms the probe.
+                ns.on_state_change = self._on_peer_state
+            ns.open()
 
         self.logger.printf("listening as http://%s", self.host)
         if self.runtime is not None:
@@ -230,6 +279,8 @@ class Server:
             self._spawn(self._monitor_max_slices, "max-slices")
         if self.anti_entropy_interval > 0:
             self._spawn(self._monitor_anti_entropy, "anti-entropy")
+        if self.fault is not None:
+            self._spawn(self._monitor_breaker_probes, "fault-probe")
 
     def close(self) -> None:
         self.logger.printf("server closing: %s", self.host)
@@ -391,6 +442,12 @@ class Server:
         bytes, so error entries carry their own status marker."""
         return (status, body)
 
+    def _on_peer_state(self, host: str, state: str) -> None:
+        """Gossip membership callback → fault layer (cluster.gossip
+        states map 1:1 onto health's liveness vocabulary)."""
+        if self.fault is not None:
+            self.fault.note_gossip(host, state)
+
     # -- slice announcements (view.go:236-246) -------------------------------
 
     def _on_create_slice(self, index: str, slice: int,
@@ -434,6 +491,39 @@ class Server:
                 if idx is not None:
                     idx.set_remote_max_inverse_slice(value)
 
+    _BREAKER_PROBE_INTERVAL = 1.0
+
+    def _monitor_breaker_probes(self) -> None:
+        """Active half-open probing: a peer behind an open circuit gets
+        NO traffic (that is the point), so recovery cannot rely on
+        query placement happening to route it a request — in many
+        topologies it never would. This loop sends each probe-ready
+        peer one cheap /version request; the fault-aware client takes
+        the half-open probe slot, and the outcome closes or re-opens
+        the breaker through the ordinary feed."""
+        self._loop(self._BREAKER_PROBE_INTERVAL,
+                   self.probe_open_breakers, "breaker probe")
+
+    def probe_open_breakers(self) -> None:
+        from ..errors import QueryDeadlineError
+        for host in (self.fault.probe_targets()
+                     if self.fault is not None else ()):
+            try:
+                # deadline_s clamps the probe's socket timeout: a
+                # blackholed peer must not pin this loop for the
+                # client's full 30 s default.
+                self.client_for(host)._do("GET", "/version",
+                                          deadline_s=2.0)
+            except QueryDeadlineError:
+                # The client deliberately does NOT feed budget-clamped
+                # timeouts to the breaker (tight query deadlines must
+                # not condemn healthy peers) — but the probe's 2 s IS
+                # the probe's verdict: a peer that can't answer
+                # /version in 2 s stays open.
+                self.fault.record_rpc(host, False)
+            except Exception:  # noqa: BLE001 - outcome fed the breaker
+                pass
+
     def _monitor_anti_entropy(self) -> None:
         from .syncer import HolderSyncer
 
@@ -443,6 +533,8 @@ class Server:
             with self.logger.track("holder sync"):
                 HolderSyncer(self.holder, self.host, self.cluster,
                              closing=self._closing,
+                             client_factory=self._client_factory,
+                             fault=self.fault,
                              logger=self.logger).sync_holder()
 
         self._loop(self.anti_entropy_interval, run, "anti-entropy")

@@ -15,7 +15,7 @@ import threading
 from typing import Optional
 
 from .. import SLICE_WIDTH
-from ..cluster.client import Client
+from ..cluster.client import Client, ClientError
 from ..errors import FragmentNotFoundError, FrameNotFoundError
 from ..models.view import VIEW_STANDARD
 from ..storage.fragment import PairSet
@@ -25,19 +25,32 @@ from ..utils import logger as logger_mod
 class HolderSyncer:
     def __init__(self, holder, host: str, cluster,
                  closing: Optional[threading.Event] = None,
-                 client_factory=Client, logger=logger_mod.NOP):
+                 client_factory=Client, logger=logger_mod.NOP,
+                 fault=None):
         self.holder = holder
         self.host = host
         self.cluster = cluster
         self.closing = closing or threading.Event()
         self.client_factory = client_factory
         self.logger = logger
+        # fault.FaultManager: peers whose circuit breaker is open are
+        # skipped for the whole pass (they get repaired when they
+        # return) instead of blocking anti-entropy on dead-peer
+        # timeouts — the 60-minute soak's sweep must survive a down
+        # replica.
+        self.fault = fault
 
     def is_closing(self) -> bool:
         return self.closing.is_set()
 
     def _peers(self):
-        return [n for n in self.cluster.nodes if n.host != self.host]
+        # would_allow, not allow: this is a pure filter — consuming
+        # the half-open probe slot here would starve the client's own
+        # gate of it when the sync RPC actually goes out.
+        return [n for n in self.cluster.nodes
+                if n.host != self.host
+                and (self.fault is None
+                     or self.fault.would_allow(n.host))]
 
     # -- whole-holder walk (holder.go:385-436) -------------------------------
 
@@ -81,6 +94,15 @@ class HolderSyncer:
                 m = fetch_diff(client, blocks)
             except (FrameNotFoundError, FragmentNotFoundError):
                 continue  # not created remotely yet
+            except ClientError as e:
+                # A dead/unreachable peer must not abort the whole
+                # sweep — the remaining peers still get their repair.
+                # The failed RPC already fed the breaker (when the
+                # client is fault-aware), so the NEXT store skips the
+                # peer without paying this timeout again.
+                self.logger.printf("sync: skipping peer %s: %s",
+                                   node.host, e)
+                continue
             if not m:
                 continue
             store.set_bulk_attrs(m)
@@ -112,36 +134,58 @@ class HolderSyncer:
         v = f.create_view_if_not_exists(view)
         frag = v.create_fragment_if_not_exists(slice)
         FragmentSyncer(frag, self.host, self.cluster, self.closing,
-                       self.client_factory,
-                       logger=self.logger).sync_fragment()
+                       self.client_factory, logger=self.logger,
+                       fault=self.fault).sync_fragment()
 
 
 class FragmentSyncer:
     def __init__(self, fragment, host: str, cluster,
                  closing: Optional[threading.Event] = None,
-                 client_factory=Client, logger=logger_mod.NOP):
+                 client_factory=Client, logger=logger_mod.NOP,
+                 fault=None):
         self.fragment = fragment
         self.host = host
         self.cluster = cluster
         self.closing = closing or threading.Event()
         self.client_factory = client_factory
         self.logger = logger
+        self.fault = fault
 
     def is_closing(self) -> bool:
         return self.closing.is_set()
+
+    def _replica_peers(self, nodes):
+        """The replica owners this pass will actually talk to: open
+        circuits are skipped — a dead replica is repaired by the sweep
+        AFTER it returns; blocking this sweep on its timeouts starves
+        every healthy fragment behind it in the schema walk."""
+        out = []
+        for node in nodes:
+            if node.host != self.host and self.fault is not None \
+                    and not self.fault.would_allow(node.host):
+                self.logger.printf(
+                    "sync: skipping open-circuit peer %s for"
+                    " %s/%s/%d", node.host, self.fragment.index,
+                    self.fragment.frame, self.fragment.slice)
+                continue
+            out.append(node)
+        return out
 
     def sync_fragment(self) -> None:
         """Compare per-block checksums across the replica set; merge any
         differing block (fragment.go:1322-1399)."""
         f = self.fragment
-        nodes = self.cluster.fragment_nodes(f.index, f.slice)
+        nodes = self._replica_peers(
+            self.cluster.fragment_nodes(f.index, f.slice))
         if len(nodes) <= 1:
             return
 
         block_sets: list[list[tuple[int, bytes]]] = []
+        sync_nodes: list = []
         for node in nodes:
             if node.host == self.host:
                 block_sets.append(f.blocks())
+                sync_nodes.append(node)
                 continue
             client = self.client_factory(node.host)
             try:
@@ -149,9 +193,20 @@ class FragmentSyncer:
                                                 f.slice, host=node.host)
             except FragmentNotFoundError:
                 blocks = []
+            except ClientError as e:
+                # Unreachable mid-pass: drop the peer from THIS
+                # fragment's consensus (its RPC failure fed the
+                # breaker; later fragments skip it up front).
+                self.logger.printf("sync: skipping peer %s: %s",
+                                   node.host, e)
+                continue
             block_sets.append(blocks)
+            sync_nodes.append(node)
             if self.is_closing():
                 return
+        if len(sync_nodes) <= 1:
+            return
+        self._sync_nodes = sync_nodes
 
         # Zip the sorted block lists; sync any id whose checksums differ
         # or that is missing somewhere.
@@ -178,19 +233,28 @@ class FragmentSyncer:
         """Pull the block from every peer, merge by majority consensus,
         push per-peer diffs back as PQL (fragment.go:1403-1481)."""
         f = self.fragment
+        nodes = getattr(self, "_sync_nodes", None)
+        if nodes is None:
+            nodes = self._replica_peers(
+                self.cluster.fragment_nodes(f.index, f.slice))
         pair_sets: list[PairSet] = []
         clients: list = []
-        for node in self.cluster.fragment_nodes(f.index, f.slice):
+        for node in nodes:
             if node.host == self.host:
                 continue
             if self.is_closing():
                 return
             client = self.client_factory(node.host)
-            clients.append(client)
             # Only the standard view blocks are consensus-merged.
-            rows, cols = client.block_data(f.index, f.frame, VIEW_STANDARD,
-                                           f.slice, block_id,
-                                           host=node.host)
+            try:
+                rows, cols = client.block_data(f.index, f.frame,
+                                               VIEW_STANDARD, f.slice,
+                                               block_id, host=node.host)
+            except ClientError as e:
+                self.logger.printf("sync: skipping peer %s: %s",
+                                   node.host, e)
+                continue
+            clients.append(client)
             pair_sets.append(PairSet(rows, cols))
 
         if self.is_closing():
@@ -215,5 +279,12 @@ class FragmentSyncer:
                              f' columnID={base + int(c)})')
             if self.is_closing():
                 return
-            client.execute_query(None, f.index, "\n".join(lines),
-                                 remote=False)
+            try:
+                client.execute_query(None, f.index, "\n".join(lines),
+                                     remote=False)
+            except ClientError as e:
+                # The peer died between pull and push-back: its repair
+                # waits for the next sweep; local + other peers' merges
+                # already landed.
+                self.logger.printf("sync: push-back to %s failed: %s",
+                                   client.host, e)

@@ -41,6 +41,7 @@ import numpy as np
 
 from .. import SLICE_WIDTH
 from ..errors import PilosaError
+from ..fault import failpoints as _fp
 from ..obs import accounting as _accounting
 from ..obs import metrics as obs_metrics
 from ..parallel.residency import DeviceRowCache
@@ -502,6 +503,8 @@ class Fragment:
                 t0 = time.perf_counter()
                 tmp = self.path + ".snapshotting"
                 with open(tmp, "wb") as f:
+                    if _fp.ACTIVE is not None:
+                        _fp.ACTIVE.hit("snapshot.write", writer=f)
                     self.storage.write_to(f)
                     f.flush()
                     os.fsync(f.fileno())
@@ -597,6 +600,13 @@ class Fragment:
                 tmp = self.path + ".snapshotting"
                 try:
                     with open(tmp, "wb") as f:
+                        # Crash-mid-snapshot injection: a fault here
+                        # leaves a partial tmp file that is never
+                        # swapped in — the old snapshot+WAL stays the
+                        # file of record and the next MAX_OP_N trigger
+                        # retries (the OSError handler below).
+                        if _fp.ACTIVE is not None:
+                            _fp.ACTIVE.hit("snapshot.write", writer=f)
                         # The expensive serialize + fsync of the frozen
                         # body runs with NO fragment lock held; writers
                         # keep appending to the old file's WAL.

@@ -33,8 +33,20 @@ from typing import Callable, Iterator as TIterator, Optional
 import numpy as np
 
 from . import native
+from ..fault import failpoints as _fp
 from ..obs import accounting as _accounting
 from ..utils.arrays import searchsorted_membership, sort_dedupe
+
+
+def _wal_write(writer, blob: bytes) -> None:
+    """Every op-log append funnels through here so the ``wal.append``
+    failpoint can inject errors and TORN writes (a prefix of the
+    record hits the file, then the write "crashes") exactly where a
+    real crash would tear the log. Disarmed cost: one module-attr
+    read."""
+    if _fp.ACTIVE is not None:
+        _fp.ACTIVE.hit("wal.append", writer=writer, data=blob)
+    writer.write(blob)
 
 # --- constants (match reference wire format) ---------------------------------
 
@@ -601,7 +613,7 @@ class Bitmap:
 
     def _write_op(self, op: Op) -> None:
         if self.op_writer is not None:
-            self.op_writer.write(op.marshal())
+            _wal_write(self.op_writer, op.marshal())
             self.op_n += 1
 
     # -- bulk ops
@@ -1069,8 +1081,8 @@ class Bitmap:
             table.types[gi] = (out_kind != 0).astype(np.uint8)
             table.ptrs[gi] = ptrs
         if wal and n_changed and self.op_writer is not None:
-            self.op_writer.write(
-                wal_buf[:n_changed * OP_SIZE].tobytes())
+            _wal_write(self.op_writer,
+                       wal_buf[:n_changed * OP_SIZE].tobytes())
         return changed[:n_changed]
 
     def _apply_groups_python(self, conts, group_keys, chunk_vals,
@@ -1138,8 +1150,8 @@ class Bitmap:
             return _EMPTY_U64
         changed = np.concatenate(changed_parts)
         if wal and self.op_writer is not None:
-            self.op_writer.write(
-                _wal_blob(changed, OP_ADD if set else OP_REMOVE))
+            _wal_write(self.op_writer,
+                       _wal_blob(changed, OP_ADD if set else OP_REMOVE))
         return changed
 
     def values(self) -> np.ndarray:

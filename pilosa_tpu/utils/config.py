@@ -117,6 +117,28 @@ class SLOConfig:
 
 
 @dataclass
+class FaultConfig:
+    """[fault] section (fault subsystem; docs/FAULT_TOLERANCE.md):
+    ``enabled`` gates peer health tracking + circuit breakers;
+    ``breaker_threshold`` consecutive transport failures trip a peer's
+    breaker open; the open window backs off exponentially from
+    ``breaker_backoff`` up to ``breaker_backoff_cap`` with full
+    jitter; ``hedge`` (seconds, 0 = off) arms hedged reads — a second
+    replica leg fires when the first exceeds max(hedge, the peer's
+    p95-ish latency estimate). ``failpoints`` maps injection sites to
+    spec strings ([fault.failpoints] in TOML, PILOSA_FAULT_<SITE> in
+    the environment); ``seed`` (PILOSA_FAULT_SEED) makes probabilistic
+    failpoint schedules replay deterministically."""
+    enabled: bool = True
+    breaker_threshold: int = 3
+    breaker_backoff: float = 0.5
+    breaker_backoff_cap: float = 30.0
+    hedge: float = 0.0
+    failpoints: dict = field(default_factory=dict)
+    seed: int = 0
+
+
+@dataclass
 class TraceConfig:
     """[trace] section (obs subsystem): ``enabled`` turns on
     distributed tracing for EVERY query (off by default — the nop
@@ -143,6 +165,7 @@ class Config:
     trace: TraceConfig = field(default_factory=TraceConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
+    fault: FaultConfig = field(default_factory=FaultConfig)
     anti_entropy_interval: float = DEFAULT_ANTI_ENTROPY_INTERVAL
     log_path: str = ""
     # Accepted and persisted but inert, exactly like the reference at
@@ -153,6 +176,11 @@ class Config:
     def to_toml(self) -> str:
         hosts = ", ".join(f'"{h}"' for h in self.cluster.hosts)
         internal = ", ".join(f'"{h}"' for h in self.cluster.internal_hosts)
+        failpoints = "".join(
+            f'"{site}" = "{spec}"\n'
+            for site, spec in sorted(self.fault.failpoints.items()))
+        if failpoints:
+            failpoints = "\n[fault.failpoints]\n" + failpoints
 
         def dur(v: float) -> str:
             # Sub-second values must survive the round trip ("0.5s"
@@ -198,6 +226,14 @@ ring = {self.profile.ring}
 objective = "{dur(self.slo.objective)}"
 target = {self.slo.target}
 
+[fault]
+enabled = {str(self.fault.enabled).lower()}
+breaker-threshold = {self.fault.breaker_threshold}
+breaker-backoff = "{dur(self.fault.breaker_backoff)}"
+breaker-backoff-cap = "{dur(self.fault.breaker_backoff_cap)}"
+hedge = "{dur(self.fault.hedge)}"
+seed = {self.fault.seed}
+{failpoints}
 [plugins]
 path = "{self.plugins_path}"
 
@@ -276,6 +312,23 @@ def load(path: str = "", env: dict | None = None) -> Config:
             cfg.slo.objective = parse_duration(s["objective"])
         if "target" in s:
             cfg.slo.target = float(s["target"])
+        fl = data.get("fault", {})
+        if "enabled" in fl:
+            cfg.fault.enabled = _parse_bool(fl["enabled"])
+        if "breaker-threshold" in fl:
+            cfg.fault.breaker_threshold = int(fl["breaker-threshold"])
+        if "breaker-backoff" in fl:
+            cfg.fault.breaker_backoff = parse_duration(
+                fl["breaker-backoff"])
+        if "breaker-backoff-cap" in fl:
+            cfg.fault.breaker_backoff_cap = parse_duration(
+                fl["breaker-backoff-cap"])
+        if "hedge" in fl:
+            cfg.fault.hedge = parse_duration(fl["hedge"])
+        if "seed" in fl:
+            cfg.fault.seed = int(fl["seed"])
+        for site, spec in (fl.get("failpoints") or {}).items():
+            cfg.fault.failpoints[str(site)] = str(spec)
         cfg.plugins_path = data.get("plugins", {}).get(
             "path", cfg.plugins_path)
     env = os.environ if env is None else env
@@ -346,4 +399,29 @@ def load(path: str = "", env: dict | None = None) -> Config:
         cfg.trace.max_spans = int(env["PILOSA_TRACE_MAX_SPANS"])
     if env.get("PILOSA_PLUGINS_PATH"):
         cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
+    if env.get("PILOSA_FAULT_ENABLED"):
+        cfg.fault.enabled = _parse_bool(env["PILOSA_FAULT_ENABLED"])
+    if env.get("PILOSA_FAULT_BREAKER_THRESHOLD"):
+        cfg.fault.breaker_threshold = int(
+            env["PILOSA_FAULT_BREAKER_THRESHOLD"])
+    if env.get("PILOSA_FAULT_BREAKER_BACKOFF"):
+        cfg.fault.breaker_backoff = parse_duration(
+            env["PILOSA_FAULT_BREAKER_BACKOFF"])
+    if env.get("PILOSA_FAULT_BREAKER_BACKOFF_CAP"):
+        cfg.fault.breaker_backoff_cap = parse_duration(
+            env["PILOSA_FAULT_BREAKER_BACKOFF_CAP"])
+    if env.get("PILOSA_FAULT_HEDGE"):
+        cfg.fault.hedge = parse_duration(env["PILOSA_FAULT_HEDGE"])
+    if env.get("PILOSA_FAULT_SEED"):
+        cfg.fault.seed = int(env["PILOSA_FAULT_SEED"])
+    # Failpoint arming: PILOSA_FAULT_<SITE> via the canonical site
+    # list + env-key mapping owned by fault.failpoints, so a newly
+    # added site cannot silently drift out of env arming and the
+    # reserved knobs above never collide (runtime import: failpoints
+    # imports parse_duration from here).
+    from ..fault.failpoints import SITES as _fp_sites
+    from ..fault.failpoints import env_key as _fp_env_key
+    for site in _fp_sites:
+        if env.get(_fp_env_key(site)):
+            cfg.fault.failpoints[site] = env[_fp_env_key(site)]
     return cfg

@@ -29,3 +29,16 @@ os.environ.setdefault("PILOSA_TPU_WARMUP", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # Marker registry (no pytest.ini in this repo): `slow` is what the
+    # tier-1 gate excludes (`-m 'not slow'`); `chaos` tags the
+    # failpoint/fault-injection tests — the fast ones run in tier-1,
+    # the multi-process SIGKILL cluster legs are additionally `slow`.
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 gate (multi-process"
+                   " cluster legs, soaks)")
+    config.addinivalue_line(
+        "markers", "chaos: failpoint-driven fault-injection tests;"
+                   " schedules replay from PILOSA_FAULT_SEED")

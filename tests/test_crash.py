@@ -19,6 +19,8 @@ import sys
 import time
 import urllib.request
 
+import pytest
+
 from podenv import cpu_env, free_port, wait_up
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
@@ -107,6 +109,93 @@ def test_sigkill_mid_write_storm_recovers(tmp_path):
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait()
+
+
+@pytest.mark.chaos
+def test_wal_append_torn_at_every_offset_recovers(tmp_path):
+    """Failpoint-driven DETERMINISTIC crash-mid-wal.append: tear the
+    op record at EVERY truncation offset (the ``torn(k)`` mode writes
+    k bytes then fails, exactly where a crash would cut the log) and
+    prove the reopen replays to precisely the acked prefix — the
+    SIGKILL storm above finds a random single offset; this sweeps all
+    of them."""
+    from pilosa_tpu.fault import failpoints
+    from pilosa_tpu.fault.failpoints import FailpointError
+    from pilosa_tpu.storage.fragment import Fragment
+    from pilosa_tpu.storage.roaring import OP_SIZE
+
+    try:
+        for k in range(OP_SIZE):  # every truncation offset of one op
+            path = str(tmp_path / f"frag{k}")
+            f = Fragment(path, "i", "f", "standard", 0)
+            f.open()
+            acked = []
+            for col in range(8):  # acked prefix, fully WAL'd
+                f.set_bit(1, col)
+                acked.append(col)
+            with failpoints.injected("wal.append", f"torn({k})"):
+                with pytest.raises(FailpointError):
+                    f.set_bit(1, 99)  # the crashed (unacked) op
+            # Simulate the crash: abandon the live object without its
+            # orderly close (which would flush/repair), release the
+            # dead process's flock, reopen from disk. The torn tail
+            # must trim to the acked set.
+            import fcntl
+            fcntl.flock(f._file.fileno(), fcntl.LOCK_UN)
+            f2 = Fragment(path, "i", "f", "standard", 0)
+            f2.open()
+            try:
+                got = sorted(f2.row(1).bits())
+                assert got == acked, (
+                    f"torn at {k}: {got} != acked {acked}")
+                assert f2.set_bit(1, 99), \
+                    f"torn at {k}: fragment must accept writes again"
+            finally:
+                f2.close()
+    finally:
+        failpoints.disarm_all()
+
+
+@pytest.mark.chaos
+def test_crash_mid_snapshot_write_recovers(tmp_path):
+    """Failpoint-driven crash-mid-``snapshot.write``: the async
+    MAX_OP_N-triggered snapshot dies mid-serialization, the old
+    snapshot+WAL stays the file of record, writes keep flowing, the
+    retry lands, and a reopen sees every acked bit."""
+    import pilosa_tpu.storage.fragment as fragmod
+    from pilosa_tpu.fault import failpoints
+    from pilosa_tpu.storage.fragment import Fragment
+
+    old_maxop = fragmod.MAX_OP_N
+    fragmod.MAX_OP_N = 20  # force snapshot storms
+    path = str(tmp_path / "frag")
+    try:
+        f = Fragment(path, "i", "f", "standard", 0)
+        f.open()
+        acked = []
+        with failpoints.injected("snapshot.write", "error"):
+            for col in range(100):  # many ops → several failed
+                f.set_bit(2, col)   # background snapshot attempts
+                acked.append(col)
+            f._join_snapshot()
+        # Disarmed: more writes re-trigger the snapshot, which now
+        # lands cleanly.
+        for col in range(100, 140):
+            f.set_bit(2, col)
+            acked.append(col)
+        f._join_snapshot()
+        assert sorted(f.row(2).bits()) == acked
+        f.close()
+        f2 = Fragment(path, "i", "f", "standard", 0)
+        f2.open()
+        try:
+            assert sorted(f2.row(2).bits()) == acked, \
+                "every acked bit must survive the failed snapshots"
+        finally:
+            f2.close()
+    finally:
+        fragmod.MAX_OP_N = old_maxop
+        failpoints.disarm_all()
 
 
 def test_single_fragment_storm_exact_model(tmp_path):

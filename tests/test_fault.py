@@ -1,0 +1,1024 @@
+"""Fault subsystem: failpoints, breakers, health, failover, hedging.
+
+Tier-1 chaos tests (the ``chaos`` marker, FAST — the multi-process
+SIGKILL legs live in test_fault_cluster.py under ``slow``): every
+failpoint site is exercised at least once, the disarmed path is proven
+free (the ctx.trace-style nop guard), the breaker state machine is
+driven through closed→open→half-open→closed with a fake clock, and
+the executor-level failover/partial/hedging contracts run against
+scripted fake clients exactly like test_executor's distributed legs.
+"""
+
+import http.client
+import io
+import json
+import random
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.cluster.client import (CircuitOpenError, Client,
+                                       ClientError)
+from pilosa_tpu.cluster.topology import new_cluster
+from pilosa_tpu.errors import SliceUnavailableError
+from pilosa_tpu.executor import ExecOptions, Executor
+from pilosa_tpu.fault import FaultManager, breaker as breaker_mod
+from pilosa_tpu.fault import failpoints
+from pilosa_tpu.fault.breaker import (STATE_CLOSED, STATE_HALF_OPEN,
+                                      STATE_OPEN, BreakerBoard)
+from pilosa_tpu.fault.failpoints import FailpointError, Failpoints
+from pilosa_tpu.fault.health import PeerHealth
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.obs import metrics as obs_metrics
+from pilosa_tpu.server.handler import Handler
+from pilosa_tpu.server.syncer import FragmentSyncer, HolderSyncer
+from pilosa_tpu.storage.fragment import Fragment
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    """Failpoints are process-global by design; no test may leak an
+    armed schedule into the rest of the suite."""
+    yield
+    failpoints.disarm_all()
+    failpoints.ACTIVE = None
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def must_set(holder, index, frame, row, col):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_frame_if_not_exists(frame)
+    f.set_bit("standard", row, col)
+
+
+# -- failpoint spec parsing + determinism -------------------------------------
+
+
+class TestFailpointSpecs:
+    def test_modes_parse(self):
+        for spec in ("error", "error(0.5)", "delay(50ms)",
+                     "delay(1ms,0.5)", "torn(7)", "partition(hostB)",
+                     "error*3", "torn(7,0.5)*2"):
+            fp = failpoints.parse_spec("rpc.send", spec)
+            assert fp is not None and fp.spec == spec
+
+    def test_off_and_empty_disarm(self):
+        assert failpoints.parse_spec("rpc.send", "off") is None
+        assert failpoints.parse_spec("rpc.send", "") is None
+
+    def test_malformed_specs_raise(self):
+        for spec in ("boom", "error(2.0)", "delay()", "torn()",
+                     "partition()", "error(0.5)(0.5)", "delay(xyz)"):
+            with pytest.raises(ValueError):
+                failpoints.parse_spec("rpc.send", spec)
+
+    def test_unknown_site_rejected(self):
+        reg = Failpoints(seed=1)
+        with pytest.raises(ValueError, match="unknown failpoint site"):
+            reg.arm("no.such.site", "error")
+
+    def test_count_auto_disarms(self):
+        reg = Failpoints(seed=1)
+        reg.arm("rpc.send", "error*2")
+        for _ in range(2):
+            with pytest.raises(FailpointError):
+                reg.hit("rpc.send")
+        reg.hit("rpc.send")  # third hit: disarmed, no raise
+        assert reg.snapshot()["armed"] == {}
+
+    def test_probability_replays_from_seed(self):
+        def schedule(seed):
+            reg = Failpoints(seed=seed)
+            reg.arm("rpc.send", "error(0.5)")
+            out = []
+            for _ in range(64):
+                try:
+                    reg.hit("rpc.send")
+                    out.append(0)
+                except FailpointError:
+                    out.append(1)
+            reg.disarm_all()
+            return out
+
+        a, b = schedule(42), schedule(42)
+        assert a == b, "same seed must replay the same schedule"
+        assert 0 < sum(a) < 64, "p=0.5 over 64 draws hit both outcomes"
+        assert schedule(43) != a, "a different seed reshuffles"
+
+    def test_partition_scopes_by_host(self):
+        reg = Failpoints(seed=1)
+        reg.arm("rpc.send", "partition(hostB)")
+        reg.hit("rpc.send", host="hostA:10101")  # no match, no raise
+        with pytest.raises(FailpointError):
+            reg.hit("rpc.send", host="hostB:10101")
+
+    def test_delay_sleeps(self):
+        reg = Failpoints(seed=1)
+        reg.arm("rpc.send", "delay(30ms)")
+        t0 = time.perf_counter()
+        reg.hit("rpc.send")
+        assert time.perf_counter() - t0 >= 0.025
+
+    def test_torn_writes_prefix_then_fails(self):
+        reg = Failpoints(seed=1)
+        reg.arm("wal.append", "torn(3)")
+        buf = io.BytesIO()
+        with pytest.raises(FailpointError):
+            reg.hit("wal.append", writer=buf, data=b"abcdef")
+        assert buf.getvalue() == b"abc"
+
+    def test_arm_from_env(self):
+        reg_sites = failpoints.arm_from_env(
+            {"PILOSA_FAULT_GOSSIP_DELIVER": "error",
+             "PILOSA_FAULT_SEED": "7",        # reserved: not a site
+             "PILOSA_FAULT_UNRELATED": "x"})  # unknown: ignored
+        assert reg_sites == ["gossip.deliver"]
+        assert "gossip.deliver" in \
+            failpoints.default().snapshot()["armed"]
+        failpoints.disarm_all()
+
+    def test_private_registry_never_touches_global_active(self):
+        """Only the DEFAULT registry publishes to the process-global
+        ACTIVE hook: a test-local registry must neither hijack the
+        production injection sites nor clear the default's schedule."""
+        failpoints.ACTIVE = None
+        reg = Failpoints(seed=1)
+        reg.arm("rpc.send", "error")
+        assert failpoints.ACTIVE is None, \
+            "a private registry must not arm the global sites"
+        failpoints.arm("rpc.recv", "error")
+        assert failpoints.ACTIVE is failpoints.default()
+        reg.disarm_all()
+        assert failpoints.ACTIVE is failpoints.default(), \
+            "a private disarm must not clear the default's schedule"
+        failpoints.disarm_all()
+        assert failpoints.ACTIVE is None
+
+    def test_trigger_counter(self):
+        before = obs_metrics.FAILPOINT_TRIGGERS.labels(
+            "mesh.dispatch").value
+        reg = Failpoints(seed=1)
+        reg.arm("mesh.dispatch", "error*1")
+        with pytest.raises(FailpointError):
+            reg.hit("mesh.dispatch")
+        after = obs_metrics.FAILPOINT_TRIGGERS.labels(
+            "mesh.dispatch").value
+        assert after == before + 1
+
+
+# -- every injection site, through its real call path -------------------------
+
+
+class _FakeResp:
+    status = 200
+    will_close = False
+
+    def read(self):
+        return b"{}"
+
+    def getheaders(self):
+        return []
+
+    def close(self):
+        pass
+
+
+class _GoodConn:
+    """Minimal http.client.HTTPConnection stand-in."""
+
+    def __init__(self, host, timeout=None):
+        self.host = host
+        self.timeout = timeout
+        self.sock = None
+        self.closed = False
+
+    def request(self, method, path, body=None, headers=None):
+        pass
+
+    def getresponse(self):
+        return _FakeResp()
+
+    def close(self):
+        self.closed = True
+
+
+class TestFailpointSites:
+    def test_rpc_send_injects_transport_error(self, monkeypatch):
+        monkeypatch.setattr(http.client, "HTTPConnection", _GoodConn)
+        c = Client("peer:1")
+        with failpoints.injected("rpc.send", "error"):
+            with pytest.raises(ClientError, match="failpoint rpc.send"):
+                c._do("GET", "/schema")
+        status, _ = c._do("GET", "/schema")  # disarmed: flows again
+        assert status == 200
+
+    def test_rpc_send_single_shot_is_retried(self, monkeypatch):
+        # error*1: the first attempt fails, the transparent retry on a
+        # fresh connection succeeds — the injection exercises exactly
+        # the stale-keep-alive recovery path.
+        monkeypatch.setattr(http.client, "HTTPConnection", _GoodConn)
+        c = Client("peer:1")
+        c._conn_put("peer:1", _GoodConn("peer:1"))  # pooled socket
+        with failpoints.injected("rpc.send", "error*1"):
+            status, _ = c._do("GET", "/schema")
+        assert status == 200
+
+    def test_rpc_recv_injects_response_loss(self, monkeypatch):
+        monkeypatch.setattr(http.client, "HTTPConnection", _GoodConn)
+        c = Client("peer:1")
+        with failpoints.injected("rpc.recv", "error"):
+            with pytest.raises(ClientError, match="failpoint rpc.recv"):
+                c._do("GET", "/schema")
+
+    def test_rpc_partition_mode_scopes_to_one_peer(self, monkeypatch):
+        monkeypatch.setattr(http.client, "HTTPConnection", _GoodConn)
+        c = Client("peerA:1")
+        with failpoints.injected("rpc.send", "partition(peerB)"):
+            status, _ = c._do("GET", "/schema")          # A unaffected
+            assert status == 200
+            with pytest.raises(ClientError):
+                c._do("GET", "/schema", host="peerB:1")  # B partitioned
+
+    def test_wal_append_error(self, tmp_path):
+        f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f.open()
+        try:
+            f.set_bit(1, 5)
+            with failpoints.injected("wal.append", "error"):
+                with pytest.raises(FailpointError):
+                    f.set_bit(1, 6)
+            assert f.set_bit(1, 7)  # disarmed: writes flow again
+        finally:
+            f.close()
+
+    def test_snapshot_write_error_keeps_old_file_of_record(self,
+                                                           tmp_path):
+        f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f.open()
+        try:
+            f.set_bit(1, 5)
+            with failpoints.injected("snapshot.write", "error*1"):
+                with pytest.raises(FailpointError):
+                    f.snapshot()
+            # The failed snapshot never swapped: WAL intact, a retry
+            # succeeds, and the data survives a reopen.
+            f.snapshot()
+        finally:
+            f.close()
+        f2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f2.open()
+        try:
+            assert list(f2.row(1).bits()) == [5]
+        finally:
+            f2.close()
+
+    def test_gossip_deliver_drop_and_restore(self):
+        from pilosa_tpu.cluster.broadcast import (CancelQueryMessage,
+                                                  marshal_message)
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+        got = []
+        gs = GossipNodeSet("n1")
+        gs.start(SimpleNamespace(receive_message=got.append))
+        data = marshal_message(CancelQueryMessage("q1"))
+        with failpoints.injected("gossip.deliver", "error"):
+            gs._handle_envelope(data)
+        assert got == [], "armed drop must swallow the envelope"
+        gs._handle_envelope(data)
+        assert len(got) == 1 and got[0].id == "q1"
+
+    def test_mesh_dispatch_gate(self):
+        from pilosa_tpu.parallel import mesh
+        with failpoints.injected("mesh.dispatch", "error"):
+            with pytest.raises(FailpointError):
+                mesh._dispatch_gate()
+        mesh._dispatch_gate()  # disarmed: no-op
+
+
+class TestDisarmedOverheadGuard:
+    def test_disarmed_sites_never_enter_the_registry(self, tmp_path,
+                                                     monkeypatch):
+        """The nop-path contract (same pattern as the PR 3 trace
+        guard): with nothing armed, NO injection site may call into
+        the registry at all — the cost is the ACTIVE None-check."""
+        failpoints.disarm_all()
+        failpoints.ACTIVE = None
+        calls = []
+        monkeypatch.setattr(
+            Failpoints, "hit",
+            lambda self, *a, **kw: calls.append((a, kw)))
+        # wal.append site: a write storm through the batch engine.
+        f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f.open()
+        try:
+            for i in range(100):
+                f.set_bit(i % 4, i)
+        finally:
+            f.close()
+        # rpc.send / rpc.recv sites.
+        monkeypatch.setattr(http.client, "HTTPConnection", _GoodConn)
+        c = Client("peer:1")
+        c._do("GET", "/schema")
+        # gossip.deliver site.
+        from pilosa_tpu.cluster.broadcast import (CancelQueryMessage,
+                                                  marshal_message)
+        from pilosa_tpu.cluster.gossip import GossipNodeSet
+        gs = GossipNodeSet("n1")
+        gs.start(SimpleNamespace(receive_message=lambda m: None))
+        gs._handle_envelope(marshal_message(CancelQueryMessage("q")))
+        # mesh.dispatch site.
+        from pilosa_tpu.parallel import mesh
+        mesh._dispatch_gate()
+        assert calls == [], (
+            "disarmed failpoints must be zero-cost: no registry calls")
+
+
+# -- circuit breaker state machine --------------------------------------------
+
+
+def _board(**kw):
+    clk = [0.0]
+    kw.setdefault("rng", random.Random(0))
+    board = BreakerBoard(clock=lambda: clk[0], **kw)
+    return board, clk
+
+
+class TestBreaker:
+    def test_threshold_consecutive_failures_open(self):
+        board, _ = _board(threshold=3)
+        for _ in range(2):
+            board.record_failure("b")
+        assert board.state("b") == STATE_CLOSED
+        assert board.allow("b")
+        board.record_failure("b")
+        assert board.state("b") == STATE_OPEN
+        assert not board.allow("b")
+
+    def test_success_resets_the_consecutive_count(self):
+        board, _ = _board(threshold=3)
+        board.record_failure("b")
+        board.record_failure("b")
+        board.record_success("b")
+        board.record_failure("b")
+        board.record_failure("b")
+        assert board.state("b") == STATE_CLOSED
+
+    def test_half_open_single_probe_then_close(self):
+        board, clk = _board(threshold=1, backoff_base_s=1.0)
+        board.record_failure("b")
+        assert not board.allow("b")
+        clk[0] = 1.5  # past any jittered window <= base
+        assert board.allow("b"), "lapsed window grants THE probe"
+        assert board.state("b") == STATE_HALF_OPEN
+        assert not board.allow("b"), "only one probe in flight"
+        board.record_success("b")
+        assert board.state("b") == STATE_CLOSED
+        assert board.allow("b")
+
+    def test_probe_failure_reopens_with_doubled_window(self):
+        board, clk = _board(threshold=1, backoff_base_s=1.0,
+                            backoff_cap_s=64.0)
+        board.record_failure("b")
+        first = board._peers["b"].open_until
+        assert first <= 1.0, "full jitter: uniform(0, base)"
+        clk[0] = 1.5
+        assert board.allow("b")  # probe
+        board.record_failure("b")
+        assert board.state("b") == STATE_OPEN
+        second = board._peers["b"].open_until - clk[0]
+        assert second <= 2.0, "second opening: uniform(0, 2*base)"
+
+    def test_backoff_caps(self):
+        board, clk = _board(threshold=1, backoff_base_s=1.0,
+                            backoff_cap_s=4.0)
+        for i in range(8):
+            clk[0] += 100.0
+            board.allow("b")  # grant the probe when open
+            board.record_failure("b")
+            window = board._peers["b"].open_until - clk[0]
+            assert window <= 4.0, f"opening {i}: window {window} > cap"
+
+    def test_force_open_and_probe_ready(self):
+        board, clk = _board(threshold=5)
+        board.force_open("b", reason="gossip dead")
+        assert board.state("b") == STATE_OPEN
+        assert not board.allow("b")
+        board.note_probe_ready("b")  # gossip: alive again
+        assert board.allow("b"), "collapsed window grants the probe"
+        board.record_success("b")
+        assert board.state("b") == STATE_CLOSED
+
+    def test_would_allow_has_no_side_effects(self):
+        board, clk = _board(threshold=1)
+        board.record_failure("b")
+        clk[0] = 100.0
+        assert board.would_allow("b")
+        assert board.state("b") == STATE_OPEN, \
+            "would_allow must not transition to half-open"
+
+    def test_abandoned_probe_expires(self):
+        """A granted probe whose caller died without reporting must
+        not blacklist the peer forever: past PROBE_EXPIRY_S the slot
+        is reclaimed and a new probe is granted."""
+        board, clk = _board(threshold=1)
+        board.record_failure("b")
+        clk[0] = 10.0
+        assert board.allow("b")  # probe granted ... and abandoned
+        assert not board.allow("b")
+        assert not board.would_allow("b")
+        clk[0] = 10.0 + BreakerBoard.PROBE_EXPIRY_S + 1.0
+        assert board.would_allow("b")
+        assert board.allow("b"), "expired slot: a fresh probe"
+        board.record_success("b")
+        assert board.state("b") == STATE_CLOSED
+
+    def test_gossip_alive_rescues_a_lost_half_open_probe(self):
+        board, clk = _board(threshold=1)
+        board.record_failure("b")
+        clk[0] = 10.0
+        assert board.allow("b")  # probe granted, then lost
+        board.note_probe_ready("b")  # gossip: the peer IS alive
+        assert board.allow("b"), \
+            "liveness evidence outranks a lost probe slot"
+
+    def test_state_gauge_and_transition_counter(self):
+        board, _ = _board(threshold=1)
+        before = obs_metrics.BREAKER_TRANSITIONS.labels(
+            "gauge-peer", "open").value
+        board.record_failure("gauge-peer")
+        assert obs_metrics.BREAKER_STATE.labels(
+            "gauge-peer").value == 2
+        assert obs_metrics.BREAKER_TRANSITIONS.labels(
+            "gauge-peer", "open").value == before + 1
+
+
+# -- peer health EWMA ---------------------------------------------------------
+
+
+class TestPeerHealth:
+    def test_unknown_peer_scores_innocent(self):
+        h = PeerHealth()
+        assert h.score("nobody") == 1.0
+
+    def test_failures_decay_the_score(self):
+        h = PeerHealth()
+        h.record("b", True, 0.01)
+        assert h.score("b") > 0.9
+        for _ in range(10):
+            h.record("b", False)
+        assert h.score("b") < 0.2
+        for _ in range(20):
+            h.record("b", True, 0.01)
+        assert h.score("b") > 0.8, "recovery decays back up"
+
+    def test_gossip_states_scale_the_score(self):
+        h = PeerHealth()
+        h.record("b", True, 0.01)
+        h.note_gossip("b", "suspect")
+        assert 0.4 < h.score("b") < 0.6
+        h.note_gossip("b", "dead")
+        assert h.score("b") == 0.0
+        h.note_gossip("b", "alive")
+        assert h.score("b") >= 0.5
+
+    def test_latency_tail_tracks_mean_plus_deviation(self):
+        h = PeerHealth()
+        for _ in range(50):
+            h.record("b", True, 0.010)
+        tail = h.latency_tail("b")
+        assert 0.009 < tail < 0.015, tail
+        for _ in range(10):
+            h.record("b", True, 0.100)  # a slow burst widens the tail
+        assert h.latency_tail("b") > tail
+
+    def test_snapshot_shape(self):
+        h = PeerHealth()
+        h.record("b", True, 0.01)
+        snap = h.snapshot()["b"]
+        for key in ("score", "okEwma", "latencyMs", "latencyTailMs",
+                    "gossip", "samples", "failures", "successes"):
+            assert key in snap
+
+
+# -- FaultManager placement ordering ------------------------------------------
+
+
+class TestFaultManagerOrdering:
+    def test_equal_health_keeps_stable_order(self):
+        fm = FaultManager(node="local")
+        nodes = new_cluster(["a", "b", "c"]).nodes
+        assert [n.host for n in fm.order_nodes(nodes)] == ["a", "b",
+                                                          "c"]
+
+    def test_local_node_first(self):
+        fm = FaultManager(node="local")
+        nodes = new_cluster(["a", "local", "b"]).nodes
+        assert fm.order_nodes(nodes)[0].host == "local"
+
+    def test_open_circuit_sinks_to_last_but_stays(self):
+        fm = FaultManager(node="local")
+        fm.breakers.force_open("a")
+        nodes = new_cluster(["a", "b"]).nodes
+        ordered = fm.order_nodes(nodes)
+        assert [n.host for n in ordered] == ["b", "a"], \
+            "open circuit sinks but is NOT dropped"
+
+    def test_unhealthy_peer_ranks_below_healthy(self):
+        fm = FaultManager(breaker_threshold=100, node="local")
+        for _ in range(10):
+            fm.record_rpc("a", False)
+        nodes = new_cluster(["a", "b"]).nodes
+        assert [n.host for n in fm.order_nodes(nodes)] == ["b", "a"]
+
+    def test_gossip_dead_opens_breaker_immediately(self):
+        fm = FaultManager(node="local")
+        fm.note_gossip("b", "dead")
+        assert not fm.allow("b")
+        fm.note_gossip("b", "alive")
+        assert fm.allow("b"), "alive refutation re-arms the probe"
+
+    def test_hedge_delay_uses_latency_tail_above_floor(self):
+        fm = FaultManager(hedge_s=0.01, node="local")
+        assert fm.hedge_delay_s("b") == 0.01  # unobserved: the floor
+        for _ in range(50):
+            fm.record_rpc("b", True, 0.2)
+        assert fm.hedge_delay_s("b") > 0.1
+        assert FaultManager(node="local").hedge_delay_s("b") is None
+
+
+# -- client integration -------------------------------------------------------
+
+
+class _BrokenConn:
+    sock = None
+    timeout = None
+
+    def __init__(self):
+        self.closed = False
+
+    def request(self, *a, **kw):
+        raise OSError("broken socket")
+
+    def close(self):
+        self.closed = True
+
+
+class TestClientFaultIntegration:
+    def test_broken_pooled_conn_never_poisons_the_pool(self,
+                                                       monkeypatch):
+        """Satellite: a failed leg must drop its connection — the next
+        _conn_get must never hand out the broken socket."""
+        monkeypatch.setattr(http.client, "HTTPConnection", _GoodConn)
+        c = Client("peer:1")
+        broken = _BrokenConn()
+        c._conn_put("peer:1", broken)
+        status, _ = c._do("GET", "/schema")  # retries on a fresh conn
+        assert status == 200
+        assert broken.closed, "the broken socket must be closed"
+        pooled = c._pool.get("peer:1", [])
+        assert broken not in pooled
+        assert all(isinstance(p, _GoodConn) for p in pooled)
+
+    def test_any_exception_drops_the_conn(self, monkeypatch):
+        """BaseException hygiene: an error escaping mid-request (not
+        just HTTPException/OSError) must close the socket, not pool
+        it."""
+        monkeypatch.setattr(http.client, "HTTPConnection", _GoodConn)
+        c = Client("peer:1")
+
+        class Boom(BaseException):
+            pass
+
+        conn = _GoodConn("peer:1")
+
+        def explode(*a, **kw):
+            raise Boom()
+
+        conn.request = explode
+        c._conn_put("peer:1", conn)
+        with pytest.raises(Boom):
+            c._do("GET", "/schema")
+        assert conn.closed
+        assert conn not in c._pool.get("peer:1", [])
+
+    def test_open_breaker_fails_fast(self):
+        fm = FaultManager(node="me")
+        fm.breakers.force_open("peer:1")
+        c = Client("peer:1", fault=fm)
+        t0 = time.perf_counter()
+        with pytest.raises(CircuitOpenError):
+            c._do("GET", "/schema")
+        assert time.perf_counter() - t0 < 0.1, \
+            "an open circuit must not pay any socket time"
+
+    def test_outcomes_feed_health_and_breaker(self, monkeypatch):
+        fm = FaultManager(breaker_threshold=2, node="me")
+        monkeypatch.setattr(http.client, "HTTPConnection", _GoodConn)
+        c = Client("peer:1", fault=fm)
+        c._do("GET", "/schema")
+        assert fm.health.snapshot()["peer:1"]["successes"] >= 1
+
+        def refuse(host, timeout=None):
+            conn = _GoodConn(host, timeout)
+            conn.request = _BrokenConn().request
+            return conn
+
+        monkeypatch.setattr(http.client, "HTTPConnection", refuse)
+        c._pool.clear()  # the pooled good socket would still answer
+        for _ in range(2):  # threshold 2 consecutive failures
+            with pytest.raises(ClientError):
+                c._do("GET", "/schema")
+        assert fm.breakers.state("peer:1") == STATE_OPEN
+        with pytest.raises(CircuitOpenError):
+            c._do("GET", "/schema")
+
+    def test_budget_clamped_timeout_does_not_feed_breaker(self,
+                                                          monkeypatch):
+        """A healthy-but-80ms peer serving 50ms-deadline queries must
+        not trip its breaker: a TIMEOUT that coincides with budget
+        exhaustion blames the budget, not the peer."""
+        fm = FaultManager(breaker_threshold=1, node="me")
+
+        def hang(host, timeout=None):
+            conn = _GoodConn(host, timeout)
+
+            def slow_request(*a, **kw):
+                time.sleep((timeout or 0.05) + 0.01)
+                raise TimeoutError("timed out")
+
+            conn.request = slow_request
+            return conn
+
+        monkeypatch.setattr(http.client, "HTTPConnection", hang)
+        c = Client("peer:1", fault=fm, timeout=0.05)
+        from pilosa_tpu.errors import QueryDeadlineError
+        with pytest.raises(QueryDeadlineError):
+            c._do("GET", "/schema", deadline_s=0.05)
+        assert fm.breakers.state("peer:1") == STATE_CLOSED, \
+            "deadline-clamped timeouts must not open the breaker"
+        # The same timeout WITHOUT a deadline is the peer's fault.
+        with pytest.raises(ClientError):
+            c._do("GET", "/schema")
+        assert fm.breakers.state("peer:1") == STATE_OPEN
+
+    def test_import_retries_429_with_retry_after(self, monkeypatch):
+        """Satellite: imports honor admission control's 429 +
+        Retry-After with capped backoff instead of surfacing the
+        first rejection."""
+        c = Client("peer:1")
+        script = [(429, b"busy", [("Retry-After", "0.01")]),
+                  (429, b"busy", [("Retry-After", "0.01")]),
+                  (200, b"", [])]
+        calls = []
+
+        def fake_do(method, path, body=None, headers=None, host=None,
+                    idempotent=None, deadline_s=None, headers_out=None):
+            status, raw, hs = script[len(calls)]
+            calls.append((method, path))
+            if headers_out is not None:
+                headers_out.extend(hs)
+            return status, raw
+
+        sleeps = []
+        monkeypatch.setattr(c, "_do", fake_do)
+        monkeypatch.setattr(time, "sleep", sleeps.append)
+        status, _ = c._do_429("POST", "/import", b"x", {}, None)
+        assert status == 200
+        assert len(calls) == 3
+        assert len(sleeps) == 2
+        assert all(s >= 0.01 for s in sleeps), \
+            "waits are floored at the server's Retry-After"
+        assert all(s <= Client._RETRY_429_CAP for s in sleeps)
+
+    def test_429_retry_bounded_by_budget(self, monkeypatch):
+        c = Client("peer:1", timeout=0.05)
+
+        def always_429(method, path, body=None, headers=None,
+                       host=None, idempotent=None, deadline_s=None,
+                       headers_out=None):
+            if headers_out is not None:
+                headers_out.append(("Retry-After", "100"))
+            return 429, b"busy"
+
+        monkeypatch.setattr(c, "_do", always_429)
+        t0 = time.perf_counter()
+        status, _ = c._do_429("POST", "/import", b"x", {}, None)
+        assert status == 429, "out of budget: the rejection surfaces"
+        assert time.perf_counter() - t0 < 1.0
+
+
+# -- anti-entropy skips dead peers --------------------------------------------
+
+
+class TestSyncerBreakerSkip:
+    def test_holder_syncer_peers_skip_open_circuits(self, holder):
+        fm = FaultManager(node="local")
+        fm.breakers.force_open("b")
+        cluster = new_cluster(["local", "b", "c"])
+        syncer = HolderSyncer(holder, "local", cluster, fault=fm)
+        assert [n.host for n in syncer._peers()] == ["c"]
+
+    def test_fragment_syncer_skips_open_circuit_replicas(self,
+                                                         tmp_path):
+        fm = FaultManager(node="local")
+        fm.breakers.force_open("b")
+        cluster = new_cluster(["local", "b", "c"], replica_n=3)
+        f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+        f.open()
+        try:
+            fs = FragmentSyncer(f, "local", cluster, fault=fm)
+            peers = fs._replica_peers(cluster.fragment_nodes("i", 0))
+            assert "b" not in [n.host for n in peers]
+            assert "local" in [n.host for n in peers]
+        finally:
+            f.close()
+
+    def test_peer_filter_does_not_consume_the_probe(self, holder):
+        """_peers must use the side-effect-free consult: if the filter
+        itself took the half-open probe slot, the syncer's own client
+        would find it gone and skip the peer it just included —
+        permanently wedging recovery."""
+        fm = FaultManager(breaker_threshold=1, node="local")
+        fm.record_rpc("b", False)  # open
+        fm.breakers.note_probe_ready("b")  # window collapsed
+        cluster = new_cluster(["local", "b"])
+        syncer = HolderSyncer(holder, "local", cluster, fault=fm)
+        assert [n.host for n in syncer._peers()] == ["b"]
+        assert fm.breakers.state("b") == STATE_OPEN, \
+            "the filter must not transition the breaker"
+        assert fm.allow("b"), \
+            "the probe slot is still there for the actual RPC"
+
+    def test_attr_sync_survives_a_dead_peer(self, holder):
+        """A ClientError from one peer must not abort the pass — the
+        remaining peers still get consulted."""
+        consulted = []
+
+        def fetch_diff(client, blocks):
+            consulted.append(client.host)
+            if client.host == "b":
+                raise ClientError("connection refused")
+            return {}
+
+        cluster = new_cluster(["local", "b", "c"])
+        syncer = HolderSyncer(
+            holder, "local", cluster,
+            client_factory=lambda h: SimpleNamespace(host=h))
+        store = SimpleNamespace(blocks=lambda: [],
+                                set_bulk_attrs=lambda m: None)
+        syncer._sync_attr_store(store, fetch_diff)  # must not raise
+        assert consulted == ["b", "c"]
+
+
+# -- executor: failover, breaker skip, partial, hedging -----------------------
+
+
+class _FaultyClient:
+    """Scripted transport that mimics the REAL client's fault-feed
+    contract: failures against a down host raise ClientError AND
+    record into the fault manager (cluster.client._do does both)."""
+
+    def __init__(self, fault, down=(), slow=(), slow_s=0.0,
+                 result_fn=None):
+        self.fault = fault
+        self.down = set(down)
+        self.slow = set(slow)
+        self.slow_s = slow_s
+        self.calls = []
+
+    def execute_query(self, node, index, query, slices, remote):
+        self.calls.append((node.host, list(slices or [])))
+        if node.host in self.down:
+            if self.fault is not None:
+                self.fault.record_rpc(node.host, False)
+            raise ClientError(f"{node.host}: connection refused")
+        if node.host in self.slow:
+            time.sleep(self.slow_s)
+        if self.fault is not None:
+            self.fault.record_rpc(node.host, True, 0.001)
+        return [len(slices or [])]
+
+
+class TestExecutorFailover:
+    def _cluster_executor(self, holder, hosts, replica_n, fault,
+                          client, n_slices=8):
+        cluster = new_cluster(hosts, replica_n=replica_n)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client, fault=fault)
+        holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("general")
+        holder.index("i").set_remote_max_slice(n_slices - 1)
+        return e, cluster
+
+    def test_first_failure_pays_next_query_skips(self, holder):
+        """The ISSUE contract: the first query after a node dies pays
+        the discovery; subsequent queries never touch the open
+        circuit."""
+        fm = FaultManager(breaker_threshold=1, node="local")
+        client = _FaultyClient(fm, down={"b"})
+        e, cluster = self._cluster_executor(
+            holder, ["local", "b", "c"], 2, fm, client)
+        down_slices = [
+            s for s in range(8)
+            if "b" in [n.host for n in cluster.fragment_nodes("i", s)]
+            and "local" not in [n.host
+                                for n in cluster.fragment_nodes("i", s)]]
+        if not down_slices:
+            pytest.skip("hash layout gave b no exclusive-remote slices")
+        res = e.execute("i", "Count(Bitmap(rowID=1, frame=general))")
+        assert res[0] >= 0  # failover produced a full answer
+        b_calls_first = sum(1 for h, _ in client.calls if h == "b")
+        assert b_calls_first >= 1, "the FIRST query discovers the death"
+        assert fm.breakers.state("b") == STATE_OPEN
+
+        client.calls.clear()
+        res2 = e.execute("i", "Count(Bitmap(rowID=1, frame=general))")
+        assert res2[0] == res[0]
+        assert all(h != "b" for h, _ in client.calls), \
+            "after the breaker opens, no query touches the dead peer"
+        failover = obs_metrics.FAILOVER_SLICES.labels("b").value
+        assert failover >= 1, "re-mapped slices are counted"
+
+    def test_partial_skips_unreachable_slices(self, holder):
+        must_set(holder, "i", "general", 1, 3)  # slice 0, local data
+        client = _FaultyClient(None, down={"remotehost"})
+        cluster = new_cluster(["local", "remotehost"], replica_n=1)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client)
+        holder.index("i").set_remote_max_slice(3)
+        remote_slices = [
+            s for s in range(4)
+            if cluster.fragment_nodes("i", s)[0].host == "remotehost"]
+        if not remote_slices:
+            pytest.skip("hash layout put every slice on local")
+
+        # Strict (default): the dead replica fails the query.
+        with pytest.raises(ClientError):
+            e.execute("i", "Count(Bitmap(rowID=1, frame=general))")
+
+        # Degraded (?partial=1): local slices answer, missing
+        # reported.
+        opt = ExecOptions(partial=True, missing_slices=[])
+        res = e.execute("i", "Count(Bitmap(rowID=1, frame=general))",
+                        opt=opt)
+        want = 0 if 0 in remote_slices else 1  # the bit lives in slice 0
+        assert res[0] == want, "reachable slices still answer"
+        assert sorted(opt.missing_slices) == remote_slices
+
+    def test_partial_with_no_owner_at_all(self, holder):
+        must_set(holder, "i", "general", 1, 3)
+        cluster = new_cluster(["local", "gone"], replica_n=1)
+        e = Executor(holder, host="local", cluster=cluster, client=None)
+        holder.index("i").set_remote_max_slice(3)
+        # Drop the remote node entirely: its slices have NO owner in
+        # the surviving node list.
+        cluster.nodes = [n for n in cluster.nodes if n.host == "local"]
+        opt = ExecOptions(partial=True, missing_slices=[])
+        res = e.execute("i", "Count(Bitmap(rowID=1, frame=general))",
+                        opt=opt)
+        assert res[0] == 1
+
+    def test_hedged_read_beats_a_slow_primary(self, holder):
+        fm = FaultManager(hedge_s=0.05, node="local")
+        client = _FaultyClient(fm, slow={"b"}, slow_s=1.5)
+        e, cluster = self._cluster_executor(
+            holder, ["local", "b", "c"], 2, fm, client)
+        hedgeable = [
+            s for s in range(8)
+            if cluster.fragment_nodes("i", s)[0].host == "b"
+            and "local" not in [n.host
+                                for n in cluster.fragment_nodes("i", s)]]
+        if not hedgeable:
+            pytest.skip("hash layout gave b no primary-remote slices")
+        before = obs_metrics.HEDGED_REQUESTS.labels("fired").value
+        t0 = time.perf_counter()
+        res = e.execute("i", "Count(Bitmap(rowID=1, frame=general))")
+        elapsed = time.perf_counter() - t0
+        assert res[0] >= len(hedgeable)
+        assert elapsed < 1.0, (
+            f"hedge must beat the 1.5s primary, took {elapsed:.2f}s")
+        assert obs_metrics.HEDGED_REQUESTS.labels("fired").value \
+            > before
+
+    def test_slices_by_node_orders_by_health(self, holder):
+        fm = FaultManager(breaker_threshold=100, node="local")
+        for _ in range(10):
+            fm.record_rpc("b", False)  # unhealthy but not open
+        client = _FaultyClient(fm)
+        e, cluster = self._cluster_executor(
+            holder, ["local", "b", "c"], 2, fm, client)
+        for s in range(8):
+            owners = [n.host
+                      for n in cluster.fragment_nodes("i", s)]
+            if set(owners) == {"b", "c"}:
+                groups = e._slices_by_node(cluster.nodes, "i", [s])
+                assert groups[0][0].host == "c", \
+                    "healthy replica outranks the failing one"
+                return
+        pytest.skip("hash layout gave no {b,c} slice")
+
+
+# -- /debug/failpoints over HTTP ----------------------------------------------
+
+
+def call(app, method, path, body=b"", content_type=""):
+    if "?" in path:
+        path, _, qs = path.partition("?")
+    else:
+        qs = ""
+    environ = {"REQUEST_METHOD": method, "PATH_INFO": path,
+               "QUERY_STRING": qs, "CONTENT_LENGTH": str(len(body)),
+               "wsgi.input": io.BytesIO(body)}
+    if content_type:
+        environ["CONTENT_TYPE"] = content_type
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = int(status.split()[0])
+        out["headers"] = dict(headers)
+
+    chunks = app(environ, start_response)
+    return out["status"], out["headers"], b"".join(chunks)
+
+
+class TestFailpointHTTP:
+    def test_get_lists_schedule_and_seed(self):
+        h = Handler(None, None)
+        status, _, body = call(h, "GET", "/debug/failpoints")
+        assert status == 200
+        got = json.loads(body)
+        assert "seed" in got and "armed" in got
+        assert set(got["sites"]) == set(failpoints.SITES)
+
+    def test_post_arms_and_off_disarms(self):
+        h = Handler(None, None)
+        status, _, body = call(
+            h, "POST", "/debug/failpoints",
+            json.dumps({"site": "rpc.send",
+                        "spec": "error(0.5)*3"}).encode())
+        assert status == 200
+        assert "rpc.send" in json.loads(body)["armed"]
+        assert failpoints.ACTIVE is not None
+        status, _, body = call(
+            h, "POST", "/debug/failpoints",
+            json.dumps({"failpoints": {"rpc.send": "off"}}).encode())
+        assert status == 200
+        assert json.loads(body)["armed"] == {}
+
+    def test_post_validates_before_arming_anything(self):
+        h = Handler(None, None)
+        status, _, _ = call(
+            h, "POST", "/debug/failpoints",
+            json.dumps({"failpoints": {"rpc.send": "error",
+                                       "bogus.site": "error"}}).encode())
+        assert status == 400
+        assert failpoints.default().snapshot()["armed"] == {}, \
+            "a bulk update must not half-apply"
+        status, _, _ = call(
+            h, "POST", "/debug/failpoints",
+            json.dumps({"site": "rpc.send", "spec": "nope"}).encode())
+        assert status == 400
+        status, _, _ = call(h, "POST", "/debug/failpoints", b"{}")
+        assert status == 400
+
+    def test_partial_header_rides_the_response(self, holder):
+        class StubExecutor:
+            def execute(self, index, query, slices=None, opt=None):
+                if opt is not None and opt.partial:
+                    opt.missing_slices.extend([3, 1])
+                return [0]
+
+        h = Handler(holder, StubExecutor(), host="local")
+        status, headers, _ = call(
+            h, "POST", "/index/i/query?partial=1",
+            b'Count(Bitmap(rowID=1, frame="general"))')
+        assert status == 200
+        assert headers.get("X-Pilosa-Partial") == "1,3"
+        status, headers, _ = call(
+            h, "POST", "/index/i/query",
+            b'Count(Bitmap(rowID=1, frame="general"))')
+        assert status == 200
+        assert "X-Pilosa-Partial" not in headers
+
+    def test_status_carries_the_fault_block(self, holder):
+        fm = FaultManager(node="local")
+        fm.record_rpc("b", True, 0.01)
+        fm.breakers.force_open("c")
+        h = Handler(holder, None, host="local",
+                    cluster=new_cluster(["local", "b", "c"]), fault=fm)
+        status, _, body = call(h, "GET", "/status")
+        assert status == 200
+        fault = json.loads(body)["fault"]
+        assert fault["peers"]["b"]["successes"] == 1
+        assert fault["breakers"]["c"]["state"] == STATE_OPEN

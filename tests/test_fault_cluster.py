@@ -1,0 +1,245 @@
+"""Chaos leg: a REAL 3-node replicas=2 gossip cluster under SIGKILL.
+
+The ISSUE acceptance contract, end to end against real processes:
+
+- the cluster keeps answering CORRECT (differential-checked) queries
+  while one node is SIGKILLed mid-load;
+- the coordinator's breaker for the dead peer runs the full
+  open → half-open → closed cycle across the kill and the restart,
+  observed via /metrics;
+- once the breaker is open, failover queries complete without paying
+  the dead peer's RPC timeout — asserted via the per-query stage
+  timings the PR 4 slow log records.
+
+Marked ``slow`` (multi-process, tens of seconds) + ``chaos``; the
+fast failpoint-driven chaos tests live in test_fault.py and run in
+tier-1.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+from podenv import cpu_env, free_port, wait_up  # noqa: E402
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+
+pytestmark = [pytest.mark.slow, pytest.mark.chaos]
+
+N_SLICES = 8
+
+
+def _post(host, path, body=b"", timeout=30):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    return urllib.request.urlopen(req, timeout=timeout).read()
+
+
+def _get(host, path, timeout=10):
+    with urllib.request.urlopen(f"http://{host}{path}",
+                                timeout=timeout) as r:
+        return r.read()
+
+
+def _get_json(host, path, timeout=10):
+    return json.loads(_get(host, path, timeout=timeout))
+
+
+def _count(host, row, timeout=30):
+    got = json.loads(_post(
+        host, "/index/fc/query",
+        f'Count(Bitmap(frame="f", rowID={row}))'.encode(),
+        timeout=timeout))
+    assert "error" not in got, got
+    return got["results"][0]
+
+
+def _breaker_gauge(host, peer):
+    """pilosa_fault_breaker_state{peer="..."} from /metrics, or None
+    while the peer has no breaker yet."""
+    for line in _get(host, "/metrics").decode().splitlines():
+        if line.startswith("pilosa_fault_breaker_state") \
+                and f'peer="{peer}"' in line:
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def _transitions(host, peer):
+    out = {}
+    for line in _get(host, "/metrics").decode().splitlines():
+        if line.startswith("pilosa_fault_breaker_transitions_total") \
+                and f'peer="{peer}"' in line:
+            state = line.split('state="', 1)[1].split('"', 1)[0]
+            out[state] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
+class _Cluster:
+    def __init__(self, tmp_path):
+        self.tmp_path = tmp_path
+        self.ports = {n: free_port() for n in "abc"}
+        self.gports = {n: free_port() for n in "abc"}
+        self.hosts = {n: f"127.0.0.1:{self.ports[n]}" for n in "abc"}
+        self.procs: dict[str, subprocess.Popen] = {}
+        self.logs = []
+        self.host_list = ",".join(self.hosts[n] for n in "abc")
+
+    def spawn(self, name, seed=""):
+        d = self.tmp_path / name
+        d.mkdir(exist_ok=True)
+        env = cpu_env()
+        env["PILOSA_TPU_MESH"] = "0"
+        env["PILOSA_TPU_WARMUP"] = "0"
+        # Fast breaker cadence so the open→half-open→closed cycle fits
+        # a test, and a fixed seed so any chaos failure replays.
+        env["PILOSA_FAULT_BREAKER_BACKOFF"] = "0.2s"
+        env["PILOSA_FAULT_BREAKER_BACKOFF_CAP"] = "1s"
+        env["PILOSA_FAULT_SEED"] = "12345"
+        log = open(self.tmp_path / f"{name}.log", "a")
+        self.logs.append(log)
+        argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                "-d", str(d), "-b", self.hosts[name],
+                "--cluster.type", "gossip",
+                "--cluster.hosts", self.host_list,
+                "--cluster.replicas", "2",
+                "--cluster.internal-port", str(self.gports[name]),
+                "--query.slow-threshold", "1ms",
+                "--anti-entropy.interval", "300s"]
+        if seed:
+            argv += ["--cluster.gossip-seed", seed]
+        p = subprocess.Popen(argv, env=env, stdout=log, stderr=log,
+                             cwd=os.path.dirname(_HERE))
+        self.procs[name] = p
+        wait_up(self.hosts[name])
+        return self.hosts[name]
+
+    def close(self):
+        for p in self.procs.values():
+            try:
+                p.send_signal(signal.SIGINT)
+            except OSError:
+                pass
+        for p in self.procs.values():
+            try:
+                p.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        for log in self.logs:
+            log.close()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = _Cluster(tmp_path)
+    c.spawn("a")
+    c.spawn("b", seed=f"127.0.0.1:{c.gports['a']}")
+    c.spawn("c", seed=f"127.0.0.1:{c.gports['a']}")
+    yield c
+    c.close()
+
+
+def test_sigkill_failover_breaker_cycle(cluster):
+    host_a = cluster.hosts["a"]
+    host_c = cluster.hosts["c"]
+    _post(host_a, "/index/fc", b"{}")
+    _post(host_a, "/index/fc/frame/f", b"{}")
+
+    # Differential model: row -> expected count, spread over N_SLICES
+    # so every node owns slices (replicas=2 of 3 nodes: each slice
+    # has TWO owners, so any single death leaves a live replica).
+    from pilosa_tpu.cluster.client import Client
+    import numpy as np
+    client = Client(host_a)
+    model = {}
+    for row in (1, 2):
+        cols = np.arange(row, N_SLICES * SLICE_WIDTH,
+                         SLICE_WIDTH // 2, dtype=np.uint64)
+        client.import_arrays("fc", "f",
+                             np.full(len(cols), row, np.uint64), cols)
+        model[row] = len(cols)
+
+    # Convergence: the coordinator answers the full count.
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if all(_count(host_a, r) == n for r, n in model.items()):
+            break
+        time.sleep(0.3)
+    for row, want in model.items():
+        assert _count(host_a, row) == want
+
+    # -- SIGKILL node c mid-load ------------------------------------------
+    # A steady query storm is in flight while the node dies: every
+    # answer, before/during/after, must be the model's (replica
+    # failover, never a wrong partial).
+    proc_c = cluster.procs.pop("c")
+    proc_c.send_signal(signal.SIGKILL)
+    proc_c.wait(timeout=30)
+    storm_deadline = time.time() + 20
+    opened_at = None
+    while time.time() < storm_deadline:
+        for row, want in model.items():
+            got = _count(host_a, row)
+            assert got == want, (
+                f"row {row}: {got} != {want} with node c dead")
+        if opened_at is None and _breaker_gauge(host_a, host_c) == 2:
+            opened_at = time.time()
+            break
+        time.sleep(0.1)
+    assert opened_at is not None, (
+        "a's breaker for the killed peer never opened; fault block: "
+        + json.dumps(_get_json(host_a, "/status").get("fault", {})))
+
+    # -- post-open failovers never pay the dead peer's timeout ------------
+    # Wall-clock on the query AND the per-query stage timings (PR 4
+    # slow log): with the breaker open, placement skips the dead peer
+    # entirely, so execute must run in milliseconds, nowhere near the
+    # 30s client timeout the first discovery could have paid.
+    for row, want in model.items():
+        t0 = time.perf_counter()
+        assert _count(host_a, row) == want
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 2.0, (
+            f"post-open failover took {elapsed:.2f}s — paid a dead"
+            f" peer timeout?")
+    slow = _get_json(host_a, "/debug/queries/slow")["slow"]
+    post_open = [q for q in slow
+                 if q["pql"].startswith("Count(")
+                 and q["startedAt"] >= opened_at - 0.05]
+    assert post_open, "slow log (threshold 1ms) must have the queries"
+    for q in post_open:
+        assert q["stages"].get("execute", 0.0) < 2.0, q
+        # And none of their legs touched the dead peer.
+        assert all(leg["host"] != host_c for leg in q["legs"]), q
+
+    # -- restart: open → half-open → closed, observed via metrics ---------
+    cluster.spawn("c", seed=f"127.0.0.1:{cluster.gports['a']}")
+    deadline = time.time() + 30
+    closed = False
+    while time.time() < deadline:
+        for row, want in model.items():  # traffic drives the probe
+            assert _count(host_a, row) == want
+        if _breaker_gauge(host_a, host_c) == 0:
+            closed = True
+            break
+        time.sleep(0.2)
+    assert closed, (
+        "breaker never closed after the peer returned; transitions: "
+        + json.dumps(_transitions(host_a, host_c)))
+    trans = _transitions(host_a, host_c)
+    assert trans.get("open", 0) >= 1, trans
+    assert trans.get("half_open", 0) >= 1, trans
+    assert trans.get("closed", 0) >= 1, trans
+
+    # The full differential model still answers after recovery.
+    for row, want in model.items():
+        assert _count(host_a, row) == want

@@ -78,6 +78,23 @@ class TestRouteTableDocumented:
         assert "/debug/queries/slow" in swept
         assert "/debug/pprof/flame" in swept
         assert "/health" in swept
+        # Fault subsystem: the failpoint admin endpoint must be both
+        # registered and documented.
+        assert "/debug/failpoints" in swept
+
+    def test_fault_metrics_registered(self):
+        """The fault-layer metric families promised by
+        docs/FAULT_TOLERANCE.md exist in the default registry (and so
+        passed the naming-convention gate at import)."""
+        fams = obs_metrics.default_registry().families()
+        for name in ("pilosa_cluster_peer_health",
+                     "pilosa_fault_breaker_state",
+                     "pilosa_fault_breaker_transitions_total",
+                     "pilosa_fault_failpoint_triggers_total",
+                     "pilosa_cluster_failover_slices_total",
+                     "pilosa_cluster_hedged_requests_total",
+                     "pilosa_query_partial_results_total"):
+            assert name in fams, name
 
 
 # One OpenMetrics 1.0 metric line, optionally with an exemplar:
