@@ -40,6 +40,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 SCALE = float(os.environ.get("PILOSA_BENCH_SCALE", "1.0"))
 USE_DEVICE = os.environ.get("PILOSA_BENCH_DEVICE", "1") != "0"
 
+# One persistent XLA compile cache for the whole pass (and its
+# subprocesses): suite-spawned servers live in temp dirs that are
+# deleted mid-run, so without this the first such server would arm the
+# process-global cache at a doomed path; with it, repeated passes also
+# reuse each other's compilations (the restart-latency story the
+# compile_stability config measures).
+if "PILOSA_TPU_COMPILE_CACHE" not in os.environ:
+    from pilosa_tpu.utils import cache_dir as _cache_dir
+    os.environ["PILOSA_TPU_COMPILE_CACHE"] = _cache_dir("xla-suite")
+
 
 # Every emit of this pass, in order — main() folds them into
 # benchmarks/MANIFEST.json so "which run wrote this artifact" is
@@ -129,6 +139,11 @@ def write_manifest() -> None:
     # roofline constants (benchmarks/roofline.py) ride the manifest;
     # a pass that skipped either carries the prior values forward.
     out["query_cost"] = _QUERY_COST or prior_doc.get("query_cost", {})
+    # Fresh-process first-vs-warm + compile counts per slice config
+    # (config_compile_stability): the restart-latency acceptance table.
+    out["compile_stability"] = (_COMPILE_STABILITY
+                                or prior_doc.get("compile_stability",
+                                                 {}))
     measured = _roofline_measured() or prior_doc.get(
         "roofline_measured_constants")
     if measured:
@@ -140,6 +155,129 @@ def write_manifest() -> None:
 # Per-config cost ledgers captured by config_query_cost() — folded
 # into MANIFEST.json's query_cost section.
 _QUERY_COST: dict = {}
+
+# Per-slice-config restart latency + compile counts captured by
+# config_compile_stability() — folded into MANIFEST.json.
+_COMPILE_STABILITY: dict = {}
+
+
+# Fresh-process measurement: each slice config restarts python, arms
+# the SHARED persistent compile cache, and times the FIRST device
+# query end-to-end (backend init + mesh + program acquisition +
+# dispatch) then the warm p50 — the real "first device query after
+# restart" number (VERDICT weak #2), not an in-process proxy.
+_STABILITY_CHILD = r"""
+import json, os, sys, tempfile, time
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PILOSA_TPU_COST_MODEL"] = "0"
+sys.path.insert(0, %(repo)r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.parallel import mesh as mesh_mod, programs
+
+armed = mesh_mod.arm_compile_cache(None)  # env carries the shared dir
+n_slices = %(n_slices)d
+rng = np.random.default_rng(17)
+with tempfile.TemporaryDirectory() as d:
+    holder = Holder(d)
+    holder.open()
+    try:
+        frame = holder.create_index_if_not_exists("cs") \
+            .create_frame_if_not_exists("f")
+        for row in (0, 1):
+            cols = (rng.integers(0, SLICE_WIDTH, size=50 * n_slices)
+                    + np.repeat(np.arange(n_slices), 50) * SLICE_WIDTH)
+            frame.import_bits(np.full(len(cols), row, dtype=np.uint64),
+                              cols.astype(np.uint64))
+        ex = Executor(holder, host="local", mesh_min_slices=1)
+        # The server's boot sequence: warmup compiles the catalogue at
+        # the holder's actual bucket (reading the persistent cache),
+        # THEN queries arrive. first_ms is the first real device query
+        # a restarted server serves; warmup_s is the startup cost it
+        # paid in the background to get there.
+        q = ("Count(Intersect(Bitmap(frame=f, rowID=0),"
+             " Bitmap(frame=f, rowID=1)))")
+        from pilosa_tpu.sched.warmup import Warmup
+        w = Warmup(ex)
+        t0 = time.perf_counter()
+        w._run()
+        warmup_s = time.perf_counter() - t0
+        assert w.state == "done", (w.state, w.error)
+        t0 = time.perf_counter()
+        first = ex.execute("cs", q)[0]
+        first_s = time.perf_counter() - t0
+        lat = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            again = ex.execute("cs", q)[0]
+            lat.append(time.perf_counter() - t0)
+        assert again == first
+        assert ex.device_fallbacks == 0, "fell back to host"
+        stats = mesh_mod.compile_stats()
+        print("RESULT " + json.dumps({
+            "first_ms": round(first_s * 1e3, 1),
+            "warm_p50_ms": round(sorted(lat)[2] * 1e3, 2),
+            "warmup_s": round(warmup_s, 2),
+            "compile_count": stats["firstCalls"],
+            "persistent_hits": stats["persistentHits"],
+            "persistent_misses": stats["persistentMisses"],
+            "bucket": programs.slice_bucket(n_slices, 8),
+            "cache_dir": armed}))
+    finally:
+        holder.close()
+"""
+
+
+def config_compile_stability() -> None:
+    """First-vs-warm device query latency AND compile counts per
+    slice-count config, each in a FRESH process sharing one on-disk
+    XLA cache — records (a) whether the compile count stays constant
+    (bucket-bound) as slice count grows 8→32, and (b) what the first
+    device query after a restart actually costs once the persistent
+    cache is warm. The tier-1 regression twin lives in
+    tests/test_programs.py; this is the measured artifact."""
+    import subprocess
+    import tempfile
+
+    from pilosa_tpu.utils import cache_dir
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    share = os.environ.get("PILOSA_TPU_COMPILE_CACHE")
+    if share == "0":
+        # The operator explicitly disabled the persistent cache; a
+        # forced-warm measurement would be the number they asked NOT
+        # to produce. Record the skip instead of overriding.
+        emit("compile_stability", -1, "error",
+             error="skipped: PILOSA_TPU_COMPILE_CACHE=0")
+        return
+    if not share:
+        share = cache_dir("xla-suite")
+    env = dict(os.environ)
+    env["PILOSA_TPU_COMPILE_CACHE"] = share
+    for n_slices in (8, 16, 24, 32):
+        code = _STABILITY_CHILD % {"repo": repo, "n_slices": n_slices}
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True, env=env,
+                             timeout=600)
+        line = [ln for ln in out.stdout.splitlines()
+                if ln.startswith("RESULT ")]
+        if out.returncode != 0 or not line:
+            emit(f"compile_stability_s{n_slices}", -1, "error",
+                 error=(out.stderr or out.stdout)[-200:])
+            continue
+        rec = json.loads(line[0][len("RESULT "):])
+        _COMPILE_STABILITY[f"s{n_slices}"] = rec
+        emit(f"compile_stability_s{n_slices}", rec["warm_p50_ms"],
+             "ms", first_ms=rec["first_ms"],
+             compile_count=rec["compile_count"],
+             persistent_hits=rec["persistent_hits"],
+             bucket=rec["bucket"], slices=n_slices)
 
 
 def _roofline_measured() -> dict | None:
@@ -1233,6 +1371,7 @@ def main() -> None:
                config_http_pipelined_setbit,
                config_wire_import,
                config_query_cost,
+               config_compile_stability,
                emit_compile_cache):
         try:
             fn()

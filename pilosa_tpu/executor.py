@@ -366,13 +366,14 @@ class Executor:
         while i < len(query.calls):
             if opt.ctx is not None:
                 opt.ctx.check()  # between calls of a multi-call query
-            # Consecutive device-compilable Count calls fuse into ONE
-            # mesh program — K counts, one dispatch (one sync).
-            batch = self._count_batch_run(index, query.calls, i, slices,
-                                          opt)
+            # Consecutive device-compilable calls (Counts, exact-count
+            # TopNs) fuse into ONE device program — the whole multi-op
+            # tree pays one dispatch (one sync), not one per call.
+            batch = self._device_batch_run(index, query.calls, i,
+                                           slices, opt)
             if batch is not None:
-                counts, n = batch
-                results.extend(counts)
+                batch_results, n = batch
+                results.extend(batch_results)
                 i += n
                 continue
             # Consecutive SetBit/ClearBit calls batch into one native
@@ -1012,12 +1013,19 @@ class Executor:
 
     # -- device-batched Count (TPU fast path) --------------------------------
 
-    def _count_batch_run(self, index: str, calls: list[Call], start: int,
-                         slices: list[int], opt: ExecOptions):
-        """(counts, n_calls) for a maximal run of ≥2 consecutive
-        device-compilable Count calls starting at ``start``, fused into
-        one mesh program over shared (deduplicated) leaf slabs — or
-        None to fall back to per-call execution.
+    def _device_batch_run(self, index: str, calls: list[Call], start: int,
+                          slices: list[int], opt: ExecOptions):
+        """(results, n_calls) for a maximal run of ≥2 consecutive
+        device-lowerable calls starting at ``start`` — Count over any
+        compilable bitmap tree (including BSI ``Range`` comparison
+        circuits) and the exact-count TopN form (explicit ids + a
+        compilable source) — fused into ONE device program over shared
+        (deduplicated) leaf slabs, or None to fall back to per-call
+        execution. A counts-only run dispatches the batched count
+        program; a run carrying TopN blocks dispatches the fused-tree
+        program (mesh.fused_tree_sharded): either way the whole tree
+        pays one dispatch, one in-program reduction, one host fetch —
+        not one crossing per call (VERDICT weak #6's host-merge tax).
 
         Requires every touched slice to be locally owned (a pod counts
         as one node: its coordinator dispatches the batch as ONE pod
@@ -1025,9 +1033,10 @@ class Executor:
         a query with remote-only slices would bypass its remote legs —
         but a node owning a replica of everything (the common
         replica_n == nodes shape) answers the whole batch from local
-        fragments and keeps the fused device fold. Count calls never
+        fragments and keeps the fused device fold. Count and TopN never
         take the inverse slice list (only Bitmap does), so every call
-        in the run shares ``slices``.
+        in the run shares ``slices``. Pod runs and Pallas-kernel meshes
+        fuse counts only (their per-kind programs serve TopN).
         """
         if not self.use_mesh or len(slices) < self.mesh_min_slices:
             return None
@@ -1037,32 +1046,36 @@ class Executor:
         if self.pod is None and self._mesh_backoff_active():
             return None
         # Cheap necessary condition before any compile work: a run
-        # needs ≥2 Counts, so a lone Count (the common query shape)
-        # must not pay a discarded device-expr compilation (or the
-        # per-slice ownership walk below) here.
-        if (start + 1 >= len(calls) or calls[start].name != "Count"
-                or calls[start + 1].name != "Count"):
+        # needs ≥2 fusable calls, so a lone Count or TopN (the common
+        # query shapes) must not pay a discarded device-expr
+        # compilation (or the per-slice ownership walk below) here.
+        if (start + 1 >= len(calls)
+                or calls[start].name not in ("Count", "TopN")
+                or calls[start + 1].name not in ("Count", "TopN")):
             return None
         if not self._owns_all_slices(index, slices):
             return None
         from .parallel import mesh as mesh_mod
+        mesh = None
+        pallas = False
+        if self.pod is None:
+            mesh = self._mesh_or_none()
+            if mesh is None or len(slices) > mesh_mod.slice_chunk_bound(
+                    mesh.shape[mesh_mod.AXIS_SLICES]):
+                return None
+            pallas = mesh_mod._mesh_pallas_mode(mesh) is not None
         shard, budget = self._count_budget(slices)
         leaves: list[tuple] = []
         leaf_ids: dict[tuple, int] = {}
-        exprs: list[tuple] = []
+        plan: list[tuple] = []       # ("count", expr) | ("topn", ...)
+        topn_items: list[tuple] = []  # (expr, frame_name, ids)
+        host_rows = 0  # per-call leaf rows: the host path's real bytes
+        rows_bytes = 0  # accumulated candidate-block bytes in the plan
         j = start
-        while j < len(calls) and len(exprs) < self._BATCH_MAX_COUNTS:
-            c = calls[j]
-            if c.name != "Count" or len(c.children) != 1:
-                break
-            call_leaves: list[tuple] = []
-            expr = self._compile_device_expr(index, c.children[0],
-                                             call_leaves)
-            if expr is None:
-                break
-            new = sum(1 for leaf in call_leaves if leaf not in leaf_ids)
-            if self._leaf_block_bytes(len(leaves) + new, shard) > budget:
-                break  # fuse the prefix that fits; the rest runs per call
+
+        def absorb(call_leaves: list[tuple], expr):
+            """Intern a call's leaves into the shared slab set and
+            remap its expr; returns the remapped expr."""
             remap = {}
             for li, leaf in enumerate(call_leaves):
                 if leaf not in leaf_ids:
@@ -1070,42 +1083,155 @@ class Executor:
                     leaves.append(leaf)
                 remap[li] = leaf_ids[leaf]
             if all(k == v for k, v in remap.items()):
-                exprs.append(expr)  # first call / no shared leaves
-            else:
-                exprs.append(mesh_mod.remap_expr_leaves(expr, remap))
-            j += 1
+                return expr  # first call / no shared leaves
+            return mesh_mod.remap_expr_leaves(expr, remap)
+
+        while j < len(calls) and len(plan) < self._BATCH_MAX_COUNTS:
+            c = calls[j]
+            if c.name == "Count" and len(c.children) == 1:
+                call_leaves: list[tuple] = []
+                expr = self._compile_device_expr(index, c.children[0],
+                                                 call_leaves)
+                if expr is None:
+                    break
+                new = sum(1 for leaf in call_leaves
+                          if leaf not in leaf_ids)
+                if (self._leaf_block_bytes(len(leaves) + new, shard)
+                        + rows_bytes > budget):
+                    break  # fuse the prefix that fits; rest per call
+                plan.append(("count", absorb(call_leaves, expr)))
+                host_rows += len(call_leaves)
+                j += 1
+                continue
+            if (c.name == "TopN" and self.pod is None and not pallas):
+                item = self._topn_fusable(index, c, slices, shard,
+                                          budget - rows_bytes, leaves,
+                                          leaf_ids)
+                if item is None:
+                    break
+                expr, frame_name, ids, call_leaves = item
+                plan.append(("topn", len(topn_items)))
+                topn_items.append((absorb(call_leaves, expr),
+                                   frame_name, ids))
+                host_rows += len(ids) + len(call_leaves)
+                # Every accepted candidate block stays live in the ONE
+                # fused program — the budget must bound their SUM, not
+                # each block alone (review finding: 16 × ~250 MB blocks
+                # each passed a per-call check while the fused program
+                # held ~4 GB of rows at once).
+                rows_bytes += (len(slices) * len(ids)
+                               * self._leaf_block_bytes(1, 1))
+                j += 1
+                continue
+            break
         if j - start < 2:
             return None
+        count_exprs = tuple(e for kind, e in plan if kind == "count")
         if self.pod is not None:
+            if topn_items:
+                return None  # unreachable: pod scan breaks at TopN
             try:
-                counts = self.pod.count_exprs(index, exprs, leaves,
-                                              slices)
+                counts = self.pod.count_exprs(index, list(count_exprs),
+                                              leaves, slices)
             except Exception as e:  # noqa: BLE001 - per-call pod paths
                 self._note_device_fallback("pod.count_exprs", e)
                 return None
             return counts, j - start
-        mesh = self._mesh_or_none()
-        if mesh is None or len(slices) > mesh_mod.slice_chunk_bound(
-                mesh.shape[mesh_mod.AXIS_SLICES]):
-            return None
-        # One sync serves all K counts; the host alternative re-walks
-        # each count's leaves, so its bytes are ≥ the unique-leaf block
-        # the veto prices — a vetoed batch falls to per-call gates that
-        # agree, landing everything on the host path.
-        if not self._device_pays(
-                mesh, len(leaves), len(slices),
-                cold_rows=self._cold_leaves(mesh, index, leaves, slices)):
+        # One sync serves the whole tree; the host alternative re-walks
+        # each call's leaves (and candidate rows), so its bytes are the
+        # per-call sum — priced separately from the deduplicated device
+        # block (costmodel host_bytes). A vetoed batch falls to
+        # per-call gates that agree, landing everything on the host.
+        from .parallel.residency import device_cache
+        cold = self._cold_leaves(mesh, index, leaves, slices)
+        rows_keys = []
+        for expr_t, frame_name, ids in topn_items:
+            rk = self._topn_rows_key(mesh, index, frame_name,
+                                     tuple(ids), tuple(slices))
+            rows_keys.append(rk)
+            if not device_cache().contains(rk):
+                cold += len(ids)
+        device_rows = (len(leaves)
+                       + sum(len(ids) for _, _, ids in topn_items))
+        if not self._device_pays(mesh, device_rows, len(slices),
+                                 cold_rows=cold, host_rows=host_rows):
             return None
         try:
             arrs = [self._leaf_device_array(mesh, index, leaf,
                                             tuple(slices))
                     for leaf in leaves]
-            counts = mesh_mod.count_exprs_sharded(mesh, tuple(exprs),
-                                                  arrs)
+            if topn_items:
+                from .parallel import residency
+                rows_arrays = []
+                for (expr_t, frame_name, ids), rk in zip(topn_items,
+                                                         rows_keys):
+                    frags = [self.holder.fragment(index, frame_name,
+                                                  VIEW_STANDARD, s)
+                             for s in slices]
+                    rows_arrays.append(residency.candidate_block(
+                        mesh, rk, frags, tuple(ids)))
+                counts, topn_counts = mesh_mod.fused_tree_sharded(
+                    mesh, count_exprs,
+                    [(expr_t, len(ids))
+                     for expr_t, _, ids in topn_items],
+                    arrs, rows_arrays)
+            else:
+                counts = mesh_mod.count_exprs_sharded(
+                    mesh, count_exprs, arrs)
+                topn_counts = []
         except Exception as e:  # noqa: BLE001 - fall back per call
-            self._note_device_fallback("count_exprs", e)
+            self._note_device_fallback(
+                "fused_tree" if topn_items else "count_exprs", e)
             return None
-        return counts, j - start
+        results: list = []
+        count_i = 0
+        for kind, v in plan:
+            if kind == "count":
+                results.append(counts[count_i])
+                count_i += 1
+            else:
+                _, _, ids = topn_items[v]
+                results.append(pairs_sort(
+                    [Pair(rid, cnt) for rid, cnt
+                     in zip(ids, topn_counts[v]) if cnt > 0]))
+        return results, j - start
+
+    def _topn_fusable(self, index: str, c: Call, slices: list[int],
+                      shard: int, budget: int, leaves: list[tuple],
+                      leaf_ids: dict):
+        """(expr, frame_name, ids, call_leaves) when this TopN call can
+        join a fused device tree: the exact-count form (explicit ids +
+        one compilable source child), unfiltered (threshold ≤ 1, no
+        Tanimoto — the pruning forms need runtime scalars and keep
+        their per-kind program), attribute filters applied host-side
+        up front (row attrs are frame-global), candidate block within
+        the resident byte bounds. None breaks the run (per-call paths
+        own every other shape and all error semantics)."""
+        (frame_name, _n, field, row_ids, min_threshold, filters,
+         tanimoto) = self._topn_args(c)
+        if (not row_ids or len(c.children) != 1 or tanimoto > 0
+                or min_threshold > 1):
+            return None
+        call_leaves: list[tuple] = []
+        expr = self._compile_device_expr(index, c.children[0],
+                                         call_leaves)
+        if expr is None:
+            return None
+        ids = self._attr_filtered_ids(index, frame_name, row_ids,
+                                      field, filters)
+        if ids is None or not ids:
+            # No attr store, or nothing survives the filter: the
+            # per-call path owns the (cheap) empty/fallback semantics.
+            return None
+        from .ops.packed import WORDS_PER_SLICE
+        from .parallel import mesh as mesh_mod
+        block_bytes = len(slices) * len(ids) * WORDS_PER_SLICE * 4
+        new = sum(1 for leaf in call_leaves if leaf not in leaf_ids)
+        if (block_bytes > mesh_mod.TOPN_BLOCK_BYTES
+                or self._leaf_block_bytes(len(leaves) + new, shard)
+                + block_bytes > budget):
+            return None
+        return expr, frame_name, ids, call_leaves
 
     _DEVICE_FOLD_OPS = {"Intersect": "and", "Union": "or",
                         "Difference": "andnot"}
@@ -1421,14 +1547,20 @@ class Executor:
 
     def _device_pays(self, mesh, n_rows: int, n_slices: int,
                      cold_rows: int = 0, note: dict | None = None,
-                     streaming: bool = False) -> bool:
+                     streaming: bool = False,
+                     host_rows: int | None = None) -> bool:
         """Calibrated routing veto: False when the host path clearly
         wins for a block of ``n_rows × n_slices`` packed rows on this
         hardware (round 2's c4 showed the static threshold sending
         128-slice Counts to a path 4× slower through the tunnel).
         ``cold_rows`` of those are not device-resident and must be
         packed + uploaded first — through a tunnel that transfer, not
-        the compute, dominates."""
+        the compute, dominates. ``host_rows`` (fused multi-op trees)
+        is the PER-CALL leaf-row sum the host alternative would
+        actually walk — the device block deduplicates shared leaves
+        and pays ONE crossing for the whole tree, so pricing the host
+        on the deduplicated bytes over-charged the mesh leg exactly
+        when fusion helps most."""
         if not self._cost_model_enabled:
             return True
         if self.cost_model is None:
@@ -1441,16 +1573,22 @@ class Executor:
                 return True
         from .ops.packed import WORDS_PER_SLICE
         row_bytes = n_slices * WORDS_PER_SLICE * 4
+        host_bytes = (host_rows * row_bytes if host_rows is not None
+                      else None)
+        # host_bytes travels only when it differs — injected test
+        # models (and the pre-fusion interface) take three args.
+        kw = {"host_bytes": host_bytes} if host_bytes is not None else {}
         pays = self.cost_model.device_pays(
             n_rows * row_bytes, cold_bytes=cold_rows * row_bytes,
-            streaming=streaming)
+            streaming=streaming, **kw)
         if not pays:
             self.cost_vetoes += 1
             if note is not None:
                 # Stamp the host leg's prediction for this query; the
                 # _map_reduce caller records actual-vs-predicted.
                 note["host_pred"] = self.cost_model.predict(
-                    "host", n_rows * row_bytes)
+                    "host", host_bytes if host_bytes is not None
+                    else n_rows * row_bytes)
         return pays
 
     def _timed_device_leg(self, fn, n_rows: int, n_slices: int,
@@ -1519,43 +1657,21 @@ class Executor:
 
     def _leaf_device_array(self, mesh, index: str, leaf: tuple,
                            slices: tuple[int, ...]):
-        """Device-resident [n_slices(+pad), words] slab for one PQL leaf
-        row, held in the budgeted HBM cache (parallel.residency).
+        """Device-resident [bucket(n_slices), words] slab for one PQL
+        leaf row, held in the budgeted HBM cache
+        (parallel.residency.leaf_slab — bucket-padded so the program
+        catalogue's compiled shapes stay stable as slice count grows).
 
         The key embeds every backing fragment's (uid, generation), so
         writes/reopens stop the entry being referenced and it ages out
         of the LRU — repeated Count/TopN over a stable index re-use the
         upload instead of re-packing + re-transferring per query."""
-        from .parallel import mesh as mesh_mod
-        from .parallel.residency import device_cache
+        from .parallel import residency
         frame, view, row_id = leaf
         frags = [self.holder.fragment(index, frame, view, s)
                  for s in slices]
-        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
         key = self._leaf_cache_key(mesh, index, leaf, slices)
-
-        def build():
-            from .ops import packed
-            from .ops.packed import WORDS_PER_SLICE
-            n = len(slices) + (-len(slices) % n_dev)
-            mode = mesh_mod.densify_mode()
-            pairs = [frag.sparse_row_pairs(row_id)
-                     if frag is not None else None for frag in frags]
-            pairs += [None] * (n - len(pairs))
-            if mode is not None:
-                use_sparse, plan = packed.sparse_gate(pairs,
-                                                      WORDS_PER_SLICE)
-                if use_sparse:
-                    subs = WORDS_PER_SLICE // 128
-                    lanes, vals = packed.bucket_prepared(pairs, subs,
-                                                         plan=plan)
-                    return mesh_mod.densify_sharded(
-                        mesh, lanes, vals,
-                        interpret=(mode == "interpret"))
-            block = packed.densify_host(pairs, WORDS_PER_SLICE)
-            return mesh_mod.shard_slices(mesh, block)
-
-        return device_cache().get_or_build(key, build)
+        return residency.leaf_slab(mesh, key, frags, row_id)
 
     # -- TopN (executor.go:271-396) ------------------------------------------
 
@@ -1910,44 +2026,12 @@ class Executor:
         the per-query pack + upload entirely. threshold>1 / tanimoto
         engage the per-slice pruning program (mesh.topn_filtered_sharded)."""
         from .parallel import mesh as mesh_mod
-        from .parallel.residency import device_cache
+        from .parallel import residency
         frags = [self.holder.fragment(index, frame_name, VIEW_STANDARD, s)
                  for s in slices]
-        n_dev = mesh.shape[mesh_mod.AXIS_SLICES]
         key = rows_key if rows_key is not None else self._topn_rows_key(
             mesh, index, frame_name, row_ids, slices)
-
-        def build():
-            from .ops import packed
-            from .ops.packed import WORDS_PER_SLICE
-            n = len(slices) + (-len(slices) % n_dev)
-            # Extract once as sparse (word idx, value) pairs; the gate
-            # then picks the transfer representation — bucketed sparse
-            # + device densify (3-6x cold-upload win at sparse shapes,
-            # benchmarks/DENSIFY.json) or host dense scatter.
-            mode = mesh_mod.densify_mode()
-            pairs: list = []
-            for si in range(n):
-                frag = frags[si] if si < len(frags) else None
-                for rid in row_ids:
-                    pairs.append(None if frag is None
-                                 else frag.sparse_row_pairs(rid))
-            if mode is not None:
-                use_sparse, plan = packed.sparse_gate(pairs,
-                                                      WORDS_PER_SLICE)
-                if use_sparse:
-                    subs = WORDS_PER_SLICE // 128
-                    lanes, vals = packed.bucket_prepared(pairs, subs,
-                                                         plan=plan)
-                    shp = (n, len(row_ids)) + lanes.shape[1:]
-                    return mesh_mod.densify_sharded(
-                        mesh, lanes.reshape(shp), vals.reshape(shp),
-                        interpret=(mode == "interpret"))
-            rows = packed.densify_host(pairs, WORDS_PER_SLICE).reshape(
-                n, len(row_ids), WORDS_PER_SLICE)
-            return mesh_mod.shard_slices(mesh, rows)
-
-        rows_arr = device_cache().get_or_build(key, build)
+        rows_arr = residency.candidate_block(mesh, key, frags, row_ids)
         leaf_arrays = [self._leaf_device_array(mesh, index, leaf, slices)
                        for leaf in leaves]
         if threshold > 1 or tanimoto > 0:

@@ -426,6 +426,11 @@ COMPILE_MISSES = _DEFAULT.counter(
 COMPILE_SECONDS = _DEFAULT.counter(
     "pilosa_compile_cache_build_seconds_total",
     "Wall seconds spent in first-call XLA trace+compile")
+COMPILE_PROGRAMS = _DEFAULT.gauge(
+    "pilosa_compile_cache_programs_live",
+    "Compiled XLA programs held live by the in-process builder caches"
+    " (the shape-stable catalogue keeps this bucket-bound as slice"
+    " count grows)")
 SLOW_QUERIES = _DEFAULT.counter(
     "pilosa_query_slow_total",
     "Queries slower than the configured slow-query threshold")

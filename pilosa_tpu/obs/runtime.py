@@ -179,6 +179,7 @@ class RuntimeCollector:
         obs_metrics.COMPILE_MISSES.set_total(stats.get("misses", 0))
         obs_metrics.COMPILE_SECONDS.set_total(
             stats.get("compileSeconds", 0.0))
+        obs_metrics.COMPILE_PROGRAMS.set(stats.get("programs", 0))
         return stats
 
     def _roaring_ops(self) -> dict:

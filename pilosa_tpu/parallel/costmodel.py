@@ -63,13 +63,18 @@ class Calibration:
     stream_scale: float = 1.0
 
     def device_cost(self, total_bytes: int, cold_bytes: int = 0,
-                    streaming: bool = False) -> float:
+                    streaming: bool = False,
+                    crossings: int = 1) -> float:
         # cold_bytes = data not device-resident: it must be PACKED
         # host-side (roaring → dense words at pack_bps) and shipped at
         # the measured transfer rate (through a tunnel the transfer is
         # the dominant term — ~512 MB of candidate block costs seconds,
         # not the microseconds the HBM term suggests).
-        cost = (self.sync_s + cold_bytes / self.upload_bps
+        # crossings = host↔device round trips the plan actually pays:
+        # a fused multi-op tree (executor._device_batch_run) dispatches
+        # ONE program for the whole tree, so it pays sync_s once — not
+        # once per Count/TopN call the tree contains.
+        cost = (self.sync_s * crossings + cold_bytes / self.upload_bps
                 + cold_bytes / self.pack_bps
                 + total_bytes / DEVICE_BPS) * self.device_scale
         if streaming:
@@ -137,10 +142,25 @@ class CostModel:
                    "device_stream": "stream_scale"}
 
     def device_pays(self, total_bytes: int, cold_bytes: int = 0,
-                    streaming: bool = False) -> bool:
-        """False only when the host path is a clear predicted win."""
-        host = self.cal.host_cost(total_bytes)
-        device = self.cal.device_cost(total_bytes, cold_bytes, streaming)
+                    streaming: bool = False,
+                    host_bytes: int | None = None,
+                    crossings: int = 1) -> bool:
+        """False only when the host path is a clear predicted win.
+
+        ``host_bytes`` prices the host alternative on ITS real byte
+        walk when it differs from the device operand block — a fused
+        multi-op tree deduplicates shared leaf slabs on device, while
+        the per-call host path re-walks each call's leaves (and packs
+        every TopN candidate row); pricing both sides on the
+        deduplicated block systematically over-charged the mesh leg
+        for exactly the multi-op queries fusion accelerates.
+        ``crossings`` is the number of device dispatches the plan pays
+        (1 for a fused tree, whatever the chunk loop needs otherwise).
+        """
+        host = self.cal.host_cost(
+            host_bytes if host_bytes is not None else total_bytes)
+        device = self.cal.device_cost(total_bytes, cold_bytes,
+                                      streaming, crossings=crossings)
         return host >= self.margin * device
 
     def predict(self, leg: str, total_bytes: int,

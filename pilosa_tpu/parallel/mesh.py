@@ -15,7 +15,17 @@ Axis conventions:
   analogue); per-row counts are psum'd over ``slices``, gathered over
   ``rows`` for the final top-k.
 
-All entry points compile once per (mesh, shape, op) and cache.
+Program forms: the XLA serving path (the recorded A/B winner, and the
+only path off-TPU) compiles through the shape-stable **global-view
+catalogue** in ``parallel.programs`` — plain ``jax.jit`` over globally
+sharded arrays with explicit ``NamedSharding`` placement, slice axes
+padded to canonical buckets (``programs.slice_bucket``) so the compile
+count is bucket-bound instead of scaling with slice count, and the
+final Count/TopN reduction is an in-program all-reduce. The Pallas
+fused kernels keep their per-shard ``shard_map`` form here
+(``pallas_call`` is a per-shard primitive); the dispatch entry points
+pick per backend. Both forms share one compile-accounting wrapper
+(``_finalize_program``) and one public entry-point surface.
 """
 
 from __future__ import annotations
@@ -99,35 +109,72 @@ def _legacy_locked(fn):
 
 _COMPILE_MU = threading.Lock()
 _COMPILE_STATS = {"programsBuilt": 0, "firstCalls": 0,
-                  "compileSeconds": 0.0}
+                  "compileSeconds": 0.0,
+                  # Persistent on-disk cache outcomes (jax monitoring
+                  # events, counted once arm_compile_cache registers
+                  # the listener): a restarted process whose programs
+                  # load from disk shows HITS here — the direct answer
+                  # to "did the cache survive the restart".
+                  "persistentHits": 0, "persistentMisses": 0}
+
+
+def _on_jax_cache_event(event: str, **kwargs) -> None:
+    if event.endswith("/cache_hits"):
+        with _COMPILE_MU:
+            _COMPILE_STATS["persistentHits"] += 1
+    elif event.endswith("/cache_misses"):
+        with _COMPILE_MU:
+            _COMPILE_STATS["persistentMisses"] += 1
 
 
 def _finalize_program(fn):
-    """Builder epilogue: legacy-dispatch lock + first-call compile
-    accounting. The first invocation of the returned program is timed
-    (that call includes the XLA trace+compile) and recorded as an
-    ``xla_compile`` span on any traced query that triggers it."""
+    """Builder epilogue: legacy-dispatch lock + compile accounting.
+
+    Accounting is per XLA COMPILATION, not per builder run: a jitted
+    program re-traces for every distinct input shape, so before the
+    bucket-stable catalogue a program serving 8, 12, 16... slices paid
+    (and hid) one compile per slice count. The wrapper detects a
+    compile by the jitted cache growing across the call
+    (``_cache_size``) and charges its wall time to ``firstCalls`` /
+    ``compileSeconds`` — making "compile count stays bucket-bound as
+    slice count grows" an assertable number. The predicted first call
+    additionally records an ``xla_compile`` span on any traced query
+    that triggers it."""
+    jitted = fn  # the jax.jit object (cache-size introspection)
     fn = _legacy_locked(fn)
     with _COMPILE_MU:
         _COMPILE_STATS["programsBuilt"] += 1
+    sized = hasattr(jitted, "_cache_size")
     state = {"first": True}
 
     @functools.wraps(fn)
     def program(*args, **kwargs):
-        if state["first"]:
+        first = state["first"]
+        try:
+            pre = jitted._cache_size() if sized else None
+        except Exception:  # noqa: BLE001 - introspection only
+            pre = None
+        t0 = time.perf_counter()
+        if first:
             state["first"] = False  # benign race: double-count at worst
-            t0 = time.perf_counter()
             with obs_trace.span_current("xla_compile"):
                 out = fn(*args, **kwargs)
-            dt = time.perf_counter() - t0
+        else:
+            out = fn(*args, **kwargs)
+        dt = time.perf_counter() - t0
+        try:
+            compiled = (jitted._cache_size() > pre if pre is not None
+                        else first)
+        except Exception:  # noqa: BLE001 - introspection only
+            compiled = first
+        if compiled:
             with _COMPILE_MU:
                 _COMPILE_STATS["firstCalls"] += 1
                 _COMPILE_STATS["compileSeconds"] += dt
             # Attribute the trace+compile to the query that paid it
             # (obs.accounting: compileMs in its cost ledger).
             _accounting.note_compile(dt)
-            return out
-        return fn(*args, **kwargs)
+        return out
 
     return program
 
@@ -143,12 +190,26 @@ def _note_dispatch(*operands) -> None:
             sum(int(getattr(a, "nbytes", 0)) for a in operands))
 
 
+def _all_program_caches():
+    """Every lru_cache'd builder across the shard_map forms here AND
+    the global-view catalogue (parallel.programs) — resolved lazily so
+    either module can import first."""
+    caches = list(_PROGRAM_CACHES)
+    try:
+        from . import programs as programs_mod
+        caches.extend(programs_mod.PROGRAM_CACHES)
+    except (ImportError, AttributeError):
+        pass  # partial init during circular import: mesh's own caches
+    return caches
+
+
 def compile_stats() -> dict:
     """Aggregate XLA program-cache counters: lookup hits/misses over
-    every lru_cache'd builder, live program count, and the first-call
-    compile totals."""
+    every lru_cache'd builder, live program count, the first-call
+    compile totals, and the armed persistent-cache directory (None =
+    cross-process reuse off)."""
     hits = misses = programs = 0
-    for cache in _PROGRAM_CACHES:
+    for cache in _all_program_caches():
         info = cache.cache_info()
         hits += info.hits
         misses += info.misses
@@ -157,6 +218,7 @@ def compile_stats() -> dict:
         stats = dict(_COMPILE_STATS)
     stats["compileSeconds"] = round(stats["compileSeconds"], 3)
     return {"hits": hits, "misses": misses, "programs": programs,
+            "persistentCacheDir": _compile_cache_dir,
             **stats}
 
 
@@ -189,37 +251,62 @@ def _rows_popcount(expr, leaves, mode):
 
 
 _compile_cache_armed = False
+_compile_cache_dir: str | None = None
 
 
-def _arm_compile_cache() -> None:
+def arm_compile_cache(path: str | None = None) -> str | None:
     """Enable JAX's persistent compilation cache before first device
-    use: measured 3.6x faster re-compiles across process restarts
-    through the tunnel's compile server (0.73 s → 0.20 s for a count
-    program), which is most of a cold server's first-query latency.
-    PILOSA_TPU_COMPILE_CACHE overrides the location; =0 disables."""
-    global _compile_cache_armed
+    use, so a RESTARTED process reuses on-disk compiled programs
+    instead of re-paying the multi-second trace+compile (VERDICT weak
+    #2: the canonical pass measured a 5.4 s first device query; with
+    the cache hitting, a second process compiles the same program in a
+    fraction — measured 3.6x faster through the tunnel's compile
+    server, and ~2.5x on the CPU backend).
+
+    ``path`` is the caller's default location — the server passes a
+    directory under the holder data dir, so the cache lives (and is
+    cleaned up) with the index it serves. Priority:
+    PILOSA_TPU_COMPILE_CACHE env (``=0`` disables) > explicit ``path``
+    > the per-machine cache dir on TPU only (CPU runs without an
+    explicit path — tests, dev shells — must not silently grow a
+    home-dir cache). First armer wins (jax.config is process-global);
+    returns the armed directory or None."""
+    global _compile_cache_armed, _compile_cache_dir
     if _compile_cache_armed:
-        return
+        return _compile_cache_dir
     _compile_cache_armed = True
     import os
 
     from ..utils import cache_dir
-    path = os.environ.get("PILOSA_TPU_COMPILE_CACHE")
-    if path == "0":
-        return
+    env = os.environ.get("PILOSA_TPU_COMPILE_CACHE")
+    if env == "0":
+        return None
+    path = env or path
     if not path:
         if jax.devices()[0].platform != "tpu":
-            # The win is the TPU tunnel's compile server; CPU runs
-            # (tests, dev) should not silently grow a home-dir cache.
-            return
+            return None
         path = cache_dir("xla")
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", 0.1)
+        _compile_cache_dir = path
     except Exception:  # noqa: BLE001 - cache is an optimization only
+        return _compile_cache_dir
+    try:
+        # Count on-disk cache outcomes (hit = a compile served from
+        # disk) into compile_stats — the observable that proves a
+        # second process reused the first one's compilations.
+        from jax._src import monitoring as _jax_monitoring
+        _jax_monitoring.register_event_listener(_on_jax_cache_event)
+    except Exception:  # noqa: BLE001 - private API, visibility only
         pass
+    return _compile_cache_dir
+
+
+def _arm_compile_cache() -> None:
+    arm_compile_cache(None)
 
 
 def make_mesh(n_devices: int | None = None, rows: int = 1) -> Mesh:
@@ -356,7 +443,7 @@ def _count_expr_fn_cached(mesh: Mesh, expr: tuple, mode: str | None):
 
 
 def count_expr_fn(mesh: Mesh, expr: tuple):
-    """[L, S, W] leaf blocks → stacked [2] (hi, lo) 16-bit halves of
+    """[L, S, W] leaf blocks → stacked (hi, lo) 16-bit halves of
     the expression bitmap's count (decode via hilo_combine — ONE
     output array = one host fetch).
 
@@ -364,14 +451,19 @@ def count_expr_fn(mesh: Mesh, expr: tuple):
     ``(op, a, b)`` combines subtrees with a bitwise op from kernels._BITWISE.
     One jitted SPMD program per (mesh, expr) — the whole PQL bitmap
     expression (e.g. Count(Intersect(Bitmap, Bitmap))) is evaluated
-    elementwise over every slice at once and reduced with a single psum,
+    elementwise over every slice at once and reduced in-program,
     replacing the reference's per-slice goroutine map + sum reduce
     (executor.go:568-597,1103-1236). On TPU the per-shard body is the
     fused Pallas expression-count kernel (ops.pallas_kernels); elsewhere
-    XLA fusion. Public: the pod layer (parallel.multihost) feeds these
-    programs process-local shards.
+    the global-view catalogue program (parallel.programs). Public: the
+    pod layer (parallel.multihost) feeds these programs process-local
+    shards.
     """
-    return _count_expr_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
+    mode = _mesh_pallas_mode(mesh)
+    if mode is None:
+        from . import programs as programs_mod
+        return programs_mod.count_exprs_block_program(mesh, (expr,))
+    return _count_expr_fn_cached(mesh, expr, mode)
 
 
 def _exprs_hi_lo(exprs, leaves, mode):
@@ -412,7 +504,11 @@ def count_exprs_fn(mesh: Mesh, exprs: tuple):
     """K-expression batch form of count_expr_fn: ``[L, S, W]`` shared
     leaf block → stacked [2, K] (hi, lo) 16-bit halves, one program =
     one host fetch. Public for the pod layer (parallel.multihost)."""
-    return _count_exprs_fn_cached(mesh, exprs, _mesh_pallas_mode(mesh))
+    mode = _mesh_pallas_mode(mesh)
+    if mode is None:
+        from . import programs as programs_mod
+        return programs_mod.count_exprs_block_program(mesh, exprs)
+    return _count_exprs_fn_cached(mesh, exprs, mode)
 
 
 def slice_chunk_bound(n_dev: int) -> int:
@@ -425,11 +521,14 @@ def slice_chunk_bound(n_dev: int) -> int:
 def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
     """Count the bitmap expression over slice-sharded leaf blocks.
 
-    ``leaves`` is ``[n_leaves, n_slices, n_words]`` u32; slices are padded
-    to the mesh and chunked at the hi/lo int32 bound, so any slice
-    count works.
+    ``leaves`` is ``[n_leaves, n_slices, n_words]`` u32; slices are
+    padded to the canonical bucket (programs.slice_bucket — zero slices
+    are the count identity, and bucket-stable shapes keep the compile
+    count bucket-bound) and chunked at the hi/lo int32 bound, so any
+    slice count works.
     """
     _dispatch_gate()
+    from . import programs as programs_mod
     n_dev = mesh.shape[AXIS_SLICES]
     fn = count_expr_fn(mesh, expr)
     total = 0
@@ -437,11 +536,8 @@ def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
     with obs_trace.span_current("mesh_dispatch", kind="count_expr",
                                 slices=int(leaves.shape[1])):
         for off in range(0, leaves.shape[1], step):
-            chunk = leaves[:, off:off + step]
-            rem = chunk.shape[1] % n_dev
-            if rem:
-                pad = [(0, 0), (0, n_dev - rem), (0, 0)]
-                chunk = np.pad(chunk, pad)
+            chunk = programs_mod.bucket_pad(
+                leaves[:, off:off + step], 1, n_dev)
             # Per chunk: each loop pass dispatches one program.
             _note_dispatch(chunk)
             total += hilo_combine(
@@ -515,8 +611,14 @@ def count_exprs_sharded(mesh: Mesh, exprs: tuple,
             mesh.shape[AXIS_SLICES]):
         raise ValueError("count_exprs_sharded: slice count above the"
                          " int32 hi/lo bound")
-    fn = _count_exprs_sharded_fn(mesh, exprs, len(leaf_arrays),
-                                 _mesh_pallas_mode(mesh))
+    mode = _mesh_pallas_mode(mesh)
+    if mode is None:
+        from . import programs as programs_mod
+        fn = programs_mod.count_exprs_program(mesh, exprs,
+                                              len(leaf_arrays))
+    else:
+        fn = _count_exprs_sharded_fn(mesh, exprs, len(leaf_arrays),
+                                     mode)
     _note_dispatch(*leaf_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="count_exprs",
                                 exprs=len(exprs),
@@ -533,6 +635,51 @@ def count_expr_sharded(mesh: Mesh, expr: tuple,
     inside the compiled program. The K=1 form of count_exprs_sharded.
     """
     return count_exprs_sharded(mesh, (expr,), leaf_arrays)[0]
+
+
+def fused_tree_sharded(mesh: Mesh, count_exprs: tuple,
+                       topn_items: list[tuple],
+                       leaf_arrays: list[jax.Array],
+                       rows_arrays: list[jax.Array]
+                       ) -> tuple[list[int], list[list[int]]]:
+    """A whole multi-op PQL tree — K expression Counts plus M TopN
+    exact-count blocks — as ONE compiled XLA computation over shared
+    device-resident leaf slabs: one dispatch, one in-program reduction,
+    one host fetch (``[2, K + Σ rows]`` hi/lo halves) for everything
+    the tree needs. ``topn_items`` is ``[(expr, n_rows), ...]`` with
+    ``rows_arrays[i]`` the matching [S, R_i, W] resident candidate
+    block. Returns (count values, per-TopN count lists).
+
+    This is the fix for the config 4-5 loss (VERDICT weak #6): the old
+    lane paid one host↔device sync per *call*; a tree pays one.
+    XLA-path only — the executor's batch lane falls back per call on
+    Pallas meshes (where the per-kind shard_map programs serve).
+    """
+    _dispatch_gate()
+    if leaf_arrays and leaf_arrays[0].shape[0] > slice_chunk_bound(
+            mesh.shape[AXIS_SLICES]):
+        raise ValueError("fused_tree_sharded: slice count above the"
+                         " int32 hi/lo bound")
+    from . import programs as programs_mod
+    fn = programs_mod.fused_program(
+        mesh, tuple(count_exprs),
+        tuple((expr, int(rows.shape[1]))
+              for (expr, _), rows in zip(topn_items, rows_arrays)),
+        len(leaf_arrays))
+    _note_dispatch(*leaf_arrays, *rows_arrays)
+    with obs_trace.span_current("mesh_dispatch", kind="fused_tree",
+                                exprs=len(count_exprs),
+                                topns=len(topn_items),
+                                leaves=len(leaf_arrays)):
+        flat = hilo_combine(fn(*leaf_arrays, *rows_arrays))
+    counts = flat[:len(count_exprs)]
+    out_topn: list[list[int]] = []
+    off = len(count_exprs)
+    for rows in rows_arrays:
+        n = int(rows.shape[1])
+        out_topn.append(flat[off:off + n])
+        off += n
+    return counts, out_topn
 
 
 @functools.lru_cache(maxsize=256)
@@ -641,8 +788,14 @@ def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
     if rows.shape[0] > slice_chunk_bound(mesh.shape[AXIS_SLICES]):
         raise ValueError("topn_filtered_sharded: slice count above the"
                          " int32 hi/lo bound")
-    fn = _topn_filtered_sharded_fn(mesh, expr, len(leaf_arrays),
-                                   _mesh_pallas_mode(mesh))
+    mode = _mesh_pallas_mode(mesh)
+    if mode is None:
+        from . import programs as programs_mod
+        fn = programs_mod.topn_program(mesh, expr, len(leaf_arrays),
+                                       filtered=True)
+    else:
+        fn = _topn_filtered_sharded_fn(mesh, expr, len(leaf_arrays),
+                                       mode)
     threshold = min(threshold, 2**31 - 1)  # counts never exceed 2^31
     _note_dispatch(rows, *leaf_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="topn_filtered",
@@ -663,8 +816,13 @@ def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
     if rows.shape[0] > slice_chunk_bound(mesh.shape[AXIS_SLICES]):
         raise ValueError("topn_exact_sharded: slice count above the"
                          " int32 hi/lo bound — use topn_exact")
-    fn = _topn_exact_sharded_fn(mesh, expr, len(leaf_arrays),
-                                _mesh_pallas_mode(mesh))
+    mode = _mesh_pallas_mode(mesh)
+    if mode is None:
+        from . import programs as programs_mod
+        fn = programs_mod.topn_program(mesh, expr, len(leaf_arrays),
+                                       filtered=False)
+    else:
+        fn = _topn_exact_sharded_fn(mesh, expr, len(leaf_arrays), mode)
     _note_dispatch(rows, *leaf_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="topn_exact",
                                 rows=int(rows.shape[1])):
@@ -755,9 +913,14 @@ def topn_filtered_fn(mesh: Mesh, expr):
     """The streaming-layout filtered TopN program: ``(threshold,
     tanimoto, rows [S, R, W], leaves [L, S, W]) → stacked [2, R]
     per-row (hi, lo)`` (decode via hilo_combine),
-    with per-slice threshold/Tanimoto pruning before the psum. Public
-    for the pod layer (parallel.multihost), like topn_exact_fn."""
-    return _topn_filtered_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
+    with per-slice threshold/Tanimoto pruning before the reduction.
+    Public for the pod layer (parallel.multihost), like topn_exact_fn."""
+    mode = _mesh_pallas_mode(mesh)
+    if mode is None:
+        from . import programs as programs_mod
+        return programs_mod.topn_block_program(mesh, expr,
+                                               filtered=True)
+    return _topn_filtered_fn_cached(mesh, expr, mode)
 
 
 def topn_exact_fn(mesh: Mesh, expr):
@@ -774,18 +937,12 @@ def topn_exact_fn(mesh: Mesh, expr):
     TopN block kernel. Public: the pod layer (parallel.multihost)
     feeds these programs process-local shards.
     """
-    return _topn_exact_fn_cached(mesh, expr, _mesh_pallas_mode(mesh))
-
-
-@functools.lru_cache(maxsize=256)
-def _materialize_fn(mesh: Mesh, expr, n_leaves: int):
-    def per_shard(*leaf_shards):  # each [S/n, W]
-        return _eval_expr(expr, jnp.stack(leaf_shards))
-
-    return _finalize_program(jax.jit(_shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(P(AXIS_SLICES),) * n_leaves,
-        out_specs=P(AXIS_SLICES))))
+    mode = _mesh_pallas_mode(mesh)
+    if mode is None:
+        from . import programs as programs_mod
+        return programs_mod.topn_block_program(mesh, expr,
+                                               filtered=False)
+    return _topn_exact_fn_cached(mesh, expr, mode)
 
 
 def materialize_expr_sharded(mesh: Mesh, expr,
@@ -793,33 +950,18 @@ def materialize_expr_sharded(mesh: Mesh, expr,
     """[S, W] dense words of the expression bitmap: one sharded device
     fold over the leaf slabs (the materializing form of count_expr —
     BASELINE config 2's Union/Difference over many rows), fetched to
-    host for roaring repack. No psum → no slice-count bound; wide folds
-    reduce associatively on device (_eval_expr's lax.reduce path).
+    host for roaring repack. No count reduction → no slice-count bound;
+    wide folds reduce associatively on device (_eval_expr's lax.reduce
+    path). Always the global-view catalogue program (no Pallas body
+    exists for materialization).
     """
     _dispatch_gate()
-    fn = _materialize_fn(mesh, expr, len(leaf_arrays))
+    from . import programs as programs_mod
+    fn = programs_mod.materialize_program(mesh, expr, len(leaf_arrays))
     _note_dispatch(*leaf_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="materialize",
                                 leaves=len(leaf_arrays)):
         return np.asarray(fn(*leaf_arrays))
-
-
-@functools.lru_cache(maxsize=256)
-def _bsi_range_fn(mesh: Mesh, op: str, n_leaves: int):
-    from ..ops import kernels
-
-    def per_shard(pbits, pbits2, *plane_shards):  # each [S/n, W]
-        planes = jnp.stack(plane_shards)  # [depth+1, S/n, W]
-        if op == "><":
-            ge = kernels.bsi_compare_select(">=", pbits, planes)
-            le = kernels.bsi_compare_select("<=", pbits2, planes)
-            return jnp.bitwise_and(ge, le)
-        return kernels.bsi_compare_select(op, pbits, planes)
-
-    return _finalize_program(jax.jit(_shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(P(), P()) + (P(AXIS_SLICES),) * n_leaves,
-        out_specs=P(AXIS_SLICES))))
 
 
 def bsi_range_sharded(mesh: Mesh, op: str, upred, depth: int,
@@ -843,7 +985,8 @@ def bsi_range_sharded(mesh: Mesh, op: str, upred, depth: int,
     else:
         pbits = kernels.bsi_predicate_bits(upred, depth)
         pbits2 = np.zeros(depth, dtype=np.uint32)
-    fn = _bsi_range_fn(mesh, op, len(plane_arrays))
+    from . import programs as programs_mod
+    fn = programs_mod.bsi_range_program(mesh, op, len(plane_arrays))
     _note_dispatch(*plane_arrays)
     with obs_trace.span_current("mesh_dispatch", kind="bsi_range",
                                 depth=depth):
@@ -878,6 +1021,7 @@ def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
                                jnp.int32(threshold), jnp.int32(tanimoto))
     else:
         fn = topn_exact_fn(mesh, expr)
+    from . import programs as programs_mod
     n_slices, n_rows, n_words = rows.shape
     slice_chunk = min(slice_chunk_bound(n_dev), n_slices) or 1
     row_chunk = max(1, TOPN_BLOCK_BYTES // (slice_chunk * n_words * 4))
@@ -890,10 +1034,11 @@ def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
             rc = rows[s_off:s_off + slice_chunk, r_off:r_off + row_chunk]
             lcc = lc if lc is not None else \
                 np.zeros((0, rc.shape[0], 1), dtype=np.uint32)
-            rem = rc.shape[0] % n_dev
-            if rem:
-                rc = np.pad(rc, [(0, n_dev - rem), (0, 0), (0, 0)])
-                lcc = np.pad(lcc, [(0, 0), (0, n_dev - rem), (0, 0)])
+            # Bucket-stable slice padding: zero slices are the count
+            # identity, and the bucketed shape reuses one compiled
+            # program across nearby slice counts.
+            rc = programs_mod.bucket_pad(rc, 0, n_dev)
+            lcc = programs_mod.bucket_pad(lcc, 1, n_dev)
             counts = hilo_combine(fn(shard_slices(mesh, rc),
                                      shard_slices_axis1(mesh, lcc)))
             for r in range(rc.shape[1]):
@@ -978,12 +1123,14 @@ def query_step(mesh: Mesh, a: jax.Array, b: jax.Array, rows: jax.Array,
     n_i, n_u, vals, ids = _query_step_fn(mesh, k)(a, b, rows)
     return int(n_i), int(n_u), np.asarray(vals), np.asarray(ids)
 
-# Every lru_cache'd program builder, for compile_stats()'s hit/miss
-# aggregation (populated after all builders are defined).
+# Every lru_cache'd shard_map program builder still hosted here, for
+# compile_stats()'s hit/miss aggregation (the global-view catalogue's
+# caches live in parallel.programs.PROGRAM_CACHES and are folded in by
+# _all_program_caches()).
 _PROGRAM_CACHES = (
     _densify_sharded_fn, _count_fn, _count_expr_fn_cached,
     _count_exprs_fn_cached, _count_exprs_sharded_fn,
     _topn_exact_sharded_fn, _topn_filtered_sharded_fn,
-    _materialize_fn, _bsi_range_fn, _topn_exact_fn_cached,
-    _topn_filtered_fn_cached, _topn_fn, _query_step_fn,
+    _topn_exact_fn_cached, _topn_filtered_fn_cached, _topn_fn,
+    _query_step_fn,
 )

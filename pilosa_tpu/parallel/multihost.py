@@ -143,17 +143,30 @@ def _assert_uniform_shards(*dims: int) -> None:
 
 
 def _pad_local(local: np.ndarray, axis: int) -> np.ndarray:
-    """Pad this process's shard so every process contributes the same
-    number of slice rows per device. Zero slices are the identity for
-    every count/TopN reduction, so the result is exact even though the
-    zeros interleave between process ranges in the global order."""
+    """Pad this process's shard to its canonical slice BUCKET
+    (parallel.programs.slice_bucket over the per-process device count),
+    so every process contributes the same number of slice rows per
+    device AND the assembled global array has a bucket-stable shape —
+    the pod reuses one compiled program as the index grows within a
+    bucket, exactly like the single-host path. Zero slices are the
+    identity for every count/TopN reduction, so the result is exact
+    even though the zeros interleave between process ranges in the
+    global order. Deterministic from the shard length alone, so every
+    process picks the same bucket (the shard-uniformity allgather has
+    already pinned the lengths equal)."""
+    from . import programs
     per_dev = len(jax.devices()) // jax.process_count()
-    rem = local.shape[axis] % per_dev
-    if rem == 0 and local.shape[axis] > 0:
+    target = programs.slice_bucket(local.shape[axis], per_dev)
+    # The GLOBAL row count (target × n_procs) must stay within the
+    # int32 hi/lo split; past the cap fall back to plain device-
+    # multiple padding (the chunk loops bound the shard anyway).
+    if target * jax.process_count() > (1 << 15):
+        n = local.shape[axis]
+        target = (n + (-n % per_dev)) or per_dev
+    if local.shape[axis] == target:
         return local
-    pad_n = per_dev - rem if local.shape[axis] else per_dev
     pad = [(0, 0)] * local.ndim
-    pad[axis] = (0, pad_n)
+    pad[axis] = (0, target - local.shape[axis])
     return np.pad(local, pad)
 
 

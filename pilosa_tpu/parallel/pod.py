@@ -14,8 +14,13 @@ pod serve PQL through the ordinary Server/Executor stack:
   (expression tree + leaf descriptors + the global slice list) to every
   worker process over HTTP, then all processes pack their owned slices
   and enter the SAME SPMD collective together
-  (parallel.multihost.count_expr / topn_exact) — the psum spans every
-  chip in the pod. Workers run the item from the ``/pod/exec`` route.
+  (parallel.multihost.count_expr / topn_exact) — the in-program
+  reduction spans every chip in the pod. Workers run the item from the
+  ``/pod/exec`` route. The programs are the single-host catalogue's
+  (parallel.programs): multihost pads each process's shard to its
+  canonical slice bucket, so the pod compiles once per bucket and the
+  identical jitted computation lowers unchanged from one host to the
+  whole pod.
 - Host-path reads (Bitmap/Range materialization, TopN candidate phase)
   and writes route within the pod over HTTP as ``podLocal`` query legs:
   the executor partitions slices by owner process and the owning
@@ -126,7 +131,10 @@ class Pod:
         """This process's shard of the item's slice list, padded with -1
         (absent → zero slices, the identity for every reduction) so all
         processes feed identically-shaped shards to the collective —
-        deterministic from the item alone, so every process agrees."""
+        deterministic from the item alone, so every process agrees.
+        (multihost._pad_local then pads the packed shard to its slice
+        BUCKET, so the collective program shape — and hence the compile
+        count — is stable as the index grows within a bucket.)"""
         per = self.max_shard_slices(slices)
         mine = self.owned(slices)
         return mine + [-1] * (per - len(mine))
@@ -356,7 +364,8 @@ class Pod:
     def count_exprs(self, index: str, exprs: list[tuple],
                     leaves: list[tuple], slices: list[int]) -> list[int]:
         """K batched Counts in one pod collective (one work item, one
-        dispatch) — the pod form of executor._count_batch_run."""
+        dispatch) — the pod form of executor._device_batch_run's
+        counts-only lane."""
         if not slices:
             return [0] * len(exprs)
         return self._dispatch({

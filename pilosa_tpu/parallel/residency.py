@@ -20,6 +20,13 @@ Staleness is handled by keys, not callbacks: every cached block's key
 embeds the owning fragments' ``(uid, generation)`` pairs — writes bump
 the generation, fragment reopen mints a fresh uid — so stale entries
 simply stop being referenced and age out of the LRU.
+
+Upload layout: the globally-sharded slab builders (``leaf_slab``,
+``candidate_block``) pad the slice axis to its canonical bucket
+(parallel.programs.slice_bucket) before the device_put, so every
+resident array already has the bucket-stable shape the program
+catalogue compiles for — growing an index within a bucket re-uses both
+the compiled programs AND the upload path's shapes.
 """
 
 from __future__ import annotations
@@ -129,6 +136,91 @@ def device_cache() -> DeviceBlockCache:
         if _device_cache is None:
             _device_cache = DeviceBlockCache()
         return _device_cache
+
+
+def _bucketed_slices(mesh, n_slices: int) -> int:
+    """The bucket-padded slice count an upload for ``n_slices`` uses
+    (zero slices are the identity for every count/TopN reduction)."""
+    from . import mesh as mesh_mod
+    from . import programs
+    return programs.slice_bucket(n_slices,
+                                 mesh.shape[mesh_mod.AXIS_SLICES])
+
+
+def leaf_slab(mesh, key: tuple, frags: list, row_id: int) -> jax.Array:
+    """Device-resident ``[bucket(n_slices), words]`` slab of one PQL
+    leaf row across ``frags`` (one fragment per slice, None = absent =
+    zero words), globally sharded over the slice axis and held in the
+    budgeted HBM cache under ``key``.
+
+    The caller owns the key contract (executor embeds every backing
+    fragment's (uid, generation), so writes/reopens age entries out of
+    the LRU); this builder owns the transfer: sparse-gate → bucketed
+    sparse upload + on-device densify when it wins, dense host pack
+    otherwise — always at the bucket-padded, program-stable shape."""
+    from . import mesh as mesh_mod
+
+    def build():
+        from ..ops import packed
+        n = _bucketed_slices(mesh, len(frags))
+        mode = mesh_mod.densify_mode()
+        pairs = [frag.sparse_row_pairs(row_id)
+                 if frag is not None else None for frag in frags]
+        pairs += [None] * (n - len(pairs))
+        if mode is not None:
+            use_sparse, plan = packed.sparse_gate(
+                pairs, packed.WORDS_PER_SLICE)
+            if use_sparse:
+                subs = packed.WORDS_PER_SLICE // 128
+                lanes, vals = packed.bucket_prepared(pairs, subs,
+                                                     plan=plan)
+                return mesh_mod.densify_sharded(
+                    mesh, lanes, vals, interpret=(mode == "interpret"))
+        block = packed.densify_host(pairs, packed.WORDS_PER_SLICE)
+        return mesh_mod.shard_slices(mesh, block)
+
+    return device_cache().get_or_build(key, build)
+
+
+def candidate_block(mesh, key: tuple, frags: list,
+                    row_ids: tuple) -> jax.Array:
+    """Device-resident ``[bucket(n_slices), n_rows, words]`` TopN
+    candidate block (same key/staleness contract as ``leaf_slab``),
+    bucket-padded and slice-sharded — repeat TopN queries skip the
+    per-query pack + upload entirely."""
+    from . import mesh as mesh_mod
+
+    def build():
+        from ..ops import packed
+        n = _bucketed_slices(mesh, len(frags))
+        # Extract once as sparse (word idx, value) pairs; the gate
+        # then picks the transfer representation — bucketed sparse +
+        # device densify (3-6x cold-upload win at sparse shapes,
+        # benchmarks/DENSIFY.json) or host dense scatter.
+        mode = mesh_mod.densify_mode()
+        pairs: list = []
+        for si in range(n):
+            frag = frags[si] if si < len(frags) else None
+            for rid in row_ids:
+                pairs.append(None if frag is None
+                             else frag.sparse_row_pairs(rid))
+        if mode is not None:
+            use_sparse, plan = packed.sparse_gate(
+                pairs, packed.WORDS_PER_SLICE)
+            if use_sparse:
+                subs = packed.WORDS_PER_SLICE // 128
+                lanes, vals = packed.bucket_prepared(pairs, subs,
+                                                     plan=plan)
+                shp = (n, len(row_ids)) + lanes.shape[1:]
+                return mesh_mod.densify_sharded(
+                    mesh, lanes.reshape(shp), vals.reshape(shp),
+                    interpret=(mode == "interpret"))
+        rows = packed.densify_host(
+            pairs, packed.WORDS_PER_SLICE).reshape(
+                n, len(row_ids), packed.WORDS_PER_SLICE)
+        return mesh_mod.shard_slices(mesh, rows)
+
+    return device_cache().get_or_build(key, build)
 
 
 class DeviceRowCache:

@@ -25,6 +25,12 @@ os.environ.setdefault("PILOSA_TPU_COST_MODEL", "0")
 # for one real server, a tax on the dozens the suite spawns. Warmup
 # behavior is tested explicitly (tests/test_sched.py enables it).
 os.environ.setdefault("PILOSA_TPU_WARMUP", "0")
+# Servers arm the persistent XLA compile cache under their data dir —
+# real servers want it, but the suite's servers live in tmp dirs that
+# are deleted mid-process (jax.config is process-global, so the FIRST
+# server's dir would stick for the whole run). Cache behavior is
+# tested explicitly in subprocesses (tests/test_programs.py).
+os.environ.setdefault("PILOSA_TPU_COMPILE_CACHE", "0")
 
 import jax  # noqa: E402
 

@@ -780,8 +780,16 @@ class TestWarmup:
             s.warmup.wait(timeout=120)
             status = http_get(s.host, "/status")
             assert status["warmup"]["state"] == "done", status["warmup"]
-            assert set(status["warmup"]["compiled"]) == {
-                "count_fold", "topn_exact", "bsi_compare_select"}
+            from pilosa_tpu.parallel import programs
+            assert set(status["warmup"]["compiled"]) == set(
+                programs.CATALOGUE)
+            cov = status["warmup"]["coverage"]
+            assert cov["warmed"] == cov["programs"] == len(
+                programs.CATALOGUE)
+            assert cov["missing"] == []
+            # An empty holder warms at the minimum bucket (= the
+            # device count); real servers key it off max_slice.
+            assert status["warmup"]["bucket"] >= 1
         finally:
             s.close()
 
