@@ -270,6 +270,19 @@ def main() -> None:
                            "deviceBytes": c.get("deviceBytes", 0),
                            "compileMs": c.get("compileMs", 0.0)}
                     for name, c in qc.items()}
+            # Run-container mix on the run-heavy workload
+            # (suite.config_container_mix): run-op share, resident
+            # bytes vs the two-kind baseline, p50 ratio — ROADMAP
+            # item 4's acceptance numbers on the line of record.
+            cm = manifest.get("container_mix") or {}
+            if cm.get("runs"):
+                line["container_mix"] = {
+                    "run_op_share": cm["runs"].get("run_op_share"),
+                    "resident_bytes_ratio": cm.get(
+                        "resident_bytes_ratio"),
+                    "p50_ratio": cm.get("p50_ratio"),
+                    "runs_p50_ms": cm["runs"].get("p50_ms"),
+                    "containers": cm["runs"].get("containers")}
         except (OSError, ValueError, KeyError):
             pass
         # Serving-quality artifact (sched subsystem): open-loop

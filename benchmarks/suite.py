@@ -139,6 +139,11 @@ def write_manifest() -> None:
     # roofline constants (benchmarks/roofline.py) ride the manifest;
     # a pass that skipped either carries the prior values forward.
     out["query_cost"] = _QUERY_COST or prior_doc.get("query_cost", {})
+    # Run-container mix on the run-heavy workload
+    # (config_container_mix): run-op share, resident bytes vs the
+    # two-kind baseline, p50 — ROADMAP item 4's acceptance artifact.
+    out["container_mix"] = (_CONTAINER_MIX
+                            or prior_doc.get("container_mix", {}))
     # Fresh-process first-vs-warm + compile counts per slice config
     # (config_compile_stability): the restart-latency acceptance table.
     out["compile_stability"] = (_COMPILE_STABILITY
@@ -155,6 +160,11 @@ def write_manifest() -> None:
 # Per-config cost ledgers captured by config_query_cost() — folded
 # into MANIFEST.json's query_cost section.
 _QUERY_COST: dict = {}
+
+# Run-container mix measurements captured by config_container_mix() —
+# folded into MANIFEST.json's container_mix section (ROADMAP item 4's
+# done-when artifact).
+_CONTAINER_MIX: dict = {}
 
 # Per-slice-config restart latency + compile counts captured by
 # config_compile_stability() — folded into MANIFEST.json.
@@ -372,6 +382,141 @@ def config_query_cost() -> None:
                 ex.close()
         finally:
             holder.close()
+
+
+def config_container_mix() -> None:
+    """Run containers on a run-heavy (timestamp/BSI-shaped) workload:
+    the same import + query mix with the cardinality-adaptive
+    optimize() pass ON vs OFF (PILOSA_TPU_RUN_CONTAINERS semantics),
+    recording (1) resident container bytes, (2) the container-op mix
+    by operand kind from the PR 4 cost ledger — the "mix shifts to
+    run ops" claim as numbers — and (3) host-path query p50. The
+    MANIFEST container_mix section is ROADMAP item 4's done-when
+    artifact: run-op share > 0 on the run leg, strictly reduced
+    resident bytes, equal-or-better p50."""
+    import tempfile
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import ExecOptions, Executor
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.obs import accounting
+    from pilosa_tpu.sched import QueryContext
+    from pilosa_tpu.storage import fragment as fragment_mod
+
+    n_slices = max(2, int(4 * SCALE))
+    n_rows = 6
+    span_len = int(120_000 * SCALE)
+    queries = [
+        "Count(Intersect(Bitmap(rowID=0, frame=f),"
+        " Bitmap(rowID=1, frame=f)))",
+        "Count(Union(Bitmap(rowID=1, frame=f),"
+        " Bitmap(rowID=2, frame=f)))",
+        "Count(Difference(Bitmap(rowID=2, frame=f),"
+        " Bitmap(rowID=3, frame=f)))",
+        "TopN(frame=f, n=3)",
+    ]
+
+    def build(d: str, optimize_on: bool):
+        prior = fragment_mod._RUN_OPTIMIZE
+        fragment_mod._RUN_OPTIMIZE = optimize_on
+        try:
+            holder = Holder(d)
+            holder.open()
+            frame = holder.create_index_if_not_exists("cm") \
+                .create_frame_if_not_exists("f")
+            # Timestamp-view shape: each row holds long dense column
+            # spans (sequential ids), overlapping so intersections are
+            # non-trivial.
+            for row in range(n_rows):
+                start = row * span_len // 2
+                cols = np.arange(start, start + span_len,
+                                 dtype=np.uint64) \
+                    % (n_slices * SLICE_WIDTH)
+                frame.import_bits(
+                    np.full(len(cols), row, dtype=np.uint64),
+                    np.sort(cols))
+        finally:
+            fragment_mod._RUN_OPTIMIZE = prior
+        stats = {"array": 0, "bitmap": 0, "run": 0}
+        bytes_ = dict(stats)
+        for s in range(n_slices):
+            frag = holder.fragment("cm", "f", "standard", s)
+            if frag is None:
+                continue
+            cs = frag.container_stats()
+            for k in stats:
+                stats[k] += cs["counts"][k]
+                bytes_[k] += cs["bytes"][k]
+        ex = Executor(holder, host="local", use_mesh=False)
+        for q in queries:
+            ex.execute("cm", q)  # warm
+        meas = {"containers": stats,
+                "resident_bytes": sum(bytes_.values()),
+                "bytes_by_kind": bytes_, "container_ops": {},
+                "lat_ms": []}
+        return holder, ex, meas
+
+    def round_of(ex, meas) -> None:
+        for q in queries:
+            ex._bitmap_results.clear()
+            ctx = QueryContext(pql=q)
+            accounting.attach(ctx)
+            t0 = time.perf_counter()
+            ex.execute("cm", q, opt=ExecOptions(ctx=ctx))
+            meas["lat_ms"].append((time.perf_counter() - t0) * 1e3)
+            ops = meas["container_ops"]
+            for key, cnt in ctx.cost.to_tree()[
+                    "containerOps"].items():
+                ops[key] = ops.get(key, 0) + cnt
+
+    def finish(meas) -> dict:
+        ops = meas.pop("container_ops")
+        total_ops = sum(ops.values()) or 1
+        run_ops = sum(cnt for key, cnt in ops.items()
+                      if "run" in key.split(":")[-1])
+        meas["container_ops"] = ops
+        meas["run_op_share"] = round(run_ops / total_ops, 4)
+        meas["p50_ms"] = round(float(np.median(meas.pop("lat_ms"))), 3)
+        return meas
+
+    # INTERLEAVED A/B rounds: the shared VM slot swings absolute
+    # latencies ±10%+ between back-to-back passes, so the two legs
+    # alternate round by round and the p50s compare like for like
+    # (same pattern as the accounting overhead guard).
+    with tempfile.TemporaryDirectory() as d1, \
+            tempfile.TemporaryDirectory() as d2:
+        h1, ex1, m_runs = build(d1, True)
+        h2, ex2, m_base = build(d2, False)
+        try:
+            for _ in range(int(max(8, 24 * SCALE))):
+                round_of(ex1, m_runs)
+                round_of(ex2, m_base)
+        finally:
+            ex1.close()
+            ex2.close()
+            h1.close()
+            h2.close()
+    runs_leg = finish(m_runs)
+    baseline = finish(m_base)
+    _CONTAINER_MIX.update({
+        "workload": {"slices": n_slices, "rows": n_rows,
+                     "span_len": span_len, "queries": len(queries)},
+        "runs": runs_leg,
+        "baseline_array_bitmap": baseline,
+        "resident_bytes_ratio": round(
+            runs_leg["resident_bytes"]
+            / max(baseline["resident_bytes"], 1), 4),
+        "p50_ratio": round(runs_leg["p50_ms"]
+                           / max(baseline["p50_ms"], 1e-9), 3),
+    })
+    emit("container_mix_runs", runs_leg["p50_ms"], "ms",
+         run_op_share=runs_leg["run_op_share"],
+         resident_bytes=runs_leg["resident_bytes"],
+         containers=runs_leg["containers"])
+    emit("container_mix_baseline", baseline["p50_ms"], "ms",
+         run_op_share=baseline["run_op_share"],
+         resident_bytes=baseline["resident_bytes"],
+         containers=baseline["containers"])
 
 
 def _compile_cache_snapshot() -> dict:
@@ -1371,6 +1516,7 @@ def main() -> None:
                config_http_pipelined_setbit,
                config_wire_import,
                config_query_cost,
+               config_container_mix,
                config_compile_stability,
                emit_compile_cache):
         try:

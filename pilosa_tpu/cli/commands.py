@@ -448,6 +448,9 @@ def _mmap_bitmap(path: str):
 
 def cmd_check(args, stdout, stderr) -> int:
     # Offline consistency check of fragment files (ctl/check.go:46-113).
+    # Bitmap.check() validates every container kind, including the run
+    # invariants: buffer length vs numRuns, sorted, non-overlapping,
+    # non-adjacent intervals, Σ lengths == cardinality.
     from ..proto import internal_pb2 as pb
     rc = 0
     for path in args.paths:
@@ -476,17 +479,29 @@ def cmd_check(args, stdout, stderr) -> int:
 
 
 def cmd_inspect(args, stdout, stderr) -> int:
-    # Container stats dump (ctl/inspect.go:48-105).
+    # Container stats dump (ctl/inspect.go:48-105) + per-kind summary
+    # (counts, run intervals, resident bytes) for the three container
+    # types.
     bm, mm = _mmap_bitmap(args.path)
+    stats = bm.container_stats()
     print("== Bitmap Info ==", file=stdout)
     print(f"Containers: {len(bm.containers)}", file=stdout)
     print(f"Operations: {bm.op_n}", file=stdout)
     print("", file=stdout)
+    print("== Container Types ==", file=stdout)
+    print(f"{'TYPE':>6} {'COUNT':>8} {'INTERVALS':>10} {'BYTES':>10}",
+          file=stdout)
+    for kind in ("array", "bitmap", "run"):
+        ivals = stats["intervals"].get(kind, 0)
+        print(f"{kind:>6} {stats['counts'][kind]:>8}"
+              f" {ivals:>10} {stats['bytes'][kind]:>10}", file=stdout)
+    print("", file=stdout)
     print("== Containers ==", file=stdout)
-    print(f"{'KEY':>12} {'TYPE':>6} {'N':>8}", file=stdout)
+    print(f"{'KEY':>12} {'TYPE':>6} {'N':>8} {'RUNS':>6}", file=stdout)
     for key, c in zip(bm.keys, bm.containers):
-        typ = "array" if c.is_array() else "bitmap"
-        print(f"{int(key):>12} {typ:>6} {c.n:>8}", file=stdout)
+        n_runs = ((len(c.runs) - 1) >> 1) if c.runs is not None else 0
+        print(f"{int(key):>12} {c.kind():>6} {c.n:>8} {n_runs:>6}",
+              file=stdout)
     bm.unmap()
     return 0
 

@@ -1690,10 +1690,75 @@ class Executor:
             return pairs
         other = c.clone()
         other.args["ids"] = sorted({p.id for p in pairs})
+        dev = self._topn_device_topk(index, other, slices, n)
+        if dev is not None:
+            return dev
         trimmed = self._top_n_slices(index, other, slices, opt)
         if n and n < len(trimmed):
             trimmed = trimmed[:n]
         return trimmed
+
+    def _topn_device_topk(self, index: str, c: Call,
+                          slices: list[int],
+                          n: int) -> Optional[list[Pair]]:
+        """The sourceless TopN exact-count refetch as ONE in-program
+        device top-k (mesh.topn_topk_sharded): the candidate union from
+        phase 1 uploads as a resident block, per-candidate counts
+        reduce in-program, and the top-k selection ALSO happens in the
+        program, so the host fetch is O(n) instead of O(candidates).
+        Plain form only — thresholds > 1, Tanimoto, attribute filters,
+        and pod legs keep the host path, which owns those semantics.
+        Counts are fresh popcounts, identical to the host refetch's
+        row_count recounts; ordering matches pairs_sort (count desc,
+        id asc) by the program's tie-break. None = fall back."""
+        if not self.use_mesh or self.pod is not None \
+                or self._mesh_backoff_active():
+            return None
+        (frame_name, _n, field, row_ids, min_threshold, filters,
+         tanimoto) = self._topn_args(c)
+        if (len(c.children) > 0 or (field and filters) or tanimoto > 0
+                or min_threshold > 1 or not row_ids
+                or len(slices) < self.mesh_min_slices
+                or not self._owns_all_slices(index, slices)):
+            return None
+        mesh = self._mesh_or_none()
+        if mesh is None:
+            return None
+        from .ops.packed import WORDS_PER_SLICE
+        from .parallel import mesh as mesh_mod
+        from .parallel import residency
+        ids = list(row_ids)
+        block_bytes = len(slices) * len(ids) * WORDS_PER_SLICE * 4
+        if (block_bytes > self._TOPN_HOST_BLOCK_BYTES
+                or block_bytes > mesh_mod.TOPN_BLOCK_BYTES
+                or len(slices) > mesh_mod.slice_chunk_bound(
+                    mesh.shape[mesh_mod.AXIS_SLICES])):
+            return None
+        rows_key = self._topn_rows_key(mesh, index, frame_name,
+                                       tuple(ids), tuple(slices))
+        cold = (0 if residency.device_cache().contains(rows_key)
+                else len(ids))
+        if not self._device_pays(mesh, len(ids), len(slices),
+                                 cold_rows=cold, streaming=False):
+            return None
+        k = min(n, len(ids)) if n else len(ids)
+        try:
+            def run():
+                frags = [self.holder.fragment(index, frame_name,
+                                              VIEW_STANDARD, s)
+                         for s in slices]
+                rows_arr = residency.candidate_block(
+                    mesh, rows_key, frags, tuple(ids))
+                return mesh_mod.topn_topk_sharded(mesh, None, rows_arr,
+                                                  [], k)
+            counts, idxs = self._timed_device_leg(
+                run, len(ids), len(slices), cold_rows=cold,
+                streaming=False)
+        except Exception as e:  # noqa: BLE001 - device trouble ≠ node down
+            self._note_device_fallback("topn_topk", e)
+            return None
+        return [Pair(ids[i], cnt)
+                for i, cnt in zip(idxs, counts) if cnt > 0]
 
     def _topn_host_single_pass(self, index: str, c: Call,
                                slices: list[int],

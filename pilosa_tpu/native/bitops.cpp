@@ -11,6 +11,7 @@
 // popcount loops with __builtin_popcountll.
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 
 extern "C" {
@@ -187,8 +188,12 @@ extern "C" int64_t bitmap_intersection_count(
 // touched container, in key order. types[g]: 0 = array container
 // (sorted u32 values at arr_ptrs[g], count arr_ns[g]); 1 = bitmap
 // container (u64[1024] at arr_ptrs[g], mutated IN PLACE — caller
-// guarantees copy-on-write happened). chunk values are sorted, unique,
-// < 65536.
+// guarantees copy-on-write happened); 2 = run container (wire-form
+// u16 buffer [numRuns, start, len-1, ...] at arr_ptrs[g], cardinality
+// arr_ns[g]) — decoded to sorted values here and merged through the
+// array path, i.e. the engine transparently upgrades runs (output is
+// array or bitmap; roaring.Bitmap.optimize() re-compresses later).
+// chunk values are sorted, unique, < 65536.
 //
 // Outputs per group:
 //   out_kind[g]: 0 = merged array written at out_vals[out_offsets[g]]
@@ -210,6 +215,18 @@ inline void wal_record(uint8_t* rec, uint8_t typ, uint64_t pos) {
     uint32_t h = 2166136261u;
     for (int i = 0; i < 9; i++) h = (h ^ rec[i]) * 16777619u;
     memcpy(rec + 9, &h, 4);
+}
+
+// Expand a wire-form run buffer into sorted u32 values; returns count.
+int64_t decode_runs_u32(const uint16_t* runs, uint32_t* out) {
+    int64_t n_runs = runs[0];
+    int64_t k = 0;
+    for (int64_t i = 0; i < n_runs; i++) {
+        uint32_t start = runs[1 + 2 * i];
+        uint32_t len = (uint32_t)runs[2 + 2 * i] + 1;
+        for (uint32_t v = 0; v < len; v++) out[k++] = start + v;
+    }
+    return k;
 }
 
 }  // namespace
@@ -242,9 +259,16 @@ extern "C" int64_t batch_add(
             out_ns[g] = n;
             out_bm_idx[g] = -1;
             out_offsets[g] = -1;
-        } else {  // array container: two-pointer union into out_vals
+        } else {  // array/run container: two-pointer union into out_vals
             const uint32_t* a = (const uint32_t*)arr_ptrs[g];
             int64_t na = arr_ns[g];
+            uint32_t* decoded = nullptr;
+            if (types[g] == 2) {  // run: decode, then merge as array
+                decoded = (uint32_t*)malloc((na ? na : 1) * 4);
+                na = decode_runs_u32((const uint16_t*)arr_ptrs[g],
+                                     decoded);
+                a = decoded;
+            }
             uint32_t* out = out_vals + out_off;
             int64_t i = 0, j = 0, k = 0;
             while (i < na && j < nb) {
@@ -277,6 +301,7 @@ extern "C" int64_t batch_add(
                 out_off += k;
             }
             out_ns[g] = k;
+            free(decoded);
         }
         if (wal_op_type >= 0) {
             for (int64_t t = before_changed; t < n_changed; t++)
@@ -286,10 +311,11 @@ extern "C" int64_t batch_add(
     return n_changed;
 }
 
-// Batched remove. Same group layout as batch_add. Array groups write the
-// difference to out_vals (kind 0). Bitmap groups clear in place; if the
-// result drops to <=4096 values it is UNPACKED to an array in out_vals
-// (kind 0) to restore the serialization invariant, else kind 2.
+// Batched remove. Same group layout as batch_add (run groups decode and
+// go through the array path). Array groups write the difference to
+// out_vals (kind 0). Bitmap groups clear in place; if the result drops
+// to <=4096 values it is UNPACKED to an array in out_vals (kind 0) to
+// restore the serialization invariant, else kind 2.
 extern "C" int64_t batch_remove(
         int64_t n_groups, const uint64_t* keys, const uint8_t* types,
         const uint64_t* arr_ptrs, const int64_t* arr_ns,
@@ -336,6 +362,13 @@ extern "C" int64_t batch_remove(
         } else {
             const uint32_t* a = (const uint32_t*)arr_ptrs[g];
             int64_t na = arr_ns[g];
+            uint32_t* decoded = nullptr;
+            if (types[g] == 2) {
+                decoded = (uint32_t*)malloc((na ? na : 1) * 4);
+                na = decode_runs_u32((const uint16_t*)arr_ptrs[g],
+                                     decoded);
+                a = decoded;
+            }
             uint32_t* out = out_vals + out_off;
             int64_t i = 0, j = 0, k = 0;
             while (i < na) {
@@ -351,6 +384,7 @@ extern "C" int64_t batch_remove(
             out_offsets[g] = out_off;
             out_ns[g] = k;
             out_off += k;
+            free(decoded);
         }
         if (wal_op_type >= 0) {
             for (int64_t t = before_changed; t < n_changed; t++)

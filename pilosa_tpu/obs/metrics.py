@@ -417,6 +417,16 @@ ROARING_OPS = _DEFAULT.counter(
     "Roaring container set-algebra operations, by op and operand"
     " container kinds",
     labels=("op", "kind"))
+ROARING_CONTAINERS = _DEFAULT.gauge(
+    "pilosa_roaring_containers_live",
+    "Live roaring containers across open fragments, by kind"
+    " (array/bitmap/run) — the container-mix shift to runs as a gauge",
+    labels=("kind",))
+ROARING_CONTAINER_BYTES = _DEFAULT.gauge(
+    "pilosa_roaring_container_bytes",
+    "Resident bytes held by live roaring containers, by kind — run"
+    " containers shrinking this is the HBM-headroom payoff ramp",
+    labels=("kind",))
 COMPILE_HITS = _DEFAULT.counter(
     "pilosa_compile_cache_hits_total",
     "XLA program-cache lookups served without building a program")

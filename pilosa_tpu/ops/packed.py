@@ -22,7 +22,7 @@ from __future__ import annotations
 import numpy as np
 
 from .. import SLICE_WIDTH
-from ..storage.roaring import Bitmap
+from ..storage.roaring import Bitmap, runs_to_words
 
 WORD_BITS = 32
 # u32 words per slice row: 2^20 / 32 = 32768 (a multiple of the 128-lane
@@ -51,7 +51,13 @@ def pack_bitmap(b: Bitmap, n_words: int, out: np.ndarray | None = None,
         if not c.is_array():
             dst0, dst1 = max(word0, 0), min(word0 + _WORDS_PER_CONTAINER,
                                             n_words)
-            src = c.bitmap.view("<u4")[dst0 - word0:dst1 - word0]
+            # Run containers decode to dense words here — the device
+            # residency upload path (parallel.residency leaf_slab /
+            # candidate_block) sees bit-plane slabs regardless of the
+            # host storage kind.
+            words64 = (c.bitmap if c.bitmap is not None
+                       else runs_to_words(c.runs))
+            src = words64.view("<u4")[dst0 - word0:dst1 - word0]
             out[dst0:dst1] |= src
         else:
             a = c.array
@@ -149,7 +155,8 @@ def sparse_words(b: Bitmap, n_words: int, base_word: int = 0
         if word0 >= n_words or word0 + _WORDS_PER_CONTAINER <= 0:
             continue
         if not c.is_array():
-            view = c.bitmap.view("<u4")
+            view = (c.bitmap if c.bitmap is not None
+                    else runs_to_words(c.runs)).view("<u4")
             nz = np.flatnonzero(view)
             widx = word0 + nz.astype(np.int64)
             keep = (widx >= 0) & (widx < n_words)

@@ -829,6 +829,37 @@ def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
         return hilo_combine(fn(rows, *leaf_arrays))[:rows.shape[1]]
 
 
+def topn_topk_sharded(mesh: Mesh, expr, rows: jax.Array,
+                      leaf_arrays: list[jax.Array],
+                      k: int) -> tuple[list[int], list[int]]:
+    """Sourceless-TopN top-k over a DEVICE-resident candidate block:
+    counts reduce AND the top-k selection happens inside one program
+    (programs.topn_topk_program), so the host fetches [3, k] instead
+    of the whole [2, R] count table. Returns (counts, row indices),
+    count-descending with ascending-index tie-break — the host
+    pairs_sort order. Pallas meshes have no top-k kernel; there the
+    exact-count program runs and the selection folds host-side, same
+    contract."""
+    _dispatch_gate()
+    if rows.shape[0] > slice_chunk_bound(mesh.shape[AXIS_SLICES]):
+        raise ValueError("topn_topk_sharded: slice count above the"
+                         " int32 hi/lo bound")
+    k = max(1, min(int(k), int(rows.shape[1])))
+    if _mesh_pallas_mode(mesh) is not None:
+        counts = topn_exact_sharded(mesh, expr, rows, leaf_arrays)
+        order = np.lexsort((np.arange(len(counts)),
+                            -np.asarray(counts)))[:k]
+        return [counts[i] for i in order.tolist()], order.tolist()
+    from . import programs as programs_mod
+    fn = programs_mod.topn_topk_program(mesh, expr, len(leaf_arrays), k)
+    _note_dispatch(rows, *leaf_arrays)
+    with obs_trace.span_current("mesh_dispatch", kind="topn_topk",
+                                rows=int(rows.shape[1]), k=k):
+        out = np.asarray(fn(rows, *leaf_arrays)).astype(np.int64)
+    counts = ((out[0] << 16) + out[1]).tolist()
+    return counts, out[2].tolist()
+
+
 def shard_slices_axis1(mesh: Mesh, arr: np.ndarray) -> jax.Array:
     """Place ``[L, n_slices, ...]`` on the mesh, sharded over axis 1."""
     spec = [None] * arr.ndim

@@ -67,6 +67,7 @@ CATALOGUE = (
     "count_batch",         # K-expression fused count batch
     "topn_exact",          # TopN exact-count block, psum'd in-program
     "topn_filtered",       # per-slice threshold/Tanimoto pruning form
+    "topn_topk",           # sourceless TopN: top-k selected IN-PROGRAM
     "materialize",         # dense expression words, sharded output
     "bsi_compare_select",  # BSI comparison circuit over bit-planes
     "fused_tree",          # Counts + TopN blocks in ONE computation
@@ -220,6 +221,46 @@ def topn_block_program(mesh, expr, filtered: bool):
     return mesh_mod._finalize_program(jax.jit(fn, **donate))
 
 
+@functools.lru_cache(maxsize=128)
+def topn_topk_program(mesh, expr, n_leaves: int, k: int):
+    """In-program top-k for the sourceless TopN forms (the ROADMAP
+    item-1 leftover): rows [S_b, R, W] (+ optional leaf slabs) →
+    [3, k] int32 (hi, lo, row index). The per-candidate (hi, lo)
+    16-bit halves reduce in-program as usual, then ONE lexicographic
+    ``lax.sort`` over (hi, lo, -index) selects the winners on device —
+    exact even though counts exceed int32 as a single key, and the
+    host fetch shrinks from O(R) to O(k). Tie-break is ascending row
+    index, matching the host pairs_sort order bit-for-bit. One program
+    per (expr, shape, k); k values in the wild are the handful of
+    TopN(n=...) sizes a deployment serves."""
+    sh = _slice_sharding(mesh)
+
+    def fn(rows, *leaf_shards):
+        rows = jax.lax.with_sharding_constraint(rows, sh)
+        if leaf_shards:
+            leaves = jnp.stack([
+                jax.lax.with_sharding_constraint(a, sh)
+                for a in leaf_shards])
+        else:
+            leaves = jnp.zeros((0,) + rows.shape[::2], dtype=rows.dtype)
+        per_slice = mesh_mod._shard_topn_inter(expr, rows, leaves, None)
+        hi = jnp.sum(per_slice >> 16, axis=0).astype(jnp.int32)
+        lo = jnp.sum(per_slice & 0xFFFF, axis=0).astype(jnp.int32)
+        # Normalize the halves before the sort: the lo-sum reaches
+        # n_slices * 0xFFFF, so without carrying its overflow into hi
+        # the lexicographic order diverges from true count order
+        # (e.g. (hi=1, lo=0) would outrank (hi=0, lo=131070)). The
+        # host decode (hi<<16)+lo is invariant under this shift.
+        hi = hi + (lo >> 16)
+        lo = lo & 0xFFFF
+        idx = jax.lax.iota(jnp.int32, hi.shape[0])
+        shi, slo, sneg = jax.lax.sort((hi, lo, -idx), num_keys=3)
+        return jnp.stack([shi[::-1][:k], slo[::-1][:k],
+                          -sneg[::-1][:k]])
+
+    return mesh_mod._finalize_program(jax.jit(fn))
+
+
 @functools.lru_cache(maxsize=256)
 def materialize_program(mesh, expr, n_leaves: int):
     """Dense [S_b, W] words of the expression bitmap over resident leaf
@@ -307,6 +348,6 @@ def fused_program(mesh, count_exprs: tuple, topn_exprs: tuple,
 # aggregates hits/misses over the catalogue too.
 PROGRAM_CACHES = (
     count_exprs_program, count_exprs_block_program, topn_program,
-    topn_block_program, materialize_program, bsi_range_program,
-    fused_program,
+    topn_block_program, topn_topk_program, materialize_program,
+    bsi_range_program, fused_program,
 )

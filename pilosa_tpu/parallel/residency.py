@@ -27,6 +27,13 @@ Upload layout: the globally-sharded slab builders (``leaf_slab``,
 resident array already has the bucket-stable shape the program
 catalogue compiles for — growing an index within a bucket re-uses both
 the compiled programs AND the upload path's shapes.
+
+Host container kinds are invisible past this layer: the extraction
+feeding both the sparse and dense upload legs (ops.packed
+sparse_row_words / pack_bitmap) decodes array, bitmap, AND run
+containers to the same word form, so run-compressed fragments (the
+memory win that lets more of the matrix fit in HBM) ride the existing
+bucket-padded path with no residency-side special case.
 """
 
 from __future__ import annotations

@@ -145,6 +145,8 @@ class Warmup:
                     mesh, ("leaf", 0), rows_block(), [a]),
                 "topn_filtered": lambda: mesh_mod.topn_filtered_sharded(
                     mesh, ("leaf", 0), rows_block(), [a], threshold=2),
+                "topn_topk": lambda: mesh_mod.topn_topk_sharded(
+                    mesh, None, rows_block(), [], k=2),
                 "materialize": lambda: mesh_mod.materialize_expr_sharded(
                     mesh, ("or", ("leaf", 0), ("leaf", 1)), [a, b]),
                 "bsi_compare_select": lambda: mesh_mod.bsi_range_sharded(

@@ -63,6 +63,13 @@ MAX_OP_N = int(os.environ.get("PILOSA_TPU_MAX_OP_N", "2000"))
 # Rows per checksum block (reference fragment.go:59).
 HASH_BLOCK_SIZE = 100
 
+# Run the cardinality-adaptive container-representation pass
+# (roaring.Bitmap.optimize — array/bitmap/run selection per the Roaring
+# papers) after bulk imports. On by default; settable off to pin the
+# two-kind vintage behavior for comparisons (benchmarks/suite.py
+# container_mix measures exactly this delta).
+_RUN_OPTIMIZE = os.environ.get("PILOSA_TPU_RUN_CONTAINERS", "1") != "0"
+
 
 @dataclass
 class TopOptions:
@@ -698,6 +705,17 @@ class Fragment:
                 self.storage.add_many(positions)
             finally:
                 self.storage.op_writer = writer
+            if _RUN_OPTIMIZE:
+                # Cardinality-adaptive representation pass (roaring run
+                # containers): bulk imports are where run-heavy data
+                # (timestamp views, BSI planes) lands, so this is the
+                # one site that (re)introduces run containers; the
+                # snapshot below persists them via the runs cookie.
+                # Restricted to the touched container keys — the full
+                # walk would re-pay O(all containers) per import, the
+                # cost the row-count pass below was rewritten to avoid.
+                self.storage.optimize(
+                    sort_dedupe(positions >> np.uint64(16)))
             # Post-import row counts in ONE pass over the container
             # table: positions are row*SLICE_WIDTH + col, so a
             # container's row is its key >> log2(SLICE_WIDTH/65536) and
@@ -794,6 +812,19 @@ class Fragment:
         n = self.storage.count()
         self._total_bits = (self._epoch, n)
         return n
+
+    def container_stats(self) -> dict:
+        """Per-kind container counts/resident bytes/run intervals
+        (roaring.Bitmap.container_stats), cached per mutation epoch —
+        the runtime collector samples every open fragment on its
+        cadence, and the underlying walk is O(containers)."""
+        hit = getattr(self, "_container_stats", None)
+        if hit is not None and hit[0] == self._epoch:
+            return hit[1]
+        with self._mu:
+            stats = self.storage.container_stats()
+        self._container_stats = (self._epoch, stats)
+        return stats
 
     def _cached_positions(self) -> np.ndarray:
         """all_positions per mutation epoch: every src's first count
@@ -1441,11 +1472,14 @@ class Fragment:
                     continue
                 snap.append((int(key),
                              None if c.array is None else c.array.copy(),
-                             None if c.bitmap is None else c.bitmap.copy()))
+                             None if c.bitmap is None else c.bitmap.copy(),
+                             None if c.runs is None else c.runs.copy()))
 
         def expand():
-            for key, arr, words in snap:
-                if arr is None:
+            for key, arr, words, runs in snap:
+                if runs is not None:
+                    arr = roaring.runs_to_values(runs)
+                elif arr is None:
                     arr = roaring.bitmap_words_to_values(words)
                 yield np.uint64(key << 16) + arr.astype(np.uint64)
         return expand()

@@ -293,10 +293,12 @@ def batch_add(keys, types, arr_ptrs, arr_ns, chunk_vals, chunk_starts,
               out_vals, out_offsets, out_ns, out_kind, out_bitmaps,
               out_bm_idx, changed, wal, wal_op_type: int) -> int:
     """One native crossing applying a whole add batch across touched
-    containers (see bitops.cpp batch_add). Caller guarantees sizing and
-    copy-on-write of in-place bitmap groups; raises if the native
-    library is unavailable (roaring.apply_batch has the numpy
-    fallback)."""
+    containers (see bitops.cpp batch_add). Group types: 0=array,
+    1=bitmap (mutated in place), 2=run (wire-form u16 buffer, decoded
+    and merged through the array path — the engine's transparent run
+    upgrade). Caller guarantees sizing and copy-on-write of in-place
+    bitmap groups; raises if the native library is unavailable
+    (roaring.apply_batch has the numpy fallback)."""
     lib = _load()
     if lib is None:
         raise RuntimeError("native library unavailable")

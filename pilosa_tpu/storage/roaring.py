@@ -15,9 +15,33 @@ File-format compatible with the reference implementation
 (Reference format sections: roaring.go:475-614 for snapshot,
 roaring.go:1560-1626 for the op-log.)
 
+Run containers (the reference vintage predates them) follow the later
+papers — arXiv:1603.06549 and arXiv:1709.07821 — which add a third
+container kind holding sorted ``[start, length]`` interval pairs plus a
+cardinality-adaptive ``optimize()`` pass that picks the smallest of
+array/bitmap/run per container. A snapshot containing at least one run
+container uses the runs cookie (the upstream SERIAL_COOKIE idiom):
+
+    snapshot  := cookie(u32 LE = 12347) keyN(u32 LE)
+                 runFlags: ceil(keyN/8) bytes rounded up to a multiple
+                           of 8 (bit i, little-endian bit order, set ⇒
+                           container i is a run container)
+                 { key(u64 LE) n-1(u32 LE) } * keyN   # n = cardinality
+                 { offset(u32 LE) } * keyN
+                 container blocks
+    run block := numRuns(u16 LE) { start(u16 LE) length-1(u16 LE) } *
+
+A snapshot with no run containers is byte-identical to the legacy
+12346 form, so pre-run files (and the golden fixtures) interchange
+unchanged. The op-log is kind-agnostic: replay mutates run containers
+directly (interval surgery) or splits/extends them.
+
 Design departure from the reference: containers are numpy arrays, not
 pointer-chased structs — an array container is a sorted ``np.uint32`` vector
-(values < 2^16), a bitmap container is an ``np.uint64[1024]`` word vector.
+(values < 2^16), a bitmap container is an ``np.uint64[1024]`` word vector,
+and a run container is a little-endian ``np.uint16`` vector that IS the
+wire block (``[numRuns, start0, len0-1, ...]``), so serialization is a
+write of the buffer and an mmap load is a zero-copy view.
 All set algebra is vectorized (numpy or the optional C++ kernel lib in
 ``pilosa_tpu.native``); the same dense-word orientation is what packs straight
 onto the TPU (see pilosa_tpu.ops.packed).
@@ -51,9 +75,14 @@ def _wal_write(writer, blob: bytes) -> None:
 # --- constants (match reference wire format) ---------------------------------
 
 COOKIE = 12346               # roaring.go:30
+COOKIE_RUNS = 12347          # runs format (upstream SERIAL_COOKIE idiom)
 HEADER_SIZE = 8              # roaring.go:33
 BITMAP_N = 1024              # u64 words per bitmap container (roaring.go:36)
 ARRAY_MAX_SIZE = 4096        # roaring.go:833
+# Past this many runs the run block (2 + 4R bytes) can never be the
+# smallest representation (a bitmap is 8192 bytes), so mutation paths
+# convert rather than let a degrading run container grow unboundedly.
+RUN_MAX_SIZE = 2047
 OP_SIZE = 13                 # 1 + 8 + 4 (roaring.go:1626)
 
 OP_ADD = 0
@@ -97,31 +126,42 @@ def lowbits(v: int) -> int:
 
 
 class Container:
-    """One 2^16-value container: sorted u32 array or 1024-word u64 bitmap.
+    """One 2^16-value container: sorted u32 array, 1024-word u64 bitmap,
+    or a wire-form u16 run buffer ([R, start, len-1, ...]).
 
     ``mapped`` marks data backed by an external (mmap'd) buffer; any mutation
     first copies (copy-on-write), mirroring the reference's ``mapped`` flag
     (roaring.go:536-614) and BitmapSegment.writable (bitmap.go:384-392).
     """
 
-    __slots__ = ("array", "bitmap", "n", "mapped", "cow")
+    __slots__ = ("array", "bitmap", "runs", "n", "mapped", "cow")
 
     def __init__(self):
         self.array: Optional[np.ndarray] = _EMPTY_U32  # sorted u32, or None
         self.bitmap: Optional[np.ndarray] = None       # u64[1024], or None
+        self.runs: Optional[np.ndarray] = None         # u16 run buffer, or None
         self.n: int = 0
         self.mapped: bool = False
         # Copy-on-write token for frozen-snapshot captures: when this
         # lags the owning Bitmap's _cow_epoch, an in-place bitmap-word
         # mutation must copy the buffer first (a background snapshot
-        # serializes the captured buffer by pointer). Array buffers are
-        # replaced, never mutated in place, so they need no token check.
+        # serializes the captured buffer by pointer). Array and run
+        # buffers are replaced, never mutated in place, so they need no
+        # token check.
         self.cow: int = 0
 
     # -- representation management
 
     def is_array(self) -> bool:
-        return self.bitmap is None
+        return self.bitmap is None and self.runs is None
+
+    def is_run(self) -> bool:
+        return self.runs is not None
+
+    def kind(self) -> str:
+        if self.runs is not None:
+            return "run"
+        return "array" if self.bitmap is None else "bitmap"
 
     def _unmap(self) -> None:
         if self.mapped:
@@ -129,35 +169,95 @@ class Container:
                 self.array = self.array.copy()
             if self.bitmap is not None:
                 self.bitmap = self.bitmap.copy()
+            if self.runs is not None:
+                self.runs = self.runs.copy()
             self.mapped = False
 
     def _to_bitmap(self) -> None:
-        """array → bitmap conversion (roaring.go:951-976)."""
+        """array/run → bitmap conversion (roaring.go:951-976)."""
         if self.bitmap is not None:
             return
         self.bitmap = self.as_words()
         self.array = None
+        self.runs = None
         self.mapped = False
 
     def _to_array(self) -> None:
-        """bitmap → array conversion (roaring.go:1023-1048)."""
+        """bitmap/run → array conversion (roaring.go:1023-1048)."""
+        if self.runs is not None:
+            self.array = runs_to_values(self.runs)
+            self.runs = None
+            self.mapped = False
+            return
         if self.bitmap is None:
             return
         self.array = bitmap_words_to_values(self.bitmap)
         self.bitmap = None
         self.mapped = False
 
+    def _run_to_legacy(self) -> None:
+        """Run → the legacy kind the n<=4096 file rule dictates — the
+        transparent upgrade the bulk write paths apply before mutating
+        (runs re-appear at the next optimize())."""
+        if self.n > ARRAY_MAX_SIZE:
+            self._to_bitmap()
+        else:
+            self._to_array()
+
     def _maybe_convert(self) -> None:
         # Invariant (required by the file format, where n<=4096 ⇒ array
         # block): array containers hold at most ARRAY_MAX_SIZE values, bitmap
         # containers strictly more. Matches reference arrayAdd/bitmapRemove
-        # boundaries (roaring.go:951-953,1023-1025).
+        # boundaries (roaring.go:951-953,1023-1025). Run containers are
+        # exempt (the runs flag bitset identifies them on disk); they
+        # only convert when mutation degrades them past the point where
+        # runs could ever be the smallest form.
+        if self.runs is not None:
+            if (len(self.runs) >> 1) > RUN_MAX_SIZE:
+                self._run_to_legacy()
+            return
         if self.bitmap is None:
             if self.n > ARRAY_MAX_SIZE:
                 self._to_bitmap()
         else:
             if self.n <= ARRAY_MAX_SIZE:
                 self._to_array()
+
+    def optimize(self) -> str:
+        """Cardinality-adaptive representation selection (the
+        runOptimize pass of arXiv:1603.06549 §3 / arXiv:1709.07821 §2.1):
+        count the runs the current contents compress into and keep the
+        smallest wire form — run (2+4R bytes) vs the legacy kind the
+        n<=4096 rule dictates (4n or 8192). Returns the chosen kind.
+        A container already in its best form is left untouched (mmap'd
+        buffers stay zero-copy)."""
+        if self.n == 0:
+            if self.runs is not None:
+                self._to_array()
+            return self.kind()
+        if self.runs is not None:
+            n_runs = (len(self.runs) - 1) >> 1
+        elif self.bitmap is None:
+            n_runs = run_count_array(self.array)
+        else:
+            n_runs = run_count_words(self.bitmap)
+        run_size = 2 + 4 * n_runs
+        legacy_size = (self.n * 4 if self.n <= ARRAY_MAX_SIZE
+                       else BITMAP_N * 8)
+        if run_size < legacy_size:
+            if self.runs is None:
+                vals = (self.array if self.bitmap is None
+                        else bitmap_words_to_values(self.bitmap))
+                self.runs = values_to_runs(vals)
+                self.array = None
+                self.bitmap = None
+                self.mapped = False
+            return "run"
+        if self.runs is not None:
+            self._run_to_legacy()
+        else:
+            self._maybe_convert()
+        return self.kind()
 
     # -- point ops
 
@@ -168,6 +268,8 @@ class Container:
         # scalar ops pay ~2 us each). Building a fresh array also
         # detaches from a mapped buffer, so no _unmap() copy on the
         # array branch.
+        if self.runs is not None:
+            return self._run_add(v)
         if self.bitmap is None:
             # Manual numpy copy-insert: the ctypes pointer prep for the
             # native kernel costs ~4 us/call (arr.ctypes construction +
@@ -196,7 +298,60 @@ class Container:
         self.n += 1
         return True
 
+    def _run_add(self, v: int) -> bool:
+        """Interval surgery: extend/merge the neighbouring runs or
+        insert a fresh single-value run. Rebuilds the (small) buffer —
+        run buffers are never mutated in place, which keeps mmap'd and
+        frozen captures safe without COW bookkeeping."""
+        starts, ends = _runs_starts_ends(self.runs)
+        n_runs = len(starts)
+        i = int(np.searchsorted(starts, v, side="right")) - 1
+        if i >= 0 and v < ends[i]:
+            return False
+        join_prev = i >= 0 and v == int(ends[i])
+        join_next = i + 1 < n_runs and v == int(starts[i + 1]) - 1
+        if join_prev and join_next:
+            starts = np.delete(starts, i + 1)
+            ends = np.delete(ends, i)
+        elif join_prev:
+            ends[i] += 1
+        elif join_next:
+            starts[i + 1] -= 1
+        else:
+            starts = np.insert(starts, i + 1, v)
+            ends = np.insert(ends, i + 1, v + 1)
+        self.runs = _build_runs(starts, ends)
+        self.mapped = False
+        self.n += 1
+        self._maybe_convert()
+        return True
+
+    def _run_remove(self, v: int) -> bool:
+        starts, ends = _runs_starts_ends(self.runs)
+        i = int(np.searchsorted(starts, v, side="right")) - 1
+        if i < 0 or v >= ends[i]:
+            return False
+        if ends[i] - starts[i] == 1:
+            starts = np.delete(starts, i)
+            ends = np.delete(ends, i)
+        elif v == int(starts[i]):
+            starts[i] += 1
+        elif v == int(ends[i]) - 1:
+            ends[i] -= 1
+        else:  # split the run around v
+            tail_end = int(ends[i])
+            ends[i] = v
+            starts = np.insert(starts, i + 1, v + 1)
+            ends = np.insert(ends, i + 1, tail_end)
+        self.runs = _build_runs(starts, ends)
+        self.mapped = False
+        self.n -= 1
+        self._maybe_convert()
+        return True
+
     def remove(self, v: int) -> bool:
+        if self.runs is not None:
+            return self._run_remove(v)
         if self.bitmap is None:
             a = self.array
             i = int(np.searchsorted(a, v))
@@ -216,6 +371,11 @@ class Container:
         return True
 
     def contains(self, v: int) -> bool:
+        if self.runs is not None:
+            starts = self.runs[1::2]
+            i = int(np.searchsorted(starts, v, side="right")) - 1
+            return (i >= 0 and
+                    v <= int(starts[i]) + int(self.runs[2 + 2 * i]))
         if self.bitmap is None:
             a = self.array
             i = int(np.searchsorted(a, v))
@@ -226,12 +386,17 @@ class Container:
 
     def values(self) -> np.ndarray:
         """All set low-16-bit values, sorted, as u32."""
+        if self.runs is not None:
+            return runs_to_values(self.runs)
         if self.bitmap is None:
             return self.array
         return bitmap_words_to_values(self.bitmap)
 
     def as_words(self) -> np.ndarray:
-        """Dense u64[1024] word view (built on demand for array containers)."""
+        """Dense u64[1024] word view (built on demand for array and run
+        containers)."""
+        if self.runs is not None:
+            return runs_to_words(self.runs)
         if self.bitmap is not None:
             return self.bitmap
         a = self.array
@@ -251,6 +416,10 @@ class Container:
         start, end = max(start, 0), min(end, 1 << 16)
         if start >= end:
             return 0
+        if self.runs is not None:
+            starts, ends = _runs_starts_ends(self.runs)
+            return int((np.clip(ends, start, end)
+                        - np.clip(starts, start, end)).sum())
         if self.bitmap is None:
             a = self.array
             return int(np.searchsorted(a, end) - np.searchsorted(a, start))
@@ -264,12 +433,41 @@ class Container:
             words[-1] &= ~(~np.uint64(0) << np.uint64(last_bits))
         return int(np.bitwise_count(words).sum())
 
+    def rank(self, v: int) -> int:
+        """Number of set values <= v within this container."""
+        return self.count_range(0, v + 1)
+
     def size_bytes(self) -> int:
-        """Serialized size (roaring.go container size())."""
+        """Serialized size (roaring.go container size(); run blocks are
+        numRuns(u16) + 4 bytes per interval)."""
+        if self.runs is not None:
+            return int(self.runs.size) * 2
         return self.n * 4 if self.bitmap is None else BITMAP_N * 8
 
     def check(self) -> None:
-        """Internal consistency (roaring.go:653-674 spirit)."""
+        """Internal consistency (roaring.go:653-674 spirit). Run
+        containers validate the full interval invariant set: buffer
+        length matches the numRuns prefix, starts strictly sorted,
+        intervals non-overlapping and non-adjacent, Σ lengths == n."""
+        if self.runs is not None:
+            r = self.runs
+            if r.ndim != 1 or not len(r):
+                raise ValueError("container: malformed run buffer")
+            if len(r) != 1 + 2 * int(r[0]):
+                raise ValueError(
+                    f"container: run buffer length {len(r)} != "
+                    f"1 + 2*{int(r[0])}")
+            starts, ends = _runs_starts_ends(r)
+            if len(starts) > 1 and not np.all(starts[1:] > ends[:-1]):
+                raise ValueError(
+                    "container: runs overlapping or adjacent")
+            if int((ends - starts).sum()) != self.n:
+                raise ValueError(
+                    f"container: run lengths sum "
+                    f"{int((ends - starts).sum())} != n {self.n}")
+            if len(ends) and int(ends[-1]) > 1 << 16:
+                raise ValueError("container: run past 2^16")
+            return
         if self.bitmap is None:
             a = self.array
             if a is None:
@@ -280,6 +478,12 @@ class Container:
                 raise ValueError("container: array not strictly sorted")
             if len(a) and int(a[-1]) > 0xFFFF:
                 raise ValueError("container: array value out of range")
+            if len(a) > ARRAY_MAX_SIZE:
+                # n<=4096 ⇒ array is a FILE-FORMAT rule: the snapshot
+                # sizer maps n>4096 to an 8192-byte bitmap block, so an
+                # oversized array serializes corrupt.
+                raise ValueError(
+                    f"container: array n {len(a)} > {ARRAY_MAX_SIZE}")
         else:
             got = int(np.bitwise_count(self.bitmap).sum())
             if got != self.n:
@@ -303,6 +507,22 @@ class Container:
         c.mapped = mapped
         return c
 
+    @staticmethod
+    def from_runs(runs: np.ndarray, n: Optional[int] = None,
+                  mapped: bool = False) -> "Container":
+        c = Container()
+        c.array = None
+        c.runs = runs
+        if n is None:
+            n_runs = (len(runs) - 1) >> 1
+            if n_runs <= _RUN_SMALL:  # scalar beats numpy overhead
+                n = sum(runs.tolist()[2::2]) + n_runs
+            else:
+                n = int(runs[2::2].astype(np.int64).sum()) + n_runs
+        c.n = n
+        c.mapped = mapped
+        return c
+
 
 def bitmap_words_to_values(words: np.ndarray) -> np.ndarray:
     """Expand u64 words → sorted u32 value vector (vectorized)."""
@@ -318,6 +538,244 @@ def bitmap_words_to_values(words: np.ndarray) -> np.ndarray:
             + bit_idx.astype(np.uint32))
 
 
+# --- run-container helpers ---------------------------------------------------
+# A run buffer is a little-endian u16 vector [R, s0, l0-1, s1, l1-1, ...]
+# — exactly the wire block, so snapshots write it verbatim and mmap
+# loads view it zero-copy. Invariants (Container.check): starts
+# strictly increasing, intervals non-overlapping AND non-adjacent
+# (adjacent runs must be merged), n == Σ lengths.
+
+
+def _build_runs(starts, ends) -> np.ndarray:
+    """Wire-form run buffer from int64 starts/exclusive-ends vectors."""
+    n_runs = len(starts)
+    buf = np.empty(1 + 2 * n_runs, dtype="<u2")
+    buf[0] = n_runs
+    buf[1::2] = starts
+    buf[2::2] = np.asarray(ends) - np.asarray(starts) - 1
+    return buf
+
+
+def _runs_starts_ends(runs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(starts, exclusive ends) of a run buffer as int64 vectors."""
+    starts = runs[1::2].astype(np.int64)
+    return starts, starts + runs[2::2].astype(np.int64) + 1
+
+
+def run_count_array(a: np.ndarray) -> int:
+    """Number of runs a sorted value vector would compress into."""
+    if not len(a):
+        return 0
+    return 1 + int(np.count_nonzero(np.diff(a) != 1))
+
+
+def run_count_words(words: np.ndarray) -> int:
+    """Number of runs in a u64[1024] bitmap: set bits whose predecessor
+    bit is clear, counted across word boundaries in one vector pass
+    (the popcount((x << 1) &~ x) trick from arXiv:1709.07821 §3)."""
+    carry = np.concatenate(([np.uint64(0)],
+                            words[:-1] >> np.uint64(63)))
+    shifted = (words << np.uint64(1)) | carry
+    return int(np.bitwise_count(words & ~shifted).sum())
+
+
+def values_to_runs(vals: np.ndarray) -> np.ndarray:
+    """Sorted unique low-16-bit values → wire-form run buffer."""
+    if not len(vals):
+        return np.zeros(1, dtype="<u2")
+    v = vals.astype(np.int64)
+    brk = np.flatnonzero(np.diff(v) != 1)
+    starts = v[np.concatenate(([0], brk + 1))]
+    lasts = v[np.concatenate((brk, [len(v) - 1]))]
+    return _build_runs(starts, lasts + 1)
+
+
+def runs_to_values(runs: np.ndarray) -> np.ndarray:
+    """Run buffer → sorted u32 value vector (vectorized decode)."""
+    starts, ends = _runs_starts_ends(runs)
+    lens = ends - starts
+    total = int(lens.sum())
+    if not total:
+        return _EMPTY_U32
+    offs = np.concatenate(([0], np.cumsum(lens[:-1])))
+    return (np.repeat(starts - offs, lens)
+            + np.arange(total)).astype(np.uint32)
+
+
+def runs_to_words(runs: np.ndarray) -> np.ndarray:
+    """Run buffer → dense u64[1024] words — the device decode step:
+    residency uploads blit this straight into bit-plane slabs (see
+    ops.packed). Boundary-mark + cumsum, O(2^16) regardless of
+    cardinality; the non-adjacency invariant guarantees every mark
+    index is distinct, so plain fancy assignment is safe."""
+    starts, ends = _runs_starts_ends(runs)
+    mark = np.zeros((1 << 16) + 1, dtype=np.int8)
+    mark[starts] = 1
+    mark[ends] = -1
+    cov = np.cumsum(mark[:-1], dtype=np.int8).astype(np.uint8)
+    return np.packbits(cov, bitorder="little").view("<u8")
+
+
+def _runs_member(runs: np.ndarray, vals: np.ndarray) -> np.ndarray:
+    """Boolean membership mask of sorted values against a run buffer —
+    one searchsorted over the starts (the vectorized form of the
+    galloping probe)."""
+    starts = runs[1::2]
+    if not len(starts):
+        return np.zeros(len(vals), dtype=bool)
+    i = np.searchsorted(starts, vals, side="right").astype(np.int64) - 1
+    safe = np.maximum(i, 0)
+    lasts = starts[safe].astype(np.int64) + runs[2::2][safe].astype(np.int64)
+    return (i >= 0) & (vals.astype(np.int64) <= lasts)
+
+
+def _runs_coverage_at(runs: np.ndarray, xs: np.ndarray) -> np.ndarray:
+    """Σ |[s,e) ∩ [0,x)| per x — prefix coverage of a run set, one
+    searchsorted + clip (no event sweep)."""
+    starts, ends = _runs_starts_ends(runs)
+    lens = ends - starts
+    prefix = np.concatenate(([0], np.cumsum(lens)))
+    k = np.searchsorted(starts, xs, side="right")
+    km = np.maximum(k - 1, 0)
+    overshoot = np.where(k > 0,
+                         np.clip(ends[km] - xs, 0, lens[km]), 0)
+    return prefix[k] - overshoot
+
+
+# Interval-list size (Ra + Rb) below which the run algebra takes
+# plain-int scalar paths: well-compressed containers hold 1-3 runs,
+# where ~10 vectorized numpy calls of fixed ~1.5 us overhead each cost
+# 10x the actual work.
+_RUN_SMALL = 16
+
+
+def _run_overlap_count(a_runs: np.ndarray, b_runs: np.ndarray) -> int:
+    """|A ∩ B| for two run sets: each A-interval's overlap with B is a
+    prefix-coverage difference — the intersection_count fast path for
+    the Count(Intersect) serving shape (no merged interval list is
+    ever built). Tiny lists take a scalar two-pointer merge."""
+    n_a = (len(a_runs) - 1) >> 1
+    n_b = (len(b_runs) - 1) >> 1
+    if not n_a or not n_b:
+        return 0
+    if n_a + n_b <= _RUN_SMALL:
+        al, bl = a_runs.tolist(), b_runs.tolist()
+        total = 0
+        i = j = 0
+        while i < n_a and j < n_b:
+            s1 = al[1 + 2 * i]
+            e1 = s1 + al[2 + 2 * i] + 1
+            s2 = bl[1 + 2 * j]
+            e2 = s2 + bl[2 + 2 * j] + 1
+            lo, hi = max(s1, s2), min(e1, e2)
+            if hi > lo:
+                total += hi - lo
+            if e1 <= e2:
+                i += 1
+            else:
+                j += 1
+        return total
+    sa, ea = _runs_starts_ends(a_runs)
+    return int((_runs_coverage_at(b_runs, ea)
+                - _runs_coverage_at(b_runs, sa)).sum())
+
+
+def _interval_combine_small(a_runs: np.ndarray, b_runs: np.ndarray,
+                            op: str) -> np.ndarray:
+    """Scalar event sweep for tiny interval lists (see _RUN_SMALL)."""
+    evs = []
+    for runs, da, db in ((a_runs, 1, 0), (b_runs, 0, 1)):
+        rl = runs.tolist()
+        for i in range((len(rl) - 1) >> 1):
+            s = rl[1 + 2 * i]
+            e = s + rl[2 + 2 * i] + 1
+            evs.append((s, da, db))
+            evs.append((e, -da, -db))
+    evs.sort()
+    res_s: list[int] = []
+    res_e: list[int] = []
+    ca = cb = 0
+    k, n = 0, len(evs)
+    while k < n:
+        pos = evs[k][0]
+        while k < n and evs[k][0] == pos:
+            ca += evs[k][1]
+            cb += evs[k][2]
+            k += 1
+        if k >= n:
+            break
+        if op == "and":
+            act = ca > 0 and cb > 0
+        elif op == "or":
+            act = ca > 0 or cb > 0
+        elif op == "andnot":
+            act = ca > 0 and cb <= 0
+        else:  # xor
+            act = (ca > 0) != (cb > 0)
+        if act:
+            if res_e and res_e[-1] == pos:
+                res_e[-1] = evs[k][0]
+            else:
+                res_s.append(pos)
+                res_e.append(evs[k][0])
+    if not res_s:
+        return np.zeros(1, dtype="<u2")
+    flat = [len(res_s)]
+    for s, e in zip(res_s, res_e):
+        flat.append(s)
+        flat.append(e - s - 1)
+    return np.array(flat, dtype="<u2")
+
+
+def _interval_combine(a_runs: np.ndarray, b_runs: np.ndarray,
+                      op: str) -> np.ndarray:
+    """The run×run algebra engine: boundary-event sweep over both
+    interval sets, one argsort + two cumsums, emitting the merged runs
+    where the per-operand coverage satisfies ``op`` (and/or/andnot/
+    xor). O((Ra+Rb) log(Ra+Rb)) — never touches cardinality. Tiny
+    lists (the well-compressed common case) take the scalar sweep."""
+    if len(a_runs) + len(b_runs) <= 2 * _RUN_SMALL + 2:
+        return _interval_combine_small(a_runs, b_runs, op)
+    sa, ea = _runs_starts_ends(a_runs)
+    sb, eb = _runs_starts_ends(b_runs)
+    na, nb = len(sa), len(sb)
+    pos = np.concatenate([sa, ea, sb, eb])
+    da = np.zeros(2 * (na + nb), dtype=np.int64)
+    da[:na] = 1
+    da[na:2 * na] = -1
+    db = np.zeros(2 * (na + nb), dtype=np.int64)
+    db[2 * na:2 * na + nb] = 1
+    db[2 * na + nb:] = -1
+    order = np.argsort(pos, kind="stable")
+    pos = pos[order]
+    ca = np.cumsum(da[order])
+    cb = np.cumsum(db[order])
+    # Collapse duplicate boundary positions: the coverage between two
+    # distinct positions is the cumsum at the LAST event of the lower.
+    if len(pos) > 1:
+        last = np.concatenate((pos[1:] != pos[:-1], [True]))
+        pos, ca, cb = pos[last], ca[last], cb[last]
+    ina, inb = ca > 0, cb > 0
+    if op == "and":
+        act = ina & inb
+    elif op == "or":
+        act = ina | inb
+    elif op == "andnot":
+        act = ina & ~inb
+    else:  # xor
+        act = ina ^ inb
+    idx = np.flatnonzero(act[:-1]) if len(pos) > 1 else \
+        np.empty(0, dtype=np.int64)
+    if not len(idx):
+        return np.zeros(1, dtype="<u2")
+    # Segments tile the breakpoint span, so consecutive kept indices
+    # are adjacent intervals — merge each consecutive group into one run.
+    brk = np.flatnonzero(np.diff(idx) != 1) + 1
+    gs = np.concatenate(([0], brk))
+    ge = np.concatenate((brk, [len(idx)]))
+    return _build_runs(pos[idx[gs]], pos[idx[ge - 1] + 1])
+
+
 # --- container set algebra (vectorized; native C++ when available) -----------
 
 # Per-(op, operand-kind) call counters — the per-container-type
@@ -326,16 +784,21 @@ def bitmap_words_to_values(words: np.ndarray) -> np.ndarray:
 # inline (GIL-coarse increments; a rare lost count is acceptable for
 # metrics), published as pilosa_roaring_container_ops_total by the
 # runtime collector (obs.runtime).
-OP_KINDS = ("array_array", "array_bitmap", "bitmap_bitmap")
+OP_KINDS = ("array_array", "array_bitmap", "bitmap_bitmap",
+            "run_run", "run_array", "run_bitmap")
 _OPS = ("intersect", "intersection_count", "union", "difference", "xor")
 _OP_COUNTS: dict[tuple[str, str], int] = {
     (op, kind): 0 for op in _OPS for kind in OP_KINDS}
 
+# Canonical pair naming order for the operand-kind label.
+_KIND_ORDER = {"run": 0, "array": 1, "bitmap": 2}
+
 
 def _op_kind(a: Container, b: Container) -> str:
-    if a.is_array():
-        return "array_array" if b.is_array() else "array_bitmap"
-    return "array_bitmap" if b.is_array() else "bitmap_bitmap"
+    ka, kb = a.kind(), b.kind()
+    if _KIND_ORDER[kb] < _KIND_ORDER[ka]:
+        ka, kb = kb, ka
+    return f"{ka}_{kb}"
 
 
 def op_counts() -> dict[tuple[str, str], int]:
@@ -351,7 +814,12 @@ _BITMAP_WORDS = 1024
 def _scan_words(c: Container) -> int:
     """Word-equivalents one operand contributes: a bitmap container is
     a full 1024-word scan; an array container counts its elements at
-    64 per word (the comparable memory-traffic unit)."""
+    64 per word (the comparable memory-traffic unit); a run container
+    counts its interval list's bytes at 8 per word — the whole point of
+    runs showing up in the ledger is that this number collapses on
+    sorted data."""
+    if c.runs is not None:
+        return max(1, (int(c.runs.size) * 2) >> 3)
     if c.is_array():
         return (len(c.array) + 63) >> 6
     return _BITMAP_WORDS
@@ -372,9 +840,60 @@ def _bump(op: str, a: Container, b: Container) -> None:
                                _scan_words(a) + _scan_words(b))
 
 
+# Size ratio past which a sorted-array intersection switches from the
+# linear two-pointer merge to binary-search probes of the small side
+# into the large (the galloping/skewed strategy of arXiv:1709.07821
+# §4.2 — vectorized here as one searchsorted_membership pass).
+_GALLOP_RATIO = 64
+
+
+def _skewed(a: np.ndarray, b: np.ndarray) -> bool:
+    na, nb = len(a), len(b)
+    return min(na, nb) * _GALLOP_RATIO < max(na, nb)
+
+
+def _settle(c: Container) -> Container:
+    """Pick the smallest representation for an algebra result that came
+    out as runs (the output half of the cardinality-adaptive kernel
+    selection — a 3-run intersection result should not stay a run
+    container if 2 array values are smaller)."""
+    c.optimize()
+    return c
+
+
+def _as_runs(c: Container) -> np.ndarray:
+    """Operand's interval form for the run×run engine (arrays convert
+    in O(n); callers keep bitmaps on the word path instead)."""
+    if c.runs is not None:
+        return c.runs
+    return values_to_runs(c.array)
+
+
 def _intersect(a: Container, b: Container) -> Container:
     _bump("intersect", a, b)
+    ra, rb = a.runs is not None, b.runs is not None
+    if ra or rb:
+        if (ra or a.bitmap is None) and (rb or b.bitmap is None):
+            if ra != rb:
+                # run ∩ array via membership probes of the array into
+                # the run list — O(n_array log R), no interval sweep.
+                run, arr = (a, b) if ra else (b, a)
+                return Container.from_array(
+                    arr.array[_runs_member(run.runs, arr.array)])
+            return _settle(Container.from_runs(
+                _interval_combine(a.runs, b.runs, "and")))
+        run, bmp = (a, b) if ra else (b, a)
+        words = runs_to_words(run.runs) & bmp.bitmap
+        c = Container.from_bitmap(words)
+        c._maybe_convert()
+        return c
     if a.is_array() and b.is_array():
+        if _skewed(a.array, b.array):
+            small, big = ((a.array, b.array)
+                          if len(a.array) <= len(b.array)
+                          else (b.array, a.array))
+            hit, _ = searchsorted_membership(big, small)
+            return Container.from_array(small[hit])
         out = native.intersect_sorted_u32(a.array, b.array)
         return Container.from_array(out)
     if a.is_array() != b.is_array():
@@ -391,7 +910,21 @@ def _intersect(a: Container, b: Container) -> Container:
 
 def _intersection_count(a: Container, b: Container) -> int:
     _bump("intersection_count", a, b)
+    ra, rb = a.runs is not None, b.runs is not None
+    if ra or rb:
+        if ra and rb:
+            return _run_overlap_count(a.runs, b.runs)
+        run, other = (a, b) if ra else (b, a)
+        if other.bitmap is None:
+            return int(_runs_member(run.runs, other.array).sum())
+        return native.popcnt_and(runs_to_words(run.runs), other.bitmap)
     if a.is_array() and b.is_array():
+        if _skewed(a.array, b.array):
+            small, big = ((a.array, b.array)
+                          if len(a.array) <= len(b.array)
+                          else (b.array, a.array))
+            hit, _ = searchsorted_membership(big, small)
+            return int(hit.sum())
         return native.intersection_count_sorted_u32(a.array, b.array)
     if a.is_array() != b.is_array():
         arr, bmp = (a, b) if a.is_array() else (b, a)
@@ -404,6 +937,15 @@ def _intersection_count(a: Container, b: Container) -> int:
 
 def _union(a: Container, b: Container) -> Container:
     _bump("union", a, b)
+    ra, rb = a.runs is not None, b.runs is not None
+    if ra or rb:
+        if (ra or a.bitmap is None) and (rb or b.bitmap is None):
+            return _settle(Container.from_runs(
+                _interval_combine(_as_runs(a), _as_runs(b), "or")))
+        run, bmp = (a, b) if ra else (b, a)
+        c = Container.from_bitmap(runs_to_words(run.runs) | bmp.bitmap)
+        c._maybe_convert()
+        return c
     if a.is_array() and b.is_array():
         out = np.union1d(a.array, b.array).astype(np.uint32)
         c = Container.from_array(out)
@@ -417,6 +959,18 @@ def _union(a: Container, b: Container) -> Container:
 
 def _difference(a: Container, b: Container) -> Container:
     _bump("difference", a, b)
+    ra, rb = a.runs is not None, b.runs is not None
+    if ra or rb:
+        if not ra and a.bitmap is None:  # array \ run: membership drop
+            return Container.from_array(
+                a.array[~_runs_member(b.runs, a.array)])
+        if (ra or a.bitmap is None) and (rb or b.bitmap is None):
+            return _settle(Container.from_runs(
+                _interval_combine(_as_runs(a), _as_runs(b), "andnot")))
+        words = a.as_words() & ~b.as_words()
+        c = Container.from_bitmap(words)
+        c._maybe_convert()
+        return c
     if a.is_array():
         av = a.array
         if b.is_array():
@@ -434,6 +988,15 @@ def _difference(a: Container, b: Container) -> Container:
 
 def _xor(a: Container, b: Container) -> Container:
     _bump("xor", a, b)
+    ra, rb = a.runs is not None, b.runs is not None
+    if ra or rb:
+        if (ra or a.bitmap is None) and (rb or b.bitmap is None):
+            return _settle(Container.from_runs(
+                _interval_combine(_as_runs(a), _as_runs(b), "xor")))
+        words = a.as_words() ^ b.as_words()
+        c = Container.from_bitmap(words)
+        c._maybe_convert()
+        return c
     if a.is_array() and b.is_array():
         out = np.setxor1d(a.array, b.array, assume_unique=True).astype(np.uint32)
         c = Container.from_array(out)
@@ -648,6 +1211,12 @@ class Bitmap:
         conts = [containers[i] for i in idx.tolist()]
         added = 0
         n_g = len(conts)
+        for c in conts:
+            # Bulk paths transparently upgrade run containers to the
+            # legacy kind before merging; optimize() re-compresses
+            # after the batch (import contract, arXiv:1709.07821 §2.1).
+            if c.runs is not None:
+                c._run_to_legacy()
         bm_mask = np.fromiter((c.bitmap is not None for c in conts),
                               bool, n_g)
         for gi in np.flatnonzero(bm_mask).tolist():
@@ -759,6 +1328,9 @@ class Bitmap:
         pres = np.flatnonzero(present)
         pres_conts = [containers[int(i)] for i in idx[pres]]
         n_p = len(pres_conts)
+        for c in pres_conts:
+            if c.runs is not None:
+                c._run_to_legacy()
         live = np.fromiter((c.n > 0 for c in pres_conts), bool, n_p)
         is_bm = np.fromiter((c.bitmap is not None for c in pres_conts),
                             bool, n_p)
@@ -1006,13 +1578,42 @@ class Bitmap:
                     types[g] = 1
                     ptrs[g] = c.bitmap.__array_interface__["data"][0]
                     ns[g] = c.n
+                elif c.runs is not None:
+                    # Run groups ship as type 2: the engine decodes the
+                    # wire-form interval buffer and merges through the
+                    # array path (the "transparent upgrade" contract —
+                    # output is array or bitmap, never runs).
+                    types[g] = 2
+                    ptrs[g] = c.runs.__array_interface__["data"][0]
+                    ns[g] = c.n
                 else:
                     a = c.array
                     types[g] = 0
                     ptrs[g] = a.__array_interface__["data"][0]
                     ns[g] = len(a)
 
-        arr_mask = types == 0
+        if not set:
+            # Transparent-upgrade, remove leg: the engine's non-bitmap
+            # remove output is array-kind only, so a run group whose
+            # cardinality exceeds ARRAY_MAX_SIZE must go in as a bitmap
+            # (the in-place branch, whose n<=4096 rule re-unpacks) — an
+            # oversized array result would violate the serialization
+            # invariant and be mis-sized as a bitmap block on snapshot.
+            for g in np.flatnonzero((types == 2)
+                                    & (ns > ARRAY_MAX_SIZE)).tolist():
+                c = conts[g]
+                c._to_bitmap()
+                self._guard_inplace(c)
+                types[g] = 1
+                ptrs[g] = c.bitmap.__array_interface__["data"][0]
+                if table is not None:
+                    table.types[gi[g]] = 1
+                    table.ptrs[gi[g]] = ptrs[g]
+                    table.bufs[gi[g]] = c.bitmap
+
+        # Capacity masks: run groups (type 2) consume array-output
+        # space like array groups (ns holds their cardinality).
+        arr_mask = types != 1
         total_chunk = len(chunk_vals)
         changed = np.empty(total_chunk, dtype=np.uint64)
         wal_buf = (np.empty(total_chunk * OP_SIZE, dtype=np.uint8)
@@ -1037,7 +1638,7 @@ class Bitmap:
                 changed, wal_buf, wal_type)
         else:
             cap = int(ns[arr_mask].sum()) + \
-                int((~arr_mask).sum()) * ARRAY_MAX_SIZE
+                int((~arr_mask).sum()) * ARRAY_MAX_SIZE  # bitmap unpack room
             out_vals = np.empty(max(cap, 1), dtype=np.uint32)
             out_bitmaps = out_bm_idx = None
             n_changed = native.batch_remove(
@@ -1061,10 +1662,12 @@ class Bitmap:
                 # per-slice memcpy of <=16 KB is noise next to that.
                 c.array = out_vals[off:off + new_ns[g]].copy()
                 c.bitmap = None
+                c.runs = None
                 c.mapped = False
             elif kind == 1:
                 c.bitmap = out_bitmaps[bm_idx[g]].copy()
                 c.array = None
+                c.runs = None
                 c.mapped = False
                 c.cow = epoch
             c.n = new_ns[g]
@@ -1092,6 +1695,9 @@ class Bitmap:
         self._table = None
         changed_parts: list[np.ndarray] = []
         starts_l = starts.tolist()
+        for c in conts:
+            if c.runs is not None:
+                c._run_to_legacy()
         for g, c in enumerate(conts):
             chunk = chunk_vals[starts_l[g]:starts_l[g + 1]]
             base = np.uint64(int(group_keys[g]) << 16)
@@ -1182,7 +1788,8 @@ class Bitmap:
             if c.n:
                 keys_l.append(k)
                 vals_l.append(c.array if c.bitmap is None
-                              else bitmap_words_to_values(c.bitmap))
+                              and c.runs is None
+                              else c.values())
                 ns_l.append(c.n)
         if not keys_l:
             return _EMPTY_U64
@@ -1213,7 +1820,8 @@ class Bitmap:
                 if c.n:
                     keys_l.append(skeys[i])
                     vals_l.append(c.array if c.bitmap is None
-                                  else bitmap_words_to_values(c.bitmap))
+                                  and c.runs is None
+                                  else c.values())
                     ns_l.append(c.n)
         if not keys_l:
             return _EMPTY_U64
@@ -1240,11 +1848,18 @@ class Bitmap:
         """Largest set position, or 0 if empty (reference roaring.go Max)."""
         for key, c in zip(reversed(self.keys), reversed(self.containers)):
             if c.n:
+                if c.runs is not None:
+                    r = c.runs
+                    return ((key << 16) + int(r[-2]) + int(r[-1]))
                 if c.is_array():
                     return (key << 16) + int(c.array[-1])
                 w = int(np.flatnonzero(c.bitmap)[-1])
                 return (key << 16) + w * 64 + int(c.bitmap[w]).bit_length() - 1
         return 0
+
+    def rank(self, pos: int) -> int:
+        """Number of set positions <= pos (reference Rank semantics)."""
+        return self.count_range(0, pos + 1)
 
     def count_range(self, start: int, end: int) -> int:
         """Set bits in [start, end)."""
@@ -1360,7 +1975,11 @@ class Bitmap:
         if len(self.keys) and len(other.keys) and native.available():
             ta = self._table_for_read()
             tb = other._table_for_read()
-            if ta is not None and tb is not None:
+            if (ta is not None and tb is not None
+                    and not ta.has_runs and not tb.has_runs):
+                # The native crossing only dispatches array/bitmap
+                # pairs; run operands keep the per-container walk,
+                # whose run kernels are already vectorized.
                 return native.bitmap_intersection_count(
                     self._keys_np(), ta.types, ta.ptrs, ta.ns,
                     other._keys_np(), tb.types, tb.ptrs, tb.ns)
@@ -1450,6 +2069,69 @@ class Bitmap:
         for c in self.containers:
             c._unmap()
 
+    # -- representation optimization (run containers)
+
+    def optimize(self, keys: Optional[np.ndarray] = None) -> dict[str, int]:
+        """Cardinality-adaptive representation pass (the whole-bitmap
+        runOptimize of the Roaring papers): each container picks the
+        smallest of array/bitmap/run. Called after mutation batches
+        (fragment import contract); point-op and bulk write paths
+        transparently upgrade runs back to legacy kinds, so this is the
+        single place run containers are (re)introduced. When ``keys``
+        (sorted container keys) is given only those containers are
+        visited — the bulk-import path passes the touched keys so a
+        small import into a huge fragment stays O(touched), not O(all
+        containers). Returns visited-container counts by kind."""
+        self.version += 1
+        counts = {"array": 0, "bitmap": 0, "run": 0}
+        changed = False
+        if keys is None:
+            visit = self.containers
+        else:
+            ka = self._keys_np()
+            keys = np.asarray(keys, dtype=np.uint64)
+            idx = np.searchsorted(ka, keys)
+            ok = idx < len(ka)
+            sel = idx[ok][ka[idx[ok]] == keys[ok]]
+            visit = [self.containers[int(i)] for i in sel.tolist()]
+        for c in visit:
+            if not c.n:
+                continue
+            before = c.kind()
+            after = c.optimize()
+            counts[after] += 1
+            changed = changed or after != before
+        if changed:
+            # Types/pointers moved wholesale; the serialization table
+            # rebuilds on next read.
+            self._table = None
+            self._table_dirty.clear()
+        return counts
+
+    def container_stats(self) -> dict[str, dict[str, int]]:
+        """Live-container counts, resident bytes, and run-interval
+        totals by kind — the data source for the
+        pilosa_roaring_containers_live / _container_bytes gauges and
+        the CLI inspect summary."""
+        counts = {"array": 0, "bitmap": 0, "run": 0}
+        bytes_ = {"array": 0, "bitmap": 0, "run": 0}
+        intervals = 0
+        for c in self.containers:
+            if not c.n:
+                continue
+            if c.runs is not None:
+                counts["run"] += 1
+                bytes_["run"] += int(c.runs.size) * 2
+                intervals += (len(c.runs) - 1) >> 1
+            elif c.bitmap is None:
+                counts["array"] += 1
+                bytes_["array"] += len(c.array) * 4
+            else:
+                counts["bitmap"] += 1
+                bytes_["bitmap"] += BITMAP_N * 8
+        return {"counts": counts, "bytes": bytes_,
+                "intervals": {"run": intervals}}
+
     # -- integrity
 
     def check(self) -> None:
@@ -1465,12 +2147,21 @@ class Bitmap:
 
     def write_to(self, w) -> int:
         # Normalize representation so the n<=4096⇒array load rule holds even
-        # for bitmaps produced by set algebra.
+        # for bitmaps produced by set algebra (run containers are
+        # exempt — the runs flag bitset identifies them on disk).
         self._table = None  # normalization may swap representations
         for c in self.containers:
             c._maybe_convert()
-        live = [(k, c.array, c.bitmap, c.n)
-                for k, c in zip(self.keys, self.containers) if c.n > 0]
+        live = []
+        for k, c in zip(self.keys, self.containers):
+            if c.n <= 0:
+                continue
+            if c.runs is not None:
+                live.append((k, 2, c.runs, c.n))
+            elif c.bitmap is not None:
+                live.append((k, 1, c.bitmap, c.n))
+            else:
+                live.append((k, 0, c.array, c.n))
         return _write_snapshot(live, w)
 
     def _flush_table_dirty(self) -> None:
@@ -1500,10 +2191,15 @@ class Bitmap:
             if i >= len(keys) or keys[i] != key:
                 continue
             c = conts[i]
-            b = c.bitmap if c.bitmap is not None else c.array
+            if c.runs is not None:
+                b = c.runs
+                t.types[i] = 2
+                t.has_runs = True
+            else:
+                b = c.bitmap if c.bitmap is not None else c.array
+                t.types[i] = 0 if c.bitmap is None else 1
             t.bufs[i] = b
             t.ns[i] = c.n
-            t.types[i] = 0 if c.bitmap is None else 1
             t.ptrs[i] = b.__array_interface__["data"][0]
         dirty.clear()
 
@@ -1518,6 +2214,13 @@ class Bitmap:
         ptrs = np.empty(n, dtype=np.uint64)
         bufs: list = [None] * n
         for i, c in enumerate(self.containers):
+            if c.runs is not None:
+                b = c.runs
+                bufs[i] = b
+                ns[i] = c.n
+                types[i] = 2
+                ptrs[i] = b.__array_interface__["data"][0]
+                continue
             if c.n and (c.bitmap is not None) != (c.n > ARRAY_MAX_SIZE):
                 c._maybe_convert()
             b = c.bitmap if c.bitmap is not None else c.array
@@ -1573,10 +2276,24 @@ class Bitmap:
         buf = memoryview(data)
         if len(buf) < HEADER_SIZE:
             raise ValueError("data too small")
-        if int.from_bytes(buf[0:4], "little") != COOKIE:
+        cookie = int.from_bytes(buf[0:4], "little")
+        if cookie not in (COOKIE, COOKIE_RUNS):
             raise ValueError("invalid roaring file")
         key_n = int.from_bytes(buf[4:8], "little")
-        if HEADER_SIZE + key_n * 16 > len(buf):
+        hdr_off = HEADER_SIZE
+        run_mask = None
+        if cookie == COOKIE_RUNS:
+            flag_len = _run_flags_len(key_n)
+            if HEADER_SIZE + flag_len > len(buf):
+                raise ValueError(
+                    f"run flags out of bounds: keyN={key_n},"
+                    f" len={len(buf)}")
+            run_mask = np.unpackbits(
+                np.frombuffer(buf, np.uint8, count=flag_len,
+                              offset=HEADER_SIZE),
+                bitorder="little")[:key_n].astype(bool)
+            hdr_off += flag_len
+        if hdr_off + key_n * 16 > len(buf):
             raise ValueError(
                 f"header out of bounds: keyN={key_n}, len={len(buf)}")
         b = Bitmap()
@@ -1585,25 +2302,48 @@ class Bitmap:
         # fragment — the bulk of every open() and of the synchronous
         # remap reopen (the write path's worst per-op outlier).
         hdr_arr = np.frombuffer(buf, dtype=_HDR_DTYPE, count=key_n,
-                                offset=HEADER_SIZE)
+                                offset=hdr_off)
         ns = (hdr_arr["n"].astype(np.int64) + 1)
         offs = np.frombuffer(buf, dtype="<u4", count=key_n,
-                             offset=HEADER_SIZE + key_n * 12
+                             offset=hdr_off + key_n * 12
                              ).astype(np.int64)
         is_arr_mask = ns <= ARRAY_MAX_SIZE
         sizes = _container_sizes(ns)
+        if run_mask is not None and run_mask.any():
+            # Run block sizes come from each block's own numRuns
+            # prefix (2 + 4R bytes); validate the prefix read first.
+            sizes = sizes.copy()
+            for i in np.flatnonzero(run_mask).tolist():
+                off = int(offs[i])
+                if off + 2 > len(buf):
+                    raise ValueError(
+                        f"run block out of bounds: off={off},"
+                        f" len={len(buf)}")
+                sizes[i] = 2 + 4 * int.from_bytes(buf[off:off + 2],
+                                                  "little")
         if key_n and int((offs + sizes).max()) > len(buf):
             bad = int(offs[np.argmax(offs + sizes)])
             raise ValueError(
                 f"offset out of bounds: off={bad}, len={len(buf)}")
         b.keys = hdr_arr["key"].tolist()
-        ops_offset = HEADER_SIZE + key_n * 16
+        ops_offset = hdr_off + key_n * 16
         end = HEADER_SIZE
         containers = b.containers
-        for off, n, is_arr in zip(offs.tolist(), ns.tolist(),
-                                  is_arr_mask.tolist()):
+        run_list = (run_mask.tolist() if run_mask is not None
+                    else [False] * key_n)
+        for off, n, is_arr, is_run in zip(offs.tolist(), ns.tolist(),
+                                          is_arr_mask.tolist(),
+                                          run_list):
             c = Container.__new__(Container)
-            if is_arr:
+            c.runs = None
+            if is_run:
+                n_runs = int.from_bytes(buf[off:off + 2], "little")
+                runs = np.frombuffer(buf, dtype="<u2",
+                                     count=1 + 2 * n_runs, offset=off)
+                c.runs = runs if mapped else runs.copy()
+                c.array = None
+                c.bitmap = None
+            elif is_arr:
                 arr = np.frombuffer(buf, dtype="<u4", count=n,
                                     offset=off)
                 c.array = arr if mapped else arr.copy()
@@ -1637,6 +2377,7 @@ def _shared_view(c: Container) -> Container:
     """A container sharing c's data, mapped (copy-on-write)."""
     out = Container()
     out.array, out.bitmap, out.n, out.mapped = c.array, c.bitmap, c.n, True
+    out.runs = c.runs
     return out
 
 
@@ -1654,13 +2395,16 @@ class _SerTable:
     structural changes (new containers from point ops, bulk rewrites)
     invalidate wholesale."""
 
-    __slots__ = ("ns", "types", "ptrs", "bufs")
+    __slots__ = ("ns", "types", "ptrs", "bufs", "has_runs")
 
     def __init__(self, ns, types, ptrs, bufs):
         self.ns = ns          # int64: container cardinality
-        self.types = types    # uint8: 0=array, 1=bitmap
+        self.types = types    # uint8: 0=array, 1=bitmap, 2=run
         self.ptrs = ptrs      # uint64: buffer data pointers
         self.bufs = bufs      # the buffer objects (keep pointers alive)
+        # Pessimistic run-presence flag gating native fast paths that
+        # only speak array/bitmap; patch sites may only raise it.
+        self.has_runs = bool((types == 2).any())
 
     def insert(self, pos: np.ndarray, empties: int) -> "_SerTable":
         """New table with empty-array entries inserted at ``pos``
@@ -1687,7 +2431,7 @@ class _Frozen:
     Buffer refs pin the captured arrays; the COW epoch bump taken at
     freeze() time guarantees no in-place mutation of them."""
 
-    __slots__ = ("keys", "ns", "types", "ptrs", "bufs")
+    __slots__ = ("keys", "ns", "types", "ptrs", "bufs", "has_runs")
 
     def __init__(self, keys, ns, types, ptrs, bufs):
         self.keys = keys
@@ -1695,26 +2439,32 @@ class _Frozen:
         self.types = types
         self.ptrs = ptrs
         self.bufs = bufs
+        self.has_runs = bool((types == 2).any())
 
     def as_live_tuples(self) -> list[tuple]:
-        """(key, array, bitmap, n) rows — the Python-serializer form."""
+        """(key, kind, buf, n) rows — the Python-serializer form."""
         out = []
         for k, n, t, b in zip(self.keys.tolist(), self.ns.tolist(),
                               self.types.tolist(), self.bufs):
             if n:
-                out.append((k, None if t else b, b if t else None, n))
+                out.append((k, t, b, n))
         return out
 
 
 def write_frozen(frozen, w) -> int:
     """Serialize a Bitmap.freeze() capture (no locks needed). Real
     files take the native writev path (zero copy, no GIL during the
-    write); BytesIO targets and native-less hosts serialize via the
-    Python writer."""
+    write); BytesIO targets, native-less hosts, and captures holding
+    run containers (the C writer speaks the legacy cookie only)
+    serialize via the Python writer."""
     if isinstance(frozen, list):  # legacy tuple-list form
-        return _write_snapshot(frozen, w)
+        live = [t if isinstance(t[1], (int, np.integer))
+                else (t[0], 0 if t[2] is None else 1,
+                      t[1] if t[2] is None else t[2], t[3])
+                for t in frozen]
+        return _write_snapshot(live, w)
     fileno = getattr(w, "fileno", None)
-    if fileno is not None and native.available():
+    if fileno is not None and native.available() and not frozen.has_runs:
         try:
             fd = w.fileno()
         except (OSError, io.UnsupportedOperation):
@@ -1738,7 +2488,21 @@ def _base_u8_window(base: np.ndarray, ptr: int, nbytes: int) -> np.ndarray:
     return b8[off:off + nbytes]
 
 
+def _run_flags_len(n_cont: int) -> int:
+    """Bytes the runs-cookie flag bitset occupies for ``n_cont``
+    containers: ceil(n/8), rounded up to a multiple of 8 so every
+    container block that follows stays even-aligned."""
+    return ((n_cont + 7) >> 3) + (-((n_cont + 7) >> 3) % 8)
+
+
+_BLOCK_DTYPES = ("<u4", "<u8", "<u2")  # kind 0=array, 1=bitmap, 2=run
+
+
 def _write_snapshot(live: list[tuple], w) -> int:
+    """Serialize (key, kind, buf, n) rows. With no run containers the
+    output is byte-identical to the legacy 12346 format; any run
+    container switches the snapshot to the 12347 runs cookie, which
+    inserts the run-flag bitset between keyN and the headers."""
     n_cont = len(live)
     # Header via numpy, payload via one join + one write: a snapshot
     # used to issue one write() per container (16 K syscalls for a
@@ -1748,8 +2512,24 @@ def _write_snapshot(live: list[tuple], w) -> int:
     hdr["key"] = np.fromiter((t[0] for t in live), np.uint64, n_cont)
     ns = np.fromiter((t[3] for t in live), np.uint32, n_cont)
     hdr["n"] = ns - 1
-    sizes = _container_sizes(ns)
-    data_start = HEADER_SIZE + n_cont * 12 + n_cont * 4
+    kinds = np.fromiter((t[1] for t in live), np.uint8, n_cont)
+    has_runs = bool((kinds == 2).any())
+    if has_runs:
+        sizes = np.where(
+            kinds == 2,
+            np.fromiter((t[2].size * 2 if t[1] == 2 else 0
+                         for t in live), np.int64, n_cont),
+            _container_sizes(ns))
+        flags = np.zeros(_run_flags_len(n_cont), dtype=np.uint8)
+        flags[:((n_cont + 7) >> 3)] = np.packbits(kinds == 2,
+                                                  bitorder="little")
+        flag_bytes = flags.tobytes()
+        cookie = COOKIE_RUNS
+    else:
+        sizes = _container_sizes(ns)
+        flag_bytes = b""
+        cookie = COOKIE
+    data_start = HEADER_SIZE + len(flag_bytes) + n_cont * 12 + n_cont * 4
     offsets = data_start + np.concatenate(
         ([0], np.cumsum(sizes[:-1], dtype=np.int64))) \
         if n_cont else np.empty(0, np.int64)
@@ -1758,11 +2538,13 @@ def _write_snapshot(live: list[tuple], w) -> int:
     # this replaces cost ~2x more at 13 K+ containers (concatenate
     # iterates the list in C). LE byte views are free on LE hosts;
     # the rare BE/non-contiguous container falls back to a cast.
-    head = (COOKIE.to_bytes(4, "little")
+    head = (cookie.to_bytes(4, "little")
             + n_cont.to_bytes(4, "little")
+            + flag_bytes
             + hdr.tobytes() + offsets.astype("<u4").tobytes())
     w.write(head)
-    total = data_start + int(sizes.sum()) if n_cont else HEADER_SIZE
+    total = data_start + int(sizes.sum()) if n_cont \
+        else HEADER_SIZE + len(flag_bytes)
     if n_cont:
         # Coalesce runs of payloads that are adjacent views of one
         # shared base buffer (the bulk-import global merge leaves every
@@ -1775,9 +2557,9 @@ def _write_snapshot(live: list[tuple], w) -> int:
         run_base = None
         run_start = 0
         run_len = 0
-        for _, array, bitmap, _n in live:
-            arr = array if bitmap is None else bitmap
-            dt = "<u4" if bitmap is None else "<u8"
+        for _, kind, buf, _n in live:
+            arr = buf
+            dt = _BLOCK_DTYPES[kind]
             if arr.dtype.str != dt or not arr.flags.c_contiguous:
                 arr = np.ascontiguousarray(arr, dtype=dt)
             ptr = arr.__array_interface__["data"][0]

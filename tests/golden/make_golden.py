@@ -15,6 +15,15 @@ Layout (all little-endian):
                                 bitmap (n > 4096): 1024 × u64
   op       := typ(u8: 0=add, 1=remove) value(u64) fnv1a32(of first 9B)(u32)
 
+Runs format (cookie 12347 — the SERIAL_COOKIE idiom of the optimized
+Roaring library paper, arXiv:1709.07821): identical except a run-flag
+bitset sits between containerN and the headers — ceil(containerN/8)
+bytes rounded up to a multiple of 8, little-endian bit order, bit i
+set ⇒ container i is a run container — and a flagged container's
+block is numRuns(u16) followed by numRuns (start u16, length-1 u16)
+pairs. Headers still carry cardinality-1. A snapshot with no run
+container MUST use cookie 12346 (byte-compatible with the vintage).
+
 Run ``python tests/golden/make_golden.py`` to (re)write the fixtures;
 test_golden.py asserts the committed bytes match this generator, so the
 fixtures cannot rot silently.
@@ -24,8 +33,56 @@ import os
 import struct
 
 COOKIE = 12346
+COOKIE_RUNS = 12347
 ARRAY_MAX = 4096
 HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _runs_of(vals: list[int]) -> list[tuple[int, int]]:
+    """[(start, length)] runs of a sorted value list."""
+    runs = []
+    for v in vals:
+        if runs and v == runs[-1][0] + runs[-1][1]:
+            runs[-1] = (runs[-1][0], runs[-1][1] + 1)
+        else:
+            runs.append((v, 1))
+    return runs
+
+
+def snapshot_runs(containers: list[tuple[int, list[int], bool]]) -> bytes:
+    """Runs-cookie snapshot. containers: sorted
+    [(key, sorted low-16-bit values, as_run)] — ``as_run`` containers
+    serialize as interval blocks and set their flag bit."""
+    n = len(containers)
+    header = struct.pack("<II", COOKIE_RUNS, n)
+    flag_len = ((n + 7) // 8 + 7) // 8 * 8
+    flags = bytearray(flag_len)
+    keys = b""
+    blocks = []
+    for i, (key, vals, as_run) in enumerate(containers):
+        assert vals == sorted(set(vals)) and all(0 <= v < 65536
+                                                 for v in vals)
+        keys += struct.pack("<QI", key, len(vals) - 1)
+        if as_run:
+            flags[i >> 3] |= 1 << (i & 7)
+            runs = _runs_of(vals)
+            blk = struct.pack("<H", len(runs))
+            for start, length in runs:
+                blk += struct.pack("<HH", start, length - 1)
+            blocks.append(blk)
+        elif len(vals) <= ARRAY_MAX:
+            blocks.append(struct.pack(f"<{len(vals)}I", *vals))
+        else:
+            words = [0] * 1024
+            for v in vals:
+                words[v >> 6] |= 1 << (v & 63)
+            blocks.append(struct.pack("<1024Q", *words))
+    offsets = b""
+    off = len(header) + flag_len + len(keys) + 4 * n
+    for blk in blocks:
+        offsets += struct.pack("<I", off)
+        off += len(blk)
+    return header + bytes(flags) + keys + offsets + b"".join(blocks)
 
 
 def fnv1a32(data: bytes) -> int:
@@ -76,6 +133,19 @@ def fixtures() -> dict[str, bytes]:
             (HIGH_KEY, [123]),
         ]),
     }
+    # Runs-format fixtures: a pure run container, a mixed snapshot
+    # (run + array + bitmap under one runs cookie), and a runs
+    # snapshot with a trailing op-log that replays against the run
+    # containers (interval split/extend on load).
+    out["runs.roaring"] = snapshot_runs([(0, RUN_VALUES, True)])
+    out["runs_mixed.roaring"] = snapshot_runs([
+        (0, ARRAY_VALUES, False),               # array block (not runny)
+        (1, RUN_VALUES, True),                  # run block
+        (2, BITMAP_LOWS, False),                # bitmap block
+        (HIGH_KEY, [7, 8, 9, 10, 500], True),   # run block, 48-bit key
+    ])
+    out["runs_oplog.roaring"] = (
+        out["runs.roaring"] + b"".join(op(t, v) for t, v in RUN_OPS))
     # Snapshot + appended op log (the on-disk WAL form a fragment file
     # has between snapshots, fragment.go:179-234).
     out["with_oplog.roaring"] = (
@@ -96,6 +166,15 @@ SIMPLE_VALUES = [1, 5, 100, 65535]
 BITMAP_LOWS = list(range(0, 10000, 2))       # 5000 values → bitmap kind
 HIGH_KEY = 1 << 21                           # a 48-bit container key
 OPS = [(0, 2 * 65536 + 7), (0, 5), (1, 100), (0, 42)]  # add/add/rm/add
+# Three intervals (one past ARRAY_MAX long, so no legacy kind round-trips
+# it as an array) + a lone value.
+RUN_VALUES = (list(range(100, 5000)) + list(range(60000, 60010)) + [65535])
+# Isolated values (every other) — optimize() must keep these an array
+# (5 single-value runs would cost 22 bytes vs the 20-byte array block).
+ARRAY_VALUES = [0, 2, 4, 6, 8]
+# Replay against runs: extend a run edge, split a run, add a new
+# container, remove a lone value (run deletion).
+RUN_OPS = [(0, 5000), (1, 2000), (0, 3 * 65536 + 9), (1, 65535)]
 
 
 def main(out_dir: str = HERE) -> None:

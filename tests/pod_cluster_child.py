@@ -63,15 +63,32 @@ def main() -> None:
         http("POST", h, "/index/i", b"{}")
         http("POST", h, "/index/i/frame/f", b"{}")
 
+    def q_retry(pql: str, deadline_s: float = 20.0):
+        # A's poll loop may have tripped its circuit breaker for B0
+        # while the pod was still initializing (pod mesh setup blocks
+        # B0's listener); the breaker's half-open probe / the server's
+        # active probe loop close it within a backoff window. Retry
+        # through that recovery window — an open circuit at this point
+        # is the breaker working as designed, not a test failure.
+        deadline = time.time() + deadline_s
+        while True:
+            try:
+                return query(host_a, "i", pql)
+            except RuntimeError as e:
+                if "circuit open" not in str(e) \
+                        or time.time() > deadline:
+                    raise
+                time.sleep(0.3)
+
     # Bits across 6 slices, routed by jump hash to A or the pod, and
     # inside the pod by slice % 2 — all through ONE client entry point.
     for s in range(6):
         for j in range(3):
-            query(host_a, "i", f"SetBit(frame=f, rowID=1,"
-                               f" columnID={s * SLICE_WIDTH + j})")
+            q_retry(f"SetBit(frame=f, rowID=1,"
+                    f" columnID={s * SLICE_WIDTH + j})")
         for j in range(2):
-            query(host_a, "i", f"SetBit(frame=f, rowID=2,"
-                               f" columnID={s * SLICE_WIDTH + j})")
+            q_retry(f"SetBit(frame=f, rowID=2,"
+                    f" columnID={s * SLICE_WIDTH + j})")
 
     # Wait for A to adopt the pod's max slice through the poll loop.
     deadline = time.time() + 30

@@ -55,6 +55,18 @@ def holder(tmp_path_factory):
                           replace=False)
         frame.import_bits(np.full(len(cols), row, dtype=np.uint64),
                           cols.astype(np.uint64))
+    # A run-heavy frame (timestamp-view shape: long dense column
+    # spans): the import optimize() pass stores these rows as run
+    # containers, so every device leg over it exercises the
+    # runs → bit-plane decode on the residency upload path.
+    runf = idx.create_frame("rf")
+    for row in range(N_ROWS):
+        start = int(rng.integers(0, (N_SLICES - 1) * SLICE_WIDTH))
+        span = np.arange(start, start + 40000, dtype=np.uint64)
+        runf.import_bits(np.full(len(span), row, dtype=np.uint64), span)
+    frag0 = holder.fragment("d", "rf", "standard", 0)
+    assert frag0 is not None and \
+        frag0.container_stats()["counts"]["run"] > 0
     # A BSI field with values spread over every slice (negative min:
     # the offset-space clamp paths matter).
     from pilosa_tpu.models.frame import Field
@@ -147,6 +159,55 @@ class TestRandomizedDeviceDifferential:
             got = _norm(fast.execute("d", q))
             want = _norm(slow.execute("d", q))
             assert got == want, q
+
+    @pytest.mark.parametrize("seed", [10, 11])
+    def test_run_backed_fragments_device_vs_host(self, executors, seed):
+        """Random trees over the run-container-backed frame: the
+        residency upload decodes runs to bit-plane slabs, and every
+        device answer must equal the host roaring-over-runs answer."""
+        fast, slow = executors
+        rng = np.random.default_rng(seed)
+
+        def leaf(_rng, _depth=None):
+            return (f"Bitmap(rowID={int(_rng.integers(N_ROWS + 1))},"
+                    f" frame=rf)")
+
+        for _ in range(8):
+            op = rng.choice(["Intersect", "Union", "Difference"])
+            q = f"Count({op}({leaf(rng)}, {leaf(rng)}))"
+            assert fast.execute("d", q) == slow.execute("d", q), q
+        ids = list(range(N_ROWS))
+        q = f"TopN({leaf(rng)}, frame=rf, n=4, ids={ids})"
+        assert _norm(fast.execute("d", q)) == \
+            _norm(slow.execute("d", q)), q
+
+    def test_sourceless_topn_in_program_topk(self, executors,
+                                             monkeypatch):
+        """The sourceless TopN refetch phase lowers to the in-program
+        top-k program (mesh.topn_topk_sharded): same pairs as the host
+        two-phase path, and the device leg must actually dispatch."""
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.parallel import mesh as mesh_mod
+        fast, slow = executors
+        # Force the two-phase path (the rank-cache single-pass answer
+        # would otherwise serve both executors host-side).
+        monkeypatch.setattr(Executor, "_topn_host_single_pass",
+                            lambda self, *a, **k: None)
+        calls = []
+        real = mesh_mod.topn_topk_sharded
+
+        def spy(*a, **k):
+            calls.append(a)
+            return real(*a, **k)
+
+        monkeypatch.setattr(mesh_mod, "topn_topk_sharded", spy)
+        for frame, n in (("f", 3), ("rf", 4), ("f", 0)):
+            q = f"TopN(frame={frame}, n={n})" if n else \
+                f"TopN(frame={frame})"
+            got = _norm(fast.execute("d", q))
+            want = _norm(slow.execute("d", q))
+            assert got == want, q
+        assert calls, "device top-k program never dispatched"
 
     def test_range_between_and_aggregates(self, executors):
         """The >< (between) circuit and Sum's fused plane-count lane."""

@@ -28,6 +28,14 @@ MULTI = (list(range(10))
          + [65536 + v for v in make_golden.BITMAP_LOWS]
          + [(make_golden.HIGH_KEY << 16) + 123])
 REPLAYED = sorted({1, 5, 65535, 42, 2 * 65536 + 7})
+RUNS = list(make_golden.RUN_VALUES)
+RUNS_MIXED = (list(make_golden.ARRAY_VALUES)
+              + [65536 + v for v in make_golden.RUN_VALUES]
+              + [2 * 65536 + v for v in make_golden.BITMAP_LOWS]
+              + [(make_golden.HIGH_KEY << 16) + v
+                 for v in (7, 8, 9, 10, 500)])
+RUNS_REPLAYED = sorted((set(make_golden.RUN_VALUES)
+                        | {5000, 3 * 65536 + 9}) - {2000, 65535})
 
 
 def load(name: str) -> bytes:
@@ -57,6 +65,9 @@ def test_generator_cli_writes_to_dir(tmp_path):
     ("simple_array.roaring", SIMPLE),
     ("multi_container.roaring", MULTI),
     ("with_oplog.roaring", REPLAYED),
+    ("runs.roaring", RUNS),
+    ("runs_mixed.roaring", RUNS_MIXED),
+    ("runs_oplog.roaring", RUNS_REPLAYED),
 ])
 def test_load_golden(name, expected):
     bm = Bitmap.unmarshal(memoryview(load(name)))
@@ -107,6 +118,62 @@ def test_mutate_appends_reference_ops(tmp_path):
     assert ops == make_golden.op(0, 777) + make_golden.op(1, 5)
     replayed = Bitmap.unmarshal(memoryview(raw))
     assert replayed.values().tolist() == sorted({1, 100, 65535, 777})
+
+
+class TestRunsGolden:
+    """Byte-level interchange for the 12347 runs format, both
+    directions, against the independent hand-assembled layout."""
+
+    def test_load_keeps_run_kind(self):
+        bm = Bitmap.unmarshal(memoryview(load("runs.roaring")))
+        assert bm.containers[0].is_run()
+        bm.check()
+
+    def test_emit_matches_golden(self):
+        """optimize() + marshal on a bitmap built through our API
+        emits the exact hand-assembled runs bytes (cookie, flag
+        bitset, cardinality headers, interval blocks)."""
+        bm = Bitmap()
+        bm.add_many(np.array(RUNS, dtype=np.uint64))
+        bm.optimize()
+        assert bm.containers[0].is_run()
+        assert bm.marshal() == load("runs.roaring")
+
+    def test_mixed_emit_matches_golden(self):
+        bm = Bitmap()
+        bm.add_many(np.array(RUNS_MIXED, dtype=np.uint64))
+        bm.optimize()
+        kinds = [c.kind() for c in bm.containers]
+        assert kinds == ["array", "run", "bitmap", "run"], kinds
+        assert bm.marshal() == load("runs_mixed.roaring")
+
+    def test_replay_mutates_runs_and_reserializes(self):
+        """Op-log replay against run containers (edge extension, run
+        split, run deletion) then canonical re-serialization."""
+        bm = Bitmap.unmarshal(memoryview(load("runs_oplog.roaring")))
+        assert bm.values().tolist() == RUNS_REPLAYED
+        ref = Bitmap()
+        ref.add_many(np.array(RUNS_REPLAYED, dtype=np.uint64))
+        ref.optimize()
+        got = Bitmap.unmarshal(memoryview(bm.marshal()))
+        got.check()
+        assert got.values().tolist() == RUNS_REPLAYED
+
+    def test_mapped_load_is_zero_copy_and_reserializes(self):
+        data = load("runs_mixed.roaring")
+        bm = Bitmap.unmarshal(memoryview(data), mapped=True)
+        run_conts = [c for c in bm.containers if c.is_run()]
+        assert run_conts and all(c.mapped for c in run_conts)
+        assert bm.marshal() == data
+
+    def test_no_runs_never_uses_runs_cookie(self):
+        """A snapshot whose optimize() picked no run containers must
+        stay byte-compatible with the legacy 12346 vintage."""
+        bm = Bitmap()
+        for v in (1, 5, 70000):
+            bm.add(v)
+        bm.optimize()
+        assert bm.marshal()[:4] == load("empty.roaring")[:4]
 
 
 def test_array_values_roundtrip_u32_width():

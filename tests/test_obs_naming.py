@@ -82,6 +82,22 @@ class TestRouteTableDocumented:
         # registered and documented.
         assert "/debug/failpoints" in swept
 
+    def test_roaring_container_metrics_registered(self):
+        """The run-container observability families (docs/STORAGE.md):
+        per-kind live-container and resident-byte gauges, and the op
+        counter whose kind label grew the run operand kinds."""
+        fams = obs_metrics.default_registry().families()
+        for name in ("pilosa_roaring_containers_live",
+                     "pilosa_roaring_container_bytes",
+                     "pilosa_roaring_container_ops_total"):
+            assert name in fams, name
+        for name in ("pilosa_roaring_containers_live",
+                     "pilosa_roaring_container_bytes"):
+            assert fams[name].type != "counter", name
+        from pilosa_tpu.storage import roaring
+        assert set(roaring.OP_KINDS) >= {"run_run", "run_array",
+                                         "run_bitmap"}
+
     def test_fault_metrics_registered(self):
         """The fault-layer metric families promised by
         docs/FAULT_TOLERANCE.md exist in the default registry (and so

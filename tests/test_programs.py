@@ -90,6 +90,27 @@ class TestFusedTree:
                             for r in range(R)]
 
 
+class TestTopKProgram:
+    def test_lo_sum_carry_does_not_break_order(self):
+        """The per-candidate lo-halves sum past 2^16 on dense rows, so
+        the in-program lexicographic sort must carry lo's overflow into
+        hi first: row A (per-slice counts 65535+65535 = 131070) must
+        outrank row B (65536 = hi 1, lo 0) even though B's raw hi is
+        larger (review finding)."""
+        m = mesh_mod.make_mesh(8)
+        S, W = 8, 2048  # 2048 u32 words = 65536 bits per slice
+        rows = np.zeros((S, 2, W), dtype=np.uint32)
+        rows[0, 0, :] = 0xFFFFFFFF
+        rows[1, 0, :] = 0xFFFFFFFF
+        rows[0, 0, 0] = 0xFFFFFFFE  # row 0: 65535 + 65535 = 131070
+        rows[1, 0, 0] = 0xFFFFFFFE
+        rows[0, 1, :] = 0xFFFFFFFF  # row 1: 65536
+        counts, idx = mesh_mod.topn_topk_sharded(
+            m, None, mesh_mod.shard_slices(m, rows), [], 2)
+        assert idx == [0, 1]
+        assert counts == [131070, 65536]
+
+
 class TestExecutorFusedTree:
     """Count+TopN multi-op queries lower into ONE fused device program
     through the executor, and agree with the host path exactly."""
