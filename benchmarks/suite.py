@@ -79,7 +79,7 @@ _CANONICAL_ARTIFACTS = {
 }
 
 
-def write_manifest() -> None:
+def write_manifest(partial: bool = False) -> None:
     """benchmarks/MANIFEST.json: THE index of benchmark truth — which
     artifact file is canonical per metric family, plus this pass's
     metrics with their same-pass canary (the measured tunnel sync
@@ -123,7 +123,11 @@ def write_manifest() -> None:
         prior_doc = {}
     prior = prior_doc.get("metrics", {})
     for k, v in prior.items():
-        if k.startswith("latency_") and k not in metrics:
+        # A partial pass (argv-selected configs) re-measures only its
+        # own families; everything else carries forward so the
+        # manifest stays the full index. Full passes carry only the
+        # latency_* entries (owned by latency_under_load.py).
+        if k not in metrics and (partial or k.startswith("latency_")):
             metrics[k] = v
     out = {
         "written_by": "benchmarks/suite.py",
@@ -135,6 +139,15 @@ def write_manifest() -> None:
         "first_vs_warm": first_vs_warm,
         "compile_cache": _compile_cache_snapshot(),
     }
+    if partial:
+        # A subset pass that measured no sync floor / warm tables /
+        # compile stats keeps the full pass's values on record.
+        if floor_ms <= 0:
+            out["canary"] = prior_doc.get("canary", out["canary"])
+        if not first_vs_warm:
+            out["first_vs_warm"] = prior_doc.get("first_vs_warm", {})
+        if not out["compile_cache"].get("programsBuilt"):
+            out["compile_cache"] = prior_doc.get("compile_cache", {})
     # Per-config cost ledgers (config_query_cost) and the measured
     # roofline constants (benchmarks/roofline.py) ride the manifest;
     # a pass that skipped either carries the prior values forward.
@@ -149,6 +162,10 @@ def write_manifest() -> None:
     out["compile_stability"] = (_COMPILE_STABILITY
                                 or prior_doc.get("compile_stability",
                                                  {}))
+    # Write-path A/B (config_write_path): per-op SetBit, executor
+    # per-op, wire import, fsync amortization — ISSUE 8's acceptance
+    # table, one-crossing+group-commit vs the pre-extension path.
+    out["write_path"] = _WRITE_PATH or prior_doc.get("write_path", {})
     measured = _roofline_measured() or prior_doc.get(
         "roofline_measured_constants")
     if measured:
@@ -169,6 +186,11 @@ _CONTAINER_MIX: dict = {}
 # Per-slice-config restart latency + compile counts captured by
 # config_compile_stability() — folded into MANIFEST.json.
 _COMPILE_STABILITY: dict = {}
+
+# Write-path A/B acceptance table captured by config_write_path() —
+# folded into MANIFEST.json's write_path section and merged into
+# WRITEPATH.json for bench.py's line of record (ISSUE 8).
+_WRITE_PATH: dict = {}
 
 
 # Fresh-process measurement: each slice config restarts python, arms
@@ -1499,8 +1521,304 @@ def config_wire_import() -> None:
             srv.close()
 
 
-def main() -> None:
-    for fn in (_measure_sync_floor,
+@contextlib.contextmanager
+def _write_path_leg(ext: bool, group: bool, fsync: str = "none"):
+    """Select one write-path configuration for the A/B legs below:
+    the one-crossing extension on/off (roaring reads native_ext.EXT
+    per op, so toggling the module attribute is the real switch) and
+    the WAL mode env vars, which fragments read at open()."""
+    from pilosa_tpu.storage import native_ext
+
+    # Load BEFORE snapshotting: the extension loads lazily at the
+    # first Fragment.open() — snapshotting the pre-load None and
+    # restoring it on exit would clobber the loaded module for every
+    # later leg (load() latches, so it never comes back): round-1 A
+    # measures the extension, every round after silently measures
+    # pure Python.
+    native_ext.load()
+    saved_ext = native_ext.EXT
+    saved_env = {k: os.environ.get(k)
+                 for k in ("PILOSA_TPU_WAL_GROUP", "PILOSA_TPU_WAL_FSYNC")}
+    if not ext:
+        native_ext.EXT = None
+    os.environ["PILOSA_TPU_WAL_GROUP"] = "1" if group else "0"
+    os.environ["PILOSA_TPU_WAL_FSYNC"] = fsync
+    try:
+        yield
+    finally:
+        native_ext.EXT = saved_ext
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def config_write_path() -> None:
+    """ISSUE 8 acceptance table: the write path A/B, interleaved.
+
+    Leg A is the production write path — one compiled crossing per op
+    (native/fastmutate.c: container mutate + marshaled WAL record in
+    one call) feeding the group-committed WAL. Leg B is the
+    pre-ISSUE-8 path: pure-Python mutate through the per-call layers,
+    write-through op-log. Rounds interleave A and B so shared-slot
+    drift cancels; best-of-rounds is reported (steady state — the
+    slot's scheduling stalls are not the write path's cost). Four
+    measurements: per-op Fragment.set_bit, per-op through the
+    executor (parse + route + mutate), bulk import over the real
+    wire, and fsyncs-per-1k-ops from 8 concurrent durable writers
+    (group commit coalescing barriers vs one fsync per op). Folds
+    into MANIFEST.json `write_path` and merges into WRITEPATH.json
+    for bench.py's line of record."""
+    import tempfile
+    import threading
+
+    from pilosa_tpu.storage.fragment import Fragment
+
+    rounds = 3
+
+    def setbit_leg(n: int) -> float:
+        # Steady-state serving shape: 50 rows over the slice (the
+        # executor-leg workload) keeps ops landing in EXISTING
+        # containers — the production per-op shape. A warmup fifth
+        # populates the container set so the measured span isn't
+        # dominated by one-time container creation (which bails to
+        # the Python path by design).
+        with tempfile.TemporaryDirectory() as d:
+            frag = Fragment(os.path.join(d, "frag"), "wp", "f",
+                            "standard", 0)
+            frag.open()
+            try:
+                rng = np.random.default_rng(7)
+                warm = n // 5
+                rows = rng.integers(0, 50, n + warm).tolist()
+                cols = rng.integers(0, 1 << 20, n + warm).tolist()
+                for r, c in zip(rows[:warm], cols[:warm]):
+                    frag.set_bit(r, c)
+                t0 = time.perf_counter()
+                for r, c in zip(rows[warm:], cols[warm:]):
+                    frag.set_bit(r, c)
+                frag.wal_barrier()  # the ack point is part of the cost
+                el = time.perf_counter() - t0
+                frag._join_snapshot()
+            finally:
+                frag.close()
+        return n / el
+
+    # Interleaved A/B rounds: per-op Fragment.set_bit.
+    n_a, n_b = max(1000, int(40_000 * SCALE)), max(500, int(8_000 * SCALE))
+    a_ops = b_ops = 0.0
+    for _ in range(rounds):
+        with _write_path_leg(ext=True, group=True):
+            a_ops = max(a_ops, setbit_leg(n_a))
+        with _write_path_leg(ext=False, group=False):
+            b_ops = max(b_ops, setbit_leg(n_b))
+    emit("writepath_setbit_per_op", a_ops, "ops/sec",
+         baseline_ops=round(b_ops, 1), speedup=round(a_ops / b_ops, 2))
+
+    # Executor per-op: the full serving stack minus HTTP — parse
+    # (point-mutation regex lane), route (write fast lane), mutate —
+    # with the commit barrier at the httpd batch-lane cadence (one
+    # barrier acks a 64-query pipelined group, server.py's
+    # _query_batcher contract). A per-op barrier would measure the
+    # bare write(2) syscall (~80 us on this host), which is exactly
+    # the cost group commit exists to amortize — the concurrent-
+    # writer fsync leg below covers per-op durability.
+    def executor_leg(n: int) -> float:
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.models.holder import Holder
+        from pilosa_tpu.storage import wal as wal_mod
+
+        with tempfile.TemporaryDirectory() as d:
+            holder = Holder(d)
+            holder.open()
+            try:
+                holder.create_index("wp").create_frame("f")
+                ex = Executor(holder, host="local", use_mesh=False)
+                warm = n // 5
+                queries = [f'SetBit(frame="f", rowID={i % 50},'
+                           f' columnID={i * 13 % (1 << 20)})'
+                           for i in range(n + warm)]
+                for q in queries[:warm]:  # containers + caches warm
+                    ex.execute("wp", q)
+                t0 = time.perf_counter()
+                for i, q in enumerate(queries[warm:]):
+                    ex.execute("wp", q)
+                    if i % 64 == 63:
+                        wal_mod.barrier_all()
+                wal_mod.barrier_all()
+                el = time.perf_counter() - t0
+                ex.close()
+            finally:
+                holder.close()
+        return n / el
+
+    ea_ops = eb_ops = 0.0
+    for _ in range(rounds):
+        with _write_path_leg(ext=True, group=True):
+            ea_ops = max(ea_ops, executor_leg(
+                max(1000, int(25_000 * SCALE))))
+        with _write_path_leg(ext=False, group=False):
+            eb_ops = max(eb_ops, executor_leg(
+                max(500, int(6_000 * SCALE))))
+    emit("writepath_executor_per_op", ea_ops, "ops/sec",
+         baseline_ops=round(eb_ops, 1),
+         speedup=round(ea_ops / eb_ops, 2))
+
+    # Wire import (real HTTP: encode + concurrent per-slice POSTs +
+    # decode + WAL-first apply + commit barrier before the 200) vs the
+    # same block applied in-process — the ≥70%-of-in-process target.
+    def wire_leg() -> tuple:
+        from pilosa_tpu.cluster.client import Client
+        from pilosa_tpu.models.holder import Holder
+        from pilosa_tpu.server.server import Server
+
+        n = int(1_000_000 * SCALE)
+        rng = np.random.default_rng(0)
+        # 50 rows x 4 slices: the steady-ingest shape (containers see
+        # ~250 bits each) — matches the per-op legs' row space and the
+        # host_import_apply density family.
+        rows = rng.integers(0, 50, n).astype(np.uint64)
+        cols = rng.integers(0, 1 << 22, n).astype(np.uint64)
+        with tempfile.TemporaryDirectory() as d:
+            srv = Server(d, host="127.0.0.1:0", anti_entropy_interval=0,
+                         polling_interval=0)
+            srv.open()
+            try:
+                client = Client(srv.host)
+                client.create_index("wi")
+                client.create_frame("wi", "f")
+                t0 = time.perf_counter()
+                client.import_arrays("wi", "f", rows, cols)
+                wire = n / (time.perf_counter() - t0)
+            finally:
+                srv.close()
+        with tempfile.TemporaryDirectory() as d:
+            holder = Holder(d)
+            holder.open()
+            try:
+                frame = holder.create_index("wi").create_frame("f")
+                t0 = time.perf_counter()
+                frame.import_bits(rows, cols)
+                inproc = n / (time.perf_counter() - t0)
+            finally:
+                holder.close()
+        return wire, inproc
+
+    wire_bps = inproc_bps = 0.0
+    for _ in range(rounds):
+        with _write_path_leg(ext=True, group=True):
+            w, p = wire_leg()
+            wire_bps, inproc_bps = max(wire_bps, w), max(inproc_bps, p)
+    emit("writepath_wire_import", wire_bps, "bits/sec",
+         inprocess_bps=round(inproc_bps, 1),
+         wire_over_inprocess=round(wire_bps / inproc_bps, 3))
+
+    # fsync amortization: 32 concurrent writers (a production ingest
+    # fan-in), each op durably acked. A: FSYNC=group — concurrent
+    # barriers coalesce into one leader fsync per batch (the
+    # reduction factor approaches the writer count). B: the
+    # un-amortized discipline — write-through WAL, one fsync per op
+    # per writer.
+    def fsync_leg(group: bool, per: int) -> tuple:
+        n_threads = 32
+        with tempfile.TemporaryDirectory() as d:
+            frag = Fragment(os.path.join(d, "frag"), "wp", "f",
+                            "standard", 0)
+            frag.open()
+            try:
+                errs: list = []
+                start = threading.Barrier(n_threads)
+
+                def writer(t: int) -> None:
+                    rng = np.random.default_rng(t)
+                    # 32 disjoint 32 Ki-column stripes tile the 2^20
+                    # slice exactly; << 16 would push t >= 16 past it.
+                    base = t << 15
+                    try:
+                        start.wait()
+                        for _ in range(per):
+                            frag.set_bit(int(rng.integers(0, 50)),
+                                         base + int(rng.integers(0, 3000)))
+                            if group:
+                                frag.wal_barrier()  # durable ack
+                            else:
+                                os.fsync(frag._file.fileno())
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+                threads = [threading.Thread(target=writer, args=(t,))
+                           for t in range(n_threads)]
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                el = time.perf_counter() - t0
+                if errs:
+                    raise errs[0]
+                n = n_threads * per
+                fsyncs = frag._wal.fsyncs if group else n
+                frag._join_snapshot()
+            finally:
+                frag.close()
+        return n / el, fsyncs * 1000.0 / n
+
+    ga_ops = gb_ops = 0.0
+    ga_per1k = gb_per1k = float("inf")
+    for _ in range(rounds):
+        with _write_path_leg(ext=True, group=True, fsync="group"):
+            ops, per1k = fsync_leg(True, max(50, int(400 * SCALE)))
+            ga_ops, ga_per1k = max(ga_ops, ops), min(ga_per1k, per1k)
+        with _write_path_leg(ext=True, group=False, fsync="none"):
+            ops, per1k = fsync_leg(False, max(25, int(125 * SCALE)))
+            gb_ops, gb_per1k = max(gb_ops, ops), min(gb_per1k, per1k)
+    emit("writepath_fsync_group", ga_ops, "ops/sec",
+         fsyncs_per_1k=round(ga_per1k, 1),
+         baseline_fsyncs_per_1k=round(gb_per1k, 1),
+         reduction_x=round(gb_per1k / max(ga_per1k, 1e-9), 1))
+
+    art = {
+        "setbit_per_op_ops": round(a_ops, 1),
+        "setbit_per_op_baseline_ops": round(b_ops, 1),
+        "setbit_per_op_speedup": round(a_ops / b_ops, 2),
+        "executor_per_op_ops": round(ea_ops, 1),
+        "executor_per_op_baseline_ops": round(eb_ops, 1),
+        "wire_import_bits_s": round(wire_bps, 1),
+        "wire_import_mbits_s": round(wire_bps / 1e6, 2),
+        "inprocess_import_bits_s": round(inproc_bps, 1),
+        "wire_over_inprocess": round(wire_bps / inproc_bps, 3),
+        "concurrent_durable_ops_s": round(ga_ops, 1),
+        "fsyncs_per_1k_group": round(ga_per1k, 1),
+        "fsyncs_per_1k_per_op": round(gb_per1k, 1),
+        "fsync_reduction_x": round(gb_per1k / max(ga_per1k, 1e-9), 1),
+        "rounds": rounds,
+        "scale": SCALE,
+        "date": time.strftime("%Y-%m-%d"),
+    }
+    _WRITE_PATH.update(art)
+    # Merge into WRITEPATH.json (the canonical write_path artifact
+    # bench.py stamps into its line) alongside _write_denominator's
+    # native-denominator keys — merge, not clobber: either config may
+    # run without the other.
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "WRITEPATH.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        doc = {}
+    doc.update(art)
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1)
+
+
+def main(argv: Optional[list] = None) -> None:
+    """Full pass by default; ``suite.py <config_name>...`` runs just
+    the named configs (e.g. ``suite.py config_write_path``) and folds
+    their families into MANIFEST.json, carrying every other family
+    forward from the prior full pass."""
+    configs = (_measure_sync_floor,
                config1_fragment_intersect_count,
                config2_union_difference_1k_rows,
                config2_executor_wide_union,
@@ -1515,16 +1833,30 @@ def main() -> None:
                config_host_write_and_import,
                config_http_pipelined_setbit,
                config_wire_import,
+               config_write_path,
                config_query_cost,
                config_container_mix,
                config_compile_stability,
-               emit_compile_cache):
+               emit_compile_cache)
+    names = [a for a in (sys.argv[1:] if argv is None else argv)
+             if not a.startswith("-")]
+    if names:
+        table = {fn.__name__: fn for fn in configs}
+        unknown = [n for n in names if n not in table]
+        if unknown:
+            raise SystemExit(
+                f"unknown config(s) {unknown}; "
+                f"choose from {sorted(table)}")
+        fns = [table[n] for n in names]
+    else:
+        fns = list(configs)
+    for fn in fns:
         try:
             fn()
         except Exception as e:  # noqa: BLE001 - report and continue
             emit(fn.__name__, -1, "error", error=str(e)[:200])
     try:
-        write_manifest()
+        write_manifest(partial=bool(names))
     except Exception as e:  # noqa: BLE001 - manifest must not kill runs
         print(f"manifest write failed: {e}", file=sys.stderr)
 

@@ -44,6 +44,7 @@ from .sched import context as sched_context
 from . import SLICE_WIDTH
 from .models.view import VIEW_INVERSE, VIEW_STANDARD
 from .pql.ast import Call, Query
+from .pql.parser import _POINT_MUTATE_RE
 from .pql.parser import parse as parse_pql
 from .storage import bsi
 from .storage.bitmap import Bitmap, BitmapSegment
@@ -192,6 +193,12 @@ class Executor:
         # Materialized bitmap-result residency (see _bitmap_result_key).
         self._bitmap_results: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._bitmap_results_mu = threading.Lock()
+        # Per-op write fast lane (see _execute_mutate_bit): (index,
+        # frame, slice) -> (frame_obj, Fragment), validated per op by
+        # identity of the CURRENT frame object and the fragment's
+        # _open flag — a deleted or recreated frame closes its
+        # fragments, which forces re-resolution.
+        self._wfast_frag: dict[tuple, tuple] = {}
 
     def _pool(self, tier: str) -> ThreadPoolExecutor:
         with self._pools_mu:
@@ -337,9 +344,35 @@ class Executor:
         if not index:
             raise PilosaError("index required")
         if isinstance(query, str):
+            # Fused point-mutation lane (ISSUE 8): the per-op serving
+            # string goes regex -> cached fragment -> set_bit in one
+            # step, skipping AST construction and call dispatch —
+            # those cost ~3x the mutate itself at per-op rates. Any
+            # miss (cold cache, unusual frame/cluster shape) falls
+            # through to the identical generic path, which also
+            # populates the cache.
+            if _partial_out is None and self.pod is None:
+                m = _POINT_MUTATE_RE.match(query)
+                if m is not None:
+                    r = self._point_mutate_fast(index, m, opt)
+                    if r is not None:
+                        return r
             query = parse_pql(query)
         if not isinstance(query, Query):
             raise QueryRequiredError("query required")
+
+        calls = query.calls
+        if (len(calls) == 1 and _partial_out is None
+                and calls[0].name in ("SetBit", "ClearBit")):
+            # Single point mutation — the per-op serving shape. Skip
+            # the multi-call preamble (slice enumeration, batch-run
+            # probes): a write call needs no slice list, and
+            # _execute_mutate_bit owns its whole contract including
+            # its own fast lane.
+            if opt.ctx is not None:
+                opt.ctx.check()
+            return [self._execute_mutate_bit(
+                index, calls[0], opt, calls[0].name == "SetBit")]
 
         needs = _needs_slices(query.calls)
         inverse_slices: list[int] = []
@@ -2378,6 +2411,29 @@ class Executor:
 
         return results, count
 
+    def _point_mutate_fast(self, index: str, m, opt: ExecOptions
+                           ) -> Optional[list]:
+        """The string lane's warm half: a ``_POINT_MUTATE_RE`` match
+        plus a hot ``_wfast_frag`` entry go straight to the fragment
+        mutate. Returns None on any miss — cold cache, closed
+        fragment, non-default labels (ent[2]), or a cluster that is
+        no longer this single node — and the generic path (which owns
+        errors and cache population) re-runs the op from the string."""
+        col_id = int(m.group(4))
+        ent = self._wfast_frag.get(
+            (index, m.group(2), col_id // SLICE_WIDTH))
+        if ent is None or not ent[2] or not ent[1]._open:
+            return None
+        nodes = self.cluster.nodes
+        if len(nodes) != 1 or nodes[0].host != self.host:
+            return None
+        if opt.ctx is not None:
+            opt.ctx.check()
+        frag = ent[1]
+        if m.group(1) == "SetBit":
+            return [frag.set_bit(int(m.group(3)), col_id)]
+        return [frag.clear_bit(int(m.group(3)), col_id)]
+
     def _execute_set_bit(self, index: str, c: Call, opt: ExecOptions
                          ) -> bool:
         return self._execute_mutate_bit(index, c, opt, set=True)
@@ -2388,6 +2444,57 @@ class Executor:
 
     def _execute_mutate_bit(self, index: str, c: Call, opt: ExecOptions,
                             set: bool) -> bool:
+        # Per-op write fast lane: the production single-op shape
+        # (standard view, no timestamp, single-node non-pod cluster,
+        # this node the sole owner) resolves (index, frame, slice) ->
+        # Fragment through a small cache instead of re-walking
+        # placement hashing + frame -> view -> fragment locks per op —
+        # the walk cost more than the mutate itself (ISSUE 8). Any
+        # unusual shape falls through to the generic path below, which
+        # also owns every error message.
+        args = c.args
+        if ("timestamp" not in args and not args.get("view")
+                and self.pod is None):
+            nodes = self.cluster.nodes
+            if len(nodes) == 1 and nodes[0].host == self.host:
+                idx = self.holder.index(index)
+                fname = args.get("frame")
+                frame = (idx.frame(fname)
+                         if idx is not None and fname else None)
+                if frame is not None and not frame.inverse_enabled:
+                    row_id = args.get(frame.row_label)
+                    col_id = args.get(idx.column_label)
+                    if (type(row_id) is int and type(col_id) is int
+                            and row_id >= 0 and col_id >= 0):
+                        fkey = (index, fname, col_id // SLICE_WIDTH)
+                        ent = self._wfast_frag.get(fkey)
+                        if (ent is None or ent[0] is not frame
+                                or not ent[1]._open):
+                            v = frame.create_view_if_not_exists(
+                                VIEW_STANDARD)
+                            # Third slot: the string lane's one-read
+                            # precondition — default labels, so the
+                            # regex's literal rowID/columnID keys are
+                            # the frame's actual labels (inverse off
+                            # is already a condition of being here,
+                            # and label/inverse options are fixed at
+                            # frame creation).
+                            ent = (frame,
+                                   v.create_fragment_if_not_exists(
+                                       fkey[2]),
+                                   frame.row_label == "rowID"
+                                   and idx.column_label == "columnID")
+                            if len(self._wfast_frag) >= 4096:
+                                # Bound the cache without per-op LRU
+                                # bookkeeping on the hot read: drop it
+                                # wholesale (rebuilds in a few ops) so
+                                # entries for deleted frames can't pin
+                                # closed fragments forever.
+                                self._wfast_frag.clear()
+                            self._wfast_frag[fkey] = ent
+                        frag = ent[1]
+                        return (frag.set_bit(row_id, col_id) if set
+                                else frag.clear_bit(row_id, col_id))
         name = "SetBit" if set else "ClearBit"
         view = c.args.get("view", "")
         frame_name = c.args.get("frame")

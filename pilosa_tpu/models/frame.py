@@ -503,3 +503,14 @@ class Frame:
             frag = view.create_fragment_if_not_exists(slice)
             frag.import_positions(
                 chunks[0] if len(chunks) == 1 else np.concatenate(chunks))
+
+    def import_slice_positions(self, slice: int,
+                               positions: np.ndarray) -> None:
+        """Standard-view bulk import of ONE slice's pre-sorted
+        slice-local positions — the rawimport-v2 wire lane. The caller
+        owns the sort/dedupe and the no-inverse/no-timestamp
+        preconditions (the handler reconstructs (row, col) pairs and
+        calls import_bits when the frame needs the transpose)."""
+        view = self.create_view_if_not_exists(VIEW_STANDARD)
+        frag = view.create_fragment_if_not_exists(slice)
+        frag.import_positions(positions)

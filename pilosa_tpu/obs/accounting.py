@@ -74,7 +74,7 @@ class QueryCost:
 
     __slots__ = ("node", "container_ops", "words_scanned",
                  "bits_written", "device_programs", "device_bytes",
-                 "compile_s", "rpc", "children", "_mu")
+                 "compile_s", "wal_wait_s", "rpc", "children", "_mu")
 
     def __init__(self, node: str = ""):
         self.node = node
@@ -84,6 +84,11 @@ class QueryCost:
         self.device_programs = 0
         self.device_bytes = 0
         self.compile_s = 0.0
+        # Seconds this query's threads spent blocked in WAL group
+        # commit (waiting for a leader's flush to cover their records)
+        # — the write-side queue wait, alongside the admission stage's
+        # read-side one.
+        self.wal_wait_s = 0.0
         # peer host -> {"bytesOut": n, "bytesIn": n, "calls": n}
         self.rpc: dict[str, dict] = {}
         self.children: list[dict] = []
@@ -106,6 +111,9 @@ class QueryCost:
 
     def note_compile(self, seconds: float) -> None:
         self.compile_s += seconds
+
+    def note_wal_wait(self, seconds: float) -> None:
+        self.wal_wait_s += seconds
 
     def note_rpc(self, peer: str, bytes_out: int, bytes_in: int) -> None:
         with self._mu:
@@ -148,6 +156,8 @@ class QueryCost:
             "deviceBytes": self.device_bytes,
             "compileMs": round(self.compile_s * 1e3, 3),
         }
+        if self.wal_wait_s:
+            out["walWaitMs"] = round(self.wal_wait_s * 1e3, 3)
         if stages:
             out["stages"] = {k: round(v, 6) for k, v in stages.items()}
             if "admission" in stages:
@@ -173,6 +183,8 @@ class QueryCost:
             "deviceBytes": self.device_bytes,
             "compileMs": round(self.compile_s * 1e3, 3),
         }
+        if self.wal_wait_s:
+            out["walWaitMs"] = round(self.wal_wait_s * 1e3, 3)
         if rpc_out or rpc_in:
             out["rpcBytesOut"] = rpc_out
             out["rpcBytesIn"] = rpc_in
@@ -206,6 +218,7 @@ class QueryCost:
 # starts from sched.warmup -> executor -> storage.
 
 _sched_current = None
+_sched_tls = None
 
 
 def current_cost() -> Optional[QueryCost]:
@@ -233,7 +246,17 @@ def attach(ctx, node: str = "") -> Optional[QueryCost]:
 
 
 def note_bits_written(n: int) -> None:
-    cost = current_cost()
+    # The per-op write hot path: one thread-local read inline instead
+    # of the current_cost() call chain (measured at per-op rates).
+    global _sched_tls
+    tls = _sched_tls
+    if tls is None:
+        from ..sched import context as _sched_ctx
+        tls = _sched_tls = _sched_ctx._tls
+    ctx = getattr(tls, "ctx", None)
+    if ctx is None:
+        return
+    cost = getattr(ctx, "cost", None)
     if cost is not None:
         cost.note_bits_written(n)
 

@@ -191,6 +191,9 @@ class Gauge(_Family):
     def set(self, v: float) -> None:
         self._default().set(v)
 
+    def inc(self, n: float = 1.0) -> None:
+        self._default().inc(n)
+
     @property
     def value(self) -> float:
         return self._default().value
@@ -508,6 +511,25 @@ PARTIAL_RESULTS = _DEFAULT.counter(
     "pilosa_query_partial_results_total",
     "Queries answered degraded (?partial=1) with at least one"
     " unreachable slice skipped")
+WAL_GROUP_BATCH_SIZE = _DEFAULT.histogram(
+    "pilosa_wal_group_commit_batch_size",
+    "Op records covered by one WAL group-commit leader flush — the"
+    " syscall/fsync amortization factor of the write path",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096,
+             16384, 65536))
+WAL_GROUP_FLUSH_SECONDS = _DEFAULT.histogram(
+    "pilosa_wal_group_commit_flush_seconds",
+    "Wall seconds one WAL group-commit leader flush took (write +"
+    " fsync per policy)")
+WAL_FSYNCS = _DEFAULT.counter(
+    "pilosa_wal_fsync_calls_total",
+    "fsync() calls issued by WAL group-commit leader flushes — the"
+    " denominator the group-commit amortization is measured against")
+IMPORT_PIPELINE_DEPTH = _DEFAULT.gauge(
+    "pilosa_import_pipeline_depth",
+    "Wire-import blocks currently in their apply stage across all"
+    " fragments — >1 means decode of later blocks is overlapping"
+    " earlier applies (the pipelined import path)")
 
 
 # -- legacy StatsClient bridge ------------------------------------------------

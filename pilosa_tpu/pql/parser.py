@@ -372,11 +372,32 @@ _FAST_ARG_RE = re.compile(
     r"|\[\s*(-?[0-9]+\s*(?:,\s*-?[0-9]+\s*)*)\])")
 
 
+# The single point-mutation wire shape — `SetBit(frame="x", rowID=N,
+# columnID=M)` with the default labels in canonical order — gets one
+# anchored regex and a direct Call build: at production per-op write
+# rates the generic fast path's finditer + groups split was a measured
+# slice of per-op latency (ISSUE 8). Digit counts bounded so int() is
+# always < 2^63; any other shape (custom labels, timestamp, view,
+# reordered args) falls through unchanged.
+_POINT_MUTATE_RE = re.compile(
+    r'\s*(SetBit|ClearBit)\(\s*frame\s*=\s*"([A-Za-z0-9 _\-.:]*)"\s*,'
+    r'\s*rowID\s*=\s*([0-9]{1,18})\s*,'
+    r'\s*columnID\s*=\s*([0-9]{1,18})\s*\)\s*$')
+
+
 def _parse_fast(text: str):
     """Query for a flat call list, or None when any call needs the full
     grammar (children, non-integer lists, floats, escapes, bool/null
     idents). Integer lists — the TopN exact-phase forwarding shape —
     stay on the fast path."""
+    m = _POINT_MUTATE_RE.match(text)
+    if m is not None:
+        call = Call(m.group(1), {"frame": m.group(2),
+                                 "rowID": int(m.group(3)),
+                                 "columnID": int(m.group(4))})
+        q = Query()
+        q.calls.append(call)
+        return q
     query = Query()
     i = 0
     n = len(text)

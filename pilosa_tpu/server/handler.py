@@ -15,7 +15,9 @@ from __future__ import annotations
 import contextlib
 import io
 import json
+import os
 import re
+import threading
 from typing import Callable, Optional
 from urllib.parse import parse_qs
 
@@ -37,6 +39,7 @@ from ..models.frame import Field, FrameOptions
 from ..models.index import IndexOptions
 from ..pql import parser as pql
 from ..proto import internal_pb2 as pb
+from ..storage import wal as storage_wal
 from ..storage.attrs import diff_blocks
 from ..storage.bitmap import Bitmap
 from ..utils import timequantum as tq
@@ -179,6 +182,20 @@ _STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
                 429: "Too Many Requests",
                 500: "Internal Server Error", 503: "Service Unavailable",
                 504: "Gateway Timeout"}
+
+
+# Import apply lanes: how many /import handlers may be in their APPLY
+# stage at once, process-wide. The apply is mostly GIL-holding
+# Python/numpy, so unbounded concurrent applies convoy on the GIL and
+# run measurably SLOWER than the same blocks queued (1.4x at 4 lanes
+# on 2 cores) — the pipelining win comes from decode/wire/WAL of
+# other blocks overlapping an apply, which the gate never blocks.
+# `pilosa_import_pipeline_depth` counts handlers in the stage
+# (applying + gate-queued), so depth > lanes means the pipeline is
+# feeding the gate faster than it drains.
+_APPLY_LANES = max(1, int(os.environ.get(
+    "PILOSA_TPU_IMPORT_APPLY_LANES", "1") or 1))
+_APPLY_GATE = threading.BoundedSemaphore(_APPLY_LANES)
 
 
 class Handler:
@@ -776,6 +793,7 @@ class Handler:
         if frame.field(field_name) is None:
             raise HTTPError(404, "field not found")
         frame.import_field_values(field_name, cols, vals)
+        storage_wal.barrier_all()  # commit before the 200
         obs_metrics.IMPORT_BITS.labels("field_values").inc(len(cols))
         if req.content_type == _PROTOBUF:
             return Response.proto(pb.ImportResponse())
@@ -1075,6 +1093,14 @@ class Handler:
             with ctx.stage("execute"):
                 results = self.executor.execute(
                     index_name, query, slices or None, exec_opt)
+            if lane == LANE_WRITE:
+                # Commit barrier before the ack: every mutation this
+                # query applied has its WAL record durable (per the
+                # fsync policy) when the response goes out. Concurrent
+                # write queries coalesce into one leader flush per
+                # touched WAL (storage.wal group commit).
+                with ctx.stage("commit"):
+                    storage_wal.barrier_all()
         except HTTPError as e:  # 429 from _admit
             err = e
             raise
@@ -1213,12 +1239,13 @@ class Handler:
         import time as time_mod
         decode_t0 = time_mod.perf_counter()
         wire_bytes = 0
+        positions = None
         if req.content_type == rawimport.CONTENT_TYPE:
             body = req.body()
             wire_bytes = len(body)
             try:
                 (index_name, frame_name, slice, rows, cols,
-                 ts_ns) = rawimport.decode(body)
+                 ts_ns, positions) = rawimport.decode(body)
             except ValueError as e:
                 raise HTTPError(400, str(e))
         elif req.content_type == _PROTOBUF:
@@ -1237,9 +1264,22 @@ class Handler:
         decode_s = time_mod.perf_counter() - decode_t0
         obs_metrics.IMPORT_STAGE_SECONDS.labels("decode").observe(
             decode_s)
-        if len(rows) != len(cols) or (
+        if positions is not None:
+            # Presorted positions form (rawimport v2): the sort is the
+            # CLIENT's job, so sortedness is a contract, not a hint —
+            # add_many would silently re-sort, but an unsorted body
+            # means a broken client and the 400 keeps the wire
+            # contract honest. One vectorized strictness pass.
+            if len(positions) > 1 and not bool(
+                    np.all(positions[:-1] < positions[1:])):
+                raise HTTPError(
+                    400, "raw-import positions not sorted-unique")
+            n_bits = len(positions)
+        elif len(rows) != len(cols) or (
                 ts_ns is not None and len(ts_ns) != len(rows)):
             raise HTTPError(400, "import array length mismatch")
+        else:
+            n_bits = len(rows)
         if self.cluster is not None and not self.cluster.owns_fragment(
                 self.host, index_name, slice):
             raise HTTPError(412, f"host does not own slice"
@@ -1268,24 +1308,62 @@ class Handler:
         pod_view = req.query.get("podView")
         if pod_view is not None and pod_view not in ("standard", "inverse"):
             raise HTTPError(400, f"invalid podView: {pod_view}")
+        if positions is not None and (
+                frame.inverse_enabled or pod_view == "inverse"
+                or (self.pod is not None and self.pod.is_coordinator
+                    and pod_view is None)):
+            # The positions form is the standard-view fast lane; a
+            # frame that also needs the inverse transpose (or a pod
+            # split by row slice) wants (row, col) pairs —
+            # reconstruct them (three vector ops) and take the
+            # generic path below.
+            from .. import SLICE_WIDTH
+            W = np.uint64(SLICE_WIDTH)
+            rows = positions // W
+            cols = np.uint64(slice) * W + (positions % W)
+            positions = None
         apply_t0 = time_mod.perf_counter()
-        if (self.pod is not None and self.pod.is_coordinator
-                and pod_view is None):
-            self._pod_import(index_name, frame_name, slice, rows, cols,
-                             ts_ns, idx, frame, timestamps)
-        else:
-            frame.import_bits(rows, cols, timestamps, views=pod_view)
+        # Pipeline depth: concurrent /import handlers in their apply
+        # stage. >1 means a later block's decode (another connection
+        # thread) overlapped this apply — the pipelined wire-import
+        # path observable as a gauge.
+        obs_metrics.IMPORT_PIPELINE_DEPTH.inc()
+        try:
+            with _APPLY_GATE:
+                if positions is not None:
+                    # Writable copy: frombuffer views of the request
+                    # body are read-only, and container merges may
+                    # keep slices of the batch vector alive — aliasing
+                    # those to the HTTP body would pin whole request
+                    # buffers in the holder.
+                    frame.import_slice_positions(slice,
+                                                 np.array(positions))
+                elif (self.pod is not None and self.pod.is_coordinator
+                        and pod_view is None):
+                    self._pod_import(index_name, frame_name, slice,
+                                     rows, cols, ts_ns, idx, frame,
+                                     timestamps)
+                else:
+                    frame.import_bits(rows, cols, timestamps,
+                                      views=pod_view)
+        finally:
+            obs_metrics.IMPORT_PIPELINE_DEPTH.inc(-1)
         apply_s = time_mod.perf_counter() - apply_t0
         obs_metrics.IMPORT_STAGE_SECONDS.labels("apply").observe(
             apply_s)
-        obs_metrics.IMPORT_BITS.labels("bits").inc(len(rows))
+        # Commit barrier before the 200: fragment import lanes barrier
+        # their own WAL, but a time-view fan-out (or a pod split) may
+        # leave sibling fragments' records pending — the ack covers
+        # them all, coalesced with concurrent imports' barriers.
+        storage_wal.barrier_all()
+        obs_metrics.IMPORT_BITS.labels("bits").inc(n_bits)
         # Cost fields ride the response: decode vs apply wall time and
         # the wire/bit volumes (the snapshot leg, when one triggers,
         # lands in the same histogram from the fragment).
         stats = json.dumps(
             {"decodeMs": round(decode_s * 1e3, 3),
              "applyMs": round(apply_s * 1e3, 3),
-             "wireBytes": wire_bytes, "bits": len(rows)},
+             "wireBytes": wire_bytes, "bits": n_bits},
             separators=(",", ":"))
         return Response.proto(
             pb.ImportResponse(),

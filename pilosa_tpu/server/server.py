@@ -373,6 +373,12 @@ class Server:
             with self.query_registry.track(ctx):
                 results, err = self.executor.execute_partial(
                     index, Query(calls), opt=ExecOptions(ctx=ctx))
+            if lane == LANE_WRITE:
+                # Commit barrier before the batch's acks go out — ONE
+                # leader flush covers every mutation the whole
+                # pipelined group applied (storage.wal group commit).
+                from ..storage import wal as storage_wal
+                storage_wal.barrier_all()
         finally:
             slot.release()
             # The batch lane bypasses the handler's query path, so it

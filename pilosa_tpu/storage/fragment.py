@@ -52,13 +52,28 @@ from ..utils.arrays import sort_dedupe
 from ..utils.streams import CappedReader
 from . import cache as cache_mod
 from . import roaring
+from . import wal as wal_mod
 from .bitmap import Bitmap
 from .cache import Pair
 
 # Number of operations before a snapshot rewrite (reference
-# fragment.go:63-65). Env-overridable so longevity harnesses can force
-# snapshot storms (benchmarks/soak.py) without patching the module.
-MAX_OP_N = int(os.environ.get("PILOSA_TPU_MAX_OP_N", "2000"))
+# fragment.go:63-65). The reference default was 2000, sized for an era
+# when every op paid its own write() and replay was a scalar walk; with
+# the group-committed WAL (appends are buffered memcpy, one leader
+# write per batch) and the vectorized replay lane, a 50 K-record log
+# (~650 KB) reopens in milliseconds while the snapshot freeze —
+# measured at ~15 ms of table patching per trigger — stops eating the
+# per-op write budget 25× as often. Env-overridable so longevity
+# harnesses can force snapshot storms (benchmarks/soak.py) without
+# patching the module.
+MAX_OP_N = int(os.environ.get("PILOSA_TPU_MAX_OP_N", "50000"))
+
+# Replay-cost weight of one bulk-import blob position relative to one
+# discrete op record: a blob's single add-run replays through the
+# vectorized add_many lane (~0.06 us/bit) where mixed discrete tails
+# pay the scalar/small-run walk (~1 us/op), so a blob bit contributes
+# ~1/16th the reopen-replay pressure MAX_OP_N exists to bound.
+_BLOB_OP_WEIGHT = 16
 
 # Rows per checksum block (reference fragment.go:59).
 HASH_BLOCK_SIZE = 100
@@ -116,6 +131,11 @@ _ROW_COUNT_CAP = 1 << 16
 # Snapshots between full close/remap cycles (see Fragment.snapshot).
 _REMAP_EVERY = 16
 
+# Largest bulk import the WAL-first lane holds as op records (13 B per
+# position) before falling back to the vintage detach-then-snapshot
+# contract — bounds transient log growth between snapshot cadences.
+_WAL_IMPORT_MAX_BYTES = 32 << 20
+
 
 class Fragment:
     def __init__(self, path: str, index: str, frame: str, view: str,
@@ -156,6 +176,13 @@ class Fragment:
         # coverage, LRU eviction is gated by consumers on len>=max).
         self._cache_complete = False
 
+        # Group-commit WAL wrapper around the data file (storage.wal):
+        # mutation paths APPEND records (no syscall); commit barriers
+        # (wal_barrier / the serving layer's barrier_all before ack)
+        # flush batches with one write()+fsync-per-policy. None when
+        # PILOSA_TPU_WAL_GROUP=0 (the vintage write-through path).
+        self._wal: Optional[wal_mod.GroupCommitWal] = None
+
         self._mu = threading.RLock()
         # Snapshot lifecycle lock. Ordering rule: ALWAYS acquired
         # BEFORE _mu when blocking (sync snapshot, close, restore);
@@ -180,6 +207,10 @@ class Fragment:
             if self._open:
                 return
             os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+            # The one-crossing mutate extension (built once, cached):
+            # serving fragments are where the per-op path runs hot.
+            from . import native_ext
+            native_ext.load()
             self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
             self._open_storage()
             self._open_cache()
@@ -211,7 +242,12 @@ class Fragment:
             self._mmap = mmap.mmap(self._file.fileno(), 0,
                                    prot=mmap.PROT_READ)
             self.storage = roaring.Bitmap.unmarshal(self._mmap, mapped=True)
-        self.storage.op_writer = self._file
+        if wal_mod.group_enabled():
+            self._wal = wal_mod.GroupCommitWal(self._file)
+            self.storage.op_writer = self._wal
+        else:
+            self._wal = None
+            self.storage.op_writer = self._file
 
     def _open_cache(self) -> None:
         # Re-rank persisted ids with counts from storage
@@ -278,6 +314,16 @@ class Fragment:
             self._open = False
 
     def _close_storage(self) -> None:
+        if self._wal is not None:
+            # Orderly close = commit barrier: whatever a library caller
+            # appended without barriering is durable per policy before
+            # the fd goes away.
+            try:
+                self._wal.barrier()
+            except wal_mod.WalError:
+                pass  # torn log: reopen trims to the flushed prefix
+            self._wal.close()
+            self._wal = None
         if self.storage is not None:
             self.storage.op_writer = None
         # Do NOT mmap.close() and do NOT copy containers out
@@ -369,8 +415,17 @@ class Fragment:
             return self._mutate(row_id, column_id, set=False)
 
     def _mutate(self, row_id: int, column_id: int, set: bool) -> bool:
-        pos = self.pos(row_id, column_id)
-        changed = self.storage.add(pos) if set else self.storage.remove(pos)
+        # The per-op serving hot path (ISSUE 8): bounds + position
+        # arithmetic inlined (pos() was a measured frame at per-op
+        # rates; column_id - min_col == column_id % SLICE_WIDTH once
+        # bounds-checked), every post-mutate maintenance step on
+        # pre-bound locals.
+        min_col = self.slice * SLICE_WIDTH
+        if not (min_col <= column_id < min_col + SLICE_WIDTH):
+            raise ValueError("column out of bounds")
+        pos = row_id * SLICE_WIDTH + (column_id - min_col)
+        storage = self.storage
+        changed = storage.add(pos) if set else storage.remove(pos)
         if not changed:
             return False
         _accounting.note_bits_written(1)
@@ -378,18 +433,20 @@ class Fragment:
         self.checksums.pop(row_id // HASH_BLOCK_SIZE, None)
         self.row_cache.invalidate(row_id)
         self.device.invalidate_row(row_id)
-        cur = self._row_counts.get(row_id)
+        row_counts = self._row_counts
+        cur = row_counts.get(row_id)
         if cur is None:
             count = self.row_count(row_id)  # already post-mutation
         else:
             count = cur + (1 if set else -1)
-        if len(self._row_counts) >= _ROW_COUNT_CAP:
-            self._row_counts.clear()
-        self._row_counts[row_id] = count
+        if len(row_counts) >= _ROW_COUNT_CAP:
+            row_counts.clear()
+        row_counts[row_id] = count
         self.cache.add(row_id, count)
         if self.stats is not None:
             self.stats.count("setN" if set else "clearN", 1)
-        self._increment_op_n()
+        if storage.op_n > MAX_OP_N and not self._snap_mu.locked():
+            self.snapshot(sync=False)
         return True
 
     def set_bits(self, row_ids, column_ids) -> np.ndarray:
@@ -461,8 +518,25 @@ class Fragment:
             return changed
 
     def _increment_op_n(self) -> None:
-        if self.storage.op_n > MAX_OP_N:
+        # locked() is a racy peek, but benign in both directions: a
+        # stale False just try-acquires (and fails fast) inside
+        # _snapshot_async; a stale True means the NEXT op re-triggers.
+        # Walking the full snapshot() chain per op while a background
+        # worker lagged behind the write rate was a measured chunk of
+        # per-op latency.
+        if self.storage.op_n > MAX_OP_N and not self._snap_mu.locked():
             self.snapshot(sync=False)
+
+    def wal_barrier(self) -> None:
+        """Commit barrier: every mutation applied so far has its WAL
+        record in the OS (fsynced per PILOSA_TPU_WAL_FSYNC) when this
+        returns. The serving layer calls the process-wide
+        ``storage.wal.barrier_all()`` before acking write requests;
+        library callers mutating fragments directly use this (or
+        ``close()``) to get the same durability point."""
+        wal = self._wal
+        if wal is not None:
+            wal.barrier()
 
     def snapshot(self, sync: bool = True,
                  reason: str = "storage") -> None:
@@ -570,7 +644,14 @@ class Fragment:
             old_file.close()
         new_file.seek(0, os.SEEK_END)
         self.storage.op_n = new_op_n
-        self.storage.op_writer = new_file
+        if self._wal is not None:
+            # The snapshot body covers every applied mutation, so any
+            # pending (even failed/torn) records are superseded; the
+            # WAL continues over the fresh file with a clean slate.
+            self._wal.reset_file(new_file, clear_pending=True)
+            self.storage.op_writer = self._wal
+        else:
+            self.storage.op_writer = new_file
 
     def _join_snapshot(self) -> None:
         """Barrier: returns once no background snapshot is in flight
@@ -586,6 +667,15 @@ class Fragment:
             return  # a worker or sync snapshot is running; op_n
             # keeps re-triggering until one lands
         try:
+            if self._wal is not None:
+                # The splice contract below needs the FILE to hold
+                # every op appended so far: tail_off divides "covered
+                # by the frozen body" from "spliced from the WAL tail",
+                # so pending userspace records must land first. (A
+                # failed/torn log raises here — fail-stop: the file
+                # past the flushed prefix is not trustworthy, and a
+                # reopen trims to exactly that prefix.)
+                self._wal.flush(None, sync=False)
             frozen = self.storage.freeze()
             tail_off = self._file.seek(0, os.SEEK_END)
         except BaseException:
@@ -625,6 +715,14 @@ class Fragment:
                             # freeze, then swap — brief: the body is
                             # already on disk, only the tail pages
                             # need syncing.
+                            if self._wal is not None:
+                                # Writers appended under _mu; get their
+                                # records into the old file so the tail
+                                # read below sees them. WalError (torn
+                                # log) aborts the swap via the OSError
+                                # handler — the old file stays the file
+                                # of record.
+                                self._wal.flush(None, sync=False)
                             with open(self.path, "rb") as rf:
                                 rf.seek(tail_off)
                                 tail = rf.read()
@@ -696,26 +794,67 @@ class Fragment:
             # before return instead of living only in memory until the
             # snapshot lands.
             self._mutate_batch_positions(positions, set=True)
+            self.wal_barrier()
             return
+        # WAL-first bulk import: append one blob of add records for the
+        # whole block (vectorized build, idempotent on replay — re-adds
+        # of already-set bits are no-ops, exactly like op replay), bulk
+        # apply, then a commit barrier. The sync snapshot the vintage
+        # import contract paid per request (serialize whole fragment +
+        # fsync, ~100 ms/slice — THE wire-import bound, VERDICT r5 #3)
+        # moves to the MAX_OP_N async cadence; reopen replays the
+        # records through the vectorized op-log lane instead. Imports
+        # too large to sensibly hold as op records keep the vintage
+        # detach-then-snapshot contract.
+        wal_first = (self.storage.op_writer is not None
+                     and len(positions) * roaring.OP_SIZE
+                     <= _WAL_IMPORT_MAX_BYTES)
         with self._mu:
             self._epoch += 1
             _accounting.note_bits_written(len(positions))
-            writer, self.storage.op_writer = self.storage.op_writer, None
-            try:
+            if wal_first:
+                roaring._wal_write(self.storage.op_writer,
+                                   roaring._wal_blob(positions,
+                                                     roaring.OP_ADD))
+                # MAX_OP_N bounds REOPEN REPLAY time, and a blob's
+                # add-run replays through the vectorized bulk lane at
+                # ~16x the discrete-op rate (roaring._replay_ops) —
+                # so a blob bit carries 1/16th the snapshot pressure
+                # of a discrete op. Unweighted, every import block
+                # larger than MAX_OP_N forced a full snapshot whose
+                # GIL-held serialization convoyed with the NEXT
+                # block's apply (the measured wire-import long pole).
+                self.storage.op_n += max(
+                    1, len(positions) // _BLOB_OP_WEIGHT)
                 self.storage.add_many(positions)
-            finally:
-                self.storage.op_writer = writer
+            else:
+                writer, self.storage.op_writer = \
+                    self.storage.op_writer, None
+                try:
+                    self.storage.add_many(positions)
+                finally:
+                    self.storage.op_writer = writer
             if _RUN_OPTIMIZE:
                 # Cardinality-adaptive representation pass (roaring run
                 # containers): bulk imports are where run-heavy data
                 # (timestamp views, BSI planes) lands, so this is the
                 # one site that (re)introduces run containers; the
                 # snapshot below persists them via the runs cookie.
-                # Restricted to the touched container keys — the full
-                # walk would re-pay O(all containers) per import, the
-                # cost the row-count pass below was rewritten to avoid.
-                self.storage.optimize(
-                    sort_dedupe(positions >> np.uint64(16)))
+                # Restricted to containers this block put an ADJACENT
+                # value pair into: a run form needs adjacency to beat
+                # the legacy kinds, and run-shaped data carries its
+                # adjacency in the import block itself — so a random
+                # sparse import (which can never win) skips the pass
+                # entirely instead of re-pricing every touched
+                # container (measured: the unrestricted pass was 40%
+                # of a 1M-bit import).
+                srt = (positions if len(positions) < 2
+                       or positions[0] <= positions[-1] else None)
+                srt = np.sort(positions) if srt is None else srt
+                adj = np.flatnonzero(np.diff(srt) == np.uint64(1))
+                if len(adj):
+                    self.storage.optimize(
+                        sort_dedupe(srt[adj] >> np.uint64(16)))
             # Post-import row counts in ONE pass over the container
             # table: positions are row*SLICE_WIDTH + col, so a
             # container's row is its key >> log2(SLICE_WIDTH/65536) and
@@ -770,10 +909,19 @@ class Fragment:
             self.checksums.clear()
         # Outside _mu: the sync snapshot takes _snap_mu then _mu (the
         # worker needs _mu to finish, so snapshotting under _mu would
-        # deadlock the join). Crash semantics unchanged — the bulk adds
-        # were never WAL'd, so the window between apply and snapshot
-        # losing them existed under the lock too.
-        self.snapshot(reason="import")
+        # deadlock the join).
+        if wal_first:
+            # The records are appended; commit them (one group flush,
+            # coalesced with any concurrent import's barrier) and let
+            # the op-count trigger schedule the snapshot in the
+            # background — the import request path no longer pays it.
+            with self._mu:
+                self._increment_op_n()
+            self.wal_barrier()
+        else:
+            # Vintage contract: the bulk adds were never WAL'd, so the
+            # mutations exist nowhere but memory until this lands.
+            self.snapshot(reason="import")
 
     # -- TopN ----------------------------------------------------------------
 
@@ -1394,6 +1542,10 @@ class Fragment:
             # Outside _mu: sync snapshot takes _snap_mu then _mu (see
             # import_bits for the ordering rationale).
             self.snapshot()
+        else:
+            # Per-bit path: records were group-appended; anti-entropy
+            # acks the merge to peers, so commit before returning.
+            self.wal_barrier()
         return sets_out[1:], clears_out[1:]
 
     # Above this many local diffs, per-bit WAL appends (plus a per-op
@@ -1502,6 +1654,7 @@ class Fragment:
         """
         import tarfile
         self.flush_cache()
+        self.wal_barrier()  # pending records must be inside the sized copy
         # Open the fd FIRST, then size it under lock: a concurrent
         # snapshot() os.replace()s the path, but this fd pins the old
         # inode, which only ever grows by appended ops — so copying

@@ -57,17 +57,25 @@ from typing import Callable, Iterator as TIterator, Optional
 import numpy as np
 
 from . import native
+from . import native_ext
+from . import wal as _wal_mod
 from ..fault import failpoints as _fp
 from ..obs import accounting as _accounting
 from ..utils.arrays import searchsorted_membership, sort_dedupe
 
 
 def _wal_write(writer, blob: bytes) -> None:
-    """Every op-log append funnels through here so the ``wal.append``
-    failpoint can inject errors and TORN writes (a prefix of the
-    record hits the file, then the write "crashes") exactly where a
-    real crash would tear the log. Disarmed cost: one module-attr
-    read."""
+    """Every op-log append funnels through here. A group-commit WAL
+    (storage.wal.GroupCommitWal — the fragment's default op writer)
+    buffers the records; its LEADER flush is where bytes reach the
+    file, so the ``wal.append`` failpoint fires there, tearing the
+    GROUPED batch exactly where a crash mid-group-commit would. Plain
+    file-like writers (tests attaching BytesIO, PILOSA_TPU_WAL_GROUP=0)
+    keep the vintage per-append injection + write. Disarmed cost: one
+    module-attr read."""
+    if type(writer) is _wal_mod.GroupCommitWal:
+        writer.append(blob)
+        return
     if _fp.ACTIVE is not None:
         _fp.ACTIVE.hit("wal.append", writer=writer, data=blob)
     writer.write(blob)
@@ -1017,7 +1025,16 @@ _OP_BODY = struct.Struct("<BQ")  # op type + u64 value (13-byte record w/ checks
 def _wal_blob(values: np.ndarray, typ: int) -> bytes:
     """13-byte op records for a value vector, checksummed, vectorized —
     the group-commit form of Op.marshal (verified byte-identical in
-    tests; 0.1 us/record vs ~2 us through the scalar path)."""
+    tests; 0.1 us/record vs ~2 us through the scalar path). With the
+    extension loaded the whole build is one GIL-released C crossing, so
+    concurrent import threads' record builds overlap each other's
+    applies."""
+    ext = native_ext.EXT
+    if ext is not None:
+        fn = getattr(ext, "wal_records", None)
+        if fn is not None:
+            return fn(np.ascontiguousarray(values, dtype=np.uint64),
+                      typ)
     n = len(values)
     rec = np.zeros((n, OP_SIZE), dtype=np.uint8)
     rec[:, 0] = typ
@@ -1061,6 +1078,70 @@ class Op:
         raise ValueError(f"invalid op type: {self.typ}")
 
 
+def _replay_ops(b: "Bitmap", rest: memoryview,
+                tolerate_torn_tail: bool) -> None:
+    """Replay a trailing op-log in bulk.
+
+    The scalar record walk this replaces cost ~10 us/op — a reopen
+    after one 250 K-bit wire-import block paid 2.7 s of replay, which
+    is what forced a snapshot per import block (MAX_OP_N bounds
+    REPLAY time, so replay speed sets how much op-log a fragment may
+    carry). Here validation is one vectorized pass (the same FNV fold
+    as the `_wal_blob` record builder) and maximal same-type op runs
+    apply through add_many/remove_many: order across runs is
+    preserved, and within a same-type run set semantics are order-
+    and duplicate-insensitive. Error contract matches the scalar
+    walk: a torn (partial) trailing record is tolerated only under
+    ``tolerate_torn_tail`` (reported via ``torn_bytes``); a complete
+    record with a bad checksum or unknown type raises — the caller
+    discards ``b``, so prevalidating before any apply is
+    unobservable. Container representations may differ from scalar
+    replay (bulk lanes upgrade touched run containers to legacy
+    kinds); the serialized-set contract is unchanged."""
+    n_rest = len(rest)
+    n_ops = n_rest // OP_SIZE
+    torn = n_rest - n_ops * OP_SIZE
+    if torn and not tolerate_torn_tail:
+        raise ValueError(f"op data out of bounds: len={torn}")
+    if n_ops:
+        recs = np.frombuffer(rest, dtype=np.uint8,
+                             count=n_ops * OP_SIZE).reshape(n_ops,
+                                                            OP_SIZE)
+        h = np.full(n_ops, int(_FNV_OFFSET), dtype=np.uint32)
+        for i in range(9):
+            h = (h ^ recs[:, i].astype(np.uint32)) * _FNV_PRIME
+        stored = np.ascontiguousarray(recs[:, 9:13]).view("<u4").ravel()
+        types = recs[:, 0]
+        bad_chk = np.flatnonzero(h != stored)
+        bad_typ = np.flatnonzero(types > OP_REMOVE)
+        first_chk = int(bad_chk[0]) if len(bad_chk) else n_ops
+        first_typ = int(bad_typ[0]) if len(bad_typ) else n_ops
+        if first_chk <= first_typ and first_chk < n_ops:
+            raise ValueError(
+                f"checksum mismatch: exp={int(h[first_chk]):08x},"
+                f" got={int(stored[first_chk]):08x}")
+        if first_typ < n_ops:
+            raise ValueError(f"invalid op type: {int(types[first_typ])}")
+        vals = np.ascontiguousarray(recs[:, 1:9]).view("<u8").ravel()
+        bnd = np.flatnonzero(types[1:] != types[:-1]) + 1
+        starts = np.concatenate(([0], bnd))
+        ends = np.concatenate((bnd, [n_ops]))
+        for s, e in zip(starts.tolist(), ends.tolist()):
+            if e - s < 16:
+                # Tiny runs (alternating add/remove traffic): the
+                # scalar ops beat the bulk lanes' fixed numpy overhead.
+                apply = b._add if types[s] == OP_ADD else b._remove
+                for v in vals[s:e].tolist():
+                    apply(int(v))
+            elif types[s] == OP_ADD:
+                b.add_many(vals[s:e])
+            else:
+                b.remove_many(vals[s:e])
+        b.op_n += n_ops
+    if torn:
+        b.torn_bytes = torn
+
+
 # --- bitmap ------------------------------------------------------------------
 
 
@@ -1092,6 +1173,13 @@ class Bitmap:
         self._cow_epoch = 0
         self._table: Optional[_SerTable] = None
         self._table_dirty: set[int] = set()
+        # Containers created by POINT ops while a table exists: their
+        # insertion is deferred to _flush_table_dirty (one vectorized
+        # table.insert per freeze) instead of invalidating the table —
+        # a wholesale rebuild is an O(all containers) Python walk that
+        # dominated the per-op write path's MAX_OP_N snapshot cadence
+        # on fragments growing by point writes.
+        self._table_new: set[int] = set()
         for v in values:
             self._add(v)
 
@@ -1131,6 +1219,35 @@ class Bitmap:
     # -- point ops (public ops write to the op-log; _ops do not)
 
     def add(self, v: int) -> bool:
+        # The per-op write hot path: ONE compiled crossing does the
+        # container mutate AND builds the marshaled WAL record
+        # (native/fastmutate.c), so Python only appends the returned
+        # bytes to the group-commit log. The extension bails (None) on
+        # anything unusual — new container, COW-stale bitmap words,
+        # odd buffers — and the pure-Python path below re-runs the op
+        # from scratch (the extension made no state change when it
+        # bails), keeping behavior identical by construction.
+        ext = native_ext.EXT
+        if ext is not None:
+            rec = ext.setbit(self, v)
+            if rec is not None:
+                if rec is False:
+                    return False
+                # _write_op_bytes/_wal_write inlined (two frames of
+                # pure dispatch at per-op serving rates): group WAL
+                # appends go straight to the buffer; plain writers
+                # keep the failpoint injection.
+                w = self.op_writer
+                if w is not None:
+                    if type(w) is _wal_mod.GroupCommitWal:
+                        w.append(rec)
+                    else:
+                        if _fp.ACTIVE is not None:
+                            _fp.ACTIVE.hit("wal.append", writer=w,
+                                           data=rec)
+                        w.write(rec)
+                    self.op_n += 1
+                return True
         changed = self._add(v)
         if changed:
             self._write_op(Op(OP_ADD, v))
@@ -1143,7 +1260,7 @@ class Bitmap:
             n0 = len(self.keys)
             c = self._container_or_create(key)
             if len(self.keys) != n0:
-                self._table = None  # new container: indices shifted
+                self._table_new.add(key)  # deferred table insert
             else:
                 self._table_dirty.add(key)
         else:
@@ -1153,6 +1270,23 @@ class Bitmap:
         return c.add(lowbits(v))
 
     def remove(self, v: int) -> bool:
+        ext = native_ext.EXT
+        if ext is not None:
+            rec = ext.clearbit(self, v)
+            if rec is not None:
+                if rec is False:
+                    return False
+                w = self.op_writer
+                if w is not None:  # same inlining as add()
+                    if type(w) is _wal_mod.GroupCommitWal:
+                        w.append(rec)
+                    else:
+                        if _fp.ACTIVE is not None:
+                            _fp.ACTIVE.hit("wal.append", writer=w,
+                                           data=rec)
+                        w.write(rec)
+                    self.op_n += 1
+                return True
         changed = self._remove(v)
         if changed:
             self._write_op(Op(OP_REMOVE, v))
@@ -1177,6 +1311,13 @@ class Bitmap:
     def _write_op(self, op: Op) -> None:
         if self.op_writer is not None:
             _wal_write(self.op_writer, op.marshal())
+            self.op_n += 1
+
+    def _write_op_bytes(self, rec: bytes) -> None:
+        """Append an already-marshaled op record (the one-crossing
+        extension returns the bytes; byte-identical to Op.marshal)."""
+        if self.op_writer is not None:
+            _wal_write(self.op_writer, rec)
             self.op_n += 1
 
     # -- bulk ops
@@ -1431,6 +1572,12 @@ class Bitmap:
         first batches) merges wholesale — one vectorized key merge that
         also refreshes the _keys_np cache in place (rebuilding it from
         the Python list each batch was most of the cold-write cost)."""
+        if self._table is not None and self._table_new:
+            # Point-created containers awaiting their deferred table
+            # splice: land them first — the positions computed below
+            # are relative to the CURRENT key array, which already
+            # contains them.
+            self._flush_table_dirty()
         new_arr = np.array(new_keys, dtype=np.uint64)
         old_arr = self._keys_np()
         pos = np.searchsorted(old_arr, new_arr)
@@ -2094,9 +2241,56 @@ class Bitmap:
             ok = idx < len(ka)
             sel = idx[ok][ka[idx[ok]] == keys[ok]]
             visit = [self.containers[int(i)] for i in sel.tolist()]
+        # Vectorized prefilter for array containers (the bulk-import
+        # common case): run counts for EVERY visited array in one
+        # concatenated diff + prefix-sum pass, then only the winners
+        # pay the per-container conversion. Per-container np.diff on
+        # import-sized arrays spent ~10x the work in numpy fixed
+        # overhead (measured: the optimize pass was 40% of a 1M-bit
+        # import).
+        arr_cs: list = []
+        others: list = []
         for c in visit:
             if not c.n:
                 continue
+            if c.runs is None and c.bitmap is None:
+                arr_cs.append(c)
+            else:
+                others.append(c)
+        if arr_cs:
+            lens = np.fromiter((len(c.array) for c in arr_cs),
+                               np.int64, len(arr_cs))
+            bounds = np.zeros(len(lens) + 1, np.int64)
+            np.cumsum(lens, out=bounds[1:])
+            cat = np.concatenate([c.array for c in arr_cs]).astype(
+                np.int64, copy=False)
+            adj = (np.diff(cat) == 1).astype(np.int64)
+            cum = np.zeros(len(cat), np.int64)
+            np.cumsum(adj, out=cum[1:])
+            # Adjacent pairs WITHIN container i are adj[s : e-1] —
+            # cross-container boundary diffs never enter the slice.
+            adj_i = cum[bounds[1:] - 1] - cum[bounds[:-1]]
+            # Same size model as Container.optimize — run form
+            # (2 + 4R bytes) vs the array form (4n; arrays are <=4096
+            # by invariant, so the bitmap form never prices in here) —
+            # but with a conversion margin: random data lands enough
+            # accidental adjacency that the run form wins by a handful
+            # of bytes per container (312 scattered values carry ~1.5
+            # adjacent pairs), and paying the ~35us interval build per
+            # container for a <2% byte win turned this pass into 40%
+            # of a 1M-bit import. Genuinely run-shaped data (timestamp
+            # views, sequential ids) clears 8/7 by orders of
+            # magnitude; point-op optimize() and _settle keep the
+            # exact smallest-size rule.
+            win = (2 + 4 * (lens - adj_i)) * 8 < 4 * lens * 7
+            for c, w in zip(arr_cs, win.tolist()):
+                if w:
+                    after = c.optimize()
+                    counts[after] += 1
+                    changed = changed or after != "array"
+                else:
+                    counts["array"] += 1
+        for c in others:
             before = c.kind()
             after = c.optimize()
             counts[after] += 1
@@ -2167,10 +2361,26 @@ class Bitmap:
     def _flush_table_dirty(self) -> None:
         """Patch point-mutated containers' entries into the
         serialization table — MUST run before any table read (freeze,
-        the batch gather prep). A dirty set rivaling the table size
-        falls back to wholesale invalidation (rebuild costs the
-        same)."""
+        the batch gather prep). Containers point ops CREATED since the
+        last read are first spliced in with ONE vectorized
+        table.insert, then patched like any dirty entry. A dirty set
+        rivaling the table size falls back to wholesale invalidation
+        (rebuild costs the same)."""
         t = self._table
+        new = self._table_new
+        if new:
+            if t is not None:
+                new_keys = np.fromiter(new, np.uint64, len(new))
+                new_keys.sort()
+                ka = self._keys_np()
+                idx_now = np.searchsorted(ka, new_keys)
+                # Position in the PRE-insert table: each earlier new
+                # key shifted this one right by one.
+                pos_old = idx_now - np.arange(len(new_keys))
+                t = self._table = t.insert(pos_old.astype(np.int64),
+                                           len(new_keys))
+                self._table_dirty.update(new)
+            new.clear()
         dirty = self._table_dirty
         if not dirty:
             return
@@ -2361,15 +2571,7 @@ class Bitmap:
             end = int(offs[-1] + sizes[-1])
         # Trailing op-log (bytes after the last container block).
         ops_end = max(ops_offset, end)
-        rest = buf[ops_end:]
-        while len(rest):
-            if tolerate_torn_tail and len(rest) < OP_SIZE:
-                b.torn_bytes = len(rest)
-                break
-            op = Op.unmarshal(rest)
-            op.apply(b)
-            b.op_n += 1
-            rest = rest[OP_SIZE:]
+        _replay_ops(b, buf[ops_end:], tolerate_torn_tail)
         return b
 
 

@@ -113,43 +113,59 @@ def test_sigkill_mid_write_storm_recovers(tmp_path):
 
 @pytest.mark.chaos
 def test_wal_append_torn_at_every_offset_recovers(tmp_path):
-    """Failpoint-driven DETERMINISTIC crash-mid-wal.append: tear the
-    op record at EVERY truncation offset (the ``torn(k)`` mode writes
-    k bytes then fails, exactly where a crash would cut the log) and
-    prove the reopen replays to precisely the acked prefix — the
-    SIGKILL storm above finds a random single offset; this sweeps all
-    of them."""
+    """Failpoint-driven DETERMINISTIC crash-mid-wal.append, group-commit
+    form: the ``wal.append`` failpoint now fires at the LEADER's batch
+    write (storage.wal), so ``torn(k)`` tears a GROUPED multi-record
+    batch at every byte offset — exactly where a crash mid group
+    commit would cut the log. The reopen must recover the acked prefix
+    (records whose commit barrier returned) plus exactly the complete
+    records of the torn batch (written but never acked — at-least-once
+    is allowed, loss of acked ops is not), and the fragment must
+    accept writes again."""
     from pilosa_tpu.fault import failpoints
     from pilosa_tpu.fault.failpoints import FailpointError
     from pilosa_tpu.storage.fragment import Fragment
     from pilosa_tpu.storage.roaring import OP_SIZE
+    from pilosa_tpu.storage.wal import WalError
 
+    batch_cols = [99, 100, 101]  # the torn batch: 3 records, 39 bytes
     try:
-        for k in range(OP_SIZE):  # every truncation offset of one op
+        for k in range(OP_SIZE * len(batch_cols)):
             path = str(tmp_path / f"frag{k}")
             f = Fragment(path, "i", "f", "standard", 0)
             f.open()
             acked = []
-            for col in range(8):  # acked prefix, fully WAL'd
+            for col in range(8):  # acked prefix: barriered below
                 f.set_bit(1, col)
                 acked.append(col)
+            f.wal_barrier()  # the ack point (group-commit contract)
             with failpoints.injected("wal.append", f"torn({k})"):
-                with pytest.raises(FailpointError):
-                    f.set_bit(1, 99)  # the crashed (unacked) op
-            # Simulate the crash: abandon the live object without its
-            # orderly close (which would flush/repair), release the
-            # dead process's flock, reopen from disk. The torn tail
-            # must trim to the acked set.
-            import fcntl
-            fcntl.flock(f._file.fileno(), fcntl.LOCK_UN)
+                # ONE atomic 3-record append (the batched write path)
+                # so the torn batch is the same 39 bytes regardless of
+                # when a background flush races the barrier.
+                import numpy as np
+                f.set_bits(np.full(3, 1, dtype=np.uint64),
+                           np.array(batch_cols, dtype=np.uint64))
+                with pytest.raises((FailpointError, WalError)):
+                    f.wal_barrier()  # leader write tears mid-batch
+                # Simulate the crash HERE (still torn-armed, so the
+                # background flusher cannot quietly retry the batch):
+                # mark the dead process's WAL dead and free its flock.
+                f._wal.close()
+                import fcntl
+                fcntl.flock(f._file.fileno(), fcntl.LOCK_UN)
             f2 = Fragment(path, "i", "f", "standard", 0)
             f2.open()
             try:
+                # The failed leader truncated back to the durable
+                # prefix, so recovery is EXACTLY the acked set — none
+                # of the torn batch's records survive at any offset.
                 got = sorted(f2.row(1).bits())
                 assert got == acked, (
                     f"torn at {k}: {got} != acked {acked}")
-                assert f2.set_bit(1, 99), \
+                assert f2.set_bit(1, 999), \
                     f"torn at {k}: fragment must accept writes again"
+                f2.wal_barrier()
             finally:
                 f2.close()
     finally:

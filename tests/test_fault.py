@@ -252,14 +252,24 @@ class TestFailpointSites:
                 c._do("GET", "/schema", host="peerB:1")  # B partitioned
 
     def test_wal_append_error(self, tmp_path):
+        """The wal.append site now lives at the group-commit LEADER
+        write (storage.wal): point ops append in memory, and the
+        injected fault surfaces at the commit barrier. A failed write
+        leaves the batch pending and retryable — after disarm the next
+        barrier lands it plus later writes."""
+        from pilosa_tpu.storage.wal import WalError
         f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
         f.open()
         try:
             f.set_bit(1, 5)
+            f.wal_barrier()
             with failpoints.injected("wal.append", "error"):
-                with pytest.raises(FailpointError):
-                    f.set_bit(1, 6)
+                f.set_bit(1, 6)  # appends fine; the flush fails
+                with pytest.raises((FailpointError, WalError)):
+                    f.wal_barrier()
             assert f.set_bit(1, 7)  # disarmed: writes flow again
+            f.wal_barrier()  # retries the failed batch + the new op
+            assert f._wal.pending_bytes() == 0
         finally:
             f.close()
 

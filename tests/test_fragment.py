@@ -357,8 +357,14 @@ class TestImport:
         frag.import_bits(rows, cols)
         assert frag.row_count(0) == 2
         assert frag.row_count(1) == 3
-        # import must snapshot: no trailing op-log
-        assert frag.storage.op_n == 0
+        # WAL-first import contract: the bits ride the op-log (one
+        # group-committed blob, durable before import_bits returned)
+        # instead of forcing a synchronous snapshot; the MAX_OP_N
+        # cadence snapshots in the background. The blob counts toward
+        # op_n at 1/16th per position (fragment._BLOB_OP_WEIGHT — blob
+        # replay is the vectorized lane), so 5 positions weigh 1.
+        assert frag.storage.op_n == 1
+        assert frag._wal is None or frag._wal.pending_bytes() == 0
 
     def test_import_out_of_bounds(self, frag):
         with pytest.raises(ValueError):
@@ -735,6 +741,11 @@ class TestAsyncSnapshot:
             return orig(live, w)
 
         monkeypatch.setattr(roaring_mod, "write_frozen", slow_write)
+        # Pin the import to the vintage detach-then-SYNC-snapshot lane
+        # (the WAL-first lane never takes _snap_mu, so it would finish
+        # while the worker is still serializing — by design).
+        import pilosa_tpu.storage.fragment as fragmod
+        monkeypatch.setattr(fragmod, "_WAL_IMPORT_MAX_BYTES", -1)
         f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
         f.open()
         try:

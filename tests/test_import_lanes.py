@@ -321,11 +321,25 @@ class TestRawImportWire:
         ts = rng.integers(0, 1 << 50, 1000).astype(np.int64)
         for t in (None, ts):
             body = rawimport.encode("idx", "frm", 7, rows, cols, t)
-            i, f, s, r, c, tt = rawimport.decode(body)
+            i, f, s, r, c, tt, p = rawimport.decode(body)
             assert (i, f, s) == ("idx", "frm", 7)
             assert np.array_equal(r, rows) and np.array_equal(c, cols)
             assert (tt is None) == (t is None)
+            assert p is None
             assert r.__array_interface__["data"][0] % 8 == 0
+
+    def test_positions_codec_round_trip(self):
+        from pilosa_tpu.proto import rawimport
+        posn = np.arange(0, 5000, 3, dtype=np.uint64)
+        body = rawimport.encode_positions("idx", "frm", 9, posn)
+        assert rawimport.version_of(body) == 2
+        i, f, s, r, c, tt, p = rawimport.decode(body)
+        assert (i, f, s) == ("idx", "frm", 9)
+        assert r is None and c is None and tt is None
+        assert np.array_equal(p, posn)
+        assert p.__array_interface__["data"][0] % 8 == 0
+        with pytest.raises(ValueError):
+            rawimport.decode(body[:-3])  # truncated positions
 
     def test_truncated_bodies_raise_value_error(self):
         from pilosa_tpu.proto import rawimport
@@ -380,5 +394,99 @@ class TestRawImportWire:
                     data=b'Count(Bitmap(rowID=3, frame="f"))',
                     method="POST")
                 assert b"[2]" in urllib.request.urlopen(q).read()
+                # v2 positions form: sorted lands, unsorted is a 400
+                # (the sort is the client's contract)
+                from pilosa_tpu import SLICE_WIDTH
+                W = np.uint64(SLICE_WIDTH)
+                posn = np.uint64(3) * W + np.array(
+                    [10, 11, 40], dtype=np.uint64)
+                assert post("/import", RAW, PB,
+                            rawimport.encode_positions(
+                                "ri", "f", 0, posn)) == 200
+                assert post("/import", RAW, PB,
+                            rawimport.encode_positions(
+                                "ri", "f", 0, posn[::-1].copy())) == 400
+                q = urllib.request.Request(
+                    f"http://{srv.host}/index/ri/query",
+                    data=b'Count(Bitmap(rowID=3, frame="f"))',
+                    method="POST")
+                assert b"[5]" in urllib.request.urlopen(q).read()
+            finally:
+                srv.close()
+
+    def test_positions_version_negotiation_falls_back(self, monkeypatch):
+        """A host that rejects the v2 positions form (400 mentioning
+        the version) must be remembered in _no_posn_import and served
+        the v1 pair form — the import still lands."""
+        import tempfile
+
+        from pilosa_tpu.cluster import client as client_mod
+        from pilosa_tpu.proto import rawimport
+        from pilosa_tpu.server.server import Server
+
+        real = rawimport.encode_positions
+
+        def bad_version(index, frame, slice, positions):
+            body = bytearray(real(index, frame, slice, positions))
+            body[4] = 9  # an unknown wire version
+            return bytes(body)
+
+        # The client resolves encode_positions through the module at
+        # call time, so patching the module attribute reroutes it;
+        # the SERVER decodes through the same module but only calls
+        # decode(), which stays real.
+        monkeypatch.setattr(rawimport, "encode_positions", bad_version)
+        with tempfile.TemporaryDirectory() as d:
+            srv = Server(d, host="127.0.0.1:0",
+                         anti_entropy_interval=0, polling_interval=0)
+            srv.open()
+            try:
+                client = client_mod.Client(srv.host)
+                client.create_index("nv")
+                client.create_frame("nv", "f")
+                rows = np.array([3, 3, 9], dtype=np.uint64)
+                cols = np.array([1, 2, 3], dtype=np.uint64)
+                client.import_arrays("nv", "f", rows, cols)
+                assert srv.host in client._no_posn_import
+                import urllib.request
+                q = urllib.request.Request(
+                    f"http://{srv.host}/index/nv/query",
+                    data=b'Count(Bitmap(rowID=3, frame="f"))',
+                    method="POST")
+                assert b"[2]" in urllib.request.urlopen(q).read()
+            finally:
+                srv.close()
+
+    def test_positions_form_inverse_frame_falls_back(self):
+        """A frame with the inverse view enabled needs (row, col)
+        pairs for the transpose; the positions lane must reconstruct
+        them server-side and land BOTH views."""
+        import tempfile
+
+        from pilosa_tpu.cluster.client import Client
+        from pilosa_tpu.server.server import Server
+        with tempfile.TemporaryDirectory() as d:
+            srv = Server(d, host="127.0.0.1:0",
+                         anti_entropy_interval=0, polling_interval=0)
+            srv.open()
+            try:
+                client = Client(srv.host)
+                client.create_index("pi")
+                client.create_frame("pi", "f",
+                                    options={"inverseEnabled": True})
+                rows = np.array([1, 1, 2], dtype=np.uint64)
+                cols = np.array([5, 9, 5], dtype=np.uint64)
+                client.import_arrays("pi", "f", rows, cols)
+                import json as json_mod
+                import urllib.request
+                for pql, want in (
+                        (b'Count(Bitmap(rowID=1, frame="f"))', 2),
+                        (b'Count(Bitmap(columnID=5, frame="f"))', 2)):
+                    q = urllib.request.Request(
+                        f"http://{srv.host}/index/pi/query", data=pql,
+                        method="POST")
+                    got = json_mod.loads(
+                        urllib.request.urlopen(q).read())
+                    assert got["results"] == [want], (pql, got)
             finally:
                 srv.close()

@@ -449,6 +449,10 @@ class TestFragmentEndToEnd:
         frag = holder.fragment("r", "f", "standard", 0)
         stats = frag.container_stats()
         assert stats["counts"]["run"] > 0, stats
+        # WAL-first imports no longer force a synchronous snapshot;
+        # take one so the on-disk cookie reflects the run containers.
+        frag._join_snapshot()
+        frag.snapshot()
         with open(frag.path, "rb") as f:
             assert int.from_bytes(f.read(4),
                                   "little") == roaring.COOKIE_RUNS

@@ -4,6 +4,7 @@ batch run (reference per-op loop: fragment.go:369-459,
 executor.go:664-797 — the batch path must be observationally
 identical)."""
 
+import fcntl
 import io
 import os
 import tempfile
@@ -206,9 +207,16 @@ class TestFragmentBatch:
             for s in range(0, n, 1000):
                 frag.set_bits(rows[s:s + 1000], cols[s:s + 1000])
             frag._join_snapshot()
+            frag.wal_barrier()  # the ack point: records reach the OS
             want = frag.storage.values().copy()
-            # simulate crash: no close(), just drop and reopen
+            # simulate crash: no close(), just drop and reopen. A real
+            # crash releases the flock with the process; in-process the
+            # mmap holds a dup of the locked description, so release
+            # explicitly.
+            if frag._wal is not None:
+                frag._wal.close()
             frag.storage.op_writer = None
+            fcntl.flock(frag._file.fileno(), fcntl.LOCK_UN)
             frag._file.close()
             frag2 = Fragment(p, "i", "f", "standard", 0)
             frag2.__init__(p, "i", "f", "standard", 0)
@@ -399,6 +407,7 @@ class TestCacheCompletenessAfterCrash:
                 frame2.set_bit("standard", 7, c)
             frag = frame2.view("standard").fragments[0]
             frag._join_snapshot()
+            frag.wal_barrier()  # the ack point: records reach the OS
             frag.storage.op_writer = None
             import fcntl
             fcntl.flock(frag._file.fileno(), fcntl.LOCK_UN)
