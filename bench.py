@@ -283,6 +283,21 @@ def main() -> None:
                     "p50_ratio": cm.get("p50_ratio"),
                     "runs_p50_ms": cm["runs"].get("p50_ms"),
                     "containers": cm["runs"].get("containers")}
+            # Distributed fast paths (suite.config_distributed_topn →
+            # DISTRIBUTED.json): 2-node TopN pushdown vs fan-out vs
+            # single-node, and the generation-validated resident
+            # chain — ROADMAP item 3's acceptance numbers on the line
+            # of record.
+            dt = manifest.get("distributed_topn") or {}
+            if dt.get("topn_pushdown_p50_ms") is not None:
+                line["distributed_topn"] = {
+                    "pushdown_p50_ms": dt["topn_pushdown_p50_ms"],
+                    "vs_single": dt.get("topn_vs_single"),
+                    "vs_fanout": dt.get("topn_vs_fanout"),
+                    "chain_hit_p50_ms": dt.get("chain_hit_p50_ms"),
+                    "chain_miss_ms": dt.get("chain_miss_ms"),
+                    "generations_rtt_ms": dt.get(
+                        "generations_rtt_ms")}
         except (OSError, ValueError, KeyError):
             pass
         # Serving-quality artifact (sched subsystem): open-loop

@@ -227,6 +227,15 @@ def load_server_config(args, env=None):
         cfg.query.default_timeout = args.query_default_timeout
     if getattr(args, "query_slow_threshold", None) is not None:
         cfg.query.slow_threshold = args.query_slow_threshold
+    if getattr(args, "query_result_cache_entries", None) is not None:
+        cfg.query.result_cache_entries = args.query_result_cache_entries
+    if getattr(args, "query_result_cache_bits", None) is not None:
+        cfg.query.result_cache_bits = args.query_result_cache_bits
+    if getattr(args, "query_cluster_cache_entries", None) is not None:
+        cfg.query.cluster_cache_entries = \
+            args.query_cluster_cache_entries
+    if getattr(args, "cluster_gen_staleness", None) is not None:
+        cfg.cluster.gen_staleness = args.cluster_gen_staleness
     from ..utils.config import _parse_bool
     if getattr(args, "metrics_enabled", None) is not None:
         cfg.metrics.enabled = _parse_bool(args.metrics_enabled)
@@ -292,7 +301,8 @@ def cmd_server(args, stdout, stderr) -> int:
                     logger=logger, query_config=cfg.query,
                     metrics_config=cfg.metrics, trace_config=cfg.trace,
                     profile_config=cfg.profile, slo_config=cfg.slo,
-                    fault_config=cfg.fault)
+                    fault_config=cfg.fault,
+                    gen_staleness_s=cfg.cluster.gen_staleness)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -595,6 +605,26 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None, metavar="DUR",
                    help="log queries slower than this with per-stage"
                         " timings (0 = disabled)")
+    s.add_argument("--query.result-cache-entries",
+                   dest="query_result_cache_entries", type=int,
+                   default=None, metavar="N",
+                   help="materialized-result residency cache entry"
+                        " bound (0 disables, default 8)")
+    s.add_argument("--query.result-cache-bits",
+                   dest="query_result_cache_bits", type=int,
+                   default=None, metavar="N",
+                   help="materialized-result residency cache total"
+                        " cached-bit bound (default 33554432)")
+    s.add_argument("--query.cluster-cache-entries",
+                   dest="query_cluster_cache_entries", type=int,
+                   default=None, metavar="N",
+                   help="coordinator hot-query result cache entry"
+                        " bound (0 disables, default 64)")
+    s.add_argument("--cluster.gen-staleness",
+                   dest="cluster_gen_staleness", type=parse_duration,
+                   default=None, metavar="DUR",
+                   help="generation-map staleness bound for"
+                        " remote-slice cache keys (default 2s)")
     s.add_argument("--anti-entropy.interval", dest="anti_entropy_interval",
                    type=parse_duration, default=None, metavar="DUR",
                    help="anti-entropy sweep interval (e.g. 10m)")

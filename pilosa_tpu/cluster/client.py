@@ -29,6 +29,7 @@ from ..obs.trace import SPANS_HEADER, TRACE_HEADER
 from ..pql import parser as pql
 from ..proto import internal_pb2 as pb
 from ..sched import context as sched_context
+from . import generations as gens_mod
 from .topology import Node
 
 _PROTOBUF = "application/x-protobuf"
@@ -90,11 +91,19 @@ class Client:
     closed an idle socket.
     """
 
-    def __init__(self, host: str, timeout: float = 30.0, fault=None):
+    def __init__(self, host: str, timeout: float = 30.0, fault=None,
+                 gens=None):
         if not host:
             raise ClientError("host required")
         self.host = host
         self.timeout = timeout
+        # Coordinator-side generation map (cluster.generations): when
+        # set, every query/import response's piggybacked
+        # X-Pilosa-Generations header lands here — unless the caller
+        # passes gens_out to take custody (hedged reads must merge
+        # the WINNING leg's tokens only). None (external clients,
+        # CLI) skips the parse entirely.
+        self.gens = gens
         # Fault-tolerance hook (fault.FaultManager): when set, every
         # request consults the target's circuit breaker (open = fail
         # fast with CircuitOpenError instead of paying the socket
@@ -314,6 +323,14 @@ class Client:
             status, raw = self._do(method, path, body, headers,
                                    host=host, headers_out=headers_out)
             if status != 429:
+                if self.gens is not None:
+                    # Import acks piggyback the touched fragments'
+                    # generation tokens (same contract as query legs)
+                    # — one parse site covers every import form.
+                    for hk, hv in headers_out:
+                        if (hk.lower()
+                                == gens_mod.GENERATIONS_HEADER.lower()):
+                            self.gens.apply_wire(host or self.host, hv)
                 return status, raw
             retry_after = 0.0
             for hk, hv in headers_out:
@@ -340,6 +357,8 @@ class Client:
     # Marker the executor checks before passing lifecycle kwargs —
     # scripted test fakes without the kwargs keep the plain call shape.
     deadline_aware = True
+    # Same idea for the generation-token kwarg (gens_out).
+    generation_aware = True
 
     def execute_query(self, node, index: str, query: str,
                       slices: Optional[list[int]] = None,
@@ -347,11 +366,18 @@ class Client:
                       column_attrs: bool = False,
                       pod_local: bool = False,
                       deadline_s: Optional[float] = None,
-                      query_id: Optional[str] = None) -> list:
+                      query_id: Optional[str] = None,
+                      gens_out: Optional[list] = None) -> list:
         """``deadline_s``/``query_id`` propagate the coordinator's
         REMAINING budget and query identity to the peer (sched wire
         contract: X-Pilosa-Deadline / X-Pilosa-Query-Id), and clamp
-        this leg's socket timeouts + retry budget to the deadline."""
+        this leg's socket timeouts + retry budget to the deadline.
+
+        ``gens_out`` (a list) takes custody of the response's
+        generation tokens as ``(peer, payload)`` pairs INSTEAD of
+        applying them to ``self.gens`` — the hedged-read path applies
+        only the winning leg's tokens, so a stale loser can never
+        poison the coordinator generation map."""
         from ..server import codec
         body = codec.encode_query_request(query, slices,
                                           column_attrs=column_attrs,
@@ -375,7 +401,8 @@ class Client:
         headers_out: Optional[list] = None
         if trace is not None:
             headers[TRACE_HEADER] = "1"
-        if trace is not None or cost is not None:
+        if (trace is not None or cost is not None
+                or self.gens is not None or gens_out is not None):
             headers_out = []
         target = _host_of(node) if node is not None else self.host
         status, raw = self._do(
@@ -392,6 +419,11 @@ class Client:
                     trace.add_remote_json(hv)
                 elif cost is not None and lk == COST_HEADER.lower():
                     cost.add_remote_json(hv)
+                elif lk == gens_mod.GENERATIONS_HEADER.lower():
+                    if gens_out is not None:
+                        gens_out.append((target, hv))
+                    elif self.gens is not None:
+                        self.gens.apply_wire(target, hv)
         self._ok(status, raw, "execute query")
         resp = pb.QueryResponse.FromString(raw)
         if resp.Err:
@@ -412,6 +444,26 @@ class Client:
         status, raw = self._do("DELETE",
                                f"/debug/queries/{query_id}", host=host)
         return json.loads(self._ok(status, raw, "cancel query"))
+
+    def generations(self, index: str,
+                    slices: Optional[list[int]] = None,
+                    host: Optional[str] = None,
+                    deadline_s: Optional[float] = None) -> dict:
+        """GET /generations: a peer's current per-fragment generation
+        tokens for the given slices — the cheap validation round-trip
+        the coordinator result cache pays instead of a full fan-out
+        re-execution. The probe's answer also refreshes ``self.gens``
+        (it is the freshest possible knowledge of that peer)."""
+        path = f"/generations?index={index}"
+        if slices:
+            path += "&slices=" + ",".join(str(s) for s in slices)
+        status, raw = self._do("GET", path, host=host,
+                               deadline_s=deadline_s)
+        data = json.loads(self._ok(status, raw, "generations"))
+        tokens = gens_mod.decode_tokens(data.get("tokens") or {})
+        if self.gens is not None and tokens:
+            self.gens.apply(host or self.host, index, tokens)
+        return tokens
 
     # -- schema / slices (client.go:63-136) ----------------------------------
 

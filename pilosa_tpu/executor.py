@@ -38,6 +38,7 @@ from .cluster.topology import Cluster, Node, new_cluster
 from .errors import (TIME_FORMAT, FrameNotFoundError, IndexNotFoundError,
                      PilosaError, QueryCancelledError, QueryDeadlineError,
                      QueryRequiredError, SliceUnavailableError)
+from .obs import accounting as obs_accounting
 from .obs import metrics as obs_metrics
 from .obs import trace as obs_trace
 from .sched import context as sched_context
@@ -132,11 +133,29 @@ class Executor:
                  cluster: Optional[Cluster] = None, client=None,
                  max_workers: int = 16, use_mesh: Optional[bool] = None,
                  mesh_min_slices: Optional[int] = None, pod=None,
-                 fault=None):
+                 fault=None, gens=None,
+                 result_cache_entries: Optional[int] = None,
+                 result_cache_bits: Optional[int] = None,
+                 cluster_cache_entries: Optional[int] = None,
+                 gen_staleness_s: Optional[float] = None):
         self.holder = holder
         self.host = host
         self.cluster = cluster or new_cluster([host])
         self.client = client
+        # Cluster-wide generation knowledge (cluster.generations
+        # GenerationMap, shared with every pooled Client): lets the
+        # result caches key and validate slices owned ELSEWHERE. None
+        # (bare executors, single node) keeps those paths local-only.
+        self.gens = gens
+        if gen_staleness_s is None:
+            raw = os.environ.get("PILOSA_CLUSTER_GEN_STALENESS")
+            if raw:
+                try:
+                    gen_staleness_s = float(raw)
+                except ValueError:
+                    from .utils.config import parse_duration
+                    gen_staleness_s = parse_duration(raw)
+        self._gen_staleness_s = gen_staleness_s  # None = map default
         # Fault-tolerance state (fault.FaultManager): _slices_by_node
         # orders replica owners by health and sinks open circuits, the
         # re-map path consults it instead of rediscovering a dead peer
@@ -191,8 +210,51 @@ class Executor:
         self._pools: dict[str, ThreadPoolExecutor] = {}
         self._pools_mu = threading.Lock()
         # Materialized bitmap-result residency (see _bitmap_result_key).
+        # Bounds are configurable ([query] result-cache-* /
+        # PILOSA_QUERY_RESULT_CACHE_*); the class attrs stay the
+        # defaults for bare executors.
         self._bitmap_results: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._bitmap_results_mu = threading.Lock()
+        if result_cache_entries is None:
+            result_cache_entries = int(os.environ.get(
+                "PILOSA_QUERY_RESULT_CACHE_ENTRIES",
+                str(self._RESULT_CACHE_ENTRIES)))
+        if result_cache_bits is None:
+            result_cache_bits = int(os.environ.get(
+                "PILOSA_QUERY_RESULT_CACHE_BITS",
+                str(self._RESULT_CACHE_BITS)))
+        self._result_cache_entries = result_cache_entries
+        self._result_cache_bits = result_cache_bits
+        # Coordinator hot-query result cache (the first cluster-wide
+        # reuse of the generation machinery): merged read-query
+        # results keyed by (index, PQL, slice set), validated on hit
+        # by a /generations token round-trip per involved peer — so a
+        # repeated resident chain over remote slices serves at ~RTT
+        # floor instead of re-running the fan-out + fold. 0 disables.
+        if cluster_cache_entries is None:
+            cluster_cache_entries = int(os.environ.get(
+                "PILOSA_QUERY_CLUSTER_CACHE_ENTRIES", "64"))
+        self._cluster_cache_entries = cluster_cache_entries
+        self._cluster_cache: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._cluster_cache_mu = threading.Lock()
+        # Hit-validation probe budget (seconds): a probe is an
+        # optimization, so a slow/stalled peer costs at most this
+        # before the entry drops and the real fan-out (with its
+        # failover machinery) answers.
+        self._CLUSTER_PROBE_TIMEOUT_S = 1.0
+        # Distributed TopN pushdown (ROADMAP item 3): remote legs run
+        # the single-pass TopN over their own slices and the
+        # coordinator merges partials per the reference two-phase
+        # semantics. Off => the plain candidate fan-out path.
+        self._topn_pushdown = os.environ.get(
+            "PILOSA_TPU_TOPN_PUSHDOWN", "1") != "0"
+        # Speculative hint memo: (index, frame) -> the last merged
+        # candidate union (bounded), dispatched with pushdown legs so
+        # the steady state needs ONE overlapped round trip. Purely
+        # advisory — a stale or missing entry costs an extra round,
+        # never correctness.
+        self._topn_hint_memo: "OrderedDict[tuple, tuple]" = \
+            OrderedDict()
         # Per-op write fast lane (see _execute_mutate_bit): (index,
         # frame, slice) -> (frame_obj, Fragment), validated per op by
         # identity of the CURRENT frame object and the fragment's
@@ -394,6 +456,23 @@ class Executor:
         if _has_only_set_row_attrs(query.calls):
             return self._execute_bulk_set_row_attrs(index, query.calls, opt)
 
+        # Coordinator hot-query result cache (cluster.generations):
+        # repeated read queries over a distributed slice set serve at
+        # ~RTT floor — one /generations token probe per involved peer
+        # instead of the full fan-out + fold — with a token mismatch
+        # (any replica took a write) invalidating the entry.
+        cluster_key = pre_tokens = None
+        if _partial_out is None:
+            cluster_key = self._cluster_cache_key(index, query, slices,
+                                                  opt)
+            if cluster_key is not None:
+                hit = self._cluster_cache_lookup(cluster_key, index,
+                                                 opt)
+                if hit is not None:
+                    return hit
+                pre_tokens = self._cluster_cache_snapshot(index,
+                                                          slices)
+
         results = _partial_out if _partial_out is not None else []
         i = 0
         while i < len(query.calls):
@@ -429,6 +508,9 @@ class Executor:
             results.append(self._execute_call(index, call, call_slices,
                                               opt))
             i += 1
+        if cluster_key is not None:
+            self._cluster_cache_store(cluster_key, index, slices,
+                                      results, pre_tokens)
         return results
 
     def _execute_call(self, index: str, c: Call, slices: list[int],
@@ -472,6 +554,176 @@ class Executor:
         owns = self.cluster.owns_fragment
         return all(owns(host, index, s) for s in slices)
 
+    # -- coordinator hot-query result cache (cluster.generations) -----------
+
+    def _share_cached(self, r):
+        """COW/shallow handout of one cached query result."""
+        if isinstance(r, Bitmap):
+            return self._share_result(r)
+        if isinstance(r, list):
+            return list(r)
+        return r
+
+    def _cluster_cache_key(self, index: str, query: Query,
+                           slices: list[int],
+                           opt: ExecOptions) -> Optional[tuple]:
+        """(index, PQL, slice set) when this query is cluster-cache
+        eligible: a coordinator-side read over a slice set NOT fully
+        owned here (the covered case belongs to the local fast
+        paths), with a generation map + probe-capable client to
+        validate against. Declines: top-level Bitmap calls (their
+        results carry row/column ATTRS, and attribute writes don't
+        bump fragment generations — a cached copy could serve stale
+        attrs) and attr-filtered TopN forms (same blind spot), and
+        anything inverse-shaped at the top level (it swaps in the
+        inverse slice list, which the per-slice token snapshot
+        doesn't span)."""
+        if (self._cluster_cache_entries <= 0 or self.gens is None
+                or self.client is None
+                or not hasattr(self.client, "generations")
+                or self.pod is not None or opt.remote or opt.partial
+                or not slices or len(self.cluster.nodes) < 2):
+            return None
+        for call in query.calls:
+            if (call.name in _WRITE_CALLS or call.name == "Bitmap"
+                    or call.args.get("filters")):
+                return None
+        if self._owns_all_slices(index, slices):
+            return None
+        return (index, str(query), tuple(slices))
+
+    def _cluster_cache_lookup(self, key: tuple, index: str,
+                              opt: ExecOptions) -> Optional[list]:
+        with self._cluster_cache_mu:
+            ent = self._cluster_cache.get(key)
+        if ent is None:
+            obs_metrics.CLUSTER_CACHE_REQUESTS.labels("miss").inc()
+            return None
+        if self._cluster_cache_validate(ent, index, opt):
+            with self._cluster_cache_mu:
+                if key in self._cluster_cache:
+                    self._cluster_cache.move_to_end(key)
+            obs_metrics.CLUSTER_CACHE_REQUESTS.labels("hit").inc()
+            obs_accounting.note_result_cache_hit(opt.ctx)
+            return [self._share_cached(r) for r in ent["results"]]
+        with self._cluster_cache_mu:
+            self._cluster_cache.pop(key, None)
+        obs_metrics.CLUSTER_CACHE_REQUESTS.labels("invalidated").inc()
+        return None
+
+    def _cluster_cache_validate(self, ent: dict, index: str,
+                                opt: ExecOptions) -> bool:
+        """True iff every generation token the entry was cached under
+        still holds: local slices against live fragments, remote
+        slices against a fresh /generations probe of the peer that
+        served them (~RTT, the whole point). Any mismatch or
+        unreachable peer reads as invalid — never a stale answer."""
+        from .cluster import generations as gens_mod
+        for s, toks in ent["local"].items():
+            if gens_mod.slice_tokens(self.holder, index, s) != toks:
+                return False
+        remote: dict = ent["remote"]
+        if not remote:
+            return True
+        ctx = opt.ctx
+        # The probe is an OPTIMIZATION: bound it tightly, far below
+        # the query budget — a stalled peer must cost at most this
+        # before the real fan-out (which owns failover) takes over. A
+        # probe timing out is a failed validation, NOT the query's
+        # deadline; ctx.check() below re-raises only when the query
+        # itself is actually dead.
+        timeout = self._CLUSTER_PROBE_TIMEOUT_S
+        if ctx is not None:
+            remaining = ctx.remaining()
+            if remaining is not None:
+                timeout = min(timeout, remaining)
+
+        def probe(peer, entry):
+            got = self.client.generations(index, sorted(entry),
+                                          host=peer,
+                                          deadline_s=timeout)
+            return all(got.get(s) == toks
+                       for s, toks in entry.items())
+
+        try:
+            items = list(remote.items())
+            if len(items) == 1:
+                return probe(*items[0])
+            pool = self._pool("node")
+            futs = [pool.submit(probe, p, e) for p, e in items]
+            ok = True
+            try:
+                for f in futs:
+                    if not f.result():
+                        ok = False
+            finally:
+                pending = [f for f in futs if not f.cancel()]
+                if pending:
+                    wait(pending)
+            return ok
+        except (QueryDeadlineError, QueryCancelledError):
+            if ctx is not None:
+                ctx.check()  # the QUERY is dead → propagate
+            return False  # only the bounded probe expired: recompute
+        except Exception:  # noqa: BLE001 - unreachable peer: recompute
+            return False
+
+    def _cluster_cache_snapshot(self, index: str,
+                                slices: list[int]) -> Optional[dict]:
+        """Pre-execution token snapshot: live fragment tokens for
+        locally-owned slices, and for remote slices the map's
+        freshest-known (peer, tokens) — from the PREVIOUS exchange
+        with the peer. A remote slice the map has never seen returns
+        None (the query can't be cached this round; its own legs
+        populate the map for the next one)."""
+        from .cluster import generations as gens_mod
+        owns = self.cluster.owns_fragment
+        local: dict = {}
+        remote: dict = {}
+        for s in slices:
+            if owns(self.host, index, s):
+                local[s] = gens_mod.slice_tokens(self.holder, index, s)
+                continue
+            got = self.gens.newest(index, s)
+            if got is None:
+                return None
+            peer, toks, _ts = got
+            remote.setdefault(peer, {})[s] = dict(toks)
+        return {"local": local, "remote": remote}
+
+    def _cluster_cache_store(self, key: tuple, index: str,
+                             slices: list[int], results: list,
+                             pre: Optional[dict]) -> None:
+        """Cache a completed read's merged results under the
+        PRE-EXECUTION token snapshot, and only when the tokens are
+        STABLE across the query (post-execution state identical): a
+        generation that moved mid-query — a write racing the legs'
+        reads, whichever side of them it landed on — means the
+        results can't be attributed to one token state, so they stay
+        uncached rather than risk a snapshot that validates forever
+        against data the legs never saw (review finding). The stable
+        case is exactly the one where the legs' reads provably fall
+        inside an unchanged-generation window."""
+        if pre is None:
+            return
+        bits = 0
+        for r in results:
+            if isinstance(r, Bitmap):
+                bits += r.count()
+        if bits > self._result_cache_bits:
+            return
+        post = self._cluster_cache_snapshot(index, slices)
+        if post != pre:
+            return
+        ent = {"results": [self._share_cached(r) for r in results],
+               "local": pre["local"], "remote": pre["remote"]}
+        with self._cluster_cache_mu:
+            cache = self._cluster_cache
+            cache[key] = ent
+            cache.move_to_end(key)
+            while len(cache) > self._cluster_cache_entries:
+                cache.popitem(last=False)
+
     # -- bitmap expressions (executor.go:192-570) ----------------------------
 
     # Materialized-result residency (VERDICT r4 item 5): completed
@@ -484,23 +736,54 @@ class Executor:
     _RESULT_CACHE_ENTRIES = 8
     _RESULT_CACHE_BITS = 32 << 20
 
+    def _primary_owner_host(self, index: str, slice: int
+                            ) -> Optional[str]:
+        """The replica owner _slices_by_node would consult first for
+        this slice (fault-ordered when a fault manager is attached) —
+        the peer whose generation tokens a remote-slice cache key
+        should embed, since it is the peer most likely to serve the
+        recompute."""
+        owners = self.cluster.fragment_nodes(index, slice)
+        if not owners:
+            return None
+        if self.fault is not None and len(owners) > 1:
+            owners = self.fault.order_nodes(owners, local=self.host)
+        return owners[0].host
+
     def _bitmap_result_key(self, index: str, c: Call,
                            slices: list[int],
                            compiled_out: Optional[list] = None):
         """Cache key embedding every input fragment's mutation
         generation, or None when the call/topology isn't cacheable.
-        Multi-node clusters cache when this node OWNS every touched
-        slice (its local generations then see every replica-fanned
-        write); slices owned elsewhere have invisible generations, so
-        a key could go stale silently — those stay uncached. The
-        compiled (expr, leaves) is appended to ``compiled_out`` so the
-        device fold reuses it instead of re-walking the call tree
+        Locally-owned slices key on the live fragment's (uid,
+        generation) (every replica-fanned write bumps it); slices
+        owned ELSEWHERE key on the owner's tokens from the coordinator
+        generation map (cluster.generations) within the bounded
+        staleness window — the map refreshes on every exchange with
+        the peer (query legs, import acks, probes), so a write routed
+        through this coordinator invalidates on its own response and
+        only out-of-band writes ride the staleness bound. An unknown
+        or stale token means uncached, never a guess. The compiled
+        (expr, leaves) is appended to ``compiled_out`` so the device
+        fold reuses it instead of re-walking the call tree
         (1000-child Unions pay the walk once, review r5)."""
         if c.name not in ("Union", "Intersect", "Difference"):
             return None
-        if self.pod is not None or not self._owns_all_slices(index,
-                                                             slices):
+        if self.pod is not None:
             return None
+        owner_of: dict[int, str] = {}
+        if len(self.cluster.nodes) > 1:
+            owns = self.cluster.owns_fragment
+            host = self.host
+            for s in slices:
+                if owns(host, index, s):
+                    continue
+                if self.gens is None:
+                    return None  # invisible generations: uncached
+                peer = self._primary_owner_host(index, s)
+                if peer is None or peer == host:
+                    return None
+                owner_of[s] = peer
         leaves: list[tuple] = []
         expr = self._compile_device_expr(index, c, leaves)
         if expr is None or not leaves:
@@ -512,9 +795,18 @@ class Executor:
         gens = []
         for frame, view, _row in leaves:
             for s in slices:
+                peer = owner_of.get(s)
+                if peer is not None:
+                    tok = self.gens.token(
+                        peer, index, frame, view, s,
+                        max_age_s=self._gen_staleness_s)
+                    if tok is None:
+                        return None  # unknown/stale: uncached
+                    gens.append((peer, tok[0], tok[1]))
+                    continue
                 f = self.holder.fragment(index, frame, view, s)
-                gens.append((f.device.uid, f.device.generation)
-                            if f is not None else (0, 0))
+                gens.append(("", f.device.uid, f.device.generation)
+                            if f is not None else ("", 0, 0))
         return (index, expr, tuple(slices), tuple(gens))
 
     def _share_result(self, bm: Bitmap) -> Bitmap:
@@ -529,17 +821,21 @@ class Executor:
 
     def _result_cache_put(self, key, bm: Bitmap) -> None:
         bits = bm.count()
-        if bits > self._RESULT_CACHE_BITS:
+        if bits > self._result_cache_bits:
             return
+        evicted_n = 0
         with self._bitmap_results_mu:
             cache = self._bitmap_results
             cache[key] = (bm, bits)
             cache.move_to_end(key)
             total = sum(b for _, b in cache.values())
-            while (len(cache) > self._RESULT_CACHE_ENTRIES
-                   or total > self._RESULT_CACHE_BITS) and len(cache) > 1:
+            while (len(cache) > self._result_cache_entries
+                   or total > self._result_cache_bits) and len(cache) > 1:
                 _, (_, evicted) = cache.popitem(last=False)
                 total -= evicted
+                evicted_n += 1
+        if evicted_n:
+            obs_metrics.RESULT_CACHE_EVICTIONS.inc(evicted_n)
 
     def _execute_bitmap_call(self, index: str, c: Call, slices: list[int],
                              opt: ExecOptions) -> Bitmap:
@@ -551,7 +847,10 @@ class Executor:
                 if hit is not None:
                     self._bitmap_results.move_to_end(key)
             if hit is not None:
+                obs_metrics.RESULT_CACHE_HITS.inc()
+                obs_accounting.note_result_cache_hit(opt.ctx)
                 return self._share_result(hit[0])
+            obs_metrics.RESULT_CACHE_MISSES.inc()
 
         def map_fn(slice):
             return self._bitmap_call_slice(index, c, slice)
@@ -1713,9 +2012,22 @@ class Executor:
         row_ids, _ = c.uint_slice_arg("ids")
         n, _ = c.uint_arg("n")
 
+        if opt.remote and not row_ids and c.args.get("pushdown"):
+            # Pushdown leg (ROADMAP item 3): the coordinator asked
+            # this node to run the WHOLE TopN algorithm over its own
+            # slices — single-pass when the rank caches allow, exact
+            # local two-phase otherwise — and return untrimmed exact
+            # partials for the two-phase merge.
+            return self._topn_exact_partial(index, c, slices, opt)
+
         fast = self._topn_host_single_pass(index, c, slices, opt)
         if fast is not None:
             return fast
+
+        if not opt.remote and not row_ids:
+            dist = self._topn_distributed(index, c, slices, opt, n)
+            if dist is not None:
+                return dist
 
         pairs = self._top_n_slices(index, c, slices, opt)
         # Only the originating node refetches exact counts for candidates.
@@ -1795,7 +2107,10 @@ class Executor:
 
     def _topn_host_single_pass(self, index: str, c: Call,
                                slices: list[int],
-                               opt: ExecOptions) -> Optional[list[Pair]]:
+                               opt: ExecOptions,
+                               allow_remote: bool = False,
+                               trim: bool = True
+                               ) -> Optional[list[Pair]]:
         """The plain sourceless TopN form on a single local node in ONE
         pass over the rank caches, or None for the general path.
 
@@ -1819,10 +2134,19 @@ class Executor:
         multi-node cluster the gate is OWNERSHIP, not cluster size:
         when this node holds a replica of every slice, its local rank
         caches cover the whole query (writes fan to every replica
-        owner) and the single-pass answer stands."""
+        owner) and the single-pass answer stands.
+
+        ``allow_remote`` lifts the coordinator-only gate for pushdown
+        legs (the coordinator explicitly requested node-local
+        semantics); ``trim=False`` skips the final top-n trim and
+        returns EVERY candidate (the union of per-slice n-trims) with
+        its exact sum — the partial-set shape the distributed
+        two-phase merge consumes, identical to the candidate set a
+        single-node single pass would mark."""
         (frame_name, n, field, row_ids, min_threshold, filters,
          tanimoto) = self._topn_args(c)
-        if (opt.remote or row_ids or len(c.children) > 0
+        if ((opt.remote and not allow_remote) or row_ids
+                or len(c.children) > 0
                 or (field and filters) or tanimoto > 0
                 or self.pod is not None
                 or not self._owns_all_slices(index, slices)):
@@ -1867,10 +2191,10 @@ class Executor:
             # come from each slice's n-trimmed prefix.
             sums = np.zeros(max_id + 1, dtype=np.int64)
             cand_mark = np.zeros(max_id + 1, dtype=bool)
-            for ids, counts, trim in acc_parts:
+            for ids, counts, marks in acc_parts:
                 idx = ids.astype(np.int64)
                 sums[idx] += counts
-                cand_mark[idx[:trim]] = True
+                cand_mark[idx[:marks]] = True
             cand = np.flatnonzero(cand_mark)
             cand_sums = sums[cand]
         else:
@@ -1884,10 +2208,336 @@ class Executor:
             cand_sums = usums[np.searchsorted(uids, cand)]
         order = np.lexsort((cand, -cand_sums))
         cand, cand_sums = cand[order], cand_sums[order]
-        if n:
+        if n and trim:
             cand, cand_sums = cand[:n], cand_sums[:n]
         return [Pair(i, cnt) for i, cnt in zip(cand.tolist(),
                                                cand_sums.tolist())]
+
+    # -- distributed TopN pushdown (ROADMAP item 3) --------------------------
+
+    @staticmethod
+    def _topn_to_dict(res) -> dict:
+        """Normalize a pushdown leg's result (Pair list off the wire,
+        dict from a local/hedge-merged leg, None) to id→count."""
+        if res is None:
+            return {}
+        if isinstance(res, dict):
+            return dict(res)
+        return {p.id: p.count for p in res}
+
+    @staticmethod
+    def _topn_merge_reduce(prev, v):
+        """id→count merge across disjoint-slice partials (the
+        _top_n_slices reduce shape, reused by hedge sub-legs)."""
+        m = prev or {}
+        if isinstance(v, dict):
+            for k, cnt in v.items():
+                m[k] = m.get(k, 0) + cnt
+        elif v:
+            for p in v:
+                m[p.id] = m.get(p.id, 0) + p.count
+        return m
+
+    def _topn_exact_partial(self, index: str, c: Call,
+                            slices: list[int],
+                            opt: ExecOptions) -> list[Pair]:
+        """EXACT node-local TopN partials over ``slices``: the
+        single-pass rank-cache walk when its safety gates hold, else
+        the full local two-phase (candidate gather + ids refetch).
+        Untrimmed — every candidate from the per-slice n-trims rides
+        back with its exact sum over these slices, so the coordinator
+        merge's candidate union equals what a single node spanning
+        all slices would mark.
+
+        ``hints`` (an internal arg the coordinator stamps on pushdown
+        legs: the candidate ids it already knew when dispatching)
+        additionally come back exact-counted in the SAME response — a
+        hinted row this node's own trims missed is refetched locally
+        here, so a 2-node cluster answers TopN in ONE remote round
+        trip instead of two (the round-trip was the measured tax, not
+        the compute). A hinted row with no ≥floor count on these
+        slices is simply not reported (it contributes zero)."""
+        fast = self._topn_host_single_pass(index, c, slices, opt,
+                                           allow_remote=True,
+                                           trim=False)
+        if fast is not None:
+            pairs = fast
+        else:
+            pairs = self._top_n_slices(index, c, slices, opt)
+            if pairs:
+                other = c.clone()
+                other.args.pop("pushdown", None)
+                other.args.pop("hints", None)
+                other.args["ids"] = sorted({p.id for p in pairs})
+                pairs = self._top_n_slices(index, other, slices, opt)
+        hints, _ = c.uint_slice_arg("hints")
+        if hints:
+            have = {p.id for p in pairs}
+            missing = sorted(i for i in set(hints) if i not in have)
+            if missing:
+                other = c.clone()
+                other.args.pop("pushdown", None)
+                other.args.pop("hints", None)
+                other.args["ids"] = missing
+                pairs = list(pairs) + self._top_n_slices(
+                    index, other, slices, opt)
+        return pairs
+
+    def _topn_distributed(self, index: str, c: Call, slices: list[int],
+                          opt: ExecOptions,
+                          n: int) -> Optional[list[Pair]]:
+        """Coordinator side of TopN pushdown, for the plain sourceless
+        form on a genuinely distributed index (some slice owned
+        elsewhere — locally-covered queries already have the
+        single-pass). Each owner runs the single-pass TopN over its
+        own slices (``pushdown=true`` legs) and returns untrimmed
+        exact (row, count) partials; the coordinator merges per the
+        reference two-phase semantics (executor.go:273-310): candidate
+        union, then an exact-count refetch ONLY for (node, rows the
+        node didn't report) — not all rows on all slices. Failed legs
+        re-map onto replicas; hedging composes per leg (the winner's
+        partial AND generation tokens count, fault subsystem). Any
+        non-lifecycle failure degrades to the fan-out path (None) —
+        reads are idempotent, so a partial pushdown attempt is only
+        spent work, never a wrong answer."""
+        if (not self._topn_pushdown or self.pod is not None
+                or opt.partial or self.client is None or not slices
+                or len(self.cluster.nodes) < 2
+                or not getattr(self.client, "generation_aware", False)
+                or self._owns_all_slices(index, slices)):
+            return None
+        (frame_name, _n, field, row_ids, _thresh, filters,
+         tanimoto) = self._topn_args(c)
+        if row_ids or c.children or (field and filters) or tanimoto > 0:
+            return None
+        try:
+            with _ctx_span(opt.ctx, "topn_pushdown",
+                           slices=len(slices)):
+                legs = self._topn_pushdown_gather(index, c, slices, opt)
+                merged = self._topn_pushdown_merge(index, c, legs, opt)
+        except (QueryDeadlineError, QueryCancelledError):
+            raise
+        except Exception:  # noqa: BLE001 - fan-out path owns failures
+            obs_metrics.TOPN_PUSHDOWN.labels("fallback").inc()
+            return None
+        obs_metrics.TOPN_PUSHDOWN.labels("merged").inc()
+        out = pairs_sort([Pair(i, cnt) for i, cnt in merged.items()
+                          if cnt > 0])
+        # Remember the merged candidate union (top slice of it) as the
+        # next query's speculative hints — bounded per entry and per
+        # memo so hot frames stay one-round.
+        memo = self._topn_hint_memo
+        memo[(index, frame_name)] = tuple(p.id for p in out[:1024])
+        memo.move_to_end((index, frame_name))
+        while len(memo) > 64:
+            memo.popitem(last=False)
+        if n and n < len(out):
+            out = out[:n]
+        return out
+
+    def _topn_pushdown_gather(self, index: str, c: Call,
+                              slices: list[int],
+                              opt: ExecOptions) -> list[tuple]:
+        """Dispatch one pushdown leg per owning node; returns
+        [(node, group_slices, id→count, hinted_ids)] with
+        _map_reduce's failover semantics (a failed leg's slices re-map
+        onto surviving replicas through the breaker-ordered
+        placement).
+
+        Remote legs dispatch IMMEDIATELY with SPECULATIVE hints — the
+        last merged candidate union for this (index, frame), kept in
+        a small memo — and the local partial computes concurrently on
+        this thread. Hints are only hints: a hinted row the leg
+        doesn't hold reads as zero, and a candidate the speculation
+        missed is refetched in a second round — so a cold or stale
+        memo costs one extra round trip, never a wrong answer. Warm
+        (the repeated-query steady state), the whole distributed TopN
+        is ONE remote round trip fully overlapped with local work —
+        the round-trip is the measured cluster tax, not the
+        compute."""
+        nodes = list(self.cluster.nodes)
+        ctx = opt.ctx
+        pool = self._pool("node")
+        futures: dict = {}
+        legs: list[tuple] = []
+        processed = 0
+        groups = self._slices_by_node(nodes, index, slices)
+        local_groups: list[tuple] = []
+        remote_slices: list[int] = []
+        for node, group in groups:
+            if node.host == self.host:
+                local_groups.append((node, group))
+            else:
+                remote_slices.extend(group)
+        if not remote_slices:
+            for node, group in local_groups:
+                m = self._topn_to_dict(
+                    self._topn_exact_partial(index, c, group, opt))
+                legs.append((node, group, m, frozenset()))
+            return legs
+        frame_name = self._topn_args(c)[0]
+        hints = sorted(self._topn_hint_memo.get((index, frame_name),
+                                                ()))
+        c_pd = c.clone()
+        c_pd.args["pushdown"] = True
+        if hints:
+            c_pd.args["hints"] = hints
+        hinted = frozenset(hints)
+
+        def submit(nodes, slices):
+            for node, group in self._slices_by_node(nodes, index,
+                                                    slices):
+                fut = pool.submit(self._topn_pushdown_node, node,
+                                  index, c, c_pd, group, opt)
+                futures[fut] = (node, group)
+                if ctx is not None:
+                    ctx.add_leg(node.host, len(group))
+
+        try:
+            submit(nodes, remote_slices)
+            # Local partials overlap the in-flight remote legs.
+            for node, group in local_groups:
+                m = self._topn_to_dict(
+                    self._topn_exact_partial(index, c, group, opt))
+                legs.append((node, group, m, frozenset()))
+            while processed < len(remote_slices):
+                if ctx is None:
+                    done, _ = wait(list(futures),
+                                   return_when=FIRST_COMPLETED)
+                else:
+                    ctx.check()
+                    done, _ = wait(list(futures),
+                                   timeout=self._CTX_POLL_S,
+                                   return_when=FIRST_COMPLETED)
+                for fut in done:
+                    node, group = futures.pop(fut)
+                    try:
+                        r = fut.result()
+                    except (QueryDeadlineError, QueryCancelledError):
+                        raise
+                    except Exception as e:  # noqa: BLE001 - re-map
+                        nodes = [x for x in nodes if x is not node]
+                        obs_metrics.FAILOVER_SLICES.labels(
+                            node.host or "local").inc(len(group))
+                        with _ctx_span(ctx, "failover", peer=node.host,
+                                       slices=len(group),
+                                       error=type(e).__name__):
+                            pass
+                        try:
+                            submit(nodes, group)
+                        except SliceUnavailableError:
+                            raise e
+                        continue
+                    legs.append((node, group, r, hinted))
+                    processed += len(group)
+        finally:
+            pending = [f for f in futures if not f.cancel()]
+            if pending:
+                if ctx is not None and (ctx.cancelled()
+                                        or ctx.expired()):
+                    wait(pending, timeout=self._DEAD_DRAIN_S)
+                else:
+                    wait(pending)
+        return legs
+
+    def _topn_pushdown_node(self, node: Node, index: str, c: Call,
+                            c_pd: Call, group: list[int],
+                            opt: ExecOptions) -> dict:
+        """One node's exact partial. Remote legs forward ``c_pd``
+        (the call with the ``pushdown`` marker + the coordinator's
+        candidate hints), which makes the peer run the whole TopN
+        algorithm over its own slices and answer exact untrimmed
+        partials INCLUDING the hinted rows (the leg contract the
+        merge relies on). A leg re-mapped onto the local replica runs
+        the same c_pd semantics in-process, so the hinted-coverage
+        bookkeeping stays uniform. Hedging composes: the hedge race
+        duplicates the pushdown leg at surviving replicas, first
+        response wins, and only the winner's generation tokens reach
+        the map."""
+        with sched_context.use(opt.ctx):
+            if opt.ctx is not None:
+                opt.ctx.check()
+            if node.host == self.host:
+                with _ctx_span(opt.ctx, "leg",
+                               host=node.host or "local",
+                               slices=len(group)):
+                    return self._topn_to_dict(
+                        self._topn_exact_partial(index, c_pd, group,
+                                                 opt))
+            hedge_s = (self.fault.hedge_delay_s(node.host)
+                       if self.fault is not None else None)
+            if hedge_s:
+                res = self._exec_remote_hedged(
+                    node, index, c_pd, group, opt, None,
+                    self._topn_merge_reduce, hedge_s,
+                    local_fn=lambda sl: self._topn_exact_partial(
+                        index, c_pd, sl, opt))
+            else:
+                rs = self._exec_remote(node, index, Query([c_pd]),
+                                       group, opt)
+                res = rs[0] if rs else None
+            return self._topn_to_dict(res)
+
+    def _topn_pushdown_merge(self, index: str, c: Call,
+                             legs: list[tuple],
+                             opt: ExecOptions) -> dict:
+        """Two-phase merge of per-node partials: sum what every node
+        reported, then refetch exact counts ONLY for (node, rows in
+        the union that node didn't report AND wasn't hinted about) — a
+        row trimmed out (or absent) on one node still collects its
+        counts there before the global trim. Hinted rows are already
+        covered by the leg's own response (zero if unreported), so on
+        a 2-node cluster the refetch set is empty by construction —
+        except the coordinator's own leg, whose refetch is in-process
+        and pays no round trip."""
+        union: set = set()
+        for _node, _group, m, _hinted in legs:
+            union.update(m)
+        total: dict = {}
+        for _node, _group, m, _hinted in legs:
+            for k, cnt in m.items():
+                total[k] = total.get(k, 0) + cnt
+        jobs = []
+        for node, group, m, hinted in legs:
+            missing = sorted(i for i in union
+                             if i not in m and i not in hinted)
+            if missing:
+                jobs.append((node, group, missing))
+        if not jobs:
+            return total
+        pool = self._pool("node")
+        futs = [pool.submit(self._topn_refetch_leg, node, index, c,
+                            group, missing, opt)
+                for node, group, missing in jobs]
+        try:
+            for fut in futs:
+                m = fut.result()
+                for k, cnt in m.items():
+                    total[k] = total.get(k, 0) + cnt
+        finally:
+            pending = [f for f in futs if not f.cancel()]
+            if pending:
+                wait(pending)
+        return total
+
+    def _topn_refetch_leg(self, node: Node, index: str, c: Call,
+                          group: list[int], ids: list[int],
+                          opt: ExecOptions) -> dict:
+        """Exact counts for ``ids`` over one node's slices (the
+        reference phase-2 shape, restricted to the rows that node is
+        missing)."""
+        with sched_context.use(opt.ctx):
+            if opt.ctx is not None:
+                opt.ctx.check()
+            other = c.clone()
+            other.args.pop("pushdown", None)
+            other.args["ids"] = [int(i) for i in ids]
+            if node.host == self.host:
+                return self._topn_to_dict(
+                    self._top_n_slices(index, other, group, opt))
+            rs = self._exec_remote(node, index, Query([other]), group,
+                                   opt)
+            return self._topn_to_dict(rs[0] if rs else None)
 
     def _top_n_slices(self, index: str, c: Call, slices: list[int],
                       opt: ExecOptions) -> list[Pair]:
@@ -2692,11 +3342,19 @@ class Executor:
     # -- remote execution (executor.go:1000-1083) ----------------------------
 
     def _exec_remote(self, node: Node, index: str, query: Query,
-                     slices: Optional[list[int]], opt: ExecOptions) -> list:
+                     slices: Optional[list[int]], opt: ExecOptions,
+                     gens_out: Optional[list] = None) -> list:
+        """``gens_out`` (hedged legs only) defers the response's
+        generation tokens to the caller instead of letting the client
+        apply them — the hedge race applies the WINNER's tokens only."""
         if self.client is None:
             raise SliceUnavailableError(
                 f"no client to reach remote node {node.host}")
         ctx = opt.ctx
+        kwargs = {}
+        if gens_out is not None and getattr(self.client,
+                                            "generation_aware", False):
+            kwargs["gens_out"] = gens_out
         t0 = time.perf_counter()
         try:
             with _ctx_span(ctx, "rpc", peer=node.host,
@@ -2713,14 +3371,23 @@ class Executor:
                     ctx.check()
                     return self.client.execute_query(
                         node, index, str(query), slices, remote=True,
-                        deadline_s=ctx.remaining(), query_id=ctx.id)
+                        deadline_s=ctx.remaining(), query_id=ctx.id,
+                        **kwargs)
                 return self.client.execute_query(node, index,
                                                  str(query), slices,
-                                                 remote=True)
+                                                 remote=True, **kwargs)
         finally:
             obs_metrics.RPC_SECONDS.labels(
                 peer=node.host, kind="query").observe(
                     time.perf_counter() - t0)
+
+    def _apply_remote_gens(self, gens_list: list) -> None:
+        """Apply a deferred (peer, payload) token list — the winning
+        hedge leg's — to the coordinator generation map."""
+        if self.gens is None:
+            return
+        for peer, payload in gens_list:
+            self.gens.apply_wire(peer, payload)
 
     # -- map-reduce core (executor.go:1087-1236) -----------------------------
 
@@ -2934,7 +3601,8 @@ class Executor:
 
     def _exec_remote_hedged(self, node: Node, index: str, c: Call,
                             slices: list[int], opt: ExecOptions,
-                            map_fn, reduce_fn, hedge_s: float):
+                            map_fn, reduce_fn, hedge_s: float,
+                            local_fn=None):
         """Tail-tolerant remote leg (fault subsystem, opt-in): fire the
         primary replica's RPC; if it hasn't answered within ``hedge_s``
         (max of the configured floor and the peer's p95-ish latency
@@ -2945,35 +3613,55 @@ class Executor:
         legs are pure reads, so a duplicated leg is only spent work,
         never a double write. A hedge that loses the race is never
         re-raised; if BOTH sides fail the primary's error surfaces and
-        the outer re-map takes over."""
+        the outer re-map takes over.
+
+        Generation accounting: each side collects its piggybacked
+        tokens privately and ONLY the winner's merge into the
+        coordinator map — a loser that straggles in with older state
+        (it started earlier, or served a stale replica) must never
+        overwrite what the winner reported.
+
+        ``local_fn(slices)`` overrides the local hedge leg's
+        computation (the TopN pushdown's exact partial); the default
+        runs the per-slice map/reduce."""
         pool = self._pool("hedge")
         query = Query([c])
+        primary_gens: list = []
 
         def primary_leg():
-            rs = self._exec_remote(node, index, query, slices, opt)
+            rs = self._exec_remote(node, index, query, slices, opt,
+                                   gens_out=primary_gens)
             return rs[0] if rs else None
 
         primary = pool.submit(primary_leg)
         done, _ = wait([primary], timeout=hedge_s)
         if done:
-            return primary.result()
+            res = primary.result()
+            self._apply_remote_gens(primary_gens)
+            return res
         others = [n for n in self.cluster.nodes if n is not node]
         try:
             groups = self._slices_by_node(others, index, slices)
         except SliceUnavailableError:
             groups = []
         if not groups:
-            return primary.result()
+            res = primary.result()
+            self._apply_remote_gens(primary_gens)
+            return res
         obs_metrics.HEDGED_REQUESTS.labels("fired").inc()
         with _ctx_span(opt.ctx, "hedge", peer=node.host,
                        slices=len(slices)):
             pass
+        hedge_gens: list = []
 
         def hedge_leg(n2: Node, sl: list[int]):
             if n2.host == self.host:
                 with sched_context.use(opt.ctx):
+                    if local_fn is not None:
+                        return local_fn(sl)
                     return self._mapper_local(sl, map_fn, reduce_fn)
-            rs = self._exec_remote(n2, index, query, sl, opt)
+            rs = self._exec_remote(n2, index, query, sl, opt,
+                                   gens_out=hedge_gens)
             return rs[0] if rs else None
 
         hedges = [pool.submit(hedge_leg, n2, sl) for n2, sl in groups]
@@ -3010,10 +3698,12 @@ class Executor:
                 obs_metrics.HEDGED_REQUESTS.labels("primary_won").inc()
                 for f in hedges:
                     f.cancel()
+                self._apply_remote_gens(primary_gens)
                 return primary_res
             if hedge_done and hedge_err is None:
                 obs_metrics.HEDGED_REQUESTS.labels("hedge_won").inc()
                 primary.cancel()
+                self._apply_remote_gens(hedge_gens)
                 return hedge_res
             if primary_done and hedge_done:
                 raise primary_err

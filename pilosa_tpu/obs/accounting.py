@@ -74,7 +74,8 @@ class QueryCost:
 
     __slots__ = ("node", "container_ops", "words_scanned",
                  "bits_written", "device_programs", "device_bytes",
-                 "compile_s", "wal_wait_s", "rpc", "children", "_mu")
+                 "compile_s", "wal_wait_s", "result_cache_hits", "rpc",
+                 "children", "_mu")
 
     def __init__(self, node: str = ""):
         self.node = node
@@ -89,6 +90,10 @@ class QueryCost:
         # — the write-side queue wait, alongside the admission stage's
         # read-side one.
         self.wal_wait_s = 0.0
+        # Results this query served from a generation-validated cache
+        # (result residency or the coordinator cluster cache) instead
+        # of re-folding — the ledger's "why was this query cheap".
+        self.result_cache_hits = 0
         # peer host -> {"bytesOut": n, "bytesIn": n, "calls": n}
         self.rpc: dict[str, dict] = {}
         self.children: list[dict] = []
@@ -114,6 +119,9 @@ class QueryCost:
 
     def note_wal_wait(self, seconds: float) -> None:
         self.wal_wait_s += seconds
+
+    def note_result_cache_hit(self, n: int = 1) -> None:
+        self.result_cache_hits += n
 
     def note_rpc(self, peer: str, bytes_out: int, bytes_in: int) -> None:
         with self._mu:
@@ -158,6 +166,8 @@ class QueryCost:
         }
         if self.wal_wait_s:
             out["walWaitMs"] = round(self.wal_wait_s * 1e3, 3)
+        if self.result_cache_hits:
+            out["resultCacheHit"] = self.result_cache_hits
         if stages:
             out["stages"] = {k: round(v, 6) for k, v in stages.items()}
             if "admission" in stages:
@@ -185,6 +195,8 @@ class QueryCost:
         }
         if self.wal_wait_s:
             out["walWaitMs"] = round(self.wal_wait_s * 1e3, 3)
+        if self.result_cache_hits:
+            out["resultCacheHit"] = self.result_cache_hits
         if rpc_out or rpc_in:
             out["rpcBytesOut"] = rpc_out
             out["rpcBytesIn"] = rpc_in
@@ -259,6 +271,15 @@ def note_bits_written(n: int) -> None:
     cost = getattr(ctx, "cost", None)
     if cost is not None:
         cost.note_bits_written(n)
+
+
+def note_result_cache_hit(ctx=None) -> None:
+    """Stamp a generation-validated cache hit on the query's ledger
+    (explicit ctx where the caller holds one; thread-bound otherwise)."""
+    cost = (getattr(ctx, "cost", None) if ctx is not None
+            else current_cost())
+    if cost is not None:
+        cost.note_result_cache_hit()
 
 
 def note_device_dispatch(nbytes: int = 0) -> None:

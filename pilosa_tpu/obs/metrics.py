@@ -530,6 +530,36 @@ IMPORT_PIPELINE_DEPTH = _DEFAULT.gauge(
     "Wire-import blocks currently in their apply stage across all"
     " fragments — >1 means decode of later blocks is overlapping"
     " earlier applies (the pipelined import path)")
+GENERATION_UPDATES = _DEFAULT.counter(
+    "pilosa_cluster_generation_updates_total",
+    "Per-slice generation-token entries applied to the coordinator"
+    " generation map, by source peer (X-Pilosa-Generations headers"
+    " and /generations probes)",
+    labels=("peer",))
+RESULT_CACHE_HITS = _DEFAULT.counter(
+    "pilosa_executor_result_cache_hits_total",
+    "Materialized-bitmap result-residency cache hits (a repeated"
+    " Union/Intersect/Difference chain served without a re-fold)")
+RESULT_CACHE_MISSES = _DEFAULT.counter(
+    "pilosa_executor_result_cache_misses_total",
+    "Result-residency lookups that had to fold (cacheable key, no"
+    " live entry)")
+RESULT_CACHE_EVICTIONS = _DEFAULT.counter(
+    "pilosa_executor_result_cache_evictions_total",
+    "Result-residency entries evicted by the entry/bit bounds")
+CLUSTER_CACHE_REQUESTS = _DEFAULT.counter(
+    "pilosa_executor_cluster_cache_requests_total",
+    "Coordinator hot-query result-cache lookups, by outcome: hit"
+    " (every generation token validated), miss (no entry or"
+    " unvalidatable), invalidated (a token mismatched — a replica"
+    " took a write since the entry was cached)",
+    labels=("outcome",))
+TOPN_PUSHDOWN = _DEFAULT.counter(
+    "pilosa_executor_topn_pushdown_total",
+    "Distributed TopN pushdown outcomes: merged (per-node partials"
+    " merged per the two-phase semantics) or fallback (pushdown"
+    " failed; the fan-out path answered)",
+    labels=("outcome",))
 
 
 # -- legacy StatsClient bridge ------------------------------------------------

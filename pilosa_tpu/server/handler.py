@@ -24,6 +24,7 @@ from urllib.parse import parse_qs
 import numpy as np
 
 from .. import __version__
+from ..cluster import generations as gens_mod
 from ..cluster.broadcast import (NOP_BROADCASTER, CancelQueryMessage,
                                  unmarshal_message)
 from ..errors import (FrameExistsError, IndexExistsError, PilosaError,
@@ -332,6 +333,7 @@ class Handler:
         r("GET", "/fragment/data", self._handle_get_fragment_data)
         r("POST", "/fragment/data", self._handle_post_fragment_data)
         r("GET", "/fragment/nodes", self._handle_fragment_nodes)
+        r("GET", "/generations", self._handle_get_generations)
         r("POST", "/import", self._handle_post_import, lane=LANE_WRITE)
         r("GET", "/hosts", self._handle_get_hosts)
         r("GET", "/schema", self._handle_get_schema)
@@ -1053,6 +1055,15 @@ class Handler:
             # (X-Pilosa-Stats) and, on remote legs, the full per-node
             # tree (X-Pilosa-Cost) for the coordinator to stitch.
             hs = [("X-Pilosa-Query-Id", ctx.id)]
+            if remote:
+                # Generation tokens ride every internal leg's response
+                # (cluster.generations): the coordinator's map learns
+                # this node's current per-fragment (uid, generation)
+                # state for the served slices — the fact its remote
+                # result-cache keys validate against.
+                gh = self._generations_header(index_name, slices)
+                if gh is not None:
+                    hs.append(gh)
             if trace is not None and remote:
                 hs.append((obs_trace.SPANS_HEADER, trace.spans_json()))
             if ctx.cost is not None:
@@ -1365,9 +1376,15 @@ class Handler:
              "applyMs": round(apply_s * 1e3, 3),
              "wireBytes": wire_bytes, "bits": n_bits},
             separators=(",", ":"))
-        return Response.proto(
-            pb.ImportResponse(),
-            headers=[(obs_accounting.STATS_HEADER, stats)])
+        hs = [(obs_accounting.STATS_HEADER, stats)]
+        # The import ack carries the written slice's fresh generation
+        # tokens: an importing coordinator's map invalidates its
+        # cached results for this slice on the ack itself, no extra
+        # round trip (cluster.generations wire contract).
+        gh = self._generations_header(index_name, [slice])
+        if gh is not None:
+            hs.append(gh)
+        return Response.proto(pb.ImportResponse(), headers=hs)
 
     def _pod_import(self, index_name, frame_name, slice, rows, cols,
                     ts_ns, idx, frame, timestamps) -> None:
@@ -1487,6 +1504,65 @@ class Handler:
         frag.write_to(spool)
         spool.seek(0)
         return Response(200, spool, "application/octet-stream")
+
+    # -- generation tokens (cluster.generations) -----------------------------
+
+    def _owned_slices(self, index_name: str) -> list[int]:
+        """The slices this node would report tokens for when a caller
+        names none: every locally-owned slice of the index."""
+        idx = self.holder.index(index_name)
+        if idx is None:
+            return []
+        max_slice = idx.max_slice()
+        if self.cluster is None:
+            return list(range(max_slice + 1))
+        return [int(s) for s in self.cluster.owns_slices(
+            index_name, max_slice, self.host)]
+
+    def _generations_header(self, index_name: str,
+                            slices) -> Optional[tuple]:
+        """(header, payload) with this node's current tokens for the
+        served slices, or None when there is nothing to report. Never
+        raises — a token header must not fail the response that
+        carries it."""
+        try:
+            if self.holder is None or self.holder.index(index_name) \
+                    is None:
+                return None
+            if not slices:
+                slices = self._owned_slices(index_name)
+            if not slices:
+                return None
+            tokens = gens_mod.local_tokens(self.holder, index_name,
+                                           slices)
+            return (gens_mod.GENERATIONS_HEADER,
+                    gens_mod.encode_wire(index_name, tokens))
+        except Exception:  # noqa: BLE001 - advisory header only
+            return None
+
+    def _handle_get_generations(self, req: Request) -> Response:
+        """The coordinator result cache's validation probe: current
+        per-fragment (uid, generation) tokens for the named slices
+        (default: every locally-owned slice). A cheap read — no locks
+        beyond the holder maps — so a validation round-trip costs
+        ~RTT, not a query."""
+        index_name = req.query.get("index", "")
+        if not index_name:
+            raise HTTPError(400, "index required")
+        if self.holder.index(index_name) is None:
+            raise HTTPError(404, "index not found")
+        raw = req.query.get("slices", "")
+        try:
+            slices = [int(s) for s in raw.split(",") if s != ""]
+        except ValueError:
+            raise HTTPError(400, "invalid slices argument")
+        if not slices:
+            slices = self._owned_slices(index_name)
+        tokens = gens_mod.local_tokens(self.holder, index_name, slices)
+        return Response.json({
+            "index": index_name, "host": self.host,
+            "tokens": {str(s): {k: [v[0], v[1]] for k, v in m.items()}
+                       for s, m in tokens.items()}})
 
     def _handle_post_fragment_data(self, req: Request) -> Response:
         slice = req.uint_param("slice")

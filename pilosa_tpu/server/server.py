@@ -64,7 +64,8 @@ class Server:
                  trace_config: Optional[TraceConfig] = None,
                  profile_config: Optional[ProfileConfig] = None,
                  slo_config: Optional[SLOConfig] = None,
-                 fault_config: Optional[FaultConfig] = None):
+                 fault_config: Optional[FaultConfig] = None,
+                 gen_staleness_s: Optional[float] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -117,6 +118,17 @@ class Server:
                 backoff_cap_s=self.fault_config.breaker_backoff_cap,
                 hedge_s=self.fault_config.hedge, node=host)
 
+        # Cluster-wide generation knowledge (cluster.generations;
+        # docs/DISTRIBUTED.md): every pooled Client feeds peers'
+        # piggybacked X-Pilosa-Generations tokens here, and the
+        # executor's result caches key + validate remote slices
+        # against it.
+        from ..cluster.generations import (DEFAULT_STALENESS_S,
+                                           GenerationMap)
+        self.gens = GenerationMap(
+            staleness_s=(gen_staleness_s if gen_staleness_s is not None
+                         else DEFAULT_STALENESS_S))
+
         # Query lifecycle subsystem (sched; docs/SCHEDULING.md): the
         # weighted admission queue in front of the executor, the
         # in-flight registry behind /debug/queries, and (from open())
@@ -151,14 +163,14 @@ class Server:
             client = self._clients.get(host)
             if client is None:
                 client = self._clients[host] = Client(
-                    host, fault=self.fault)
+                    host, fault=self.fault, gens=self.gens)
             return client
 
     def _client_factory(self, host: str) -> Client:
         """client_factory seam for layers that build their own Client
         (anti-entropy, frame restore): fault-aware like client_for,
         but a fresh instance per call (the syncer closes its own)."""
-        return Client(host, fault=self.fault)
+        return Client(host, fault=self.fault, gens=self.gens)
 
     # -- lifecycle (server.go:89-180) ----------------------------------------
 
@@ -212,9 +224,14 @@ class Server:
                                fault_failpoints.default().seed)
 
         client = _RoutingClient(self)
-        self.executor = Executor(self.holder, host=self.host,
-                                 cluster=self.cluster, client=client,
-                                 pod=self.pod, fault=self.fault)
+        self.executor = Executor(
+            self.holder, host=self.host, cluster=self.cluster,
+            client=client, pod=self.pod, fault=self.fault,
+            gens=self.gens, gen_staleness_s=self.gens.staleness_s,
+            result_cache_entries=self.query_config.result_cache_entries,
+            result_cache_bits=self.query_config.result_cache_bits,
+            cluster_cache_entries=self.query_config
+            .cluster_cache_entries)
         # Cold-start warmup: background-compile the hot XLA programs so
         # the first real device query doesn't pay the multi-second
         # trace+compile (state surfaces at /status; PILOSA_TPU_WARMUP=0
@@ -662,13 +679,25 @@ class _RoutingClient:
     timeouts/retries and stamps the fan-out headers."""
 
     deadline_aware = True
+    generation_aware = True
 
     def __init__(self, server: Server):
         self.server = server
 
     def execute_query(self, node, index, query, slices, remote,
-                      pod_local=False, deadline_s=None, query_id=None):
+                      pod_local=False, deadline_s=None, query_id=None,
+                      gens_out=None):
+        # gens_out travels only when set — test fixtures fake the
+        # pooled client with the pre-generations signature.
+        kwargs = {"gens_out": gens_out} if gens_out is not None else {}
         return self.server.client_for(node.host).execute_query(
             node, index, query, slices, remote=remote,
             pod_local=pod_local, deadline_s=deadline_s,
-            query_id=query_id)
+            query_id=query_id, **kwargs)
+
+    def generations(self, index, slices=None, host=None,
+                    deadline_s=None):
+        """The executor's cluster-cache validation probe, routed
+        through the pooled per-host Client."""
+        return self.server.client_for(host).generations(
+            index, slices, deadline_s=deadline_s)

@@ -61,11 +61,24 @@ class ClusterConfig:
     internal_port: str = DEFAULT_INTERNAL_PORT  # gossip bind port
     gossip_seed: str = ""                       # seed "host:port" to join
     gossip_secret: str = ""                     # HMAC key for gossip frames
+    # Staleness bound (seconds) on the coordinator generation map
+    # (cluster.generations): remote-slice cache keys stop trusting a
+    # peer's tokens this long after the last exchange with it. Writes
+    # routed through this coordinator invalidate on their own response
+    # — the bound only governs out-of-band writes (docs/DISTRIBUTED.md).
+    gen_staleness: float = 2.0
 
 
 # Query lifecycle defaults (sched subsystem; docs/SCHEDULING.md).
 DEFAULT_QUERY_CONCURRENCY = 16
 DEFAULT_QUERY_QUEUE_DEPTH = 64
+
+
+# Executor cache defaults (docs/DISTRIBUTED.md): the materialized
+# bitmap-result residency bounds and the coordinator hot-query cache.
+DEFAULT_RESULT_CACHE_ENTRIES = 8
+DEFAULT_RESULT_CACHE_BITS = 32 << 20
+DEFAULT_CLUSTER_CACHE_ENTRIES = 64
 
 
 @dataclass
@@ -74,11 +87,17 @@ class QueryConfig:
     queue_depth bound the admission controller (overflow answers 429);
     default_timeout (seconds, 0 = none) applies when a request carries
     neither ?timeout= nor X-Pilosa-Deadline; slow_threshold (seconds,
-    0 = disabled) arms the slow-query log."""
+    0 = disabled) arms the slow-query log. result_cache_entries/_bits
+    bound the executor's materialized-result residency cache;
+    cluster_cache_entries bounds the coordinator hot-query result
+    cache (0 disables either)."""
     concurrency: int = DEFAULT_QUERY_CONCURRENCY
     queue_depth: int = DEFAULT_QUERY_QUEUE_DEPTH
     default_timeout: float = 0.0
     slow_threshold: float = 0.0
+    result_cache_entries: int = DEFAULT_RESULT_CACHE_ENTRIES
+    result_cache_bits: int = DEFAULT_RESULT_CACHE_BITS
+    cluster_cache_entries: int = DEFAULT_CLUSTER_CACHE_ENTRIES
 
 
 @dataclass
@@ -200,12 +219,16 @@ polling-interval = "{int(self.cluster.polling_interval)}s"
 internal-port = "{self.cluster.internal_port}"
 gossip-seed = "{self.cluster.gossip_seed}"
 gossip-secret = "{self.cluster.gossip_secret}"
+gen-staleness = "{dur(self.cluster.gen_staleness)}"
 
 [query]
 concurrency = {self.query.concurrency}
 queue-depth = {self.query.queue_depth}
 default-timeout = "{dur(self.query.default_timeout)}"
 slow-threshold = "{dur(self.query.slow_threshold)}"
+result-cache-entries = {self.query.result_cache_entries}
+result-cache-bits = {self.query.result_cache_bits}
+cluster-cache-entries = {self.query.cluster_cache_entries}
 
 [metrics]
 enabled = {str(self.metrics.enabled).lower()}
@@ -271,6 +294,9 @@ def load(path: str = "", env: dict | None = None) -> Config:
                                          cfg.cluster.gossip_seed)
         cfg.cluster.gossip_secret = cl.get("gossip-secret",
                                            cfg.cluster.gossip_secret)
+        if "gen-staleness" in cl:
+            cfg.cluster.gen_staleness = parse_duration(
+                cl["gen-staleness"])
         ae = data.get("anti-entropy", {})
         if "interval" in ae:
             cfg.anti_entropy_interval = parse_duration(ae["interval"])
@@ -285,6 +311,12 @@ def load(path: str = "", env: dict | None = None) -> Config:
         if "slow-threshold" in q:
             cfg.query.slow_threshold = parse_duration(
                 q["slow-threshold"])
+        cfg.query.result_cache_entries = int(q.get(
+            "result-cache-entries", cfg.query.result_cache_entries))
+        cfg.query.result_cache_bits = int(q.get(
+            "result-cache-bits", cfg.query.result_cache_bits))
+        cfg.query.cluster_cache_entries = int(q.get(
+            "cluster-cache-entries", cfg.query.cluster_cache_entries))
         m = data.get("metrics", {})
         if "enabled" in m:
             cfg.metrics.enabled = _parse_bool(m["enabled"])
@@ -372,6 +404,23 @@ def load(path: str = "", env: dict | None = None) -> Config:
     if env.get("PILOSA_QUERY_SLOW_THRESHOLD"):
         cfg.query.slow_threshold = parse_duration(
             env["PILOSA_QUERY_SLOW_THRESHOLD"])
+    if env.get("PILOSA_QUERY_RESULT_CACHE_ENTRIES"):
+        cfg.query.result_cache_entries = int(
+            env["PILOSA_QUERY_RESULT_CACHE_ENTRIES"])
+    if env.get("PILOSA_QUERY_RESULT_CACHE_BITS"):
+        cfg.query.result_cache_bits = int(
+            env["PILOSA_QUERY_RESULT_CACHE_BITS"])
+    if env.get("PILOSA_QUERY_CLUSTER_CACHE_ENTRIES"):
+        cfg.query.cluster_cache_entries = int(
+            env["PILOSA_QUERY_CLUSTER_CACHE_ENTRIES"])
+    if env.get("PILOSA_CLUSTER_GEN_STALENESS"):
+        # Bare numbers accepted too (the executor's direct env read
+        # takes them; the two entry points must not diverge).
+        raw = env["PILOSA_CLUSTER_GEN_STALENESS"]
+        try:
+            cfg.cluster.gen_staleness = float(raw)
+        except ValueError:
+            cfg.cluster.gen_staleness = parse_duration(raw)
     if env.get("PILOSA_METRICS_ENABLED"):
         cfg.metrics.enabled = _parse_bool(env["PILOSA_METRICS_ENABLED"])
     if env.get("PILOSA_METRICS_RUNTIME_INTERVAL"):

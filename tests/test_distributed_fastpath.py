@@ -1,0 +1,701 @@
+"""Distributed fast paths (ROADMAP item 3): mutation-generation
+tokens, TopN pushdown, and the coordinator hot-query result cache.
+
+Unit legs drive the executor against scripted transports (the
+executor_test.go mock-server seam); the cluster leg runs a REAL 2-node
+gossip cluster (replicas=1) plus a single-node reference server and
+proves (a) distributed TopN merge is differentially equal to
+single-node, (b) a write through any node invalidates the coordinator
+result cache on the next query, and (c) failpoint-injected rpc.recv
+failures degrade to the fan-out path — never a wrong answer."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+from podenv import cpu_env, free_port, wait_up  # noqa: E402
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.cluster import generations as gens_mod  # noqa: E402
+from pilosa_tpu.cluster.generations import GenerationMap  # noqa: E402
+from pilosa_tpu.cluster.topology import new_cluster  # noqa: E402
+from pilosa_tpu.errors import PilosaError  # noqa: E402
+from pilosa_tpu.executor import ExecOptions, Executor  # noqa: E402
+from pilosa_tpu.models.holder import Holder  # noqa: E402
+from pilosa_tpu.obs import metrics as obs_metrics  # noqa: E402
+from pilosa_tpu.pql.parser import parse as parse_pql  # noqa: E402
+from pilosa_tpu.storage.bitmap import Bitmap  # noqa: E402
+from pilosa_tpu.storage.cache import Pair  # noqa: E402
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+def must_set(holder, index, frame, row, col, view="standard"):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_frame_if_not_exists(frame)
+    f.set_bit(view, row, col)
+
+
+# ---------------------------------------------------------------------------
+# generations module: tokens, wire codec, GenerationMap
+
+
+class TestGenerationsModule:
+    def test_wire_round_trip(self):
+        tokens = {0: {"f/standard": (3, 7)},
+                  2: {"f/standard": (4, 0), "g/inverse": (5, 12)},
+                  5: {}}
+        payload = gens_mod.encode_wire("idx", tokens)
+        got = gens_mod.decode_wire(payload)
+        assert got is not None
+        index, decoded = got
+        assert index == "idx"
+        assert decoded == tokens
+
+    def test_wire_truncation_drops_whole_slices(self):
+        tokens = {s: {f"f{i}/standard": (1, 1) for i in range(10)}
+                  for s in range(5)}
+        payload = gens_mod.encode_wire("i", tokens, max_fragments=25)
+        data = json.loads(payload)
+        assert data["x"] == 1
+        # Whole slices only, ascending: the first two fit (20 frags).
+        assert sorted(data["t"]) == ["0", "1"]
+        for m in data["t"].values():
+            assert len(m) == 10  # never a partial slice
+
+    def test_wire_byte_budget_binds(self):
+        """The encoded payload must stay under the byte budget even
+        when the fragment cap would admit more — an over-64KiB header
+        line fails the whole response carrying it."""
+        tokens = {s: {f"frame{i:04d}/standard": (10 ** 9 + i, 10 ** 8)
+                      for i in range(50)}
+                  for s in range(100)}
+        payload = gens_mod.encode_wire("i", tokens, max_bytes=4096)
+        assert len(payload) <= 4096
+        data = json.loads(payload)
+        assert data["x"] == 1 and data["t"]  # some whole slices fit
+        # Even a single oversized slice cannot blow the budget.
+        one = {0: {f"f{i:05d}/standard": (i, i) for i in range(3000)}}
+        payload = gens_mod.encode_wire("i", one, max_bytes=2048)
+        assert len(payload) <= 2048
+        assert json.loads(payload)["t"] == {}
+
+    def test_decode_garbage_is_none(self):
+        assert gens_mod.decode_wire("not json") is None
+        assert gens_mod.decode_wire('{"t": {}}') is None  # no index
+        assert gens_mod.decode_wire('[1,2]') is None
+
+    def test_map_apply_token_and_staleness(self):
+        m = GenerationMap(staleness_s=30.0)
+        m.apply("peer:1", "i", {4: {"f/standard": (9, 2)}})
+        assert m.token("peer:1", "i", "f", "standard", 4) == (9, 2)
+        # Absent fragment in a KNOWN slice reads (0, 0) — distinct
+        # from an unknown slice, which reads None.
+        assert m.token("peer:1", "i", "g", "standard", 4) == (0, 0)
+        assert m.token("peer:1", "i", "f", "standard", 5) is None
+        assert m.token("peer:2", "i", "f", "standard", 4) is None
+        # Staleness bound: a negative max-age forces every entry stale.
+        assert m.token("peer:1", "i", "f", "standard", 4,
+                       max_age_s=-1.0) is None
+
+    def test_map_newest_min_ts_filter(self):
+        m = GenerationMap()
+        t0 = time.monotonic()
+        m.apply("a:1", "i", {0: {"f/standard": (1, 1)}})
+        got = m.newest("i", 0)
+        assert got is not None and got[0] == "a:1"
+        # An entry applied BEFORE min_ts is filtered out.
+        assert m.newest("i", 0, min_ts=time.monotonic() + 1) is None
+        assert m.newest("i", 0, min_ts=t0) is not None
+        # A fresher peer wins.
+        m.apply("b:1", "i", {0: {"f/standard": (2, 5)}})
+        assert m.newest("i", 0)[0] == "b:1"
+
+    def test_slice_tokens_from_holder(self, holder):
+        must_set(holder, "i", "f", 1, 3)
+        toks = gens_mod.slice_tokens(holder, "i", 0)
+        assert "f/standard" in toks
+        uid, gen = toks["f/standard"]
+        holder.frame("i", "f").set_bit("standard", 1, 4)
+        uid2, gen2 = gens_mod.slice_tokens(holder, "i",
+                                           0)["f/standard"]
+        assert uid2 == uid and gen2 > gen  # writes bump the token
+        assert gens_mod.slice_tokens(holder, "i", 9) == {}
+        assert gens_mod.slice_tokens(holder, "nope", 0) == {}
+
+
+# ---------------------------------------------------------------------------
+# remote-token result-residency keys (executor._bitmap_result_key)
+
+
+class BitmapFakeClient:
+    """Scripted remote transport answering bitmap legs."""
+
+    generation_aware = True
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.calls = []
+
+    def execute_query(self, node, index, query, slices, remote,
+                      **kwargs):
+        self.calls.append((node.host, index, query, slices, remote))
+        return self.fn(node, index, query, slices)
+
+
+class TestRemoteResultKey:
+    def _setup(self, holder, fn=None):
+        must_set(holder, "i", "general", 10, 3)
+        must_set(holder, "i", "general", 11, 3)
+        holder.index("i").set_remote_max_slice(2)
+        cluster = new_cluster(["local", "remotehost"], replica_n=1)
+        client = BitmapFakeClient(fn or (lambda *a: [Bitmap()]))
+        gens = GenerationMap(staleness_s=60.0)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client, gens=gens, use_mesh=False)
+        remote_slices = [s for s in range(3)
+                         if cluster.fragment_nodes("i", s)[0].host
+                         == "remotehost"]
+        assert remote_slices, "3 slices over 2 nodes: some are remote"
+        return e, client, gens, remote_slices
+
+    def _remote_tokens(self, remote_slices, gen=0):
+        return {s: {"general/standard": (100 + s, gen)}
+                for s in remote_slices}
+
+    def test_key_requires_fresh_remote_tokens(self, holder):
+        e, _client, gens, remote = self._setup(holder)
+        call = parse_pql('Union(Bitmap(rowID=10, frame=general),'
+                         ' Bitmap(rowID=11, frame=general))').calls[0]
+        slices = [0, 1, 2]
+        # Empty map: slices owned elsewhere are unkeyable.
+        assert e._bitmap_result_key("i", call, slices) is None
+        gens.apply("remotehost", "i", self._remote_tokens(remote))
+        key1 = e._bitmap_result_key("i", call, slices)
+        assert key1 is not None
+        # A bumped remote generation changes the key (invalidation by
+        # mismatch), and the peer host is part of the token (uids are
+        # process-local).
+        gens.apply("remotehost", "i",
+                   self._remote_tokens(remote, gen=1))
+        key2 = e._bitmap_result_key("i", call, slices)
+        assert key2 is not None and key2 != key1
+        assert any(t[0] == "remotehost" for t in key2[3])
+        # Past the staleness bound the key disappears again.
+        e._gen_staleness_s = -1.0
+        assert e._bitmap_result_key("i", call, slices) is None
+
+    def test_remote_result_caches_and_invalidates(self, holder):
+        def fn(node, index, query, slices):
+            bm = Bitmap()
+            for s in slices:
+                bm.set_bit(s * SLICE_WIDTH + 7)
+            return [bm]
+
+        e, client, gens, remote = self._setup(holder, fn)
+        q = ('Union(Bitmap(rowID=10, frame=general),'
+             ' Bitmap(rowID=11, frame=general))')
+        gens.apply("remotehost", "i", self._remote_tokens(remote))
+        r1 = e.execute("i", q)[0]
+        n_calls = len(client.calls)
+        assert n_calls >= 1
+        # Same tokens: the repeat serves from residency — no remote leg.
+        r2 = e.execute("i", q)[0]
+        assert len(client.calls) == n_calls
+        assert sorted(r2.bits()) == sorted(r1.bits())
+        # A remote write (token bump) forces a recompute.
+        gens.apply("remotehost", "i",
+                   self._remote_tokens(remote, gen=3))
+        e.execute("i", q)
+        assert len(client.calls) > n_calls
+
+    def test_env_configurable_bounds(self, holder, monkeypatch):
+        monkeypatch.setenv("PILOSA_QUERY_RESULT_CACHE_ENTRIES", "2")
+        monkeypatch.setenv("PILOSA_QUERY_RESULT_CACHE_BITS", "1024")
+        monkeypatch.setenv("PILOSA_QUERY_CLUSTER_CACHE_ENTRIES", "5")
+        monkeypatch.setenv("PILOSA_CLUSTER_GEN_STALENESS", "250ms")
+        e = Executor(holder, host="local", use_mesh=False)
+        assert e._result_cache_entries == 2
+        assert e._result_cache_bits == 1024
+        assert e._cluster_cache_entries == 5
+        assert e._gen_staleness_s == 0.25
+        e2 = Executor(holder, host="local", use_mesh=False,
+                      result_cache_entries=9, result_cache_bits=99,
+                      cluster_cache_entries=0, gen_staleness_s=1.5)
+        assert (e2._result_cache_entries, e2._result_cache_bits,
+                e2._cluster_cache_entries,
+                e2._gen_staleness_s) == (9, 99, 0, 1.5)
+
+
+# ---------------------------------------------------------------------------
+# hedged reads: the WINNING leg's generation tokens only (regression)
+
+
+class TestHedgedGenerations:
+    def test_loser_tokens_never_poison_the_map(self, holder):
+        """A slow primary that straggles in AFTER the hedge won must
+        not land its (older) tokens in the coordinator map."""
+        from pilosa_tpu.cluster.topology import Node
+
+        must_set(holder, "i", "general", 1, 1)
+        cluster = new_cluster(["slowpeer:1", "fastpeer:2"],
+                              replica_n=2)
+        gens = GenerationMap(staleness_s=60.0)
+        released = []
+
+        class HedgeClient:
+            generation_aware = True
+
+            def execute_query(self, node, index, query, slices,
+                              remote, gens_out=None, **kwargs):
+                payload = gens_mod.encode_wire(
+                    index, {0: {"general/standard":
+                                (1, 0 if "slow" in node.host else 5)}})
+                if "slow" in node.host:
+                    time.sleep(0.6)  # loses the race
+                if gens_out is not None:
+                    gens_out.append((node.host, payload))
+                released.append(node.host)
+                return [3]
+
+        e = Executor(holder, host="coord", cluster=cluster,
+                     client=HedgeClient(), gens=gens, use_mesh=False)
+        c = parse_pql('Count(Bitmap(rowID=1, frame=general))').calls[0]
+        res = e._exec_remote_hedged(
+            Node("slowpeer:1"), "i", c, [0], ExecOptions(),
+            map_fn=None, reduce_fn=lambda prev, v: (prev or 0) + v,
+            hedge_s=0.05)
+        assert res == 3
+        # Winner (fast) tokens landed; loser's did not.
+        assert gens.token("fastpeer:2", "i", "general", "standard",
+                          0) == (1, 5)
+        assert gens.token("slowpeer:1", "i", "general", "standard",
+                          0) is None
+        # Even after the loser finally completes, its tokens stay out.
+        deadline = time.time() + 5
+        while "slowpeer:1" not in released and time.time() < deadline:
+            time.sleep(0.05)
+        assert gens.token("slowpeer:1", "i", "general", "standard",
+                          0) is None
+
+
+# ---------------------------------------------------------------------------
+# distributed TopN pushdown (unit, scripted transport)
+
+
+class TopNFakeClient(BitmapFakeClient):
+    pass
+
+
+class TestTopNPushdownUnit:
+    def _setup(self, holder, fn):
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("f")
+        for col in (1, 2, 3):
+            f.set_bit("standard", 1, col)
+        f.set_bit("standard", 2, 4)
+        idx.set_remote_max_slice(2)
+        cluster = new_cluster(["local", "remotehost"], replica_n=1)
+        client = TopNFakeClient(fn)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client, use_mesh=False)
+        local_slices = [s for s in range(3)
+                        if cluster.fragment_nodes("i", s)[0].host
+                        == "local"]
+        return e, client, local_slices
+
+    def test_pushdown_merge_and_missing_row_refetch(self, holder):
+        refetched = []
+
+        def fn2(node, index, query, slices):
+            if "pushdown=true" in query:
+                return [[Pair(1, 10), Pair(30, 7)]]
+            assert "ids=" in query, f"unexpected leg: {query}"
+            refetched.append(query)
+            return [[Pair(2, 5)]]  # row 2's count on the remote node
+
+        e, client, local_slices = self._setup(holder, fn2)
+        res = e.execute("i", "TopN(frame=f, n=5)")[0]
+        assert any("pushdown=true" in c[2] for c in client.calls)
+        got = {p.id: p.count for p in res}
+        if 0 in local_slices:
+            # Local partial {1:3, 2:1}; remote {1:10, 30:7}; remote
+            # refetch fills row 2 (+5).
+            assert refetched and all("pushdown" not in q
+                                     for q in refetched)
+            assert got == {1: 13, 2: 6, 30: 7}
+        else:
+            # Data slice lives remotely: local partials are empty and
+            # local refetches contribute nothing.
+            assert got[30] == 7
+        assert obs_metrics.TOPN_PUSHDOWN.labels("merged").value >= 1
+
+    def test_pushdown_failure_degrades_to_fanout(self, holder):
+        from pilosa_tpu.cluster.client import ClientError
+
+        def fn(node, index, query, slices):
+            if "pushdown=true" in query:
+                raise ClientError("injected")
+            if "ids=" in query:
+                return [[Pair(1, 4)]]
+            return [[Pair(1, 4)]]
+
+        e, client, local_slices = self._setup(holder, fn)
+        before = obs_metrics.TOPN_PUSHDOWN.labels("fallback").value
+        res = e.execute("i", "TopN(frame=f, n=5)")[0]
+        got = {p.id: p.count for p in res}
+        assert got.get(1, 0) >= 4  # remote contribution survived
+        assert obs_metrics.TOPN_PUSHDOWN.labels("fallback").value \
+            == before + 1
+
+    def test_remote_leg_answers_exact_untrimmed_partials(self, holder):
+        """The pushdown leg contract: a remote=True query carrying
+        pushdown=true returns EXACT counts over the node's own slices
+        for every per-slice candidate — untrimmed."""
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("f")
+        for row, n_bits in ((1, 5), (2, 4), (3, 3), (4, 2), (5, 1)):
+            for col in range(n_bits):
+                f.set_bit("standard", row, col)
+        e = Executor(holder, host="local", use_mesh=False)
+        res = e.execute("i", "TopN(frame=f, n=2, pushdown=true)",
+                        slices=[0], opt=ExecOptions(remote=True))[0]
+        # n=2 would trim to 2; the pushdown partial keeps every
+        # candidate of the per-slice trim... which for ONE slice is
+        # the per-slice top-2.
+        got = {p.id: p.count for p in res}
+        assert got == {1: 5, 2: 4}
+
+
+# ---------------------------------------------------------------------------
+# coordinator hot-query result cache (unit, scripted transport)
+
+
+class ClusterCacheClient:
+    """Scripted transport whose responses carry generation tokens
+    (applied straight to the shared map, like the real pooled client)
+    and which answers the /generations validation probe."""
+
+    generation_aware = True
+
+    def __init__(self, gens, tokens):
+        self.gens = gens
+        self.tokens = tokens  # host -> {slice: {fk: (uid, gen)}}
+        self.exec_calls = []
+        self.probe_calls = []
+
+    def execute_query(self, node, index, query, slices, remote,
+                      **kwargs):
+        self.exec_calls.append((node.host, query, tuple(slices or ())))
+        self.gens.apply(node.host, index,
+                        {s: self.tokens[node.host][s]
+                         for s in slices})
+        return [7]
+
+    def generations(self, index, slices=None, host=None,
+                    deadline_s=None):
+        self.probe_calls.append((host, tuple(slices or ())))
+        t = {s: dict(self.tokens[host][s]) for s in (slices or [])}
+        self.gens.apply(host, index, t)
+        return t
+
+
+class TestClusterResultCache:
+    def test_hit_validate_invalidate_cycle(self, holder):
+        must_set(holder, "i", "general", 10, 3)
+        holder.index("i").set_remote_max_slice(2)
+        cluster = new_cluster(["local", "remotehost"], replica_n=1)
+        remote_slices = [s for s in range(3)
+                         if cluster.fragment_nodes("i", s)[0].host
+                         == "remotehost"]
+        assert remote_slices
+        gens = GenerationMap(staleness_s=60.0)
+        tokens = {"remotehost": {s: {"general/standard": (50, 0)}
+                                 for s in remote_slices}}
+        client = ClusterCacheClient(gens, tokens)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client, gens=gens, use_mesh=False)
+        # Warm the map as a prior query's legs would have: a query
+        # whose remote slices the map has NEVER seen stays uncached
+        # (no pre-execution snapshot to attribute its results to).
+        gens.apply("remotehost", "i",
+                   {s: tokens["remotehost"][s] for s in remote_slices})
+        q = 'Count(Bitmap(rowID=10, frame=general))'
+        hits = obs_metrics.CLUSTER_CACHE_REQUESTS.labels("hit")
+        inval = obs_metrics.CLUSTER_CACHE_REQUESTS.labels(
+            "invalidated")
+        h0, i0 = hits.value, inval.value
+
+        r1 = e.execute("i", q)
+        n_exec = len(client.exec_calls)
+        assert n_exec >= 1 and not client.probe_calls
+        # Identical repeat: ONE validation probe, zero execute legs.
+        r2 = e.execute("i", q)
+        assert r2 == r1
+        assert len(client.exec_calls) == n_exec
+        assert len(client.probe_calls) == 1
+        assert hits.value == h0 + 1
+        # A remote write bumps the owner's tokens: the next query
+        # invalidates and recomputes — no stale answer.
+        for s in remote_slices:
+            tokens["remotehost"][s] = {"general/standard": (50, 9)}
+        r3 = e.execute("i", q)
+        assert r3 == r1  # scripted counts unchanged; path recomputed
+        assert len(client.exec_calls) > n_exec
+        assert inval.value == i0 + 1
+
+    def test_local_write_invalidates_without_probe_mismatch(self,
+                                                           holder):
+        must_set(holder, "i", "general", 10, 3)
+        holder.index("i").set_remote_max_slice(2)
+        cluster = new_cluster(["local", "remotehost"], replica_n=1)
+        remote_slices = [s for s in range(3)
+                         if cluster.fragment_nodes("i", s)[0].host
+                         == "remotehost"]
+        local_slices = [s for s in range(3)
+                        if s not in remote_slices]
+        if not local_slices:
+            pytest.skip("jump-hash gave every slice to the peer")
+        gens = GenerationMap(staleness_s=60.0)
+        tokens = {"remotehost": {s: {"general/standard": (50, 0)}
+                                 for s in remote_slices}}
+        client = ClusterCacheClient(gens, tokens)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client, gens=gens, use_mesh=False)
+        gens.apply("remotehost", "i",
+                   {s: tokens["remotehost"][s] for s in remote_slices})
+        q = 'Count(Bitmap(rowID=10, frame=general))'
+        r1 = e.execute("i", q)
+        n_exec = len(client.exec_calls)
+        # Local write: the LOCAL token check catches it (no probe
+        # round-trip needed to invalidate).
+        holder.frame("i", "general").set_bit(
+            "standard", 10, local_slices[0] * SLICE_WIDTH + 9)
+        r2 = e.execute("i", q)
+        assert r2[0] == r1[0] + 1
+        assert len(client.exec_calls) > n_exec
+
+    def test_write_queries_and_partial_are_never_cached(self, holder):
+        must_set(holder, "i", "general", 10, 3)
+        holder.index("i").set_remote_max_slice(2)
+        cluster = new_cluster(["local", "remotehost"], replica_n=1)
+        gens = GenerationMap()
+        client = ClusterCacheClient(gens, {"remotehost": {}})
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client, gens=gens, use_mesh=False)
+        q = parse_pql('SetBit(frame="general", rowID=1, columnID=1)')
+        assert e._cluster_cache_key("i", q, [0, 1, 2],
+                                    ExecOptions()) is None
+        rq = parse_pql('Count(Bitmap(rowID=1, frame=general))')
+        assert e._cluster_cache_key(
+            "i", rq, [0, 1, 2], ExecOptions(partial=True)) is None
+        assert e._cluster_cache_key(
+            "i", rq, [0, 1, 2], ExecOptions(remote=True)) is None
+        assert e._cluster_cache_key("i", rq, [0, 1, 2],
+                                    ExecOptions()) is not None
+
+
+# ---------------------------------------------------------------------------
+# REAL 2-node gossip cluster + single-node reference (the acceptance leg)
+
+
+def _post(host: str, path: str, body: bytes) -> bytes:
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    return urllib.request.urlopen(req, timeout=30).read()
+
+
+def _query(host: str, index: str, body: str, qs: str = ""):
+    req = urllib.request.Request(
+        f"http://{host}/index/{index}/query{qs}",
+        data=body.encode(), method="POST")
+    resp = urllib.request.urlopen(req, timeout=30)
+    return json.loads(resp.read())["results"], dict(resp.headers)
+
+
+def _metric(host: str, name: str, **labels) -> float:
+    with urllib.request.urlopen(f"http://{host}/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    want = "".join(sorted(f'{k}="{v}"' for k, v in labels.items()))
+    total = 0.0
+    for line in text.splitlines():
+        if not line.startswith(name):
+            continue
+        rest = line[len(name):]
+        if rest[:1] not in ("{", " "):
+            continue
+        if labels:
+            inside = rest[1:rest.index("}")] if rest[0] == "{" else ""
+            if "".join(sorted(inside.split(","))) != want:
+                continue
+        total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def _topn(host: str, index: str, n: int):
+    res, _ = _query(host, index, f'TopN(frame="f", n={n})')
+    return [(p["key"] if "key" in p else p["id"], p["count"])
+            for p in res[0]]
+
+
+def test_two_node_distributed_fastpath(tmp_path):
+    pa, pb, ps = free_port(), free_port(), free_port()
+    ga, gb = free_port(), free_port()
+    hosts = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+    procs = []
+    logs = []
+
+    def spawn(name, port, internal=None, seed="", cluster=True):
+        d = tmp_path / name
+        d.mkdir(exist_ok=True)
+        env = cpu_env()
+        env["PILOSA_TPU_MESH"] = "0"
+        log = open(tmp_path / f"{name}.log", "a")
+        logs.append(log)
+        argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                "-d", str(d), "-b", f"127.0.0.1:{port}",
+                "--anti-entropy.interval", "300s"]
+        if cluster:
+            argv += ["--cluster.type", "gossip",
+                     "--cluster.hosts", hosts,
+                     "--cluster.replicas", "1",
+                     "--cluster.internal-port", str(internal)]
+            if seed:
+                argv += ["--cluster.gossip-seed", seed]
+        p = subprocess.Popen(argv, env=env, stdout=log, stderr=log,
+                             cwd=os.path.dirname(_HERE))
+        procs.append(p)
+        wait_up(f"127.0.0.1:{port}")
+        return f"127.0.0.1:{port}"
+
+    try:
+        host_a = spawn("a", pa, ga)
+        host_b = spawn("b", pb, gb, seed=f"127.0.0.1:{ga}")
+        host_s = spawn("solo", ps, cluster=False)
+
+        for h in (host_a, host_s):
+            _post(h, "/index/df", b"{}")
+            _post(h, "/index/df/frame/f", b"{}")
+
+        from pilosa_tpu.cluster.client import Client
+        rng = np.random.default_rng(23)
+        n_cols = 4 * SLICE_WIDTH
+        rows = rng.integers(0, 8, 600).astype(np.uint64)
+        cols = rng.choice(n_cols, size=600,
+                          replace=False).astype(np.uint64)
+        Client(host_a).import_arrays("df", "f", rows, cols)
+        Client(host_s).import_arrays("df", "f", rows, cols)
+        model: dict[int, set] = {}
+        for r, c in zip(rows.tolist(), cols.tolist()):
+            model.setdefault(r, set()).add(c)
+
+        # Both cluster nodes own SOME slices (replicas=1 over 4
+        # slices), and cross-node slice discovery has converged.
+        def row_count(h, row):
+            res, _ = _query(h, "df",
+                            f'Count(Bitmap(frame="f", rowID={row}))')
+            return res[0]
+
+        want0 = len(model[0])
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if (row_count(host_a, 0) == want0
+                    and row_count(host_b, 0) == want0):
+                break
+            time.sleep(0.3)
+        assert row_count(host_a, 0) == want0
+        assert row_count(host_b, 0) == want0
+
+        # (a) distributed TopN == single-node, randomized workload,
+        # several n, from BOTH coordinators.
+        for k in (2, 3, 5, 8):
+            want = _topn(host_s, "df", k)
+            assert _topn(host_a, "df", k) == want, f"n={k} via A"
+            assert _topn(host_b, "df", k) == want, f"n={k} via B"
+        assert _metric(host_a,
+                       "pilosa_executor_topn_pushdown_total",
+                       outcome="merged") >= 1
+        assert _metric(host_b,
+                       "pilosa_executor_topn_pushdown_total",
+                       outcome="merged") >= 1
+
+        # (b) repeated resident chain: second identical query is a
+        # generation-validated cluster-cache hit; a write through the
+        # OTHER node invalidates it on the very next query.
+        q = ('Count(Intersect(Bitmap(frame="f", rowID=0),'
+             ' Bitmap(frame="f", rowID=1)))')
+        want_ix = len(model[0] & model[1])
+        r1, _ = _query(host_a, "df", q)
+        assert r1[0] == want_ix
+        hits0 = _metric(host_a,
+                        "pilosa_executor_cluster_cache_requests_total",
+                        outcome="hit")
+        r2, _ = _query(host_a, "df", q)
+        assert r2[0] == want_ix
+        assert _metric(
+            host_a, "pilosa_executor_cluster_cache_requests_total",
+            outcome="hit") == hits0 + 1
+        # Write through B: make a column shared between rows 0 and 1.
+        new_col = next(c for c in sorted(model[1])
+                       if c not in model[0])
+        _query(host_b, "df",
+               f'SetBit(frame="f", rowID=0, columnID={new_col})')
+        _query(host_s, "df",
+               f'SetBit(frame="f", rowID=0, columnID={new_col})')
+        model[0].add(new_col)
+        r3, _ = _query(host_a, "df", q)
+        assert r3[0] == len(model[0] & model[1]) == want_ix + 1, \
+            "stale answer after a write through the other node"
+
+        # (c) chaos: an injected rpc.recv failure (both attempts — a
+        # single error is absorbed by the client's idempotent
+        # keep-alive retry) downgrades the pushdown to the fan-out
+        # path with a CORRECT answer; a full partition with
+        # ?partial=1 reports the missing slices instead of answering
+        # wrong.
+        fb0 = _metric(host_a, "pilosa_executor_topn_pushdown_total",
+                      outcome="fallback")
+        _post(host_a, "/debug/failpoints",
+              json.dumps({"site": "rpc.recv",
+                          "spec": "error*2"}).encode())
+        assert _topn(host_a, "df", 4) == _topn(host_s, "df", 4)
+        assert _metric(host_a, "pilosa_executor_topn_pushdown_total",
+                       outcome="fallback") == fb0 + 1
+        _post(host_a, "/debug/failpoints",
+              json.dumps({"site": "rpc.recv", "spec": "error"}).encode())
+        res, headers = _query(host_a, "df",
+                              'TopN(frame="f", n=8)', qs="?partial=1")
+        assert "X-Pilosa-Partial" in headers
+        solo = dict(_topn(host_s, "df", 8))
+        for p in res[0]:
+            rid = p.get("id", p.get("key"))
+            assert p["count"] <= solo.get(rid, 0), \
+                "partial degraded answer exceeded the true count"
+        _post(host_a, "/debug/failpoints",
+              json.dumps({"site": "rpc.recv", "spec": "off"}).encode())
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for log in logs:
+            log.close()

@@ -53,6 +53,13 @@ def cluster(tmp_path):
         env["PILOSA_TPU_MESH"] = "0"
         env["PILOSA_TPU_WARMUP"] = "0"
         env["PILOSA_TRACE_ENABLED"] = "1"
+        # These tests assert on the SPANS OF A FAN-OUT (stitched
+        # coordinator + remote legs); the coordinator hot-query
+        # result cache would serve the repeated convergence query
+        # from cache — correct results, no remote legs to stitch —
+        # so pin it off (distributed fast paths have their own
+        # suite, test_distributed_fastpath.py).
+        env["PILOSA_QUERY_CLUSTER_CACHE_ENTRIES"] = "0"
         # Slow log at ~0: every finished query's ledger is retained,
         # so the cost-tree test can read the REMOTE node's own ledger
         # after the fact and compare it to the stitched child.

@@ -187,6 +187,20 @@ def test_cancel_releases_coordinator_and_reaches_peer(cluster):
             time.sleep(0.05)
         assert qs, "query never became visible on the coordinator"
         q = qs[0]
+        # Legs appear once the fan-out dispatches; the query may
+        # first spend a bounded moment in the cluster result cache's
+        # hit-validation probe (the fixture's convergence loop cached
+        # this exact query, and the probe to the STOPPED peer must
+        # fail within its ~1s budget before the real fan-out runs).
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not q["legs"]:
+            time.sleep(0.05)
+            found = [x for x in _get_json(
+                host_a, "/debug/queries")["queries"]
+                if x["id"] == q["id"]]
+            if not found:
+                break
+            q = found[0]
         assert q["legs"], "no fan-out legs recorded"
         req = urllib.request.Request(
             f"http://{host_a}/debug/queries/{q['id']}", method="DELETE")
