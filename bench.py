@@ -288,6 +288,17 @@ def main() -> None:
             # single-node, and the generation-validated resident
             # chain — ROADMAP item 3's acceptance numbers on the line
             # of record.
+            # Always-on observability overhead (suite.
+            # config_obs_overhead): tail sampling + blackbox cadence
+            # vs all-off, interleaved A/B — ISSUE 11's ≤2% acceptance
+            # bound on the bench-leg p50, on the line of record.
+            oo = manifest.get("obs_overhead") or {}
+            if oo.get("ratio") is not None:
+                line["obs_overhead"] = {
+                    "ratio": oo["ratio"],
+                    "on_p50_ms": oo.get("on_p50_ms"),
+                    "off_p50_ms": oo.get("off_p50_ms"),
+                    "target_ratio": oo.get("target_ratio")}
             dt = manifest.get("distributed_topn") or {}
             if dt.get("topn_pushdown_p50_ms") is not None:
                 line["distributed_topn"] = {

@@ -243,6 +243,12 @@ def load_server_config(args, env=None):
         cfg.metrics.runtime_interval = args.metrics_runtime_interval
     if getattr(args, "trace_enabled", None) is not None:
         cfg.trace.enabled = _parse_bool(args.trace_enabled)
+    if getattr(args, "trace_tail", None) is not None:
+        cfg.trace.tail = _parse_bool(args.trace_tail)
+    if getattr(args, "blackbox_enabled", None) is not None:
+        cfg.blackbox.enabled = _parse_bool(args.blackbox_enabled)
+    if getattr(args, "watchdog_enabled", None) is not None:
+        cfg.watchdog.enabled = _parse_bool(args.watchdog_enabled)
     if getattr(args, "trace_max_traces", None) is not None:
         cfg.trace.max_traces = args.trace_max_traces
     if getattr(args, "metrics_accounting", None) is not None:
@@ -302,7 +308,9 @@ def cmd_server(args, stdout, stderr) -> int:
                     metrics_config=cfg.metrics, trace_config=cfg.trace,
                     profile_config=cfg.profile, slo_config=cfg.slo,
                     fault_config=cfg.fault,
-                    gen_staleness_s=cfg.cluster.gen_staleness)
+                    gen_staleness_s=cfg.cluster.gen_staleness,
+                    blackbox_config=cfg.blackbox,
+                    watchdog_config=cfg.watchdog)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -642,6 +650,17 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None, metavar="BOOL",
                    help="trace every query (default false; any single"
                         " request can opt in with ?trace=1)")
+    s.add_argument("--trace.tail", dest="trace_tail",
+                   default=None,
+                   help="tail-sampled tracing: every query buffers"
+                        " spans; slow/errored/faulted ones persist"
+                        " (default true)")
+    s.add_argument("--blackbox.enabled", dest="blackbox_enabled",
+                   default=None,
+                   help="blackbox flight recorder (default true)")
+    s.add_argument("--watchdog.enabled", dest="watchdog_enabled",
+                   default=None,
+                   help="stall watchdog (default true)")
     s.add_argument("--trace.max-traces", dest="trace_max_traces",
                    type=int, default=None, metavar="N",
                    help="recent traces kept per node for /debug/traces"

@@ -171,6 +171,12 @@ class Client:
         # timeout again. allow() grants the half-open probe when the
         # backoff window has lapsed.
         if self.fault is not None and not self.fault.allow(target):
+            ctx = sched_context.current()
+            if ctx is not None:
+                # Tail-sampling cross-link: this query touched an open
+                # breaker — whatever happens next (failover, partial,
+                # error), its trace is worth keeping (obs.sampler).
+                ctx.note_flag("breaker")
             raise CircuitOpenError(
                 f"{method} http://{target}{path}: circuit open")
         deadline = (time.monotonic() + deadline_s

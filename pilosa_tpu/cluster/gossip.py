@@ -186,6 +186,12 @@ class GossipNodeSet:
         self._relays: dict[int, tuple[str, int]] = {}
         self._udp: Optional[socket.socket] = None
         self._tcp: Optional[socket.socket] = None
+        # Stall-watchdog signal (obs.watchdog "gossip_silence"): when
+        # the membership layer last RECEIVED anything (UDP absorb or a
+        # TCP push/pull). 0.0 until open(); single-member clusters
+        # report no age (silence is not observable — nothing should be
+        # talking).
+        self._last_recv = 0.0
         self._send_pool = None          # lazy bounded sync-send pool
         self._send_pool_mu = threading.Lock()
         self._closing = threading.Event()
@@ -560,7 +566,21 @@ class GossipNodeSet:
             except Exception:  # noqa: BLE001 - a bad packet must not kill IO
                 continue
 
+    def last_activity_age(self) -> Optional[float]:
+        """Seconds since the last received membership traffic, or
+        None when silence is not meaningful (not open yet, or no
+        known peers to hear from)."""
+        if self._last_recv == 0.0:
+            return None
+        with self._mu:
+            peers = sum(1 for m in self._members.values()
+                        if m.name != self.host)
+        if peers == 0:
+            return None
+        return time.monotonic() - self._last_recv
+
     def _absorb(self, pkt: dict) -> None:
+        self._last_recv = time.monotonic()
         for w in pkt.get("updates", []):
             try:
                 self._merge_member(Member.from_wire(w))
@@ -661,6 +681,7 @@ class GossipNodeSet:
 
     def _absorb_state(self, state: dict) -> None:
         """MergeRemoteState (gossip.go:208-222)."""
+        self._last_recv = time.monotonic()
         for w in state.get("members", []):
             try:
                 self._merge_member(Member.from_wire(w))

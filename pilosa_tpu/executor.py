@@ -2419,6 +2419,10 @@ class Executor:
                         nodes = [x for x in nodes if x is not node]
                         obs_metrics.FAILOVER_SLICES.labels(
                             node.host or "local").inc(len(group))
+                        if ctx is not None:
+                            # Tail sampling: a failover leg is keep-
+                            # worthy evidence (obs.sampler "breaker").
+                            ctx.note_flag("failover")
                         with _ctx_span(ctx, "failover", peer=node.host,
                                        slices=len(group),
                                        error=type(e).__name__):
@@ -3493,6 +3497,10 @@ class Executor:
                 if ctx is not None:
                     ctx.add_leg(node.host, len(node_slices))
             if missing is not None:
+                if ctx is not None and len(missing) > before:
+                    # Tail sampling: a degraded (partial) answer is
+                    # keep-worthy evidence (obs.sampler "partial").
+                    ctx.note_flag("partial")
                 # Unservable slices still count toward completion —
                 # that is what "partial" means.
                 processed += len(missing) - before
@@ -3536,6 +3544,10 @@ class Executor:
                         nodes = [n for n in nodes if n is not node]
                         obs_metrics.FAILOVER_SLICES.labels(
                             node.host or "local").inc(len(node_slices))
+                        if ctx is not None:
+                            # Tail sampling: a failover leg is keep-
+                            # worthy evidence (obs.sampler "breaker").
+                            ctx.note_flag("failover")
                         with _ctx_span(ctx, "failover", peer=node.host,
                                        slices=len(node_slices),
                                        error=type(e).__name__):

@@ -17,6 +17,9 @@ Sites (each exercised by at least one test):
 ``snapshot.write``  storage/fragment, inside the snapshot tmp-file write
 ``gossip.deliver``  cluster/gossip envelope delivery (drop / delay)
 ``mesh.dispatch``   parallel/mesh device dispatch gates
+``ring.write``      obs/diskring segment appends (trace store +
+                    blackbox ring; torn-write capable — crash
+                    mid-segment-write)
 ==================  =========================================================
 
 Spec grammar (one string per site)::
@@ -59,7 +62,7 @@ from ..utils.config import parse_duration
 ACTIVE: Optional["Failpoints"] = None
 
 SITES = ("rpc.send", "rpc.recv", "wal.append", "snapshot.write",
-         "gossip.deliver", "mesh.dispatch")
+         "gossip.deliver", "mesh.dispatch", "ring.write")
 
 
 def env_key(site: str) -> str:
@@ -233,6 +236,13 @@ class Failpoints:
             mode, arg = fp.mode, fp.arg
         self._sync_active()
         obs_metrics.FAILPOINT_TRIGGERS.labels(site).inc()
+        # Tail-sampling cross-link (obs.sampler): a query that hit an
+        # armed failpoint is chaos evidence — flag its context so the
+        # end-of-query keep decision retains the trace.
+        from ..sched import context as sched_context
+        ctx = sched_context.current()
+        if ctx is not None:
+            ctx.note_flag("failpoint")
         if mode == "delay":
             time.sleep(arg)
             return

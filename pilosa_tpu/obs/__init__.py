@@ -27,6 +27,18 @@ runtime collector.
 - ``obs.runtime`` — a background collector sampling holder/cache/
   residency sizes, thread activity, and the XLA compile-cache
   counters (parallel.mesh.compile_stats) into gauges and ``/status``.
+- ``obs.sampler`` — always-on tail-sampled tracing: every query gets
+  the span buffer, the keep decision runs at query end (slow/errored/
+  deadline/cancelled/partial/shed/breaker/failpoint/head), and kept
+  traces persist to a crash-safe on-disk segment ring
+  (``obs.diskring``) that survives restarts.
+- ``obs.blackbox`` — the flight recorder: periodic whole-system
+  snapshots into a bounded disk ring, dumped in full on SIGTERM,
+  fatal thread death, a watchdog trip, or the API.
+- ``obs.watchdog`` — the stall watchdog: wedged WAL flusher, legs
+  stuck past deadline grace, gossip silence, non-draining admission
+  queue → ``pilosa_watchdog_trips_total{cause}``, force-kept
+  in-flight traces, a blackbox dump.
 
 See docs/OBSERVABILITY.md for the metric name reference, the trace
 and cost wire contracts, and the perfetto/speedscope how-tos.

@@ -41,7 +41,11 @@ from ..utils.stats import StatsClient
 
 # pilosa_<subsystem>_<noun>_<unit>: at least three snake segments after
 # the pilosa prefix (subsystem, noun, unit); plain lowercase/digits.
-NAME_RE = re.compile(r"^pilosa(_[a-z][a-z0-9]*){3,}$")
+# The one sanctioned exception is the OpenMetrics *info* idiom —
+# ``pilosa_build_info``-style constant-1 gauges whose labels carry the
+# values — which keeps the ecosystem-conventional name.
+NAME_RE = re.compile(r"^pilosa(_[a-z][a-z0-9]*){3,}$"
+                     r"|^pilosa(_[a-z][a-z0-9]*)+_info$")
 
 
 def validate_name(name: str, type_: str) -> None:
@@ -67,6 +71,17 @@ def log_buckets(lo: float = 0.001, hi: float = 64.0
     return tuple(out)
 
 
+# Per-family bound on distinct label sets: per-peer families
+# (pilosa_cluster_rpc_seconds{peer}, pilosa_cluster_peer_health{peer})
+# otherwise grow without bound as the cluster scales, and an unbounded
+# registry is both a memory leak and a scrape-size incident. Past the
+# cap, NEW label sets collapse into one ``_overflow_`` bucket and
+# pilosa_metrics_label_overflow_total{family} counts the collapses.
+DEFAULT_MAX_LABEL_SETS = 256
+_OVERFLOW_LABEL = "_overflow_"
+_OVERFLOW_COUNTER_NAME = "pilosa_metrics_label_overflow_total"
+
+
 class _Family:
     """Shared base: a named family with optional label names and a
     dict of label-tuple → child state."""
@@ -74,20 +89,37 @@ class _Family:
     type = "untyped"
 
     def __init__(self, name: str, help: str = "",
-                 labels: Iterable[str] = ()):
+                 labels: Iterable[str] = (),
+                 max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
         validate_name(name, self.type)
         self.name = name
         self.help = help
         self.labelnames = tuple(labels)
+        self.max_label_sets = max(1, int(max_label_sets))
         self._mu = threading.Lock()
         self._children: dict[tuple, object] = {}
 
     def _child(self, labelvalues: tuple):
+        overflowed = False
         with self._mu:
             child = self._children.get(labelvalues)
             if child is None:
-                child = self._children[labelvalues] = self._new_child()
-            return child
+                if (self.labelnames
+                        and len(self._children) >= self.max_label_sets
+                        and self.name != _OVERFLOW_COUNTER_NAME):
+                    # Cardinality guard: the cap is on NEW label sets;
+                    # existing children (and the overflow bucket
+                    # itself) keep resolving normally.
+                    overflowed = True
+                    labelvalues = ((_OVERFLOW_LABEL,)
+                                   * len(self.labelnames))
+                    child = self._children.get(labelvalues)
+                if child is None:
+                    child = self._children[labelvalues] = \
+                        self._new_child()
+        if overflowed:
+            LABEL_OVERFLOW.labels(self.name).inc()
+        return child
 
     def labels(self, *values, **kv):
         if kv:
@@ -242,9 +274,11 @@ class Histogram(_Family):
 
     def __init__(self, name: str, help: str = "",
                  labels: Iterable[str] = (),
-                 buckets: Optional[tuple[float, ...]] = None):
+                 buckets: Optional[tuple[float, ...]] = None,
+                 max_label_sets: int = DEFAULT_MAX_LABEL_SETS):
         self.buckets = tuple(buckets) if buckets else log_buckets()
-        super().__init__(name, help, labels)
+        super().__init__(name, help, labels,
+                         max_label_sets=max_label_sets)
 
     def _new_child(self):
         return _HistogramChild(self.buckets)
@@ -308,18 +342,26 @@ class Registry:
             return fam
 
     def counter(self, name: str, help: str = "",
-                labels: Iterable[str] = ()) -> Counter:
-        return self._register(Counter(name, help, labels))
+                labels: Iterable[str] = (),
+                max_label_sets: int = DEFAULT_MAX_LABEL_SETS
+                ) -> Counter:
+        return self._register(Counter(
+            name, help, labels, max_label_sets=max_label_sets))
 
     def gauge(self, name: str, help: str = "",
-              labels: Iterable[str] = ()) -> Gauge:
-        return self._register(Gauge(name, help, labels))
+              labels: Iterable[str] = (),
+              max_label_sets: int = DEFAULT_MAX_LABEL_SETS) -> Gauge:
+        return self._register(Gauge(
+            name, help, labels, max_label_sets=max_label_sets))
 
     def histogram(self, name: str, help: str = "",
                   labels: Iterable[str] = (),
-                  buckets: Optional[tuple[float, ...]] = None
+                  buckets: Optional[tuple[float, ...]] = None,
+                  max_label_sets: int = DEFAULT_MAX_LABEL_SETS
                   ) -> Histogram:
-        return self._register(Histogram(name, help, labels, buckets))
+        return self._register(Histogram(
+            name, help, labels, buckets,
+            max_label_sets=max_label_sets))
 
     def families(self) -> dict[str, _Family]:
         with self._mu:
@@ -461,7 +503,39 @@ RESIDENCY_BYTES = _DEFAULT.gauge(
     "Device residency cache HBM", labels=("kind",))
 TRACES_KEPT = _DEFAULT.counter(
     "pilosa_trace_kept_total",
-    "Traces retained in the per-node ring buffer")
+    "Traces retained by the tail sampler, by keep reason (slow/error/"
+    "deadline/cancelled/partial/shed/breaker/failpoint/head/requested/"
+    "watchdog — docs/OBSERVABILITY.md keep-reason catalogue)",
+    labels=("reason",))
+TRACE_DISK_RECORDS = _DEFAULT.counter(
+    "pilosa_trace_disk_records_total",
+    "Kept traces persisted to the on-disk segment ring, by outcome"
+    " (written / dropped)",
+    labels=("outcome",))
+LABEL_OVERFLOW = _DEFAULT.counter(
+    "pilosa_metrics_label_overflow_total",
+    "New label sets collapsed into a family's _overflow_ bucket by the"
+    " per-family cardinality cap, by family",
+    labels=("family",))
+BUILD_INFO = _DEFAULT.gauge(
+    "pilosa_build_info",
+    "Constant 1; the labels carry the build identity (version, python,"
+    " jax, backend) — the OpenMetrics info idiom",
+    labels=("version", "python", "jax", "backend"))
+WATCHDOG_TRIPS = _DEFAULT.counter(
+    "pilosa_watchdog_trips_total",
+    "Stall-watchdog trips, by cause (wal_flusher / stuck_query /"
+    " gossip_silence / admission_stall)",
+    labels=("cause",))
+BLACKBOX_SNAPSHOTS = _DEFAULT.counter(
+    "pilosa_blackbox_snapshots_total",
+    "Flight-recorder whole-system snapshots taken, by trigger",
+    labels=("trigger",))
+BLACKBOX_DUMPS = _DEFAULT.counter(
+    "pilosa_blackbox_dumps_total",
+    "Flight-recorder full dumps written, by cause (sigterm / fatal /"
+    " watchdog / api)",
+    labels=("cause",))
 IMPORT_STAGE_SECONDS = _DEFAULT.histogram(
     "pilosa_import_stage_seconds",
     "Wire-import handler stage timings: decode (wire to arrays),"

@@ -28,6 +28,8 @@ Everything lands twice: as gauges/counters in the metrics registry
 
 from __future__ import annotations
 
+import platform
+import sys
 import threading
 import time
 from typing import Optional
@@ -35,6 +37,41 @@ from typing import Optional
 from . import metrics as obs_metrics
 
 DEFAULT_INTERVAL_S = 10.0
+
+_build_info: Optional[dict] = None
+
+
+def build_info() -> dict:
+    """Build identity: package version, python, jax version, and the
+    jax backend platform — the value block behind the
+    ``pilosa_build_info`` gauge and the ``build`` block in /status.
+    The jax fields read from the ALREADY-IMPORTED module only: a bare
+    handler serving /status must not pay (or fail) a jax import, and
+    ``default_backend()`` is only consulted once something else has
+    initialized a backend."""
+    global _build_info
+    if _build_info is not None:
+        return _build_info
+    from .. import __version__
+    jax_mod = sys.modules.get("jax")
+    jax_version = getattr(jax_mod, "__version__", "") if jax_mod else ""
+    backend = ""
+    if jax_mod is not None:
+        try:
+            backend = jax_mod.default_backend()
+        except Exception:  # noqa: BLE001 - backend init can fail off-TPU
+            backend = "unavailable"
+    info = {"version": __version__,
+            "python": platform.python_version(),
+            "jax": jax_version or "unloaded",
+            "backend": backend or "unloaded"}
+    # Publish (and cache) only once jax is actually loaded: an early
+    # /status on a bare handler must neither freeze "unloaded" for the
+    # process nor leave a second, stale build_info series behind.
+    if jax_mod is not None:
+        obs_metrics.BUILD_INFO.labels(**info).set(1)
+        _build_info = info
+    return info
 
 
 class RuntimeCollector:
@@ -80,6 +117,7 @@ class RuntimeCollector:
         """One sampling pass: update registry gauges, return (and
         retain for /status) the snapshot dict."""
         snap: dict = {"sampledAt": time.time()}
+        snap["build"] = build_info()
         snap["holder"] = self._holder_sizes()
         snap["threads"] = self._thread_sample()
         snap["deviceBlockCache"] = self._residency()

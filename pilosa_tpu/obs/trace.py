@@ -19,10 +19,15 @@ lists the per-node bounded ring of recent traces;
 (open in https://ui.perfetto.dev — each node renders as a process,
 each thread as a track).
 
-Overhead contract: tracing is OFF by default. The disabled path
-allocates nothing — ``span_current()`` returns a shared no-op context
-manager after two attribute reads, and a QueryContext whose ``trace``
-is None never creates a Span.
+Overhead contract: the *keep-everything* mode is OFF by default, and a
+QueryContext whose ``trace`` is None allocates nothing —
+``span_current()`` returns a shared no-op context manager after two
+attribute reads. Since the always-on PR the serving layer attaches a
+span buffer to EVERY query (tail sampling, obs.sampler): the buffer
+itself is the measured-near-free part, and the keep decision at query
+end picks which traces reach the ring and the on-disk segment ring
+(``Tracer.keep(trace, reason)``; the keep-reason catalogue lives in
+obs.sampler / docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -114,10 +119,23 @@ class Trace:
         self.started = time.time()
         self.max_spans = max_spans
         self.dropped = 0
+        # Why the tail sampler retained this trace ("" while in
+        # flight / never kept) — obs.sampler's keep-reason catalogue.
+        self.keep_reason = ""
         self._mu = threading.Lock()
         self._spans: list[Span] = []
 
     # -- recording -----------------------------------------------------------
+
+    def claim_keep(self, reason: str) -> bool:
+        """Atomically claim the keep of this trace (first claimant
+        wins): the end-of-query decision and the watchdog's force-keep
+        can race, and exactly ONE of them may enter the ring/disk."""
+        with self._mu:
+            if self.keep_reason:
+                return False
+            self.keep_reason = reason
+            return True
 
     def span(self, name: str, **tags) -> _SpanCM:
         return _SpanCM(self, name, tags or None)
@@ -177,7 +195,7 @@ class Trace:
         spans = self.spans()
         end = max((s.start + s.dur for s in spans),
                   default=self.started)
-        return {
+        out = {
             "id": self.id,
             "node": self.node,
             "pql": self.pql[:200],
@@ -187,6 +205,9 @@ class Trace:
             "dropped": self.dropped,
             "nodes": sorted({s.node for s in spans if s.node}),
         }
+        if self.keep_reason:
+            out["reason"] = self.keep_reason
+        return out
 
     def to_chrome(self) -> dict:
         """Chrome trace-event JSON (perfetto-loadable): one process
@@ -238,11 +259,17 @@ class Tracer:
         ctx.trace = trace
         return trace
 
-    def keep(self, trace: Trace) -> None:
+    def keep(self, trace: Trace, reason: str = "requested") -> bool:
+        """Retain ``trace`` in the ring under ``reason``; idempotent —
+        False (and no second ring entry / counter tick) when another
+        keeper already claimed it."""
         from . import metrics as obs_metrics
+        if not trace.claim_keep(reason):
+            return False
         with self._mu:
             self._ring.append(trace)
-        obs_metrics.TRACES_KEPT.inc()
+        obs_metrics.TRACES_KEPT.labels(reason).inc()
+        return True
 
     def traces(self) -> list[dict]:
         with self._mu:

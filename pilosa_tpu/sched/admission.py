@@ -100,6 +100,12 @@ class AdmissionController:
         self._rejected = 0
         # EWMA of slot hold seconds, feeding the Retry-After estimate.
         self._hold_ewma = 0.05
+        # Stall/shed observability (obs.watchdog, obs.sampler): when
+        # the last slot was granted, when the wait queue last became
+        # non-empty, and per-lane when the last 429 was issued.
+        self._last_grant = time.monotonic()
+        self._queue_since = 0.0
+        self._last_reject: dict[str, float] = {}
 
     # -- acquire / release ---------------------------------------------------
 
@@ -114,11 +120,18 @@ class AdmissionController:
                 return Slot(self, lane)
             if queued >= self.queue_depth:
                 self._rejected += 1
+                self._last_reject[lane] = time.monotonic()
                 raise AdmissionFullError(
                     f"admission queue full ({queued} waiting,"
                     f" {self._in_flight} in flight)",
                     retry_after_s=self._retry_after_locked())
             w = _Waiter()
+            if queued == 0:
+                # The queue just became non-empty: the watchdog's
+                # stall clock starts HERE, not at the last grant — a
+                # fresh waiter behind legitimately long-running slot
+                # holders is not a stall.
+                self._queue_since = time.monotonic()
             self._queues.setdefault(lane, []).append(w)
             try:
                 while not w.granted:
@@ -144,6 +157,7 @@ class AdmissionController:
 
     def _grant_locked(self, lane: str) -> None:
         self._in_flight += 1
+        self._last_grant = time.monotonic()
         self._served[lane] = self._served.get(lane, 0) + 1
         w = self.weights.get(lane, 1) or 1
         # A lane idle for a while re-enters near the current clock
@@ -182,6 +196,26 @@ class AdmissionController:
     def in_flight(self) -> int:
         with self._mu:
             return self._in_flight
+
+    def recent_rejection(self, lane: str, window_s: float) -> bool:
+        """Did this lane answer a 429 within the last ``window_s``?
+        The tail sampler's shed-lane signal: a query that finished in
+        a lane that was actively shedding is evidence worth keeping."""
+        with self._mu:
+            t = self._last_reject.get(lane)
+        return t is not None and time.monotonic() - t <= window_s
+
+    def stall_state(self) -> tuple[int, float]:
+        """(queued, stall age) for the watchdog's non-draining-queue
+        detector: the age is since the LATER of the last grant and
+        the moment the queue became non-empty — grants draining the
+        queue reset it, and so does an empty queue refilling."""
+        with self._mu:
+            queued = sum(len(q) for q in self._queues.values())
+            if queued == 0:
+                return 0, 0.0
+            return queued, time.monotonic() - max(self._last_grant,
+                                                  self._queue_since)
 
     def snapshot(self) -> dict:
         with self._mu:

@@ -77,6 +77,22 @@ class QueryContext:
         # contract as trace: None means every note_* site records
         # nothing.
         self.cost = None
+        # Fault-event flags the tail sampler's keep decision reads at
+        # query end ("breaker", "failover", "failpoint", "partial"):
+        # set by the choke points that observe the event (client
+        # circuit-open, executor failover, failpoints.hit). Set.add is
+        # GIL-atomic; no lock needed.
+        self.flags: set[str] = set()
+        # Filled at query end by the serving layer: whether this
+        # query's trace was kept and why — the slow log cross-links on
+        # these so /debug/queries/slow points at the persisted trace.
+        self.trace_kept = False
+        self.keep_reason = ""
+
+    def note_flag(self, name: str) -> None:
+        """Record a fault-event flag for the tail sampler (no-op
+        semantics: flags only widen the keep decision)."""
+        self.flags.add(name)
 
     # -- budget --------------------------------------------------------------
 

@@ -90,6 +90,14 @@ class QueryRegistry:
         entry["elapsedS"] = round(elapsed, 4)
         if error is not None:
             entry["error"] = str(error)[:200]
+        # Cross-link to the tail sampler (obs.sampler): when the
+        # query's trace was kept, this slow entry points straight at
+        # the persisted trace — /debug/traces/{id} (ring) or
+        # /debug/traces?source=disk (after a restart).
+        entry["traceKept"] = bool(getattr(ctx, "trace_kept", False))
+        reason = getattr(ctx, "keep_reason", "")
+        if reason:
+            entry["traceKeepReason"] = reason
         with self._mu:
             self._slow.append(entry)
         self.stats.count("slowQueries", 1)
@@ -108,10 +116,16 @@ class QueryRegistry:
     # -- visibility + cancellation -------------------------------------------
 
     def active(self) -> list[dict]:
+        return [c.to_json() for c in self.active_contexts()]
+
+    def active_contexts(self) -> list[QueryContext]:
+        """The live QueryContext objects, oldest first — the watchdog
+        (stuck-leg detection, force-keeping in-flight traces) needs
+        the contexts themselves, not their JSON."""
         with self._mu:
             ctxs = [c for group in self._active.values() for c in group]
         ctxs.sort(key=lambda c: c.started)
-        return [c.to_json() for c in ctxs]
+        return ctxs
 
     def __len__(self) -> int:
         with self._mu:

@@ -36,6 +36,7 @@ from ..obs import profile as obs_profile
 from ..obs import trace as obs_trace
 from ..sched import (LANE_ADMIN, LANE_READ, LANE_WRITE, AdmissionFullError,
                      QueryContext, QueryRegistry)
+from ..sched import context as sched_context
 from ..models.frame import Field, FrameOptions
 from ..models.index import IndexOptions
 from ..pql import parser as pql
@@ -211,7 +212,8 @@ class Handler:
                  pod=None, logger=None, admission=None, registry=None,
                  warmup=None, default_timeout_s: float = 0.0,
                  tracer=None, runtime=None, profiler=None, health=None,
-                 accounting: bool = True, fault=None):
+                 accounting: bool = True, fault=None, sampler=None,
+                 blackbox=None, watchdog=None):
         from ..utils import logger as logger_mod
         self.logger = logger or logger_mod.NOP
         self.holder = holder
@@ -240,6 +242,14 @@ class Handler:
         self.tracer = tracer if tracer is not None \
             else obs_trace.Tracer(enabled=False)
         self.runtime = runtime
+        # Tail sampling (obs.sampler): when wired, EVERY query gets
+        # the span buffer and the keep decision runs at query end;
+        # None (bare test handlers) keeps the ask-first behavior.
+        self.sampler = sampler
+        # Flight recorder + stall watchdog (obs.blackbox/obs.watchdog)
+        # behind /debug/blackbox*; None serves empty state.
+        self.blackbox = blackbox
+        self.watchdog = watchdog
         # Continuous profiler (obs.profile) behind /debug/pprof/flame —
         # the module default is NOT started, so bare handlers serve the
         # route with an empty ring and zero sampling overhead.
@@ -315,6 +325,9 @@ class Handler:
         r("DELETE", "/debug/queries/{qid}", self._handle_delete_query)
         r("GET", "/debug/traces", self._handle_debug_traces)
         r("GET", "/debug/traces/{qid}", self._handle_debug_trace)
+        r("GET", "/debug/blackbox", self._handle_debug_blackbox)
+        r("POST", "/debug/blackbox/dump",
+          self._handle_post_blackbox_dump)
         r("GET", "/debug/failpoints", self._handle_debug_failpoints)
         r("POST", "/debug/failpoints", self._handle_post_failpoints)
         r("GET", "/debug/vars", self._handle_expvar)
@@ -432,6 +445,12 @@ class Handler:
         runtime = (self.runtime.snapshot()
                    if self.runtime is not None else None)
         fault = self.fault.snapshot() if self.fault is not None else None
+        # Build identity (the JSON face of pilosa_build_info): version,
+        # python, jax, backend — same block on every status form.
+        from ..obs.runtime import build_info
+        build = build_info()
+        watchdog = (self.watchdog.snapshot()
+                    if self.watchdog is not None else None)
         if self.status_handler is not None:
             cs = self.status_handler.cluster_status()  # pb.ClusterStatus
             if _PROTOBUF in req.accept:
@@ -445,22 +464,28 @@ class Handler:
                                          for f in ix.Frames]}
                              for ix in ns.Indexes]}
                 for ns in cs.Nodes]}}
+            out["build"] = build
             if warm is not None:
                 out["warmup"] = warm
             if runtime is not None:
                 out["runtime"] = runtime
             if fault is not None:
                 out["fault"] = fault
+            if watchdog is not None:
+                out["watchdog"] = watchdog
             return Response.json(out)
         states = self.cluster.node_states() if self.cluster else {}
         out = {"status": {"Nodes": [
             {"Host": h, "State": s} for h, s in sorted(states.items())]}}
+        out["build"] = build
         if warm is not None:
             out["warmup"] = warm
         if runtime is not None:
             out["runtime"] = runtime
         if fault is not None:
             out["fault"] = fault
+        if watchdog is not None:
+            out["watchdog"] = watchdog
         return Response.json(out)
 
     def _handle_expvar(self, req: Request) -> Response:
@@ -915,8 +940,38 @@ class Handler:
                         "text/plain; version=0.0.4; charset=utf-8")
 
     def _handle_debug_traces(self, req: Request) -> Response:
+        """The in-memory ring by default; ``?source=disk`` lists the
+        PERSISTED kept traces (tail sampler's segment ring — survives
+        restarts), ``?reason=<keep-reason>`` filters either source,
+        ``?limit=N`` bounds the listing (default 100)."""
+        from ..obs import sampler as obs_sampler
+        reason = req.query.get("reason", "")
+        try:
+            limit = max(1, int(req.query.get("limit", "100")))
+        except ValueError:
+            raise HTTPError(400, "invalid limit")
+        if req.query.get("source") == "disk":
+            disk = self.sampler.disk if self.sampler is not None \
+                else None
+            traces: list[dict] = []
+            if disk is not None:
+                for record in disk.scan():
+                    if reason and record.get("reason") != reason:
+                        continue
+                    traces.append(obs_sampler.record_summary(record))
+                    if len(traces) >= limit:
+                        break
+            out = {"enabled": self.tracer.enabled, "source": "disk",
+                   "traces": traces}
+            if disk is not None:
+                out["disk"] = disk.stats()
+            return Response.json(out)
+        traces = self.tracer.traces()
+        if reason:
+            traces = [t for t in traces if t.get("reason") == reason]
         return Response.json({"enabled": self.tracer.enabled,
-                              "traces": self.tracer.traces()})
+                              "tail": self.sampler is not None,
+                              "traces": traces[:limit]})
 
     # -- failpoint admin (fault subsystem; docs/FAULT_TOLERANCE.md) ----------
 
@@ -960,15 +1015,60 @@ class Handler:
 
     def _handle_debug_trace(self, req: Request) -> Response:
         """One trace as Chrome trace-event JSON (open in perfetto);
-        ``?format=spans`` returns the raw span list instead."""
-        trace = self.tracer.get(req.vars["qid"])
+        ``?format=spans`` returns the raw span list instead. A miss in
+        the in-memory ring falls back to the tail sampler's disk ring
+        (``?source=disk`` skips the ring and goes straight there), so
+        a persisted trace stays addressable after a restart."""
+        trace = None
+        if req.query.get("source") != "disk":
+            trace = self.tracer.get(req.vars["qid"])
+        if trace is None and self.sampler is not None \
+                and self.sampler.disk is not None:
+            from ..obs import sampler as obs_sampler
+            qid = req.vars["qid"]
+            for record in self.sampler.disk.scan():
+                if record.get("id") == qid:
+                    trace = obs_sampler.record_to_trace(record)
+                    break
         if trace is None:
             raise HTTPError(404, "trace not found")
         if req.query.get("format") == "spans":
             return Response.json(
-                {"id": trace.id,
+                {"id": trace.id, "reason": trace.keep_reason,
                  "spans": [s.to_json() for s in trace.spans()]})
         return Response.json(trace.to_chrome())
+
+    def _handle_debug_blackbox(self, req: Request) -> Response:
+        """Flight-recorder state: ring/dump stats plus the most recent
+        snapshots (``?limit=N``, default 8) and the watchdog's trip
+        record — the read side of docs/OBSERVABILITY.md's blackbox."""
+        try:
+            limit = max(0, int(req.query.get("limit", "8")))
+        except ValueError:
+            raise HTTPError(400, "invalid limit")
+        out: dict = {"enabled": self.blackbox is not None}
+        if self.blackbox is not None:
+            out.update(self.blackbox.stats())
+            snaps = []
+            if limit:
+                for rec in self.blackbox.ring.scan():
+                    snaps.append(rec)
+                    if len(snaps) >= limit:
+                        break
+            out["recent"] = snaps
+        if self.watchdog is not None:
+            out["watchdog"] = self.watchdog.snapshot()
+        return Response.json(out)
+
+    def _handle_post_blackbox_dump(self, req: Request) -> Response:
+        """Force a full flight-recorder dump (cause ``api``) — the
+        operator's "capture everything NOW" button."""
+        if self.blackbox is None:
+            raise HTTPError(404, "no blackbox recorder")
+        path = self.blackbox.dump("api")
+        if path is None:
+            raise HTTPError(500, "blackbox dump failed")
+        return Response.json({"dumped": path})
 
     # -- query ---------------------------------------------------------------
 
@@ -1033,12 +1133,15 @@ class Handler:
         # tracer is on, the request opts in (?trace=1), or a
         # coordinator asked this forwarded leg to trace itself
         # (X-Pilosa-Trace) — remote legs piggyback their spans back on
-        # the response for stitching. None (the default) allocates no
-        # spans anywhere below.
+        # the response for stitching. With tail sampling wired
+        # (obs.sampler — the server default), EVERY query buffers
+        # spans and the keep decision runs at query end instead.
         trace = None
-        if (self.tracer.enabled or req.query.get("trace") == "1"
-                or (remote and self.environ_header(
-                    req, "HTTP_X_PILOSA_TRACE") == "1")):
+        trace_requested = (
+            self.tracer.enabled or req.query.get("trace") == "1"
+            or (remote and self.environ_header(
+                req, "HTTP_X_PILOSA_TRACE") == "1"))
+        if trace_requested or self.sampler is not None:
             trace = self.tracer.start(ctx, node=self.host)
             trace.add_span("parse", parse_wall, parse_s)
         # Query latency label set: one call name when the query is
@@ -1083,6 +1186,7 @@ class Handler:
         # on a peer's slot).
         slot = None
         err: Optional[BaseException] = None
+        exec_opt = None
         self.registry.register(ctx)
         try:
             if not remote:
@@ -1109,8 +1213,11 @@ class Handler:
                 # query applied has its WAL record durable (per the
                 # fsync policy) when the response goes out. Concurrent
                 # write queries coalesce into one leader flush per
-                # touched WAL (storage.wal group commit).
-                with ctx.stage("commit"):
+                # touched WAL (storage.wal group commit). Bound as the
+                # thread's current query so a wal.append failpoint hit
+                # during THIS query's barrier flags its context for
+                # the tail sampler (the barrier covers its records).
+                with ctx.stage("commit"), sched_context.use(ctx):
                     storage_wal.barrier_all()
         except HTTPError as e:  # 429 from _admit
             err = e
@@ -1134,10 +1241,6 @@ class Handler:
         finally:
             if slot is not None:
                 slot.release()
-            self.registry.finish(ctx, error=err)
-            # Latency histogram + outcome counter, labeled by call
-            # type / lane / status (obs.metrics) — recorded for every
-            # outcome, including 429/504/409 error returns.
             if isinstance(err, HTTPError):
                 status = err.status
             elif isinstance(err, QueryDeadlineError):
@@ -1150,6 +1253,58 @@ class Handler:
                 status = 500
             else:
                 status = 200
+            # Tail-sampling keep decision (obs.sampler), BEFORE the
+            # registry finishes the context so the slow-log entry can
+            # cross-link the kept trace (traceKept / traceKeepReason).
+            # Explicitly-requested traces ([trace] enabled, ?trace=1,
+            # a coordinator-asked leg) keep unconditionally.
+            if trace is not None:
+                if ctx.cost is not None:
+                    # Cost roll-up as span args: the perfetto view of
+                    # this query carries its resource ledger.
+                    trace.add_span("query_cost", ctx.started_wall, 0.0,
+                                   tags=ctx.cost.summary())
+                reason = None
+                if self.sampler is not None:
+                    partial = bool(exec_opt is not None
+                                   and exec_opt.partial
+                                   and exec_opt.missing_slices)
+                    reason = self.sampler.decide(
+                        ctx, err=err, status=status, partial=partial)
+                # Explicit keeps: [trace] enabled and ?trace=1 always;
+                # a coordinator-asked remote leg only when no sampler
+                # runs here — with tail sampling on, EVERY leg carries
+                # the header (the spans piggyback back either way), so
+                # auto-keeping would persist every healthy remote leg
+                # on every peer. The peer's own tail decision keeps
+                # the interesting legs.
+                if reason is None and (
+                        self.tracer.enabled
+                        or req.query.get("trace") == "1"
+                        or (trace_requested and remote
+                            and self.sampler is None)):
+                    reason = "requested"
+                if trace.keep_reason:
+                    # Already force-kept mid-flight (watchdog): it IS
+                    # in the ring/disk — report that, don't re-enter,
+                    # whatever the end-of-query decision said.
+                    reason = trace.keep_reason
+                elif reason is not None:
+                    # keep() claims atomically: a watchdog force-keep
+                    # racing this exact window wins and we report ITS
+                    # reason instead of double-entering the ring/disk.
+                    if self.tracer.keep(trace, reason=reason):
+                        if self.sampler is not None:
+                            self.sampler.persist(trace, reason,
+                                                 ctx=ctx)
+                    else:
+                        reason = trace.keep_reason or reason
+                ctx.trace_kept = reason is not None
+                ctx.keep_reason = reason or ""
+            self.registry.finish(ctx, error=err)
+            # Latency histogram + outcome counter, labeled by call
+            # type / lane / status (obs.metrics) — recorded for every
+            # outcome, including 429/504/409 error returns.
             labels = (call_label, ctx.lane, str(status))
             # The latency observation carries the query id as an
             # OpenMetrics exemplar: "p99 regressed" comes with a trace
@@ -1157,15 +1312,6 @@ class Handler:
             obs_metrics.QUERY_SECONDS.labels(*labels).observe(
                 ctx.elapsed(), exemplar={"trace_id": ctx.id})
             obs_metrics.QUERIES_TOTAL.labels(*labels).inc()
-            # The trace lands in the per-node ring whatever the
-            # outcome — failed queries are the ones worth inspecting.
-            if trace is not None:
-                if ctx.cost is not None:
-                    # Cost roll-up as span args: the perfetto view of
-                    # this query carries its resource ledger.
-                    trace.add_span("query_cost", ctx.started_wall, 0.0,
-                                   tags=ctx.cost.summary())
-                self.tracer.keep(trace)
 
         # Optional column-attribute join (handler.go:208-227).
         attr_sets = []

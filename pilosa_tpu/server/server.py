@@ -29,17 +29,21 @@ from ..models.frame import FrameOptions
 from ..models.holder import Holder
 from ..models.index import IndexOptions
 from ..obs import accounting as obs_accounting
+from ..obs import blackbox as obs_blackbox
 from ..obs.metrics import RegistryStatsClient, default_registry
 from ..obs.profile import ContinuousProfiler
-from ..obs.runtime import RuntimeCollector
+from ..obs.runtime import RuntimeCollector, build_info
+from ..obs.sampler import TailSampler
 from ..obs.slo import SLOTracker
 from ..obs.trace import Tracer
+from ..obs.watchdog import Watchdog
 from ..proto import internal_pb2 as pb
 from ..sched import (AdmissionController, QueryRegistry, Warmup,
                      warmup_enabled)
 from ..utils import logger as logger_mod
-from ..utils.config import (FaultConfig, MetricsConfig, ProfileConfig,
-                            QueryConfig, SLOConfig, TraceConfig)
+from ..utils.config import (BlackboxConfig, FaultConfig, MetricsConfig,
+                            ProfileConfig, QueryConfig, SLOConfig,
+                            TraceConfig, WatchdogConfig)
 from ..utils.stats import NOP, MultiStatsClient
 from .handler import Handler
 from .httpd import HTTPServer
@@ -65,7 +69,9 @@ class Server:
                  profile_config: Optional[ProfileConfig] = None,
                  slo_config: Optional[SLOConfig] = None,
                  fault_config: Optional[FaultConfig] = None,
-                 gen_staleness_s: Optional[float] = None):
+                 gen_staleness_s: Optional[float] = None,
+                 blackbox_config: Optional[BlackboxConfig] = None,
+                 watchdog_config: Optional[WatchdogConfig] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -86,6 +92,14 @@ class Server:
         self.tracer = Tracer(enabled=self.trace_config.enabled,
                              max_traces=self.trace_config.max_traces,
                              max_spans=self.trace_config.max_spans)
+        # Tail sampling + flight recorder + stall watchdog (obs
+        # subsystem, docs/OBSERVABILITY.md): built in open() — the
+        # disk rings live under the holder data dir.
+        self.blackbox_config = blackbox_config or BlackboxConfig()
+        self.watchdog_config = watchdog_config or WatchdogConfig()
+        self.sampler: Optional[TailSampler] = None
+        self.blackbox: Optional[obs_blackbox.Blackbox] = None
+        self.watchdog: Optional[Watchdog] = None
         # Continuous profiler + SLO tracker (obs subsystem). The
         # accounting knob stays PER SERVER (threaded into the handler
         # and the batch lane) — a process-global flip here would let
@@ -245,6 +259,53 @@ class Server:
                 admission=self.admission,
                 interval_s=self.metrics_config.runtime_interval,
                 slo=self.slo, profiler=self.profiler)
+        # Publish build identity now that jax is loaded (the
+        # pilosa_build_info gauge + the /status build block).
+        build_info()
+        # Tail sampling (obs.sampler): always-on span buffers with an
+        # end-of-query keep decision; kept traces persist to a segment
+        # ring under the data dir that survives restarts.
+        if self.trace_config.tail:
+            from ..obs.diskring import SegmentRing
+            self.sampler = TailSampler(
+                disk=SegmentRing(
+                    os.path.join(self.holder.path, "traces"),
+                    segment_bytes=self.trace_config.disk_segment_bytes,
+                    max_segments=self.trace_config.disk_segments),
+                head_n=self.trace_config.head_n,
+                slow_floor_s=self.trace_config.slow_floor,
+                admission=self.admission)
+        # Blackbox flight recorder (obs.blackbox): periodic whole-
+        # system snapshots into a bounded disk ring; dumped in full on
+        # SIGTERM, fatal thread death, watchdog trip, or the API.
+        if self.blackbox_config.enabled:
+            self.blackbox = obs_blackbox.Blackbox(
+                os.path.join(self.holder.path, "blackbox"),
+                state_fn=self._blackbox_state,
+                interval_s=self.blackbox_config.interval,
+                segment_bytes=self.blackbox_config.segment_bytes,
+                max_segments=self.blackbox_config.segments,
+                max_dumps=self.blackbox_config.dumps,
+                node=self.host, logger=self.logger)
+            self.blackbox.start()
+            obs_blackbox.install_process_hooks()
+        # Stall watchdog (obs.watchdog): wedged WAL flusher, stuck
+        # legs, gossip silence, non-draining admission queue. A trip
+        # force-keeps in-flight traces and dumps the blackbox.
+        if self.watchdog_config.enabled:
+            self.watchdog = Watchdog(
+                registry=self.query_registry, admission=self.admission,
+                tracer=self.tracer, sampler=self.sampler,
+                blackbox=self.blackbox,
+                gossip_age_fn=self._gossip_age,
+                interval_s=self.watchdog_config.interval,
+                wal_stall_s=self.watchdog_config.wal_stall,
+                deadline_grace_s=self.watchdog_config.deadline_grace,
+                gossip_silence_s=self.watchdog_config.gossip_silence,
+                queue_stall_s=self.watchdog_config.queue_stall,
+                retrip_s=self.watchdog_config.retrip,
+                logger=self.logger)
+            self.watchdog.start()
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
@@ -257,7 +318,8 @@ class Server:
             tracer=self.tracer, runtime=self.runtime,
             profiler=self.profiler,
             accounting=self.metrics_config.accounting,
-            fault=self.fault)
+            fault=self.fault, sampler=self.sampler,
+            blackbox=self.blackbox, watchdog=self.watchdog)
 
         self._httpd = HTTPServer(self.handler, bind_host, port,
                                  logger=self.logger,
@@ -314,6 +376,12 @@ class Server:
     def close(self) -> None:
         self.logger.printf("server closing: %s", self.host)
         self._closing.set()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+        if self.blackbox is not None:
+            self.blackbox.stop()
+        if self.sampler is not None and self.sampler.disk is not None:
+            self.sampler.disk.close()
         if self.runtime is not None:
             self.runtime.stop()
         self.profiler.stop()
@@ -482,6 +550,51 @@ class Server:
         states map 1:1 onto health's liveness vocabulary)."""
         if self.fault is not None:
             self.fault.note_gossip(host, state)
+
+    # -- blackbox / watchdog wiring (obs subsystem) --------------------------
+
+    def _gossip_age(self) -> Optional[float]:
+        """Seconds of membership silence for the watchdog, or None
+        when not observable (static membership, single node)."""
+        ns = self.cluster.node_set if self.cluster is not None else None
+        if ns is None or not hasattr(ns, "last_activity_age"):
+            return None
+        return ns.last_activity_age()
+
+    def _blackbox_state(self) -> dict:
+        """One whole-system snapshot for the flight recorder: the
+        states an incident retro always wants and can never get after
+        the fact — queues, breakers, generation knowledge, the WAL
+        dirty set + flusher heartbeat, cache/runtime counters, recent
+        slow queries, and a thread dump."""
+        from ..storage import wal as storage_wal
+        from ..utils.profiling import thread_dump
+        out: dict = {"host": self.host,
+                     "admission": self.admission.snapshot(),
+                     "wal": storage_wal.flusher_health()}
+        if self.fault is not None:
+            out["fault"] = self.fault.snapshot()
+        out["generations"] = self.gens.snapshot()
+        reg = self.query_registry
+        out["queries"] = {"active": reg.active()[:32],
+                          "slow": reg.slow_queries()[-8:]}
+        if self.runtime is not None:
+            # The collector's last background sample (holder shape,
+            # residency, compile-cache, SLO burn) — cheap to reuse.
+            out["runtime"] = self.runtime.snapshot()
+        if self.executor is not None:
+            out["executor"] = {
+                "deviceFallbacks": getattr(self.executor,
+                                           "device_fallbacks", 0),
+                "costModelVetoes": getattr(self.executor,
+                                           "cost_vetoes", 0)}
+        if self.watchdog is not None:
+            out["watchdog"] = self.watchdog.snapshot()
+        try:
+            out["threads"] = thread_dump()[:20000]
+        except Exception:  # noqa: BLE001 - interpreter-internal API
+            pass
+        return out
 
     # -- slice announcements (view.go:236-246) -------------------------------
 

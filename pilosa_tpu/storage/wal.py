@@ -328,8 +328,16 @@ class GroupCommitWal:
 
 _dirty_mu = threading.Lock()
 _dirty: set = set()
+# When each dirty WAL first registered (monotonic): the watchdog's
+# wedged-flusher detector is "some WAL has been dirty longer than the
+# stall threshold" — a healthy flusher drains within ~one window.
+_dirty_since: dict = {}
 _flusher: Optional[threading.Thread] = None
 _flusher_wake = threading.Event()
+# Flusher heartbeat: stamped at the top of every flusher pass. A
+# heartbeat that stops while WALs stay dirty means the flusher thread
+# itself is wedged (stuck in a leader write), not merely idle.
+_flusher_beat = 0.0
 
 
 def _note_wait(seconds: float) -> None:
@@ -342,6 +350,7 @@ def _register_dirty(wal: GroupCommitWal) -> None:
     global _flusher
     with _dirty_mu:
         _dirty.add(wal)
+        _dirty_since.setdefault(wal, time.monotonic())
         if _flusher is None:
             _flusher = threading.Thread(target=_flush_loop,
                                         name="wal-group-flusher",
@@ -353,6 +362,36 @@ def _register_dirty(wal: GroupCommitWal) -> None:
 def _deregister_dirty(wal: GroupCommitWal) -> None:
     with _dirty_mu:
         _dirty.discard(wal)
+        _dirty_since.pop(wal, None)
+
+
+def flusher_health() -> dict:
+    """The WAL flusher's vital signs for the stall watchdog and the
+    blackbox: the dirty set with per-WAL pending bytes + dirty age
+    (worst first), the oldest dirty age, and the heartbeat age. All
+    reads are lock-leaf cheap — safe from a 1 Hz watchdog."""
+    now = time.monotonic()
+    with _dirty_mu:
+        items = [(w, t) for w, t in _dirty_since.items()
+                 if w in _dirty]
+        beat = _flusher_beat
+    wals = []
+    for w, t in items:
+        try:
+            pending = w.pending_bytes()
+        except Exception:  # noqa: BLE001 - a wedged WAL must still report
+            pending = -1
+        wals.append({"file": getattr(w._file, "name", None) or "?",
+                     "pendingBytes": pending,
+                     "dirtyAgeS": round(now - t, 4)})
+    wals.sort(key=lambda e: -e["dirtyAgeS"])
+    return {
+        "dirtyWals": len(wals),
+        "oldestDirtyAgeS": wals[0]["dirtyAgeS"] if wals else 0.0,
+        "flusherBeatAgeS": (round(now - beat, 4) if beat else None),
+        "windowS": window_s(),
+        "wals": wals[:8],
+    }
 
 
 def barrier_all() -> None:
@@ -371,11 +410,13 @@ def barrier_all() -> None:
 def _flush_loop() -> None:
     """Bounded-latency background flusher: any record a writer never
     barriers reaches the OS within ~one window (plus write time)."""
+    global _flusher_beat
     while True:
         _flusher_wake.wait()
         _flusher_wake.clear()
         time.sleep(window_s())
         with _dirty_mu:
+            _flusher_beat = time.monotonic()
             wals = list(_dirty)
         for wal in wals:
             if wal.closed:

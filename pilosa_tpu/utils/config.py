@@ -159,13 +159,57 @@ class FaultConfig:
 
 @dataclass
 class TraceConfig:
-    """[trace] section (obs subsystem): ``enabled`` turns on
-    distributed tracing for EVERY query (off by default — the nop
-    path allocates no spans; ``?trace=1`` opts in per request either
-    way); ``max_traces``/``max_spans`` bound the per-node ring."""
+    """[trace] section (obs subsystem): ``enabled`` keeps EVERY
+    query's trace (off by default; ``?trace=1`` opts in per request
+    either way); ``max_traces``/``max_spans`` bound the per-node ring.
+
+    Tail sampling (on by default — docs/OBSERVABILITY.md): ``tail``
+    gives every query the span buffer and keeps the interesting ones
+    at query end (slow / errored / deadline / cancelled / partial /
+    shed / breaker / failpoint / 1-in-``head_n`` head sample);
+    ``slow_floor`` floors the histogram-derived slow threshold. Kept
+    traces persist to a disk segment ring under the data dir bounded
+    by ``disk_segment_bytes`` × ``disk_segments`` (the retention
+    knobs), browsable via /debug/traces?source=disk."""
     enabled: bool = False
     max_traces: int = 64
     max_spans: int = 512
+    tail: bool = True
+    head_n: int = 1000
+    slow_floor: float = 0.1
+    disk_segment_bytes: int = 1 << 20
+    disk_segments: int = 8
+
+
+@dataclass
+class BlackboxConfig:
+    """[blackbox] section (obs.blackbox): the flight recorder.
+    ``interval`` paces the periodic whole-system snapshot;
+    ``segment_bytes`` × ``segments`` bound the on-disk ring;
+    ``dumps`` bounds the retained full-dump files."""
+    enabled: bool = True
+    interval: float = 10.0
+    segment_bytes: int = 256 << 10
+    segments: int = 4
+    dumps: int = 4
+
+
+@dataclass
+class WatchdogConfig:
+    """[watchdog] section (obs.watchdog): the stall watchdog.
+    ``interval`` paces the detectors; ``wal_stall`` is the WAL
+    dirty-age threshold, ``deadline_grace`` the past-deadline grace
+    for running legs, ``gossip_silence`` the membership-silence bound,
+    ``queue_stall`` the no-grant-while-queued bound; ``retrip`` rate-
+    limits repeat trips per cause (0 on any threshold disables that
+    detector)."""
+    enabled: bool = True
+    interval: float = 1.0
+    wal_stall: float = 5.0
+    deadline_grace: float = 5.0
+    gossip_silence: float = 60.0
+    queue_stall: float = 10.0
+    retrip: float = 60.0
 
 
 def _parse_bool(v) -> bool:
@@ -182,6 +226,8 @@ class Config:
     query: QueryConfig = field(default_factory=QueryConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
+    blackbox: BlackboxConfig = field(default_factory=BlackboxConfig)
+    watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
@@ -239,6 +285,27 @@ accounting = {str(self.metrics.accounting).lower()}
 enabled = {str(self.trace.enabled).lower()}
 max-traces = {self.trace.max_traces}
 max-spans = {self.trace.max_spans}
+tail = {str(self.trace.tail).lower()}
+head-n = {self.trace.head_n}
+slow-floor = "{dur(self.trace.slow_floor)}"
+disk-segment-bytes = {self.trace.disk_segment_bytes}
+disk-segments = {self.trace.disk_segments}
+
+[blackbox]
+enabled = {str(self.blackbox.enabled).lower()}
+interval = "{dur(self.blackbox.interval)}"
+segment-bytes = {self.blackbox.segment_bytes}
+segments = {self.blackbox.segments}
+dumps = {self.blackbox.dumps}
+
+[watchdog]
+enabled = {str(self.watchdog.enabled).lower()}
+interval = "{dur(self.watchdog.interval)}"
+wal-stall = "{dur(self.watchdog.wal_stall)}"
+deadline-grace = "{dur(self.watchdog.deadline_grace)}"
+gossip-silence = "{dur(self.watchdog.gossip_silence)}"
+queue-stall = "{dur(self.watchdog.queue_stall)}"
+retrip = "{dur(self.watchdog.retrip)}"
 
 [profile]
 continuous = {str(self.profile.continuous).lower()}
@@ -332,6 +399,38 @@ def load(path: str = "", env: dict | None = None) -> Config:
             cfg.trace.max_traces = int(t["max-traces"])
         if "max-spans" in t:
             cfg.trace.max_spans = int(t["max-spans"])
+        if "tail" in t:
+            cfg.trace.tail = _parse_bool(t["tail"])
+        if "head-n" in t:
+            cfg.trace.head_n = int(t["head-n"])
+        if "slow-floor" in t:
+            cfg.trace.slow_floor = parse_duration(t["slow-floor"])
+        if "disk-segment-bytes" in t:
+            cfg.trace.disk_segment_bytes = int(t["disk-segment-bytes"])
+        if "disk-segments" in t:
+            cfg.trace.disk_segments = int(t["disk-segments"])
+        bb = data.get("blackbox", {})
+        if "enabled" in bb:
+            cfg.blackbox.enabled = _parse_bool(bb["enabled"])
+        if "interval" in bb:
+            cfg.blackbox.interval = parse_duration(bb["interval"])
+        if "segment-bytes" in bb:
+            cfg.blackbox.segment_bytes = int(bb["segment-bytes"])
+        if "segments" in bb:
+            cfg.blackbox.segments = int(bb["segments"])
+        if "dumps" in bb:
+            cfg.blackbox.dumps = int(bb["dumps"])
+        wd = data.get("watchdog", {})
+        if "enabled" in wd:
+            cfg.watchdog.enabled = _parse_bool(wd["enabled"])
+        for key, attr in (("interval", "interval"),
+                          ("wal-stall", "wal_stall"),
+                          ("deadline-grace", "deadline_grace"),
+                          ("gossip-silence", "gossip_silence"),
+                          ("queue-stall", "queue_stall"),
+                          ("retrip", "retrip")):
+            if key in wd:
+                setattr(cfg.watchdog, attr, parse_duration(wd[key]))
         p = data.get("profile", {})
         if "continuous" in p:
             cfg.profile.continuous = _parse_bool(p["continuous"])
@@ -446,6 +545,43 @@ def load(path: str = "", env: dict | None = None) -> Config:
         cfg.trace.max_traces = int(env["PILOSA_TRACE_MAX_TRACES"])
     if env.get("PILOSA_TRACE_MAX_SPANS"):
         cfg.trace.max_spans = int(env["PILOSA_TRACE_MAX_SPANS"])
+    if env.get("PILOSA_TRACE_TAIL"):
+        cfg.trace.tail = _parse_bool(env["PILOSA_TRACE_TAIL"])
+    if env.get("PILOSA_TRACE_HEAD_N"):
+        cfg.trace.head_n = int(env["PILOSA_TRACE_HEAD_N"])
+    if env.get("PILOSA_TRACE_SLOW_FLOOR"):
+        cfg.trace.slow_floor = parse_duration(
+            env["PILOSA_TRACE_SLOW_FLOOR"])
+    if env.get("PILOSA_TRACE_DISK_SEGMENT_BYTES"):
+        cfg.trace.disk_segment_bytes = int(
+            env["PILOSA_TRACE_DISK_SEGMENT_BYTES"])
+    if env.get("PILOSA_TRACE_DISK_SEGMENTS"):
+        cfg.trace.disk_segments = int(env["PILOSA_TRACE_DISK_SEGMENTS"])
+    if env.get("PILOSA_BLACKBOX_ENABLED"):
+        cfg.blackbox.enabled = _parse_bool(env["PILOSA_BLACKBOX_ENABLED"])
+    if env.get("PILOSA_BLACKBOX_INTERVAL"):
+        cfg.blackbox.interval = parse_duration(
+            env["PILOSA_BLACKBOX_INTERVAL"])
+    if env.get("PILOSA_BLACKBOX_SEGMENT_BYTES"):
+        cfg.blackbox.segment_bytes = int(
+            env["PILOSA_BLACKBOX_SEGMENT_BYTES"])
+    if env.get("PILOSA_BLACKBOX_SEGMENTS"):
+        cfg.blackbox.segments = int(env["PILOSA_BLACKBOX_SEGMENTS"])
+    if env.get("PILOSA_BLACKBOX_DUMPS"):
+        cfg.blackbox.dumps = int(env["PILOSA_BLACKBOX_DUMPS"])
+    if env.get("PILOSA_WATCHDOG_ENABLED"):
+        cfg.watchdog.enabled = _parse_bool(env["PILOSA_WATCHDOG_ENABLED"])
+    for env_key_, attr_ in (("PILOSA_WATCHDOG_INTERVAL", "interval"),
+                            ("PILOSA_WATCHDOG_WAL_STALL", "wal_stall"),
+                            ("PILOSA_WATCHDOG_DEADLINE_GRACE",
+                             "deadline_grace"),
+                            ("PILOSA_WATCHDOG_GOSSIP_SILENCE",
+                             "gossip_silence"),
+                            ("PILOSA_WATCHDOG_QUEUE_STALL",
+                             "queue_stall"),
+                            ("PILOSA_WATCHDOG_RETRIP", "retrip")):
+        if env.get(env_key_):
+            setattr(cfg.watchdog, attr_, parse_duration(env[env_key_]))
     if env.get("PILOSA_PLUGINS_PATH"):
         cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
     if env.get("PILOSA_FAULT_ENABLED"):

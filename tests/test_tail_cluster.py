@@ -1,0 +1,213 @@
+"""Tail sampling on a REAL 2-node gossip cluster — the acceptance
+path of the always-on observability PR: a deadline-exceeded query
+(with tracing OFF — tail sampling is the default) yields a kept,
+stitched, disk-persisted trace with keep reason ``deadline``,
+retrievable via ``/debug/traces?source=disk`` after the coordinator is
+SIGKILLed and restarted."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+from podenv import cpu_env, free_port, wait_up  # noqa: E402
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+
+
+def _post(host, path, body=b"", timeout=30):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get_json(host, path, timeout=10):
+    with urllib.request.urlopen(f"http://{host}{path}",
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Two gossip-joined nodes, 4 slices of data, tracing NOT enabled
+    — the tail sampler (default-on) is what must catch the incident.
+    The coordinator's spawn closure is yielded so the test can SIGKILL
+    and resurrect it on the same data dir."""
+    pa, pb = free_port(), free_port()
+    ga, gb = free_port(), free_port()
+    hosts = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+    procs, logs = {}, []
+
+    def spawn(name, port, internal, seed=""):
+        d = tmp_path / name
+        d.mkdir(exist_ok=True)
+        env = cpu_env()
+        env["PILOSA_TPU_MESH"] = "0"
+        env["PILOSA_TPU_WARMUP"] = "0"
+        # Force real fan-out every time (the hot-query and result-
+        # residency caches would serve repeats without remote legs to
+        # stitch — the convergence loop primes both).
+        env["PILOSA_QUERY_CLUSTER_CACHE_ENTRIES"] = "0"
+        env["PILOSA_QUERY_RESULT_CACHE_ENTRIES"] = "0"
+        log = open(tmp_path / f"{name}.log", "a")
+        logs.append(log)
+        argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                "-d", str(d), "-b", f"127.0.0.1:{port}",
+                "--cluster.type", "gossip",
+                "--cluster.hosts", hosts,
+                "--cluster.replicas", "1",
+                "--cluster.internal-port", str(internal),
+                "--anti-entropy.interval", "300s"]
+        if seed:
+            argv += ["--cluster.gossip-seed", seed]
+        p = subprocess.Popen(argv, env=env, stdout=log, stderr=log,
+                             cwd=os.path.dirname(_HERE))
+        procs[name] = p
+        wait_up(f"127.0.0.1:{port}")
+        return f"127.0.0.1:{port}"
+
+    host_a = spawn("a", pa, ga)
+    host_b = spawn("b", pb, gb, seed=f"127.0.0.1:{ga}")
+    _post(host_a, "/index/tl", b"{}")
+    _post(host_a, "/index/tl/frame/f", b"{}")
+
+    import numpy as np
+
+    from pilosa_tpu.cluster.client import Client
+    client = Client(host_a)
+    cols = np.arange(0, 4 * SLICE_WIDTH,
+                     SLICE_WIDTH // 8).astype(np.uint64)
+    client.import_arrays("tl", "f", np.ones(len(cols), np.uint64),
+                         cols)
+
+    deadline = time.time() + 30
+    got = None
+    while time.time() < deadline:
+        with _post(host_a, "/index/tl/query",
+                   b'Count(Bitmap(frame="f", rowID=1))') as r:
+            got = json.loads(r.read())["results"][0]
+        if got == len(cols):
+            break
+        time.sleep(0.3)
+    assert got == len(cols), got
+
+    yield {"a": host_a, "b": host_b, "procs": procs,
+           "respawn_a": lambda: spawn("a", pa, ga,
+                                      seed=f"127.0.0.1:{gb}"),
+           "n_bits": len(cols)}
+
+    for p in procs.values():
+        try:
+            p.send_signal(signal.SIGINT)
+        except OSError:
+            pass
+    for p in procs.values():
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for log in logs:
+        log.close()
+
+
+def test_deadline_exceeded_query_persists_stitched_trace_across_restart(
+        cluster):
+    host_a, host_b = cluster["a"], cluster["b"]
+
+    # Slow every fan-out RPC leg by 350 ms (rpc.recv delay — the
+    # response arrives, the delay burns budget, then the spans
+    # stitch), and give a two-call query a 600 ms budget: call 1
+    # completes and stitches the remote leg's spans (~355 ms), call 2
+    # is still mid-RPC when the fan-out loop's deadline poll fires
+    # past 600 ms → QueryDeadlineError → 504. The delay must exceed
+    # the executor's 250 ms poll tick so a check lands while the leg
+    # is pending (a leg that completes between checks still answers).
+    with _post(host_a, "/debug/failpoints",
+               json.dumps({"site": "rpc.recv",
+                           "spec": "delay(350ms)"}).encode()):
+        pass
+    qid = None
+    try:
+        q = (b'Count(Bitmap(frame="f", rowID=1))'
+             b'Count(Bitmap(frame="f", rowID=1))')
+        try:
+            with _post(host_a,
+                       "/index/tl/query?timeout=600ms", q) as r:
+                qid = r.headers["X-Pilosa-Query-Id"]
+                status = r.status
+        except urllib.error.HTTPError as e:
+            qid = e.headers["X-Pilosa-Query-Id"]
+            status = e.code
+            e.read()
+        assert status == 504, status
+        assert qid
+    finally:
+        with _post(host_a, "/debug/failpoints",
+                   json.dumps({"site": "rpc.recv",
+                               "spec": "off"}).encode()):
+            pass
+
+    # Kept in the ring with the deadline reason, remote spans stitched.
+    listing = _get_json(host_a, "/debug/traces?reason=deadline")
+    entry = next(t for t in listing["traces"] if t["id"] == qid)
+    assert entry["reason"] == "deadline"
+    assert host_b in entry["nodes"], entry
+
+    # Persisted to disk with the same shape.
+    disk = _get_json(host_a,
+                     "/debug/traces?source=disk&reason=deadline")
+    assert any(t["id"] == qid for t in disk["traces"]), disk
+
+    # SIGKILL the coordinator (no orderly close — the disk ring's
+    # crash-safety is part of the contract) and resurrect it.
+    proc_a = cluster["procs"]["a"]
+    proc_a.kill()
+    proc_a.wait(timeout=20)
+    host_a = cluster["respawn_a"]()
+
+    # The in-memory ring is gone; the disk ring survived the restart.
+    disk = _get_json(host_a,
+                     "/debug/traces?source=disk&reason=deadline")
+    entry = next(t for t in disk["traces"] if t["id"] == qid)
+    assert entry["reason"] == "deadline"
+    assert host_b in entry["nodes"], entry
+
+    # The full trace is still addressable by id (disk fallback) and
+    # exports as perfetto-loadable Chrome JSON with BOTH nodes.
+    chrome = _get_json(host_a, f"/debug/traces/{qid}?source=disk")
+    assert chrome["otherData"]["traceId"] == qid
+    pid_names = {e["args"]["name"] for e in chrome["traceEvents"]
+                 if e["name"] == "process_name"}
+    assert {host_a, host_b} <= pid_names, pid_names
+
+    # The restarted node records fresh disk writes under the new
+    # family — the persisted-trace counter survives as a contract.
+    with urllib.request.urlopen(f"http://{host_a}/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    assert "pilosa_trace_disk_records_total" in text
+
+
+def test_build_info_served_and_status_block(cluster):
+    host_a = cluster["a"]
+    with urllib.request.urlopen(f"http://{host_a}/metrics",
+                                timeout=10) as r:
+        text = r.read().decode()
+    line = next(ln for ln in text.splitlines()
+                if ln.startswith("pilosa_build_info{"))
+    assert 'version="' in line and 'python="' in line \
+        and 'jax="' in line and 'backend="' in line
+    assert line.rstrip().endswith(" 1")
+    status = _get_json(host_a, "/status")
+    build = status["build"]
+    assert build["version"] and build["python"]
+    assert build["jax"] not in ("", "unloaded")
