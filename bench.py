@@ -309,6 +309,21 @@ def main() -> None:
                     "chain_miss_ms": dt.get("chain_miss_ms"),
                     "generations_rtt_ms": dt.get(
                         "generations_rtt_ms")}
+            # Elastic resize under load (suite.config_resize →
+            # RESIZE.json): resize duration + query p99 inflation
+            # during the migration — ROADMAP item 5's acceptance
+            # numbers on the line of record.
+            rz = manifest.get("resize") or {}
+            if rz.get("resize_duration_s") is not None:
+                line["resize"] = {
+                    "duration_s": rz["resize_duration_s"],
+                    "p99_inflation": rz.get("p99_inflation"),
+                    "during_p99_ms": rz.get("during_p99_ms"),
+                    "baseline_p99_ms": rz.get("baseline_p99_ms"),
+                    "bytes_streamed": rz.get("bytes_streamed"),
+                    "slices_moved": rz.get("slices_moved"),
+                    "zero_wrong_answers": rz.get(
+                        "zero_wrong_answers")}
         except (OSError, ValueError, KeyError):
             pass
         # Serving-quality artifact (sched subsystem): open-loop

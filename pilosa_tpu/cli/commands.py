@@ -310,7 +310,9 @@ def cmd_server(args, stdout, stderr) -> int:
                     fault_config=cfg.fault,
                     gen_staleness_s=cfg.cluster.gen_staleness,
                     blackbox_config=cfg.blackbox,
-                    watchdog_config=cfg.watchdog)
+                    watchdog_config=cfg.watchdog,
+                    resize_pace_s=cfg.cluster.resize_pace,
+                    resize_grace_s=cfg.cluster.resize_grace)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -554,6 +556,79 @@ def cmd_config(args, stdout, stderr) -> int:
     return 0
 
 
+def cmd_resize(args, stdout, stderr) -> int:
+    """Operator face of the online resize (docs/CLUSTER_RESIZE.md):
+    POST /cluster/resize on any member to start/abort, GET to watch."""
+    import json as json_mod
+    import urllib.request
+
+    def get_status():
+        with urllib.request.urlopen(
+                f"http://{args.host}/cluster/resize", timeout=10) as r:
+            return json_mod.loads(r.read())
+
+    def post(body: dict):
+        req = urllib.request.Request(
+            f"http://{args.host}/cluster/resize",
+            data=json_mod.dumps(body).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json_mod.loads(r.read())
+
+    if args.status:
+        print(json_mod.dumps(get_status(), indent=1), file=stdout)
+        return 0
+    if args.abort:
+        print(json_mod.dumps(post({"abort": True}), indent=1),
+              file=stdout)
+        return 0
+    body: dict = {}
+    if args.hosts:
+        body["hosts"] = [h.strip() for h in args.hosts.split(",")
+                         if h.strip()]
+    elif args.add:
+        body["add"] = args.add
+    elif args.remove:
+        body["remove"] = args.remove
+    else:
+        print("resize: one of --add/--remove/--hosts/--abort/--status"
+              " required", file=stderr)
+        return 1
+    status = post(body)
+    print(json_mod.dumps(status, indent=1), file=stdout)
+    if not args.wait:
+        return 0
+    rid = (status.get("op") or {}).get("id") or status.get("id")
+    # Transient poll failures (a node busy streaming, a coordinator
+    # restart mid-recovery) keep waiting; only a sustained outage or
+    # the overall deadline gives up. An absent op is NOT terminal —
+    # journal recovery re-registers it.
+    deadline = time.time() + 1800
+    misses = 0
+    while time.time() < deadline:
+        time.sleep(0.5)
+        try:
+            s = get_status()
+        except Exception as e:  # noqa: BLE001 - transient poll error
+            misses += 1
+            if misses >= 60:
+                print(f"resize {rid}: status unreachable: {e}",
+                      file=stderr)
+                return 1
+            continue
+        misses = 0
+        op = s.get("op") or {}
+        phase = op.get("phase", "")
+        print(f"resize {rid}: {phase or '(pending)'} "
+              f"(slices={op.get('slicesMoved', 0)},"
+              f" bytes={op.get('bytesStreamed', 0)})", file=stdout,
+              flush=True)
+        if phase in ("done", "aborted"):
+            return 0 if phase == "done" else 1
+    print(f"resize {rid}: wait timed out", file=stderr)
+    return 1
+
+
 def build_parser() -> argparse.ArgumentParser:
     from .. import __version__
     p = argparse.ArgumentParser(
@@ -740,6 +815,25 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--op", default="", help="benchmark operation"
                                             " (set-bit)")
     c.add_argument("-n", type=int, default=0, help="operation count")
+
+    c = sub.add_parser(
+        "resize", help="drive / inspect an elastic cluster resize")
+    c.add_argument("--host", default="localhost:10101",
+                   help="any current cluster member (it coordinates)")
+    c.add_argument("--add", default="",
+                   help="host:port joining the cluster")
+    c.add_argument("--remove", default="",
+                   help="host:port leaving the cluster")
+    c.add_argument("--hosts", default="",
+                   help="explicit target membership (comma-separated;"
+                        " overrides --add/--remove)")
+    c.add_argument("--abort", action="store_true",
+                   help="abort the in-flight resize")
+    c.add_argument("--status", action="store_true",
+                   help="print resize status and exit")
+    c.add_argument("--wait", action="store_true",
+                   help="poll until the resize settles")
+    c.set_defaults(fn=cmd_resize)
 
     c = sub.add_parser("config", help="print default configuration")
     c.set_defaults(fn=cmd_config)

@@ -907,6 +907,39 @@ class Client:
         return (np.array(resp.RowIDs, dtype=np.uint64),
                 np.array(resp.ColumnIDs, dtype=np.uint64))
 
+    def fragment_import(self, index: str, frame: str, view: str,
+                        slice: int, positions: np.ndarray,
+                        host: Optional[str] = None) -> None:
+        """Additive per-fragment import of slice-local bit positions
+        (row*SLICE_WIDTH + col%SLICE_WIDTH) — the resize streamer's
+        push lane (POST /fragment/import): unlike the /fragment/data
+        restore it never replaces content (concurrent double-writes
+        land between a diff read and this push), and unlike /import it
+        applies to the EXACT (frame, view) fragment so time and
+        inverse views migrate byte-faithfully. Idempotent (re-adding
+        set bits is a no-op), so torn streams re-push safely."""
+        body = np.asarray(positions, dtype="<u8").tobytes()
+        status, raw = self._do(
+            "POST", f"/fragment/import?index={index}&frame={frame}"
+                    f"&view={view}&slice={slice}", body,
+            {"Content-Type": "application/octet-stream"}, host=host,
+            idempotent=True)
+        if status == 404:
+            raise FragmentNotFoundError()
+        self._ok(status, raw, "fragment import")
+
+    def post_message(self, data: bytes,
+                     host: Optional[str] = None,
+                     deadline_s: Optional[float] = None) -> None:
+        """POST one marshaled broadcast envelope to a node's
+        /messages — the resize coordinator's DIRECT, acked control
+        sends (a 200 is the node's ack; any failure raises)."""
+        status, raw = self._do(
+            "POST", "/messages", data,
+            {"Content-Type": "application/x-protobuf"}, host=host,
+            idempotent=True, deadline_s=deadline_s)
+        self._ok(status, raw, "post message")
+
     def column_attr_diff(self, index: str, blocks: list[tuple[int, bytes]],
                          host: Optional[str] = None) -> dict[int, dict]:
         return self._attr_diff(f"/index/{index}/attr/diff", blocks, host)

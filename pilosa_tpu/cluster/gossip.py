@@ -676,8 +676,19 @@ class GossipNodeSet:
             except Exception as e:  # noqa: BLE001 - status is best-effort
                 self.logger.printf("gossip: error getting local state:"
                                    " %s", e)
-        return {"t": "pushpull", "members": members,
-                "status_pb": status_b64}
+        out = {"t": "pushpull", "members": members,
+               "status_pb": status_b64}
+        # Elastic-resize convergence (cluster.resize): the placement
+        # epoch + in-flight/last-settled resize ride the push/pull, so
+        # a node that missed the coordinator's control sends
+        # (partitioned, restarted) converges within one exchange.
+        if self._handler is not None and hasattr(
+                self._handler, "resize_wire_state"):
+            try:
+                out["resize_state"] = self._handler.resize_wire_state()
+            except Exception:  # noqa: BLE001 - piggyback best-effort
+                pass
+        return out
 
     def _absorb_state(self, state: dict) -> None:
         """MergeRemoteState (gossip.go:208-222)."""
@@ -687,6 +698,13 @@ class GossipNodeSet:
                 self._merge_member(Member.from_wire(w))
             except (KeyError, ValueError):
                 continue
+        rz = state.get("resize_state")
+        if rz and self._handler is not None and hasattr(
+                self._handler, "apply_resize_wire_state"):
+            try:
+                self._handler.apply_resize_wire_state(rz)
+            except Exception as e:  # noqa: BLE001 - merge best-effort
+                self.logger.printf("gossip: resize merge error: %s", e)
         status_b64 = state.get("status_pb")
         if status_b64 and self._handler is not None and hasattr(
                 self._handler, "handle_remote_status"):

@@ -547,12 +547,16 @@ class Executor:
         path: every SetBit/import/anti-entropy leg applies to EVERY
         replica owner, so an owned slice's local fragment (and its
         mutation generation, for the residency keys) tracks all
-        writes."""
-        if len(self.cluster.nodes) == 1:
+        writes. During an elastic resize this is READ authority, not
+        the write-accept union — a stream target's copy is incomplete
+        until the flip, so it must not claim local fast paths for a
+        moving slice (cluster.topology.read_allowed)."""
+        if (len(self.cluster.nodes) == 1
+                and self.cluster.resize is None):
             return True
         host = self.host
-        owns = self.cluster.owns_fragment
-        return all(owns(host, index, s) for s in slices)
+        allowed = self.cluster.read_allowed
+        return all(allowed(host, index, s) for s in slices)
 
     # -- coordinator hot-query result cache (cluster.generations) -----------
 
@@ -582,7 +586,11 @@ class Executor:
                 or self.client is None
                 or not hasattr(self.client, "generations")
                 or self.pod is not None or opt.remote or opt.partial
-                or not slices or len(self.cluster.nodes) < 2):
+                or not slices or len(self.cluster.nodes) < 2
+                or self.cluster.resize is not None):
+            # An in-flight resize declines caching outright: moving
+            # slices' serving peers are in flux and a token snapshot
+            # cannot attribute results to one placement.
             return None
         for call in query.calls:
             if (call.name in _WRITE_CALLS or call.name == "Bitmap"
@@ -590,7 +598,12 @@ class Executor:
                 return None
         if self._owns_all_slices(index, slices):
             return None
-        return (index, str(query), tuple(slices))
+        # The placement epoch is part of the key: after a resize flips,
+        # a moved slice is served by a DIFFERENT peer whose fresh uid
+        # must never validate an entry cached under the old owner's
+        # tokens (the old owner's copy freezes and would validate
+        # forever).
+        return (index, str(query), tuple(slices), self.cluster.epoch)
 
     def _cluster_cache_lookup(self, key: tuple, index: str,
                               opt: ExecOptions) -> Optional[list]:
@@ -677,7 +690,11 @@ class Executor:
         None (the query can't be cached this round; its own legs
         populate the map for the next one)."""
         from .cluster import generations as gens_mod
-        owns = self.cluster.owns_fragment
+        # READ authority (see _bitmap_result_key): a moved slice this
+        # node merely write-accepts during the post-resize grace must
+        # snapshot the SERVING owner's tokens, never the local frozen
+        # copy's.
+        owns = self.cluster.read_allowed
         local: dict = {}
         remote: dict = {}
         for s in slices:
@@ -688,6 +705,14 @@ class Executor:
             if got is None:
                 return None
             peer, toks, _ts = got
+            # The freshest map entry can belong to a peer that no
+            # longer SERVES the slice (an old owner whose copy froze
+            # at a resize finalize): an entry snapshotted under its
+            # tokens would validate forever. Only read-authoritative
+            # peers key cache entries; otherwise the query stays
+            # uncached this round.
+            if not self.cluster.read_allowed(peer, index, s):
+                return None
             remote.setdefault(peer, {})[s] = dict(toks)
         return {"local": local, "remote": remote}
 
@@ -723,6 +748,29 @@ class Executor:
             cache.move_to_end(key)
             while len(cache) > self._cluster_cache_entries:
                 cache.popitem(last=False)
+
+    def on_resize_change(self, moved_fn=None) -> None:
+        """Called on every resize transition this node observes
+        (prepare / flip / finalize / abort — server.receive_message).
+        Drops cached artifacts whose placement assumptions a resize
+        breaks: the write fast-lane fragment cache (its single-node
+        precondition), and — given ``moved_fn(index, slice) -> bool``
+        — every result-residency and cluster-cache entry touching a
+        moved slice (ISSUE 12 satellite: a moved slice served by a
+        new peer with a fresh uid must never validate a stale entry;
+        the epoch baked into both key shapes is the backstop, this is
+        the eager flush)."""
+        self._wfast_frag.clear()
+        if moved_fn is None:
+            return
+        with self._bitmap_results_mu:
+            for key in [k for k in self._bitmap_results
+                        if any(moved_fn(k[0], s) for s in k[2])]:
+                self._bitmap_results.pop(key, None)
+        with self._cluster_cache_mu:
+            for key in [k for k in self._cluster_cache
+                        if any(moved_fn(k[0], s) for s in k[2])]:
+                self._cluster_cache.pop(key, None)
 
     # -- bitmap expressions (executor.go:192-570) ----------------------------
 
@@ -771,9 +819,20 @@ class Executor:
             return None
         if self.pod is not None:
             return None
+        if self.cluster.resize is not None:
+            # In-flight resize: moving slices' serving peers are in
+            # flux (double-reads, mid-flip ownership) — uncached until
+            # the epoch settles.
+            return None
         owner_of: dict[int, str] = {}
         if len(self.cluster.nodes) > 1:
-            owns = self.cluster.owns_fragment
+            # READ authority, not the write-accept union: inside the
+            # post-resize grace window an old owner still write-
+            # ACCEPTS a moved slice, but its copy no longer receives
+            # single-path writes — keying on the frozen local fragment
+            # would validate stale results forever (caught by the
+            # resize verify drive).
+            owns = self.cluster.read_allowed
             host = self.host
             for s in slices:
                 if owns(host, index, s):
@@ -807,7 +866,11 @@ class Executor:
                 f = self.holder.fragment(index, frame, view, s)
                 gens.append(("", f.device.uid, f.device.generation)
                             if f is not None else ("", 0, 0))
-        return (index, expr, tuple(slices), tuple(gens))
+        # Epoch in the key: a slice that moved in a resize is served
+        # by a different peer afterwards — entries keyed under the old
+        # epoch's owners must never match post-flip lookups.
+        return (index, expr, tuple(slices), tuple(gens),
+                self.cluster.epoch)
 
     def _share_result(self, bm: Bitmap) -> Bitmap:
         """COW handout of a cached result (mutating callers copy,
@@ -3079,7 +3142,10 @@ class Executor:
         if ent is None or not ent[2] or not ent[1]._open:
             return None
         nodes = self.cluster.nodes
-        if len(nodes) != 1 or nodes[0].host != self.host:
+        if (len(nodes) != 1 or nodes[0].host != self.host
+                or self.cluster.resize is not None):
+            # An in-flight resize (1→2 grow) means even a single-node
+            # cluster's writes must fan to the union — generic path.
             return None
         if opt.ctx is not None:
             opt.ctx.check()
@@ -3108,7 +3174,8 @@ class Executor:
         # also owns every error message.
         args = c.args
         if ("timestamp" not in args and not args.get("view")
-                and self.pod is None):
+                and self.pod is None
+                and self.cluster.resize is None):
             nodes = self.cluster.nodes
             if len(nodes) == 1 and nodes[0].host == self.host:
                 idx = self.holder.index(index)
@@ -3405,7 +3472,15 @@ class Executor:
         so the first query after a peer dies pays one timeout, and
         every query after it routes around the open circuit without
         paying anything. ``missing`` (partial mode) collects slices
-        with no owner among ``nodes`` instead of raising."""
+        with no owner among ``nodes`` instead of raising.
+
+        Owners come from ``read_nodes`` — READ authority, which equals
+        plain placement except during an elastic resize, where a
+        stream target's incomplete copy must not serve. This is also
+        the server-side fence: a remote leg asking a mid-migration
+        target for a moving slice fails here, which is what lets the
+        coordinator's double-read treat a successful target leg as
+        proof the target considers itself authoritative."""
         fault = self.fault
         m: dict[int, tuple[Node, list[int]]] = {}
         # Placement ordering memo: PARTITION_N bounds the distinct
@@ -3414,7 +3489,7 @@ class Executor:
         # instead of one per slice.
         order_memo: dict[tuple, list[Node]] = {}
         for slice in slices:
-            owners = self.cluster.fragment_nodes(index, slice)
+            owners = self.cluster.read_nodes(index, slice)
             if fault is not None and len(owners) > 1:
                 key = tuple(id(n) for n in owners)
                 ordered = order_memo.get(key)
@@ -3432,6 +3507,183 @@ class Executor:
                     continue
                 raise SliceUnavailableError(str(slice))
         return list(m.values())
+
+    # -- elastic-resize double reads (cluster.resize) ------------------------
+
+    def _resize_moving_groups(self, index: str, slices: list[int]):
+        """``{(old_hosts, new_hosts): [slices]}`` for the slices of
+        ``slices`` sitting in MIGRATING partitions of an in-flight
+        resize, or None when there are none (the hot-path answer —
+        one attr read when no resize is in flight)."""
+        from .cluster.topology import RESIZE_MIGRATING
+        cl = self.cluster
+        if cl.resize is None:
+            return None
+        groups: dict[tuple, list[int]] = {}
+        for s in slices:
+            mv = cl.moving_slice(index, s)
+            if mv is None or mv[0] != RESIZE_MIGRATING:
+                continue
+            groups.setdefault((mv[1], mv[2]), []).append(s)
+        return groups or None
+
+    def _double_read_side(self, hosts, index: str, c: Call,
+                          slices: list[int], opt: ExecOptions,
+                          map_fn, reduce_fn, local_fn,
+                          gens_out: list):
+        """One side of a double-read: try each candidate owner in turn
+        (local legs compute in-process, remote legs forward with
+        private token custody). Raises the last error when every
+        candidate failed."""
+        cl = self.cluster
+        last: Optional[Exception] = None
+        ordered = list(hosts)
+        if self.fault is not None and len(ordered) > 1:
+            ordered = sorted(
+                ordered,
+                key=lambda h: 0 if (h == self.host
+                                    or self.fault.would_allow(h))
+                else 1)
+        for host in ordered:
+            try:
+                if host == self.host:
+                    # The read-authority fence applies to the local leg
+                    # exactly as _slices_by_node applies it to remote
+                    # ones: a mid-migration target must not serve its
+                    # incomplete copy, even to itself.
+                    if not all(cl.read_allowed(host, index, s)
+                               for s in slices):
+                        raise SliceUnavailableError(
+                            f"{host} not read-authoritative for"
+                            f" {slices}")
+                    with sched_context.use(opt.ctx):
+                        if local_fn is not None:
+                            r = local_fn(slices)
+                            if r is not NotImplemented:
+                                return r
+                        return self._mapper_local(slices, map_fn,
+                                                  reduce_fn)
+                node = cl.node_by_host(host) or Node(host)
+                rs = self._exec_remote(node, index, Query([c]), slices,
+                                       opt, gens_out=gens_out)
+                return rs[0] if rs else None
+            except (QueryDeadlineError, QueryCancelledError):
+                raise
+            except Exception as e:  # noqa: BLE001 - next candidate
+                last = e
+        raise last if last is not None else SliceUnavailableError(
+            str(slices))
+
+    def _target_tokens_newest(self, index: str, slices: list[int],
+                              gens_list: list) -> bool:
+        """The double-read's newest-token-wins check: before the
+        TARGET side's answer is accepted, its piggybacked (uid, gen)
+        tokens must be at least as new as the map's freshest knowledge
+        of each slice — a straggling or rolled-back target (same uid,
+        LOWER generation than previously observed) can never win. A
+        fresh uid (reopened fragment) reads as newest: its on-disk
+        state is the durable acked state."""
+        if self.gens is None:
+            return True
+        from .cluster import generations as gens_mod
+        fresh: dict[int, dict] = {}
+        peers: dict[int, str] = {}
+        for peer, payload in gens_list:
+            decoded = gens_mod.decode_wire(payload)
+            if decoded is None:
+                continue
+            idx, tokens = decoded
+            if idx != index:
+                continue
+            for s, toks in tokens.items():
+                fresh[s] = toks
+                peers[s] = peer
+        for s in slices:
+            toks = fresh.get(s)
+            if toks is None:
+                continue  # target reported nothing: nothing to refute
+            known = self.gens.tokens(peers.get(s, ""), index, s,
+                                     max_age_s=float("inf"))
+            if not known:
+                continue
+            for fk, (uid, gen) in known.items():
+                got = toks.get(fk)
+                if got is not None and got[0] == uid and got[1] < gen:
+                    return False
+        return True
+
+    def _exec_double_read(self, index: str, c: Call, slices: list[int],
+                          old_hosts, new_hosts, opt: ExecOptions,
+                          map_fn, reduce_fn, local_fn=None):
+        """A moving slice group's fan-out during the MIGRATING phase
+        of an elastic resize (docs/CLUSTER_RESIZE.md): both owner
+        sides are queried concurrently —
+
+        - the OLD side is authoritative pre-flip (its copy has every
+          bit; the stream target's may not) and wins whenever it
+          answers;
+        - the NEW side can only answer after it has flipped (the
+          read-authority fence in _slices_by_node makes a
+          mid-migration target refuse the leg), so a successful target
+          answer is proof the epoch advanced under this query — the
+          exact window the double-read exists for. It wins only when
+          the old side failed AND its piggybacked generation tokens
+          are the newest the coordinator map has seen for every slice.
+
+        Token custody follows the hedged-read discipline: each side
+        collects privately; ONLY the winner's tokens merge into the
+        coordinator map."""
+        pool = self._pool("hedge")
+        gens_old: list = []
+        gens_new: list = []
+        f_old = pool.submit(self._double_read_side, old_hosts, index,
+                            c, slices, opt, map_fn, reduce_fn,
+                            local_fn, gens_old)
+        f_new = pool.submit(self._double_read_side, new_hosts, index,
+                            c, slices, opt, map_fn, reduce_fn,
+                            local_fn, gens_new)
+        ctx = opt.ctx
+        try:
+            while True:
+                if ctx is not None:
+                    ctx.check()
+                if f_old.done():
+                    break
+                wait([f_old], timeout=(self._CTX_POLL_S
+                                       if ctx is not None else None))
+        except BaseException:
+            f_old.cancel()
+            f_new.cancel()
+            raise
+        try:
+            result = f_old.result()
+        except (QueryDeadlineError, QueryCancelledError):
+            f_new.cancel()
+            raise
+        except Exception as old_err:  # noqa: BLE001 - target may win
+            try:
+                while not f_new.done():
+                    if ctx is not None:
+                        ctx.check()
+                    wait([f_new],
+                         timeout=(self._CTX_POLL_S
+                                  if ctx is not None else None))
+                result = f_new.result()
+            except (QueryDeadlineError, QueryCancelledError):
+                raise
+            except Exception:  # noqa: BLE001 - both sides dead
+                raise old_err
+            if not self._target_tokens_newest(index, slices, gens_new):
+                raise old_err
+            obs_metrics.RESIZE_DOUBLE_READS.labels("target").inc()
+            self._apply_remote_gens(gens_new)
+            return result
+        obs_metrics.RESIZE_DOUBLE_READS.labels("source").inc()
+        self._apply_remote_gens(gens_old)
+        # The losing target leg is abandoned, not awaited: its socket
+        # timeouts are budget-clamped and its tokens never merge.
+        f_new.cancel()
+        return result
 
     # Wake tick of the fan-out wait loop for lifecycle-bound queries:
     # bounds how long a cancellation or deadline expiry can go unseen
@@ -3488,6 +3740,27 @@ class Executor:
         def submit(nodes, slices):
             nonlocal processed
             before = len(missing) if missing is not None else 0
+            # Elastic resize, migrating phase: moving slices fan out as
+            # DOUBLE-READ legs (old owner authoritative, new owner the
+            # fenced fallback) instead of riding the normal grouping —
+            # health ordering must never route a read to a target whose
+            # copy is still streaming. The sentinel node None marks
+            # these futures: their failover lives inside the leg, so
+            # the outer re-map must not retry them.
+            if not opt.remote and self.cluster.resize is not None:
+                groups = self._resize_moving_groups(index, slices)
+                if groups:
+                    moved = set()
+                    for (old_hosts, new_hosts), group in groups.items():
+                        moved.update(group)
+                        fut = pool.submit(
+                            self._exec_double_read, index, c, group,
+                            old_hosts, new_hosts, opt, map_fn,
+                            reduce_fn, local_fn)
+                        futures[fut] = (None, group)
+                        if ctx is not None:
+                            ctx.add_leg("double-read", len(group))
+                    slices = [s for s in slices if s not in moved]
             for node, node_slices in self._slices_by_node(
                     nodes, index, slices, missing=missing):
                 fut = pool.submit(self._mapper_node, node, index, c,
@@ -3534,6 +3807,21 @@ class Executor:
                         # re-map — surface it (handler maps to 504/409).
                         raise
                     except Exception as e:  # noqa: BLE001 - retry replicas
+                        if node is None:
+                            # A double-read leg already exhausted both
+                            # sides of the migration (old owners AND
+                            # the fenced new owner) — there is no
+                            # further replica to re-map onto. Partial
+                            # mode keeps its contract: the slices are
+                            # reported missing instead of failing the
+                            # query.
+                            if missing is not None:
+                                missing.extend(node_slices)
+                                processed += len(node_slices)
+                                if ctx is not None:
+                                    ctx.note_flag("partial")
+                                continue
+                            raise
                         # Filter the failed node; re-map its slices onto
                         # surviving replicas (executor.go:1137-1151).
                         # The client already fed the failure into the
@@ -3557,7 +3845,9 @@ class Executor:
                         except SliceUnavailableError:
                             raise e
                         continue
-                    with _ctx_span(ctx, "merge", host=node.host):
+                    with _ctx_span(ctx, "merge",
+                                   host=(node.host if node is not None
+                                         else "double-read")):
                         result = reduce_fn(result, r)
                     processed += len(node_slices)
         finally:

@@ -20,6 +20,11 @@ Sites (each exercised by at least one test):
 ``ring.write``      obs/diskring segment appends (trace store +
                     blackbox ring; torn-write capable — crash
                     mid-segment-write)
+``resize.stream``   server/syncer FragmentStreamer block pushes during
+                    an elastic resize (torn-write capable: a PREFIX of
+                    the block's positions lands on the target, then the
+                    stream fails — the idempotent block re-diff must
+                    converge); partition mode scopes by target host
 ==================  =========================================================
 
 Spec grammar (one string per site)::
@@ -62,7 +67,8 @@ from ..utils.config import parse_duration
 ACTIVE: Optional["Failpoints"] = None
 
 SITES = ("rpc.send", "rpc.recv", "wal.append", "snapshot.write",
-         "gossip.deliver", "mesh.dispatch", "ring.write")
+         "gossip.deliver", "mesh.dispatch", "ring.write",
+         "resize.stream")
 
 
 def env_key(site: str) -> str:

@@ -634,6 +634,30 @@ TOPN_PUSHDOWN = _DEFAULT.counter(
     " merged per the two-phase semantics) or fallback (pushdown"
     " failed; the fan-out path answered)",
     labels=("outcome",))
+RESIZE_STATE = _DEFAULT.gauge(
+    "pilosa_cluster_resize_state",
+    "Elastic-resize state on this node: 1 on the current phase label"
+    " (idle / preparing / streaming / migrating / flipping / draining /"
+    " finalizing / done / aborted), 0 elsewhere — the cluster_ prefix"
+    " carries the naming convention's subsystem segment"
+    " (docs/CLUSTER_RESIZE.md)",
+    labels=("phase",))
+RESIZE_SLICES_MOVED = _DEFAULT.counter(
+    "pilosa_resize_slices_moved_total",
+    "Moving (index, slice) groups whose fragments finished streaming"
+    " to their new owner during an elastic resize")
+RESIZE_STREAM_BYTES = _DEFAULT.counter(
+    "pilosa_resize_stream_bytes_total",
+    "Position bytes pushed source→target by the resize fragment"
+    " streamer (the migration wire cost — run-shaped fragments ride"
+    " their compact container form)")
+RESIZE_DOUBLE_READS = _DEFAULT.counter(
+    "pilosa_cluster_resize_double_reads_total",
+    "Moving-slice double-read legs during a resize, by winner: source"
+    " (old owner answered — the authoritative pre-flip copy) or"
+    " target (old side failed; the new owner's post-flip answer won"
+    " with the newest generation tokens)",
+    labels=("winner",))
 
 
 # -- legacy StatsClient bridge ------------------------------------------------

@@ -17,6 +17,12 @@ Four stall detectors, each cheap enough to run every second:
 - **admission_stall** — queries are queued but nothing has been
   granted a slot for ``queue_stall_s``: the queue is not draining
   (every slot wedged, or a lost wakeup).
+- **resize_stall** — this node coordinates an elastic resize whose
+  active phase has made no forward progress (no ack, no streamed
+  block, no phase move) for ``resize_stall_s``: a wedged stream
+  target, a partitioned flip, or a stuck control send — the window
+  where the cluster is paying double-write/double-read overhead for
+  nothing (docs/CLUSTER_RESIZE.md).
 
 A trip increments ``pilosa_watchdog_trips_total{cause}``, force-keeps
 every in-flight trace (reason ``watchdog`` — the wedged query's spans
@@ -38,10 +44,11 @@ DEFAULT_WAL_STALL_S = 5.0
 DEFAULT_DEADLINE_GRACE_S = 5.0
 DEFAULT_GOSSIP_SILENCE_S = 60.0
 DEFAULT_QUEUE_STALL_S = 10.0
+DEFAULT_RESIZE_STALL_S = 60.0
 DEFAULT_RETRIP_S = 60.0
 
 CAUSES = ("wal_flusher", "stuck_query", "gossip_silence",
-          "admission_stall")
+          "admission_stall", "resize_stall")
 
 
 class Watchdog:
@@ -49,11 +56,13 @@ class Watchdog:
                  sampler=None, blackbox=None,
                  gossip_age_fn: Optional[Callable[[], Optional[float]]]
                  = None,
+                 resize_progress_fn: Optional[Callable] = None,
                  interval_s: float = DEFAULT_INTERVAL_S,
                  wal_stall_s: float = DEFAULT_WAL_STALL_S,
                  deadline_grace_s: float = DEFAULT_DEADLINE_GRACE_S,
                  gossip_silence_s: float = DEFAULT_GOSSIP_SILENCE_S,
                  queue_stall_s: float = DEFAULT_QUEUE_STALL_S,
+                 resize_stall_s: float = DEFAULT_RESIZE_STALL_S,
                  retrip_s: float = DEFAULT_RETRIP_S, logger=None):
         from ..utils import logger as logger_mod
         self.registry = registry      # sched.QueryRegistry
@@ -62,11 +71,15 @@ class Watchdog:
         self.sampler = sampler        # obs.sampler.TailSampler
         self.blackbox = blackbox      # obs.blackbox.Blackbox
         self.gossip_age_fn = gossip_age_fn
+        # () -> None | (phase, seconds_without_progress): the server's
+        # view of an ACTIVE resize it coordinates (cluster.resize).
+        self.resize_progress_fn = resize_progress_fn
         self.interval_s = max(0.02, float(interval_s))
         self.wal_stall_s = float(wal_stall_s)
         self.deadline_grace_s = float(deadline_grace_s)
         self.gossip_silence_s = float(gossip_silence_s)
         self.queue_stall_s = float(queue_stall_s)
+        self.resize_stall_s = float(resize_stall_s)
         self.retrip_s = float(retrip_s)
         self.logger = logger or logger_mod.NOP
         self.trips = 0
@@ -145,6 +158,20 @@ class Watchdog:
                 out.append((
                     "admission_stall",
                     f"{queued} queued, no grant for {grant_age:.1f}s"))
+        # Stalled elastic resize (this node coordinating).
+        if (self.resize_progress_fn is not None
+                and self.resize_stall_s > 0):
+            try:
+                st = self.resize_progress_fn()
+            except Exception:  # noqa: BLE001
+                st = None
+            if st is not None:
+                phase, age = st
+                if age > self.resize_stall_s:
+                    out.append((
+                        "resize_stall",
+                        f"resize phase {phase}: no progress for"
+                        f" {age:.1f}s"))
         return out
 
     # -- the trip --------------------------------------------------------------
@@ -194,4 +221,5 @@ class Watchdog:
                 "thresholds": {"walStallS": self.wal_stall_s,
                                "deadlineGraceS": self.deadline_grace_s,
                                "gossipSilenceS": self.gossip_silence_s,
-                               "queueStallS": self.queue_stall_s}}
+                               "queueStallS": self.queue_stall_s,
+                               "resizeStallS": self.resize_stall_s}}

@@ -320,6 +320,10 @@ class Handler:
           self._handle_post_field_import, lane=LANE_WRITE)
         r("PATCH", "/index/{index}/time-quantum",
           self._handle_patch_index_time_quantum, lane=LANE_ADMIN)
+        r("GET", "/cluster/resize", self._handle_get_cluster_resize)
+        r("POST", "/cluster/resize", self._handle_post_cluster_resize,
+          lane=LANE_ADMIN)
+        r("GET", "/debug/topology", self._handle_debug_topology)
         r("GET", "/debug/queries", self._handle_debug_queries)
         r("GET", "/debug/queries/slow", self._handle_debug_slow_queries)
         r("DELETE", "/debug/queries/{qid}", self._handle_delete_query)
@@ -345,6 +349,8 @@ class Handler:
         r("GET", "/fragment/blocks", self._handle_fragment_blocks)
         r("GET", "/fragment/data", self._handle_get_fragment_data)
         r("POST", "/fragment/data", self._handle_post_fragment_data)
+        r("POST", "/fragment/import",
+          self._handle_post_fragment_import, lane=LANE_WRITE)
         r("GET", "/fragment/nodes", self._handle_fragment_nodes)
         r("GET", "/generations", self._handle_get_generations)
         r("POST", "/import", self._handle_post_import, lane=LANE_WRITE)
@@ -1709,6 +1715,139 @@ class Handler:
             "index": index_name, "host": self.host,
             "tokens": {str(s): {k: [v[0], v[1]] for k, v in m.items()}
                        for s, m in tokens.items()}})
+
+    # -- elastic resize (cluster.resize; docs/CLUSTER_RESIZE.md) -------------
+
+    def _resize_server(self):
+        """The Server behind the resize control surface; bare test
+        handlers (no status_handler / no start_resize) answer 503."""
+        s = self.status_handler
+        if s is None or not hasattr(s, "start_resize"):
+            raise HTTPError(503, "no resize coordinator on this node")
+        return s
+
+    def _handle_get_cluster_resize(self, req: Request) -> Response:
+        out: dict = {"epoch": self.cluster.epoch
+                     if self.cluster is not None else 0}
+        rs = self.cluster.resize if self.cluster is not None else None
+        out["installed"] = rs.to_wire() if rs is not None else None
+        s = self.status_handler
+        op = getattr(s, "resize_op", None)
+        out["op"] = op.status() if op is not None else None
+        return Response.json(out)
+
+    def _handle_post_cluster_resize(self, req: Request) -> Response:
+        """Start (or abort) an online resize with THIS node as
+        coordinator. Body: {"hosts": [target membership]} |
+        {"add": "h:p"} | {"remove": "h:p"} | {"abort": true}."""
+        server = self._resize_server()
+        body = req.json()
+        if body.get("abort"):
+            status = server.abort_resize()
+            if status is None:
+                raise HTTPError(409, "no resize in flight")
+            return Response.json({"op": status})
+        current = [n.host for n in self.cluster.nodes]
+        if body.get("hosts"):
+            target = [str(h) for h in body["hosts"]]
+        elif body.get("add"):
+            h = str(body["add"])
+            if h in current:
+                raise HTTPError(400, f"{h} already a member")
+            target = current + [h]
+        elif body.get("remove"):
+            h = str(body["remove"])
+            if h not in current:
+                raise HTTPError(400, f"{h} not a member")
+            if len(current) == 1:
+                raise HTTPError(400, "cannot remove the last node")
+            target = [x for x in current if x != h]
+        else:
+            raise HTTPError(400, "hosts, add, remove, or abort"
+                                 " required")
+        try:
+            coord = server.start_resize(target)
+        except PilosaError as e:
+            raise HTTPError(409, str(e))
+        return Response.json({"op": coord.status()}, status=202)
+
+    def _handle_debug_topology(self, req: Request) -> Response:
+        """Placement introspection: the epoch, the membership, every
+        index's per-slice owner map, and the in-flight resize state —
+        the first thing a mis-routed-query investigation needs."""
+        if self.cluster is None:
+            return Response.json({"epoch": 0, "nodes": [],
+                                  "indexes": {}, "resize": None})
+        cl = self.cluster
+        rs = cl.resize
+        out: dict = {
+            "epoch": cl.epoch,
+            "partitionN": cl.partition_n,
+            "replicaN": cl.replica_n,
+            "nodes": [n.host for n in cl.nodes],
+            "resize": rs.to_wire() if rs is not None else None,
+        }
+        indexes: dict = {}
+        if self.holder is not None:
+            for name in sorted(self.holder.indexes):
+                idx = self.holder.indexes[name]
+                hi = max(idx.max_slice(), idx.max_inverse_slice())
+                owners = {}
+                moving = []
+                for s in range(hi + 1):
+                    owners[str(s)] = [n.host for n in
+                                      cl.fragment_nodes(name, s)]
+                    if cl.moving_slice(name, s) is not None:
+                        moving.append(s)
+                entry: dict = {"maxSlice": idx.max_slice(),
+                               "owners": owners}
+                if moving:
+                    entry["movingSlices"] = moving
+                indexes[name] = entry
+        out["indexes"] = indexes
+        return Response.json(out)
+
+    def _handle_post_fragment_import(self, req: Request) -> Response:
+        """Additive per-fragment positions import — the resize
+        streamer's push lane (cluster.client.fragment_import). Unlike
+        POST /fragment/data it never replaces content (concurrent
+        double-writes land between a block-diff read and this push);
+        unlike /import it applies to the EXACT (frame, view) fragment
+        so inverse and time views migrate faithfully. Body: LE u64
+        slice-local positions (row*SLICE_WIDTH + col%SLICE_WIDTH)."""
+        index_name = req.query.get("index", "")
+        frame_name = req.query.get("frame", "")
+        view = req.query.get("view", "")
+        slice = req.uint_param("slice")
+        if not index_name or not frame_name or not view:
+            raise HTTPError(400, "index, frame, and view required")
+        if self.cluster is not None and not self.cluster.owns_fragment(
+                self.host, index_name, slice):
+            raise HTTPError(412, f"host does not own slice"
+                                 f" {self.host}-{index_name}"
+                                 f" slice:{slice}")
+        frame = self.holder.frame(index_name, frame_name)
+        if frame is None:
+            raise HTTPError(404, "frame not found")
+        body = req.body()
+        if len(body) % 8:
+            raise HTTPError(400, "positions body not 8-byte aligned")
+        positions = np.frombuffer(body, dtype="<u8")
+        v = frame.create_view_if_not_exists(view)
+        frag = v.create_fragment_if_not_exists(slice)
+        if len(positions):
+            # Writable sorted copy: frombuffer views of the HTTP body
+            # are read-only and may alias the request buffer.
+            frag.import_positions(np.sort(positions))
+        storage_wal.barrier_all()
+        obs_metrics.IMPORT_BITS.labels("resizeStream").inc(
+            len(positions))
+        hs = []
+        gh = self._generations_header(index_name, [slice])
+        if gh is not None:
+            hs.append(gh)
+        return Response.json({"accepted": int(len(positions))},
+                             headers=hs)
 
     def _handle_post_fragment_data(self, req: Request) -> Response:
         slice = req.uint_param("slice")

@@ -14,9 +14,12 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+import numpy as np
+
 from .. import SLICE_WIDTH
 from ..cluster.client import Client, ClientError
 from ..errors import FragmentNotFoundError, FrameNotFoundError
+from ..fault import failpoints as _fp
 from ..models.view import VIEW_STANDARD
 from ..storage.fragment import PairSet
 from ..utils import logger as logger_mod
@@ -74,8 +77,26 @@ class HolderSyncer:
                     continue
                 max_slice = self.holder.index(di["name"]).max_slice()
                 for slice in range(max_slice + 1):
-                    if not self.cluster.owns_fragment(
+                    # READ authority, not the write-accept union: an
+                    # old owner inside the post-resize grace window
+                    # still owns_fragment a moved slice, but its
+                    # frozen copy must never VOTE in the consensus
+                    # merge — majority with a stale voter can push
+                    # ClearBits of acked writes or resurrect cleared
+                    # bits (review finding, same class as the
+                    # executor cache gates).
+                    if not self.cluster.read_allowed(
                             self.host, di["name"], slice):
+                        continue
+                    # Elastic resize: a moving slice's target copy is
+                    # legitimately incomplete mid-migration — feeding
+                    # it into the majority-consensus merge could push
+                    # CLEARS of not-yet-streamed bits back to the
+                    # source. The resize streamer owns these fragments
+                    # until the flip settles; anti-entropy resumes on
+                    # the next sweep after finalize.
+                    if self.cluster.moving_slice(di["name"],
+                                                 slice) is not None:
                         continue
                     if self.is_closing():
                         return
@@ -288,3 +309,142 @@ class FragmentSyncer:
                 # already landed.
                 self.logger.printf("sync: push-back to %s failed: %s",
                                    client.host, e)
+
+
+class _PrefixPush:
+    """Torn-stream adapter for the ``resize.stream`` failpoint: torn
+    mode hands this "writer" a byte PREFIX of the block's encoded u64
+    positions; we push the whole positions that fit in it, so a torn
+    injection leaves a genuine partial block on the target — exactly
+    the state a crashed stream leaves — which the idempotent re-diff
+    must then converge."""
+
+    def __init__(self, push_fn, positions: np.ndarray):
+        self.push_fn = push_fn
+        self.positions = positions
+
+    def write(self, data: bytes) -> None:
+        n = len(data) // 8
+        if n > 0:
+            self.push_fn(self.positions[:n])
+
+
+class FragmentStreamer:
+    """Directed fragment migration for elastic resize
+    (docs/CLUSTER_RESIZE.md): reuses the FragmentSyncer block-diff
+    protocol (per-block SHA1 checksums via GET /fragment/blocks,
+    changed-block pulls via the block-data wire), but instead of the
+    consensus merge it pushes SETS-ONLY source→target through the
+    additive ``POST /fragment/import`` lane:
+
+    - sets-only because during migration every ClearBit double-writes
+      to both copies (a bit absent on the source is already absent on
+      the target), while a bit present on the target but not yet on
+      the source can only be an in-flight double-write racing the diff
+      read — clearing it would drop an acked write;
+    - additive import (never the replace-style /fragment/data restore)
+      because concurrent double-writes land between the diff read and
+      the push, and a whole-fragment replace would clobber them;
+    - per-block pushes bound memory, give the ``resize.stream``
+      failpoint its injection granularity (error / delay / torn /
+      partition-by-target-host), and let the pacing hook breathe
+      between blocks.
+
+    The push is idempotent (re-adding set bits is a no-op), so a torn
+    or crashed stream recovers by simply re-running the diff.
+    """
+
+    def __init__(self, client_factory=Client, logger=logger_mod.NOP,
+                 fault=None, pace_s: float = 0.0, on_block=None):
+        self.client_factory = client_factory
+        self.logger = logger
+        # fault.FaultManager: the stream defers to the breaker state —
+        # a target (or source) behind an open circuit pauses the
+        # migration instead of hammering a struggling peer (the PR-5
+        # health/breaker machinery paces the stream).
+        self.fault = fault
+        self.pace_s = pace_s
+        # on_block(bits, nbytes): per-BLOCK progress callback — the
+        # resize coordinator feeds its status/watchdog heartbeat from
+        # it, so a long fragment's progress is visible while it
+        # streams, not only after.
+        self.on_block = on_block
+        self.bits_pushed = 0
+        self.bytes_pushed = 0
+
+    def wait_allowed(self, host: str, closing=None,
+                     timeout_s: float = 30.0) -> bool:
+        """Block until the peer's circuit allows traffic (half-open
+        probe windows count), up to ``timeout_s``."""
+        if self.fault is None:
+            return True
+        deadline = None
+        import time as _time
+        while not self.fault.would_allow(host):
+            if deadline is None:
+                deadline = _time.monotonic() + timeout_s
+            elif _time.monotonic() > deadline:
+                return False
+            if closing is not None and closing.is_set():
+                return False
+            _time.sleep(0.1)
+        return True
+
+    def stream_fragment(self, index: str, frame: str, view: str,
+                        slice: int, source_host: str,
+                        target_host: str) -> tuple[int, int]:
+        """One fragment source→target; returns (bits, bytes) pushed.
+        Zero bits pushed on a re-run is the convergence signal the
+        coordinator's diff-until-clean loop keys on."""
+        src = self.client_factory(source_host)
+        tgt = self.client_factory(target_host)
+        try:
+            src_blocks = src.fragment_blocks(index, frame, view, slice,
+                                             host=source_host)
+        except FragmentNotFoundError:
+            return (0, 0)  # nothing at the source: nothing to move
+        try:
+            tgt_blocks = dict(tgt.fragment_blocks(index, frame, view,
+                                                  slice,
+                                                  host=target_host))
+        except FragmentNotFoundError:
+            tgt_blocks = {}
+        bits = nbytes = 0
+        for block_id, checksum in src_blocks:
+            if tgt_blocks.get(block_id) == checksum:
+                continue
+            rows, cols = src.block_data(index, frame, view, slice,
+                                        block_id, host=source_host)
+            if not len(rows):
+                continue
+            positions = np.unique(
+                np.asarray(rows, dtype=np.uint64)
+                * np.uint64(SLICE_WIDTH)
+                + np.asarray(cols, dtype=np.uint64)
+                % np.uint64(SLICE_WIDTH))
+
+            def push(p, _tgt=tgt, _host=target_host):
+                _tgt.fragment_import(index, frame, view, slice, p,
+                                     host=_host)
+
+            if _fp.ACTIVE is not None:
+                # Torn mode pushes a PREFIX of this block, then raises
+                # — the mid-stream crash shape.
+                _fp.ACTIVE.hit("resize.stream", host=target_host,
+                               writer=_PrefixPush(push, positions),
+                               data=positions.tobytes())
+            push(positions)
+            block_bits = len(positions)
+            block_bytes = block_bits * 8
+            bits += block_bits
+            nbytes += block_bytes
+            self.bits_pushed += block_bits
+            self.bytes_pushed += block_bytes
+            from ..obs import metrics as obs_metrics
+            obs_metrics.RESIZE_STREAM_BYTES.inc(block_bytes)
+            if self.on_block is not None:
+                self.on_block(block_bits, block_bytes)
+            if self.pace_s:
+                import time as _time
+                _time.sleep(self.pace_s)
+        return (bits, nbytes)

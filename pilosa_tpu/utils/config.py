@@ -67,6 +67,14 @@ class ClusterConfig:
     # routed through this coordinator invalidate on their own response
     # — the bound only governs out-of-band writes (docs/DISTRIBUTED.md).
     gen_staleness: float = 2.0
+    # Elastic resize (cluster.resize; docs/CLUSTER_RESIZE.md):
+    # ``resize_pace`` (seconds) breathes between streamed blocks so a
+    # migration never saturates a serving node; ``resize_grace``
+    # (seconds) keeps the previous epoch's owners write-accepting
+    # after finalize so straggler coordinators' union-writes don't
+    # bounce.
+    resize_pace: float = 0.0
+    resize_grace: float = 30.0
 
 
 # Query lifecycle defaults (sched subsystem; docs/SCHEDULING.md).
@@ -200,15 +208,17 @@ class WatchdogConfig:
     ``interval`` paces the detectors; ``wal_stall`` is the WAL
     dirty-age threshold, ``deadline_grace`` the past-deadline grace
     for running legs, ``gossip_silence`` the membership-silence bound,
-    ``queue_stall`` the no-grant-while-queued bound; ``retrip`` rate-
-    limits repeat trips per cause (0 on any threshold disables that
-    detector)."""
+    ``queue_stall`` the no-grant-while-queued bound; ``resize_stall``
+    the no-progress bound on an elastic resize this node coordinates;
+    ``retrip`` rate-limits repeat trips per cause (0 on any threshold
+    disables that detector)."""
     enabled: bool = True
     interval: float = 1.0
     wal_stall: float = 5.0
     deadline_grace: float = 5.0
     gossip_silence: float = 60.0
     queue_stall: float = 10.0
+    resize_stall: float = 60.0
     retrip: float = 60.0
 
 
@@ -266,6 +276,8 @@ internal-port = "{self.cluster.internal_port}"
 gossip-seed = "{self.cluster.gossip_seed}"
 gossip-secret = "{self.cluster.gossip_secret}"
 gen-staleness = "{dur(self.cluster.gen_staleness)}"
+resize-pace = "{dur(self.cluster.resize_pace)}"
+resize-grace = "{dur(self.cluster.resize_grace)}"
 
 [query]
 concurrency = {self.query.concurrency}
@@ -305,6 +317,7 @@ wal-stall = "{dur(self.watchdog.wal_stall)}"
 deadline-grace = "{dur(self.watchdog.deadline_grace)}"
 gossip-silence = "{dur(self.watchdog.gossip_silence)}"
 queue-stall = "{dur(self.watchdog.queue_stall)}"
+resize-stall = "{dur(self.watchdog.resize_stall)}"
 retrip = "{dur(self.watchdog.retrip)}"
 
 [profile]
@@ -364,6 +377,11 @@ def load(path: str = "", env: dict | None = None) -> Config:
         if "gen-staleness" in cl:
             cfg.cluster.gen_staleness = parse_duration(
                 cl["gen-staleness"])
+        if "resize-pace" in cl:
+            cfg.cluster.resize_pace = parse_duration(cl["resize-pace"])
+        if "resize-grace" in cl:
+            cfg.cluster.resize_grace = parse_duration(
+                cl["resize-grace"])
         ae = data.get("anti-entropy", {})
         if "interval" in ae:
             cfg.anti_entropy_interval = parse_duration(ae["interval"])
@@ -428,6 +446,7 @@ def load(path: str = "", env: dict | None = None) -> Config:
                           ("deadline-grace", "deadline_grace"),
                           ("gossip-silence", "gossip_silence"),
                           ("queue-stall", "queue_stall"),
+                          ("resize-stall", "resize_stall"),
                           ("retrip", "retrip")):
             if key in wd:
                 setattr(cfg.watchdog, attr, parse_duration(wd[key]))
@@ -520,6 +539,12 @@ def load(path: str = "", env: dict | None = None) -> Config:
             cfg.cluster.gen_staleness = float(raw)
         except ValueError:
             cfg.cluster.gen_staleness = parse_duration(raw)
+    if env.get("PILOSA_CLUSTER_RESIZE_PACE"):
+        cfg.cluster.resize_pace = parse_duration(
+            env["PILOSA_CLUSTER_RESIZE_PACE"])
+    if env.get("PILOSA_CLUSTER_RESIZE_GRACE"):
+        cfg.cluster.resize_grace = parse_duration(
+            env["PILOSA_CLUSTER_RESIZE_GRACE"])
     if env.get("PILOSA_METRICS_ENABLED"):
         cfg.metrics.enabled = _parse_bool(env["PILOSA_METRICS_ENABLED"])
     if env.get("PILOSA_METRICS_RUNTIME_INTERVAL"):
@@ -579,6 +604,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
                              "gossip_silence"),
                             ("PILOSA_WATCHDOG_QUEUE_STALL",
                              "queue_stall"),
+                            ("PILOSA_WATCHDOG_RESIZE_STALL",
+                             "resize_stall"),
                             ("PILOSA_WATCHDOG_RETRIP", "retrip")):
         if env.get(env_key_):
             setattr(cfg.watchdog, attr_, parse_duration(env[env_key_]))

@@ -56,3 +56,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: failpoint-driven fault-injection tests;"
                    " schedules replay from PILOSA_FAULT_SEED")
+    config.addinivalue_line(
+        "markers", "resize: elastic cluster-resize tests (ISSUE 12) —"
+                   " fast failpoint legs run tier-1, the multi-process"
+                   " SIGKILL legs are additionally `slow`")

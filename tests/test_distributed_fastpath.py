@@ -490,6 +490,64 @@ class TestClusterResultCache:
         assert r2[0] == r1[0] + 1
         assert len(client.exec_calls) > n_exec
 
+    def test_epoch_bump_never_validates_stale_entries(self, holder):
+        """ISSUE 12 satellite regression: after an elastic-resize
+        epoch flip moves a slice to a NEW peer, a cluster-cache entry
+        cached under the OLD owner's tokens must never validate — the
+        old owner's copy freezes (it stops receiving writes), so its
+        /generations probe would match forever. Both defenses are
+        exercised: the placement epoch baked into the key (post-flip
+        lookups can't even find the old entry) and the eager
+        on_resize_change flush for moved slices."""
+        must_set(holder, "i", "general", 10, 3)
+        holder.index("i").set_remote_max_slice(2)
+        cluster = new_cluster(["local", "remotehost"], replica_n=1)
+        remote_slices = [s for s in range(3)
+                         if cluster.fragment_nodes("i", s)[0].host
+                         == "remotehost"]
+        assert remote_slices
+        gens = GenerationMap(staleness_s=60.0)
+        tokens = {"remotehost": {s: {"general/standard": (50, 0)}
+                                 for s in remote_slices},
+                  # The post-flip owner: fresh uid, per the satellite.
+                  "new:1": {s: {"general/standard": (77, 0)}
+                            for s in range(3)}}
+        client = ClusterCacheClient(gens, tokens)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client, gens=gens, use_mesh=False)
+        gens.apply("remotehost", "i",
+                   {s: tokens["remotehost"][s] for s in remote_slices})
+        q = 'Count(Bitmap(rowID=10, frame=general))'
+        e.execute("i", q)
+        n_exec = len(client.exec_calls)
+        assert e._cluster_cache, "warm-up did not cache"
+        old_key = next(iter(e._cluster_cache))
+        assert old_key[-1] == 0  # epoch in the key
+        # The resize moves ownership; the server calls
+        # on_resize_change at install and flip (server.py
+        # _apply_resize_message).
+        cluster.install_resize("r1",
+                               ["local", "remotehost", "new:1"])
+        e.on_resize_change()
+        # While the resize is in flight NOTHING caches.
+        assert e._cluster_cache_key(
+            "i", parse_pql(q), [0, 1, 2], ExecOptions()) is None
+        cluster.flip_epoch("r1")
+        e.on_resize_change(lambda index, s: True)  # all slices moved
+        cluster.finalize_resize("r1", grace_s=0.0)
+        # The eager flush dropped the entry outright...
+        assert not e._cluster_cache
+        # ...and even a hypothetical survivor could not serve: the
+        # next query keys under epoch 1 and recomputes (the scripted
+        # old owner would happily validate its frozen tokens — that
+        # answer must never be served).
+        hits = obs_metrics.CLUSTER_CACHE_REQUESTS.labels("hit").value
+        e.execute("i", q)
+        assert len(client.exec_calls) > n_exec, \
+            "stale cluster-cache entry served after the epoch flip"
+        assert obs_metrics.CLUSTER_CACHE_REQUESTS.labels(
+            "hit").value == hits
+
     def test_write_queries_and_partial_are_never_cached(self, holder):
         must_set(holder, "i", "general", 10, 3)
         holder.index("i").set_remote_max_slice(2)

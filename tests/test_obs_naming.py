@@ -101,6 +101,32 @@ class TestRouteTableDocumented:
         assert set(roaring.OP_KINDS) >= {"run_run", "run_array",
                                          "run_bitmap"}
 
+    def test_resize_metrics_and_routes_registered(self):
+        """ISSUE 12: the elastic-resize metric families exist (and so
+        passed the naming gate at import — the state gauge carries the
+        cluster_ subsystem prefix the convention requires), the
+        watchdog grew the resize_stall cause, the failpoint registry
+        grew the resize.stream site, and the control/debug routes are
+        registered."""
+        fams = obs_metrics.default_registry().families()
+        for name in ("pilosa_cluster_resize_state",
+                     "pilosa_resize_slices_moved_total",
+                     "pilosa_resize_stream_bytes_total",
+                     "pilosa_cluster_resize_double_reads_total"):
+            assert name in fams, name
+        assert fams["pilosa_cluster_resize_state"].type == "gauge"
+        assert fams["pilosa_resize_slices_moved_total"].type \
+            == "counter"
+        from pilosa_tpu.obs.watchdog import CAUSES
+        assert "resize_stall" in CAUSES
+        from pilosa_tpu.fault.failpoints import SITES
+        assert "resize.stream" in SITES
+        handler = Handler(None, None)
+        patterns = {p for _m, _r, _f, _l, p in handler._routes}
+        assert "/debug/topology" in patterns
+        assert "/cluster/resize" in patterns
+        assert "/fragment/import" in patterns
+
     def test_observability_pr_metrics_registered(self):
         """The tail-sampling / blackbox / watchdog metric families
         promised by docs/OBSERVABILITY.md exist in the default

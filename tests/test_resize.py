@@ -1,0 +1,1042 @@
+"""Elastic cluster resize (ISSUE 12): movement-set math, epoch
+lifecycle, the journal, the streamer, double-reads, and the in-process
+coordinator protocol with failpoint chaos.
+
+The real multi-process gossip legs (SIGKILL of source / target /
+coordinator, partition during the flip) live in
+tests/test_resize_cluster.py; everything here runs in-process and
+tier-1."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.cluster import resize as resize_mod  # noqa: E402
+from pilosa_tpu.cluster.broadcast import (  # noqa: E402
+    ResizeMessage, marshal_message, unmarshal_message)
+from pilosa_tpu.cluster.topology import (  # noqa: E402
+    RESIZE_DRAINING, RESIZE_MIGRATING, Cluster, Node, jump_hash,
+    movement, new_cluster, owner_hosts)
+from pilosa_tpu.errors import PilosaError  # noqa: E402
+from pilosa_tpu.executor import ExecOptions, Executor  # noqa: E402
+from pilosa_tpu.fault import failpoints  # noqa: E402
+from pilosa_tpu.models.holder import Holder  # noqa: E402
+from pilosa_tpu.obs import metrics as obs_metrics  # noqa: E402
+from pilosa_tpu.pql.parser import parse as parse_pql  # noqa: E402
+
+pytestmark = pytest.mark.resize
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    yield h
+    h.close()
+
+
+# ---------------------------------------------------------------------------
+# movement-set math: jump-hash minimality (ISSUE 12 satellite)
+
+
+class TestMovementMinimality:
+    PARTITION_N = 256  # higher resolution than the runtime default
+
+    def _hosts(self, n):
+        return [f"node{i}:1" for i in range(n)]
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 5, 8, 13])
+    def test_grow_moves_one_over_n_plus_one(self, n):
+        """Appending one host relocates ~1/(n+1) of partitions —
+        the jump-hash minimality the whole migration cost story rests
+        on (Lamping & Veach)."""
+        old = self._hosts(n)
+        new = old + [f"node{n}:1"]
+        mv = movement(old, new, self.PARTITION_N, 1)
+        frac = len(mv) / self.PARTITION_N
+        want = 1.0 / (n + 1)
+        # Generous tolerance: 256 partitions is a small sample.
+        assert abs(frac - want) < max(0.08, 2.5 * want), (
+            f"n={n}: moved {frac:.3f}, expected ~{want:.3f}")
+
+    @pytest.mark.parametrize("n", [2, 3, 5, 8])
+    def test_grow_never_moves_between_survivors(self, n):
+        """Every relocated partition's new owner set includes the ADDED
+        host — growing never shuffles a partition between two
+        surviving old owners (replica_n=1: the destination IS the new
+        host)."""
+        old = self._hosts(n)
+        added = f"node{n}:1"
+        mv = movement(old, old + [added], self.PARTITION_N, 1)
+        assert mv, "growing a cluster must move something"
+        for p, (o, nw) in mv.items():
+            assert nw == (added,), (
+                f"partition {p} moved {o} -> {nw}: relocation between"
+                f" surviving owners")
+
+    def test_grow_with_replicas_primary_stays_or_is_added(self):
+        """With replica_n=2 the replica RING can shift a successor,
+        but the PRIMARY of a moved partition either stays put or
+        becomes the added host — jump hash never reassigns a primary
+        between surviving buckets."""
+        old = self._hosts(4)
+        added = "node4:1"
+        mv = movement(old, old + [added], self.PARTITION_N, 2)
+        assert mv
+        for p, (o, nw) in mv.items():
+            assert nw[0] in (o[0], added), (
+                f"partition {p}: primary {o[0]} -> {nw[0]} between"
+                f" survivors")
+
+    def test_shrink_of_last_host_mirrors_grow(self):
+        """Removing the most-recently-added host is the exact inverse
+        of adding it: the same partitions move, back to their old
+        owners."""
+        old = self._hosts(5)
+        grown = old + ["node5:1"]
+        mv_grow = movement(old, grown, self.PARTITION_N, 1)
+        mv_shrink = movement(grown, old, self.PARTITION_N, 1)
+        assert set(mv_grow) == set(mv_shrink)
+        for p in mv_grow:
+            assert mv_grow[p] == (mv_shrink[p][1], mv_shrink[p][0])
+
+    def test_slice_level_movement_matches_partition_movement(self):
+        """Per-slice relocation fraction over a real Cluster follows
+        the per-partition movement (slices hash uniformly into
+        partitions)."""
+        old = self._hosts(4)
+        cl = new_cluster(old)
+        mv = movement(old, old + ["node4:1"], cl.partition_n, 1)
+        moved = sum(1 for s in range(512)
+                    if cl.partition("i", s) in mv)
+        # 16 partitions: the moved fraction is len(mv)/16 exactly in
+        # expectation.
+        assert abs(moved / 512 - len(mv) / cl.partition_n) < 0.1
+
+    def test_owner_hosts_matches_cluster_partition_nodes(self):
+        hosts = self._hosts(5)
+        cl = new_cluster(hosts, replica_n=2)
+        for p in range(cl.partition_n):
+            assert owner_hosts(hosts, p, 2, jump_hash) == tuple(
+                n.host for n in cl.partition_nodes(p))
+
+
+# ---------------------------------------------------------------------------
+# topology: epoch lifecycle + union placement + read fencing
+
+
+class TestTopologyResizeLifecycle:
+    def _cluster(self):
+        return new_cluster(["a:1", "b:1"])
+
+    def _moving_slice(self, cl, rs, index="i"):
+        for s in range(64):
+            mv = cl.moving_slice(index, s)
+            if mv is not None:
+                return s, mv
+        pytest.skip("no moving slice in range")
+
+    def test_install_flip_finalize(self):
+        cl = self._cluster()
+        rs = cl.install_resize("r1", ["a:1", "b:1", "c:1"])
+        assert cl.epoch == 0 and rs.phase == RESIZE_MIGRATING
+        s, (phase, old, new) = self._moving_slice(cl, rs)
+        assert phase == RESIZE_MIGRATING
+        assert "c:1" in new and "c:1" not in old
+        # Union write placement includes the target; reads stay old.
+        write_hosts = [n.host for n in cl.fragment_nodes("i", s)]
+        read_hosts = [n.host for n in cl.read_nodes("i", s)]
+        assert "c:1" in write_hosts
+        assert "c:1" not in read_hosts
+        assert cl.owns_fragment("c:1", "i", s)       # write-accept
+        assert not cl.read_allowed("c:1", "i", s)    # read-fenced
+        # Flip: atomic switch, draining keeps the union.
+        assert cl.flip_epoch("r1") is True
+        assert cl.flip_epoch("r1") is False  # idempotent
+        assert cl.epoch == 1 and len(cl.nodes) == 3
+        assert cl.resize.phase == RESIZE_DRAINING
+        read_hosts = [n.host for n in cl.read_nodes("i", s)]
+        write_hosts = [n.host for n in cl.fragment_nodes("i", s)]
+        assert "c:1" in read_hosts          # new owner serves
+        assert set(old) <= set(write_hosts)  # union writes continue
+        # Old owner still read-valid while draining (both complete).
+        assert any(h in read_hosts for h in old)
+        # Finalize: union drops; old owner keeps WRITE-accepting
+        # within grace, never read authority.
+        assert cl.finalize_resize("r1", grace_s=60.0)
+        assert cl.resize is None
+        owners_now = [n.host for n in cl.fragment_nodes("i", s)]
+        assert "c:1" in owners_now
+        for h in old:
+            if h not in owners_now:
+                assert cl.owns_fragment(h, "i", s)      # grace
+                assert not cl.read_allowed(h, "i", s)   # fenced
+
+    def test_second_resize_id_refused(self):
+        cl = self._cluster()
+        cl.install_resize("r1", ["a:1", "b:1", "c:1"])
+        cl.install_resize("r1", ["a:1", "b:1", "c:1"])  # idempotent
+        with pytest.raises(ValueError):
+            cl.install_resize("r2", ["a:1"])
+
+    def test_abort_pre_flip_and_post_flip(self):
+        cl = self._cluster()
+        cl.install_resize("r1", ["a:1", "b:1", "c:1"])
+        assert cl.abort_resize("r1")
+        assert cl.resize is None and cl.epoch == 0
+        assert not cl.abort_resize("r1")  # idempotent
+        # Post-flip abort reverts nodes AND epoch.
+        cl.install_resize("r2", ["a:1", "b:1", "c:1"])
+        cl.flip_epoch("r2")
+        assert cl.epoch == 1 and len(cl.nodes) == 3
+        assert cl.abort_resize("r2")
+        assert cl.epoch == 0 and len(cl.nodes) == 2
+        assert [n.host for n in cl.nodes] == ["a:1", "b:1"]
+
+    def test_grace_expires(self):
+        cl = self._cluster()
+        cl.install_resize("r1", ["a:1", "b:1", "c:1"])
+        s, (_, old, _new) = self._moving_slice(cl, cl.resize)
+        cl.flip_epoch("r1")
+        cl.finalize_resize("r1", grace_s=0.0)
+        time.sleep(0.01)
+        owners_now = {n.host for n in cl.fragment_nodes("i", s)}
+        for h in old:
+            if h not in owners_now:
+                assert not cl.owns_fragment(h, "i", s)
+
+    def test_non_moving_slices_identical_across_epochs(self):
+        """The mixed-epoch-unobservable argument: every slice NOT in
+        the movement set has the same owner set before and after the
+        flip."""
+        cl = self._cluster()
+        before = {s: tuple(n.host for n in cl.fragment_nodes("i", s))
+                  for s in range(64)}
+        cl.install_resize("r1", ["a:1", "b:1", "c:1"])
+        moving = {s for s in range(64)
+                  if cl.moving_slice("i", s) is not None}
+        cl.flip_epoch("r1")
+        cl.finalize_resize("r1", grace_s=0.0)
+        after = {s: tuple(n.host for n in cl.fragment_nodes("i", s))
+                 for s in range(64)}
+        for s in range(64):
+            if s not in moving:
+                assert before[s] == after[s], f"slice {s} moved"
+            else:
+                assert set(before[s]) != set(after[s])
+
+
+# ---------------------------------------------------------------------------
+# ResizeMessage wire + journal
+
+
+class TestWireAndJournal:
+    def test_resize_message_round_trip(self):
+        m = ResizeMessage(id="abc", phase="flip", epoch=3,
+                          old_hosts=["a:1"], new_hosts=["a:1", "b:1"],
+                          coordinator="a:1")
+        got = unmarshal_message(marshal_message(m))
+        assert isinstance(got, ResizeMessage)
+        assert (got.id, got.phase, got.epoch) == ("abc", "flip", 3)
+        assert got.old_hosts == ["a:1"]
+        assert got.new_hosts == ["a:1", "b:1"]
+        assert got.coordinator == "a:1"
+
+    def test_journal_atomic_and_in_flight(self, tmp_path):
+        j = resize_mod.ResizeJournal.for_data_dir(str(tmp_path))
+        assert j.load() is None
+        j.write(id="r1", phase=resize_mod.PHASE_STREAMING,
+                old=["a:1"], new=["a:1", "b:1"], epochFrom=0)
+        j2 = resize_mod.ResizeJournal.for_data_dir(str(tmp_path))
+        state = j2.load()
+        assert state["id"] == "r1" and j2.in_flight()
+        j2.write(phase=resize_mod.PHASE_DONE)
+        j3 = resize_mod.ResizeJournal.for_data_dir(str(tmp_path))
+        j3.load()
+        assert not j3.in_flight()
+
+    def test_journal_aborted_needs_ack(self, tmp_path):
+        j = resize_mod.ResizeJournal.for_data_dir(str(tmp_path))
+        j.write(id="r1", phase=resize_mod.PHASE_ABORTED,
+                abortAcked=False)
+        assert j.in_flight()  # peers may still hold installed state
+        j.write(abortAcked=True)
+        assert not j.in_flight()
+
+    def test_torn_journal_ignored(self, tmp_path):
+        path = os.path.join(str(tmp_path), resize_mod.JOURNAL_FILE)
+        with open(path, "w") as f:
+            f.write('{"version": 1, "phase": "stre')  # torn write
+        j = resize_mod.ResizeJournal(path)
+        assert j.load() is None and not j.in_flight()
+
+
+# ---------------------------------------------------------------------------
+# executor: read fencing, double reads, cache invalidation
+
+
+def must_set(holder, index, frame, row, col, view="standard"):
+    idx = holder.create_index_if_not_exists(index)
+    f = idx.create_frame_if_not_exists(frame)
+    f.set_bit(view, row, col)
+
+
+class ScriptedClient:
+    generation_aware = True
+    deadline_aware = False
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.calls = []
+
+    def execute_query(self, node, index, query, slices, remote,
+                      gens_out=None, **kwargs):
+        self.calls.append((node.host, query, tuple(slices or ())))
+        return self.fn(node, index, query, slices, gens_out)
+
+
+class TestExecutorResize:
+    def _setup(self, holder, fn, n_slices=4):
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("f")
+        for s in range(n_slices):
+            f.set_bit("standard", 1, s * SLICE_WIDTH + 1)
+        idx.set_remote_max_slice(n_slices - 1)
+        cluster = new_cluster(["local", "peer:1"], replica_n=1)
+        client = ScriptedClient(fn)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client, use_mesh=False)
+        return e, client, cluster
+
+    def test_remote_leg_fenced_on_migration_target(self, holder):
+        """The server-side read fence: a remote (opt.remote) leg for a
+        moving slice on a node that is only the TARGET owner fails
+        instead of serving the incomplete copy."""
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("f")
+        for s in range(4):
+            f.set_bit("standard", 1, s * SLICE_WIDTH + 1)
+        idx.set_remote_max_slice(3)
+        cluster = new_cluster(["a:1", "b:1"], replica_n=1)
+        # THIS node is the joining target "local".
+        e = Executor(holder, host="local", cluster=cluster,
+                     use_mesh=False)
+        cluster.install_resize("r1", ["a:1", "b:1", "local"])
+        moving = [s for s in range(4)
+                  if cluster.moving_slice("i", s) is not None]
+        assert moving, "no moving slices in this layout"
+        from pilosa_tpu.errors import SliceUnavailableError
+        with pytest.raises(SliceUnavailableError):
+            e.execute("i", 'Count(Bitmap(rowID=1, frame="f"))',
+                      slices=moving, opt=ExecOptions(remote=True))
+        # After the flip the same leg serves.
+        cluster.flip_epoch("r1")
+        res = e.execute("i", 'Count(Bitmap(rowID=1, frame="f"))',
+                        slices=moving, opt=ExecOptions(remote=True))
+        assert res[0] == len(moving)
+
+    def test_double_read_source_wins(self, holder):
+        """Migrating phase: both sides are queried; the old owner's
+        answer is authoritative and its tokens merge."""
+        def fn(node, index, query, slices, gens_out):
+            # Remote peer (old owner) answers its slices.
+            return [len(slices)]
+
+        e, client, cluster = self._setup(holder, fn)
+        cluster.install_resize("r1", ["local", "peer:1", "new:1"])
+        moving = [s for s in range(4)
+                  if cluster.moving_slice("i", s) is not None
+                  and cluster.moving_slice("i", s)[1] == ("peer:1",)]
+        if not moving:
+            pytest.skip("no peer-owned moving slice in this layout")
+        w0 = obs_metrics.RESIZE_DOUBLE_READS.labels("source").value
+        res = e.execute("i", 'Count(Bitmap(rowID=1, frame="f"))')
+        assert res[0] == 4  # scripted: every remote slice counts 1
+        assert obs_metrics.RESIZE_DOUBLE_READS.labels(
+            "source").value > w0
+
+    def test_double_read_target_wins_only_post_flip_shape(self, holder):
+        """Old side dead: the target's answer is used only when IT
+        accepted the leg (which the fence permits only once the
+        target believes the epoch advanced) — and its tokens merge as
+        the newest."""
+        from pilosa_tpu.cluster import generations as gens_mod
+        from pilosa_tpu.cluster.client import ClientError
+
+        def fn(node, index, query, slices, gens_out):
+            if node.host == "peer:1":
+                raise ClientError("old owner SIGKILLed")
+            # the target answers (it has flipped) and piggybacks
+            # fresh tokens
+            if gens_out is not None:
+                payload = gens_mod.encode_wire(
+                    index, {s: {"f/standard": (9, 5)} for s in slices})
+                gens_out.append((node.host, payload))
+            return [len(slices) * 10]
+
+        e, client, cluster = self._setup(holder, fn)
+        from pilosa_tpu.cluster.generations import GenerationMap
+        e.gens = GenerationMap(staleness_s=60.0)
+        cluster.install_resize("r1", ["local", "peer:1", "new:1"])
+        moving = [s for s in range(4)
+                  if cluster.moving_slice("i", s) is not None
+                  and cluster.moving_slice("i", s)[1] == ("peer:1",)]
+        if not moving:
+            pytest.skip("no peer-owned moving slice in this layout")
+        t0 = obs_metrics.RESIZE_DOUBLE_READS.labels("target").value
+        # Restrict to the moving slices: the scripted old owner is
+        # "dead" for every leg, and non-moving peer slices have no
+        # second copy to fail over to.
+        res = e.execute("i", 'Count(Bitmap(rowID=1, frame="f"))',
+                        slices=moving)
+        assert res[0] == 10 * len(moving)
+        assert obs_metrics.RESIZE_DOUBLE_READS.labels(
+            "target").value == t0 + 1
+        # winner tokens merged
+        assert e.gens.token("new:1", "i", "f", "standard",
+                            moving[0]) == (9, 5)
+
+    def test_double_read_stale_target_tokens_lose(self, holder):
+        """Newest-token-wins: a target whose piggybacked generation
+        REGRESSED vs the map's knowledge (same uid, lower gen) cannot
+        win even when the old side is dead."""
+        from pilosa_tpu.cluster import generations as gens_mod
+        from pilosa_tpu.cluster.client import ClientError
+
+        def fn(node, index, query, slices, gens_out):
+            if node.host == "peer:1":
+                raise ClientError("old owner dead")
+            if gens_out is not None:
+                payload = gens_mod.encode_wire(
+                    index, {s: {"f/standard": (9, 1)} for s in slices})
+                gens_out.append((node.host, payload))
+            return [999]
+
+        e, client, cluster = self._setup(holder, fn)
+        from pilosa_tpu.cluster.generations import GenerationMap
+        e.gens = GenerationMap(staleness_s=60.0)
+        cluster.install_resize("r1", ["local", "peer:1", "new:1"])
+        moving = [s for s in range(4)
+                  if cluster.moving_slice("i", s) is not None
+                  and cluster.moving_slice("i", s)[1] == ("peer:1",)]
+        if not moving:
+            pytest.skip("no peer-owned moving slice in this layout")
+        # The map already saw gen 4 from this target for the slice.
+        e.gens.apply("new:1", "i",
+                     {moving[0]: {"f/standard": (9, 4)}})
+        from pilosa_tpu.cluster.client import ClientError as CE
+        with pytest.raises(CE):
+            e.execute("i", 'Count(Bitmap(rowID=1, frame="f"))',
+                      slices=moving)
+
+    def test_double_read_partial_mode_reports_missing(self, holder):
+        """?partial=1 keeps its degraded-read contract during a
+        migration: a moving slice with BOTH sides unreachable is
+        reported missing instead of failing the query."""
+        from pilosa_tpu.cluster.client import ClientError
+
+        def fn(node, index, query, slices, gens_out):
+            raise ClientError("everyone is dead")
+
+        e, client, cluster = self._setup(holder, fn)
+        cluster.install_resize("r1", ["local", "peer:1", "new:1"])
+        moving = [s for s in range(4)
+                  if cluster.moving_slice("i", s) is not None
+                  and cluster.moving_slice("i", s)[1] == ("peer:1",)]
+        if not moving:
+            pytest.skip("no peer-owned moving slice in this layout")
+        opt = ExecOptions(partial=True)
+        res = e.execute("i", 'Count(Bitmap(rowID=1, frame="f"))',
+                        slices=moving, opt=opt)
+        assert res[0] == 0
+        assert sorted(opt.missing_slices) == sorted(moving)
+
+    def test_fast_write_lane_disabled_during_resize(self, holder):
+        """The single-node per-op fast lane must fall back to the
+        generic (union-fanning) path the moment a resize is
+        installed — a 1→2 grow's double-writes depend on it."""
+        forwarded = []
+
+        def fn(node, index, query, slices, gens_out):
+            forwarded.append((node.host, query))
+            return [True]
+
+        idx = holder.create_index_if_not_exists("i")
+        idx.create_frame_if_not_exists("f")
+        cluster = new_cluster(["local"], replica_n=1)
+        client = ScriptedClient(fn)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=client, use_mesh=False)
+        # Warm the fast lane pre-resize.
+        e.execute("i", 'SetBit(frame="f", rowID=1, columnID=1)')
+        assert not forwarded
+        cluster.install_resize("r1", ["local", "new:1"])
+        e.on_resize_change()
+        # Find a column whose slice moves (its partition gained new:1).
+        target_col = None
+        for s in range(16):
+            mv = cluster.moving_slice("i", s)
+            if mv is not None:
+                target_col = s * SLICE_WIDTH + 5
+                break
+        assert target_col is not None
+        e.execute("i", f'SetBit(frame="f", rowID=1,'
+                       f' columnID={target_col})')
+        assert forwarded, "write did not fan to the union target"
+
+    def test_grace_window_never_keys_on_frozen_local_copy(self, holder):
+        """Regression (caught by the end-to-end verify drive): inside
+        the post-finalize grace window an old owner still
+        write-ACCEPTS a moved slice (owns_fragment is true), but its
+        copy stops receiving single-path writes — the cache snapshot
+        and result keys must classify the slice by READ authority, or
+        the frozen local fragment validates stale results forever."""
+        idx = holder.create_index_if_not_exists("i")
+        f = idx.create_frame_if_not_exists("general")
+        from pilosa_tpu.cluster.generations import GenerationMap
+        cluster = new_cluster(["local", "peer:1"], replica_n=1)
+        gens = GenerationMap(staleness_s=60.0)
+        e = Executor(holder, host="local", cluster=cluster,
+                     client=ScriptedClient(lambda *a: [0]), gens=gens,
+                     use_mesh=False)
+        # A slice that moves FROM local TO the joiner.
+        cluster.install_resize("g1", ["local", "peer:1", "new:1"])
+        moved = next(
+            (s for s in range(64)
+             if cluster.moving_slice("i", s) is not None
+             and cluster.moving_slice("i", s)[1] == ("local",)), None)
+        if moved is None:
+            pytest.skip("no local-owned moving slice in this layout")
+        f.set_bit("standard", 1, moved * SLICE_WIDTH + 3)
+        cluster.flip_epoch("g1")
+        cluster.finalize_resize("g1", grace_s=60.0)
+        # Grace: local still write-accepts, but has NO read authority.
+        assert cluster.owns_fragment("local", "i", moved)
+        assert not cluster.read_allowed("local", "i", moved)
+        # The snapshot must NOT classify the moved slice as local —
+        # with no knowledge of the new owner it declines outright.
+        assert e._cluster_cache_snapshot("i", [moved]) is None
+        # With the serving owner's tokens known, it keys on THEM.
+        gens.apply("new:1", "i",
+                   {moved: {"general/standard": (9, 4)}})
+        snap = e._cluster_cache_snapshot("i", [moved])
+        assert snap is not None and moved not in snap["local"]
+        assert snap["remote"]["new:1"][moved] == {
+            "general/standard": (9, 4)}
+        # A FRESHER map entry from a peer with no read authority
+        # (e.g. an old owner's frozen copy) must not key an entry.
+        gens.apply("peer:1", "i",
+                   {moved: {"general/standard": (5, 7)}})
+        assert e._cluster_cache_snapshot("i", [moved]) is None
+        # Result-residency keys follow the same rule: the moved slice
+        # keys on the new owner's tokens, never the frozen local
+        # fragment's.
+        f.set_bit("standard", 2, moved * SLICE_WIDTH + 4)
+        call = parse_pql(
+            'Union(Bitmap(rowID=1, frame=general),'
+            ' Bitmap(rowID=2, frame=general))').calls[0]
+        key = e._bitmap_result_key("i", call, [moved])
+        assert key is not None
+        gen_entries = key[3]
+        assert any(p == "new:1" for p, _u, _g in gen_entries)
+        assert all(p != "" for p, _u, _g in gen_entries), \
+            "moved slice keyed on the frozen local fragment"
+
+    def test_epoch_bump_invalidates_result_caches(self, holder):
+        """ISSUE 12 satellite regression (also in
+        test_distributed_fastpath): entries keyed before the flip —
+        local-only keys included — never serve after it."""
+        must_set(holder, "i", "general", 10, 3)
+        must_set(holder, "i", "general", 11, 3)
+        # Pinned hasher: every partition's owner is nodes[0] in both
+        # memberships, so the epoch can bump with an EMPTY movement
+        # set and everything keeps serving locally (the key/flush
+        # mechanics are what is under test, not routing).
+        cluster = new_cluster(["local"], replica_n=1)
+        cluster.hasher = lambda key, n: 0
+        e = Executor(holder, host="local", cluster=cluster,
+                     use_mesh=False)
+        q = ('Union(Bitmap(rowID=10, frame=general),'
+             ' Bitmap(rowID=11, frame=general))')
+        e.execute("i", q)
+        assert e._bitmap_results, "warm-up did not cache"
+        key = next(iter(e._bitmap_results))
+        assert key[-1] == 0  # epoch in the key
+        # During the in-flight resize nothing caches at all.
+        cluster.install_resize("r1", ["local", "new:1"])
+        e.on_resize_change()
+        call = parse_pql(q).calls[0]
+        assert e._bitmap_result_key("i", call, [0]) is None
+        cluster.flip_epoch("r1")
+        # The eager flush drops entries touching moved slices.
+        e.on_resize_change(lambda index, s: True)
+        assert not e._bitmap_results
+        cluster.finalize_resize("r1", grace_s=0.0)
+        e.execute("i", q)
+        key2 = next(iter(e._bitmap_results))
+        assert key2[-1] == 1 and key2 != key
+
+
+# ---------------------------------------------------------------------------
+# in-process coordinator protocol (real Servers, static membership)
+
+
+def _post(host, path, body=b"{}"):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    return urllib.request.urlopen(req, timeout=30).read()
+
+
+def _query(host, index, body):
+    return json.loads(
+        _post(host, f"/index/{index}/query", body.encode()))["results"]
+
+
+def _get(host, path):
+    return json.loads(urllib.request.urlopen(
+        f"http://{host}{path}", timeout=10).read())
+
+
+def _wait_resize(host, timeout=60.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        op = _get(host, "/cluster/resize")["op"]
+        if op and op["phase"] in ("done", "aborted"):
+            return op
+        time.sleep(0.1)
+    raise AssertionError("resize did not settle")
+
+
+@pytest.fixture
+def trio(tmp_path, monkeypatch):
+    """Three in-process servers: two cross-wired as a static cluster,
+    the third booted knowing the CURRENT membership (the join
+    candidate), plus seeded data and its dict model."""
+    monkeypatch.setenv("PILOSA_TPU_MESH", "0")
+    from pilosa_tpu.cluster.client import Client
+    from pilosa_tpu.server.server import Server
+
+    servers = []
+
+    def make(name):
+        s = Server(str(tmp_path / name), host="127.0.0.1:0",
+                   anti_entropy_interval=0, polling_interval=0)
+        s.open()
+        servers.append(s)
+        return s
+
+    s1, s2, s3 = make("n1"), make("n2"), make("n3")
+    for s in servers:
+        s.cluster.nodes = [Node(s1.host), Node(s2.host)]
+    for h in (s1.host, s2.host, s3.host):
+        _post(h, "/index/rz")
+        _post(h, "/index/rz/frame/f")
+    rng = np.random.default_rng(5)
+    n_bits = 2000
+    rows = rng.integers(0, 8, n_bits).astype(np.uint64)
+    cols = rng.choice(6 * SLICE_WIDTH, size=n_bits,
+                      replace=False).astype(np.uint64)
+    Client(s1.host).import_arrays("rz", "f", rows, cols)
+    for s in servers:
+        s.holder.index("rz").set_remote_max_slice(5)
+    model: dict = {}
+    for r, c in zip(rows.tolist(), cols.tolist()):
+        model.setdefault(int(r), set()).add(int(c))
+    yield servers, model
+    failpoints.disarm_all()
+    for s in servers:
+        try:
+            s.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+
+def _differential(hosts, model, rows=range(8)):
+    for h in hosts:
+        for row in rows:
+            got = _query(h, "rz",
+                         f'Count(Bitmap(frame="f", rowID={row}))')[0]
+            assert got == len(model.get(row, set())), (h, row, got)
+
+
+class TestCoordinatorInProcess:
+    def test_grow_under_live_load_zero_wrong_answers(self, trio):
+        (s1, s2, s3), model = trio
+        stop = threading.Event()
+        errors: list = []
+
+        def loadgen():
+            i = 0
+            while not stop.is_set():
+                col = int(6 * SLICE_WIDTH - 1 - i)
+                i += 1
+                try:
+                    _query((s1, s2)[i % 2].host, "rz",
+                           f'SetBit(frame="f", rowID=30,'
+                           f' columnID={col})')
+                    for h in (s1.host, s2.host):
+                        got = _query(
+                            h, "rz",
+                            'Count(Bitmap(frame="f", rowID=2))')[0]
+                        if got != len(model[2]):
+                            errors.append((h, got, len(model[2])))
+                except Exception as e:  # noqa: BLE001 - recorded
+                    errors.append(("load", repr(e)))
+                time.sleep(0.005)
+
+        t = threading.Thread(target=loadgen)
+        t.start()
+        try:
+            _post(s1.host, "/cluster/resize", json.dumps(
+                {"hosts": [s1.host, s2.host, s3.host]}).encode())
+            op = _wait_resize(s1.host)
+        finally:
+            stop.set()
+            t.join()
+        assert op["phase"] == "done", op
+        assert not errors, errors[:5]
+        for s in (s1, s2, s3):
+            assert s.cluster.epoch == 1
+            assert len(s.cluster.nodes) == 3
+            assert s.cluster.resize is None
+        _differential((s1.host, s2.host, s3.host), model)
+        # Concurrent writes (row 30) converged identically everywhere.
+        counts = {h: _query(h, "rz",
+                            'Count(Bitmap(frame="f", rowID=30))')[0]
+                  for h in (s1.host, s2.host, s3.host)}
+        assert len(set(counts.values())) == 1, counts
+        # The joiner genuinely owns slices now.
+        assert any(s3.cluster.owns_fragment(s3.host, "rz", s)
+                   for s in range(6))
+        assert op["slicesMoved"] >= 1
+        assert op["bytesStreamed"] > 0
+
+    @pytest.mark.chaos
+    def test_torn_stream_aborts_then_retry_succeeds(self, trio):
+        (s1, s2, s3), model = trio
+        failpoints.arm("resize.stream", "torn(48)")
+        _post(s1.host, "/cluster/resize", json.dumps(
+            {"hosts": [s1.host, s2.host, s3.host]}).encode())
+        op = _wait_resize(s1.host)
+        failpoints.disarm_all()
+        assert op["phase"] == "aborted"
+        assert "resize.stream" in (op["error"] or "")
+        for s in (s1, s2, s3):
+            assert s.cluster.epoch == 0
+            assert s.cluster.resize is None
+            assert len(s.cluster.nodes) == 2
+        # The torn prefixes on the target are harmless orphans: the
+        # old epoch answers exactly.
+        _differential((s1.host, s2.host), model)
+        # Retry converges (idempotent block re-diff).
+        _post(s1.host, "/cluster/resize", json.dumps(
+            {"hosts": [s1.host, s2.host, s3.host]}).encode())
+        op = _wait_resize(s1.host)
+        assert op["phase"] == "done", op
+        _differential((s1.host, s2.host, s3.host), model)
+
+    @pytest.mark.chaos
+    def test_intermittent_stream_errors_survive(self, trio):
+        """error(p)*N injection: the pass that hits the fault aborts
+        nothing by itself — the coordinator retries passes; once the
+        budget disarms, the resize completes."""
+        (s1, s2, s3), model = trio
+        failpoints.arm("resize.stream", "error*2")
+        _post(s1.host, "/cluster/resize", json.dumps(
+            {"hosts": [s1.host, s2.host, s3.host]}).encode())
+        op = _wait_resize(s1.host)
+        failpoints.disarm_all()
+        # Two injected errors abort the FIRST attempt only if they
+        # exhaust it; either way the cluster is consistent.
+        if op["phase"] == "aborted":
+            for s in (s1, s2, s3):
+                assert s.cluster.epoch == 0
+            _differential((s1.host, s2.host), model)
+        else:
+            _differential((s1.host, s2.host, s3.host), model)
+
+    @pytest.mark.chaos
+    def test_operator_abort_mid_stream_stops_the_coordinator(self, trio):
+        """Review regression: an operator abort must CANCEL the live
+        run loop, not just broadcast — otherwise the coordinator
+        thread keeps driving and can complete a resize the operator
+        was told is aborted."""
+        (s1, s2, s3), model = trio
+        # The fixture's data holds one checksum block per fragment, so
+        # the whole stream is one long delay hit — abort lands inside
+        # it (phase "streaming" is enough; bytes only appear after the
+        # block completes).
+        failpoints.arm("resize.stream", "delay(700ms)")
+        _post(s1.host, "/cluster/resize", json.dumps(
+            {"hosts": [s1.host, s2.host, s3.host]}).encode())
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            op = _get(s1.host, "/cluster/resize")["op"] or {}
+            if op.get("phase") == "streaming":
+                break
+            if op.get("phase") in ("done", "aborted"):
+                pytest.skip("stream window closed before the abort")
+            time.sleep(0.02)
+        _post(s1.host, "/cluster/resize",
+              json.dumps({"abort": True}).encode())
+        op = _wait_resize(s1.host)
+        failpoints.disarm_all()
+        assert op["phase"] == "aborted", op
+        # The run thread must not resurrect it afterwards.
+        time.sleep(1.0)
+        assert _get(s1.host,
+                    "/cluster/resize")["op"]["phase"] == "aborted"
+        for s in (s1, s2, s3):
+            assert s.cluster.epoch == 0
+            assert s.cluster.resize is None
+        _differential((s1.host, s2.host), model)
+        # A fresh resize (new id) still goes through afterwards.
+        _post(s1.host, "/cluster/resize", json.dumps(
+            {"hosts": [s1.host, s2.host, s3.host]}).encode())
+        assert _wait_resize(s1.host)["phase"] == "done"
+        _differential((s1.host, s2.host, s3.host), model)
+
+    @pytest.mark.chaos
+    def test_partition_during_epoch_flip(self, trio):
+        """The flip-window chaos leg: the coordinator drives the
+        protocol up to the flip, then a one-way partition cuts the
+        joining target off the control plane — s1 and s2 flip, s3
+        cannot. Differential queries DURING the mixed-epoch window
+        must stay exact (flipped nodes route moved slices to the
+        target, the partitioned leg fails, the failover re-map serves
+        from the still-read-valid old owner), and once the partition
+        heals the flip completes cluster-wide."""
+        import threading as threading_mod
+
+        from pilosa_tpu.server.syncer import FragmentStreamer
+        (s1, s2, s3), model = trio
+        coord = resize_mod.ResizeCoordinator(
+            s1, [s1.host, s2.host, s3.host])
+        coord.moving = movement(
+            coord.old_hosts, coord.target_hosts,
+            s1.cluster.partition_n, s1.cluster.replica_n)
+        coord.journal.write(id=coord.id, epochFrom=0,
+                            old=coord.old_hosts,
+                            new=coord.target_hosts,
+                            coordinator=s1.host)
+        coord._set_phase(resize_mod.PHASE_PREPARING)
+        coord._send_phase(coord._message("prepare"),
+                          coord._union_hosts(), require_all=True)
+        coord._sync_slice_knowledge()
+        streamer = FragmentStreamer(
+            client_factory=s1._client_factory,
+            on_block=coord._on_stream_block)
+        coord._set_phase(resize_mod.PHASE_STREAMING)
+        for _ in range(resize_mod.MAX_STREAM_PASSES):
+            if coord._stream_pass(streamer) == 0:
+                break
+        # One-way partition: nothing from this process reaches s3.
+        failpoints.arm("rpc.send", f"partition({s3.host})")
+        flip_err: list = []
+
+        def do_flip():
+            try:
+                coord._set_phase(resize_mod.PHASE_FLIPPING)
+                coord._send_phase(coord._message("flip"),
+                                  coord._union_hosts(),
+                                  require_all=True, retries=60)
+            except Exception as e:  # noqa: BLE001 - recorded
+                flip_err.append(e)
+
+        t = threading_mod.Thread(target=do_flip)
+        t.start()
+        # The mixed-epoch window: s1 + s2 flipped, s3 fenced out.
+        deadline = time.time() + 10
+        while time.time() < deadline and not (
+                s1.cluster.epoch == 1 and s2.cluster.epoch == 1):
+            time.sleep(0.05)
+        assert s1.cluster.epoch == 1 and s2.cluster.epoch == 1
+        assert s3.cluster.epoch == 0  # partitioned: not yet flipped
+        # Differential-checked queries INSIDE the window, from both
+        # flipped coordinators: moved-slice legs to the unflipped
+        # target fail (partition + read fence) and fail over to the
+        # old owner, whose draining copy is complete — answers exact.
+        for _ in range(3):
+            _differential((s1.host, s2.host), model)
+        # Heal the partition: the flip completes cluster-wide.
+        failpoints.disarm_all()
+        t.join(timeout=60)
+        assert not t.is_alive() and not flip_err, flip_err
+        assert s3.cluster.epoch == 1
+        coord._set_phase(resize_mod.PHASE_DRAINING)
+        coord._stream_pass(streamer)
+        coord._set_phase(resize_mod.PHASE_FINALIZING)
+        coord._send_phase(coord._message("finalize"),
+                          coord._union_hosts(), require_all=False)
+        coord._set_phase(resize_mod.PHASE_DONE)
+        for s in (s1, s2, s3):
+            assert s.cluster.resize is None and s.cluster.epoch == 1
+        _differential((s1.host, s2.host, s3.host), model)
+
+    def test_shrink_back(self, trio):
+        (s1, s2, s3), model = trio
+        _post(s1.host, "/cluster/resize", json.dumps(
+            {"hosts": [s1.host, s2.host, s3.host]}).encode())
+        assert _wait_resize(s1.host)["phase"] == "done"
+        _post(s2.host, "/cluster/resize",
+              json.dumps({"remove": s3.host}).encode())
+        op = _wait_resize(s2.host)
+        assert op["phase"] == "done", op
+        for s in (s1, s2):
+            assert s.cluster.epoch == 2
+            assert len(s.cluster.nodes) == 2
+        _differential((s1.host, s2.host), model)
+
+    def test_one_resize_at_a_time(self, trio):
+        (s1, s2, s3), _model = trio
+        s1.cluster.install_resize("blocker", [s1.host, s2.host,
+                                              "x:1"])
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(s1.host, "/cluster/resize", json.dumps(
+                {"hosts": [s1.host, s2.host, s3.host]}).encode())
+        assert ei.value.code == 409
+        s1.cluster.abort_resize("blocker")
+
+    def test_journal_recovery_pre_flip_aborts(self, trio):
+        """A coordinator that died mid-STREAMING aborts back to the
+        old epoch on recovery — and the abort broadcast clears the
+        peers' installed state."""
+        (s1, s2, s3), model = trio
+        # Simulate the crashed coordinator: peers installed the
+        # resize, the journal says streaming, nobody is driving.
+        msg = ResizeMessage(id="crashed", phase="prepare", epoch=0,
+                            old_hosts=[s1.host, s2.host],
+                            new_hosts=[s1.host, s2.host, s3.host])
+        for s in (s1, s2, s3):
+            s.receive_message(msg)
+        assert all(s.cluster.resize is not None for s in (s1, s2, s3))
+        j = resize_mod.ResizeJournal.for_data_dir(s1.holder.path)
+        j.write(id="crashed", phase=resize_mod.PHASE_STREAMING,
+                epochFrom=0, old=[s1.host, s2.host],
+                new=[s1.host, s2.host, s3.host], coordinator=s1.host)
+        status = resize_mod.recover(s1)
+        assert status is not None
+        assert status["phase"] == resize_mod.PHASE_ABORTED
+        for s in (s1, s2, s3):
+            assert s.cluster.resize is None
+            assert s.cluster.epoch == 0
+        _differential((s1.host, s2.host), model)
+        # The journal records the acked abort: nothing left in flight.
+        j2 = resize_mod.ResizeJournal.for_data_dir(s1.holder.path)
+        j2.load()
+        assert not j2.in_flight()
+
+    def test_journal_recovery_post_flip_rolls_forward(self, trio):
+        """A coordinator that died after sending ANY flip rolls the
+        resize forward: flip is re-sent (nodes that lost state install
+        from the message), the drain diff runs, finalize lands."""
+        (s1, s2, s3), model = trio
+        prep = ResizeMessage(id="flipped", phase="prepare", epoch=0,
+                             old_hosts=[s1.host, s2.host],
+                             new_hosts=[s1.host, s2.host, s3.host])
+        for s in (s1, s2, s3):
+            s.receive_message(prep)
+        # Pretend the crash happened mid-flip: only s2 processed it.
+        flip = ResizeMessage(id="flipped", phase="flip", epoch=0,
+                             old_hosts=[s1.host, s2.host],
+                             new_hosts=[s1.host, s2.host, s3.host])
+        s2.receive_message(flip)
+        assert s2.cluster.epoch == 1 and s1.cluster.epoch == 0
+        j = resize_mod.ResizeJournal.for_data_dir(s1.holder.path)
+        j.write(id="flipped", phase=resize_mod.PHASE_FLIPPING,
+                epochFrom=0, old=[s1.host, s2.host],
+                new=[s1.host, s2.host, s3.host], coordinator=s1.host)
+        status = resize_mod.recover(s1)
+        assert status is not None
+        assert status["phase"] == resize_mod.PHASE_DONE, status
+        for s in (s1, s2, s3):
+            assert s.cluster.epoch == 1
+            assert len(s.cluster.nodes) == 3
+            assert s.cluster.resize is None
+        _differential((s1.host, s2.host, s3.host), model)
+
+    def test_debug_topology_and_metrics(self, trio):
+        (s1, s2, s3), _model = trio
+        topo = _get(s1.host, "/debug/topology")
+        assert topo["epoch"] == 0
+        assert sorted(topo["nodes"]) == sorted([s1.host, s2.host])
+        assert topo["resize"] is None
+        assert "rz" in topo["indexes"]
+        owners = topo["indexes"]["rz"]["owners"]
+        assert set(owners) == {str(s) for s in range(6)}
+        # In-flight state surfaces (install a resize by hand).
+        s1.cluster.install_resize("t1", [s1.host, s2.host, s3.host])
+        topo = _get(s1.host, "/debug/topology")
+        assert topo["resize"]["id"] == "t1"
+        assert topo["resize"]["phase"] == "migrating"
+        moving = topo["indexes"]["rz"].get("movingSlices", [])
+        assert moving, "no moving slices reported"
+        s1.cluster.abort_resize("t1")
+        # Metric families exist and render.
+        text = urllib.request.urlopen(
+            f"http://{s1.host}/metrics", timeout=10).read().decode()
+        for fam in ("pilosa_cluster_resize_state",
+                    "pilosa_resize_slices_moved_total",
+                    "pilosa_resize_stream_bytes_total",
+                    "pilosa_cluster_resize_double_reads_total"):
+            assert fam in text, fam
+
+    def test_watchdog_resize_stall_cause(self, trio):
+        """A coordinator whose active phase stops progressing trips
+        the watchdog's resize_stall cause."""
+        from pilosa_tpu.obs.watchdog import Watchdog
+        (s1, s2, s3), _model = trio
+        coord = resize_mod.ResizeCoordinator(
+            s1, [s1.host, s2.host, s3.host])
+        coord.phase = resize_mod.PHASE_STREAMING
+        coord.last_progress = time.monotonic() - 100.0
+        s1.resize_op = coord
+        wd = Watchdog(resize_progress_fn=s1._resize_progress,
+                      resize_stall_s=5.0, wal_stall_s=0,
+                      deadline_grace_s=0, gossip_silence_s=0,
+                      queue_stall_s=0)
+        fired = wd.check()
+        assert any(c == "resize_stall" for c, _ in fired), fired
+        assert obs_metrics.WATCHDOG_TRIPS.labels(
+            "resize_stall").value >= 1
+        s1.resize_op = None
+
+    def test_blackbox_state_has_resize_block(self, trio):
+        (s1, s2, s3), _model = trio
+        state = s1._blackbox_state()
+        assert state["resize"]["epoch"] == 0
+        assert state["resize"]["inFlight"] is None
+        s1.cluster.install_resize("bb", [s1.host, s2.host, s3.host])
+        state = s1._blackbox_state()
+        assert state["resize"]["inFlight"]["id"] == "bb"
+        s1.cluster.abort_resize("bb")
+
+    def test_anti_entropy_skips_moving_fragments(self, trio):
+        """The syncer must leave moving fragments to the streamer — a
+        consensus merge with an incomplete target could clear
+        not-yet-streamed bits."""
+        from pilosa_tpu.server.syncer import HolderSyncer
+        (s1, s2, s3), model = trio
+        s1.cluster.install_resize("ae", [s1.host, s2.host, s3.host])
+        synced = []
+
+        class SpyingSyncer(HolderSyncer):
+            def sync_fragment(self, index, frame, view, slice):
+                synced.append(slice)
+
+        SpyingSyncer(s1.holder, s1.host, s1.cluster,
+                     client_factory=s1._client_factory).sync_holder()
+        moving = {s for s in range(6)
+                  if s1.cluster.moving_slice("rz", s) is not None}
+        assert moving
+        assert not (set(synced) & moving), (synced, moving)
+        s1.cluster.abort_resize("ae")
