@@ -299,6 +299,17 @@ def main() -> None:
                     "on_p50_ms": oo.get("on_p50_ms"),
                     "off_p50_ms": oo.get("off_p50_ms"),
                     "target_ratio": oo.get("target_ratio")}
+            # Metric-history + sentinel overhead (suite.
+            # config_obs_history): whole-registry sampling + rule
+            # evaluation vs all-off, interleaved A/B — ISSUE 13's
+            # ≤2% acceptance bound, on the line of record.
+            oh = manifest.get("obs_history") or {}
+            if oh.get("ratio") is not None:
+                line["obs_history"] = {
+                    "ratio": oh["ratio"],
+                    "on_p50_ms": oh.get("on_p50_ms"),
+                    "off_p50_ms": oh.get("off_p50_ms"),
+                    "target_ratio": oh.get("target_ratio")}
             dt = manifest.get("distributed_topn") or {}
             if dt.get("topn_pushdown_p50_ms") is not None:
                 line["distributed_topn"] = {

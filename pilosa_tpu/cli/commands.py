@@ -253,6 +253,12 @@ def load_server_config(args, env=None):
         cfg.trace.max_traces = args.trace_max_traces
     if getattr(args, "metrics_accounting", None) is not None:
         cfg.metrics.accounting = _parse_bool(args.metrics_accounting)
+    if getattr(args, "history_enabled", None) is not None:
+        cfg.history.enabled = _parse_bool(args.history_enabled)
+    if getattr(args, "sentinel_enabled", None) is not None:
+        cfg.sentinel.enabled = _parse_bool(args.sentinel_enabled)
+    if getattr(args, "sentinel_manifest", ""):
+        cfg.sentinel.manifest = args.sentinel_manifest
     if getattr(args, "profile_continuous", None) is not None:
         cfg.profile.continuous = _parse_bool(args.profile_continuous)
     if getattr(args, "profile_hz", None) is not None:
@@ -312,7 +318,9 @@ def cmd_server(args, stdout, stderr) -> int:
                     blackbox_config=cfg.blackbox,
                     watchdog_config=cfg.watchdog,
                     resize_pace_s=cfg.cluster.resize_pace,
-                    resize_grace_s=cfg.cluster.resize_grace)
+                    resize_grace_s=cfg.cluster.resize_grace,
+                    history_config=cfg.history,
+                    sentinel_config=cfg.sentinel)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -733,6 +741,18 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--blackbox.enabled", dest="blackbox_enabled",
                    default=None,
                    help="blackbox flight recorder (default true)")
+    s.add_argument("--history.enabled", dest="history_enabled",
+                   default=None,
+                   help="on-disk metric history under the data dir"
+                        " (default true)")
+    s.add_argument("--sentinel.enabled", dest="sentinel_enabled",
+                   default=None,
+                   help="regression sentinel over the metric history"
+                        " (default true)")
+    s.add_argument("--sentinel.manifest", dest="sentinel_manifest",
+                   default="", metavar="PATH",
+                   help="benchmarks/MANIFEST.json whose committed"
+                        " envelope live latencies must stay inside")
     s.add_argument("--watchdog.enabled", dest="watchdog_enabled",
                    default=None,
                    help="stall watchdog (default true)")
@@ -815,6 +835,21 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--op", default="", help="benchmark operation"
                                             " (set-bit)")
     c.add_argument("-n", type=int, default=0, help="operation count")
+
+    c = sub.add_parser(
+        "top", help="live fleet dashboard over the federation"
+                    " endpoints (docs/OBSERVABILITY.md)")
+    c.add_argument("--host", default="localhost:10101",
+                   help="any cluster member (it federates the fleet)")
+    c.add_argument("--interval", type=parse_duration, default=2.0,
+                   metavar="DUR", help="poll interval (default 2s)")
+    c.add_argument("--window", default="10m", metavar="DUR",
+                   help="history window for the sparkline"
+                        " (default 10m)")
+    c.add_argument("--once", action="store_true",
+                   help="render one frame and exit (scripts, tests)")
+    from .top import cmd_top
+    c.set_defaults(fn=cmd_top)
 
     c = sub.add_parser(
         "resize", help="drive / inspect an elastic cluster resize")

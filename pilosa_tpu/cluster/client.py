@@ -443,6 +443,46 @@ class Client:
         status, raw = self._do("GET", "/debug/queries", host=host)
         return json.loads(self._ok(status, raw, "debug queries"))
 
+    # -- fleet observability (obs.federate; docs/OBSERVABILITY.md) -----------
+
+    def metrics_text(self, host: Optional[str] = None,
+                     deadline_s: Optional[float] = None) -> str:
+        """GET /metrics: one peer's Prometheus exposition — the
+        federation scrape leg (/metrics/cluster). ``deadline_s`` is
+        the per-peer scrape budget; the breaker consult in _do makes a
+        dead peer fail this fast instead of paying the timeout."""
+        status, raw = self._do("GET", "/metrics", host=host,
+                               deadline_s=deadline_s)
+        return self._ok(status, raw, "metrics scrape").decode()
+
+    def debug_cluster_local(self, host: Optional[str] = None,
+                            deadline_s: Optional[float] = None
+                            ) -> dict:
+        """GET /debug/cluster?local=1: one peer's local debug rollup
+        block (build, epoch, breakers, SLO burn, WAL health, resize
+        phase) — the /debug/cluster fan-out leg."""
+        status, raw = self._do("GET", "/debug/cluster?local=1",
+                               host=host, deadline_s=deadline_s)
+        return json.loads(self._ok(status, raw, "debug cluster"))
+
+    def metrics_history(self, family: str = "", label: str = "",
+                        window: str = "", step: str = "",
+                        host: Optional[str] = None,
+                        deadline_s: Optional[float] = None) -> dict:
+        """GET /debug/metrics/history: one peer's metric-history
+        series — the scope=cluster federation leg."""
+        from urllib.parse import urlencode
+        params = {k: v for k, v in (("family", family),
+                                    ("label", label),
+                                    ("window", window),
+                                    ("step", step)) if v}
+        path = "/debug/metrics/history"
+        if params:
+            path += "?" + urlencode(params)
+        status, raw = self._do("GET", path, host=host,
+                               deadline_s=deadline_s)
+        return json.loads(self._ok(status, raw, "metrics history"))
+
     def cancel_query(self, query_id: str,
                      host: Optional[str] = None) -> dict:
         """DELETE /debug/queries/{id}: cancel a query on this node;

@@ -688,6 +688,16 @@ class GossipNodeSet:
                 out["resize_state"] = self._handler.resize_wire_state()
             except Exception:  # noqa: BLE001 - piggyback best-effort
                 pass
+        # Build identity (obs.runtime.build_info): rides every
+        # push/pull so version skew across a mixed-version fleet is
+        # visible from any member during a rolling restart
+        # (/debug/cluster's gossipBuilds block).
+        if self._handler is not None and hasattr(
+                self._handler, "build_wire_state"):
+            try:
+                out["build_state"] = self._handler.build_wire_state()
+            except Exception:  # noqa: BLE001 - piggyback best-effort
+                pass
         return out
 
     def _absorb_state(self, state: dict) -> None:
@@ -705,6 +715,13 @@ class GossipNodeSet:
                 self._handler.apply_resize_wire_state(rz)
             except Exception as e:  # noqa: BLE001 - merge best-effort
                 self.logger.printf("gossip: resize merge error: %s", e)
+        bd = state.get("build_state")
+        if bd and self._handler is not None and hasattr(
+                self._handler, "apply_build_wire_state"):
+            try:
+                self._handler.apply_build_wire_state(bd)
+            except Exception:  # noqa: BLE001 - piggyback best-effort
+                pass
         status_b64 = state.get("status_pb")
         if status_b64 and self._handler is not None and hasattr(
                 self._handler, "handle_remote_status"):

@@ -39,6 +39,22 @@ runtime collector.
   stuck past deadline grace, gossip silence, non-draining admission
   queue → ``pilosa_watchdog_trips_total{cause}``, force-kept
   in-flight traces, a blackbox dump.
+- ``obs.history`` — the on-disk metric history: every registry
+  family sampled on the collector cadence into bounded
+  multi-resolution rings (counters as rates, histograms as
+  p50/p99/rate series) persisted crash-safe under the data dir;
+  served at ``GET /debug/metrics/history``.
+- ``obs.federate`` — cluster-wide aggregation at query time:
+  ``GET /metrics/cluster`` (counters sum, histograms merge, gauges
+  per-node) and the ``GET /debug/cluster`` fleet rollup, over a
+  bounded breaker-aware parallel scrape with the ``?partial=1``
+  degradation contract.
+- ``obs.sentinel`` — the regression sentinel: robust-z rules over
+  the live history plus committed-envelope rules against
+  benchmarks/MANIFEST.json; a finding raises
+  ``pilosa_sentinel_findings_total{metric,direction}``, force-keeps
+  in-flight traces (reason ``anomaly``), and lands a blackbox
+  snapshot naming the regressed metric.
 
 See docs/OBSERVABILITY.md for the metric name reference, the trace
 and cost wire contracts, and the perfetto/speedscope how-tos.

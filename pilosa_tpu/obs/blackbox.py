@@ -94,14 +94,20 @@ class Blackbox:
 
     # -- recording ------------------------------------------------------------
 
-    def snapshot(self, trigger: str = "manual") -> dict:
-        """One whole-system state sample into the ring."""
+    def snapshot(self, trigger: str = "manual",
+                 extra: Optional[dict] = None) -> dict:
+        """One whole-system state sample into the ring. ``extra``
+        rides the record verbatim — the regression sentinel names the
+        regressed metric there, so the snapshot self-documents WHY it
+        was taken (the trigger label stays low-cardinality)."""
         snap = {"ts": time.time(), "node": self.node,
                 "trigger": trigger}
         try:
             snap.update(self.state_fn() or {})
         except Exception as e:  # noqa: BLE001 - partial state beats none
             snap["stateError"] = str(e)[:200]
+        if extra:
+            snap.update(extra)
         self.ring.append(snap)
         obs_metrics.BLACKBOX_SNAPSHOTS.labels(trigger).inc()
         return snap

@@ -317,8 +317,25 @@ def _fmt(v: float) -> str:
 
 
 def _escape(v: str) -> str:
+    """Label-VALUE escaping per the exposition spec: backslash, double
+    quote, and line feed (in that order — escaping the backslash last
+    would corrupt the other two escapes)."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n",
                                                                "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: ONLY backslash and line feed. ``\\"`` is
+    not a valid escape sequence in help text — emitting it (the old
+    shared escaper did) renders a spec-invalid line that strict
+    OpenMetrics parsers reject."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+# Public faces for the federation renderer (obs.federate) and tests:
+# one escaping implementation, every exposition writer.
+escape_label_value = _escape
+escape_help = _escape_help
 
 
 class Registry:
@@ -381,7 +398,8 @@ class Registry:
                     and name.endswith("_total")):
                 om_name = name[: -len("_total")]
             if fam.help:
-                lines.append(f"# HELP {om_name} {_escape(fam.help)}")
+                lines.append(
+                    f"# HELP {om_name} {_escape_help(fam.help)}")
             lines.append(f"# TYPE {om_name} {fam.type}")
             for suffix, labels, value, exemplar in fam.samples_ex():
                 if labels:
@@ -408,6 +426,9 @@ def _fnum(v: float) -> str:
     if isinstance(v, int) or v == int(v):
         return str(int(v))
     return repr(v)
+
+
+format_value = _fnum  # the federation renderer's sample formatting
 
 
 def _fnum_om(v: float) -> str:
@@ -505,7 +526,7 @@ TRACES_KEPT = _DEFAULT.counter(
     "pilosa_trace_kept_total",
     "Traces retained by the tail sampler, by keep reason (slow/error/"
     "deadline/cancelled/partial/shed/breaker/failpoint/head/requested/"
-    "watchdog — docs/OBSERVABILITY.md keep-reason catalogue)",
+    "watchdog/anomaly — docs/OBSERVABILITY.md keep-reason catalogue)",
     labels=("reason",))
 TRACE_DISK_RECORDS = _DEFAULT.counter(
     "pilosa_trace_disk_records_total",
@@ -658,6 +679,47 @@ RESIZE_DOUBLE_READS = _DEFAULT.counter(
     " target (old side failed; the new owner's post-flip answer won"
     " with the newest generation tokens)",
     labels=("winner",))
+HISTORY_SAMPLES = _DEFAULT.counter(
+    "pilosa_history_samples_total",
+    "Metric-history sampling passes over the registry (obs.history —"
+    " one pass per runtime-collector tick)")
+HISTORY_SERIES_LIVE = _DEFAULT.gauge(
+    "pilosa_history_series_live",
+    "Series held in the on-disk metric history's in-memory rings"
+    " (bounded by the per-process series cap)")
+HISTORY_SERIES_DROPPED = _DEFAULT.counter(
+    "pilosa_history_series_dropped_total",
+    "New series the metric history refused past its series cap — a"
+    " nonzero value means some families' label growth outran the"
+    " retention budget")
+HISTORY_DISK_RECORDS = _DEFAULT.counter(
+    "pilosa_history_disk_records_total",
+    "Metric-history tick records persisted to the per-resolution"
+    " segment rings, by outcome (written / dropped)",
+    labels=("outcome",))
+FEDERATION_SCRAPES = _DEFAULT.counter(
+    "pilosa_federation_scrapes_total",
+    "Cluster-federation fan-out legs (/metrics/cluster,"
+    " /debug/cluster, history scope=cluster), by peer and outcome —"
+    " error legs are the partial-result denominator",
+    labels=("peer", "outcome"))
+SENTINEL_FINDINGS = _DEFAULT.counter(
+    "pilosa_sentinel_findings_total",
+    "Regression-sentinel findings raised, by watched metric and"
+    " direction (up = regressed slower/hotter, down = cliff): a"
+    " robust-z anomaly against the trailing baseline or a breach of"
+    " the committed MANIFEST envelope (obs.sentinel;"
+    " docs/OBSERVABILITY.md rule catalogue)",
+    labels=("metric", "direction"))
+SENTINEL_ACTIVE = _DEFAULT.gauge(
+    "pilosa_sentinel_findings_active",
+    "1 while a sentinel finding's condition still holds on the most"
+    " recent evaluation, 0 once it recovers, by watched metric and"
+    " direction",
+    labels=("metric", "direction"))
+SENTINEL_CHECKS = _DEFAULT.counter(
+    "pilosa_sentinel_checks_total",
+    "Regression-sentinel evaluation passes (every rule, every pass)")
 
 
 # -- legacy StatsClient bridge ------------------------------------------------

@@ -77,7 +77,7 @@ def build_info() -> dict:
 class RuntimeCollector:
     def __init__(self, holder=None, executor=None, admission=None,
                  registry=None, interval_s: float = DEFAULT_INTERVAL_S,
-                 slo=None, profiler=None):
+                 slo=None, profiler=None, history=None):
         self.holder = holder
         self.executor = executor
         self.admission = admission
@@ -86,6 +86,11 @@ class RuntimeCollector:
         # cadence so /status carries both.
         self.slo = slo
         self.profiler = profiler
+        # Metric history (obs.history): one registry-wide sampling
+        # pass per collector tick — AFTER the gauges above refresh, so
+        # each tick's rings see this tick's sizes. The store guards
+        # against the on-demand /status path double-sampling a tick.
+        self.history = history
         self.registry = registry or obs_metrics.default_registry()
         self.interval_s = interval_s
         self._mu = threading.Lock()
@@ -102,7 +107,15 @@ class RuntimeCollector:
         self._thread.start()
 
     def stop(self) -> None:
+        """Stop AND join: callers close the metric history right
+        after, and a collector thread still mid-collect would write
+        a fresh history segment past the close."""
         self._stop.set()
+        thread = self._thread
+        if thread is not None \
+                and thread is not threading.current_thread():
+            thread.join(timeout=5.0)
+        self._thread = None
 
     def _run(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -141,6 +154,12 @@ class RuntimeCollector:
                 pass
         if self.profiler is not None:
             snap["profiler"] = self.profiler.snapshot()
+        if self.history is not None:
+            try:
+                self.history.sample()
+                snap["history"] = self.history.stats()
+            except Exception:  # noqa: BLE001 - history must not break /status
+                pass
         with self._mu:
             self._last = snap
         return snap

@@ -26,8 +26,10 @@ inverts the decision to *query end*, when the outcome is known:
 The keep-reason catalogue (docs/OBSERVABILITY.md):
 ``slow``, ``error``, ``deadline``, ``cancelled``, ``partial``,
 ``shed``, ``breaker``, ``failpoint``, ``head``, ``requested`` (the
-explicit [trace] enabled / ?trace=1 / coordinator-asked paths), and
-``watchdog`` (in-flight traces force-kept on a stall trip).
+explicit [trace] enabled / ?trace=1 / coordinator-asked paths),
+``watchdog`` (in-flight traces force-kept on a stall trip), and
+``anomaly`` (force-kept by a regression-sentinel finding,
+obs.sentinel).
 """
 
 from __future__ import annotations
@@ -42,9 +44,11 @@ from .diskring import SegmentRing
 from .trace import Span, Trace
 
 # Keep reasons, in decision order (the first matching wins).
+# ``watchdog`` and ``anomaly`` are force-keeps claimed mid-flight (a
+# stall trip / a sentinel finding), not end-of-query decisions.
 REASONS = ("deadline", "cancelled", "error", "shed", "partial",
            "breaker", "failpoint", "slow", "head", "requested",
-           "watchdog")
+           "watchdog", "anomaly")
 
 DEFAULT_HEAD_N = 1000
 DEFAULT_SLOW_FLOOR_S = 0.1

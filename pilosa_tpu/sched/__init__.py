@@ -16,7 +16,7 @@ See docs/SCHEDULING.md for the lifecycle diagram and wire contract.
 from .admission import (AdmissionController, AdmissionFullError,  # noqa: F401
                         Slot)
 from .context import (DEADLINE_HEADER, LANE_ADMIN, LANE_READ,  # noqa: F401
-                      LANE_WRITE, QUERY_ID_HEADER, QueryContext,
+                      LANE_WRITE, LANES, QUERY_ID_HEADER, QueryContext,
                       check_current, current, use)
 from .registry import QueryRegistry  # noqa: F401
 from .warmup import Warmup, warmup_enabled  # noqa: F401

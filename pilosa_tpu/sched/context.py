@@ -33,10 +33,14 @@ from typing import Optional
 
 from ..errors import QueryCancelledError, QueryDeadlineError
 
-# Lanes the admission controller schedules between.
+# Lanes the admission controller schedules between. LANES is the
+# canonical display order (the `pilosa-tpu top` per-lane table and
+# any other lane-enumerating consumer read it from here instead of
+# hardcoding the strings).
 LANE_READ = "read"
 LANE_WRITE = "write"
 LANE_ADMIN = "admin"
+LANES = (LANE_READ, LANE_WRITE, LANE_ADMIN)
 
 # Wire headers for cluster fan-out propagation.
 DEADLINE_HEADER = "X-Pilosa-Deadline"

@@ -213,7 +213,8 @@ class Handler:
                  warmup=None, default_timeout_s: float = 0.0,
                  tracer=None, runtime=None, profiler=None, health=None,
                  accounting: bool = True, fault=None, sampler=None,
-                 blackbox=None, watchdog=None):
+                 blackbox=None, watchdog=None, history=None,
+                 sentinel=None, federator=None):
         from ..utils import logger as logger_mod
         self.logger = logger or logger_mod.NOP
         self.holder = holder
@@ -250,6 +251,18 @@ class Handler:
         # behind /debug/blackbox*; None serves empty state.
         self.blackbox = blackbox
         self.watchdog = watchdog
+        # Fleet observability (obs.history / obs.sentinel /
+        # obs.federate): the on-disk metric history behind
+        # /debug/metrics/history, the regression sentinel behind
+        # /debug/sentinel, and the federator behind /metrics/cluster +
+        # /debug/cluster. A bare handler keeps a peerless federator so
+        # the cluster routes serve single-node answers.
+        self.history = history
+        self.sentinel = sentinel
+        if federator is None:
+            from ..obs.federate import Federator
+            federator = Federator(host)
+        self.federator = federator
         # Continuous profiler (obs.profile) behind /debug/pprof/flame —
         # the module default is NOT started, so bare handlers serve the
         # route with an empty ring and zero sampling overhead.
@@ -328,6 +341,10 @@ class Handler:
         r("GET", "/debug/queries/slow", self._handle_debug_slow_queries)
         r("DELETE", "/debug/queries/{qid}", self._handle_delete_query)
         r("GET", "/debug/traces", self._handle_debug_traces)
+        # /summary must register BEFORE the {qid} wildcard or the
+        # wildcard swallows it.
+        r("GET", "/debug/traces/summary",
+          self._handle_debug_traces_summary)
         r("GET", "/debug/traces/{qid}", self._handle_debug_trace)
         r("GET", "/debug/blackbox", self._handle_debug_blackbox)
         r("POST", "/debug/blackbox/dump",
@@ -335,7 +352,12 @@ class Handler:
         r("GET", "/debug/failpoints", self._handle_debug_failpoints)
         r("POST", "/debug/failpoints", self._handle_post_failpoints)
         r("GET", "/debug/vars", self._handle_expvar)
+        r("GET", "/debug/metrics/history",
+          self._handle_metrics_history)
+        r("GET", "/debug/cluster", self._handle_debug_cluster)
+        r("GET", "/debug/sentinel", self._handle_debug_sentinel)
         r("GET", "/metrics", self._handle_metrics)
+        r("GET", "/metrics/cluster", self._handle_metrics_cluster)
         r("GET", "/debug/pprof", self._handle_pprof_index)
         r("GET", "/debug/pprof/", self._handle_pprof_index)
         r("GET", "/debug/pprof/profile", self._handle_pprof_profile)
@@ -926,49 +948,233 @@ class Handler:
         samplers (the O(fragments) holder walk, compile/residency
         snapshots) stay on the runtime collector's background cadence
         — a scrape must not get slower as the index grows."""
-        if self.admission is not None:
-            adm = self.admission.snapshot()
-            obs_metrics.ADMISSION_IN_FLIGHT.set(adm.get("inFlight", 0))
-            for lane, depth in (adm.get("queued") or {}).items():
-                obs_metrics.ADMISSION_QUEUE_DEPTH.labels(lane).set(depth)
         # Content negotiation: an OpenMetrics scraper gets exemplars
         # (the trace/query id riding each latency bucket); everyone
-        # else keeps the plain 0.0.4 exposition byte-for-byte.
+        # else keeps the plain 0.0.4 exposition byte-for-byte (the
+        # same body the federation legs scrape — one implementation).
         if "application/openmetrics-text" in req.accept:
+            self._refresh_scrape_gauges()
             body = obs_metrics.default_registry().render(
                 openmetrics=True).encode()
             return Response(
                 200, body,
                 "application/openmetrics-text; version=1.0.0;"
                 " charset=utf-8")
-        body = obs_metrics.default_registry().render().encode()
-        return Response(200, body,
+        return Response(200, self._local_metrics_text().encode(),
                         "text/plain; version=0.0.4; charset=utf-8")
+
+    # -- fleet observability (obs.federate / obs.history / obs.sentinel) -----
+
+    def _refresh_scrape_gauges(self) -> None:
+        """Only the CHEAP admission gauges refresh at scrape time; the
+        heavy samplers stay on the runtime collector's cadence."""
+        if self.admission is not None:
+            adm = self.admission.snapshot()
+            obs_metrics.ADMISSION_IN_FLIGHT.set(adm.get("inFlight", 0))
+            for lane, depth in (adm.get("queued") or {}).items():
+                obs_metrics.ADMISSION_QUEUE_DEPTH.labels(lane).set(
+                    depth)
+
+    def _local_metrics_text(self) -> str:
+        """The local 0.0.4 exposition exactly as /metrics serves it —
+        also the body the /metrics/cluster local leg merges."""
+        self._refresh_scrape_gauges()
+        return obs_metrics.default_registry().render()
+
+    def _partial_or_503(self, req: Request, missing: list[str],
+                        headers: list) -> None:
+        """The federation partial contract (docs/OBSERVABILITY.md):
+        unreachable peers fail the request unless ``?partial=1``, in
+        which case the merged answer is served and the missing nodes
+        ride ``X-Pilosa-Partial-Nodes``."""
+        if not missing:
+            return
+        if req.query.get("partial") != "1":
+            raise HTTPError(
+                503, "federation incomplete; unreachable nodes: "
+                     + ",".join(missing)
+                     + " (retry with ?partial=1 for a marked partial"
+                       " rollup)")
+        headers.append(("X-Pilosa-Partial-Nodes", ",".join(missing)))
+
+    def _handle_metrics_cluster(self, req: Request) -> Response:
+        """Cluster-wide Prometheus exposition: ONE bounded parallel
+        scrape of every peer's /metrics (pooled clients — an open
+        breaker fails a dead peer's leg fast), merged at query time:
+        counters sum, histograms merge, gauges stay per-node labeled
+        ``{node}``. The Monarch shape: history lives at the leaf,
+        aggregation happens when the question is asked."""
+        from ..obs import federate as obs_federate
+        fed = self.federator
+
+        def fetch(host: str) -> dict:
+            client = fed.client_for(host)
+            return obs_federate.parse_exposition(
+                client.metrics_text(host=host,
+                                    deadline_s=fed.peer_timeout_s))
+
+        results, missing = fed.fan_out(
+            fetch,
+            lambda: obs_federate.parse_exposition(
+                self._local_metrics_text()))
+        headers: list = []
+        self._partial_or_503(req, missing, headers)
+        body = obs_federate.render_merged(
+            obs_federate.merge_node_families(results)).encode()
+        headers.append(("X-Pilosa-Federated-Nodes",
+                        str(len(results))))
+        return Response(200, body,
+                        "text/plain; version=0.0.4; charset=utf-8",
+                        headers=headers)
+
+    def _handle_debug_cluster(self, req: Request) -> Response:
+        """The fleet rollup: every node's local debug block (build
+        info, placement epoch, breaker states, SLO burn, WAL flusher
+        health, resize phase — the blackbox state, fleet-wide), plus
+        a version-skew verdict. ``?local=1`` answers just this node's
+        block (the internal leg the coordinator fans out)."""
+        state_fn = getattr(self.status_handler, "local_debug_state",
+                           None)
+
+        def local() -> dict:
+            if state_fn is not None:
+                return state_fn()
+            from ..obs.runtime import build_info
+            return {"host": self.host, "build": build_info()}
+
+        if req.query.get("local") == "1":
+            return Response.json(local())
+        fed = self.federator
+
+        def fetch(host: str) -> dict:
+            client = fed.client_for(host)
+            return client.debug_cluster_local(
+                host=host, deadline_s=fed.peer_timeout_s)
+
+        results, missing = fed.fan_out(fetch, local)
+        headers: list = []
+        self._partial_or_503(req, missing, headers)
+        versions: dict[str, str] = {}
+        for host, block in results.items():
+            versions[host] = str(
+                (block.get("build") or {}).get("version", ""))
+        # Gossip-learned builds cover nodes a scrape can't reach (the
+        # rolling-restart window where skew matters most).
+        local_block = results.get(self.host) or {}
+        for host, build in (local_block.get("gossipBuilds")
+                            or {}).items():
+            versions.setdefault(host, str(build.get("version", "")))
+        distinct = {v for v in versions.values() if v}
+        return Response.json(
+            {"coordinator": self.host,
+             "nodes": results,
+             "missing": missing,
+             "versions": versions,
+             "versionSkew": len(distinct) > 1},
+            headers=headers)
+
+    def _handle_metrics_history(self, req: Request) -> Response:
+        """The on-disk metric history (obs.history) as JSON series:
+        ``?family=`` selects a family (and its derived ``:p50``/
+        ``:p99``/``:rate`` forms), ``?label=k=v[,k=v]`` filters,
+        ``?window=``/``?step=`` pick the trailing window and
+        resolution hint. ``?scope=cluster`` asks every node the same
+        question and returns the series with per-node attribution."""
+        from ..utils.config import parse_duration
+        family = req.query.get("family", "")
+        window_s, step_s = 3600.0, 0.0
+        try:
+            if req.query.get("window"):
+                window_s = parse_duration(req.query["window"])
+            if req.query.get("step"):
+                step_s = parse_duration(req.query["step"])
+        except ValueError:
+            raise HTTPError(400, "invalid window/step")
+        label_filter: dict = {}
+        for pair in (req.query.get("label") or "").split(","):
+            if not pair:
+                continue
+            k, sep, v = pair.partition("=")
+            if not sep:
+                raise HTTPError(400, f"invalid label filter: {pair!r}")
+            label_filter[k] = v
+
+        def local() -> dict:
+            if self.history is None:
+                return {"family": family, "series": [],
+                        "enabled": False}
+            return self.history.series(
+                family, label_filter or None, window_s, step_s)
+
+        if req.query.get("scope") != "cluster":
+            out = local()
+            out.setdefault("enabled", self.history is not None)
+            return Response.json(out)
+        fed = self.federator
+
+        def fetch(host: str) -> dict:
+            client = fed.client_for(host)
+            return client.metrics_history(
+                family=family, label=req.query.get("label", ""),
+                window=req.query.get("window", ""),
+                step=req.query.get("step", ""), host=host,
+                deadline_s=fed.peer_timeout_s)
+
+        results, missing = fed.fan_out(fetch, local)
+        headers: list = []
+        self._partial_or_503(req, missing, headers)
+        series = []
+        for host in sorted(results):
+            for s in results[host].get("series") or []:
+                series.append({**s, "node": host})
+        return Response.json(
+            {"family": family, "scope": "cluster",
+             "windowS": window_s, "missing": missing,
+             "series": series},
+            headers=headers)
+
+    def _handle_debug_sentinel(self, req: Request) -> Response:
+        """The regression sentinel's state: recent findings, active
+        conditions, and the rule thresholds (obs.sentinel)."""
+        out: dict = {"enabled": self.sentinel is not None}
+        if self.sentinel is not None:
+            out.update(self.sentinel.snapshot())
+        return Response.json(out)
 
     def _handle_debug_traces(self, req: Request) -> Response:
         """The in-memory ring by default; ``?source=disk`` lists the
         PERSISTED kept traces (tail sampler's segment ring — survives
         restarts), ``?reason=<keep-reason>`` filters either source,
-        ``?limit=N`` bounds the listing (default 100)."""
+        ``?limit=N&offset=M`` page through the listing (newest first,
+        default limit 100) — so the disk ring is browsable without
+        streaming every kept trace."""
         from ..obs import sampler as obs_sampler
         reason = req.query.get("reason", "")
         try:
             limit = max(1, int(req.query.get("limit", "100")))
+            offset = max(0, int(req.query.get("offset", "0")))
         except ValueError:
-            raise HTTPError(400, "invalid limit")
+            raise HTTPError(400, "invalid limit/offset")
         if req.query.get("source") == "disk":
             disk = self.sampler.disk if self.sampler is not None \
                 else None
             traces: list[dict] = []
+            matched = 0
             if disk is not None:
                 for record in disk.scan():
                     if reason and record.get("reason") != reason:
                         continue
-                    traces.append(obs_sampler.record_summary(record))
-                    if len(traces) >= limit:
-                        break
+                    matched += 1
+                    if matched <= offset:
+                        continue
+                    if len(traces) < limit:
+                        traces.append(
+                            obs_sampler.record_summary(record))
+                        # Keep counting past the page: ``total`` tells
+                        # the pager whether another page exists.
             out = {"enabled": self.tracer.enabled, "source": "disk",
-                   "traces": traces}
+                   "traces": traces, "offset": offset, "limit": limit,
+                   "total": matched}
             if disk is not None:
                 out["disk"] = disk.stats()
             return Response.json(out)
@@ -977,7 +1183,27 @@ class Handler:
             traces = [t for t in traces if t.get("reason") == reason]
         return Response.json({"enabled": self.tracer.enabled,
                               "tail": self.sampler is not None,
-                              "traces": traces[:limit]})
+                              "offset": offset, "limit": limit,
+                              "total": len(traces),
+                              "traces": traces[offset:offset + limit]})
+
+    def _handle_debug_traces_summary(self, req: Request) -> Response:
+        """Keep-reason roll-up over both stores: how many kept traces
+        per reason in the in-memory ring and the on-disk segment ring
+        — the browse-entry point before paging /debug/traces."""
+        ring: dict[str, int] = {}
+        for t in self.tracer.traces():
+            r = t.get("reason") or "unkept"
+            ring[r] = ring.get(r, 0) + 1
+        disk_counts: dict[str, int] = {}
+        out: dict = {"ring": ring, "disk": disk_counts}
+        disk = self.sampler.disk if self.sampler is not None else None
+        if disk is not None:
+            for record in disk.scan():
+                r = str(record.get("reason") or "unknown")
+                disk_counts[r] = disk_counts.get(r, 0) + 1
+            out["diskStats"] = disk.stats()
+        return Response.json(out)
 
     # -- failpoint admin (fault subsystem; docs/FAULT_TOLERANCE.md) ----------
 

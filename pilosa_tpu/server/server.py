@@ -31,10 +31,13 @@ from ..models.holder import Holder
 from ..models.index import IndexOptions
 from ..obs import accounting as obs_accounting
 from ..obs import blackbox as obs_blackbox
+from ..obs.federate import Federator
+from ..obs.history import MetricHistory
 from ..obs.metrics import RegistryStatsClient, default_registry
 from ..obs.profile import ContinuousProfiler
 from ..obs.runtime import RuntimeCollector, build_info
 from ..obs.sampler import TailSampler
+from ..obs.sentinel import Sentinel
 from ..obs.slo import SLOTracker
 from ..obs.trace import Tracer
 from ..obs.watchdog import Watchdog
@@ -42,9 +45,10 @@ from ..proto import internal_pb2 as pb
 from ..sched import (AdmissionController, QueryRegistry, Warmup,
                      warmup_enabled)
 from ..utils import logger as logger_mod
-from ..utils.config import (BlackboxConfig, FaultConfig, MetricsConfig,
-                            ProfileConfig, QueryConfig, SLOConfig,
-                            TraceConfig, WatchdogConfig)
+from ..utils.config import (BlackboxConfig, FaultConfig, HistoryConfig,
+                            MetricsConfig, ProfileConfig, QueryConfig,
+                            SentinelConfig, SLOConfig, TraceConfig,
+                            WatchdogConfig, parse_resolutions)
 from ..utils.stats import NOP, MultiStatsClient
 from .handler import Handler
 from .httpd import HTTPServer
@@ -74,7 +78,9 @@ class Server:
                  blackbox_config: Optional[BlackboxConfig] = None,
                  watchdog_config: Optional[WatchdogConfig] = None,
                  resize_pace_s: float = 0.0,
-                 resize_grace_s: float = 30.0):
+                 resize_grace_s: float = 30.0,
+                 history_config: Optional[HistoryConfig] = None,
+                 sentinel_config: Optional[SentinelConfig] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -103,6 +109,21 @@ class Server:
         self.sampler: Optional[TailSampler] = None
         self.blackbox: Optional[obs_blackbox.Blackbox] = None
         self.watchdog: Optional[Watchdog] = None
+        # Fleet observability (this PR; docs/OBSERVABILITY.md): the
+        # on-disk metric history, the cluster federator behind
+        # /metrics/cluster + /debug/cluster, and the regression
+        # sentinel — built in open() (the history ring lives under
+        # the holder data dir).
+        self.history_config = history_config or HistoryConfig()
+        self.sentinel_config = sentinel_config or SentinelConfig()
+        self.history: Optional[MetricHistory] = None
+        self.sentinel: Optional[Sentinel] = None
+        self.federator: Optional[Federator] = None
+        # Peer build identities learned via the gossip push/pull
+        # piggyback (build_wire_state): version skew across a
+        # mixed-version fleet stays visible through /debug/cluster
+        # even for nodes a scrape can't reach right now.
+        self.peer_builds: dict[str, dict] = {}
         # Continuous profiler + SLO tracker (obs subsystem). The
         # accounting knob stays PER SERVER (threaded into the handler
         # and the batch lane) — a process-global flip here would let
@@ -272,12 +293,33 @@ class Server:
         if warmup_enabled() and self.executor.use_mesh:
             self.warmup = Warmup(self.executor, logger=self.logger)
             self.warmup.start()
+        # On-disk metric history (obs.history): one sampling pass per
+        # runtime-collector tick into bounded multi-resolution rings
+        # persisted under the data dir (crash-safe; survives SIGKILL
+        # minus the unflushed tail).
+        if self.metrics_config.enabled and self.history_config.enabled:
+            self.history = MetricHistory(
+                os.path.join(self.holder.path, "history"),
+                resolutions=parse_resolutions(
+                    self.history_config.resolutions),
+                max_series=self.history_config.max_series,
+                segment_bytes=self.history_config.segment_bytes,
+                max_segments=self.history_config.segments)
         if self.metrics_config.enabled:
             self.runtime = RuntimeCollector(
                 holder=self.holder, executor=self.executor,
                 admission=self.admission,
                 interval_s=self.metrics_config.runtime_interval,
-                slo=self.slo, profiler=self.profiler)
+                slo=self.slo, profiler=self.profiler,
+                history=self.history)
+        # Cluster federation (obs.federate): /metrics/cluster,
+        # /debug/cluster, and history scope=cluster fan a bounded
+        # parallel scrape over the pooled (breaker-aware) clients.
+        self.federator = Federator(
+            self.host, cluster=self.cluster,
+            client_for=self.client_for,
+            peer_timeout_s=self.metrics_config.federate_timeout,
+            fanout=self.metrics_config.federate_fanout)
         # Publish build identity now that jax is loaded (the
         # pilosa_build_info gauge + the /status build block).
         build_info()
@@ -327,6 +369,27 @@ class Server:
                 retrip_s=self.watchdog_config.retrip,
                 logger=self.logger)
             self.watchdog.start()
+        # Regression sentinel (obs.sentinel): slow-cadence robust-z +
+        # manifest-envelope rules over the live history; a finding
+        # force-keeps in-flight traces (reason ``anomaly``) and lands
+        # a blackbox snapshot naming the regressed metric.
+        if self.sentinel_config.enabled and self.history is not None:
+            self.sentinel = Sentinel(
+                self.history, registry=self.query_registry,
+                tracer=self.tracer, sampler=self.sampler,
+                blackbox=self.blackbox,
+                interval_s=self.sentinel_config.interval,
+                window_s=self.sentinel_config.window,
+                baseline_s=self.sentinel_config.baseline,
+                zscore=self.sentinel_config.zscore,
+                min_points=self.sentinel_config.min_points,
+                min_ratio=self.sentinel_config.min_ratio,
+                retrip_s=self.sentinel_config.retrip,
+                manifest_path=self.sentinel_config.manifest,
+                manifest_tolerance=self.sentinel_config
+                .manifest_tolerance,
+                logger=self.logger)
+            self.sentinel.start()
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
@@ -340,7 +403,9 @@ class Server:
             profiler=self.profiler,
             accounting=self.metrics_config.accounting,
             fault=self.fault, sampler=self.sampler,
-            blackbox=self.blackbox, watchdog=self.watchdog)
+            blackbox=self.blackbox, watchdog=self.watchdog,
+            history=self.history, sentinel=self.sentinel,
+            federator=self.federator)
 
         self._httpd = HTTPServer(self.handler, bind_host, port,
                                  logger=self.logger,
@@ -360,6 +425,8 @@ class Server:
             self.host = new_host
             self.executor.host = new_host
             self.handler.host = new_host
+            if self.federator is not None:
+                self.federator.host = new_host
             if self.fault is not None:
                 # The self-identity every fault consult skips.
                 self.fault.node = new_host
@@ -410,14 +477,21 @@ class Server:
             # Cooperative stop; an in-flight journal is recovered (or
             # aborted) on the next open.
             self.resize_op.cancel()
+        if self.sentinel is not None:
+            self.sentinel.stop()
         if self.watchdog is not None:
             self.watchdog.stop()
         if self.blackbox is not None:
             self.blackbox.stop()
         if self.sampler is not None and self.sampler.disk is not None:
             self.sampler.disk.close()
+        # Collector before history: a mid-tick sample() racing the
+        # close would reopen a fresh disk segment after it (stop()
+        # joins the collector thread).
         if self.runtime is not None:
             self.runtime.stop()
+        if self.history is not None:
+            self.history.close()
         self.profiler.stop()
         if self.warmup is not None:
             self.warmup.stop()
@@ -936,6 +1010,69 @@ class Server:
                 self._apply_resize_message(msg("finalize", last))
         except Exception as e:  # noqa: BLE001 - convergence best-effort
             self.logger.printf("resize gossip catch-up skipped: %s", e)
+
+    # -- fleet observability (obs.federate; docs/OBSERVABILITY.md) -----------
+
+    def local_debug_state(self) -> dict:
+        """This node's block of the ``/debug/cluster`` rollup: the
+        blackbox state, fleet-queryable — build identity, placement
+        epoch, breaker states, SLO burn, WAL flusher health, resize
+        phase, admission shape. Deliberately lighter than
+        ``_blackbox_state`` (no thread dump, no generation map, no
+        slow-log bodies): a fleet-wide fan-out must stay cheap on
+        every leg."""
+        from ..storage import wal as storage_wal
+        out: dict = {"host": self.host,
+                     "build": build_info(),
+                     "epoch": self.cluster.epoch,
+                     "admission": self.admission.snapshot(),
+                     "wal": storage_wal.flusher_health()}
+        if self.fault is not None:
+            out["fault"] = self.fault.snapshot()
+        if self.runtime is not None:
+            rt = self.runtime.snapshot()
+            if rt.get("slo") is not None:
+                out["slo"] = rt["slo"]
+            if rt.get("holder") is not None:
+                out["holder"] = rt["holder"]
+            if rt.get("deviceBlockCache"):
+                out["deviceBlockCache"] = rt["deviceBlockCache"]
+        if self.watchdog is not None:
+            out["watchdog"] = self.watchdog.snapshot()
+        if self.sentinel is not None:
+            out["sentinel"] = self.sentinel.snapshot()
+        if self.history is not None:
+            out["history"] = self.history.stats()
+        rs = self.cluster.resize
+        out["resize"] = {"phase": (rs.phase if rs is not None
+                                   else "idle"),
+                         "inFlight": rs.to_wire()
+                         if rs is not None else None}
+        if self.resize_op is not None:
+            out["resize"]["op"] = self.resize_op.status()
+        if self.peer_builds:
+            out["gossipBuilds"] = dict(self.peer_builds)
+        return out
+
+    # -- gossip piggyback: build identity (version-skew visibility) ----------
+
+    def build_wire_state(self) -> dict:
+        """Rides the gossip push/pull next to the resize state: each
+        node's build identity, so a mixed-version fleet's skew is
+        visible from ANY member during a rolling restart — even for
+        peers an HTTP scrape can't currently reach."""
+        return {"host": self.host, **build_info()}
+
+    def apply_build_wire_state(self, d: dict) -> None:
+        try:
+            host = str(d.get("host", ""))
+        except (TypeError, ValueError):
+            return
+        if not host or host == self.host:
+            return
+        self.peer_builds[host] = {
+            k: str(d.get(k, "")) for k in ("version", "python", "jax",
+                                           "backend")}
 
     # -- blackbox / watchdog wiring (obs subsystem) --------------------------
 

@@ -115,10 +115,78 @@ class MetricsConfig:
     runtime collector; ``runtime_interval`` (seconds) paces the
     collector's background sampling; ``accounting`` gates the
     per-query cost ledger (obs.accounting — on by default, plain-int
-    increments)."""
+    increments). ``federate_timeout``/``federate_fanout`` bound the
+    cluster-federation fan-out (obs.federate): per-peer scrape
+    deadline and max parallel legs."""
     enabled: bool = True
     runtime_interval: float = 10.0
     accounting: bool = True
+    federate_timeout: float = 2.0
+    federate_fanout: int = 8
+
+
+def parse_resolutions(raw: str) -> tuple[tuple[float, int], ...]:
+    """``"10s:360,1m:720,15m:672"`` → ((10.0, 360), ...) — the metric
+    history's (step, ring-capacity) ladder. The store hard-depends on
+    finest-first ordering (resolutions[0] drives the sampling guard
+    and every window walk assumes steps grow with index), so this IS
+    the validation gate: steps must be strictly ascending and every
+    capacity positive — a misconfigured ladder fails loudly at load
+    instead of serving garbage history to a blinded sentinel."""
+    out = []
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        step_s, _, cap = part.partition(":")
+        step, points = parse_duration(step_s), int(cap)
+        if step <= 0 or points <= 0:
+            raise ValueError(
+                f"invalid history resolution {part!r}: step and"
+                f" capacity must be positive")
+        if out and step <= out[-1][0]:
+            raise ValueError(
+                f"history resolutions must be strictly ascending"
+                f" (finest first): {raw!r}")
+        out.append((step, points))
+    if not out:
+        raise ValueError(f"invalid history resolutions: {raw!r}")
+    return tuple(out)
+
+
+@dataclass
+class HistoryConfig:
+    """[history] section (obs.history): the embedded on-disk metric
+    history. ``resolutions`` is the step:capacity ladder (finest
+    first); ``segment_bytes`` × ``segments`` bound each resolution's
+    disk ring; ``max_series`` caps the in-memory series count."""
+    enabled: bool = True
+    resolutions: str = "10s:360,1m:720,15m:672"
+    segment_bytes: int = 1 << 20
+    segments: int = 8
+    max_series: int = 4096
+
+
+@dataclass
+class SentinelConfig:
+    """[sentinel] section (obs.sentinel): the regression sentinel.
+    ``interval`` paces evaluation; a robust-z rule fires when the
+    recent ``window`` median sits ``zscore`` MAD-scaled deviations
+    past the trailing ``baseline`` median AND at least ``min_ratio``
+    times it; ``manifest`` points at a committed benchmarks/
+    MANIFEST.json whose envelope (× ``manifest_tolerance``) live
+    medians must stay inside; ``retrip`` rate-limits re-fires per
+    series."""
+    enabled: bool = True
+    interval: float = 30.0
+    window: float = 120.0
+    baseline: float = 3600.0
+    zscore: float = 6.0
+    min_points: int = 5
+    min_ratio: float = 1.5
+    retrip: float = 300.0
+    manifest: str = ""
+    manifest_tolerance: float = 5.0
 
 
 @dataclass
@@ -235,6 +303,8 @@ class Config:
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     query: QueryConfig = field(default_factory=QueryConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    history: HistoryConfig = field(default_factory=HistoryConfig)
+    sentinel: SentinelConfig = field(default_factory=SentinelConfig)
     trace: TraceConfig = field(default_factory=TraceConfig)
     blackbox: BlackboxConfig = field(default_factory=BlackboxConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
@@ -292,6 +362,27 @@ cluster-cache-entries = {self.query.cluster_cache_entries}
 enabled = {str(self.metrics.enabled).lower()}
 runtime-interval = "{dur(self.metrics.runtime_interval)}"
 accounting = {str(self.metrics.accounting).lower()}
+federate-timeout = "{dur(self.metrics.federate_timeout)}"
+federate-fanout = {self.metrics.federate_fanout}
+
+[history]
+enabled = {str(self.history.enabled).lower()}
+resolutions = "{self.history.resolutions}"
+segment-bytes = {self.history.segment_bytes}
+segments = {self.history.segments}
+max-series = {self.history.max_series}
+
+[sentinel]
+enabled = {str(self.sentinel.enabled).lower()}
+interval = "{dur(self.sentinel.interval)}"
+window = "{dur(self.sentinel.window)}"
+baseline = "{dur(self.sentinel.baseline)}"
+zscore = {self.sentinel.zscore}
+min-points = {self.sentinel.min_points}
+min-ratio = {self.sentinel.min_ratio}
+retrip = "{dur(self.sentinel.retrip)}"
+manifest = "{self.sentinel.manifest}"
+manifest-tolerance = {self.sentinel.manifest_tolerance}
 
 [trace]
 enabled = {str(self.trace.enabled).lower()}
@@ -410,6 +501,43 @@ def load(path: str = "", env: dict | None = None) -> Config:
                 m["runtime-interval"])
         if "accounting" in m:
             cfg.metrics.accounting = _parse_bool(m["accounting"])
+        if "federate-timeout" in m:
+            cfg.metrics.federate_timeout = parse_duration(
+                m["federate-timeout"])
+        if "federate-fanout" in m:
+            cfg.metrics.federate_fanout = int(m["federate-fanout"])
+        hs = data.get("history", {})
+        if "enabled" in hs:
+            cfg.history.enabled = _parse_bool(hs["enabled"])
+        if "resolutions" in hs:
+            parse_resolutions(hs["resolutions"])  # validate at load
+            cfg.history.resolutions = str(hs["resolutions"])
+        if "segment-bytes" in hs:
+            cfg.history.segment_bytes = int(hs["segment-bytes"])
+        if "segments" in hs:
+            cfg.history.segments = int(hs["segments"])
+        if "max-series" in hs:
+            cfg.history.max_series = int(hs["max-series"])
+        sn = data.get("sentinel", {})
+        if "enabled" in sn:
+            cfg.sentinel.enabled = _parse_bool(sn["enabled"])
+        for key, attr in (("interval", "interval"),
+                          ("window", "window"),
+                          ("baseline", "baseline"),
+                          ("retrip", "retrip")):
+            if key in sn:
+                setattr(cfg.sentinel, attr, parse_duration(sn[key]))
+        if "zscore" in sn:
+            cfg.sentinel.zscore = float(sn["zscore"])
+        if "min-points" in sn:
+            cfg.sentinel.min_points = int(sn["min-points"])
+        if "min-ratio" in sn:
+            cfg.sentinel.min_ratio = float(sn["min-ratio"])
+        if "manifest" in sn:
+            cfg.sentinel.manifest = str(sn["manifest"])
+        if "manifest-tolerance" in sn:
+            cfg.sentinel.manifest_tolerance = float(
+                sn["manifest-tolerance"])
         t = data.get("trace", {})
         if "enabled" in t:
             cfg.trace.enabled = _parse_bool(t["enabled"])
@@ -553,6 +681,44 @@ def load(path: str = "", env: dict | None = None) -> Config:
     if env.get("PILOSA_METRICS_ACCOUNTING"):
         cfg.metrics.accounting = _parse_bool(
             env["PILOSA_METRICS_ACCOUNTING"])
+    if env.get("PILOSA_METRICS_FEDERATE_TIMEOUT"):
+        cfg.metrics.federate_timeout = parse_duration(
+            env["PILOSA_METRICS_FEDERATE_TIMEOUT"])
+    if env.get("PILOSA_METRICS_FEDERATE_FANOUT"):
+        cfg.metrics.federate_fanout = int(
+            env["PILOSA_METRICS_FEDERATE_FANOUT"])
+    if env.get("PILOSA_HISTORY_ENABLED"):
+        cfg.history.enabled = _parse_bool(env["PILOSA_HISTORY_ENABLED"])
+    if env.get("PILOSA_HISTORY_RESOLUTIONS"):
+        parse_resolutions(env["PILOSA_HISTORY_RESOLUTIONS"])
+        cfg.history.resolutions = env["PILOSA_HISTORY_RESOLUTIONS"]
+    if env.get("PILOSA_HISTORY_SEGMENT_BYTES"):
+        cfg.history.segment_bytes = int(
+            env["PILOSA_HISTORY_SEGMENT_BYTES"])
+    if env.get("PILOSA_HISTORY_SEGMENTS"):
+        cfg.history.segments = int(env["PILOSA_HISTORY_SEGMENTS"])
+    if env.get("PILOSA_HISTORY_MAX_SERIES"):
+        cfg.history.max_series = int(env["PILOSA_HISTORY_MAX_SERIES"])
+    if env.get("PILOSA_SENTINEL_ENABLED"):
+        cfg.sentinel.enabled = _parse_bool(
+            env["PILOSA_SENTINEL_ENABLED"])
+    for env_key_, attr_ in (("PILOSA_SENTINEL_INTERVAL", "interval"),
+                            ("PILOSA_SENTINEL_WINDOW", "window"),
+                            ("PILOSA_SENTINEL_BASELINE", "baseline"),
+                            ("PILOSA_SENTINEL_RETRIP", "retrip")):
+        if env.get(env_key_):
+            setattr(cfg.sentinel, attr_, parse_duration(env[env_key_]))
+    if env.get("PILOSA_SENTINEL_ZSCORE"):
+        cfg.sentinel.zscore = float(env["PILOSA_SENTINEL_ZSCORE"])
+    if env.get("PILOSA_SENTINEL_MIN_POINTS"):
+        cfg.sentinel.min_points = int(env["PILOSA_SENTINEL_MIN_POINTS"])
+    if env.get("PILOSA_SENTINEL_MIN_RATIO"):
+        cfg.sentinel.min_ratio = float(env["PILOSA_SENTINEL_MIN_RATIO"])
+    if env.get("PILOSA_SENTINEL_MANIFEST"):
+        cfg.sentinel.manifest = env["PILOSA_SENTINEL_MANIFEST"]
+    if env.get("PILOSA_SENTINEL_MANIFEST_TOLERANCE"):
+        cfg.sentinel.manifest_tolerance = float(
+            env["PILOSA_SENTINEL_MANIFEST_TOLERANCE"])
     if env.get("PILOSA_PROFILE_CONTINUOUS"):
         cfg.profile.continuous = _parse_bool(
             env["PILOSA_PROFILE_CONTINUOUS"])

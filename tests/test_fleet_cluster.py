@@ -1,0 +1,245 @@
+"""Fleet observability on a REAL 2-node gossip cluster (ISSUE 13
+acceptance): one ``GET /metrics/cluster`` scrape returns both nodes'
+merged families in a single coordinator round trip; a SIGSTOPped peer
+degrades to a marked partial rollup instead of hanging; the on-disk
+metric history survives SIGKILL + restart; build identities ride
+gossip so version skew is observable from any member."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+from podenv import cpu_env, free_port, wait_up  # noqa: E402
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+from pilosa_tpu.obs import federate  # noqa: E402
+
+
+def _post(host, path, body=b"", timeout=30):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _get(host, path, timeout=15):
+    with urllib.request.urlopen(f"http://{host}{path}",
+                                timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read()
+
+
+def _get_json(host, path, timeout=15):
+    _st, _hd, body = _get(host, path, timeout)
+    return json.loads(body)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Two gossip-joined nodes with the history sampler on an
+    accelerated cadence (0.25 s base resolution) so a short test
+    accumulates real multi-tick series. The sentinel is off — this
+    leg exercises the history/federation plane, not the rules."""
+    pa, pb = free_port(), free_port()
+    ga, gb = free_port(), free_port()
+    hosts = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+    procs, logs = {}, []
+
+    def spawn(name, port, internal, seed=""):
+        d = tmp_path / name
+        d.mkdir(exist_ok=True)
+        env = cpu_env()
+        env["PILOSA_TPU_MESH"] = "0"
+        env["PILOSA_TPU_WARMUP"] = "0"
+        env["PILOSA_METRICS_RUNTIME_INTERVAL"] = "0.25s"
+        env["PILOSA_HISTORY_RESOLUTIONS"] = "0.25s:400,1s:200,5s:100"
+        env["PILOSA_METRICS_FEDERATE_TIMEOUT"] = "1s"
+        env["PILOSA_SENTINEL_ENABLED"] = "0"
+        log = open(tmp_path / f"{name}.log", "a")
+        logs.append(log)
+        argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                "-d", str(d), "-b", f"127.0.0.1:{port}",
+                "--cluster.type", "gossip",
+                "--cluster.hosts", hosts,
+                "--cluster.replicas", "1",
+                "--cluster.internal-port", str(internal),
+                "--anti-entropy.interval", "300s"]
+        if seed:
+            argv += ["--cluster.gossip-seed", seed]
+        p = subprocess.Popen(argv, env=env, stdout=log, stderr=log,
+                             cwd=os.path.dirname(_HERE))
+        procs[name] = p
+        wait_up(f"127.0.0.1:{port}")
+        return f"127.0.0.1:{port}"
+
+    host_a = spawn("a", pa, ga)
+    host_b = spawn("b", pb, gb, seed=f"127.0.0.1:{ga}")
+    _post(host_a, "/index/fl", b"{}")
+    _post(host_a, "/index/fl/frame/f", b"{}")
+
+    import numpy as np
+
+    from pilosa_tpu.cluster.client import Client
+    client = Client(host_a)
+    cols = np.arange(0, 4 * SLICE_WIDTH,
+                     SLICE_WIDTH // 8).astype(np.uint64)
+    client.import_arrays("fl", "f", np.ones(len(cols), np.uint64),
+                         cols)
+    deadline = time.time() + 30
+    got = None
+    while time.time() < deadline:
+        with _post(host_a, "/index/fl/query",
+                   b'Count(Bitmap(frame="f", rowID=1))') as r:
+            got = json.loads(r.read())["results"][0]
+        if got == len(cols):
+            break
+        time.sleep(0.3)
+    assert got == len(cols), got
+
+    yield {"a": host_a, "b": host_b, "procs": procs,
+           "respawn_a": lambda: spawn("a", pa, ga,
+                                      seed=f"127.0.0.1:{gb}")}
+
+    for p in procs.values():
+        try:
+            p.send_signal(signal.SIGINT)
+        except OSError:
+            pass
+    for p in procs.values():
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for log in logs:
+        log.close()
+
+
+def test_fleet_federation_partial_and_history_survival(cluster):
+    host_a, host_b = cluster["a"], cluster["b"]
+
+    # Traffic on BOTH nodes so each registry has its own counts.
+    for host in (host_a, host_b):
+        for _ in range(5):
+            with _post(host, "/index/fl/query",
+                       b'Count(Bitmap(frame="f", rowID=1))') as r:
+                r.read()
+    # A few history ticks at the 0.25s cadence.
+    time.sleep(1.5)
+
+    # -- one /metrics/cluster scrape merges both nodes ------------------------
+    st, headers, body = _get(host_a, "/metrics/cluster")
+    assert st == 200
+    assert headers["X-Pilosa-Federated-Nodes"] == "2"
+    fams = federate.parse_exposition(body.decode())
+    # Counters summed: the cluster-wide query count >= each node's own.
+    merged_queries = sum(
+        v for _n, _l, v in fams["pilosa_query_requests_total"][
+            "samples"])
+    per_node = []
+    for host in (host_a, host_b):
+        _st, _hd, raw = _get(host, "/metrics")
+        own = federate.parse_exposition(raw.decode())
+        per_node.append(sum(
+            v for _n, _l, v in own.get(
+                "pilosa_query_requests_total",
+                {"samples": []})["samples"]))
+    assert merged_queries >= max(per_node)
+    assert all(n > 0 for n in per_node)
+    # Gauges per-node: the build-info gauge names BOTH nodes.
+    build_nodes = {labels.get("node")
+                   for _n, labels, _v in fams["pilosa_build_info"][
+                       "samples"]}
+    assert {host_a, host_b} <= build_nodes, build_nodes
+    # Histograms merged: bucket counts from both nodes summed.
+    hist_count = sum(
+        v for n, _l, v in fams["pilosa_query_duration_seconds"][
+            "samples"] if n.endswith("_count"))
+    assert hist_count >= 10
+
+    # -- /debug/cluster rollup: builds, epoch, skew, gossip builds ------------
+    doc = _get_json(host_a, "/debug/cluster")
+    assert set(doc["nodes"]) == {host_a, host_b}
+    assert doc["versionSkew"] is False
+    assert doc["versions"][host_a] == doc["versions"][host_b] != ""
+    for host, block in doc["nodes"].items():
+        assert block["build"]["version"]
+        assert "wal" in block and "admission" in block
+        assert block["resize"]["phase"] == "idle"
+    # The gossip build piggyback: each node learned its peer's build
+    # identity through push/pull, no HTTP scrape required.
+    local_a = _get_json(host_a, "/debug/cluster?local=1")
+    assert host_b in (local_a.get("gossipBuilds") or {}), local_a.get(
+        "gossipBuilds")
+
+    # -- history federates across the fleet -----------------------------------
+    doc = _get_json(
+        host_a, "/debug/metrics/history?scope=cluster"
+                "&family=pilosa_query_requests_total&window=60s")
+    nodes_seen = {s["node"] for s in doc["series"]}
+    assert {host_a, host_b} <= nodes_seen, nodes_seen
+
+    # -- SIGSTOPped peer: partial, marked, bounded ----------------------------
+    proc_b = cluster["procs"]["b"]
+    proc_b.send_signal(signal.SIGSTOP)
+    try:
+        t0 = time.time()
+        try:
+            st, headers, body = _get(host_a, "/metrics/cluster",
+                                     timeout=30)
+        except urllib.error.HTTPError as e:
+            st, body = e.code, e.read()
+        elapsed = time.time() - t0
+        assert st == 503, (st, body[:200])
+        assert host_b.encode() in body
+        # Bounded by the 1s per-peer federate timeout, not a hang.
+        assert elapsed < 15, elapsed
+        st, headers, body = _get(host_a, "/metrics/cluster?partial=1",
+                                 timeout=30)
+        assert st == 200
+        assert headers["X-Pilosa-Partial-Nodes"] == host_b
+        fams = federate.parse_exposition(body.decode())
+        build_nodes = {labels.get("node") for _n, labels, _v in
+                       fams["pilosa_build_info"]["samples"]}
+        assert host_a in build_nodes and host_b not in build_nodes
+        # The rollup degrades the same way.
+        doc = _get_json(host_a, "/debug/cluster?partial=1",
+                        timeout=30)
+        assert doc["missing"] == [host_b]
+        assert host_a in doc["nodes"]
+    finally:
+        proc_b.send_signal(signal.SIGCONT)
+
+    # -- history survives SIGKILL + restart -----------------------------------
+    # More ticks, then kill -9: reopen must serve the pre-kill series
+    # minus at most the unflushed tail.
+    time.sleep(1.0)
+    pre_kill = _get_json(
+        host_a, "/debug/metrics/history"
+                "?family=pilosa_query_requests_total&window=60s")
+    pre_points = [tuple(p) for s in pre_kill["series"]
+                  for p in s["points"]]
+    assert pre_points, pre_kill
+    kill_at = time.time()
+    proc_a = cluster["procs"]["a"]
+    proc_a.kill()
+    proc_a.wait(timeout=20)
+    host_a = cluster["respawn_a"]()
+    # window 90s stays inside the 0.25s*400 base-ring span, so the
+    # reopened BASE resolution is what answers (the acceptance shape).
+    post = _get_json(
+        host_a, "/debug/metrics/history"
+                "?family=pilosa_query_requests_total&window=90s")
+    post_points = [tuple(p) for s in post["series"]
+                   for p in s["points"]]
+    survived = [p for p in post_points if p[0] < kill_at]
+    # All but the unflushed tail of the pre-kill ticks persisted.
+    assert len(survived) >= max(1, len(pre_points) - 3), (
+        len(survived), len(pre_points))

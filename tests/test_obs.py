@@ -102,6 +102,76 @@ def parse_exposition(text: str) -> dict:
     return families
 
 
+class TestExpositionEscaping:
+    # The exposition-spec escape matrix: label VALUES escape
+    # backslash, double quote, and line feed; HELP text escapes ONLY
+    # backslash and line feed (an escaped quote in help is itself a
+    # spec violation strict OpenMetrics parsers reject).
+
+    HOSTILE = ('back\\slash', 'quo"te', 'new\nline',
+               'all\\three" at\nonce', 'trailing\\', '\\"')
+
+    @staticmethod
+    def _unescape(v: str) -> str:
+        from pilosa_tpu.obs.federate import unescape_label_value
+        return unescape_label_value(v)
+
+    def test_hostile_label_values_round_trip(self):
+        """Hostile label values render escaped and parse back to the
+        exact original through the existing test parser."""
+        reg = obs_metrics.Registry()
+        c = reg.counter("pilosa_test_hostile_events_total",
+                        labels=("k",))
+        for v in self.HOSTILE:
+            c.labels(v).inc()
+        text = reg.render()
+        # Every rendered line must stay single-line (the newline in
+        # the value is escaped, not emitted).
+        for line in text.splitlines():
+            assert "\n" not in line
+        fams = parse_exposition(text)
+        got = {self._unescape(labels["k"])
+               for _n, labels, _v in
+               fams["pilosa_test_hostile_events_total"]["samples"]}
+        assert got == set(self.HOSTILE), got
+        # The OpenMetrics rendering escapes identically (parsed with
+        # the production federation parser, which unescapes — the
+        # 0.0.4 test parser above is strict about OM counter naming).
+        from pilosa_tpu.obs import federate
+        om = reg.render(openmetrics=True)
+        got_om = {labels["k"] for _n, labels, _v in
+                  federate.parse_exposition(om)[
+                      "pilosa_test_hostile_events_total"]["samples"]}
+        assert got_om == set(self.HOSTILE), got_om
+
+    def test_help_escapes_backslash_newline_but_not_quote(self):
+        reg = obs_metrics.Registry()
+        reg.counter("pilosa_test_help_events_total",
+                    'say "hi" to\na back\\slash')
+        text = reg.render()
+        help_line = next(ln for ln in text.splitlines()
+                         if ln.startswith("# HELP"))
+        # Quote NOT escaped; newline and backslash escaped.
+        assert 'say "hi" to\\na back\\\\slash' in help_line, help_line
+        assert '\\"' not in help_line
+
+    def test_federate_parser_matches_test_parser(self):
+        """The production exposition parser (obs.federate — the one
+        /metrics/cluster merges through) agrees with this test file's
+        parser on hostile values, unescaping included."""
+        from pilosa_tpu.obs import federate
+        reg = obs_metrics.Registry()
+        c = reg.counter("pilosa_test_cross_events_total",
+                        labels=("k",))
+        for v in self.HOSTILE:
+            c.labels(v).inc(2)
+        fams = federate.parse_exposition(reg.render())
+        got = {labels["k"]: v for _n, labels, v in
+               fams["pilosa_test_cross_events_total"]["samples"]}
+        assert set(got) == set(self.HOSTILE)
+        assert all(v == 2.0 for v in got.values())
+
+
 class TestRegistry:
     def test_counter_gauge_histogram_render(self):
         reg = obs_metrics.Registry()

@@ -64,7 +64,7 @@ class TestRouteTableDocumented:
             readme = f.read()
         swept = []
         for _method, _regex, _fn, _lane, pattern in handler._routes:
-            if pattern in ("/metrics", "/health") \
+            if pattern == "/health" or pattern.startswith("/metrics") \
                     or pattern.startswith("/debug/"):
                 swept.append(pattern)
                 # Variable segments differ in name between code and
@@ -84,6 +84,43 @@ class TestRouteTableDocumented:
         # Fault subsystem: the failpoint admin endpoint must be both
         # registered and documented.
         assert "/debug/failpoints" in swept
+        # Fleet observability (ISSUE 13): the federation, history,
+        # sentinel, and trace-summary routes are registered AND
+        # documented.
+        assert "/metrics/cluster" in swept
+        assert "/debug/metrics/history" in swept
+        assert "/debug/cluster" in swept
+        assert "/debug/sentinel" in swept
+        assert "/debug/traces/summary" in swept
+
+    def test_fleet_observability_metrics_registered(self):
+        """ISSUE 13: the metric-history / federation / sentinel
+        families exist (and so passed the naming gate at import), the
+        sentinel findings counter carries the promised labels, and the
+        tail sampler's keep-reason catalogue grew ``anomaly``."""
+        fams = obs_metrics.default_registry().families()
+        for name in ("pilosa_history_samples_total",
+                     "pilosa_history_series_live",
+                     "pilosa_history_series_dropped_total",
+                     "pilosa_history_disk_records_total",
+                     "pilosa_federation_scrapes_total",
+                     "pilosa_sentinel_findings_total",
+                     "pilosa_sentinel_findings_active",
+                     "pilosa_sentinel_checks_total"):
+            assert name in fams, name
+        assert fams["pilosa_sentinel_findings_total"].labelnames == (
+            "metric", "direction")
+        assert fams["pilosa_sentinel_findings_active"].type == "gauge"
+        assert fams["pilosa_federation_scrapes_total"].labelnames == (
+            "peer", "outcome")
+        from pilosa_tpu.obs.sampler import REASONS
+        assert "anomaly" in REASONS
+        # The summary route must precede the {qid} wildcard or the
+        # wildcard swallows it.
+        handler = Handler(None, None)
+        patterns = [p for _m, _r, _f, _l, p in handler._routes]
+        assert patterns.index("/debug/traces/summary") \
+            < patterns.index("/debug/traces/{qid}")
 
     def test_roaring_container_metrics_registered(self):
         """The run-container observability families (docs/STORAGE.md):
