@@ -78,6 +78,7 @@ _CANONICAL_ARTIFACTS = {
     "densify": "DENSIFY.json",
     "host_baselines": "HOST_BASELINE.json",
     "latency_under_load": "LATENCY.json",
+    "tenant_isolation": "TENANTS.json",
 }
 
 
@@ -129,7 +130,13 @@ def write_manifest(partial: bool = False) -> None:
         # own families; everything else carries forward so the
         # manifest stays the full index. Full passes carry only the
         # latency_* entries (owned by latency_under_load.py).
-        if k not in metrics and (partial or k.startswith("latency_")):
+        # Error rows never carry forward: a failed config's row is
+        # keyed by FUNCTION name while its successful rerun emits
+        # metric names, so a stale error would otherwise contradict
+        # the fresh section forever.
+        if (k not in metrics and (partial or k.startswith("latency_"))
+                and (not isinstance(v, dict)
+                     or v.get("unit") != "error")):
             metrics[k] = v
     out = {
         "written_by": "benchmarks/suite.py",
@@ -196,6 +203,13 @@ def write_manifest(partial: bool = False) -> None:
     # volume, and query p99 inflation during the migration — ROADMAP
     # item 5's acceptance table.
     out["resize"] = _RESIZE or prior_doc.get("resize", {})
+    # Multi-tenant isolation (config_tenant_isolation): quiet-tenant
+    # p99 under an aggressor at ≥3× its cap vs solo, per-tenant
+    # shed/kill counts, and the quiet burn rate — ISSUE 14's
+    # acceptance table.
+    out["tenant_isolation"] = (_TENANT_ISOLATION
+                               or prior_doc.get("tenant_isolation",
+                                                {}))
     measured = _roofline_measured() or prior_doc.get(
         "roofline_measured_constants")
     if measured:
@@ -243,6 +257,13 @@ _OBS_HISTORY: dict = {}
 # RESIZE.json (ROADMAP item 5 / ISSUE 12): resize duration + query
 # p99 inflation under live load during the migration.
 _RESIZE: dict = {}
+
+# Multi-tenant isolation A/B captured by config_tenant_isolation() —
+# folded into MANIFEST.json's tenant_isolation section and written to
+# TENANTS.json (ROADMAP item 5's multi-tenant half / ISSUE 14): the
+# quiet tenant's p99 with an aggressor at ≥3× its admission cap vs its
+# solo baseline, interleaved, with the aggressor's shed/kill counts.
+_TENANT_ISOLATION: dict = {}
 
 
 # Fresh-process measurement: each slice config restarts python, arms
@@ -2459,6 +2480,274 @@ def config_resize() -> None:
                 os.environ[k] = v
 
 
+def config_tenant_isolation() -> None:
+    """ISSUE 14 acceptance artifact: interleaved multi-tenant A/B
+    against a REAL server subprocess (the load generator must not
+    share the server's interpreter, or the measurement itself
+    perturbs the quiet tenant).
+
+    Leg A: the quiet tenant alone, closed-loop — its solo p50/p99.
+    Leg B: the same quiet loop while an AGGRESSOR tenant (admission
+    cap 2, queue quota 2, 2 s wall ceiling) is driven by 8 concurrent
+    Retry-After-honoring workers — 4x its cap — running a dense
+    multi-row Union/Count (~0.8 s of work per request). Overflow
+    sheds as tenant-scoped 429s; requests whose queue wait pushes
+    them past the wall ceiling are cost-policy KILLED (402). Leg C
+    (the counterfactual): the identical aggressor against the same
+    data with NO tenant policy — it eats the global slot pool and the
+    quiet tenant queues behind ~0.8 s queries. Rounds interleave A
+    and B; C runs once at the end on a fresh default-policy server
+    over the same data dir. Both tenants' successful results are
+    differential-checked every probe. Folds into MANIFEST.json
+    `tenant_isolation` and writes TENANTS.json."""
+    import statistics
+    import subprocess
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "tests"))
+    from podenv import cpu_env, free_port, wait_up
+
+    from pilosa_tpu import SLICE_WIDTH as W
+    from pilosa_tpu.cluster.client import Client as PClient
+
+    rounds = 3
+    window_s = max(1.5, 3.0 * SCALE)
+    # 8 workers against a concurrency cap of 2 (+2 queue quota): 4x
+    # the cap offered, 2x what the whole admission envelope accepts.
+    aggr_workers, aggr_cap, aggr_quota = 8, 2, 2
+    wall_ms = 2000
+    n_rows, col_stride = 12, 3
+
+    def post(host, path, body=b"", timeout=120):
+        req = urllib.request.Request(f"http://{host}{path}",
+                                     data=body, method="POST")
+        return urllib.request.urlopen(req, timeout=timeout).read()
+
+    td = tempfile.TemporaryDirectory()
+    data_dir = os.path.join(td.name, "data")
+    logf = open(os.path.join(td.name, "server.log"), "w")
+    env = cpu_env()
+    env["PILOSA_TPU_WARMUP"] = "0"
+    env["PILOSA_TPU_COST_MODEL"] = "0"
+    env["PILOSA_TPU_MESH"] = "0"  # the admission machinery is the
+    # thing under test (the config_resize precedent); host path keeps
+    # the 0.4 CPU backend's serialized device dispatch out of the A/B
+
+    def spawn(tenants_spec):
+        port = free_port()
+        p = subprocess.Popen(
+            [sys.executable, "-m", "pilosa_tpu.cli", "server",
+             "-d", data_dir, "-b", f"127.0.0.1:{port}",
+             "--tenants", tenants_spec,
+             "--anti-entropy.interval", "300s"],
+            env=env, stdout=logf, stderr=logf, cwd=repo)
+        host = f"127.0.0.1:{port}"
+        wait_up(host)
+        return p, host
+
+    proc, host = spawn(
+        f"default:weight=1;aggr:weight=1,concurrency={aggr_cap},"
+        f"queue-depth={aggr_quota},max-wall={wall_ms}ms")
+    proc_c = None
+    try:
+        # Dense rows (every {col_stride}rd column over 4 slices):
+        # bitmap containers, so the aggressor's Union folds are big
+        # contiguous numpy — the workload shape where per-tenant QoS
+        # (not the interpreter) decides who waits.
+        for index in ("quiet", "aggr"):
+            post(host, f"/index/{index}")
+            post(host, f"/index/{index}/frame/f")
+            for r in range(n_rows):
+                cols_d = np.arange(r % col_stride, 4 * W, col_stride,
+                                   dtype=np.uint64)
+                PClient(host).import_arrays(
+                    index, "f", np.full(len(cols_d), r, np.uint64),
+                    cols_d)
+        model = len(np.arange(0, 4 * W, col_stride))
+        # The 12 rows cycle through every column residue, so their
+        # union covers the whole 4-slice column space.
+        heavy_model = 4 * W
+        heavy = ("Count(Union(" + ",".join(
+            f'Bitmap(frame="f", rowID={r})'
+            for r in range(n_rows)) + "))").encode()
+        quiet_body = b'Count(Bitmap(frame="f", rowID=0))'
+
+        wrong: list = []
+
+        def quiet_probe(h):
+            t0 = time.perf_counter()
+            got = json.loads(post(h, "/index/quiet/query",
+                                  quiet_body))["results"][0]
+            if got != model:
+                wrong.append(("quiet", got))
+            return (time.perf_counter() - t0) * 1e3
+
+        def quiet_window(h, seconds):
+            lat = []
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                lat.append(quiet_probe(h))
+            return lat
+
+        def drive_aggr(h, seconds, counts):
+            stop = threading.Event()
+            mu = threading.Lock()
+
+            def worker():
+                while not stop.is_set():
+                    try:
+                        got = json.loads(post(
+                            h, "/index/aggr/query",
+                            heavy))["results"][0]
+                        if got != heavy_model:
+                            wrong.append(("aggr", got))
+                        c, ra = 200, 0.0
+                    except urllib.error.HTTPError as e:
+                        e.read()
+                        c = e.code
+                        ra = float(e.headers.get("Retry-After")
+                                   or 0.2)
+                    with mu:
+                        counts[c] = counts.get(c, 0) + 1
+                    if c != 200:
+                        # Compliant clients honor Retry-After; a
+                        # client that ignores it is a DoS, and even
+                        # then the quiet tenant's ADMISSION position
+                        # is protected (its slots/queue are its own).
+                        stop.wait(min(ra, 1.0))
+
+            threads = [threading.Thread(target=worker)
+                       for _ in range(aggr_workers)]
+            for t in threads:
+                t.start()
+            time.sleep(0.3)
+            out = quiet_window(h, seconds)
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            return out
+
+        def pct(xs, p):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+        # Warm both paths once.
+        quiet_probe(host)
+        try:
+            post(host, "/index/aggr/query", heavy)
+        except urllib.error.HTTPError as e:
+            e.read()
+
+        solo, contended = [], []
+        aggr_counts: dict = {}
+        for _ in range(rounds):
+            solo.extend(quiet_window(host, window_s))      # leg A
+            contended.extend(drive_aggr(host, window_s,
+                                        aggr_counts))      # leg B
+        shed = aggr_counts.get(429, 0)
+        killed = aggr_counts.get(402, 0)
+        assert not wrong, f"WRONG ANSWERS: {wrong[:5]}"
+        assert shed + killed > 0, (
+            f"aggressor at {aggr_workers} workers vs cap {aggr_cap}"
+            f" was never shed/killed: {aggr_counts}")
+        dbg = json.loads(urllib.request.urlopen(
+            f"http://{host}/debug/tenants", timeout=10).read())
+        burn = (dbg["tenants"].get("quiet", {}).get("slo", {})
+                .get("burnRates", {}).get("5m", 0.0))
+        aggr_row = dbg["tenants"].get("aggr", {})
+        proc.send_signal(2)
+        proc.wait(timeout=30)
+
+        # Leg C: the same aggressor, NO tenant policy, same data.
+        # Compared against the SAME solo baseline as leg B (one
+        # denominator for both ratios).
+        proc_c, host_c = spawn("default:weight=1")
+        unpol_counts: dict = {}
+        quiet_probe(host_c)  # warm the fresh server's caches
+        unpoliced = drive_aggr(host_c, window_s, unpol_counts)
+        assert not wrong, f"WRONG ANSWERS (unpoliced): {wrong[:5]}"
+
+        solo_p50, solo_p99 = statistics.median(solo), pct(solo, 0.99)
+        cont_p50, cont_p99 = (statistics.median(contended),
+                              pct(contended, 0.99))
+        unpol_p99 = pct(unpoliced, 0.99)
+        ratio = cont_p99 / max(solo_p99, 1e-9)
+        unpol_ratio = unpol_p99 / max(solo_p99, 1e-9)
+        # The artifact ENFORCES its isolation invariants, not just
+        # records them: the quiet tenant's burn must sit under the
+        # fast-burn threshold under attack, and the policed quiet
+        # p99 must beat the unpoliced counterfactual by a wide
+        # margin (the machinery's effect). The 1.5x solo target is
+        # recorded with a pass flag — on this CPU-only container the
+        # residual is interpreter timesharing (environment_note).
+        assert burn < 10.0, f"quiet burn {burn} past threshold"
+        assert unpol_p99 > 5 * cont_p99, (
+            f"no isolation effect: policed p99 {cont_p99:.1f}ms vs"
+            f" unpoliced {unpol_p99:.1f}ms")
+        table = {
+            "quiet_solo_p50_ms": round(solo_p50, 3),
+            "quiet_solo_p99_ms": round(solo_p99, 3),
+            "quiet_contended_p50_ms": round(cont_p50, 3),
+            "quiet_contended_p99_ms": round(cont_p99, 3),
+            "quiet_p99_ratio": round(ratio, 3),
+            "quiet_p99_ratio_target": 1.5,
+            "quiet_p99_ratio_pass": ratio <= 1.5,
+            "quiet_p99_unpoliced_ms": round(unpol_p99, 3),
+            "quiet_p99_ratio_unpoliced": round(unpol_ratio, 3),
+            "isolation_factor": round(unpol_p99 / max(cont_p99,
+                                                      1e-9), 2),
+            "quiet_burn_5m": burn,
+            "burn_threshold": 10.0,
+            "aggr_workers": aggr_workers,
+            "aggr_admission_cap": aggr_cap,
+            "aggr_offered_over_cap": round(aggr_workers / aggr_cap,
+                                           2),
+            "aggr_wall_ceiling_ms": wall_ms,
+            "aggr_ok": aggr_counts.get(200, 0),
+            "aggr_shed_429": shed,
+            "aggr_killed_402": killed,
+            "aggr_penalty_score": aggr_row.get("penaltyScore", 0.0),
+            "aggr_unpoliced_ok": unpol_counts.get(200, 0),
+            "zero_wrong_answers": True,
+            "rounds": rounds,
+            "window_s": window_s,
+            "samples_solo": len(solo),
+            "samples_contended": len(contended),
+            "environment_note": (
+                "CPU-only container, single interpreter: the"
+                " residual contended-vs-solo inflation is"
+                " GIL/core timesharing below the scheduler —"
+                " admission wait stays ~0.1 ms under full attack"
+                " (per-stage profile); on parallel hardware the"
+                " admission numbers are the binding ones"),
+        }
+        _TENANT_ISOLATION.update(table)
+        emit("tenant_isolation_quiet_p99", cont_p99, "ms",
+             **{k: v for k, v in table.items()
+                if k not in ("quiet_contended_p99_ms",
+                             "environment_note")})
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "TENANTS.json")
+        with open(path, "w") as f:
+            json.dump({"written_by": "benchmarks/suite.py"
+                                     " config_tenant_isolation",
+                       "scale": SCALE, **table}, f, indent=1)
+    finally:
+        for pp in (proc, proc_c):
+            if pp is not None and pp.poll() is None:
+                pp.send_signal(2)
+                try:
+                    pp.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    pp.kill()
+        logf.close()
+        td.cleanup()
+
+
 def main(argv: Optional[list] = None) -> None:
     """Full pass by default; ``suite.py <config_name>...`` runs just
     the named configs (e.g. ``suite.py config_write_path``) and folds
@@ -2482,6 +2771,7 @@ def main(argv: Optional[list] = None) -> None:
                config_write_path,
                config_distributed_topn,
                config_resize,
+               config_tenant_isolation,
                config_obs_overhead,
                config_obs_history,
                config_query_cost,

@@ -234,6 +234,9 @@ def load_server_config(args, env=None):
     if getattr(args, "query_cluster_cache_entries", None) is not None:
         cfg.query.cluster_cache_entries = \
             args.query_cluster_cache_entries
+    if getattr(args, "tenants", ""):
+        from ..utils.config import parse_tenants
+        cfg.tenants.table = parse_tenants(args.tenants)
     if getattr(args, "cluster_gen_staleness", None) is not None:
         cfg.cluster.gen_staleness = args.cluster_gen_staleness
     from ..utils.config import _parse_bool
@@ -320,7 +323,8 @@ def cmd_server(args, stdout, stderr) -> int:
                     resize_pace_s=cfg.cluster.resize_pace,
                     resize_grace_s=cfg.cluster.resize_grace,
                     history_config=cfg.history,
-                    sentinel_config=cfg.sentinel)
+                    sentinel_config=cfg.sentinel,
+                    tenants_config=cfg.tenants)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -711,6 +715,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default=None, metavar="N",
                    help="coordinator hot-query result cache entry"
                         " bound (0 disables, default 64)")
+    s.add_argument("--tenants", dest="tenants", default="",
+                   metavar="SPEC",
+                   help="per-tenant QoS table, compact form:"
+                        " 'default:weight=4,concurrency=8;"
+                        "bulk:weight=1,max-wall=2s' — same keys as"
+                        " the [tenants] TOML table (a 'default'"
+                        " entry is required; docs/SCHEDULING.md)")
     s.add_argument("--cluster.gen-staleness",
                    dest="cluster_gen_staleness", type=parse_duration,
                    default=None, metavar="DUR",

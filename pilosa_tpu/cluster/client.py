@@ -404,6 +404,14 @@ class Client:
         ctx = sched_context.current()
         trace = getattr(ctx, "trace", None) if ctx is not None else None
         cost = getattr(ctx, "cost", None) if ctx is not None else None
+        # Tenant principal (sched.tenants, the X-Pilosa-Deadline
+        # pattern): the remote leg schedules its device work, accounts
+        # its costs, and enforces cost ceilings under the SAME tenant
+        # as the coordinator — forwarded legs bypass admission, but
+        # never the accounting.
+        tenant = getattr(ctx, "tenant", "") if ctx is not None else ""
+        if tenant:
+            headers[sched_context.TENANT_HEADER] = tenant
         headers_out: Optional[list] = None
         if trace is not None:
             headers[TRACE_HEADER] = "1"

@@ -57,6 +57,16 @@ class QueryCancelledError(PilosaError):
     HTTP 409; never triggers replica re-mapping."""
 
 
+class QueryKilledError(QueryCancelledError):
+    """A query killed by the per-tenant slow-query cost policy
+    (sched.tenants): its ledger crossed a configured ceiling at a
+    stage boundary. Subclasses QueryCancelledError so every
+    cancellation-aware layer (executor legs, admission waits, mesh
+    dispatch) treats it as a cancel; the HTTP layer maps it to a
+    DISTINCT status (402 + ``X-Pilosa-Killed-By: cost-policy``) so
+    clients can tell a budget kill from an operator cancel."""
+
+
 # Name/label rules (reference: pilosa.go:50-53).
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,64}$")
 _LABEL_RE = re.compile(r"^[A-Za-z][A-Za-z0-9_-]{0,64}$")

@@ -137,7 +137,8 @@ class Executor:
                  result_cache_entries: Optional[int] = None,
                  result_cache_bits: Optional[int] = None,
                  cluster_cache_entries: Optional[int] = None,
-                 gen_staleness_s: Optional[float] = None):
+                 gen_staleness_s: Optional[float] = None,
+                 tenants=None):
         self.holder = holder
         self.host = host
         self.cluster = cluster or new_cluster([host])
@@ -147,6 +148,12 @@ class Executor:
         # result caches key and validate slices owned ELSEWHERE. None
         # (bare executors, single node) keeps those paths local-only.
         self.gens = gens
+        # Tenant policy (sched.tenants.TenantRegistry): partitions the
+        # result-cache budgets per tenant (= index, both cache keys
+        # lead with it) via each tenant's cache-share, so one tenant's
+        # working set cannot evict everyone else's. None = the
+        # pre-tenant single-pool behavior.
+        self.tenants = tenants
         if gen_staleness_s is None:
             raw = os.environ.get("PILOSA_CLUSTER_GEN_STALENESS")
             if raw:
@@ -746,6 +753,15 @@ class Executor:
             cache = self._cluster_cache
             cache[key] = ent
             cache.move_to_end(key)
+            # Per-tenant quota first (tenant = key[0], the index):
+            # a tenant past its share evicts ITS OWN oldest entries,
+            # never another tenant's.
+            share = self._cache_share(key[0])
+            if share < 1.0:
+                cap = max(1, int(self._cluster_cache_entries * share))
+                mine = [k for k in cache if k[0] == key[0]]
+                for k in mine[:max(0, len(mine) - cap)]:
+                    cache.pop(k, None)
             while len(cache) > self._cluster_cache_entries:
                 cache.popitem(last=False)
 
@@ -882,15 +898,38 @@ class Executor:
                                               seg.slice, False))
         return out
 
+    def _cache_share(self, tenant: str) -> float:
+        """The fraction of each cache budget this tenant (= index) may
+        occupy — 1.0 without a tenant registry (single pool)."""
+        if self.tenants is None:
+            return 1.0
+        return self.tenants.policy(tenant).cache_share
+
     def _result_cache_put(self, key, bm: Bitmap) -> None:
         bits = bm.count()
-        if bits > self._result_cache_bits:
+        share = self._cache_share(key[0])
+        tenant_budget = int(self._result_cache_bits * share)
+        if bits > min(self._result_cache_bits, tenant_budget):
             return
         evicted_n = 0
         with self._bitmap_results_mu:
             cache = self._bitmap_results
             cache[key] = (bm, bits)
             cache.move_to_end(key)
+            if share < 1.0:
+                # Per-tenant byte quota: the inserting tenant evicts
+                # its OWN LRU entries down to its share before the
+                # global bound runs — a hot aggressor can fill its
+                # slice of the cache, never the whole pool.
+                mine = [(k, b) for k, (_, b) in cache.items()
+                        if k[0] == key[0]]
+                mine_total = sum(b for _, b in mine)
+                for k, b in mine:
+                    if mine_total <= tenant_budget or k == key:
+                        break
+                    cache.pop(k, None)
+                    mine_total -= b
+                    evicted_n += 1
             total = sum(b for _, b in cache.values())
             while (len(cache) > self._result_cache_entries
                    or total > self._result_cache_bits) and len(cache) > 1:
@@ -899,6 +938,29 @@ class Executor:
                 evicted_n += 1
         if evicted_n:
             obs_metrics.RESULT_CACHE_EVICTIONS.inc(evicted_n)
+
+    def tenant_cache_usage(self) -> dict:
+        """Per-tenant cache residency for /debug/tenants and the
+        ``pilosa_tenant_cache_bytes`` scrape refresh: result-residency
+        bits (reported as bytes, bits/8) + cluster-cache entry
+        counts, keyed by tenant (= index)."""
+        out: dict[str, dict] = {}
+        with self._bitmap_results_mu:
+            for k, (_, bits) in self._bitmap_results.items():
+                ent = out.setdefault(k[0], {"resultEntries": 0,
+                                            "resultBits": 0,
+                                            "clusterEntries": 0})
+                ent["resultEntries"] += 1
+                ent["resultBits"] += bits
+        with self._cluster_cache_mu:
+            for k in self._cluster_cache:
+                ent = out.setdefault(k[0], {"resultEntries": 0,
+                                            "resultBits": 0,
+                                            "clusterEntries": 0})
+                ent["clusterEntries"] += 1
+        for ent in out.values():
+            ent["bytes"] = ent["resultBits"] // 8
+        return out
 
     def _execute_bitmap_call(self, index: str, c: Call, slices: list[int],
                              opt: ExecOptions) -> Bitmap:

@@ -32,6 +32,10 @@ Spec grammar (one string per site)::
     off                        disarm
     error                      raise FailpointError every hit
     error(0.25)                ... with probability 0.25 (seeded RNG)
+    enospc                     raise FailpointError carrying
+                               errno.ENOSPC — a full disk at this
+                               site (fault.diskfull degradation path)
+    enospc(0.25)               ... with probability 0.25
     delay(50ms)                sleep 50 ms, then proceed
     delay(50ms,0.5)            ... with probability 0.5
     torn(7)                    write the first 7 bytes of the record,
@@ -84,7 +88,7 @@ _SPEC_RE = re.compile(
     r"(?:\((?P<args>[^)]*)\))?"
     r"(?:\*(?P<count>\d+))?$")
 
-_MODES = ("error", "delay", "torn", "partition")
+_MODES = ("error", "delay", "torn", "partition", "enospc")
 
 
 class FailpointError(OSError):
@@ -125,9 +129,9 @@ def parse_spec(site: str, spec: str) -> Optional[Failpoint]:
     count = int(m.group("count")) if m.group("count") else None
     pct = 1.0
     arg = None
-    if mode == "error":
+    if mode in ("error", "enospc"):
         if len(raw_args) > 1:
-            raise ValueError(f"failpoint {site}: error takes at most"
+            raise ValueError(f"failpoint {site}: {mode} takes at most"
                              f" one argument")
         if raw_args:
             pct = float(raw_args[0])
@@ -257,6 +261,15 @@ class Failpoints:
                 writer.write(data[:max(0, min(int(arg), len(data)))])
             raise FailpointError(
                 f"failpoint {site}: torn write after {arg} bytes")
+        if mode == "enospc":
+            # The two-arg OSError form sets .errno, so the catching
+            # site's `err.errno == errno.ENOSPC` test sees exactly
+            # what a real full disk raises.
+            import errno as errno_mod
+            raise FailpointError(
+                errno_mod.ENOSPC,
+                f"failpoint {site}: injected ENOSPC"
+                " (no space left on device)")
         # error / partition
         raise FailpointError(f"failpoint {site}: injected"
                              + (f" (partition {arg})"

@@ -597,6 +597,77 @@ FAILOVER_SLICES = _DEFAULT.counter(
     "Slices re-mapped onto surviving replicas after a node leg"
     " failed mid-query, by failed peer",
     labels=("peer",))
+
+# -- multi-tenant QoS (sched.tenants; docs/SCHEDULING.md) ---------------------
+# Tenant-labeled families ride an explicit per-family cardinality cap:
+# past _TENANT_LABEL_SETS distinct tenants, new ones collapse into the
+# shared ``_overflow_`` bucket (the PR-10 overflow machinery) — a
+# tenant-per-customer deployment cannot blow up the exposition.
+_TENANT_LABEL_SETS = 64
+TENANT_QUERY_SECONDS = _DEFAULT.histogram(
+    "pilosa_tenant_query_duration_seconds",
+    "End-to-end /query latency on this node, by tenant — the"
+    " per-tenant SLO burn rates are computed over this family",
+    labels=("tenant",), max_label_sets=_TENANT_LABEL_SETS)
+TENANT_QUERIES = _DEFAULT.counter(
+    "pilosa_tenant_query_requests_total",
+    "Queries served, by tenant and status — 429s and cost-policy"
+    " 402s included, so shed/kill rates are derivable per tenant",
+    labels=("tenant", "status"), max_label_sets=4 * _TENANT_LABEL_SETS)
+TENANT_COST_UNITS = _DEFAULT.counter(
+    "pilosa_tenant_cost_units_total",
+    "Chargeback roll-up of the per-query cost ledgers, by tenant and"
+    " resource (container_ops / words_scanned / bits_written /"
+    " device_bytes / rpc_bytes / queue_wait_ms / wall_us)",
+    labels=("tenant", "resource"),
+    max_label_sets=8 * _TENANT_LABEL_SETS)
+TENANT_SHED = _DEFAULT.counter(
+    "pilosa_tenant_admission_rejections_total",
+    "Per-tenant 429s: arrivals past the tenant's own queue quota"
+    " (lane-scoped) — only the offending tenant sheds",
+    labels=("tenant", "lane"), max_label_sets=4 * _TENANT_LABEL_SETS)
+TENANT_KILLS = _DEFAULT.counter(
+    "pilosa_tenant_cost_kills_total",
+    "Queries killed cluster-wide by the per-tenant cost policy"
+    " (ceiling breach at a stage boundary), by tenant",
+    labels=("tenant",), max_label_sets=_TENANT_LABEL_SETS)
+TENANT_INFLIGHT = _DEFAULT.gauge(
+    "pilosa_tenant_inflight_queries",
+    "Execution slots currently held, by tenant (scrape-time refresh"
+    " from the admission controller)",
+    labels=("tenant",), max_label_sets=_TENANT_LABEL_SETS)
+TENANT_PENALTY = _DEFAULT.gauge(
+    "pilosa_tenant_penalty_score",
+    "Decaying penalty-box score, by tenant: each cost-policy kill"
+    " adds 1, halving every penalty half-life; the effective stride"
+    " weight is demoted by 2^-score until the score decays away",
+    labels=("tenant",), max_label_sets=_TENANT_LABEL_SETS)
+TENANT_CACHE_BYTES = _DEFAULT.gauge(
+    "pilosa_tenant_cache_bytes",
+    "Result-cache residency held per tenant (result-residency bits/8"
+    " + coordinator cluster-cache entries) under the per-tenant"
+    " cache quota",
+    labels=("tenant",), max_label_sets=_TENANT_LABEL_SETS)
+TENANT_SLO_BURN = _DEFAULT.gauge(
+    "pilosa_tenant_slo_burn_rate_ratio",
+    "Per-tenant latency-objective error-budget burn rate over a"
+    " rolling window (1.0 = sustainable) — the quiet tenant's"
+    " isolation guarantee is stated against this",
+    labels=("tenant", "window"),
+    max_label_sets=4 * _TENANT_LABEL_SETS)
+
+# -- disk-full graceful degradation (fault.diskfull) --------------------------
+STORAGE_ENOSPC = _DEFAULT.counter(
+    "pilosa_storage_enospc_events_total",
+    "ENOSPC hits at durable-write sites (wal.append /"
+    " snapshot.write), by site — each flips the node write-unready"
+    " until a probe write succeeds",
+    labels=("site",))
+STORAGE_WRITE_READY = _DEFAULT.gauge(
+    "pilosa_storage_write_ready",
+    "1 while durable writes are accepted; 0 while the node is"
+    " write-unready after ENOSPC (writes answer 507, reads keep"
+    " serving, auto-recovers on a successful probe write)")
 HEDGED_REQUESTS = _DEFAULT.counter(
     "pilosa_cluster_hedged_requests_total",
     "Hedged-read outcomes: fired (second leg launched), primary_won,"

@@ -77,14 +77,17 @@ def build_info() -> dict:
 class RuntimeCollector:
     def __init__(self, holder=None, executor=None, admission=None,
                  registry=None, interval_s: float = DEFAULT_INTERVAL_S,
-                 slo=None, profiler=None, history=None):
+                 slo=None, profiler=None, history=None,
+                 tenant_slo=None):
         self.holder = holder
         self.executor = executor
         self.admission = admission
-        # SLO burn-rate tracker (obs.slo.SLOTracker) and the continuous
+        # SLO burn-rate trackers (obs.slo.SLOTracker and the
+        # per-tenant obs.slo.TenantSLOTracker) and the continuous
         # profiler (obs.profile) — sampled/summarized on the same
         # cadence so /status carries both.
         self.slo = slo
+        self.tenant_slo = tenant_slo
         self.profiler = profiler
         # Metric history (obs.history): one registry-wide sampling
         # pass per collector tick — AFTER the gauges above refresh, so
@@ -150,6 +153,11 @@ class RuntimeCollector:
         if self.slo is not None:
             try:
                 snap["slo"] = self.slo.record()
+            except Exception:  # noqa: BLE001 - visibility only
+                pass
+        if self.tenant_slo is not None:
+            try:
+                snap["tenantSlo"] = self.tenant_slo.record()
             except Exception:  # noqa: BLE001 - visibility only
                 pass
         if self.profiler is not None:

@@ -67,7 +67,18 @@ DEFAULT_WATCHES = (
     ("pilosa_cluster_rpc_seconds:p99", "up"),
     ("pilosa_wal_group_commit_flush_seconds:p99", "up"),
     ("pilosa_import_stage_seconds:p99", "up"),
+    # Per-tenant latency regression: one tenant's p99 bending while
+    # the aggregate stays flat is exactly the noisy-neighbor signature
+    # the multi-tenant isolation work exists to catch.
+    ("pilosa_tenant_query_duration_seconds:p99", "up"),
 )
+
+# Per-tenant SLO-burn rule (absolute, not robust-z): a tenant whose
+# recent-median burn rate sits past this is eating its error budget
+# 10x faster than sustainable — the classic fast-burn page threshold.
+# Series: pilosa_tenant_slo_burn_rate_ratio{tenant,window}.
+DEFAULT_TENANT_BURN_FAMILY = "pilosa_tenant_slo_burn_rate_ratio"
+DEFAULT_TENANT_BURN_THRESHOLD = 10.0
 
 # Manifest envelope rules: (manifest metrics key, live series name,
 # unit scale manifest→seconds). Only the committed keys that map
@@ -119,7 +130,9 @@ class Sentinel:
                  retrip_s: float = DEFAULT_RETRIP_S,
                  manifest_path: str = "",
                  manifest_tolerance: float = DEFAULT_MANIFEST_TOLERANCE,
-                 watches=DEFAULT_WATCHES, logger=None):
+                 watches=DEFAULT_WATCHES,
+                 tenant_burn_threshold: float
+                 = DEFAULT_TENANT_BURN_THRESHOLD, logger=None):
         from ..utils import logger as logger_mod
         self.history = history
         self.registry = registry    # sched.QueryRegistry
@@ -136,6 +149,7 @@ class Sentinel:
         self.manifest_path = manifest_path
         self.manifest_tolerance = float(manifest_tolerance)
         self.watches = tuple(watches)
+        self.tenant_burn_threshold = float(tenant_burn_threshold)
         self.logger = logger or logger_mod.NOP
         self.findings: list[dict] = []   # newest last, bounded
         self.checks = 0
@@ -256,6 +270,29 @@ class Sentinel:
                         "baselineMedian": round(bm, 6),
                         "windowS": self.window_s,
                         "baselineS": self.baseline_s})
+        # Per-tenant SLO-burn rule: absolute threshold over the
+        # tenant burn-rate gauge series (sched.tenants isolation
+        # contract — a quiet tenant's burn past the fast-burn
+        # threshold is a finding whoever caused it).
+        if self.tenant_burn_threshold > 0:
+            for key in hist.keys():
+                name, labels = split_key(key)
+                if name != DEFAULT_TENANT_BURN_FAMILY:
+                    continue
+                recent = hist.window_values(
+                    key, now - self.window_s, now + 1.0)
+                if len(recent) < self.min_points:
+                    continue
+                rm = _median(recent)
+                if rm > self.tenant_burn_threshold:
+                    out.append({
+                        "rule": "tenant_burn",
+                        "metric": DEFAULT_TENANT_BURN_FAMILY,
+                        "series": key, "labels": labels,
+                        "direction": "up",
+                        "recentMedian": round(rm, 4),
+                        "threshold": self.tenant_burn_threshold,
+                        "windowS": self.window_s})
         # Manifest envelope rules.
         metrics = self._manifest_metrics()
         for man_key, family, to_seconds in DEFAULT_MANIFEST_RULES:

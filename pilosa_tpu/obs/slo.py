@@ -135,6 +135,98 @@ class SLOTracker:
         return out
 
 
+class TenantSLOTracker:
+    """Per-tenant burn rates over the tenant-labeled latency histogram
+    (``pilosa_tenant_query_duration_seconds``) — the SLOTracker
+    machinery applied per label child, so "the quiet tenant's burn
+    stays below threshold while an aggressor sheds" is a measurable,
+    alertable statement (published as
+    ``pilosa_tenant_slo_burn_rate_ratio{tenant,window}``, /status,
+    /debug/tenants). Same zero-hot-path-cost contract: the handler's
+    one histogram observe is the only per-request work; burn math
+    runs at the runtime collector's cadence."""
+
+    def __init__(self, histogram: Optional[obs_metrics.Histogram] = None,
+                 objective_s: float = DEFAULT_OBJECTIVE_S,
+                 target: float = DEFAULT_TARGET,
+                 windows=DEFAULT_WINDOWS):
+        self.histogram = histogram or obs_metrics.TENANT_QUERY_SECONDS
+        self.objective_s = float(objective_s)
+        self.target = min(max(float(target), 0.0), 0.999999)
+        self.windows = tuple(windows)
+        bounds = self.histogram.buckets
+        i = bisect_left(bounds, self.objective_s)
+        self._good_le = bounds[i] if i < len(bounds) else None
+        self._mu = threading.Lock()
+        # tenant -> ring of (ts, good, total); rings appear lazily as
+        # tenants first serve traffic, seeded at the TRACKER's start
+        # with zero counts — the tracker is built before serving, so
+        # a newly-appearing tenant's whole count history genuinely
+        # accumulated after this stamp and lands inside the window.
+        self._t0 = time.time()
+        self._rings: dict[str, deque] = {}
+        self._last: dict[str, dict] = {}
+
+    def _counts(self) -> dict[str, tuple[int, int]]:
+        out: dict[str, tuple[int, int]] = {}
+        for labels, child in self.histogram._label_dicts():
+            tenant = labels.get("tenant", "")
+            counts, _sum, n = child.snapshot()
+            if self._good_le is None:
+                good = n
+            else:
+                good = 0
+                for bound, c in zip(self.histogram.buckets, counts):
+                    good += c
+                    if bound == self._good_le:
+                        break
+            prev = out.get(tenant, (0, 0))
+            out[tenant] = (prev[0] + good, prev[1] + n)
+        return out
+
+    def record(self) -> dict:
+        """One sampling pass: per-tenant burn rates per window, gauges
+        updated, /status + /debug/tenants block returned."""
+        now = time.time()
+        budget = 1.0 - self.target
+        out: dict[str, dict] = {}
+        for tenant, (good, total) in self._counts().items():
+            with self._mu:
+                ring = self._rings.get(tenant)
+                if ring is None:
+                    ring = self._rings[tenant] = deque(maxlen=1024)
+                    ring.append((self._t0, 0, 0))
+                snaps = list(ring)
+                ring.append((now, good, total))
+            burns = {}
+            for window_s, label in self.windows:
+                base = snaps[0] if snaps else (now, good, total)
+                for ts, g, t in snaps:
+                    if ts <= now - window_s:
+                        base = (ts, g, t)
+                    else:
+                        break
+                d_total = total - base[2]
+                d_bad = (total - good) - (base[2] - base[1])
+                burn = 0.0 if d_total <= 0 else \
+                    (d_bad / d_total) / budget
+                burns[label] = round(burn, 4)
+                obs_metrics.TENANT_SLO_BURN.labels(tenant, label).set(
+                    round(burn, 4))
+            out[tenant] = {"requestsTotal": total,
+                           "goodTotal": good,
+                           "burnRates": burns}
+        with self._mu:
+            self._last = out
+        return out
+
+    def last(self) -> dict:
+        """The most recent record() pass (for /debug/tenants — no
+        recompute on the request path)."""
+        with self._mu:
+            return dict(self._last)
+
+
 class HealthChecker:
     """Readiness checks behind ``GET /health`` — every check is cheap
     (the disk probe is throttled) so a load balancer can poll at 1 Hz
@@ -208,6 +300,19 @@ class HealthChecker:
             checks["admission"] = {"ok": True, "detail": "unlimited"}
 
         checks["disk"] = self._check_disk()
+
+        # Disk-full degradation (fault.diskfull): while ENOSPC holds
+        # the node write-unready, /health SAYS so — but the node is
+        # not "down": reads keep serving, so the block carries its
+        # own key instead of failing the disk probe (which may well
+        # still succeed for tiny probe files on a nearly-full disk).
+        from ..fault import diskfull as _diskfull
+        wr = _diskfull.write_ready()
+        checks["writeReady"] = {
+            "ok": wr,
+            "detail": ("writes accepted" if wr else
+                       "write-unready after ENOSPC (writes answer"
+                       " 507, reads serving)")}
 
         ready = all(c["ok"] for c in checks.values())
         return ready, checks

@@ -5,7 +5,12 @@ and cluster-wide cancellation + visibility.
   timings) and its thread-local propagation into layers that do not
   take a ctx argument (the mesh device dispatch).
 - ``sched.admission`` — the weighted (read/write/admin) bounded queue
-  in front of the executor; overflow surfaces as HTTP 429.
+  in front of the executor with a second, per-tenant stride level;
+  overflow surfaces as HTTP 429 (tenant-scoped when a tenant's own
+  quota overflowed).
+- ``sched.tenants`` — the tenant (= index) as a scheduling and
+  accounting principal: weights, concurrency caps, queue quotas,
+  slow-query cost ceilings with a decaying penalty box.
 - ``sched.registry`` — in-flight query visibility (/debug/queries),
   cancellation, and the slow-query log.
 - ``sched.warmup`` — cold-start compilation of the hot XLA programs.
@@ -16,7 +21,9 @@ See docs/SCHEDULING.md for the lifecycle diagram and wire contract.
 from .admission import (AdmissionController, AdmissionFullError,  # noqa: F401
                         Slot)
 from .context import (DEADLINE_HEADER, LANE_ADMIN, LANE_READ,  # noqa: F401
-                      LANE_WRITE, LANES, QUERY_ID_HEADER, QueryContext,
-                      check_current, current, use)
+                      LANE_WRITE, LANES, QUERY_ID_HEADER, TENANT_HEADER,
+                      QueryContext, check_current, current, use)
 from .registry import QueryRegistry  # noqa: F401
+from .tenants import (DEFAULT_TENANT, KILL_POLICY,  # noqa: F401
+                      KILLED_BY_HEADER, TenantPolicy, TenantRegistry)
 from .warmup import Warmup, warmup_enabled  # noqa: F401

@@ -31,7 +31,8 @@ import uuid
 from contextlib import contextmanager
 from typing import Optional
 
-from ..errors import QueryCancelledError, QueryDeadlineError
+from ..errors import (QueryCancelledError, QueryDeadlineError,
+                      QueryKilledError)
 
 # Lanes the admission controller schedules between. LANES is the
 # canonical display order (the `pilosa-tpu top` per-lane table and
@@ -42,9 +43,15 @@ LANE_WRITE = "write"
 LANE_ADMIN = "admin"
 LANES = (LANE_READ, LANE_WRITE, LANE_ADMIN)
 
-# Wire headers for cluster fan-out propagation.
+# Wire headers for cluster fan-out propagation. The tenant header
+# carries the scheduling/accounting principal (= index, today) onto
+# remote legs — same pattern as the deadline: a peer inherits the
+# coordinator's principal, so per-tenant cost ceilings and chargeback
+# roll-ups hold cluster-wide even though forwarded legs bypass
+# admission.
 DEADLINE_HEADER = "X-Pilosa-Deadline"
 QUERY_ID_HEADER = "X-Pilosa-Query-Id"
+TENANT_HEADER = "X-Pilosa-Tenant"
 
 
 class QueryContext:
@@ -54,11 +61,15 @@ class QueryContext:
                  lane: str = LANE_READ,
                  timeout_s: Optional[float] = None,
                  id: Optional[str] = None, remote: bool = False,
-                 node: str = ""):
+                 node: str = "", tenant: str = ""):
         self.id = id or uuid.uuid4().hex[:16]
         self.pql = pql
         self.index = index
         self.lane = lane
+        # Scheduling/accounting principal (sched.tenants): the index
+        # by default, the X-Pilosa-Tenant header on forwarded legs.
+        # Empty = the default tenant (bare contexts in tests).
+        self.tenant = tenant or index
         self.remote = remote
         self.node = node
         self.started = time.monotonic()
@@ -81,6 +92,18 @@ class QueryContext:
         # contract as trace: None means every note_* site records
         # nothing.
         self.cost = None
+        # Per-tenant cost policy (sched.tenants.TenantRegistry.install):
+        # a callable check() consults at every cooperative checkpoint —
+        # the stage boundaries — and which raises QueryKilledError the
+        # moment the ledger crosses a ceiling. None (the default) costs
+        # one attribute read per check.
+        self.cost_policy = None
+        # Set by the cost policy when it kills this query: check()
+        # then raises QueryKilledError (not the plain cancel) from
+        # EVERY thread touching this context, so the HTTP layer maps
+        # the distinct status deterministically whichever leg
+        # surfaces first.
+        self.killed_by = ""
         # Fault-event flags the tail sampler's keep decision reads at
         # query end ("breaker", "failover", "failpoint", "partial"):
         # set by the choke points that observe the event (client
@@ -128,8 +151,16 @@ class QueryContext:
 
     def check(self) -> None:
         """Raise if this query must stop. The single cooperative
-        cancellation point every lifecycle-aware layer calls."""
+        cancellation point every lifecycle-aware layer calls — which
+        makes it the per-tenant cost policy's stage-boundary hook
+        too (the policy kills by cancelling, so a killed query stops
+        at exactly the same points a cancelled one does)."""
         if self._cancelled.is_set():
+            if self.killed_by:
+                raise QueryKilledError(
+                    f"query {self.id} killed by {self.killed_by}"
+                    + (f": {self.cancel_reason}" if self.cancel_reason
+                       else ""))
             raise QueryCancelledError(
                 f"query {self.id} cancelled"
                 + (f": {self.cancel_reason}" if self.cancel_reason
@@ -139,6 +170,8 @@ class QueryContext:
             raise QueryDeadlineError(
                 f"query {self.id}: deadline exceeded after"
                 f" {self.elapsed():.3f}s")
+        if self.cost_policy is not None:
+            self.cost_policy(self)
 
     # -- bookkeeping ---------------------------------------------------------
 
@@ -173,6 +206,7 @@ class QueryContext:
             "id": self.id,
             "pql": self.pql[:200],
             "index": self.index,
+            "tenant": self.tenant,
             "lane": self.lane,
             "state": self.state,
             "remote": self.remote,

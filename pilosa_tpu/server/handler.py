@@ -29,13 +29,15 @@ from ..cluster.broadcast import (NOP_BROADCASTER, CancelQueryMessage,
                                  unmarshal_message)
 from ..errors import (FrameExistsError, IndexExistsError, PilosaError,
                       QueryCancelledError, QueryDeadlineError,
-                      validate_label)
+                      QueryKilledError, validate_label)
+from ..fault import diskfull as fault_diskfull
 from ..obs import accounting as obs_accounting
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
 from ..obs import trace as obs_trace
-from ..sched import (LANE_ADMIN, LANE_READ, LANE_WRITE, AdmissionFullError,
-                     QueryContext, QueryRegistry)
+from ..sched import (KILL_POLICY, KILLED_BY_HEADER, LANE_ADMIN, LANE_READ,
+                     LANE_WRITE, AdmissionFullError, QueryContext,
+                     QueryRegistry)
 from ..sched import context as sched_context
 from ..models.frame import Field, FrameOptions
 from ..models.index import IndexOptions
@@ -177,13 +179,16 @@ def _stream_chunks(f, chunk_size: int = 1 << 20):
         f.close()
 
 
-_STATUS_TEXT = {200: "OK", 400: "Bad Request", 404: "Not Found",
+_STATUS_TEXT = {200: "OK", 400: "Bad Request",
+                402: "Payment Required",  # cost-policy kill
+                404: "Not Found",
                 405: "Method Not Allowed", 406: "Not Acceptable",
                 409: "Conflict", 412: "Precondition Failed",
                 415: "Unsupported Media Type",
                 429: "Too Many Requests",
                 500: "Internal Server Error", 503: "Service Unavailable",
-                504: "Gateway Timeout"}
+                504: "Gateway Timeout",
+                507: "Insufficient Storage"}  # ENOSPC write-unready
 
 
 # Import apply lanes: how many /import handlers may be in their APPLY
@@ -214,7 +219,8 @@ class Handler:
                  tracer=None, runtime=None, profiler=None, health=None,
                  accounting: bool = True, fault=None, sampler=None,
                  blackbox=None, watchdog=None, history=None,
-                 sentinel=None, federator=None):
+                 sentinel=None, federator=None, tenants=None,
+                 tenant_slo=None):
         from ..utils import logger as logger_mod
         self.logger = logger or logger_mod.NOP
         self.holder = holder
@@ -233,6 +239,14 @@ class Handler:
         # admission control (bare test handlers); the registry always
         # exists so /debug/queries works on any handler.
         self.admission = admission
+        # Multi-tenant QoS (sched.tenants): the tenant registry
+        # resolves every request's principal (header > index >
+        # default), installs the cost-kill policy, and backs
+        # /debug/tenants; tenant_slo is the per-tenant burn tracker
+        # (obs.slo.TenantSLOTracker). None = tenant-blind (bare test
+        # handlers; tenant metrics still record by index).
+        self.tenants = tenants
+        self.tenant_slo = tenant_slo
         self.registry = registry if registry is not None \
             else QueryRegistry(logger=self.logger)
         self.warmup = warmup
@@ -337,6 +351,7 @@ class Handler:
         r("POST", "/cluster/resize", self._handle_post_cluster_resize,
           lane=LANE_ADMIN)
         r("GET", "/debug/topology", self._handle_debug_topology)
+        r("GET", "/debug/tenants", self._handle_debug_tenants)
         r("GET", "/debug/queries", self._handle_debug_queries)
         r("GET", "/debug/queries/slow", self._handle_debug_slow_queries)
         r("DELETE", "/debug/queries/{qid}", self._handle_delete_query)
@@ -404,8 +419,16 @@ class Handler:
                 if lane is None:
                     resp = fn(Request(environ, match.groupdict()))
                 else:
-                    with self._admitted(lane):
-                        resp = fn(Request(environ, match.groupdict()))
+                    # Tenant principal for the non-query lanes
+                    # (imports, schema admin): header > {index} path
+                    # segment > default — resolved BEFORE the slot is
+                    # taken, so the stride/quota accounting charges
+                    # the right tenant from the first byte.
+                    vars_ = match.groupdict()
+                    tenant = (environ.get("HTTP_X_PILOSA_TENANT", "")
+                              or vars_.get("index", ""))
+                    with self._admitted(lane, tenant=tenant):
+                        resp = fn(Request(environ, vars_))
             except HTTPError as e:
                 resp = Response(e.status, (e.message + "\n").encode(),
                                 "text/plain; charset=utf-8",
@@ -881,30 +904,62 @@ class Handler:
     def environ_header(req: Request, key: str) -> str:
         return req.environ.get(key, "")
 
-    def _admit(self, lane: str, ctx=None):
+    def _check_writable(self, lane: str) -> None:
+        """Disk-full graceful degradation (fault.diskfull): while the
+        node is write-unready after ENOSPC, writes answer 507 +
+        Retry-After INSTEAD of being admitted into a doomed WAL
+        append — reads and admin keep serving. The throttled probe
+        inside write_ready() is also the auto-recovery path."""
+        if lane != LANE_WRITE:
+            return
+        if fault_diskfull.write_ready():
+            return
+        st = fault_diskfull.default()
+        raise HTTPError(
+            507, "insufficient storage: node is write-unready after"
+                 " ENOSPC (reads still serving; retry after space"
+                 " frees)",
+            headers=[("Retry-After", str(st.retry_after_s()))])
+
+    def _admit(self, lane: str, ctx=None, tenant: str = ""):
         """Acquire an execution slot (None admission = unlimited, for
         bare test handlers). AdmissionFullError maps to 429 with the
-        controller's Retry-After estimate; a deadline that expires
-        while QUEUED maps like any other expiry (504) — the query
-        never occupied a slot."""
+        controller's Retry-After estimate — computed per lane, and per
+        tenant-lane when the rejection was the tenant's own quota; a
+        deadline that expires while QUEUED maps like any other expiry
+        (504) — the query never occupied a slot."""
+        self._check_writable(lane)
         if self.admission is None:
             return None
         try:
-            return self.admission.acquire(lane, ctx)
+            return self.admission.acquire(lane, ctx,
+                                          tenant=tenant or None)
         except AdmissionFullError as e:
             if self.stats is not None:
                 self.stats.count("queriesRejected", 1)
             obs_metrics.ADMISSION_REJECTED.labels(lane).inc()
+            if e.tenant:
+                # Tenant-scoped shed: only the offending tenant 429s,
+                # and its chargeback row says so. note_shed owns the
+                # TENANT_SHED increment (one site, metric + registry
+                # counter in lockstep); the direct inc covers bare
+                # handlers with no registry.
+                if self.tenants is not None:
+                    self.tenants.note_shed(e.tenant, lane)
+                else:
+                    obs_metrics.TENANT_SHED.labels(e.tenant,
+                                                   lane).inc()
             raise HTTPError(
                 429, f"too many requests: {e}",
                 headers=[("Retry-After",
                           str(int(e.retry_after_s)))])
 
     @contextlib.contextmanager
-    def _admitted(self, lane: str):
+    def _admitted(self, lane: str, tenant: str = ""):
         """Slot-scoped admission for the non-query lanes (imports ride
-        ``write``, schema mutations ``admin``)."""
-        slot = self._admit(lane)
+        ``write``, schema mutations ``admin``), under the resolved
+        tenant principal."""
+        slot = self._admit(lane, tenant=tenant)
         try:
             yield
         finally:
@@ -917,6 +972,51 @@ class Handler:
         if self.admission is not None:
             out["admission"] = self.admission.snapshot()
         return Response.json(out)
+
+    def _handle_debug_tenants(self, req: Request) -> Response:
+        """The multi-tenant operator view (sched.tenants): per tenant
+        — policy + effective weight, penalty-box state, in-flight /
+        queued / served / shed / killed, cache residency, and the
+        latest SLO burn rates. One merged row per tenant; the
+        ``writeReady`` block rides along since a write-unready node
+        sheds every tenant's writes at once."""
+        rows: dict[str, dict] = {}
+
+        def row(name: str) -> dict:
+            return rows.setdefault(name, {})
+
+        if self.tenants is not None:
+            for name, snap in self.tenants.snapshot().items():
+                row(name).update(snap)
+        if self.admission is not None:
+            adm = self.admission.snapshot()
+            for name, snap in (adm.get("tenants") or {}).items():
+                row(name).update(snap)
+        if self.tenants is not None:
+            # Unknown-but-active tenants (indexes with no [tenants.*]
+            # entry) ride the default policy — their rows say which
+            # policy actually governs them instead of showing nothing.
+            for name, r in rows.items():
+                if "policy" not in r:
+                    score = self.tenants.penalty_score(name)
+                    r["policy"] = self.tenants.policy(name).to_json()
+                    r["effectiveWeight"] = round(
+                        self.tenants.effective_weight(name), 4)
+                    r["penaltyScore"] = round(score, 4)
+                    r["inPenaltyBox"] = score > 0.0
+                    r.setdefault("killed", 0)
+                    r.setdefault("shed", 0)
+        usage_fn = getattr(self.executor, "tenant_cache_usage", None)
+        if callable(usage_fn):
+            for name, snap in usage_fn().items():
+                row(name)["cache"] = snap
+        if self.tenant_slo is not None:
+            for name, snap in self.tenant_slo.last().items():
+                row(name)["slo"] = snap
+        return Response.json({
+            "tenants": rows,
+            "writeReady": fault_diskfull.default().snapshot(),
+        })
 
     def _handle_delete_query(self, req: Request) -> Response:
         """Cancel one query CLUSTER-WIDE: flip the local cancel flag
@@ -974,6 +1074,31 @@ class Handler:
             for lane, depth in (adm.get("queued") or {}).items():
                 obs_metrics.ADMISSION_QUEUE_DEPTH.labels(lane).set(
                     depth)
+            tif = getattr(self.admission, "tenant_in_flight", None)
+            if callable(tif):
+                now = tif()
+                # Zero stale children first: the controller pops a
+                # tenant's key when its count drains, so a gauge set
+                # only from present keys would report the last busy
+                # value forever.
+                for labels, _child in \
+                        obs_metrics.TENANT_INFLIGHT._label_dicts():
+                    t = labels.get("tenant", "")
+                    if t and t not in now:
+                        obs_metrics.TENANT_INFLIGHT.labels(t).set(0)
+                for tenant, n in now.items():
+                    obs_metrics.TENANT_INFLIGHT.labels(tenant).set(n)
+        usage_fn = getattr(self.executor, "tenant_cache_usage", None)
+        if callable(usage_fn):
+            usage = usage_fn()
+            for labels, _child in \
+                    obs_metrics.TENANT_CACHE_BYTES._label_dicts():
+                t = labels.get("tenant", "")
+                if t and t not in usage:
+                    obs_metrics.TENANT_CACHE_BYTES.labels(t).set(0)
+            for tenant, ent in usage.items():
+                obs_metrics.TENANT_CACHE_BYTES.labels(tenant).set(
+                    ent.get("bytes", 0))
 
     def _local_metrics_text(self) -> str:
         """The local 0.0.4 exposition exactly as /metrics serves it —
@@ -1349,11 +1474,17 @@ class Handler:
         lane = (LANE_WRITE
                 if any(c.name in _WRITE_CALLS for c in query.calls)
                 else LANE_READ)
+        # Tenant principal (sched.tenants): the X-Pilosa-Tenant header
+        # on forwarded legs (the coordinator's principal), the index
+        # otherwise — resolved BEFORE admission so the stride/quota
+        # accounting and the 429 counters charge the right tenant.
+        tenant = (self.environ_header(req, "HTTP_X_PILOSA_TENANT")
+                  or index_name)
         ctx = QueryContext(
             pql=query_str, index=index_name, lane=lane,
             timeout_s=self._query_timeout_s(req),
             id=self.environ_header(req, "HTTP_X_PILOSA_QUERY_ID") or None,
-            remote=remote, node=self.host)
+            remote=remote, node=self.host, tenant=tenant)
         ctx.stages["parse"] = parse_s
         # Resource accounting (obs.accounting): every query gets a cost
         # ledger — container ops by kind, device bytes, compile ms, RPC
@@ -1361,6 +1492,12 @@ class Handler:
         # their own ledger AND piggyback it back for stitching.
         if self.accounting:
             obs_accounting.attach(ctx, node=self.host)
+        # Slow-query kill policy: when this tenant's policy has cost
+        # ceilings, every ctx.check() (the stage boundaries, on EVERY
+        # node this query touches) compares the live ledger against
+        # them — a breach kills cluster-wide via the cancel broadcast.
+        if self.tenants is not None:
+            self.tenants.install(ctx)
         # Distributed tracing (obs.trace): traced when this node's
         # tracer is on, the request opts in (?trace=1), or a
         # coordinator asked this forwarded leg to trace itself
@@ -1451,17 +1588,40 @@ class Handler:
                 # the tail sampler (the barrier covers its records).
                 with ctx.stage("commit"), sched_context.use(ctx):
                     storage_wal.barrier_all()
-        except HTTPError as e:  # 429 from _admit
+        except HTTPError as e:  # 429 from _admit / 507 write-unready
             err = e
             raise
         except QueryDeadlineError as e:
             err = e
             return error_resp(504, str(e),
                               headers=_resp_headers())
+        except QueryKilledError as e:
+            # Cost-policy kill (sched.tenants): a DISTINCT status so
+            # clients tell a budget kill from an operator cancel, with
+            # the policy named in the header contract.
+            err = e
+            hs = _resp_headers()
+            hs.append((KILLED_BY_HEADER, KILL_POLICY))
+            return error_resp(402, str(e), headers=hs)
         except QueryCancelledError as e:
             err = e
             return error_resp(409, str(e),
                               headers=_resp_headers())
+        except storage_wal.WalError as e:
+            # A commit barrier that failed on a FULL disk answers 507
+            # + Retry-After (fault.diskfull already flipped the node
+            # write-unready at the WAL site) — a retryable condition,
+            # not a 500 crash-loop. Any other WAL failure stays a 500.
+            err = e
+            if not fault_diskfull.write_ready(probe=False):
+                hs = _resp_headers()
+                hs.append(("Retry-After", str(
+                    fault_diskfull.default().retry_after_s())))
+                return error_resp(
+                    507, "insufficient storage: write not durable"
+                         f" ({e})", headers=hs)
+            self.logger.printf("query commit barrier failed: %s", e)
+            return error_resp(500, str(e), headers=_resp_headers())
         except PilosaError as e:
             err = e
             return error_resp(400, str(e), headers=_resp_headers())
@@ -1477,8 +1637,13 @@ class Handler:
                 status = err.status
             elif isinstance(err, QueryDeadlineError):
                 status = 504
+            elif isinstance(err, QueryKilledError):
+                status = 402
             elif isinstance(err, QueryCancelledError):
                 status = 409
+            elif (isinstance(err, storage_wal.WalError)
+                  and not fault_diskfull.write_ready(probe=False)):
+                status = 507
             elif isinstance(err, PilosaError):
                 status = 400
             elif err is not None:
@@ -1544,6 +1709,38 @@ class Handler:
             obs_metrics.QUERY_SECONDS.labels(*labels).observe(
                 ctx.elapsed(), exemplar={"trace_id": ctx.id})
             obs_metrics.QUERIES_TOTAL.labels(*labels).inc()
+            # Per-tenant chargeback (sched.tenants): client-facing
+            # latency/outcome on the COORDINATOR only (a remote leg
+            # re-observing would double count the fleet roll-up);
+            # cost units on EVERY node — each node's ledger holds its
+            # own local work, so per-node increments sum correctly.
+            tlabel = ctx.tenant or "default"
+            if not remote:
+                obs_metrics.TENANT_QUERY_SECONDS.labels(
+                    tlabel).observe(ctx.elapsed())
+                obs_metrics.TENANT_QUERIES.labels(
+                    tlabel, str(status)).inc()
+            cost = ctx.cost
+            if cost is not None:
+                rpc_b = sum(v["bytesOut"] + v["bytesIn"]
+                            for v in cost.rpc.values())
+                for resource, amount in (
+                        ("container_ops",
+                         sum(cost.container_ops.values())),
+                        ("words_scanned", cost.words_scanned),
+                        ("bits_written", cost.bits_written),
+                        ("device_bytes", cost.device_bytes),
+                        ("rpc_bytes", rpc_b),
+                        ("queue_wait_ms",
+                         int(ctx.stages.get("admission", 0.0) * 1e3)),
+                        # Wall microseconds: the universal chargeback
+                        # unit — kernel-fused paths can legitimately
+                        # do zero container algebra, but every leg
+                        # burns wall time on its node.
+                        ("wall_us", int(ctx.elapsed() * 1e6))):
+                    if amount:
+                        obs_metrics.TENANT_COST_UNITS.labels(
+                            tlabel, resource).inc(amount)
 
         # Optional column-attribute join (handler.go:208-227).
         attr_sets = []

@@ -38,17 +38,18 @@ from ..obs.profile import ContinuousProfiler
 from ..obs.runtime import RuntimeCollector, build_info
 from ..obs.sampler import TailSampler
 from ..obs.sentinel import Sentinel
-from ..obs.slo import SLOTracker
+from ..obs.slo import SLOTracker, TenantSLOTracker
 from ..obs.trace import Tracer
 from ..obs.watchdog import Watchdog
 from ..proto import internal_pb2 as pb
-from ..sched import (AdmissionController, QueryRegistry, Warmup,
-                     warmup_enabled)
+from ..sched import (AdmissionController, QueryRegistry, TenantRegistry,
+                     Warmup, warmup_enabled)
 from ..utils import logger as logger_mod
 from ..utils.config import (BlackboxConfig, FaultConfig, HistoryConfig,
                             MetricsConfig, ProfileConfig, QueryConfig,
-                            SentinelConfig, SLOConfig, TraceConfig,
-                            WatchdogConfig, parse_resolutions)
+                            SentinelConfig, SLOConfig, TenantsConfig,
+                            TraceConfig, WatchdogConfig,
+                            parse_resolutions)
 from ..utils.stats import NOP, MultiStatsClient
 from .handler import Handler
 from .httpd import HTTPServer
@@ -80,7 +81,8 @@ class Server:
                  resize_pace_s: float = 0.0,
                  resize_grace_s: float = 30.0,
                  history_config: Optional[HistoryConfig] = None,
-                 sentinel_config: Optional[SentinelConfig] = None):
+                 sentinel_config: Optional[SentinelConfig] = None,
+                 tenants_config: Optional[TenantsConfig] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -168,13 +170,30 @@ class Server:
                          else DEFAULT_STALENESS_S))
 
         # Query lifecycle subsystem (sched; docs/SCHEDULING.md): the
-        # weighted admission queue in front of the executor, the
-        # in-flight registry behind /debug/queries, and (from open())
-        # the cold-start warmup lane.
+        # weighted admission queue in front of the executor — with the
+        # tenant (= index) as a second stride level (sched.tenants:
+        # weights, caps, quotas, cost-kill ceilings, penalty box) —
+        # the in-flight registry behind /debug/queries, and (from
+        # open()) the cold-start warmup lane.
         self.query_config = query_config or QueryConfig()
+        self.tenants_config = tenants_config or TenantsConfig()
+        self.tenants = TenantRegistry(self.tenants_config.table,
+                                      node=host)
+        # Cluster-wide kill fan-out: reads self.broadcaster at CALL
+        # time (it is swapped after open() for http/gossip modes).
+        self.tenants.kill_broadcast = self._broadcast_kill
         self.admission = AdmissionController(
             concurrency=self.query_config.concurrency,
-            queue_depth=self.query_config.queue_depth)
+            queue_depth=self.query_config.queue_depth,
+            tenants=self.tenants)
+        # Per-tenant SLO burn (obs.slo.TenantSLOTracker), recorded on
+        # the runtime collector's cadence against the SAME objective
+        # as the aggregate tracker.
+        self.tenant_slo: Optional[TenantSLOTracker] = None
+        if self.metrics_config.enabled:
+            self.tenant_slo = TenantSLOTracker(
+                objective_s=self.slo_config.objective,
+                target=self.slo_config.target)
         self.query_registry = QueryRegistry(
             slow_threshold_s=self.query_config.slow_threshold or None,
             stats=stats, logger=logger)
@@ -205,6 +224,13 @@ class Server:
         self._clients: dict[str, Client] = {}
         self._clients_mu = threading.Lock()
 
+    def _broadcast_kill(self, qid: str) -> None:
+        """Fan a cost-policy kill cluster-wide (sched.tenants): the
+        SAME CancelQueryMessage an operator DELETE rides, so peers
+        cancel the legs registered under the killed id."""
+        from ..cluster.broadcast import CancelQueryMessage
+        self.broadcaster.send_async(CancelQueryMessage(qid))
+
     def client_for(self, host: str) -> Client:
         """The shared keep-alive Client for a peer host."""
         with self._clients_mu:
@@ -223,6 +249,23 @@ class Server:
     # -- lifecycle (server.go:89-180) ----------------------------------------
 
     def open(self) -> None:
+        # GIL fairness for multi-tenant latency isolation: CPython's
+        # default 5 ms switch interval lets one tenant's CPU-bound
+        # handler thread hold the interpreter for whole milliseconds
+        # while a quiet tenant's 2 ms query waits — a direct p99
+        # transfer between tenants that admission cannot see. 1 ms
+        # keeps cross-thread handoff latency ~interference-sized;
+        # PILOSA_TPU_GIL_SWITCH_MS overrides (0 keeps the interpreter
+        # default). Process-global by nature, set once at open.
+        raw_switch = os.environ.get("PILOSA_TPU_GIL_SWITCH_MS", "1")
+        try:
+            switch_ms = float(raw_switch)
+        except ValueError:
+            switch_ms = 1.0
+        if switch_ms > 0:
+            import sys as sys_mod
+            sys_mod.setswitchinterval(switch_ms / 1e3)
+
         bind_host, sep, port_s = self.host.rpartition(":")
         if not sep:  # bare hostname, no port
             bind_host, port_s = self.host, ""
@@ -285,7 +328,8 @@ class Server:
             result_cache_entries=self.query_config.result_cache_entries,
             result_cache_bits=self.query_config.result_cache_bits,
             cluster_cache_entries=self.query_config
-            .cluster_cache_entries)
+            .cluster_cache_entries,
+            tenants=self.tenants)
         # Cold-start warmup: background-compile the hot XLA programs so
         # the first real device query doesn't pay the multi-second
         # trace+compile (state surfaces at /status; PILOSA_TPU_WARMUP=0
@@ -310,7 +354,8 @@ class Server:
                 holder=self.holder, executor=self.executor,
                 admission=self.admission,
                 interval_s=self.metrics_config.runtime_interval,
-                slo=self.slo, profiler=self.profiler,
+                slo=self.slo, tenant_slo=self.tenant_slo,
+                profiler=self.profiler,
                 history=self.history)
         # Cluster federation (obs.federate): /metrics/cluster,
         # /debug/cluster, and history scope=cluster fan a bounded
@@ -405,7 +450,8 @@ class Server:
             fault=self.fault, sampler=self.sampler,
             blackbox=self.blackbox, watchdog=self.watchdog,
             history=self.history, sentinel=self.sentinel,
-            federator=self.federator)
+            federator=self.federator, tenants=self.tenants,
+            tenant_slo=self.tenant_slo)
 
         self._httpd = HTTPServer(self.handler, bind_host, port,
                                  logger=self.logger,
@@ -550,16 +596,27 @@ class Server:
             return None  # bulk-attrs path applies non-positionally
         lane = (LANE_WRITE if any(c.name in _WRITE_CALLS for c in calls)
                 else LANE_READ)
+        if lane == LANE_WRITE:
+            from ..fault import diskfull as fault_diskfull
+            if not fault_diskfull.write_ready():
+                # Write-unready after ENOSPC: decline the batch so
+                # per-request dispatch answers the proper 507s.
+                return None
         try:
-            slot = self.admission.acquire(lane)
+            # The batch's tenant is resolved BEFORE the slot is taken
+            # (all requests in a batchable run share one index, which
+            # IS the principal) — the combined run schedules and
+            # charges under it like any single query would.
+            slot = self.admission.acquire(lane, tenant=index)
         except AdmissionFullError:
-            return None  # per-request dispatch answers the 429s
+            return None  # per-request dispatch answers the 429s/507s
         ctx = QueryContext(pql=f"<pipelined batch: {len(calls)} calls>",
                            index=index, lane=lane,
                            timeout_s=self.query_config.default_timeout
-                           or None, node=self.host)
+                           or None, node=self.host, tenant=index)
         if self.metrics_config.accounting:
             obs_accounting.attach(ctx, node=self.host)
+        self.tenants.install(ctx)
         err = None  # stays None if execute_partial itself raises —
         # the finally below must never NameError over the real failure
         try:

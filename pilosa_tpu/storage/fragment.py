@@ -583,12 +583,22 @@ class Fragment:
                 # the map reference (see its comment).
                 t0 = time.perf_counter()
                 tmp = self.path + ".snapshotting"
-                with open(tmp, "wb") as f:
-                    if _fp.ACTIVE is not None:
-                        _fp.ACTIVE.hit("snapshot.write", writer=f)
-                    self.storage.write_to(f)
-                    f.flush()
-                    os.fsync(f.fileno())
+                try:
+                    with open(tmp, "wb") as f:
+                        if _fp.ACTIVE is not None:
+                            _fp.ACTIVE.hit("snapshot.write", writer=f)
+                        self.storage.write_to(f)
+                        f.flush()
+                        os.fsync(f.fileno())
+                except OSError as e:
+                    # A full disk flips the node write-unready
+                    # (fault.diskfull → writes answer 507, reads keep
+                    # serving) before the failure propagates; the old
+                    # snapshot+WAL stays the file of record.
+                    from ..fault import diskfull as _diskfull
+                    _diskfull.note_if_enospc(e, "snapshot.write",
+                                             self.path)
+                    raise
                 self._swap_data_file(tmp, new_op_n=0)
                 snap_s = time.perf_counter() - t0
                 # The snapshot leg of the import-stage breakdown
@@ -740,7 +750,12 @@ class Fragment:
                     # caught: its own fallback reopen either restores
                     # a consistent state or propagates, leaving the
                     # fragment visibly broken — never quietly
-                    # unlogged.)
+                    # unlogged.) ENOSPC additionally flips the node
+                    # write-unready (fault.diskfull) so the retry
+                    # pressure stops at the HTTP layer with 507s.
+                    from ..fault import diskfull as _diskfull
+                    _diskfull.note_if_enospc(e, "snapshot.write",
+                                             self.path)
                     self.logger.printf(
                         "fragment: async snapshot failed for"
                         " %s/%s/%s/%d: %s", self.index, self.frame,

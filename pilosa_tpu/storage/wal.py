@@ -64,6 +64,7 @@ import threading
 import time
 from typing import Optional
 
+from ..fault import diskfull as _diskfull
 from ..fault import failpoints as _fp
 from ..obs import accounting as _accounting
 from ..obs import metrics as obs_metrics
@@ -240,6 +241,14 @@ class GroupCommitWal:
                 obs_metrics.WAL_FSYNCS.inc()
         except BaseException as e:  # noqa: BLE001 — must wake waiters
             err = e
+            # A full disk is a NODE condition, not this WAL's: flip
+            # the process write-unready (fault.diskfull) so the
+            # serving layer answers 507 + Retry-After instead of
+            # letting every write query rediscover the same wall.
+            # The batch stays pending either way — recovery retries
+            # it cleanly.
+            _diskfull.note_if_enospc(e, "wal.append",
+                                     getattr(file, "name", None))
             try:
                 # An arbitrary prefix of the batch may be on disk; cut
                 # the file back to the durable prefix so retries (and
@@ -251,6 +260,11 @@ class GroupCommitWal:
                 recovered = True
             except Exception:
                 recovered = False  # fail-stop until the snapshot swap
+        else:
+            if batch:
+                # Successful durable write: the cheapest possible
+                # recovery signal when the node was write-unready.
+                _diskfull.note_write_ok()
         el = time.perf_counter() - ft0
         with self._mu:
             self._leader = False

@@ -108,6 +108,127 @@ class QueryConfig:
     cluster_cache_entries: int = DEFAULT_CLUSTER_CACHE_ENTRIES
 
 
+# -- [tenants]: per-tenant QoS (sched.tenants; docs/SCHEDULING.md) -----------
+# One sub-table per tenant (tenant = index). The ``default`` entry is
+# MANDATORY whenever the table is present: it is what unknown tenants
+# (new indexes, forwarded legs with no header) schedule under, so a
+# table without it would silently drop them on the floor.
+
+_TENANT_KEYS = ("weight", "concurrency", "queue-depth",
+                "max-container-ops", "max-device-bytes", "max-wall",
+                "cache-share")
+
+DEFAULT_TENANT = "default"
+
+
+def validate_tenant_entry(name: str, entry) -> dict:
+    """One ``[tenants.<name>]`` table → normalized snake_case dict.
+    Fails LOUDLY (ValueError) on unknown keys, non-positive weights,
+    or out-of-range shares — a half-parsed QoS table that silently
+    drops a ceiling is an isolation hole, not a default."""
+    if not isinstance(entry, dict):
+        raise ValueError(f"[tenants.{name}]: expected a table,"
+                         f" got {type(entry).__name__}")
+    unknown = sorted(set(entry) - set(_TENANT_KEYS))
+    if unknown:
+        raise ValueError(
+            f"[tenants.{name}]: unknown key(s) {', '.join(unknown)}"
+            f" (valid: {', '.join(_TENANT_KEYS)})")
+    out: dict = {}
+    if "weight" in entry:
+        w = float(entry["weight"])
+        if w <= 0:
+            raise ValueError(
+                f"[tenants.{name}]: weight must be positive, got {w}")
+        out["weight"] = w
+    for key, attr in (("concurrency", "concurrency"),
+                      ("queue-depth", "queue_depth"),
+                      ("max-container-ops", "max_container_ops"),
+                      ("max-device-bytes", "max_device_bytes")):
+        if key in entry:
+            v = int(entry[key])
+            if v < 0:
+                raise ValueError(f"[tenants.{name}]: {key} must be"
+                                 f" >= 0 (0 = unlimited), got {v}")
+            out[attr] = v
+    if "max-wall" in entry:
+        v = parse_duration(entry["max-wall"])
+        if v < 0:
+            raise ValueError(f"[tenants.{name}]: max-wall must be"
+                             f" >= 0 (0 = unlimited), got {v}")
+        out["max_wall_s"] = v
+    if "cache-share" in entry:
+        v = float(entry["cache-share"])
+        if not 0.0 < v <= 1.0:
+            raise ValueError(
+                f"[tenants.{name}]: cache-share must be in (0, 1],"
+                f" got {v}")
+        out["cache_share"] = v
+    return out
+
+
+def parse_tenant_table(table) -> dict[str, dict]:
+    """The whole ``[tenants]`` TOML table → {name: normalized dict}.
+    A present-but-defaultless table fails loudly."""
+    if not isinstance(table, dict):
+        raise ValueError("[tenants]: expected a table of tables")
+    out = {str(name): validate_tenant_entry(str(name), entry)
+           for name, entry in table.items()}
+    if out and DEFAULT_TENANT not in out:
+        raise ValueError(
+            "[tenants]: a 'default' entry is required — it is what"
+            " unknown tenants schedule and account under")
+    return out
+
+
+def parse_tenants(raw: str) -> dict[str, dict]:
+    """Compact env/flag form of the tenant table (PILOSA_TENANTS /
+    --tenants), same key vocabulary as the TOML::
+
+        default:weight=4,concurrency=8;bulk:weight=1,max-wall=2s
+
+    ``;`` separates tenants, ``name:`` starts one, ``,``-separated
+    ``key=value`` pairs follow. Same loud validation as the table."""
+    table: dict = {}
+    for part in str(raw).split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, sep, body = part.partition(":")
+        name = name.strip()
+        if not sep or not name:
+            raise ValueError(
+                f"invalid tenant spec {part!r}: expected"
+                f" name:key=value[,key=value...]")
+        entry: dict = {}
+        for kv in body.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, eq, v = kv.partition("=")
+            if not eq:
+                raise ValueError(
+                    f"invalid tenant spec {part!r}: {kv!r} is not"
+                    f" key=value")
+            entry[k.strip()] = v.strip()
+        table[name] = entry
+    return parse_tenant_table(table)
+
+
+@dataclass
+class TenantsConfig:
+    """[tenants] section (sched.tenants; docs/SCHEDULING.md): the
+    per-tenant QoS table — weight (second-level stride share within
+    each lane), concurrency / queue-depth (per-tenant slot cap and
+    queue quota; overflow 429s only that tenant), max-container-ops /
+    max-device-bytes / max-wall (slow-query kill ceilings over the
+    live cost ledger; 0 = unlimited), cache-share (fraction of the
+    result-cache budgets one tenant may occupy). ``table`` maps
+    tenant name → normalized entry; empty = every tenant rides the
+    built-in default policy."""
+    table: dict = field(default_factory=dict)
+
+
 @dataclass
 class MetricsConfig:
     """[metrics] section (obs subsystem): ``enabled`` gates the
@@ -302,6 +423,7 @@ class Config:
     host: str = f"{DEFAULT_HOST}:{DEFAULT_PORT}"
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     query: QueryConfig = field(default_factory=QueryConfig)
+    tenants: TenantsConfig = field(default_factory=TenantsConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     history: HistoryConfig = field(default_factory=HistoryConfig)
     sentinel: SentinelConfig = field(default_factory=SentinelConfig)
@@ -326,6 +448,21 @@ class Config:
             for site, spec in sorted(self.fault.failpoints.items()))
         if failpoints:
             failpoints = "\n[fault.failpoints]\n" + failpoints
+        toml_keys = {"weight": "weight", "concurrency": "concurrency",
+                     "queue_depth": "queue-depth",
+                     "max_container_ops": "max-container-ops",
+                     "max_device_bytes": "max-device-bytes",
+                     "max_wall_s": "max-wall",
+                     "cache_share": "cache-share"}
+        tenants = ""
+        for name, entry in sorted(self.tenants.table.items()):
+            tenants += f"\n[tenants.{name}]\n"
+            for attr, key in toml_keys.items():
+                if attr in entry:
+                    v = entry[attr]
+                    tenants += (f'{key} = "{v}s"\n'
+                                if key == "max-wall" else
+                                f"{key} = {v}\n")
 
         def dur(v: float) -> str:
             # Sub-second values must survive the round trip ("0.5s"
@@ -357,7 +494,7 @@ slow-threshold = "{dur(self.query.slow_threshold)}"
 result-cache-entries = {self.query.result_cache_entries}
 result-cache-bits = {self.query.result_cache_bits}
 cluster-cache-entries = {self.query.cluster_cache_entries}
-
+{tenants}
 [metrics]
 enabled = {str(self.metrics.enabled).lower()}
 runtime-interval = "{dur(self.metrics.runtime_interval)}"
@@ -493,6 +630,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
             "result-cache-bits", cfg.query.result_cache_bits))
         cfg.query.cluster_cache_entries = int(q.get(
             "cluster-cache-entries", cfg.query.cluster_cache_entries))
+        if "tenants" in data:
+            cfg.tenants.table = parse_tenant_table(data["tenants"])
         m = data.get("metrics", {})
         if "enabled" in m:
             cfg.metrics.enabled = _parse_bool(m["enabled"])
@@ -659,6 +798,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
     if env.get("PILOSA_QUERY_CLUSTER_CACHE_ENTRIES"):
         cfg.query.cluster_cache_entries = int(
             env["PILOSA_QUERY_CLUSTER_CACHE_ENTRIES"])
+    if env.get("PILOSA_TENANTS"):
+        cfg.tenants.table = parse_tenants(env["PILOSA_TENANTS"])
     if env.get("PILOSA_CLUSTER_GEN_STALENESS"):
         # Bare numbers accepted too (the executor's direct env read
         # takes them; the two entry points must not diverge).

@@ -60,3 +60,8 @@ def pytest_configure(config):
         "markers", "resize: elastic cluster-resize tests (ISSUE 12) —"
                    " fast failpoint legs run tier-1, the multi-process"
                    " SIGKILL legs are additionally `slow`")
+    config.addinivalue_line(
+        "markers", "tenant: multi-tenant QoS tests (ISSUE 14) — "
+                   "per-tenant lanes/quotas/kill-policy/cache-quota"
+                   " units run tier-1, the real 2-node gossip legs"
+                   " are additionally `slow`")

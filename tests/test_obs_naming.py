@@ -57,6 +57,48 @@ class TestMetricNamingSweep:
             assert obs_metrics.NAME_RE.match(name), name
 
 
+class TestTenantFamiliesSwept:
+    """ISSUE 14: the pilosa_tenant_* chargeback families must exist,
+    follow the naming convention (the sweep above already enforces
+    that for everything registered), carry a ``tenant`` label, and
+    ride a bounded label set (the PR-10 overflow bucket) — a
+    tenant-per-customer deployment must not blow up the exposition."""
+
+    _FAMILIES = (
+        "pilosa_tenant_query_duration_seconds",
+        "pilosa_tenant_query_requests_total",
+        "pilosa_tenant_cost_units_total",
+        "pilosa_tenant_admission_rejections_total",
+        "pilosa_tenant_cost_kills_total",
+        "pilosa_tenant_inflight_queries",
+        "pilosa_tenant_penalty_score",
+        "pilosa_tenant_cache_bytes",
+        "pilosa_tenant_slo_burn_rate_ratio",
+    )
+
+    def test_families_registered_with_tenant_label(self):
+        fams = obs_metrics.default_registry().families()
+        for name in self._FAMILIES:
+            assert name in fams, f"tenant family {name} not registered"
+            fam = fams[name]
+            assert "tenant" in fam.labelnames, (
+                f"{name} must carry a tenant label,"
+                f" has {fam.labelnames}")
+            assert fam.max_label_sets <= 512, (
+                f"{name} must ride an explicit bounded label set")
+
+    def test_overflow_bucket_engages(self):
+        """Past the cap, new tenants collapse into _overflow_ instead
+        of growing the family unboundedly."""
+        fam = obs_metrics.TENANT_KILLS
+        for i in range(fam.max_label_sets + 8):
+            fam.labels(f"naming-sweep-tenant-{i}").inc()
+        labelsets = [labels for labels, _ in fam._label_dicts()]
+        assert len(labelsets) <= fam.max_label_sets + 1
+        assert any(obs_metrics._OVERFLOW_LABEL in ls.values()
+                   for ls in labelsets)
+
+
 class TestRouteTableDocumented:
     def test_debug_and_metrics_routes_in_readme(self):
         handler = Handler(None, None)

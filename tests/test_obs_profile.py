@@ -384,7 +384,7 @@ class TestSLOAndHealth:
         out = json.loads(body)
         assert out["status"] == "ok"
         assert set(out["checks"]) == {"holder", "gossip", "admission",
-                                      "disk"}
+                                      "disk", "writeReady"}
         # A handler with no holder is NOT ready (and says why).
         bare = Handler(None, None)
         status, _, body = call(bare, "GET", "/health")

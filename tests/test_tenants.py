@@ -1,0 +1,715 @@
+"""Multi-tenant QoS (ISSUE 14, pilosa_tpu.sched.tenants): per-tenant
+weighted lanes / caps / quotas in admission, the slow-query cost-kill
+policy with its penalty box, per-tenant cache quotas, the `[tenants]`
+config contract, per-tenant SLO burn, the sentinel's tenant rule, and
+ENOSPC disk-full graceful degradation (fault.diskfull + the `enospc`
+failpoint mode)."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.errors import QueryKilledError
+from pilosa_tpu.fault import diskfull as fault_diskfull
+from pilosa_tpu.fault import failpoints
+from pilosa_tpu.obs import accounting as obs_accounting
+from pilosa_tpu.obs import metrics as obs_metrics
+from pilosa_tpu.obs.slo import HealthChecker, TenantSLOTracker
+from pilosa_tpu.sched import (AdmissionController, AdmissionFullError,
+                              QueryContext, TenantRegistry)
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.storage.bitmap import Bitmap
+from pilosa_tpu.storage.wal import GroupCommitWal, WalError
+from pilosa_tpu.utils.config import (Config, QueryConfig, TenantsConfig,
+                                     load, parse_tenant_table,
+                                     parse_tenants)
+
+pytestmark = pytest.mark.tenant
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_state():
+    """The diskfull latch and failpoint registry are process-global:
+    a leaked write-unready flag would 507 every later write test in
+    the tier-1 run."""
+    yield
+    failpoints.disarm_all()
+    fault_diskfull.default().reset()
+
+
+# ---------------------------------------------------------------------------
+# [tenants] config contract
+
+
+class TestTenantConfig:
+    def test_table_parses_and_normalizes(self):
+        table = parse_tenant_table({
+            "default": {"weight": 4, "concurrency": 8,
+                        "queue-depth": 16, "max-wall": "2s",
+                        "cache-share": 0.5},
+            "bulk": {"weight": 1, "max-container-ops": 1000,
+                     "max-device-bytes": 1 << 20},
+        })
+        assert table["default"]["weight"] == 4.0
+        assert table["default"]["max_wall_s"] == 2.0
+        assert table["default"]["cache_share"] == 0.5
+        assert table["bulk"]["max_container_ops"] == 1000
+
+    def test_unknown_key_fails_loudly(self):
+        with pytest.raises(ValueError, match="unknown key.*wieght"):
+            parse_tenant_table({"default": {"wieght": 4}})
+
+    def test_non_positive_weight_fails_loudly(self):
+        with pytest.raises(ValueError, match="weight must be positive"):
+            parse_tenant_table({"default": {"weight": 0}})
+        with pytest.raises(ValueError, match="weight must be positive"):
+            parse_tenant_table({"default": {"weight": -2}})
+
+    def test_missing_default_fails_loudly(self):
+        with pytest.raises(ValueError, match="'default' entry"):
+            parse_tenant_table({"bulk": {"weight": 1}})
+
+    def test_bad_cache_share_fails_loudly(self):
+        with pytest.raises(ValueError, match="cache-share"):
+            parse_tenant_table({"default": {"cache-share": 1.5}})
+
+    def test_compact_form_round_trips(self):
+        table = parse_tenants(
+            "default:weight=4,concurrency=8;"
+            "bulk:weight=1,max-wall=500ms,queue-depth=2")
+        assert table["default"]["concurrency"] == 8
+        assert table["bulk"]["max_wall_s"] == 0.5
+        assert table["bulk"]["queue_depth"] == 2
+
+    def test_compact_form_malformed_fails(self):
+        with pytest.raises(ValueError):
+            parse_tenants("default")  # no colon
+        with pytest.raises(ValueError):
+            parse_tenants("default:weight")  # no =
+
+    def test_env_plumbing(self):
+        cfg = load(env={"PILOSA_TENANTS":
+                        "default:weight=2;hot:concurrency=4"})
+        assert cfg.tenants.table["default"]["weight"] == 2.0
+        assert cfg.tenants.table["hot"]["concurrency"] == 4
+        with pytest.raises(ValueError):
+            load(env={"PILOSA_TENANTS": "hot:weight=1"})  # no default
+
+    def test_toml_file_and_to_toml_round_trip(self, tmp_path):
+        cfg = Config()
+        cfg.tenants = TenantsConfig(table=parse_tenants(
+            "default:weight=4,cache-share=0.5;"
+            "bulk:weight=1,max-wall=2s"))
+        p = tmp_path / "c.toml"
+        p.write_text(cfg.to_toml())
+        got = load(str(p))
+        assert got.tenants.table["default"]["weight"] == 4.0
+        assert got.tenants.table["default"]["cache_share"] == 0.5
+        assert got.tenants.table["bulk"]["max_wall_s"] == 2.0
+
+
+# ---------------------------------------------------------------------------
+# TenantRegistry: resolution, inheritance, penalty box
+
+
+class TestTenantRegistry:
+    def test_unknown_tenant_rides_default_policy(self):
+        reg = TenantRegistry({"default": {"weight": 4,
+                                          "concurrency": 8}})
+        pol = reg.policy("never-seen-index")
+        assert pol.weight == 4 and pol.concurrency == 8
+
+    def test_named_tenant_inherits_unset_knobs_from_default(self):
+        reg = TenantRegistry({"default": {"weight": 4,
+                                          "cache_share": 0.25},
+                              "bulk": {"weight": 1}})
+        pol = reg.policy("bulk")
+        assert pol.weight == 1 and pol.cache_share == 0.25
+
+    def test_penalty_box_demotes_and_recovers(self):
+        reg = TenantRegistry({"default": {"weight": 4}},
+                             penalty_half_life_s=0.05)
+        assert reg.effective_weight("t") == 4.0
+        reg.note_kill("t")
+        w = reg.effective_weight("t")
+        assert w < 4.0  # demoted (~half)
+        assert reg.snapshot()["t"]["inPenaltyBox"]
+        time.sleep(0.5)  # 10 half-lives: score decays past the floor
+        assert reg.effective_weight("t") == 4.0
+        assert not reg.snapshot()["t"]["inPenaltyBox"]
+
+    def test_repeat_offender_sinks_further(self):
+        reg = TenantRegistry({"default": {"weight": 8}},
+                             penalty_half_life_s=60.0)
+        reg.note_kill("t")
+        one = reg.effective_weight("t")
+        reg.note_kill("t")
+        two = reg.effective_weight("t")
+        assert two < one < 8.0
+
+
+# ---------------------------------------------------------------------------
+# Two-level stride admission
+
+
+def _drain(ac, slots):
+    for s in slots:
+        s.release()
+
+
+class TestTenantAdmission:
+    def _grant_order(self, ac, plan, n_grants):
+        """Enqueue one waiter per (lane, tenant) in ``plan`` behind a
+        gate slot; release serially; return grant order."""
+        order, threads = [], []
+        gate = ac.acquire("read", tenant="gate")
+        mu = threading.Lock()
+
+        def worker(lane, tenant):
+            s = ac.acquire(lane, tenant=tenant)
+            with mu:
+                order.append(tenant)
+            s.release()
+
+        for lane, tenant in plan:
+            t = threading.Thread(target=worker, args=(lane, tenant))
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + 10
+        while (time.monotonic() < deadline
+               and ac.snapshot()["queued"].get("read", 0)
+               + ac.snapshot()["queued"].get("write", 0) < len(plan)):
+            time.sleep(0.01)
+        gate.release()
+        for t in threads:
+            t.join(timeout=10)
+        return order
+
+    def test_weighted_share_between_tenants_within_lane(self):
+        reg = TenantRegistry({"default": {"weight": 1},
+                              "heavy": {"weight": 3}})
+        ac = AdmissionController(concurrency=1, queue_depth=64,
+                                 tenants=reg)
+        plan = [("read", "heavy")] * 6 + [("read", "light")] * 6
+        order = self._grant_order(ac, plan, len(plan))
+        # Stride at 3:1 — the first 4 grants hold ~3 heavy to 1
+        # light, NOT 6 heavy in a row (FIFO would).
+        first4 = order[:4]
+        assert first4.count("heavy") == 3 and "light" in first4, order
+
+    def test_aggressor_backlog_cannot_starve_quiet_tenant(self):
+        reg = TenantRegistry({"default": {"weight": 1}})
+        ac = AdmissionController(concurrency=1, queue_depth=64,
+                                 tenants=reg)
+        # 10 queued aggressor waiters, 1 quiet: equal weights mean the
+        # quiet tenant is granted 2nd, not 11th.
+        plan = [("read", "aggr")] * 10 + [("read", "quiet")]
+        order = self._grant_order(ac, plan, len(plan))
+        assert "quiet" in order[:2], order
+
+    def test_per_tenant_concurrency_cap_queues_at_cap(self):
+        reg = TenantRegistry({"default": {"weight": 1},
+                              "capped": {"concurrency": 1}})
+        ac = AdmissionController(concurrency=4, queue_depth=8,
+                                 tenants=reg)
+        s1 = ac.acquire("read", tenant="capped")
+        got = []
+
+        def second():
+            s = ac.acquire("read", tenant="capped")
+            got.append(time.monotonic())
+            s.release()
+
+        t = threading.Thread(target=second)
+        t.start()
+        time.sleep(0.15)
+        # Capped tenant waits despite 3 free global slots; another
+        # tenant sails through them.
+        assert not got
+        ac.acquire("read", tenant="other").release()
+        s1.release()
+        t.join(timeout=10)
+        assert got  # cap freed -> granted
+
+    def test_queue_quota_429s_only_the_offender(self):
+        reg = TenantRegistry({"default": {"weight": 1},
+                              "noisy": {"concurrency": 1,
+                                        "queue-depth": 1}})
+        ac = AdmissionController(concurrency=1, queue_depth=16,
+                                 tenants=reg)
+        gate = ac.acquire("read", tenant="noisy")  # holds noisy's cap
+        t = threading.Thread(
+            target=lambda: ac.acquire("read", tenant="noisy").release())
+        t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline \
+                and not ac.snapshot()["queued"]:
+            time.sleep(0.01)
+        with pytest.raises(AdmissionFullError) as ei:
+            ac.acquire("read", tenant="noisy")
+        assert ei.value.tenant == "noisy"
+        assert ei.value.retry_after_s >= 1
+        # The quiet tenant still queues fine (global depth not hit).
+        t2 = threading.Thread(
+            target=lambda: ac.acquire("read", tenant="quiet").release())
+        t2.start()
+        time.sleep(0.1)
+        snap = ac.snapshot()
+        assert snap["tenants"]["quiet"]["queued"] == 1
+        assert snap["tenants"]["noisy"]["rejected"] == 1
+        gate.release()
+        t.join(timeout=10)
+        t2.join(timeout=10)
+
+    def test_retry_after_is_per_lane(self):
+        """A shed write burst (long write holds) must not inflate the
+        Retry-After handed to rejected READ traffic."""
+        ac = AdmissionController(concurrency=1, queue_depth=0)
+        s = ac.acquire("write")
+        s._t0 -= 8.0  # backdate: an 8 s write hold
+        s.release()   # write-lane hold EWMA ~= 1.6s
+        gate = ac.acquire("write")
+        with pytest.raises(AdmissionFullError) as wr:
+            ac.acquire("write")
+        with pytest.raises(AdmissionFullError) as rd:
+            ac.acquire("read")
+        assert wr.value.retry_after_s >= 2
+        assert rd.value.retry_after_s == 1  # read EWMA untouched
+        gate.release()
+
+    def test_snapshot_shape_still_has_lane_totals(self):
+        ac = AdmissionController(concurrency=1, queue_depth=4)
+        snap = ac.snapshot()
+        assert snap["queued"] == {} and snap["rejected"] == 0
+        assert "tenants" in snap
+
+
+# ---------------------------------------------------------------------------
+# Slow-query cost-kill policy
+
+
+class TestCostKillPolicy:
+    def _ctx(self, reg, tenant="t", **kw):
+        ctx = QueryContext(pql="Count()", index=tenant, tenant=tenant,
+                           **kw)
+        obs_accounting.attach(ctx, node="n")
+        reg.install(ctx)
+        return ctx
+
+    def test_container_op_ceiling_kills(self):
+        reg = TenantRegistry({"default": {},
+                              "t": {"max_container_ops": 5}})
+        ctx = self._ctx(reg)
+        for _ in range(5):
+            ctx.cost.note_container_op("and", "bitmap:bitmap")
+        ctx.check()  # at the ceiling: fine
+        ctx.cost.note_container_op("and", "bitmap:bitmap")
+        with pytest.raises(QueryKilledError, match="cost-policy"):
+            ctx.check()
+        assert ctx.killed_by == "cost-policy"
+        # Every subsequent check raises the KILLED form, from any
+        # thread (deterministic 402 mapping).
+        with pytest.raises(QueryKilledError):
+            ctx.check()
+
+    def test_wall_ceiling_kills(self):
+        reg = TenantRegistry({"default": {},
+                              "t": {"max_wall_s": 0.01}})
+        ctx = self._ctx(reg)
+        time.sleep(0.03)
+        with pytest.raises(QueryKilledError, match="wall"):
+            ctx.check()
+
+    def test_device_bytes_ceiling_kills(self):
+        reg = TenantRegistry({"default": {},
+                              "t": {"max_device_bytes": 100}})
+        ctx = self._ctx(reg)
+        ctx.cost.note_device_dispatch(101)
+        with pytest.raises(QueryKilledError, match="device bytes"):
+            ctx.check()
+
+    def test_kill_broadcasts_and_enters_penalty_box(self):
+        reg = TenantRegistry({"default": {},
+                              "t": {"max_container_ops": 1}})
+        fanned = []
+        reg.kill_broadcast = fanned.append
+        ctx = self._ctx(reg)
+        ctx.cost.note_container_op("or", "array:array")
+        ctx.cost.note_container_op("or", "array:array")
+        with pytest.raises(QueryKilledError):
+            ctx.check()
+        assert fanned == [ctx.id]
+        snap = reg.snapshot()["t"]
+        assert snap["killed"] == 1 and snap["inPenaltyBox"]
+
+    def test_no_ceilings_attaches_nothing(self):
+        reg = TenantRegistry({"default": {}})
+        ctx = self._ctx(reg)
+        assert ctx.cost_policy is None  # zero per-check overhead
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant cache quotas (executor)
+
+
+class TestCacheQuotas:
+    def _bm(self, n):
+        bm = Bitmap()
+        for i in range(n):
+            bm.set_bit(i)
+        return bm
+
+    def _executor(self, share=0.5, entries=64, bits=400):
+        from pilosa_tpu.executor import Executor
+        reg = TenantRegistry({"default": {"cache_share": share}})
+        ex = Executor(None, host="a", use_mesh=False, tenants=reg)
+        ex._result_cache_entries = entries
+        ex._result_cache_bits = bits
+        return ex
+
+    def test_aggressor_evicts_its_own_entries_not_quiet_tenants(self):
+        ex = self._executor(share=0.5, bits=400)
+        ex._result_cache_put(("quiet", "e1", (0,)), self._bm(100))
+        ex._result_cache_put(("quiet", "e2", (0,)), self._bm(100))
+        # Aggressor floods: its share is 200 bits -> only its own
+        # entries churn; the quiet tenant's 200 bits stay put.
+        for i in range(10):
+            ex._result_cache_put(("aggr", f"e{i}", (0,)),
+                                 self._bm(100))
+        usage = ex.tenant_cache_usage()
+        assert usage["quiet"]["resultBits"] == 200
+        assert usage["aggr"]["resultBits"] <= 200
+
+    def test_oversize_single_entry_respects_tenant_budget(self):
+        ex = self._executor(share=0.25, bits=400)  # tenant budget 100
+        ex._result_cache_put(("t", "big", (0,)), self._bm(150))
+        assert ex.tenant_cache_usage() == {}
+
+    def test_cluster_cache_per_tenant_entry_cap(self):
+        ex = self._executor(share=0.5)
+        ex._cluster_cache_entries = 4  # tenant cap = 2
+        pre = {"local": {}, "remote": {}}
+        ex._cluster_cache_snapshot = lambda *a: pre
+        for i in range(4):
+            ex._cluster_cache_store(("aggr", f"q{i}", (0,), 0), "aggr",
+                                    [0], [i], pre)
+        ex._cluster_cache_store(("quiet", "q", (0,), 0), "quiet",
+                                [0], [9], pre)
+        usage = ex.tenant_cache_usage()
+        assert usage["aggr"]["clusterEntries"] <= 2
+        assert usage["quiet"]["clusterEntries"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant SLO burn + sentinel rule
+
+
+class TestTenantSLO:
+    def test_per_tenant_burn_rates(self):
+        hist = obs_metrics.Registry().histogram(
+            "pilosa_test_tenant_seconds", "t", labels=("tenant",))
+        tracker = TenantSLOTracker(histogram=hist, objective_s=0.25,
+                                   target=0.9)
+        tracker.record()  # baseline
+        for _ in range(10):
+            hist.labels("quiet").observe(0.01)   # all good
+            hist.labels("aggr").observe(5.0)     # all bad
+        out = tracker.record()
+        assert out["quiet"]["burnRates"]["5m"] == 0.0
+        # 100% bad over a 10% budget = 10x burn.
+        assert out["aggr"]["burnRates"]["5m"] == pytest.approx(10.0)
+        assert tracker.last()["aggr"]["requestsTotal"] == 10
+
+    def test_sentinel_tenant_burn_rule_fires(self):
+        from pilosa_tpu.obs.sentinel import Sentinel
+
+        from pilosa_tpu.obs.history import series_key
+
+        class _Hist:
+            def keys(self, family=""):
+                return [series_key("pilosa_tenant_slo_burn_rate_ratio",
+                                   {"tenant": "aggr", "window": "5m"}),
+                        series_key("pilosa_tenant_slo_burn_rate_ratio",
+                                   {"tenant": "quiet", "window": "5m"})]
+
+            def window_values(self, key, start, end):
+                return [12.0] * 6 if "aggr" in key else [0.1] * 6
+
+        sen = Sentinel(_Hist(), interval_s=1000, min_points=5,
+                       tenant_burn_threshold=10.0, watches=())
+        findings = sen.check()
+        assert len(findings) == 1
+        f = findings[0]
+        assert f["rule"] == "tenant_burn"
+        assert f["labels"].get("tenant") == "aggr"
+
+
+# ---------------------------------------------------------------------------
+# ENOSPC graceful degradation
+
+
+class TestEnospc:
+    def test_enospc_failpoint_mode_carries_errno(self):
+        import errno
+        fp = failpoints.parse_spec("wal.append", "enospc*1")
+        assert fp.mode == "enospc"
+        with failpoints.injected("wal.append", "enospc"):
+            with pytest.raises(failpoints.FailpointError) as ei:
+                failpoints.default().hit("wal.append")
+            assert ei.value.errno == errno.ENOSPC
+            assert fault_diskfull.is_enospc(ei.value)
+
+    def test_wal_enospc_flips_unready_and_recovers_on_write(self,
+                                                            tmp_path):
+        f = open(tmp_path / "w.wal", "ab")
+        wal = GroupCommitWal(f, fsync_policy="none")
+        wal.append(b"x" * 13)
+        with failpoints.injected("wal.append", "enospc*1"):
+            with pytest.raises(WalError):
+                wal.flush()
+        st = fault_diskfull.default()
+        assert not st.write_ready(probe=False)
+        assert st.snapshot()["events"] == {"wal.append": 1}
+        # The batch stayed pending; the next (post-disarm) flush
+        # succeeds and THAT clears the latch — real traffic is the
+        # cheapest recovery probe.
+        wal.flush()
+        assert st.write_ready(probe=False)
+        wal.close()
+        f.close()
+
+    def test_probe_auto_recovery(self, tmp_path):
+        st = fault_diskfull.default()
+        st.note_enospc("snapshot.write",
+                       path=str(tmp_path / "frag" / "0"))
+        assert not st.write_ready(probe=False)
+        os.makedirs(tmp_path / "frag", exist_ok=True)
+        # First probed call recovers (the dir is writable again).
+        assert st.write_ready()
+        assert st.snapshot()["recoveries"] == 1
+
+    def test_diskring_drops_and_counts_instead_of_raising(self,
+                                                          tmp_path):
+        from pilosa_tpu.obs.diskring import SegmentRing
+        ring = SegmentRing(str(tmp_path / "ring"))
+        with failpoints.injected("ring.write", "enospc"):
+            assert ring.append({"a": 1}) is False
+        assert ring.dropped == 1
+        # And it does NOT gate serving: the node stays write-ready.
+        assert fault_diskfull.default().write_ready(probe=False)
+        assert ring.append({"a": 2}) is True
+
+    def test_health_reports_write_unready(self):
+        st = fault_diskfull.default()
+        st.note_enospc("wal.append", path="/nonexistent-dir/x")
+        hc = HealthChecker()
+        ready, checks = hc.check()
+        assert not checks["writeReady"]["ok"]
+        assert not ready
+        st.reset()
+        _, checks = hc.check()
+        assert checks["writeReady"]["ok"]
+
+
+# ---------------------------------------------------------------------------
+# HTTP integration: tenant-scoped 429, cost-kill 402, ENOSPC 507,
+# /debug/tenants
+
+
+def _post(host, path, body=b"", headers=None):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST", headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, r.read(), dict(r.headers)
+
+
+def _get(host, path):
+    with urllib.request.urlopen(f"http://{host}{path}",
+                                timeout=30) as r:
+        return json.loads(r.read())
+
+
+class _SlowExecutor:
+    """Busy-waits (cooperatively checking the query context) for
+    queries against ``only`` (default: every index)."""
+
+    def __init__(self, real, seconds=30.0, only=None):
+        self._real = real
+        self._seconds = seconds
+        self._only = only
+
+    def __getattr__(self, name):
+        return getattr(self._real, name)
+
+    def execute(self, index, query, slices=None, opt=None, **kw):
+        if self._only is None or index == self._only:
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < self._seconds:
+                if opt is not None and opt.ctx is not None:
+                    opt.ctx.check()
+                time.sleep(0.005)
+        return self._real.execute(index, query, slices, opt, **kw)
+
+
+def _make_server(tmp_path, tenants=None, **qc):
+    s = Server(str(tmp_path / "srv"), host="127.0.0.1:0",
+               anti_entropy_interval=0, polling_interval=0,
+               query_config=QueryConfig(**qc),
+               tenants_config=TenantsConfig(
+                   table=parse_tenants(tenants) if tenants else {}))
+    s.open()
+    _post(s.host, "/index/i")
+    _post(s.host, "/index/i/frame/f")
+    _post(s.host, "/index/i/query",
+          b'SetBit(frame="f", rowID=1, columnID=3)')
+    return s
+
+
+class TestTenantHTTP:
+    def test_cost_kill_answers_402_with_header(self, tmp_path):
+        s = _make_server(tmp_path,
+                         tenants="default:weight=1;i:max-wall=150ms")
+        try:
+            s.handler.executor = _SlowExecutor(s.executor)
+            t0 = time.monotonic()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(s.host, "/index/i/query",
+                      b'Count(Bitmap(frame="f", rowID=1))')
+            assert ei.value.code == 402
+            assert ei.value.headers["X-Pilosa-Killed-By"] \
+                == "cost-policy"
+            assert time.monotonic() - t0 < 10
+            assert b"cost-policy" in ei.value.read()
+            # Penalty + kill count surface at /debug/tenants; the
+            # registry is drained (no leaked slot or entry).
+            dbg = _get(s.host, "/debug/tenants")["tenants"]["i"]
+            assert dbg["killed"] == 1 and dbg["inPenaltyBox"]
+            assert dbg["effectiveWeight"] < dbg["policy"]["weight"]
+            assert _get(s.host, "/debug/queries")["queries"] == []
+        finally:
+            s.close()
+
+    def test_tenant_quota_429_spares_other_tenant(self, tmp_path):
+        s = _make_server(
+            tmp_path, concurrency=8, queue_depth=64,
+            tenants="default:weight=1;i:concurrency=1,queue-depth=1")
+        try:
+            _post(s.host, "/index/quiet")
+            _post(s.host, "/index/quiet/frame/f")
+            _post(s.host, "/index/quiet/query",
+                  b'SetBit(frame="f", rowID=1, columnID=3)')
+            s.handler.executor = _SlowExecutor(s.executor, only="i")
+
+            def swallow():
+                try:
+                    _post(s.host, "/index/i/query?timeout=5s",
+                          b'Bitmap(frame="f", rowID=1)')
+                except urllib.error.HTTPError:
+                    pass
+
+            threads = [threading.Thread(target=swallow)
+                       for _ in range(2)]  # 1 slot + 1 queue seat
+            for t in threads:
+                t.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                snap = _get(s.host, "/debug/queries")["admission"]
+                ten = (snap.get("tenants") or {}).get("i", {})
+                if ten.get("inFlight", 0) >= 1 \
+                        and ten.get("queued", 0) >= 1:
+                    break
+                time.sleep(0.02)
+            try:
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _post(s.host, "/index/i/query",
+                          b'Bitmap(frame="f", rowID=1)')
+                assert ei.value.code == 429
+                assert int(ei.value.headers["Retry-After"]) >= 1
+                # The OTHER tenant still has the remaining 7 slots.
+                st, _, _ = _post(s.host,
+                                 "/index/quiet/query?timeout=10s",
+                                 b'Count(Bitmap(frame="f", rowID=1))')
+                assert st == 200
+                dbg = _get(s.host, "/debug/tenants")["tenants"]
+                assert dbg["i"]["shed"] >= 1
+                assert dbg.get("quiet", {}).get("shed", 0) == 0
+            finally:
+                for ctx in [s.query_registry.get(q["id"]) for q in
+                            s.query_registry.active()]:
+                    if ctx is not None:
+                        ctx.cancel()
+                for t in threads:
+                    t.join(timeout=10)
+        finally:
+            s.close()
+
+    def test_enospc_write_507_read_serving_and_recovery(self,
+                                                        tmp_path):
+        s = _make_server(tmp_path)
+        try:
+            st = fault_diskfull.default()
+            st.note_enospc("wal.append", path="/nonexistent-dir/x")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(s.host, "/index/i/query",
+                      b'SetBit(frame="f", rowID=2, columnID=4)')
+            assert ei.value.code == 507
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            # Imports (the write lane) shed identically.
+            with pytest.raises(urllib.error.HTTPError) as ei2:
+                _post(s.host, "/index/i/query",
+                      b'SetBit(frame="f", rowID=2, columnID=5)')
+            assert ei2.value.code == 507
+            # Reads keep serving; /health reports the condition.
+            stc, _, _ = _post(s.host, "/index/i/query",
+                              b'Count(Bitmap(frame="f", rowID=1))')
+            assert stc == 200
+            with pytest.raises(urllib.error.HTTPError) as eh:
+                urllib.request.urlopen(f"http://{s.host}/health",
+                                       timeout=10)
+            assert eh.value.code == 503
+            body = json.loads(eh.value.read())
+            assert body["checks"]["writeReady"]["ok"] is False
+            # Space "frees": point the probe at a writable dir; the
+            # next write probes, recovers, and lands.
+            with st._mu:
+                st._dir = str(tmp_path)
+                st._last_probe = 0.0
+            stw, _, _ = _post(s.host, "/index/i/query",
+                              b'SetBit(frame="f", rowID=2, columnID=6)')
+            assert stw == 200
+            assert _get(s.host, "/debug/tenants")["writeReady"][
+                "writeReady"] is True
+        finally:
+            s.close()
+
+    def test_debug_tenants_shape(self, tmp_path):
+        s = _make_server(tmp_path,
+                         tenants="default:weight=2,concurrency=8")
+        try:
+            out = _get(s.host, "/debug/tenants")
+            assert "writeReady" in out
+            row = out["tenants"]["i"]
+            assert row["served"] >= 1  # the fixture's SetBit
+            assert row["policy"]["weight"] == 2.0
+        finally:
+            s.close()
+
+    def test_tenant_metrics_families_emit(self, tmp_path):
+        s = _make_server(tmp_path)
+        try:
+            _post(s.host, "/index/i/query",
+                  b'Count(Bitmap(frame="f", rowID=1))')
+            with urllib.request.urlopen(f"http://{s.host}/metrics",
+                                        timeout=10) as r:
+                text = r.read().decode()
+            assert 'pilosa_tenant_query_requests_total{tenant="i"' \
+                in text
+            assert "pilosa_tenant_query_duration_seconds" in text
+            assert "pilosa_storage_write_ready 1" in text
+        finally:
+            s.close()

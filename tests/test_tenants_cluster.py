@@ -1,0 +1,279 @@
+"""Multi-tenant QoS on a REAL 2-node gossip cluster (ISSUE 14):
+the tenant principal must ride fan-out legs (X-Pilosa-Tenant), a
+cost-policy kill must propagate cluster-wide via the cancel
+broadcast, and a STORM of concurrent cost-policy kills must drain
+both nodes' registries with zero admission-slot or penalty-box
+leaks (the PR-2 staggered-deadline storm, extended to the kill
+path)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+from podenv import cpu_env, free_port, wait_up  # noqa: E402
+
+from pilosa_tpu import SLICE_WIDTH  # noqa: E402
+
+pytestmark = pytest.mark.tenant
+
+# tc's wall ceiling: generous against healthy-cluster latency (a
+# fan-out read is ~ms), tiny against a stalled peer.
+_TENANTS_SPEC = ("default:weight=1;"
+                 "tc:max-wall=600ms;"
+                 "alpha:weight=2")
+
+
+def _post(host, path, body=b"", headers=None, timeout=30):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST", headers=headers or {})
+    return urllib.request.urlopen(req, timeout=timeout).read()
+
+
+def _get_json(host, path, timeout=10):
+    with urllib.request.urlopen(f"http://{host}{path}",
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_text(host, path, timeout=10):
+    with urllib.request.urlopen(f"http://{host}{path}",
+                                timeout=timeout) as r:
+        return r.read().decode()
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Two gossip-joined nodes (replicas=1 → fan-out is mandatory),
+    both carrying the same [tenants] table, with data in indexes
+    ``tc`` (kill-ceiling tenant) and ``q`` (quiet tenant) spanning 4
+    slices."""
+    pa, pb = free_port(), free_port()
+    ga, gb = free_port(), free_port()
+    hosts = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+    procs, logs = [], []
+
+    def spawn(name, port, internal, seed=""):
+        d = tmp_path / name
+        d.mkdir(exist_ok=True)
+        env = cpu_env()
+        env["PILOSA_TPU_MESH"] = "0"
+        env["PILOSA_TPU_WARMUP"] = "0"
+        log = open(tmp_path / f"{name}.log", "a")
+        logs.append(log)
+        argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                "-d", str(d), "-b", f"127.0.0.1:{port}",
+                "--cluster.type", "gossip",
+                "--cluster.hosts", hosts,
+                "--cluster.replicas", "1",
+                "--cluster.internal-port", str(internal),
+                "--tenants", _TENANTS_SPEC,
+                "--anti-entropy.interval", "300s"]
+        if seed:
+            argv += ["--cluster.gossip-seed", seed]
+        p = subprocess.Popen(argv, env=env, stdout=log, stderr=log,
+                             cwd=os.path.dirname(_HERE))
+        procs.append(p)
+        wait_up(f"127.0.0.1:{port}")
+        return f"127.0.0.1:{port}"
+
+    host_a = spawn("a", pa, ga)
+    host_b = spawn("b", pb, gb, seed=f"127.0.0.1:{ga}")
+    from pilosa_tpu.cluster.client import Client
+    import numpy as np
+    client = Client(host_a)
+    cols = np.arange(0, 4 * SLICE_WIDTH,
+                     SLICE_WIDTH // 8).astype(np.uint64)
+    for index in ("tc", "q"):
+        _post(host_a, f"/index/{index}", b"{}")
+        _post(host_a, f"/index/{index}/frame/f", b"{}")
+        client.import_arrays(index, "f",
+                             np.ones(len(cols), np.uint64), cols)
+        client.import_arrays(index, "f",
+                             np.full(len(cols), 2, np.uint64), cols)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        got = json.loads(_post(
+            host_a, "/index/q/query",
+            b'Count(Bitmap(frame="f", rowID=1))'))["results"][0]
+        if got == len(cols):
+            break
+        time.sleep(0.3)
+    assert got == len(cols), got
+
+    yield {"a": host_a, "b": host_b, "procs": procs,
+           "n_bits": len(cols)}
+
+    for p in procs:
+        try:
+            os.kill(p.pid, signal.SIGCONT)
+        except OSError:
+            pass
+        try:
+            p.send_signal(signal.SIGINT)
+        except OSError:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            p.kill()
+    for log in logs:
+        log.close()
+
+
+def test_tenant_principal_rides_fanout_legs(cluster):
+    """An EXPLICIT X-Pilosa-Tenant header (≠ index) on a
+    fan-out-requiring read must reach the peer's leg: node B's
+    per-tenant chargeback counters record the coordinator's
+    principal, not the index fallback — the header crossed the wire
+    end to end (client → A → B)."""
+    host_a, host_b = cluster["a"], cluster["b"]
+    out = json.loads(_post(
+        host_a, "/index/q/query",
+        b'Count(Intersect(Bitmap(frame="f", rowID=1),'
+        b' Bitmap(frame="f", rowID=2)))',
+        headers={"X-Pilosa-Tenant": "alpha"}))
+    assert out["results"][0] == cluster["n_bits"]
+    deadline = time.time() + 10
+    seen = ""
+    while time.time() < deadline:
+        seen = _get_text(host_b, "/metrics")
+        if 'pilosa_tenant_cost_units_total{tenant="alpha"' in seen:
+            break
+        time.sleep(0.2)
+    assert 'pilosa_tenant_cost_units_total{tenant="alpha"' in seen, (
+        "peer never accounted the propagated tenant principal")
+    # And the default path (no header): the index IS the principal.
+    _post(host_a, "/index/q/query",
+          b'Count(Intersect(Bitmap(frame="f", rowID=1),'
+          b' Bitmap(frame="f", rowID=2)))')
+    assert 'pilosa_tenant_cost_units_total{tenant="q"' in _get_text(
+        host_b, "/metrics")
+
+
+def test_cost_policy_kill_propagates_cluster_wide(cluster):
+    """SIGSTOP node B: a query on the wall-ceilinged tenant stalls on
+    its remote leg, the coordinator's cost policy kills it at a stage
+    boundary (402 + X-Pilosa-Killed-By), the kill broadcast reaches B
+    (buffered while stopped), and after B resumes BOTH registries are
+    drained."""
+    host_a, host_b, procs = cluster["a"], cluster["b"], cluster["procs"]
+    os.kill(procs[1].pid, signal.SIGSTOP)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(host_a, "/index/tc/query?timeout=60s",
+                  b'Count(Bitmap(frame="f", rowID=1))', timeout=90)
+        elapsed = time.monotonic() - t0
+        assert ei.value.code == 402, ei.value.code
+        assert ei.value.headers["X-Pilosa-Killed-By"] == "cost-policy"
+        assert b"cost-policy" in ei.value.read().lower()
+        # Killed at ~the 600ms ceiling, not the 60s client budget.
+        assert elapsed < 15, elapsed
+        dbg = _get_json(host_a, "/debug/tenants")["tenants"]["tc"]
+        assert dbg["killed"] >= 1 and dbg["inPenaltyBox"]
+        assert dbg["effectiveWeight"] < dbg["policy"]["weight"]
+        # Coordinator drained (slot + registry) without waiting out
+        # the stalled leg.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if not _get_json(host_a, "/debug/queries")["queries"]:
+                break
+            time.sleep(0.2)
+        assert _get_json(host_a, "/debug/queries")["queries"] == []
+    finally:
+        os.kill(procs[1].pid, signal.SIGCONT)
+    # B drains its buffered leg (the kill broadcast or the leg's own
+    # completion) without leaking a registry entry.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if not _get_json(host_b, "/debug/queries")["queries"]:
+            break
+        time.sleep(0.3)
+    assert _get_json(host_b, "/debug/queries")["queries"] == []
+    # The healthy cluster still serves the penalized tenant (demoted,
+    # not banned).
+    got = json.loads(_post(
+        host_a, "/index/tc/query?timeout=10s",
+        b'Count(Bitmap(frame="f", rowID=1))'))["results"][0]
+    assert got == cluster["n_bits"]
+
+
+def test_cost_kill_storm_drains_both_registries(cluster):
+    """The PR-2 staggered-deadline storm on the KILL path: N
+    concurrent queries all breach the tenant's wall ceiling against a
+    stalled peer — every one answers 402, and afterwards both nodes'
+    registries are empty, the coordinator's admission has zero
+    in-flight slots, and the penalty box holds exactly the storm's
+    kills (no leaked slots, entries, or scores)."""
+    host_a, host_b, procs = cluster["a"], cluster["b"], cluster["procs"]
+    n = 8
+    kills_before = _get_json(
+        host_a, "/debug/tenants")["tenants"].get("tc", {}).get(
+        "killed", 0)
+    os.kill(procs[1].pid, signal.SIGSTOP)
+    codes = []
+    mu = threading.Lock()
+
+    def one(i):
+        try:
+            _post(host_a, "/index/tc/query?timeout=60s",
+                  b'Count(Bitmap(frame="f", rowID=1))', timeout=90)
+            code = 200
+        except urllib.error.HTTPError as e:
+            code = e.code
+        with mu:
+            codes.append(code)
+
+    try:
+        threads = [threading.Thread(target=one, args=(i,))
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert len(codes) == n
+        assert all(c == 402 for c in codes), codes
+        dbg = _get_json(host_a, "/debug/tenants")["tenants"]["tc"]
+        assert dbg["killed"] == kills_before + n, dbg
+        # Zero admission-slot leaks: every killed query released its
+        # slot (and its registry entry) on the way out.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            adm = _get_json(host_a, "/debug/queries")
+            if (not adm["queries"]
+                    and adm["admission"]["inFlight"] == 0):
+                break
+            time.sleep(0.2)
+        adm = _get_json(host_a, "/debug/queries")
+        assert adm["queries"] == []
+        assert adm["admission"]["inFlight"] == 0
+        assert adm["admission"]["queued"] == {}
+    finally:
+        os.kill(procs[1].pid, signal.SIGCONT)
+    # Both registries drain after the peer resumes.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if not _get_json(host_b, "/debug/queries")["queries"]:
+            break
+        time.sleep(0.3)
+    assert _get_json(host_b, "/debug/queries")["queries"] == []
+    # No penalty-box leak: the score decays back toward zero (no
+    # stuck demotion) — observable as a strictly shrinking score.
+    s1 = _get_json(host_a,
+                   "/debug/tenants")["tenants"]["tc"]["penaltyScore"]
+    time.sleep(2.0)
+    s2 = _get_json(host_a,
+                   "/debug/tenants")["tenants"]["tc"]["penaltyScore"]
+    assert s2 < s1
