@@ -310,6 +310,17 @@ def main() -> None:
                     "on_p50_ms": oh.get("on_p50_ms"),
                     "off_p50_ms": oh.get("off_p50_ms"),
                     "target_ratio": oh.get("target_ratio")}
+            # Background storage-scrub overhead (suite.
+            # config_scrub_overhead): continuous re-verification
+            # passes vs off, interleaved A/B — ISSUE 15's ≤2%
+            # acceptance bound, on the line of record.
+            so = manifest.get("scrub_overhead") or {}
+            if so.get("ratio") is not None:
+                line["scrub_overhead"] = {
+                    "ratio": so["ratio"],
+                    "on_p50_ms": so.get("on_p50_ms"),
+                    "off_p50_ms": so.get("off_p50_ms"),
+                    "target_ratio": so.get("target_ratio")}
             dt = manifest.get("distributed_topn") or {}
             if dt.get("topn_pushdown_p50_ms") is not None:
                 line["distributed_topn"] = {

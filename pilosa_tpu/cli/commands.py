@@ -14,6 +14,7 @@ import csv
 import datetime as dt
 import io
 import mmap
+import os
 import random
 import re
 import sys
@@ -478,12 +479,73 @@ def _mmap_bitmap(path: str):
     return roaring.Bitmap.unmarshal(mm, mapped=True), mm
 
 
+def _fragment_files(path: str) -> list[str]:
+    """Fragment data files under a data dir (or the path itself when
+    it IS a file): numeric names inside a ``fragments`` directory —
+    the holder layout <index>/<frame>/views/<view>/fragments/<slice>."""
+    if not os.path.isdir(path):
+        return [path]
+    out = []
+    for root, _dirs, files in os.walk(path):
+        if os.path.basename(root) != "fragments":
+            continue
+        for name in sorted(files):
+            if name.isdigit():
+                out.append(os.path.join(root, name))
+    return out
+
+
+def _check_deep(args, stdout) -> int:
+    """Offline storage scrub (the CLI face of storage.scrub): verify
+    every snapshot footer (per-block crc32 table + whole-body digest)
+    and WAL-tail FNV checksums under the given data dirs / files, one
+    verdict line per fragment; nonzero exit on ANY corruption.
+    ``.corrupt`` aside files (quarantine forensics / pending-repair
+    sentinels) are reported too."""
+    from ..storage import scrub as scrub_mod
+    rc = 0
+    n = corrupt = vintage = 0
+    for path in args.paths:
+        files = _fragment_files(path)
+        if not files:
+            print(f"{path}: no fragment files found", file=stdout)
+        for f in files:
+            n += 1
+            v = scrub_mod.scrub_file(f)
+            if v.get("corrupt"):
+                corrupt += 1
+                rc = 1
+                print(f"{f}: CORRUPT: {v.get('error')}", file=stdout)
+            else:
+                cov = v.get("coverage")
+                if cov != "full":
+                    vintage += 1
+                extra = ""
+                if v.get("walTornBytes"):
+                    extra = (f", torn tail {v['walTornBytes']}B"
+                             " (trimmed on next open)")
+                print(f"{f}: ok ({cov} coverage,"
+                      f" {v.get('blocks', 0)} blocks,"
+                      f" {v.get('walRecords', 0)} wal records{extra})",
+                      file=stdout)
+            if os.path.exists(f + ".corrupt"):
+                print(f"{f}.corrupt: quarantine forensics present"
+                      f" (fragment pending repair)", file=stdout)
+    print(f"checked {n} fragments: {corrupt} corrupt,"
+          f" {vintage} without footers", file=stdout)
+    return rc
+
+
 def cmd_check(args, stdout, stderr) -> int:
     # Offline consistency check of fragment files (ctl/check.go:46-113).
     # Bitmap.check() validates every container kind, including the run
     # invariants: buffer length vs numRuns, sorted, non-overlapping,
     # non-adjacent intervals, Σ lengths == cardinality.
+    # --deep instead runs the offline storage scrub (footer + WAL
+    # checksums) and accepts whole data DIRS.
     from ..proto import internal_pb2 as pb
+    if getattr(args, "deep", False):
+        return _check_deep(args, stdout)
     rc = 0
     for path in args.paths:
         if path.endswith(".cache"):
@@ -519,6 +581,17 @@ def cmd_inspect(args, stdout, stderr) -> int:
     print("== Bitmap Info ==", file=stdout)
     print(f"Containers: {len(bm.containers)}", file=stdout)
     print(f"Operations: {bm.op_n}", file=stdout)
+    # Checksum coverage (storage.integrity): whether this snapshot
+    # carries the integrity footer, and how much it covers.
+    footer = bm.footer
+    if footer is not None:
+        print(f"Checksums: footer v{footer.version}"
+              f" ({footer.block_n} block crc32s,"
+              f" {footer.body_len} body bytes covered)", file=stdout)
+    else:
+        print("Checksums: none (vintage snapshot — scrub blind;"
+              " rewritten with a footer on next snapshot)",
+              file=stdout)
     print("", file=stdout)
     print("== Container Types ==", file=stdout)
     print(f"{'TYPE':>6} {'COUNT':>8} {'INTERVALS':>10} {'BYTES':>10}",
@@ -836,6 +909,11 @@ def build_parser() -> argparse.ArgumentParser:
 
     c = sub.add_parser("check", help="consistency-check fragment files")
     c.add_argument("paths", nargs="+")
+    c.add_argument("--deep", action="store_true",
+                   help="offline storage scrub: verify snapshot"
+                        " footers (block crc32s + body digest) and"
+                        " WAL-tail checksums; accepts data DIRS;"
+                        " nonzero exit on corruption")
     c.set_defaults(fn=cmd_check)
 
     c = sub.add_parser("inspect", help="dump container stats of a file")

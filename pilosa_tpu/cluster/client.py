@@ -312,12 +312,17 @@ class Client:
     def _do_429(self, method: str, path: str, body, headers: dict,
                 host: Optional[str]) -> tuple[int, bytes]:
         """_do for import legs, honoring admission control's 429 +
-        Retry-After with capped exponential backoff + full jitter
-        instead of surfacing the first rejection. The loop is bounded
-        by the calling query's remaining deadline budget when one is
-        bound to this thread (sched.context), and by ``self.timeout``
-        of total sleep otherwise — an overloaded server sheds load;
-        the client must neither hammer it nor wait forever."""
+        Retry-After — and the disk-full degradation's 507 + Retry-After
+        (PR-14 write-unready: the peer is SHEDDING WRITES while it
+        reclaims space, exactly as transient as an admission shed; a
+        mid-import ENOSPC on one peer used to fail the whole import
+        instead of waiting it out) — with capped exponential backoff +
+        full jitter instead of surfacing the first rejection. The loop
+        is bounded by the calling query's remaining deadline budget
+        when one is bound to this thread (sched.context), and by
+        ``self.timeout`` of total sleep otherwise — an overloaded
+        server sheds load; the client must neither hammer it nor wait
+        forever."""
         ctx = sched_context.current()
         budget = ctx.remaining() if ctx is not None else None
         if budget is None:
@@ -328,7 +333,7 @@ class Client:
             headers_out: list = []
             status, raw = self._do(method, path, body, headers,
                                    host=host, headers_out=headers_out)
-            if status != 429:
+            if status not in (429, 507):
                 if self.gens is not None:
                     # Import acks piggyback the touched fragments'
                     # generation tokens (same contract as query legs)

@@ -558,6 +558,13 @@ class Executor:
         the write-accept union — a stream target's copy is incomplete
         until the flip, so it must not claim local fast paths for a
         moving slice (cluster.topology.read_allowed)."""
+        q = getattr(self.holder, "quarantine", None)
+        if q is not None and len(q) and any(
+                q.slice_blocked(index, s) for s in slices):
+            # Storage integrity: a quarantined local copy must never
+            # claim a fast path — its bytes (or its fresh post-reset
+            # replacement) cannot be trusted to answer.
+            return False
         if (len(self.cluster.nodes) == 1
                 and self.cluster.resize is None):
             return True
@@ -3544,6 +3551,15 @@ class Executor:
         coordinator's double-read treat a successful target leg as
         proof the target considers itself authoritative."""
         fault = self.fault
+        # Storage integrity: slices whose LOCAL fragments are
+        # quarantined must not be served from this node — skipping
+        # the local owner here IS the transparent read failover (the
+        # remaining breaker-ordered owners serve; a peer's own
+        # quarantine surfaces as its leg failing, which the generic
+        # re-map routes around).
+        q = getattr(self.holder, "quarantine", None)
+        if q is not None and not len(q):
+            q = None
         m: dict[int, tuple[Node, list[int]]] = {}
         # Placement ordering memo: PARTITION_N bounds the distinct
         # owner tuples, so a 256-slice query pays ≤16 order_nodes
@@ -3560,6 +3576,14 @@ class Executor:
                         owners, local=self.host)
                 owners = ordered
             for node in owners:
+                if (q is not None and node.host == self.host
+                        and q.slice_blocked(index, slice)):
+                    ctx = sched_context.current()
+                    if ctx is not None:
+                        # Tail sampling: a corruption-driven failover
+                        # is keep-worthy (obs.sampler "corruption").
+                        ctx.note_flag("corruption")
+                    continue
                 if any(n is node for n in nodes):
                     m.setdefault(id(node), (node, []))[1].append(slice)
                     break

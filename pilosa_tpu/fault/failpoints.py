@@ -25,6 +25,12 @@ Sites (each exercised by at least one test):
                     the block's positions lands on the target, then the
                     stream fails — the idempotent block re-diff must
                     converge); partition mode scopes by target host
+``storage.read``    storage/fragment, before the data file is read
+                    back (open) and before the scrubber re-reads it —
+                    corrupt-capable: flips real bits in the on-disk
+                    snapshot/mmap bytes, so detection → quarantine →
+                    repair is deterministically injectable at every
+                    leg (storage-integrity subsystem)
 ==================  =========================================================
 
 Spec grammar (one string per site)::
@@ -41,6 +47,13 @@ Spec grammar (one string per site)::
     torn(7)                    write the first 7 bytes of the record,
                                then raise (wal.append / sites passing
                                ``data`` + ``writer``)
+    corrupt                    flip ONE real bit of the site's file
+                               (``writer`` at snapshot.write, ``path``
+                               at storage.read) at a seeded-random
+                               offset, then PROCEED — silent on-disk
+                               corruption, exactly the fault the
+                               integrity footer exists to catch
+    corrupt(3)                 ... flip 3 bits
     partition(hostB)           raise only when the site's ``host``
                                contains "hostB" (one-way partition)
     <mode>*3                   trigger at most 3 times, then auto-disarm
@@ -72,7 +85,7 @@ ACTIVE: Optional["Failpoints"] = None
 
 SITES = ("rpc.send", "rpc.recv", "wal.append", "snapshot.write",
          "gossip.deliver", "mesh.dispatch", "ring.write",
-         "resize.stream")
+         "resize.stream", "storage.read")
 
 
 def env_key(site: str) -> str:
@@ -88,7 +101,7 @@ _SPEC_RE = re.compile(
     r"(?:\((?P<args>[^)]*)\))?"
     r"(?:\*(?P<count>\d+))?$")
 
-_MODES = ("error", "delay", "torn", "partition", "enospc")
+_MODES = ("error", "delay", "torn", "partition", "enospc", "corrupt")
 
 
 class FailpointError(OSError):
@@ -145,6 +158,14 @@ def parse_spec(site: str, spec: str) -> Optional[Failpoint]:
         if not raw_args or len(raw_args) > 2:
             raise ValueError(f"failpoint {site}: torn(bytes[,p])")
         arg = int(raw_args[0])
+        if len(raw_args) == 2:
+            pct = float(raw_args[1])
+    elif mode == "corrupt":
+        if len(raw_args) > 2:
+            raise ValueError(f"failpoint {site}: corrupt([bits][,p])")
+        arg = int(raw_args[0]) if raw_args else 1
+        if arg < 1:
+            raise ValueError(f"failpoint {site}: corrupt needs >=1 bit")
         if len(raw_args) == 2:
             pct = float(raw_args[1])
     elif mode == "partition":
@@ -224,11 +245,13 @@ class Failpoints:
     # -- the injection hook --------------------------------------------------
 
     def hit(self, site: str, host: Optional[str] = None,
-            writer=None, data: Optional[bytes] = None) -> None:
+            writer=None, data: Optional[bytes] = None,
+            path: Optional[str] = None) -> None:
         """Evaluate ``site``. Raises FailpointError when the armed mode
         says so; returns silently otherwise. ``host`` scopes partition
         mode; ``writer``+``data`` let torn mode emit a prefix of the
-        record before failing."""
+        record before failing; ``writer`` (an open file) or ``path``
+        give corrupt mode the bytes to flip."""
         with self._mu:
             fp = self._points.get(site)
             if fp is None:
@@ -261,6 +284,10 @@ class Failpoints:
                 writer.write(data[:max(0, min(int(arg), len(data)))])
             raise FailpointError(
                 f"failpoint {site}: torn write after {arg} bytes")
+        if mode == "corrupt":
+            self._corrupt(site, writer=writer, path=path,
+                          bits=int(arg or 1))
+            return
         if mode == "enospc":
             # The two-arg OSError form sets .errno, so the catching
             # site's `err.errno == errno.ENOSPC` test sees exactly
@@ -274,6 +301,59 @@ class Failpoints:
         raise FailpointError(f"failpoint {site}: injected"
                              + (f" (partition {arg})"
                                 if mode == "partition" else ""))
+
+    def _corrupt(self, site: str, writer, path: Optional[str],
+                 bits: int) -> None:
+        """Flip ``bits`` real bits at seeded-random offsets of the
+        site's file — silent on-disk corruption, the fault the
+        storage-integrity footer (storage.integrity) exists to catch.
+        Proceeds (never raises): the point is that NOTHING fails at
+        the write, exactly like real bit rot."""
+        opened = None
+        fd = None
+        if writer is not None and hasattr(writer, "fileno"):
+            # Snapshot writers are opened "wb" (write-only), so flips
+            # reopen the file read-write by name; a nameless writer
+            # (BytesIO-backed test double) falls through to its fd.
+            try:
+                writer.flush()
+            except (OSError, ValueError):
+                pass
+            name = getattr(writer, "name", None)
+            if isinstance(name, str) and path is None:
+                path = name
+            else:
+                try:
+                    fd = writer.fileno()
+                except (OSError, ValueError):
+                    fd = None
+        if fd is None:
+            if path is None:
+                return
+            try:
+                opened = open(path, "r+b")
+            except OSError:
+                return  # nothing on disk yet: nothing to rot
+            fd = opened.fileno()
+        try:
+            size = os.fstat(fd).st_size
+            if size <= 0:
+                return
+            with self._mu:  # seeded draws stay on the replay schedule
+                flips = [(self._rng.randrange(size),
+                          self._rng.randrange(8))
+                         for _ in range(bits)]
+            for off, bit in flips:
+                b = os.pread(fd, 1, off)
+                if not b:
+                    continue
+                os.pwrite(fd, bytes([b[0] ^ (1 << bit)]), off)
+                _LOG.warning(
+                    "failpoint %s: corrupt flipped bit %d of byte %d"
+                    " (file size %d)", site, bit, off, size)
+        finally:
+            if opened is not None:
+                opened.close()
 
     # -- exposition ----------------------------------------------------------
 

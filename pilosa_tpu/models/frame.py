@@ -102,12 +102,14 @@ class FrameOptions:
 class Frame:
     def __init__(self, path: str, index: str, name: str,
                  options: Optional[FrameOptions] = None,
-                 on_create_slice=None, stats=NOP, logger=logger_mod.NOP):
+                 on_create_slice=None, stats=NOP, logger=logger_mod.NOP,
+                 quarantine=None):
         self.logger = logger
         self.path = path
         self.index = index
         self.name = name
         self.options = options or FrameOptions()
+        self.quarantine = quarantine  # holder's QuarantineRegistry
         self.views: dict[str, View] = {}
         self.row_attr_store = AttrStore(os.path.join(path, "attrs"))
         self.on_create_slice = on_create_slice
@@ -316,7 +318,7 @@ class Frame:
                     row_attr_store=self.row_attr_store,
                     on_create_slice=self._announce_slice(name),
                     stats=self.stats.with_tags(f"view:{name}"),
-                    logger=self.logger)
+                    logger=self.logger, quarantine=self.quarantine)
 
     def _announce_slice(self, view_name: str):
         if self.on_create_slice is None:

@@ -14,6 +14,7 @@ import threading
 from typing import Optional
 
 from ..errors import IndexExistsError, validate_name
+from ..storage.integrity import QuarantineRegistry
 from ..utils import logger as logger_mod
 from ..utils.stats import NOP
 from .index import Index, IndexOptions
@@ -27,6 +28,10 @@ class Holder:
         self.on_create_slice = on_create_slice  # fn(index, slice, inverse)
         self.stats = stats
         self.logger = logger
+        # Storage integrity (storage.integrity): per-holder registry of
+        # quarantined fragments — the executor's read path consults it,
+        # /debug/integrity lists it, and the repairer drains it.
+        self.quarantine = QuarantineRegistry()
         self._mu = threading.RLock()
 
     # -- lifecycle
@@ -70,7 +75,7 @@ class Holder:
         return Index(self.index_path(name), name, options=options,
                      on_create_slice=announce,
                      stats=self.stats.with_tags(f"index:{name}"),
-                     logger=self.logger)
+                     logger=self.logger, quarantine=self.quarantine)
 
     def index(self, name: str) -> Optional[Index]:
         return self.indexes.get(name)
@@ -158,3 +163,15 @@ class Holder:
                     for view in frame.views.values():
                         for frag in view.fragments.values():
                             frag.flush_cache()
+
+    def iter_fragments(self) -> list:
+        """A point-in-time list of every open fragment — the scrub
+        walk's snapshot (storage.scrub) and the integrity coverage
+        summary's (/debug/integrity). A list, not a generator: the
+        walker must not hold the holder lock for a whole paced pass."""
+        with self._mu:
+            return [frag
+                    for idx in self.indexes.values()
+                    for frame in idx.frames.values()
+                    for view in frame.views.values()
+                    for frag in view.fragments.values()]

@@ -45,12 +45,14 @@ class IndexOptions:
 class Index:
     def __init__(self, path: str, name: str,
                  options: Optional[IndexOptions] = None,
-                 on_create_slice=None, stats=NOP, logger=logger_mod.NOP):
+                 on_create_slice=None, stats=NOP, logger=logger_mod.NOP,
+                 quarantine=None):
         validate_name(name)
         self.logger = logger
         self.path = path
         self.name = name
         self.options = options or IndexOptions()
+        self.quarantine = quarantine  # holder's QuarantineRegistry
         self.frames: dict[str, Frame] = {}
         self.column_attr_store = AttrStore(os.path.join(path, ".data"))
         self.on_create_slice = on_create_slice
@@ -150,7 +152,7 @@ class Index:
         return Frame(self.frame_path(name), self.name, name, options=options,
                      on_create_slice=self.on_create_slice,
                      stats=self.stats.with_tags(f"frame:{name}"),
-                     logger=self.logger)
+                     logger=self.logger, quarantine=self.quarantine)
 
     def create_frame(self, name: str, options: Optional[FrameOptions] = None
                      ) -> Frame:

@@ -50,8 +50,9 @@ class View:
                  cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
                  row_attr_store=None,
                  on_create_slice: Optional[Callable[[int], None]] = None,
-                 stats=NOP, logger=logger_mod.NOP):
+                 stats=NOP, logger=logger_mod.NOP, quarantine=None):
         self.logger = logger
+        self.quarantine = quarantine  # holder's QuarantineRegistry
         self.path = path
         self.index = index
         self.frame = frame
@@ -98,7 +99,7 @@ class View:
                         cache_size=self.cache_size,
                         row_attr_store=self.row_attr_store,
                         stats=self.stats.with_tags(f"slice:{slice}"),
-                        logger=self.logger)
+                        logger=self.logger, quarantine=self.quarantine)
 
     # -- fragments
 

@@ -525,8 +525,9 @@ RESIDENCY_BYTES = _DEFAULT.gauge(
 TRACES_KEPT = _DEFAULT.counter(
     "pilosa_trace_kept_total",
     "Traces retained by the tail sampler, by keep reason (slow/error/"
-    "deadline/cancelled/partial/shed/breaker/failpoint/head/requested/"
-    "watchdog/anomaly — docs/OBSERVABILITY.md keep-reason catalogue)",
+    "deadline/cancelled/partial/corruption/shed/breaker/failpoint/"
+    "head/requested/watchdog/anomaly — docs/OBSERVABILITY.md"
+    " keep-reason catalogue)",
     labels=("reason",))
 TRACE_DISK_RECORDS = _DEFAULT.counter(
     "pilosa_trace_disk_records_total",
@@ -597,6 +598,33 @@ FAILOVER_SLICES = _DEFAULT.counter(
     "Slices re-mapped onto surviving replicas after a node leg"
     " failed mid-query, by failed peer",
     labels=("peer",))
+
+# -- storage integrity (storage.integrity / storage.scrub;
+#    docs/FAULT_TOLERANCE.md) ------------------------------------------------
+STORAGE_SCRUB_BLOCKS = _DEFAULT.counter(
+    "pilosa_storage_scrub_blocks_total",
+    "Container blocks whose crc32 was re-verified against the snapshot"
+    " footer, by source (scrub = the background pass, read = the lazy"
+    " first-read check after an open)",
+    labels=("source",))
+STORAGE_CORRUPTION = _DEFAULT.counter(
+    "pilosa_storage_corruption_detected_total",
+    "On-disk corruption detections (checksum mismatch or unparseable"
+    " snapshot), by detection site (open / read / scrub)",
+    labels=("site",))
+STORAGE_QUARANTINED = _DEFAULT.counter(
+    "pilosa_storage_quarantined_fragments_total",
+    "Fragments newly quarantined after a corruption detection (reads"
+    " fail over to a replica; writes keep WAL-buffering)")
+STORAGE_QUARANTINED_LIVE = _DEFAULT.gauge(
+    "pilosa_storage_quarantined_fragments_live",
+    "Fragments currently quarantined on this node (awaiting replica"
+    " repair, or unrepairable with no healthy replica)")
+STORAGE_REPAIRS = _DEFAULT.counter(
+    "pilosa_storage_repairs_total",
+    "Automatic replica re-stream repairs of quarantined fragments, by"
+    " outcome (repaired / failed / no_replica)",
+    labels=("outcome",))
 
 # -- multi-tenant QoS (sched.tenants; docs/SCHEDULING.md) ---------------------
 # Tenant-labeled families ride an explicit per-family cardinality cap:

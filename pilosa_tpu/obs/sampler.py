@@ -25,11 +25,12 @@ inverts the decision to *query end*, when the outcome is known:
 
 The keep-reason catalogue (docs/OBSERVABILITY.md):
 ``slow``, ``error``, ``deadline``, ``cancelled``, ``partial``,
-``shed``, ``breaker``, ``failpoint``, ``head``, ``requested`` (the
-explicit [trace] enabled / ?trace=1 / coordinator-asked paths),
-``watchdog`` (in-flight traces force-kept on a stall trip), and
-``anomaly`` (force-kept by a regression-sentinel finding,
-obs.sentinel).
+``corruption`` (the query detected on-disk corruption or failed over
+a quarantined fragment — storage integrity subsystem), ``shed``,
+``breaker``, ``failpoint``, ``head``, ``requested`` (the explicit
+[trace] enabled / ?trace=1 / coordinator-asked paths), ``watchdog``
+(in-flight traces force-kept on a stall trip), and ``anomaly``
+(force-kept by a regression-sentinel finding, obs.sentinel).
 """
 
 from __future__ import annotations
@@ -47,8 +48,8 @@ from .trace import Span, Trace
 # ``watchdog`` and ``anomaly`` are force-keeps claimed mid-flight (a
 # stall trip / a sentinel finding), not end-of-query decisions.
 REASONS = ("deadline", "cancelled", "error", "shed", "partial",
-           "breaker", "failpoint", "slow", "head", "requested",
-           "watchdog", "anomaly")
+           "corruption", "breaker", "failpoint", "slow", "head",
+           "requested", "watchdog", "anomaly")
 
 DEFAULT_HEAD_N = 1000
 DEFAULT_SLOW_FLOOR_S = 0.1
@@ -142,6 +143,10 @@ class TailSampler:
         flags = getattr(ctx, "flags", None) or ()
         if partial or "partial" in flags:
             return "partial"
+        if "corruption" in flags:
+            # The query detected on-disk corruption or failed over a
+            # quarantined fragment (storage integrity subsystem).
+            return "corruption"
         if "breaker" in flags or "failover" in flags:
             return "breaker"
         if "failpoint" in flags:

@@ -301,6 +301,29 @@ class HealthChecker:
 
         checks["disk"] = self._check_disk()
 
+        # Storage integrity (storage.integrity): quarantined fragments
+        # are a degraded-but-serving condition when replicas exist
+        # (reads fail over while the repairer re-streams); with no
+        # peers to fail over to, the touched slices genuinely cannot
+        # answer, so readiness reflects it.
+        q = (getattr(self.holder, "quarantine", None)
+             if self.holder is not None else None)
+        if q is not None:
+            n = len(q)
+            # Failover needs a REPLICA of the quarantined data, not
+            # just another node: replica_n=1 means no copy exists
+            # anywhere else regardless of cluster size.
+            replicated = (self.cluster is not None
+                          and len(self.cluster.nodes) > 1
+                          and getattr(self.cluster, "replica_n", 1)
+                          > 1)
+            checks["storage"] = {
+                "ok": n == 0 or replicated,
+                "detail": ("clean" if n == 0 else
+                           f"{n} fragments quarantined"
+                           + ("" if replicated
+                              else " (no replica to fail over to)"))}
+
         # Disk-full degradation (fault.diskfull): while ENOSPC holds
         # the node write-unready, /health SAYS so — but the node is
         # not "down": reads keep serving, so the block carries its

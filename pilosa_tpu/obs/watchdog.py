@@ -23,6 +23,10 @@ Four stall detectors, each cheap enough to run every second:
   target, a partitioned flip, or a stuck control send — the window
   where the cluster is paying double-write/double-read overhead for
   nothing (docs/CLUSTER_RESIZE.md).
+- **scrub_stall** — a background storage-scrub pass (storage.scrub)
+  is in flight but has verified no fragment for ``scrub_stall_s``: a
+  hung disk read or a wedged pacing sleep — the window where silent
+  corruption detection is blind.
 
 A trip increments ``pilosa_watchdog_trips_total{cause}``, force-keeps
 every in-flight trace (reason ``watchdog`` — the wedged query's spans
@@ -45,10 +49,11 @@ DEFAULT_DEADLINE_GRACE_S = 5.0
 DEFAULT_GOSSIP_SILENCE_S = 60.0
 DEFAULT_QUEUE_STALL_S = 10.0
 DEFAULT_RESIZE_STALL_S = 60.0
+DEFAULT_SCRUB_STALL_S = 300.0
 DEFAULT_RETRIP_S = 60.0
 
 CAUSES = ("wal_flusher", "stuck_query", "gossip_silence",
-          "admission_stall", "resize_stall")
+          "admission_stall", "resize_stall", "scrub_stall")
 
 
 class Watchdog:
@@ -57,12 +62,14 @@ class Watchdog:
                  gossip_age_fn: Optional[Callable[[], Optional[float]]]
                  = None,
                  resize_progress_fn: Optional[Callable] = None,
+                 scrub_progress_fn: Optional[Callable] = None,
                  interval_s: float = DEFAULT_INTERVAL_S,
                  wal_stall_s: float = DEFAULT_WAL_STALL_S,
                  deadline_grace_s: float = DEFAULT_DEADLINE_GRACE_S,
                  gossip_silence_s: float = DEFAULT_GOSSIP_SILENCE_S,
                  queue_stall_s: float = DEFAULT_QUEUE_STALL_S,
                  resize_stall_s: float = DEFAULT_RESIZE_STALL_S,
+                 scrub_stall_s: float = DEFAULT_SCRUB_STALL_S,
                  retrip_s: float = DEFAULT_RETRIP_S, logger=None):
         from ..utils import logger as logger_mod
         self.registry = registry      # sched.QueryRegistry
@@ -74,12 +81,16 @@ class Watchdog:
         # () -> None | (phase, seconds_without_progress): the server's
         # view of an ACTIVE resize it coordinates (cluster.resize).
         self.resize_progress_fn = resize_progress_fn
+        # () -> None | seconds_without_progress of an IN-FLIGHT scrub
+        # pass (storage.scrub.Scrubber.stall_age).
+        self.scrub_progress_fn = scrub_progress_fn
         self.interval_s = max(0.02, float(interval_s))
         self.wal_stall_s = float(wal_stall_s)
         self.deadline_grace_s = float(deadline_grace_s)
         self.gossip_silence_s = float(gossip_silence_s)
         self.queue_stall_s = float(queue_stall_s)
         self.resize_stall_s = float(resize_stall_s)
+        self.scrub_stall_s = float(scrub_stall_s)
         self.retrip_s = float(retrip_s)
         self.logger = logger or logger_mod.NOP
         self.trips = 0
@@ -172,6 +183,18 @@ class Watchdog:
                         "resize_stall",
                         f"resize phase {phase}: no progress for"
                         f" {age:.1f}s"))
+        # Stalled storage scrub pass (storage.scrub).
+        if (self.scrub_progress_fn is not None
+                and self.scrub_stall_s > 0):
+            try:
+                age = self.scrub_progress_fn()
+            except Exception:  # noqa: BLE001
+                age = None
+            if age is not None and age > self.scrub_stall_s:
+                out.append((
+                    "scrub_stall",
+                    f"scrub pass in flight, no fragment verified for"
+                    f" {age:.1f}s"))
         return out
 
     # -- the trip --------------------------------------------------------------
@@ -222,4 +245,5 @@ class Watchdog:
                                "deadlineGraceS": self.deadline_grace_s,
                                "gossipSilenceS": self.gossip_silence_s,
                                "queueStallS": self.queue_stall_s,
-                               "resizeStallS": self.resize_stall_s}}
+                               "resizeStallS": self.resize_stall_s,
+                               "scrubStallS": self.scrub_stall_s}}

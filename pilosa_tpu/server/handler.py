@@ -29,7 +29,8 @@ from ..cluster.broadcast import (NOP_BROADCASTER, CancelQueryMessage,
                                  unmarshal_message)
 from ..errors import (FrameExistsError, IndexExistsError, PilosaError,
                       QueryCancelledError, QueryDeadlineError,
-                      QueryKilledError, validate_label)
+                      QueryKilledError, SliceUnavailableError,
+                      validate_label)
 from ..fault import diskfull as fault_diskfull
 from ..obs import accounting as obs_accounting
 from ..obs import metrics as obs_metrics
@@ -220,7 +221,7 @@ class Handler:
                  accounting: bool = True, fault=None, sampler=None,
                  blackbox=None, watchdog=None, history=None,
                  sentinel=None, federator=None, tenants=None,
-                 tenant_slo=None):
+                 tenant_slo=None, scrubber=None, repairer=None):
         from ..utils import logger as logger_mod
         self.logger = logger or logger_mod.NOP
         self.holder = holder
@@ -273,6 +274,11 @@ class Handler:
         # the cluster routes serve single-node answers.
         self.history = history
         self.sentinel = sentinel
+        # Storage integrity (storage.scrub / server.repair) behind
+        # /debug/integrity; None (bare handlers) serves the holder's
+        # quarantine registry alone.
+        self.scrubber = scrubber
+        self.repairer = repairer
         if federator is None:
             from ..obs.federate import Federator
             federator = Federator(host)
@@ -366,6 +372,9 @@ class Handler:
           self._handle_post_blackbox_dump)
         r("GET", "/debug/failpoints", self._handle_debug_failpoints)
         r("POST", "/debug/failpoints", self._handle_post_failpoints)
+        r("GET", "/debug/integrity", self._handle_debug_integrity)
+        r("POST", "/debug/integrity/scrub",
+          self._handle_post_integrity_scrub)
         r("GET", "/debug/vars", self._handle_expvar)
         r("GET", "/debug/metrics/history",
           self._handle_metrics_history)
@@ -1370,6 +1379,43 @@ class Handler:
                                spec or "off", reg.seed)
         return Response.json(reg.snapshot())
 
+    def _handle_debug_integrity(self, req: Request) -> Response:
+        """Storage-integrity state: quarantined fragments (what, why,
+        since when), scrub pass progress/totals, repair totals, and
+        the per-fragment footer coverage summary (how much of the
+        fleet's bytes actually carry checksums — vintage files read
+        fine but scrub blind)."""
+        covered = vintage = 0
+        iter_fragments = getattr(self.holder, "iter_fragments", None)
+        for frag in (iter_fragments() if iter_fragments else ()):
+            storage = getattr(frag, "storage", None)
+            if storage is not None and getattr(storage, "footer",
+                                               None) is not None:
+                covered += 1
+            else:
+                vintage += 1
+        registry = getattr(self.holder, "quarantine", None)
+        out: dict = {
+            "quarantined": registry.entries() if registry is not None
+            else [],
+            "coverage": {"footered": covered, "vintage": vintage}}
+        if self.scrubber is not None:
+            out["scrub"] = self.scrubber.state()
+        if self.repairer is not None:
+            out["repair"] = self.repairer.state()
+        return Response.json(out)
+
+    def _handle_post_integrity_scrub(self, req: Request) -> Response:
+        """Trigger an immediate scrub pass. ``?sync=1`` runs the pass
+        inline and returns its summary (operator spot checks, chaos
+        tests); the default just wakes the background thread."""
+        if self.scrubber is None:
+            raise HTTPError(503, "no scrubber on this node")
+        if req.query.get("sync") == "1":
+            return Response.json(self.scrubber.pass_once())
+        self.scrubber.trigger()
+        return Response.json({"triggered": True})
+
     def _handle_debug_trace(self, req: Request) -> Response:
         """One trace as Chrome trace-event JSON (open in perfetto);
         ``?format=spans`` returns the raw span list instead. A miss in
@@ -1622,6 +1668,14 @@ class Handler:
                          f" ({e})", headers=hs)
             self.logger.printf("query commit barrier failed: %s", e)
             return error_resp(500, str(e), headers=_resp_headers())
+        except SliceUnavailableError as e:
+            # No reachable (or trustworthy — storage quarantine) copy
+            # of a touched slice anywhere: a 503 retryable condition,
+            # not a 400 client error. ``?partial=1`` keeps the
+            # degraded-answer contract instead (X-Pilosa-Partial).
+            err = e
+            return error_resp(503, f"slice unavailable: {e}",
+                              headers=_resp_headers())
         except PilosaError as e:
             err = e
             return error_resp(400, str(e), headers=_resp_headers())
@@ -1644,6 +1698,8 @@ class Handler:
             elif (isinstance(err, storage_wal.WalError)
                   and not fault_diskfull.write_ready(probe=False)):
                 status = 507
+            elif isinstance(err, SliceUnavailableError):
+                status = 503
             elif isinstance(err, PilosaError):
                 status = 400
             elif err is not None:
@@ -2051,10 +2107,23 @@ class Handler:
                                "internalHost": n.internal_host}
                               for n in nodes])
 
+    @staticmethod
+    def _refuse_quarantined(frag) -> None:
+        """Storage integrity: a quarantined fragment's copy (corrupt,
+        or the fresh near-empty replacement awaiting repair) must not
+        feed a peer's anti-entropy vote, a resize diff, or a backup —
+        409 so remote consumers skip this node and sweep again after
+        repair. The local repairer bypasses HTTP (server.repair's
+        in-process target adapter)."""
+        if frag is not None and getattr(frag, "quarantined", False):
+            raise HTTPError(409, "fragment quarantined: "
+                                 + frag.quarantine_reason)
+
     def _handle_fragment_blocks(self, req: Request) -> Response:
         frag = self._fragment_from_query(req)
         if frag is None:
             raise HTTPError(404, "fragment not found")
+        self._refuse_quarantined(frag)
         return Response.json({"blocks": codec.blocks_to_json(frag.blocks())})
 
     def _handle_fragment_block_data(self, req: Request) -> Response:
@@ -2063,6 +2132,7 @@ class Handler:
                                     breq.Slice)
         if frag is None:
             raise HTTPError(404, "fragment not found")
+        self._refuse_quarantined(frag)
         ps = frag.block_data(breq.Block)
         return Response.proto(pb.BlockDataResponse(
             RowIDs=[int(r) for r in ps.row_ids],
@@ -2072,6 +2142,7 @@ class Handler:
         frag = self._fragment_from_query(req)
         if frag is None:
             raise HTTPError(404, "fragment not found")
+        self._refuse_quarantined(frag)
         # Spool to disk above 8 MB so concurrent 128 MB+ backups don't
         # each hold the whole archive in memory.
         import tempfile

@@ -154,6 +154,14 @@ class HolderSyncer:
             raise FrameNotFoundError(frame)
         v = f.create_view_if_not_exists(view)
         frag = v.create_fragment_if_not_exists(slice)
+        if frag.quarantined:
+            # Storage integrity: a quarantined local copy must not
+            # VOTE in the consensus merge — majority with a corrupt
+            # (or freshly-reset, near-empty) voter could push
+            # ClearBits of acked writes to healthy replicas. The
+            # repairer owns this fragment; anti-entropy resumes on
+            # the sweep after it clears.
+            return
         FragmentSyncer(frag, self.host, self.cluster, self.closing,
                        self.client_factory, logger=self.logger,
                        fault=self.fault).sync_fragment()
@@ -196,6 +204,8 @@ class FragmentSyncer:
         """Compare per-block checksums across the replica set; merge any
         differing block (fragment.go:1322-1399)."""
         f = self.fragment
+        if getattr(f, "quarantined", False):
+            return  # must not vote (see HolderSyncer.sync_fragment)
         nodes = self._replica_peers(
             self.cluster.fragment_nodes(f.index, f.slice))
         if len(nodes) <= 1:

@@ -51,6 +51,7 @@ from ..utils import arrays as arrays_mod
 from ..utils.arrays import sort_dedupe
 from ..utils.streams import CappedReader
 from . import cache as cache_mod
+from . import integrity as integrity_mod
 from . import roaring
 from . import wal as wal_mod
 from .bitmap import Bitmap
@@ -141,7 +142,8 @@ class Fragment:
     def __init__(self, path: str, index: str, frame: str, view: str,
                  slice: int, cache_type: str = cache_mod.DEFAULT_CACHE_TYPE,
                  cache_size: int = cache_mod.DEFAULT_CACHE_SIZE,
-                 row_attr_store=None, stats=None, logger=logger_mod.NOP):
+                 row_attr_store=None, stats=None, logger=logger_mod.NOP,
+                 quarantine=None):
         self.logger = logger
         self.path = path
         self.index = index
@@ -151,6 +153,16 @@ class Fragment:
         self.cache_type = cache_type
         self.cache_size = cache_size
         self.row_attr_store = row_attr_store
+
+        # Storage integrity (storage.integrity): the holder-level
+        # quarantine registry (None for bare library fragments), the
+        # quarantine flag gating the READ path, and the lazy
+        # first-read verification latch (armed on every open of a
+        # footered snapshot; one attr check on the read hot path).
+        self.quarantine = quarantine
+        self.quarantined = False
+        self.quarantine_reason = ""
+        self._verify_pending = False
 
         self.storage: Optional[roaring.Bitmap] = None
         self.cache = None                       # rank/lru count cache
@@ -212,28 +224,74 @@ class Fragment:
             from . import native_ext
             native_ext.load()
             self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
-            self._open_storage()
+            self._open_storage_quarantining(verify=True)
+            if not self.quarantined and os.path.exists(
+                    self.path + ".corrupt"):
+                # A prior quarantine replaced the data file with a
+                # fresh one and the process died before repair
+                # completed — the aside file is the crash-safe
+                # sentinel. Without it a restart would serve the
+                # near-empty replacement as authoritative (a silent
+                # wrong answer, the one thing this subsystem exists
+                # to prevent). The repairer removes the sentinel when
+                # the replica re-stream verifies clean.
+                self._set_quarantined(
+                    "pending repair (restart before repair completed)",
+                    site="open")
             self._open_cache()
             self._open = True
 
-    def _open_storage(self) -> None:
+    def _open_storage_quarantining(self, verify: bool = False) -> None:
+        """_open_storage, but a file whose bytes contradict their
+        checksums (or no longer parse at all) QUARANTINES the fragment
+        instead of bricking the open: the corrupt file moves aside
+        (``<path>.corrupt`` — forensics + ``check --deep``), a fresh
+        empty snapshot takes its place so writes keep buffering
+        through the WAL, reads fail over to a replica (executor
+        consults the registry), and the repairer re-streams the
+        content (docs/FAULT_TOLERANCE.md)."""
+        try:
+            self._open_storage(verify=verify)
+        except (ValueError, integrity_mod.CorruptionError) as e:
+            self.logger.printf(
+                "fragment: CORRUPT storage %s/%s/%s/%d: %s — "
+                "quarantining", self.index, self.frame, self.view,
+                self.slice, e)
+            self._close_storage()
+            try:
+                os.replace(self.path, self.path + ".corrupt")
+            except FileNotFoundError:
+                pass
+            self._open_storage()
+            self._set_quarantined(f"open: {e}", site="open")
+
+    def _open_storage(self, verify: bool = False) -> None:
         # Open (creating) the data file, flock it, seed empty files with an
         # empty snapshot header, map, replay snapshot + op-log, then attach
         # the op writer for subsequent mutations (reference
         # fragment.go:179-234).
         # buffering=0: each op record hits the OS immediately — a WAL that
         # lingers in a userspace buffer is not a WAL.
+        # ``storage.read`` failpoint: the deterministic injection site
+        # for on-disk corruption (corrupt mode flips real bits in the
+        # file before it is read back — fault.failpoints).
+        if _fp.ACTIVE is not None:
+            _fp.ACTIVE.hit("storage.read", path=self.path)
         self._file = open(self.path, "a+b", buffering=0)
         fcntl.flock(self._file.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
         self._file.seek(0, os.SEEK_END)
         if self._file.tell() == 0:
-            roaring.Bitmap().write_to(self._file)
+            # Seed with a footered empty snapshot so integrity
+            # coverage starts at file birth.
+            roaring.Bitmap().write_to(self._file, footer=True)
         self._mmap = mmap.mmap(self._file.fileno(), 0, prot=mmap.PROT_READ)
         self.storage = roaring.Bitmap.unmarshal(self._mmap, mapped=True,
-                                                tolerate_torn_tail=True)
+                                                tolerate_torn_tail=True,
+                                                verify_body=verify)
         if self.storage.torn_bytes:
-            # Crash mid-append left a partial op record; the WAL is
-            # append-only so the tail is the only casualty — trim it.
+            # Crash mid-append left a partial op record (or a torn
+            # footer); the WAL is append-only so the tail is the only
+            # casualty — trim it.
             size = self._file.seek(0, os.SEEK_END)
             self.storage.unmap()
             self._mmap = None
@@ -241,7 +299,13 @@ class Fragment:
             self._file.seek(0, os.SEEK_END)
             self._mmap = mmap.mmap(self._file.fileno(), 0,
                                    prot=mmap.PROT_READ)
-            self.storage = roaring.Bitmap.unmarshal(self._mmap, mapped=True)
+            self.storage = roaring.Bitmap.unmarshal(self._mmap, mapped=True,
+                                                    verify_body=verify)
+        # Arm the lazy per-block verification: the first READ after an
+        # open re-checks every container block's crc against the mmap
+        # (the first-fault re-verification the footer exists for);
+        # until then only the footer/header crcs have been checked.
+        self._verify_pending = self.storage.footer is not None
         if wal_mod.group_enabled():
             self._wal = wal_mod.GroupCommitWal(self._file)
             self.storage.op_writer = self._wal
@@ -295,6 +359,159 @@ class Fragment:
                 self._cache_complete = True
                 return
         self._cache_complete = False
+
+    # -- storage integrity (storage.integrity; docs/FAULT_TOLERANCE.md) ------
+
+    def _set_quarantined(self, reason: str, site: str) -> None:
+        """Mark this fragment's local copy untrustworthy: the executor
+        stops serving its slice locally (reads fail over through the
+        breaker-ordered placement), anti-entropy stops letting it
+        vote, and the repairer re-streams it from a replica. Writes
+        keep applying (WAL-buffered) — they also fan out to every
+        replica owner, so the repaired copy includes them."""
+        obs_metrics.STORAGE_CORRUPTION.labels(site).inc()
+        self.quarantined = True
+        self.quarantine_reason = reason
+        # Tail sampling: the query that tripped over the corruption is
+        # keep-worthy evidence (obs.sampler reason "corruption").
+        from ..sched import context as sched_context
+        ctx = sched_context.current()
+        if ctx is not None:
+            ctx.note_flag("corruption")
+        if self.quarantine is not None:
+            if self.quarantine.add(self, reason):
+                obs_metrics.STORAGE_QUARANTINED.inc()
+            obs_metrics.STORAGE_QUARANTINED_LIVE.set(
+                len(self.quarantine))
+        else:
+            obs_metrics.STORAGE_QUARANTINED.inc()
+        self.logger.printf(
+            "fragment: quarantined %s/%s/%s/%d (%s): %s", self.index,
+            self.frame, self.view, self.slice, site, reason)
+
+    def clear_quarantine(self) -> None:
+        """Repair complete: the local copy is trustworthy again. The
+        ``.corrupt`` aside file goes too — it doubles as the crash-safe
+        quarantine sentinel (see open()), so leaving it would
+        re-quarantine a REPAIRED fragment at the next restart."""
+        try:
+            os.remove(self.path + ".corrupt")
+        except FileNotFoundError:
+            pass
+        except OSError as e:
+            # A lingering sentinel re-quarantines this fragment at
+            # every restart (and re-streams it for nothing) — say so
+            # loudly instead of hiding the why.
+            self.logger.printf(
+                "fragment: could not remove quarantine sentinel"
+                " %s.corrupt (%s) — the fragment will re-quarantine"
+                " at the next restart until it is removed",
+                self.path, e)
+        self.quarantined = False
+        self.quarantine_reason = ""
+        if self.quarantine is not None:
+            self.quarantine.remove(self)
+            obs_metrics.STORAGE_QUARANTINED_LIVE.set(
+                len(self.quarantine))
+
+    def _verify_on_read(self) -> None:
+        """Lazy per-block verification on the FIRST read after an open
+        (the mmap-fault half of the footer contract): one crc pass over
+        the container blocks against the footer table, then free. A
+        mismatch quarantines and raises — the executor re-maps the
+        slice onto a healthy replica (the same machinery as a failed
+        remote leg)."""
+        if not self._verify_pending:
+            return
+        self._verify_pending = False
+        storage = self.storage
+        info = getattr(storage, "footer", None)
+        mm = self._mmap
+        if info is None or mm is None:
+            return
+        bad = integrity_mod.verify_blocks(mm, info)
+        obs_metrics.STORAGE_SCRUB_BLOCKS.labels("read").inc(
+            info.block_n)
+        if bad:
+            self._set_quarantined(
+                f"container block crc mismatch (blocks {bad[:4]},"
+                f" {len(bad)} total)", site="read")
+            raise integrity_mod.CorruptionError(
+                f"fragment {self.index}/{self.frame}/{self.view}/"
+                f"{self.slice}: {len(bad)} container blocks fail crc")
+
+    def verify_on_disk(self) -> dict:
+        """Re-read the data FILE and verify footer + blocks + WAL tail
+        — the scrubber's per-fragment pass (storage.scrub). Opens its
+        own fd (os.replace swaps pin the old inode, so the read is a
+        consistent append-only prefix — the fragment backup trick),
+        sizes it under the fragment lock after a commit barrier, and
+        quarantines on any corruption verdict."""
+        from . import scrub as scrub_mod
+        try:
+            self.wal_barrier()
+        except wal_mod.WalError:
+            pass  # torn pending tail: the flushed prefix still verifies
+        if _fp.ACTIVE is not None:
+            # The scrub leg's deterministic corruption injection site.
+            _fp.ACTIVE.hit("storage.read", path=self.path)
+        try:
+            f = open(self.path, "rb")
+        except OSError as e:
+            return {"error": f"unreadable: {e}", "coverage": "none"}
+        try:
+            with self._mu:
+                size = os.fstat(f.fileno()).st_size
+            mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+            try:
+                mv = memoryview(mm)
+                try:
+                    verdict = scrub_mod.scrub_buffer(mv[:size])
+                finally:
+                    del mv
+            finally:
+                mm.close()
+        finally:
+            f.close()
+        if verdict.get("corrupt"):
+            self._set_quarantined(
+                f"scrub: {verdict.get('error', 'checksum mismatch')}",
+                site="scrub")
+        return verdict
+
+    def reset_for_repair(self) -> None:
+        """Drop the suspect local state ahead of a replica re-stream
+        (server.repair): the data file moves aside to ``.corrupt``, a
+        fresh footered empty snapshot takes its place, and every
+        derived cache resets. Writes racing this land in the fresh
+        WAL; reads stay quarantined until the repairer verifies the
+        streamed copy and clears the flag. Lock order: _snap_mu (waits
+        out any background snapshot worker) then _mu — the
+        close/restore discipline."""
+        with self._snap_mu, self._mu:
+            if not self._open:
+                return
+            self._close_storage()
+            try:
+                aside = self.path + ".corrupt"
+                if os.path.exists(aside):
+                    # An open-time quarantine already moved the original
+                    # corrupt bytes aside; keep THAT forensics file.
+                    os.remove(self.path)
+                else:
+                    os.replace(self.path, aside)
+            except FileNotFoundError:
+                pass
+            self._open_storage()
+            self._epoch += 1
+            self._row_counts.clear()
+            self.row_cache.clear()
+            self.device.invalidate_all()
+            self.checksums.clear()
+            self._src_counts.clear()
+            self._cache_complete = False
+            self.cache = cache_mod.new_cache(self.cache_type,
+                                             self.cache_size)
 
     def close(self) -> None:
         # _snap_mu first (lock order): waits out any worker and blocks
@@ -365,6 +582,7 @@ class Fragment:
         """Materialize a row as a one-segment result Bitmap of absolute
         column ids (reference fragment.go:338-367)."""
         with self._mu:
+            self._verify_on_read()
             if check_cache:
                 cached = self.row_cache.fetch(row_id)
                 if cached is not None:
@@ -391,6 +609,7 @@ class Fragment:
         ``cached=False`` to avoid churning the LRU for a 0% hit rate."""
         from ..ops.packed import pack_storage_row
         with self._mu:
+            self._verify_on_read()
             if cached:
                 out[:] = self.device.host_row_words(self.storage, row_id)
             else:
@@ -585,9 +804,14 @@ class Fragment:
                 tmp = self.path + ".snapshotting"
                 try:
                     with open(tmp, "wb") as f:
+                        self.storage.write_to(f, footer=True)
+                        # The hit sits AFTER the body write so corrupt
+                        # mode can flip real bits in the just-written
+                        # snapshot (error/torn/enospc semantics are
+                        # unchanged: the tmp file is discarded either
+                        # way and the old file stays the record).
                         if _fp.ACTIVE is not None:
                             _fp.ACTIVE.hit("snapshot.write", writer=f)
-                        self.storage.write_to(f)
                         f.flush()
                         os.fsync(f.fileno())
                 except OSError as e:
@@ -632,7 +856,11 @@ class Fragment:
         if self._snapshot_n % _REMAP_EVERY == 0:
             self._close_storage()
             os.replace(tmp, self.path)
-            self._open_storage()
+            # Quarantining reopen: a snapshot that landed corrupt
+            # (failpoint corrupt mode, real bit rot in the write path)
+            # must degrade to quarantine + repair, not brick the
+            # fragment mid-swap.
+            self._open_storage_quarantining()
             return
         self.storage.op_writer = None
         os.replace(tmp, self.path)
@@ -642,7 +870,7 @@ class Fragment:
                         fcntl.LOCK_EX | fcntl.LOCK_NB)
         except BaseException:
             self._close_storage()
-            self._open_storage()
+            self._open_storage_quarantining()
             return
         old_file, self._file = self._file, new_file
         self._mmap = None
@@ -707,17 +935,20 @@ class Fragment:
                 tmp = self.path + ".snapshotting"
                 try:
                     with open(tmp, "wb") as f:
+                        # The expensive serialize + fsync of the frozen
+                        # body runs with NO fragment lock held; writers
+                        # keep appending to the old file's WAL.
+                        roaring.write_frozen(frozen, f, footer=True)
                         # Crash-mid-snapshot injection: a fault here
                         # leaves a partial tmp file that is never
                         # swapped in — the old snapshot+WAL stays the
                         # file of record and the next MAX_OP_N trigger
-                        # retries (the OSError handler below).
+                        # retries (the OSError handler below). Corrupt
+                        # mode instead flips real bits in the written
+                        # body, which the open-time / scrub checks
+                        # must catch downstream.
                         if _fp.ACTIVE is not None:
                             _fp.ACTIVE.hit("snapshot.write", writer=f)
-                        # The expensive serialize + fsync of the frozen
-                        # body runs with NO fragment lock held; writers
-                        # keep appending to the old file's WAL.
-                        roaring.write_frozen(frozen, f)
                         f.flush()
                         os.fsync(f.fileno())
                         with self._mu:
@@ -964,6 +1195,7 @@ class Fragment:
         mutations (review finding, round 4)."""
         from ..ops import packed
         with self._mu:
+            self._verify_on_read()
             return packed.sparse_row_words(self.storage, row_id)
 
     def _cached_total_bits(self) -> int:
@@ -1193,6 +1425,7 @@ class Fragment:
         if not row_ids:
             return np.empty(0, dtype=np.uint64)
         with self._mu:
+            self._verify_on_read()
             w = np.uint64(SLICE_WIDTH)
             ids = np.unique(np.asarray(row_ids, dtype=np.uint64))
             # Gather ONLY the target rows' container key spans (each
@@ -1232,6 +1465,7 @@ class Fragment:
         (reference fragment.go:490-625; same semantics, batched counts)."""
         opt = opt or TopOptions()
         with self._mu:
+            self._verify_on_read()
             # Array fast path for the plain TopN(frame, n) shape — no
             # source bitmap, no attribute filter, no tanimoto: the
             # answer is the first n rank-cache entries with count ≥

@@ -1,0 +1,314 @@
+"""Storage integrity: checksummed snapshot footers + quarantine.
+
+The fragment data file (roaring snapshot + appended op-log) is the one
+layer of the durability story that carried no checksums of its own: WAL
+records are FNV-checksummed and the observability rings are crc-framed,
+but a flipped bit in a snapshot container block silently corrupted
+Count/TopN answers forever. This module closes that hole:
+
+- **Footer** — snapshot writers (both the Python serializer and the
+  native writev path) append a version-flagged footer after the body:
+  a per-container-block crc32 table, a crc32 of the header region, and
+  a whole-body crc32 digest. Vintage (un-footered) files stay fully
+  readable — the footer is detected by magic at the body/op-log
+  boundary, and its first byte can never alias a valid op record
+  (op types are 0/1).
+- **Verification** — the footer's own crc and the header-region crc
+  are checked at unmarshal time (cheap, O(header)); the per-block
+  table is re-checked lazily on the first read after an open and
+  re-checked continuously by the background scrubber
+  (storage.scrub), which also cross-validates the WAL tail's FNV
+  checksums.
+- **Quarantine** — a mismatch quarantines the fragment in the
+  holder's :class:`QuarantineRegistry`: reads fail over to a healthy
+  replica through the breaker-ordered placement (executor consults
+  ``slice_blocked``), no-replica reads degrade per the ``?partial=1``
+  contract (503 without it), writes keep buffering through the WAL,
+  and the repairer (server.repair) re-streams the content from a
+  replica and un-quarantines.
+
+Footer wire format (all little-endian), appended at the end of the
+snapshot body — op-log records append AFTER the footer::
+
+    footer := magic(u32 = 0x46B10C07)     # low byte 0x07: never a
+                                          # valid op-record type (0/1)
+              version(u16 = 1) flags(u16 = 0)
+              bodyLen(u64)                # bytes covered: [0, bodyLen)
+              blockN(u32)                 # container blocks (= keyN)
+              { blockCrc32(u32) } * blockN
+              headerCrc32(u32)            # crc of [0, dataStart)
+              bodyCrc32(u32)              # crc of [0, bodyLen) — the
+                                          # whole-file digest
+              footerCrc32(u32)            # crc of the footer bytes
+                                          # before this field
+
+A truncated footer at EOF (crash mid-append on a direct write path)
+reads as a torn tail — the bytes are reported so the reopen trims
+them, exactly like a torn op record. A complete footer whose own crc
+fails is corruption, not a tear.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import zlib
+from typing import Optional
+
+import numpy as np
+
+FOOTER_MAGIC = 0x46B10C07
+FOOTER_VERSION = 1
+
+_FIXED = struct.Struct("<IHHQI")     # magic, version, flags, bodyLen, blockN
+_TAIL = struct.Struct("<III")        # headerCrc, bodyCrc, footerCrc
+_FIXED_N = _FIXED.size               # 20
+_TAIL_N = _TAIL.size                 # 12
+
+
+def footer_len(block_n: int) -> int:
+    return _FIXED_N + 4 * block_n + _TAIL_N
+
+
+class CorruptionError(ValueError):
+    """On-disk bytes contradict their recorded checksums (or a footer
+    is structurally invalid). Subclasses ValueError so the vintage
+    open-path error handling (which quarantines on any unmarshal
+    failure) catches it uniformly."""
+
+
+class TornFooterError(ValueError):
+    """A footer truncated at EOF — the signature of a crash mid-append,
+    not of corruption. Carries ``torn_bytes`` so the caller can trim
+    the tail like any torn op record."""
+
+    def __init__(self, torn_bytes: int):
+        super().__init__(f"torn snapshot footer ({torn_bytes} bytes)")
+        self.torn_bytes = torn_bytes
+
+
+class FooterInfo:
+    """A parsed footer plus the block layout needed to re-verify it
+    against the buffer it came from."""
+
+    __slots__ = ("version", "body_len", "block_n", "crcs",
+                 "header_crc", "body_crc", "size", "offsets", "sizes")
+
+    def __init__(self, version: int, body_len: int, block_n: int,
+                 crcs: np.ndarray, header_crc: int, body_crc: int,
+                 size: int):
+        self.version = version
+        self.body_len = body_len
+        self.block_n = block_n
+        self.crcs = crcs                    # u32[block_n]
+        self.header_crc = header_crc
+        self.body_crc = body_crc
+        self.size = size                    # total footer bytes
+        # Container-block layout, attached by the snapshot parser so
+        # lazy per-block verification needs no re-parse.
+        self.offsets: Optional[np.ndarray] = None
+        self.sizes: Optional[np.ndarray] = None
+
+    def to_json(self) -> dict:
+        return {"version": self.version, "bodyLen": self.body_len,
+                "blocks": self.block_n}
+
+
+# -- building -----------------------------------------------------------------
+
+
+def build_footer(head: bytes, block_crcs: list[int],
+                 body_crc: int, body_len: int) -> bytes:
+    """Assemble the footer bytes for a just-written snapshot body.
+    ``head`` is the header region (cookie through the offset table),
+    ``block_crcs`` one crc32 per container block in file order, and
+    ``body_crc`` the running crc32 over the whole body."""
+    parts = [_FIXED.pack(FOOTER_MAGIC, FOOTER_VERSION, 0, body_len,
+                         len(block_crcs))]
+    if block_crcs:
+        parts.append(np.asarray(block_crcs,
+                                dtype="<u4").tobytes())
+    parts.append(struct.pack("<II", zlib.crc32(head) & 0xFFFFFFFF,
+                             body_crc & 0xFFFFFFFF))
+    body = b"".join(parts)
+    return body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
+
+
+# -- parsing / verification ---------------------------------------------------
+
+
+def parse_footer(buf, offset: int) -> Optional[FooterInfo]:
+    """Parse a footer at ``offset`` of ``buf`` (the end of the
+    container blocks). Returns None when no footer magic is present
+    (a vintage file, or op records follow directly). Raises
+    :class:`TornFooterError` when a footer is truncated at EOF and
+    :class:`CorruptionError` when a complete footer fails its own
+    crc."""
+    avail = len(buf) - offset
+    if avail < 4:
+        return None
+    magic = int.from_bytes(bytes(buf[offset:offset + 4]), "little")
+    if magic != FOOTER_MAGIC:
+        return None
+    if avail < _FIXED_N:
+        raise TornFooterError(avail)
+    ver, _flags, body_len, block_n = _FIXED.unpack(
+        bytes(buf[offset:offset + _FIXED_N]))[1:]
+    if ver > FOOTER_VERSION:
+        raise CorruptionError(
+            f"snapshot footer version {ver} unsupported")
+    total = footer_len(block_n)
+    if avail < total:
+        raise TornFooterError(avail)
+    raw = bytes(buf[offset:offset + total])
+    (want_crc,) = struct.unpack("<I", raw[-4:])
+    got_crc = zlib.crc32(raw[:-4]) & 0xFFFFFFFF
+    if want_crc != got_crc:
+        raise CorruptionError(
+            f"snapshot footer crc mismatch: exp={want_crc:08x},"
+            f" got={got_crc:08x}")
+    crcs = np.frombuffer(raw, dtype="<u4", count=block_n,
+                         offset=_FIXED_N).copy()
+    header_crc, body_crc = struct.unpack(
+        "<II", raw[_FIXED_N + 4 * block_n:_FIXED_N + 4 * block_n + 8])
+    if body_len != offset:
+        raise CorruptionError(
+            f"snapshot footer bodyLen {body_len} != body end {offset}")
+    return FooterInfo(ver, body_len, block_n, crcs, header_crc,
+                      body_crc, total)
+
+
+def verify_header(buf, header_len: int, info: FooterInfo) -> None:
+    # memoryview slice: crc straight off the mmap, no copy.
+    got = zlib.crc32(memoryview(buf)[:header_len]) & 0xFFFFFFFF
+    if got != info.header_crc:
+        raise CorruptionError(
+            f"snapshot header crc mismatch: exp={info.header_crc:08x},"
+            f" got={got:08x}")
+
+
+def verify_body(buf, info: FooterInfo) -> None:
+    """The whole-file digest: one crc pass over [0, bodyLen) —
+    memoryview-sliced so a multi-GB mmap'd body is streamed by zlib,
+    never copied (this runs on every cold open and scrub pass)."""
+    got = zlib.crc32(memoryview(buf)[:info.body_len]) & 0xFFFFFFFF
+    if got != info.body_crc:
+        raise CorruptionError(
+            f"snapshot body crc mismatch: exp={info.body_crc:08x},"
+            f" got={got:08x}")
+
+
+def parse_and_verify_footer(buf, key_n: int, header_len: int,
+                            offs, sizes, body_end: int,
+                            check_body: bool = False
+                            ) -> Optional[FooterInfo]:
+    """The ONE footer-verification sequence shared by the decoder
+    (roaring.Bitmap.unmarshal) and the scrubber (storage.scrub):
+    parse the footer at ``body_end`` (None for vintage files), check
+    blockN against the header's keyN, verify the header-region crc,
+    attach the block layout for later per-block checks, and — with
+    ``check_body`` — verify the whole-body digest. Raises
+    TornFooterError / CorruptionError exactly like parse_footer."""
+    info = parse_footer(buf, body_end)
+    if info is None:
+        return None
+    if info.block_n != key_n:
+        raise CorruptionError(
+            f"snapshot footer blockN {info.block_n} != keyN {key_n}")
+    verify_header(buf, header_len, info)
+    info.offsets = offs
+    info.sizes = np.asarray(sizes, dtype=np.int64)
+    if check_body:
+        verify_body(buf, info)
+    return info
+
+
+def verify_blocks(buf, info: FooterInfo) -> list[int]:
+    """Re-check every container block's crc32 against the footer
+    table; returns the indices that mismatch (empty = clean). The
+    layout arrays must have been attached by the snapshot parser."""
+    offs, sizes = info.offsets, info.sizes
+    if offs is None or sizes is None or info.block_n != len(offs):
+        return []
+    bad: list[int] = []
+    mv = memoryview(buf)
+    crcs = info.crcs
+    for i, (off, size) in enumerate(zip(offs.tolist(),
+                                        sizes.tolist())):
+        if (zlib.crc32(mv[off:off + size]) & 0xFFFFFFFF) != int(crcs[i]):
+            bad.append(i)
+    return bad
+
+
+# -- quarantine ---------------------------------------------------------------
+
+
+class QuarantineRegistry:
+    """Per-holder registry of quarantined fragments. The executor
+    consults ``slice_blocked`` per (index, slice) on the read path (an
+    O(1) rollup), /debug/integrity lists entries, and the repairer
+    drains it."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._entries: dict[tuple, dict] = {}
+        self._by_slice: dict[tuple, int] = {}
+        # Wired by the server's repairer so a quarantine recorded at
+        # any time (open, lazy read verify, scrub) wakes a repair
+        # attempt without polling.
+        self.on_quarantine = None
+
+    @staticmethod
+    def _key(frag) -> tuple:
+        return (frag.index, frag.frame, frag.view, frag.slice)
+
+    def add(self, frag, reason: str) -> bool:
+        """Record ``frag`` as quarantined; returns False when it was
+        already recorded (re-detections do not re-count)."""
+        import time
+        key = self._key(frag)
+        with self._mu:
+            if key in self._entries:
+                self._entries[key]["reason"] = reason
+                return False
+            self._entries[key] = {
+                "index": frag.index, "frame": frag.frame,
+                "view": frag.view, "slice": frag.slice,
+                "path": frag.path, "reason": reason,
+                "since": time.time()}
+            sk = (frag.index, frag.slice)
+            self._by_slice[sk] = self._by_slice.get(sk, 0) + 1
+        cb = self.on_quarantine
+        if cb is not None:
+            try:
+                cb(frag)
+            except Exception:  # noqa: BLE001 - advisory wake
+                pass
+        return True
+
+    def remove(self, frag) -> bool:
+        key = self._key(frag)
+        with self._mu:
+            if self._entries.pop(key, None) is None:
+                return False
+            sk = (frag.index, frag.slice)
+            n = self._by_slice.get(sk, 0) - 1
+            if n <= 0:
+                self._by_slice.pop(sk, None)
+            else:
+                self._by_slice[sk] = n
+        return True
+
+    def slice_blocked(self, index: str, slice: int) -> bool:
+        """True when ANY fragment of (index, slice) is quarantined
+        here — the read path must not serve the slice locally."""
+        if not self._by_slice:  # lock-free fast path: empty registry
+            return False
+        return (index, slice) in self._by_slice
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def entries(self) -> list[dict]:
+        with self._mu:
+            return [dict(v) for v in self._entries.values()]

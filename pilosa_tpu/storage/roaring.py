@@ -52,10 +52,12 @@ from __future__ import annotations
 import bisect
 import io
 import struct
+import zlib
 from typing import Callable, Iterator as TIterator, Optional
 
 import numpy as np
 
+from . import integrity as _integrity
 from . import native
 from . import native_ext
 from . import wal as _wal_mod
@@ -1022,6 +1024,17 @@ def _xor(a: Container, b: Container) -> Container:
 _OP_BODY = struct.Struct("<BQ")  # op type + u64 value (13-byte record w/ checksum)
 
 
+def fnv_fold_records(recs: np.ndarray) -> np.ndarray:
+    """Vectorized FNV-1a over the 9 body bytes of each 13-byte op
+    record row ([n, OP_SIZE] u8) — the ONE checksum fold shared by
+    the record builder (_wal_blob), replay validation (_replay_ops),
+    and the scrubber's WAL-tail cross-check (storage.scrub)."""
+    h = np.full(len(recs), int(_FNV_OFFSET), dtype=np.uint32)
+    for i in range(9):
+        h = (h ^ recs[:, i].astype(np.uint32)) * _FNV_PRIME
+    return h
+
+
 def _wal_blob(values: np.ndarray, typ: int) -> bytes:
     """13-byte op records for a value vector, checksummed, vectorized —
     the group-commit form of Op.marshal (verified byte-identical in
@@ -1039,9 +1052,7 @@ def _wal_blob(values: np.ndarray, typ: int) -> bytes:
     rec = np.zeros((n, OP_SIZE), dtype=np.uint8)
     rec[:, 0] = typ
     rec[:, 1:9] = values.astype("<u8").view(np.uint8).reshape(n, 8)
-    h = np.full(n, int(_FNV_OFFSET), dtype=np.uint32)
-    for i in range(9):
-        h = (h ^ rec[:, i].astype(np.uint32)) * _FNV_PRIME
+    h = fnv_fold_records(rec)
     rec[:, 9:13] = h.astype("<u4").view(np.uint8).reshape(n, 4)
     return rec.tobytes()
 
@@ -1107,9 +1118,7 @@ def _replay_ops(b: "Bitmap", rest: memoryview,
         recs = np.frombuffer(rest, dtype=np.uint8,
                              count=n_ops * OP_SIZE).reshape(n_ops,
                                                             OP_SIZE)
-        h = np.full(n_ops, int(_FNV_OFFSET), dtype=np.uint32)
-        for i in range(9):
-            h = (h ^ recs[:, i].astype(np.uint32)) * _FNV_PRIME
+        h = fnv_fold_records(recs)
         stored = np.ascontiguousarray(recs[:, 9:13]).view("<u4").ravel()
         types = recs[:, 0]
         bad_chk = np.flatnonzero(h != stored)
@@ -1158,6 +1167,11 @@ class Bitmap:
         self.op_writer = None
         self.op_n = 0      # ops appended/replayed since last snapshot
         self.torn_bytes = 0  # dangling tail bytes found during unmarshal
+        # Parsed integrity footer (storage.integrity.FooterInfo) when
+        # the decoded snapshot carried one; None for vintage files and
+        # wire-form buffers. Consumers (fragment lazy verify, scrub)
+        # re-check its per-block crc table against the backing buffer.
+        self.footer = None
         # Monotonic mutation counter: bumped by every mutating entry
         # point so derived-value memos (e.g. the fragment src-key
         # cache) can validate against in-place mutation instead of
@@ -2339,7 +2353,7 @@ class Bitmap:
 
     # -- serialization (reference-compatible; roaring.go:475-614)
 
-    def write_to(self, w) -> int:
+    def write_to(self, w, footer: bool = False) -> int:
         # Normalize representation so the n<=4096⇒array load rule holds even
         # for bitmaps produced by set algebra (run containers are
         # exempt — the runs flag bitset identifies them on disk).
@@ -2356,7 +2370,7 @@ class Bitmap:
                 live.append((k, 1, c.bitmap, c.n))
             else:
                 live.append((k, 0, c.array, c.n))
-        return _write_snapshot(live, w)
+        return _write_snapshot(live, w, footer=footer)
 
     def _flush_table_dirty(self) -> None:
         """Patch point-mutated containers' entries into the
@@ -2471,7 +2485,8 @@ class Bitmap:
 
     @staticmethod
     def unmarshal(data, mapped: bool = False,
-                  tolerate_torn_tail: bool = False) -> "Bitmap":
+                  tolerate_torn_tail: bool = False,
+                  verify_body: bool = False) -> "Bitmap":
         """Decode a snapshot (+trailing op-log) from a bytes-like buffer.
 
         With ``mapped=True`` container data are zero-copy views into ``data``
@@ -2482,62 +2497,20 @@ class Bitmap:
         instead of raising; the number of dangling bytes is reported in
         ``.torn_bytes`` so the caller can truncate the file. A bad checksum
         on a *complete* record is still corruption and still raises.
+
+        A footered snapshot (storage.integrity) always has its footer
+        self-crc + header-region crc verified; ``verify_body=True``
+        additionally checks the whole-body digest (one crc pass over
+        the file — the cold-open verification; per-block checks run
+        lazily on first read and on the scrub cadence).
         """
         buf = memoryview(data)
-        if len(buf) < HEADER_SIZE:
-            raise ValueError("data too small")
-        cookie = int.from_bytes(buf[0:4], "little")
-        if cookie not in (COOKIE, COOKIE_RUNS):
-            raise ValueError("invalid roaring file")
-        key_n = int.from_bytes(buf[4:8], "little")
-        hdr_off = HEADER_SIZE
-        run_mask = None
-        if cookie == COOKIE_RUNS:
-            flag_len = _run_flags_len(key_n)
-            if HEADER_SIZE + flag_len > len(buf):
-                raise ValueError(
-                    f"run flags out of bounds: keyN={key_n},"
-                    f" len={len(buf)}")
-            run_mask = np.unpackbits(
-                np.frombuffer(buf, np.uint8, count=flag_len,
-                              offset=HEADER_SIZE),
-                bitorder="little")[:key_n].astype(bool)
-            hdr_off += flag_len
-        if hdr_off + key_n * 16 > len(buf):
-            raise ValueError(
-                f"header out of bounds: keyN={key_n}, len={len(buf)}")
-        b = Bitmap()
-        # Vectorized header/offset parse: the per-container
-        # int.from_bytes loop cost ~100 ms on a 15 K-container
-        # fragment — the bulk of every open() and of the synchronous
-        # remap reopen (the write path's worst per-op outlier).
-        hdr_arr = np.frombuffer(buf, dtype=_HDR_DTYPE, count=key_n,
-                                offset=hdr_off)
-        ns = (hdr_arr["n"].astype(np.int64) + 1)
-        offs = np.frombuffer(buf, dtype="<u4", count=key_n,
-                             offset=hdr_off + key_n * 12
-                             ).astype(np.int64)
+        hdr_arr, run_mask, ns, offs, sizes, ops_offset, body_end = \
+            parse_snapshot_layout(buf)
+        key_n = len(hdr_arr)
         is_arr_mask = ns <= ARRAY_MAX_SIZE
-        sizes = _container_sizes(ns)
-        if run_mask is not None and run_mask.any():
-            # Run block sizes come from each block's own numRuns
-            # prefix (2 + 4R bytes); validate the prefix read first.
-            sizes = sizes.copy()
-            for i in np.flatnonzero(run_mask).tolist():
-                off = int(offs[i])
-                if off + 2 > len(buf):
-                    raise ValueError(
-                        f"run block out of bounds: off={off},"
-                        f" len={len(buf)}")
-                sizes[i] = 2 + 4 * int.from_bytes(buf[off:off + 2],
-                                                  "little")
-        if key_n and int((offs + sizes).max()) > len(buf):
-            bad = int(offs[np.argmax(offs + sizes)])
-            raise ValueError(
-                f"offset out of bounds: off={bad}, len={len(buf)}")
+        b = Bitmap()
         b.keys = hdr_arr["key"].tolist()
-        ops_offset = hdr_off + key_n * 16
-        end = HEADER_SIZE
         containers = b.containers
         run_list = (run_mask.tolist() if run_mask is not None
                     else [False] * key_n)
@@ -2567,12 +2540,94 @@ class Bitmap:
             c.mapped = mapped
             c.cow = 0
             containers.append(c)
-        if key_n:
-            end = int(offs[-1] + sizes[-1])
-        # Trailing op-log (bytes after the last container block).
-        ops_end = max(ops_offset, end)
-        _replay_ops(b, buf[ops_end:], tolerate_torn_tail)
+        # Integrity footer (storage.integrity), if one sits between the
+        # container blocks and the op-log. Vintage files parse None and
+        # replay straight from the body end; a footer truncated at EOF
+        # is a torn tail (trimmed like a torn op record); a complete
+        # footer failing its own or the header-region crc is
+        # CORRUPTION and raises — the fragment open path quarantines.
+        ops_start = body_end
+        try:
+            info = _integrity.parse_and_verify_footer(
+                buf, key_n, ops_offset, offs, sizes, body_end,
+                check_body=verify_body)
+        except _integrity.TornFooterError as e:
+            if not tolerate_torn_tail:
+                raise
+            b.torn_bytes = e.torn_bytes
+            return b
+        if info is not None:
+            b.footer = info
+            ops_start = body_end + info.size
+        # Trailing op-log (bytes after the body / footer).
+        _replay_ops(b, buf[ops_start:], tolerate_torn_tail)
         return b
+
+
+def parse_snapshot_layout(buf) -> tuple:
+    """Vectorized parse of a snapshot's header region WITHOUT building
+    containers: ``(hdr_arr, run_mask, ns, offs, sizes, ops_offset,
+    body_end)``. The ONE layout parser shared by the decoder
+    (Bitmap.unmarshal) and the integrity scrubber
+    (storage.scrub.scrub_buffer), so a format change cannot
+    desynchronize corruption DETECTION from decoding. Raises
+    ValueError on any structural violation. ``buf`` must be a
+    memoryview.
+
+    The per-container int.from_bytes loop this vectorization replaced
+    cost ~100 ms on a 15 K-container fragment — the bulk of every
+    open() and of the synchronous remap reopen (the write path's
+    worst per-op outlier)."""
+    if len(buf) < HEADER_SIZE:
+        raise ValueError("data too small")
+    cookie = int.from_bytes(buf[0:4], "little")
+    if cookie not in (COOKIE, COOKIE_RUNS):
+        raise ValueError("invalid roaring file")
+    key_n = int.from_bytes(buf[4:8], "little")
+    hdr_off = HEADER_SIZE
+    run_mask = None
+    if cookie == COOKIE_RUNS:
+        flag_len = _run_flags_len(key_n)
+        if HEADER_SIZE + flag_len > len(buf):
+            raise ValueError(
+                f"run flags out of bounds: keyN={key_n},"
+                f" len={len(buf)}")
+        run_mask = np.unpackbits(
+            np.frombuffer(buf, np.uint8, count=flag_len,
+                          offset=HEADER_SIZE),
+            bitorder="little")[:key_n].astype(bool)
+        hdr_off += flag_len
+    if hdr_off + key_n * 16 > len(buf):
+        raise ValueError(
+            f"header out of bounds: keyN={key_n}, len={len(buf)}")
+    hdr_arr = np.frombuffer(buf, dtype=_HDR_DTYPE, count=key_n,
+                            offset=hdr_off)
+    ns = (hdr_arr["n"].astype(np.int64) + 1)
+    offs = np.frombuffer(buf, dtype="<u4", count=key_n,
+                         offset=hdr_off + key_n * 12
+                         ).astype(np.int64)
+    sizes = _container_sizes(ns)
+    if run_mask is not None and run_mask.any():
+        # Run block sizes come from each block's own numRuns
+        # prefix (2 + 4R bytes); validate the prefix read first.
+        sizes = sizes.copy()
+        for i in np.flatnonzero(run_mask).tolist():
+            off = int(offs[i])
+            if off + 2 > len(buf):
+                raise ValueError(
+                    f"run block out of bounds: off={off},"
+                    f" len={len(buf)}")
+            sizes[i] = 2 + 4 * int.from_bytes(buf[off:off + 2],
+                                              "little")
+    if key_n and int((offs + sizes).max()) > len(buf):
+        bad = int(offs[np.argmax(offs + sizes)])
+        raise ValueError(
+            f"offset out of bounds: off={bad}, len={len(buf)}")
+    ops_offset = hdr_off + key_n * 16
+    body_end = max(ops_offset,
+                   int(offs[-1] + sizes[-1]) if key_n
+                   else HEADER_SIZE)
+    return hdr_arr, run_mask, ns, offs, sizes, ops_offset, body_end
 
 
 def _shared_view(c: Container) -> Container:
@@ -2653,18 +2708,21 @@ class _Frozen:
         return out
 
 
-def write_frozen(frozen, w) -> int:
+def write_frozen(frozen, w, footer: bool = False) -> int:
     """Serialize a Bitmap.freeze() capture (no locks needed). Real
     files take the native writev path (zero copy, no GIL during the
     write); BytesIO targets, native-less hosts, and captures holding
     run containers (the C writer speaks the legacy cookie only)
-    serialize via the Python writer."""
+    serialize via the Python writer. ``footer=True`` appends the
+    storage-integrity footer on BOTH paths (the native body write
+    stays C; the footer crcs compute from the frozen buffers, no file
+    re-read)."""
     if isinstance(frozen, list):  # legacy tuple-list form
         live = [t if isinstance(t[1], (int, np.integer))
                 else (t[0], 0 if t[2] is None else 1,
                       t[1] if t[2] is None else t[2], t[3])
                 for t in frozen]
-        return _write_snapshot(live, w)
+        return _write_snapshot(live, w, footer=footer)
     fileno = getattr(w, "fileno", None)
     if fileno is not None and native.available() and not frozen.has_runs:
         try:
@@ -2677,8 +2735,14 @@ def write_frozen(frozen, w) -> int:
                                              frozen.types, frozen.ptrs)
             if total < 0:
                 raise OSError("write_snapshot_fd failed")
+            if footer:
+                # The C writer advanced the shared fd offset past the
+                # body; append straight through the fd (the buffered
+                # wrapper was flushed above and holds nothing).
+                import os as _os
+                _os.write(fd, _live_footer(frozen.as_live_tuples()))
             return total
-    return _write_snapshot(frozen.as_live_tuples(), w)
+    return _write_snapshot(frozen.as_live_tuples(), w, footer=footer)
 
 
 def _base_u8_window(base: np.ndarray, ptr: int, nbytes: int) -> np.ndarray:
@@ -2700,11 +2764,13 @@ def _run_flags_len(n_cont: int) -> int:
 _BLOCK_DTYPES = ("<u4", "<u8", "<u2")  # kind 0=array, 1=bitmap, 2=run
 
 
-def _write_snapshot(live: list[tuple], w) -> int:
-    """Serialize (key, kind, buf, n) rows. With no run containers the
-    output is byte-identical to the legacy 12346 format; any run
-    container switches the snapshot to the 12347 runs cookie, which
-    inserts the run-flag bitset between keyN and the headers."""
+def _snapshot_head(live: list[tuple]) -> tuple[bytes, np.ndarray, int]:
+    """(header-region bytes, per-block sizes, total body bytes) for a
+    snapshot of (key, kind, buf, n) rows — the ONE place the on-disk
+    header layout is computed, shared by the Python writer and the
+    footer builder for the native writev path (whose C code writes a
+    byte-identical header; a format tweak here desynchronizing them is
+    caught by the footer verifying against the real file bytes)."""
     n_cont = len(live)
     # Header via numpy, payload via one join + one write: a snapshot
     # used to issue one write() per container (16 K syscalls for a
@@ -2744,9 +2810,44 @@ def _write_snapshot(live: list[tuple], w) -> int:
             + n_cont.to_bytes(4, "little")
             + flag_bytes
             + hdr.tobytes() + offsets.astype("<u4").tobytes())
-    w.write(head)
     total = data_start + int(sizes.sum()) if n_cont \
         else HEADER_SIZE + len(flag_bytes)
+    return head, sizes, total
+
+
+def _live_footer(live: list[tuple]) -> bytes:
+    """The integrity footer for a snapshot body of ``live`` rows,
+    computed from the in-memory buffers (no file re-read) — the
+    native writev path's footer builder."""
+    head, _sizes, total = _snapshot_head(live)
+    crcs: list[int] = []
+    body_crc = zlib.crc32(head)
+    for _, kind, buf, _n in live:
+        arr = buf
+        dt = _BLOCK_DTYPES[kind]
+        if arr.dtype.str != dt or not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr, dtype=dt)
+        crcs.append(zlib.crc32(arr) & 0xFFFFFFFF)
+        body_crc = zlib.crc32(arr, body_crc)
+    return _integrity.build_footer(head, crcs, body_crc, total)
+
+
+def _write_snapshot(live: list[tuple], w, footer: bool = False) -> int:
+    """Serialize (key, kind, buf, n) rows. With no run containers the
+    output is byte-identical to the legacy 12346 format; any run
+    container switches the snapshot to the 12347 runs cookie, which
+    inserts the run-flag bitset between keyN and the headers.
+
+    ``footer=True`` (the fragment FILE snapshot paths) appends the
+    storage-integrity footer — per-container-block crc32 table +
+    whole-body digest (storage.integrity) — after the body. Wire
+    serialization (marshal, /fragment/data) stays footer-free, so
+    golden vectors and the exchange format are byte-unchanged."""
+    n_cont = len(live)
+    head, sizes, total = _snapshot_head(live)
+    w.write(head)
+    block_crcs: list[int] = []
+    body_crc = zlib.crc32(head) if footer else 0
     if n_cont:
         # Coalesce runs of payloads that are adjacent views of one
         # shared base buffer (the bulk-import global merge leaves every
@@ -2764,6 +2865,8 @@ def _write_snapshot(live: list[tuple], w) -> int:
             dt = _BLOCK_DTYPES[kind]
             if arr.dtype.str != dt or not arr.flags.c_contiguous:
                 arr = np.ascontiguousarray(arr, dtype=dt)
+            if footer:
+                block_crcs.append(zlib.crc32(arr) & 0xFFFFFFFF)
             ptr = arr.__array_interface__["data"][0]
             nbytes = arr.nbytes
             b = arr.base
@@ -2778,6 +2881,12 @@ def _write_snapshot(live: list[tuple], w) -> int:
             run_base, run_start, run_len = base, ptr, nbytes
         if run_base is not None:
             parts.append(_base_u8_window(run_base, run_start, run_len))
+        if footer:
+            for p in parts:
+                body_crc = zlib.crc32(p, body_crc)
         w.write(memoryview(np.concatenate(parts))
                 if len(parts) > 1 else parts[0])
+    if footer:
+        w.write(_integrity.build_footer(head, block_crcs,
+                                        body_crc, total))
     return total

@@ -399,8 +399,9 @@ class WatchdogConfig:
     for running legs, ``gossip_silence`` the membership-silence bound,
     ``queue_stall`` the no-grant-while-queued bound; ``resize_stall``
     the no-progress bound on an elastic resize this node coordinates;
-    ``retrip`` rate-limits repeat trips per cause (0 on any threshold
-    disables that detector)."""
+    ``scrub_stall`` the no-progress bound on an in-flight storage
+    scrub pass (storage.scrub); ``retrip`` rate-limits repeat trips
+    per cause (0 on any threshold disables that detector)."""
     enabled: bool = True
     interval: float = 1.0
     wal_stall: float = 5.0
@@ -408,7 +409,23 @@ class WatchdogConfig:
     gossip_silence: float = 60.0
     queue_stall: float = 10.0
     resize_stall: float = 60.0
+    scrub_stall: float = 300.0
     retrip: float = 60.0
+
+
+@dataclass
+class ScrubConfig:
+    """[scrub] section (storage.scrub): the background storage-
+    integrity scrubber. ``interval`` is the pause between passes;
+    ``pace`` the sleep between fragments WITHIN a pass (serving
+    traffic owns the disk — the scrub breathes); ``repair`` gates the
+    automatic replica re-stream of quarantined fragments
+    (server.repair); ``repair_rescan`` its rescan/retry cadence."""
+    enabled: bool = True
+    interval: float = 600.0
+    pace: float = 0.01
+    repair: bool = True
+    repair_rescan: float = 15.0
 
 
 def _parse_bool(v) -> bool:
@@ -430,6 +447,7 @@ class Config:
     trace: TraceConfig = field(default_factory=TraceConfig)
     blackbox: BlackboxConfig = field(default_factory=BlackboxConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
+    scrub: ScrubConfig = field(default_factory=ScrubConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
@@ -546,7 +564,15 @@ deadline-grace = "{dur(self.watchdog.deadline_grace)}"
 gossip-silence = "{dur(self.watchdog.gossip_silence)}"
 queue-stall = "{dur(self.watchdog.queue_stall)}"
 resize-stall = "{dur(self.watchdog.resize_stall)}"
+scrub-stall = "{dur(self.watchdog.scrub_stall)}"
 retrip = "{dur(self.watchdog.retrip)}"
+
+[scrub]
+enabled = {str(self.scrub.enabled).lower()}
+interval = "{dur(self.scrub.interval)}"
+pace = "{dur(self.scrub.pace)}"
+repair = {str(self.scrub.repair).lower()}
+repair-rescan = "{dur(self.scrub.repair_rescan)}"
 
 [profile]
 continuous = {str(self.profile.continuous).lower()}
@@ -714,9 +740,21 @@ def load(path: str = "", env: dict | None = None) -> Config:
                           ("gossip-silence", "gossip_silence"),
                           ("queue-stall", "queue_stall"),
                           ("resize-stall", "resize_stall"),
+                          ("scrub-stall", "scrub_stall"),
                           ("retrip", "retrip")):
             if key in wd:
                 setattr(cfg.watchdog, attr, parse_duration(wd[key]))
+        sc = data.get("scrub", {})
+        if "enabled" in sc:
+            cfg.scrub.enabled = _parse_bool(sc["enabled"])
+        if "interval" in sc:
+            cfg.scrub.interval = parse_duration(sc["interval"])
+        if "pace" in sc:
+            cfg.scrub.pace = parse_duration(sc["pace"])
+        if "repair" in sc:
+            cfg.scrub.repair = _parse_bool(sc["repair"])
+        if "repair-rescan" in sc:
+            cfg.scrub.repair_rescan = parse_duration(sc["repair-rescan"])
         p = data.get("profile", {})
         if "continuous" in p:
             cfg.profile.continuous = _parse_bool(p["continuous"])
@@ -913,9 +951,22 @@ def load(path: str = "", env: dict | None = None) -> Config:
                              "queue_stall"),
                             ("PILOSA_WATCHDOG_RESIZE_STALL",
                              "resize_stall"),
+                            ("PILOSA_WATCHDOG_SCRUB_STALL",
+                             "scrub_stall"),
                             ("PILOSA_WATCHDOG_RETRIP", "retrip")):
         if env.get(env_key_):
             setattr(cfg.watchdog, attr_, parse_duration(env[env_key_]))
+    if env.get("PILOSA_SCRUB_ENABLED"):
+        cfg.scrub.enabled = _parse_bool(env["PILOSA_SCRUB_ENABLED"])
+    if env.get("PILOSA_SCRUB_INTERVAL"):
+        cfg.scrub.interval = parse_duration(env["PILOSA_SCRUB_INTERVAL"])
+    if env.get("PILOSA_SCRUB_PACE"):
+        cfg.scrub.pace = parse_duration(env["PILOSA_SCRUB_PACE"])
+    if env.get("PILOSA_SCRUB_REPAIR"):
+        cfg.scrub.repair = _parse_bool(env["PILOSA_SCRUB_REPAIR"])
+    if env.get("PILOSA_SCRUB_REPAIR_RESCAN"):
+        cfg.scrub.repair_rescan = parse_duration(
+            env["PILOSA_SCRUB_REPAIR_RESCAN"])
     if env.get("PILOSA_PLUGINS_PATH"):
         cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
     if env.get("PILOSA_FAULT_ENABLED"):

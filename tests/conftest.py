@@ -65,3 +65,8 @@ def pytest_configure(config):
                    "per-tenant lanes/quotas/kill-policy/cache-quota"
                    " units run tier-1, the real 2-node gossip legs"
                    " are additionally `slow`")
+    config.addinivalue_line(
+        "markers", "scrub: storage-integrity tests (ISSUE 15) — "
+                   "footer/scrub/quarantine/repair units run tier-1,"
+                   " the real 3-node bit-flip chaos legs are"
+                   " additionally `slow`")

@@ -665,10 +665,10 @@ class TestAsyncSnapshot:
         entered = th.Event()
         orig = roaring_mod.write_frozen
 
-        def slow_write(live, w):
+        def slow_write(live, w, footer=False):
             entered.set()
             gate.wait(10)  # hold serialization open
-            return orig(live, w)
+            return orig(live, w, footer=footer)
 
         monkeypatch.setattr(roaring_mod, "write_frozen", slow_write)
         f = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
@@ -735,10 +735,10 @@ class TestAsyncSnapshot:
         entered = th.Event()
         orig = roaring_mod.write_frozen
 
-        def slow_write(live, w):
+        def slow_write(live, w, footer=False):
             entered.set()
             gate.wait(10)
-            return orig(live, w)
+            return orig(live, w, footer=footer)
 
         monkeypatch.setattr(roaring_mod, "write_frozen", slow_write)
         # Pin the import to the vintage detach-then-SYNC-snapshot lane

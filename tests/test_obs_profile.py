@@ -384,7 +384,8 @@ class TestSLOAndHealth:
         out = json.loads(body)
         assert out["status"] == "ok"
         assert set(out["checks"]) == {"holder", "gossip", "admission",
-                                      "disk", "writeReady"}
+                                      "disk", "writeReady", "storage"}
+        assert out["checks"]["storage"]["ok"] is True
         # A handler with no holder is NOT ready (and says why).
         bare = Handler(None, None)
         status, _, body = call(bare, "GET", "/health")

@@ -1,0 +1,788 @@
+"""Storage-integrity tests (ISSUE 15): checksummed snapshot footers,
+the background scrubber, quarantine, and automatic replica repair.
+
+Tier-1 (fast) legs: footer wire round-trips on BOTH snapshot writers,
+vintage-file compatibility, torn-footer reopen, every detection leg
+(open / lazy first-read / scrub / the ``corrupt`` failpoint mode),
+quarantine gating end to end (executor skip → 503 / partial contract,
+409 fragment routes, anti-entropy skip), scrub-vs-concurrent-write
+races, the in-process repair cycle against a real 2-node replica set,
+the 507 import retry satellite, and the config/CLI/observability
+surfaces. The REAL 3-node gossip chaos legs live in
+tests/test_scrub_cluster.py (slow).
+"""
+
+import io
+import json
+import os
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.fault import failpoints
+from pilosa_tpu.storage import integrity, roaring
+from pilosa_tpu.storage import scrub as scrub_mod
+from pilosa_tpu.storage.fragment import Fragment
+from pilosa_tpu.storage.integrity import (CorruptionError,
+                                          QuarantineRegistry)
+
+pytestmark = pytest.mark.scrub
+
+
+def _mk_bitmap(n=5000, seed=3):
+    rng = np.random.default_rng(seed)
+    b = roaring.Bitmap()
+    b.add_many(rng.choice(1 << 20, size=n, replace=False)
+               .astype(np.uint64))
+    return b
+
+
+def _footered_bytes(b):
+    buf = io.BytesIO()
+    b.write_to(buf, footer=True)
+    return buf.getvalue()
+
+
+# -- footer wire format -------------------------------------------------------
+
+
+class TestFooter:
+    def test_round_trip_and_values_unchanged(self):
+        b = _mk_bitmap()
+        data = _footered_bytes(b)
+        b2 = roaring.Bitmap.unmarshal(data, verify_body=True)
+        assert b2.footer is not None
+        assert b2.footer.version == integrity.FOOTER_VERSION
+        assert (b2.values() == b.values()).all()
+
+    def test_wire_form_is_footer_free_and_body_identical(self):
+        """marshal() / the exchange format carries NO footer, and the
+        footered file's body is byte-identical to the vintage form —
+        the golden-vector compatibility claim."""
+        b = _mk_bitmap()
+        wire = b.marshal()
+        data = _footered_bytes(b)
+        assert data[:len(wire)] == wire
+        assert len(data) == len(wire) + integrity.footer_len(
+            len([c for c in b.containers if c.n]))
+        assert roaring.Bitmap.unmarshal(wire).footer is None
+
+    def test_vintage_file_loads_with_no_footer(self):
+        b = _mk_bitmap()
+        b2 = roaring.Bitmap.unmarshal(b.marshal(), verify_body=True)
+        assert b2.footer is None
+        assert (b2.values() == b.values()).all()
+
+    def test_empty_bitmap_footer(self):
+        data = _footered_bytes(roaring.Bitmap())
+        b = roaring.Bitmap.unmarshal(data, verify_body=True)
+        assert b.footer is not None and b.footer.block_n == 0
+        assert b.count() == 0
+
+    def test_ops_replay_after_footer(self):
+        b = _mk_bitmap(100)
+        buf = io.BytesIO()
+        b.write_to(buf, footer=True)
+        buf.write(roaring.Op(roaring.OP_ADD, 12345678).marshal())
+        buf.write(roaring.Op(roaring.OP_REMOVE, 12345678).marshal())
+        buf.write(roaring.Op(roaring.OP_ADD, 999).marshal())
+        b2 = roaring.Bitmap.unmarshal(buf.getvalue(), verify_body=True)
+        assert b2.contains(999) and not b2.contains(12345678)
+        assert b2.op_n == 3
+
+    def test_runs_cookie_snapshot_gets_footer(self):
+        b = roaring.Bitmap()
+        b.add_many(np.arange(30000, dtype=np.uint64))
+        b.optimize()
+        assert any(c.is_run() for c in b.containers)
+        data = _footered_bytes(b)
+        b2 = roaring.Bitmap.unmarshal(data, verify_body=True)
+        assert b2.footer is not None
+        assert b2.count() == 30000
+        assert not scrub_mod.scrub_buffer(data)["corrupt"]
+
+    def test_frozen_native_writev_path_gets_footer(self, tmp_path):
+        b = _mk_bitmap(20000, seed=9)
+        frozen = b.freeze()
+        p = tmp_path / "snap"
+        with open(p, "wb") as f:
+            roaring.write_frozen(frozen, f, footer=True)
+        raw = p.read_bytes()
+        b2 = roaring.Bitmap.unmarshal(raw, verify_body=True)
+        assert b2.footer is not None
+        assert b2.count() == b.count()
+        v = scrub_mod.scrub_buffer(raw)
+        assert not v["corrupt"] and v["coverage"] == "full"
+
+    def test_body_flip_detected_at_unmarshal_and_scrub(self):
+        b = _mk_bitmap()
+        data = bytearray(_footered_bytes(b))
+        body_len = roaring.Bitmap.unmarshal(bytes(data)).footer.body_len
+        data[body_len - 33] ^= 0x08  # inside a container block
+        with pytest.raises(CorruptionError):
+            roaring.Bitmap.unmarshal(bytes(data), verify_body=True)
+        v = scrub_mod.scrub_buffer(bytes(data))
+        assert v["corrupt"] and v["badBlocks"]
+
+    def test_header_flip_detected_without_body_verify(self):
+        b = _mk_bitmap()
+        data = bytearray(_footered_bytes(b))
+        data[9] ^= 0x01  # keyN/header region
+        with pytest.raises(ValueError):
+            # Either the header crc or the structural parse trips —
+            # both are ValueError, both quarantine at the open path.
+            roaring.Bitmap.unmarshal(bytes(data))
+
+    def test_footer_flip_is_corruption(self):
+        b = _mk_bitmap(50)
+        data = bytearray(_footered_bytes(b))
+        data[-6] ^= 0x40  # inside the footer
+        with pytest.raises(ValueError):
+            roaring.Bitmap.unmarshal(bytes(data))
+
+    def test_torn_footer_reads_as_torn_tail(self):
+        b = _mk_bitmap(50)
+        wire = b.marshal()
+        data = _footered_bytes(b)
+        torn = data[:len(wire) + 7]  # magic + 3 bytes: truncated at EOF
+        b2 = roaring.Bitmap.unmarshal(torn, tolerate_torn_tail=True)
+        assert b2.torn_bytes == 7
+        assert (b2.values() == b.values()).all()
+        with pytest.raises(integrity.TornFooterError):
+            roaring.Bitmap.unmarshal(torn)
+        v = scrub_mod.scrub_buffer(torn)
+        assert not v["corrupt"] and v["walTornBytes"] == 7
+
+    def test_wal_tail_checksum_flip_is_corrupt_in_scrub(self):
+        b = _mk_bitmap(50)
+        buf = io.BytesIO()
+        b.write_to(buf, footer=True)
+        buf.write(roaring.Op(roaring.OP_ADD, 1).marshal())
+        buf.write(roaring.Op(roaring.OP_ADD, 2).marshal())
+        data = bytearray(buf.getvalue())
+        data[-20] ^= 0x04  # first wal record's value bytes
+        v = scrub_mod.scrub_buffer(bytes(data))
+        assert v["corrupt"] and v["walBad"] >= 1
+
+    def test_wal_partial_trailing_record_is_a_tear(self):
+        b = _mk_bitmap(50)
+        buf = io.BytesIO()
+        b.write_to(buf, footer=True)
+        buf.write(roaring.Op(roaring.OP_ADD, 1).marshal())
+        buf.write(b"\x00\x01\x02")  # 3 bytes of a next record
+        v = scrub_mod.scrub_buffer(buf.getvalue())
+        assert not v["corrupt"]
+        assert v["walRecords"] == 1 and v["walTornBytes"] == 3
+
+
+# -- the corrupt failpoint mode ----------------------------------------------
+
+
+class TestCorruptFailpoint:
+    def teardown_method(self):
+        failpoints.disarm_all()
+
+    def test_spec_parses(self):
+        fp = failpoints.parse_spec("storage.read", "corrupt")
+        assert fp.mode == "corrupt" and fp.arg == 1
+        fp = failpoints.parse_spec("storage.read", "corrupt(3)*2")
+        assert fp.arg == 3 and fp.remaining == 2
+        with pytest.raises(ValueError):
+            failpoints.parse_spec("storage.read", "corrupt(0)")
+
+    def test_flips_exactly_n_bits_and_proceeds(self, tmp_path):
+        p = tmp_path / "f"
+        p.write_bytes(bytes(1024))
+        failpoints.arm("storage.read", "corrupt(3)*1")
+        failpoints.default().hit("storage.read", path=str(p))
+        after = np.frombuffer(p.read_bytes(), dtype=np.uint8)
+        flipped = int(np.unpackbits(after).sum())
+        assert 1 <= flipped <= 3  # same-offset re-flips may cancel
+        assert failpoints.ACTIVE is None, "*1 auto-disarmed"
+
+    def test_missing_path_is_a_noop(self, tmp_path):
+        failpoints.arm("storage.read", "corrupt*1")
+        failpoints.default().hit("storage.read",
+                                 path=str(tmp_path / "absent"))
+        # no exception; the trigger was still consumed
+
+
+# -- fragment quarantine machinery -------------------------------------------
+
+
+@pytest.fixture
+def frag_dir(tmp_path):
+    q = QuarantineRegistry()
+
+    def make(name="0", n_bits=800):
+        f = Fragment(str(tmp_path / name), "i", "f", "standard", 0,
+                     quarantine=q)
+        f.open()
+        for i in range(n_bits):
+            f.set_bit(3, (i * 7) % SLICE_WIDTH)
+        f.snapshot(sync=True)
+        return f
+    yield q, make
+    failpoints.disarm_all()
+
+
+class TestFragmentQuarantine:
+    def test_clean_cycle(self, frag_dir):
+        q, make = frag_dir
+        f = make()
+        assert not f.quarantined
+        v = f.verify_on_disk()
+        assert not v["corrupt"] and v["coverage"] == "full"
+        assert f.storage.footer is not None
+        f.close()
+
+    def test_open_detects_raw_flip_resets_and_registers(self, frag_dir):
+        q, make = frag_dir
+        f = make()
+        path, count = f.path, f.row(3).count()
+        f.close()
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0x10
+        open(path, "wb").write(bytes(raw))
+        f2 = Fragment(path, "i", "f", "standard", 0, quarantine=q)
+        f2.open()
+        assert f2.quarantined and q.slice_blocked("i", 0)
+        assert os.path.exists(path + ".corrupt")
+        # fresh replacement: writes still apply + WAL durable
+        assert f2.set_bit(9, 42)
+        assert f2.storage.footer is not None
+        # sentinel: reopen BEFORE repair stays quarantined
+        f2.close()
+        f3 = Fragment(path, "i", "f", "standard", 0,
+                      quarantine=QuarantineRegistry())
+        f3.open()
+        assert f3.quarantined, "restart must not serve the near-empty" \
+                               " replacement as authoritative"
+        f3.clear_quarantine()
+        assert not os.path.exists(path + ".corrupt")
+        f3.close()
+        del count
+
+    def test_lazy_first_read_verify_detects_rot_under_mmap(self,
+                                                           frag_dir):
+        """Rot landing AFTER a clean open (the mmap-fault scenario):
+        the first read re-checks the block crc table and quarantines."""
+        q, make = frag_dir
+        f = make()
+        f.close()
+        f = Fragment(f.path, "i", "f", "standard", 0, quarantine=q)
+        f.open()  # clean: body digest passes, lazy latch armed
+        assert f._verify_pending
+        info = f.storage.footer
+        off = int(info.offsets[0]) + 2  # inside the first block
+        with open(f.path, "r+b") as raw:
+            raw.seek(off)
+            byte = raw.read(1)[0]
+            raw.seek(off)
+            raw.write(bytes([byte ^ 0x20]))
+        with pytest.raises(CorruptionError):
+            f.row(3)
+        assert f.quarantined and q.slice_blocked("i", 0)
+        f.close()
+
+    def test_scrub_leg_detects_and_quarantines(self, frag_dir):
+        q, make = frag_dir
+        f = make()
+        failpoints.arm("storage.read", "corrupt*1")
+        v = f.verify_on_disk()
+        assert v["corrupt"] and f.quarantined
+        f.close()
+
+    def test_snapshot_write_corrupt_mode_rots_the_file(self, frag_dir):
+        """corrupt at snapshot.write flips bits in the JUST-WRITTEN
+        snapshot — nothing fails at the write (real bit rot); the
+        scrub pass catches it after."""
+        q, make = frag_dir
+        f = make()
+        failpoints.arm("snapshot.write", "corrupt*1")
+        f.snapshot(sync=True)
+        failpoints.disarm_all()
+        v = f.verify_on_disk()
+        assert v["corrupt"] and f.quarantined
+        f.close()
+
+    def test_scrub_vs_concurrent_writes_no_false_positives(self,
+                                                           frag_dir):
+        """The race leg: verify_on_disk re-reads the file while a
+        writer hammers the WAL — the append-only prefix discipline
+        must never misread an in-flight append as corruption."""
+        q, make = frag_dir
+        f = make()
+        stop = threading.Event()
+        errors: list = []
+
+        def writer():
+            i = 0
+            try:
+                while not stop.is_set():
+                    f.set_bit(5, i % SLICE_WIDTH)
+                    i += 1
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(25):
+                v = f.verify_on_disk()
+                assert not v["corrupt"], v
+        finally:
+            stop.set()
+            t.join()
+        assert not errors
+        assert not f.quarantined
+        f.close()
+
+    def test_reset_for_repair_preserves_first_forensics(self, frag_dir):
+        q, make = frag_dir
+        f = make()
+        f._set_quarantined("test", site="scrub")
+        f.reset_for_repair()
+        assert f.row_count(3) == 0  # fresh state
+        assert f.quarantined  # repairer clears, not reset
+        f.close()
+
+
+# -- scrubber ------------------------------------------------------------------
+
+
+class TestScrubber:
+    def test_pass_detects_and_fires_callback(self, tmp_path):
+        from pilosa_tpu.models.holder import Holder
+        h = Holder(str(tmp_path))
+        h.open()
+        idx = h.create_index("i")
+        fr = idx.create_frame("f")
+        for col in (1, 5, 9):
+            fr.set_bit("standard", 2, col)
+        frag = h.fragment("i", "f", "standard", 0)
+        frag.snapshot(sync=True)
+        hits: list = []
+        s = scrub_mod.Scrubber(h, interval_s=999, pace_s=0,
+                               on_corrupt=hits.append)
+        out = s.pass_once()
+        assert out["fragments"] >= 1 and out["corrupt"] == 0
+        assert s.stall_age() is None
+        # rot it, scrub again
+        raw = bytearray(open(frag.path, "rb").read())
+        raw[40] ^= 0x02
+        open(frag.path, "wb").write(bytes(raw))
+        out = s.pass_once()
+        assert out["corrupt"] == 1
+        assert hits and hits[0] is frag
+        assert frag.quarantined
+        st = s.state()
+        assert st["corruptionsFound"] == 1 and st["passes"] == 2
+        h.close()
+
+    def test_watchdog_scrub_stall_cause(self):
+        from pilosa_tpu.obs.watchdog import Watchdog
+        wd = Watchdog(scrub_progress_fn=lambda: 42.0,
+                      scrub_stall_s=1.0, wal_stall_s=0,
+                      gossip_silence_s=0, queue_stall_s=0,
+                      deadline_grace_s=0)
+        fired = wd.check()
+        assert any(c == "scrub_stall" for c, _ in fired)
+
+    def test_sampler_corruption_keep_reason(self):
+        from pilosa_tpu.obs.sampler import TailSampler
+        s = TailSampler(head_n=0)
+        ctx = types.SimpleNamespace(flags={"corruption"}, lane="read",
+                                    elapsed=lambda: 0.0)
+        assert s.decide(ctx) == "corruption"
+
+
+# -- serving-layer gates (single node) ----------------------------------------
+
+
+def _post(host, path, body=b"", timeout=30, headers=None):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST",
+                                 headers=headers or {})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _query_raw(host, index, pql, qs=""):
+    return _post(host, f"/index/{index}/query{qs}", pql.encode())
+
+
+@pytest.fixture
+def solo(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_MESH", "0")
+    from pilosa_tpu.server.server import Server
+    from pilosa_tpu.utils.config import ScrubConfig
+    s = Server(str(tmp_path / "solo"), host="127.0.0.1:0",
+               anti_entropy_interval=0, polling_interval=0,
+               scrub_config=ScrubConfig(interval=999.0, pace=0.0,
+                                        repair=False))
+    s.open()
+    _post(s.host, "/index/it", b"{}")
+    _post(s.host, "/index/it/frame/f", b"{}")
+    _query_raw(s.host, "it", 'SetBit(frame="f", rowID=1, columnID=3)')
+    _query_raw(s.host, "it", 'SetBit(frame="f", rowID=1, columnID=9)')
+    yield s
+    failpoints.disarm_all()
+    s.close()
+
+
+class TestServingGates:
+    def _quarantine(self, s):
+        frag = s.holder.fragment("it", "f", "standard", 0)
+        frag._set_quarantined("test corruption", site="scrub")
+        return frag
+
+    def test_quarantined_single_node_answers_503_not_wrong(self, solo):
+        s = solo
+        got = json.loads(_query_raw(
+            s.host, "it", 'Count(Bitmap(frame="f", rowID=1))').read())
+        assert got["results"][0] == 2
+        self._quarantine(s)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _query_raw(s.host, "it",
+                       'Count(Bitmap(frame="f", rowID=1))')
+        assert ei.value.code == 503
+
+    def test_partial_contract_reports_quarantined_slice(self, solo):
+        s = solo
+        self._quarantine(s)
+        resp = _query_raw(s.host, "it",
+                          'Count(Bitmap(frame="f", rowID=1))',
+                          qs="?partial=1")
+        assert resp.status == 200
+        assert resp.headers.get("X-Pilosa-Partial") == "0"
+        assert json.loads(resp.read())["results"][0] == 0
+
+    def test_writes_keep_applying_while_quarantined(self, solo):
+        s = solo
+        frag = self._quarantine(s)
+        _query_raw(s.host, "it",
+                   'SetBit(frame="f", rowID=7, columnID=1)')
+        assert frag.row_count(7) == 1  # WAL-buffered locally
+
+    def test_fragment_routes_409_and_antientropy_skip(self, solo):
+        s = solo
+        frag = self._quarantine(s)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{s.host}/fragment/blocks?index=it&frame=f"
+                f"&view=standard&slice=0", timeout=10)
+        assert ei.value.code == 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{s.host}/fragment/data?index=it&frame=f"
+                f"&view=standard&slice=0", timeout=10)
+        assert ei.value.code == 409
+        # the local syncer never lets the copy vote
+        from pilosa_tpu.server.syncer import FragmentSyncer
+        calls: list = []
+
+        class _Boom:
+            def __init__(self, host):
+                calls.append(host)
+        FragmentSyncer(frag, s.host, s.cluster,
+                       client_factory=_Boom).sync_fragment()
+        assert not calls, "quarantined fragment must not sync"
+
+    def test_debug_integrity_and_health_surfaces(self, solo):
+        s = solo
+        out = json.loads(urllib.request.urlopen(
+            f"http://{s.host}/debug/integrity", timeout=10).read())
+        assert out["quarantined"] == []
+        assert out["coverage"]["footered"] >= 1
+        assert "scrub" in out
+        frag = self._quarantine(s)
+        out = json.loads(urllib.request.urlopen(
+            f"http://{s.host}/debug/integrity", timeout=10).read())
+        assert out["quarantined"][0]["slice"] == 0
+        assert out["quarantined"][0]["reason"] == "test corruption"
+        # POST ?sync=1 runs a pass inline (skips quarantined frags)
+        out = json.loads(_post(
+            s.host, "/debug/integrity/scrub?sync=1").read())
+        assert "fragments" in out
+        # /health: single node + quarantine = not ready (no replica)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{s.host}/health",
+                                   timeout=10)
+        assert ei.value.code == 503
+        checks = json.loads(ei.value.read())["checks"]
+        assert checks["storage"]["ok"] is False
+        frag.clear_quarantine()
+        ok = json.loads(urllib.request.urlopen(
+            f"http://{s.host}/health", timeout=10).read())
+        assert ok["checks"]["storage"]["ok"] is True
+
+
+# -- in-process repair cycle (2 nodes, replicas=2) ----------------------------
+
+
+@pytest.fixture
+def duo(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_MESH", "0")
+    from pilosa_tpu.cluster.client import Client
+    from pilosa_tpu.cluster.topology import Node
+    from pilosa_tpu.server.server import Server
+    from pilosa_tpu.utils.config import ScrubConfig
+    servers = []
+
+    def make(name):
+        s = Server(str(tmp_path / name), host="127.0.0.1:0",
+                   anti_entropy_interval=0, polling_interval=0,
+                   scrub_config=ScrubConfig(interval=999.0, pace=0.0,
+                                            repair=False))
+        s.open()
+        servers.append(s)
+        return s
+
+    s1, s2 = make("n1"), make("n2")
+    for s in servers:
+        s.cluster.nodes = [Node(s1.host), Node(s2.host)]
+        s.cluster.replica_n = 2
+    for h in (s1.host, s2.host):
+        _post(h, "/index/rp", b"{}")
+        _post(h, "/index/rp/frame/f", b"{}")
+    rng = np.random.default_rng(11)
+    rows = rng.integers(0, 6, 1500).astype(np.uint64)
+    cols = rng.choice(2 * SLICE_WIDTH, size=1500,
+                      replace=False).astype(np.uint64)
+    Client(s1.host).import_arrays("rp", "f", rows, cols)
+    model: dict = {}
+    for r, c in zip(rows.tolist(), cols.tolist()):
+        model.setdefault(int(r), set()).add(int(c))
+    yield (s1, s2), model
+    failpoints.disarm_all()
+    for s in servers:
+        try:
+            s.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+
+
+class TestRepair:
+    def _counts_ok(self, host, model):
+        for row in range(6):
+            got = json.loads(_query_raw(
+                host, "rp",
+                f'Count(Bitmap(frame="f", rowID={row}))').read())
+            assert got["results"][0] == len(model.get(row, set())), row
+
+    def test_detect_failover_repair_cycle(self, duo):
+        (s1, s2), model = duo
+        self._counts_ok(s1.host, model)
+        frag = s1.holder.fragment("rp", "f", "standard", 0)
+        frag.snapshot(sync=True)
+        # rot s1's slice-0 copy on disk, scrub-detect it
+        raw = bytearray(open(frag.path, "rb").read())
+        raw[len(raw) // 3] ^= 0x40
+        open(frag.path, "wb").write(bytes(raw))
+        v = frag.verify_on_disk()
+        assert v["corrupt"] and frag.quarantined
+
+        # reads fail over to s2's replica: every answer still exact
+        self._counts_ok(s1.host, model)
+        self._counts_ok(s2.host, model)
+
+        # repair re-streams from the replica and un-quarantines
+        from pilosa_tpu.server.repair import Repairer
+        rep = Repairer(s1.holder, s1.cluster, s1.host,
+                       client_factory=s1._client_factory,
+                       fault=s1.fault)
+        assert rep.repair_fragment(frag) == "repaired"
+        assert not frag.quarantined
+        assert not s1.holder.quarantine.slice_blocked("rp", 0)
+        assert not os.path.exists(frag.path + ".corrupt")
+        v = frag.verify_on_disk()
+        assert not v["corrupt"]
+        # local copy answers exactly again (local fast paths back on)
+        self._counts_ok(s1.host, model)
+        # and the repaired content equals the replica's, block by block
+        f2 = s2.holder.fragment("rp", "f", "standard", 0)
+        assert dict(frag.blocks()) == dict(f2.blocks())
+
+    def test_missing_source_fragment_never_counts_as_converged(
+            self, duo):
+        """Review regression: stream_fragment answers (0, 0) for a
+        MISSING source too — a peer that never materialized the
+        fragment must NOT let the repairer un-quarantine the fresh
+        empty replacement as authoritative (a silent wrong answer)."""
+        (s1, s2), model = duo
+        frag = s1.holder.fragment("rp", "f", "standard", 0)
+        frag._set_quarantined("test", site="scrub")
+        # Drop the replica's copy of this exact fragment.
+        v2 = s2.holder.index("rp").frame("f").view("standard")
+        f2 = v2.fragments.pop(0)
+        f2.close()
+        from pilosa_tpu.server.repair import Repairer
+        rep = Repairer(s1.holder, s1.cluster, s1.host,
+                       client_factory=s1._client_factory,
+                       fault=s1.fault)
+        assert rep.repair_fragment(frag) == "failed"
+        assert frag.quarantined, \
+            "no source content: must stay quarantined"
+        v2.fragments[0] = f2
+        f2.open()
+
+    def test_no_replica_outcome(self, duo):
+        (s1, s2), model = duo
+        frag = s1.holder.fragment("rp", "f", "standard", 0)
+        frag._set_quarantined("test", site="scrub")
+        from pilosa_tpu.cluster.topology import Node
+        from pilosa_tpu.server.repair import Repairer
+        s1.cluster.nodes = [Node(s1.host)]  # peers gone
+        rep = Repairer(s1.holder, s1.cluster, s1.host,
+                       client_factory=s1._client_factory)
+        assert rep.repair_fragment(frag) == "no_replica"
+        assert frag.quarantined, "stays quarantined: partial contract"
+
+    def test_writes_during_quarantine_survive_repair(self, duo):
+        """Acked writes fan to every replica owner, so content written
+        WHILE the local copy is quarantined comes home with the
+        re-stream."""
+        (s1, s2), model = duo
+        frag = s1.holder.fragment("rp", "f", "standard", 0)
+        frag._set_quarantined("test", site="scrub")
+        _query_raw(s1.host, "rp",
+                   'SetBit(frame="f", rowID=50, columnID=123)')
+        model.setdefault(50, set()).add(123)
+        from pilosa_tpu.server.repair import Repairer
+        rep = Repairer(s1.holder, s1.cluster, s1.host,
+                       client_factory=s1._client_factory,
+                       fault=s1.fault)
+        assert rep.repair_fragment(frag) == "repaired"
+        assert frag.row_count(50) == 1
+        self._counts_ok(s1.host, model)
+
+
+# -- client 507 retry (satellite) ---------------------------------------------
+
+
+class TestImport507Retry:
+    def test_import_retries_507_honoring_retry_after(self, monkeypatch):
+        """A mid-import ENOSPC on a peer (PR-14 write-unready) is as
+        transient as an admission shed: wait it out like a 429
+        instead of failing the import."""
+        from pilosa_tpu.cluster.client import Client
+        c = Client("peer:1")
+        script = [(507, b"full", [("Retry-After", "0.01")]),
+                  (507, b"full", [("Retry-After", "0.01")]),
+                  (200, b"", [])]
+        calls: list = []
+
+        def fake_do(method, path, body=None, headers=None, host=None,
+                    idempotent=None, deadline_s=None,
+                    headers_out=None):
+            status, raw, hs = script[len(calls)]
+            calls.append(path)
+            if headers_out is not None:
+                headers_out.extend(hs)
+            return status, raw
+
+        sleeps: list = []
+        monkeypatch.setattr(c, "_do", fake_do)
+        monkeypatch.setattr(time, "sleep", sleeps.append)
+        status, _ = c._do_429("POST", "/import", b"x", {}, None)
+        assert status == 200
+        assert len(calls) == 3 and len(sleeps) == 2
+        assert all(s >= 0.01 for s in sleeps)
+
+    def test_507_bounded_by_budget(self, monkeypatch):
+        from pilosa_tpu.cluster.client import Client
+        c = Client("peer:1", timeout=0.05)
+
+        def always_507(method, path, body=None, headers=None,
+                       host=None, idempotent=None, deadline_s=None,
+                       headers_out=None):
+            if headers_out is not None:
+                headers_out.append(("Retry-After", "100"))
+            return 507, b"full"
+
+        monkeypatch.setattr(c, "_do", always_507)
+        t0 = time.perf_counter()
+        status, _ = c._do_429("POST", "/import", b"x", {}, None)
+        assert status == 507
+        assert time.perf_counter() - t0 < 1.0
+
+
+# -- config / CLI --------------------------------------------------------------
+
+
+class TestConfigSurfaces:
+    def test_toml_env_round_trip(self, tmp_path):
+        from pilosa_tpu.utils import config as config_mod
+        p = tmp_path / "c.toml"
+        p.write_text("""
+[scrub]
+enabled = false
+interval = "30s"
+pace = "0.5s"
+repair = false
+repair-rescan = "5s"
+
+[watchdog]
+scrub-stall = "45s"
+""")
+        cfg = config_mod.load(str(p), env={})
+        assert cfg.scrub.enabled is False
+        assert cfg.scrub.interval == 30.0 and cfg.scrub.pace == 0.5
+        assert cfg.scrub.repair is False
+        assert cfg.scrub.repair_rescan == 5.0
+        assert cfg.watchdog.scrub_stall == 45.0
+        cfg2 = config_mod.load("", env={
+            "PILOSA_SCRUB_ENABLED": "0",
+            "PILOSA_SCRUB_INTERVAL": "12s",
+            "PILOSA_SCRUB_PACE": "0.25s",
+            "PILOSA_WATCHDOG_SCRUB_STALL": "9s"})
+        assert cfg2.scrub.enabled is False
+        assert cfg2.scrub.interval == 12.0
+        assert cfg2.scrub.pace == 0.25
+        assert cfg2.watchdog.scrub_stall == 9.0
+        # the default config's to_toml parses back
+        out = config_mod.Config().to_toml()
+        assert "[scrub]" in out and "scrub-stall" in out
+
+    def test_cli_check_deep_and_inspect(self, tmp_path):
+        from pilosa_tpu.cli.commands import main
+        # build a mini data-dir shape with one good + one rotten file
+        d = tmp_path / "data" / "i" / "f" / "views" / "standard" \
+            / "fragments"
+        d.mkdir(parents=True)
+        good = _mk_bitmap(200, seed=1)
+        (d / "0").write_bytes(_footered_bytes(good))
+        bad = bytearray(_footered_bytes(_mk_bitmap(200, seed=2)))
+        bad[len(bad) // 2] ^= 0x01
+        (d / "1").write_bytes(bytes(bad))
+        out, err = io.StringIO(), io.StringIO()
+        rc = main(["check", "--deep", str(tmp_path / "data")],
+                  stdout=out, stderr=err)
+        assert rc == 1
+        text = out.getvalue()
+        assert "CORRUPT" in text and "full coverage" in text
+        assert "checked 2 fragments: 1 corrupt" in text
+        # clean dir exits 0
+        out2 = io.StringIO()
+        (d / "1").write_bytes(_footered_bytes(_mk_bitmap(200, seed=2)))
+        rc = main(["check", "--deep", str(tmp_path / "data")],
+                  stdout=out2, stderr=err)
+        assert rc == 0
+        # inspect prints coverage
+        out3 = io.StringIO()
+        rc = main(["inspect", str(d / "0")], stdout=out3, stderr=err)
+        assert rc == 0
+        assert "Checksums: footer v1" in out3.getvalue()
+        # vintage file: coverage "none" but ok
+        (d / "0").write_bytes(good.marshal())
+        out4 = io.StringIO()
+        rc = main(["check", "--deep", str(d / "0")], stdout=out4,
+                  stderr=err)
+        assert rc == 0
+        assert "none coverage" in out4.getvalue()
