@@ -79,6 +79,7 @@ _CANONICAL_ARTIFACTS = {
     "host_baselines": "HOST_BASELINE.json",
     "latency_under_load": "LATENCY.json",
     "tenant_isolation": "TENANTS.json",
+    "tiered": "TIERED.json",
 }
 
 
@@ -216,6 +217,10 @@ def write_manifest(partial: bool = False) -> None:
     out["tenant_isolation"] = (_TENANT_ISOLATION
                                or prior_doc.get("tenant_isolation",
                                                 {}))
+    # Tiered storage (config_tiered): hot-working-set p99 with the
+    # index 10× over the resident budget (bulk in the blob tier) vs
+    # all-resident, zero wrong answers — ISSUE 16's acceptance table.
+    out["tiered"] = _TIERED or prior_doc.get("tiered", {})
     measured = _roofline_measured() or prior_doc.get(
         "roofline_measured_constants")
     if measured:
@@ -276,6 +281,12 @@ _RESIZE: dict = {}
 # quiet tenant's p99 with an aggressor at ≥3× its admission cap vs its
 # solo baseline, interleaved, with the aggressor's shed/kill counts.
 _TENANT_ISOLATION: dict = {}
+
+# Tiered-storage acceptance table captured by config_tiered() —
+# folded into MANIFEST.json's tiered section and written to
+# TIERED.json (ISSUE 16: hot-working-set p99 ≤ 1.2× all-resident
+# while the index is ≥ 10× the resident budget, zero wrong answers).
+_TIERED: dict = {}
 
 
 # Fresh-process measurement: each slice config restarts python, arms
@@ -2875,6 +2886,164 @@ def config_tenant_isolation() -> None:
         td.cleanup()
 
 
+def config_tiered() -> None:
+    """ISSUE 16 acceptance artifact: the tiered-storage working-set
+    manager serving an index ≥ 10× the resident budget.
+
+    Build a bulk of fragments plus a small working set, snapshot
+    everything, and measure the working-set Count p50/p99 through the
+    executor twice: leg A all-resident (the baseline), leg B after
+    demoting EVERYTHING cold and pushing the bulk into the blob tier
+    — so local residency starts at zero, the first probe pays the
+    blob fetch + block faults (reported as first_ms), and the warm
+    window runs with the manager's eviction/retry pass interleaved
+    under a budget of total/10. Every probe differential-checks its
+    count against the build-time model: zero wrong answers is an
+    assertion, not a hope. Folds into MANIFEST.json `tiered` and
+    writes TIERED.json."""
+    import statistics
+    import tempfile
+
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.tier.manager import TierManager
+
+    n_bulk = max(10, int(30 * SCALE))
+    n_ws = 2
+    n_rows, per_row = 4, 20000
+    probes_resident = max(60, int(200 * SCALE))
+    probes_tiered = max(90, int(300 * SCALE))
+
+    td = tempfile.TemporaryDirectory()
+    holder = Holder(os.path.join(td.name, "data"))
+    holder.open()
+    ex = Executor(holder, host="local", use_mesh=False)
+    try:
+        rng = np.random.default_rng(16)
+        model: dict = {}
+        frags: dict = {}
+        names = [f"bulk{i}" for i in range(n_bulk)] + \
+                [f"ws{i}" for i in range(n_ws)]
+        for name in names:
+            idx = holder.create_index(name)
+            view = idx.create_frame("f").create_view_if_not_exists(
+                "standard")
+            frag = view.create_fragment_if_not_exists(0)
+            rows_np, cols_np, counts = [], [], {}
+            for r in range(n_rows):
+                cols = np.unique(rng.integers(
+                    0, 1 << 20, size=per_row)).astype(np.uint64)
+                rows_np.append(np.full(len(cols), r, np.uint64))
+                cols_np.append(cols)
+                counts[r] = len(cols)
+            frag.import_bits(np.concatenate(rows_np),
+                             np.concatenate(cols_np))
+            model[name] = counts
+            frags[name] = frag
+        total_bytes = sum(os.path.getsize(f.path)
+                          for f in frags.values())
+        budget = total_bytes // 10
+        ws_bytes = sum(os.path.getsize(frags[f"ws{i}"].path)
+                       for i in range(n_ws))
+
+        wrong: list = []
+
+        def probe(i: int) -> float:
+            name = f"ws{i % n_ws}"
+            r = (i // n_ws) % n_rows
+            t0 = time.perf_counter()
+            got = ex.execute(
+                name, f'Count(Bitmap(frame="f", rowID={r}))')[0]
+            dt = (time.perf_counter() - t0) * 1e3
+            if got != model[name][r]:
+                wrong.append((name, r, got))
+            return dt
+
+        def pct(xs, p):
+            xs = sorted(xs)
+            return xs[min(len(xs) - 1, int(p * len(xs)))]
+
+        probe(0)  # warm the executor path once
+        resident = [probe(i) for i in range(probes_resident)]
+
+        mgr = TierManager(
+            holder, resident_budget=budget, high_watermark=0.9,
+            low_watermark=0.7, idle_s=30.0, blob_idle_s=60.0,
+            cold_dir=os.path.join(td.name, "_tier"), blob="dir",
+            pace_s=0.0)
+        holder.tier = mgr
+        mgr.sync()
+        for frag in frags.values():
+            frag.demote_cold()
+        for i in range(n_bulk):
+            mgr.push_blob(frags[f"bulk{i}"])
+        local_bytes = sum(
+            os.path.getsize(f.path) for f in frags.values()
+            if os.path.exists(f.path))
+
+        first_ms = probe(0)  # pays the blob fetch + block faults
+        for i in range(n_ws):
+            # The prefetcher's move for a known-hot working set:
+            # promote fully so the warm window measures the resident
+            # fast path, not a long cold-fault ramp.
+            frags[f"ws{i}"].promote(trigger="prefetch")
+        tiered = []
+        for i in range(probes_tiered):
+            if i % 50 == 25:
+                mgr.pass_once()  # eviction pressure stays live
+            tiered.append(probe(i))
+
+        assert not wrong, f"WRONG ANSWERS: {wrong[:5]}"
+        res_p50, res_p99 = statistics.median(resident), pct(resident,
+                                                            0.99)
+        t_p50, t_p99 = statistics.median(tiered), pct(tiered, 0.99)
+        ratio = t_p99 / max(res_p99, 1e-9)
+        oversub = total_bytes / max(budget, 1)
+        assert oversub >= 10.0, f"index only {oversub:.1f}× budget"
+        assert ratio <= 1.2, (
+            f"hot working-set p99 {t_p99:.3f}ms is {ratio:.2f}× the"
+            f" all-resident {res_p99:.3f}ms (target ≤ 1.2×)")
+        st = mgr.state()
+        table = {
+            "total_bytes": total_bytes,
+            "resident_budget_bytes": budget,
+            "oversubscription": round(oversub, 2),
+            "working_set_bytes": ws_bytes,
+            "local_bytes_after_blob_push": local_bytes,
+            "fragments_bulk": n_bulk,
+            "fragments_ws": n_ws,
+            "resident_p50_ms": round(res_p50, 4),
+            "resident_p99_ms": round(res_p99, 4),
+            "tiered_p50_ms": round(t_p50, 4),
+            "tiered_p99_ms": round(t_p99, 4),
+            "tiered_first_probe_ms": round(first_ms, 3),
+            "p99_ratio": round(ratio, 3),
+            "p99_ratio_target": 1.2,
+            "p99_ratio_pass": ratio <= 1.2,
+            "zero_wrong_answers": True,
+            "samples_resident": len(resident),
+            "samples_tiered": len(tiered),
+            "blob_pushes": st["blobPushes"],
+            "blob_fetches": st["blobFetches"],
+            "promotions": st["promotions"],
+            "demotions": st["demotions"],
+        }
+        _TIERED.update(table)
+        emit("tiered_hot_ws_p99", t_p99, "ms", first_ms=round(
+            first_ms, 3), **{k: v for k, v in table.items()
+                             if k != "tiered_p99_ms"})
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "TIERED.json")
+        with open(path, "w") as f:
+            json.dump({"written_by": "benchmarks/suite.py"
+                                     " config_tiered",
+                       "scale": SCALE, **table}, f, indent=1)
+    finally:
+        ex.close()
+        holder.close()
+        td.cleanup()
+
+
 def main(argv: Optional[list] = None) -> None:
     """Full pass by default; ``suite.py <config_name>...`` runs just
     the named configs (e.g. ``suite.py config_write_path``) and folds
@@ -2899,6 +3068,7 @@ def main(argv: Optional[list] = None) -> None:
                config_distributed_topn,
                config_resize,
                config_tenant_isolation,
+               config_tiered,
                config_obs_overhead,
                config_obs_history,
                config_scrub_overhead,

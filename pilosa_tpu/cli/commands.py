@@ -325,7 +325,9 @@ def cmd_server(args, stdout, stderr) -> int:
                     resize_grace_s=cfg.cluster.resize_grace,
                     history_config=cfg.history,
                     sentinel_config=cfg.sentinel,
-                    tenants_config=cfg.tenants)
+                    tenants_config=cfg.tenants,
+                    scrub_config=cfg.scrub,
+                    tier_config=cfg.tier)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -495,19 +497,66 @@ def _fragment_files(path: str) -> list[str]:
     return out
 
 
+def _blob_stubs(path: str) -> list[str]:
+    """``<slice>.blob`` stub files under a data dir — fragments whose
+    bytes live in the blob tier (pilosa_tpu.tier)."""
+    if not os.path.isdir(path):
+        return [path] if path.endswith(".blob") else []
+    out = []
+    for root, _dirs, files in os.walk(path):
+        if os.path.basename(root) != "fragments":
+            continue
+        for name in sorted(files):
+            if name.endswith(".blob") and name[:-5].isdigit():
+                out.append(os.path.join(root, name))
+    return out
+
+
+def _blob_store_for(stub_path: str):
+    """Resolve the blob store a stub's objects live in: the
+    PILOSA_TIER_BLOB / PILOSA_TIER_COLD_DIR env settings when present
+    (the same knobs the server reads), else the default layout — a
+    ``_tier/blob`` dir under an ancestor of the stub (the data dir).
+    Returns None when no store can be located."""
+    from ..tier import blob as blob_mod
+    spec = os.environ.get("PILOSA_TIER_BLOB", "")
+    cold = os.environ.get("PILOSA_TIER_COLD_DIR", "")
+    if spec.startswith("dir:"):
+        return blob_mod.LocalDirBlobStore(spec[len("dir:"):])
+    if cold and os.path.isdir(os.path.join(cold, "blob")):
+        return blob_mod.LocalDirBlobStore(os.path.join(cold, "blob"))
+    probe = os.path.dirname(os.path.abspath(stub_path))
+    for _ in range(8):
+        root = os.path.join(probe, "_tier", "blob")
+        if os.path.isdir(root):
+            return blob_mod.LocalDirBlobStore(root)
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    return None
+
+
 def _check_deep(args, stdout) -> int:
     """Offline storage scrub (the CLI face of storage.scrub): verify
     every snapshot footer (per-block crc32 table + whole-body digest)
     and WAL-tail FNV checksums under the given data dirs / files, one
     verdict line per fragment; nonzero exit on ANY corruption.
     ``.corrupt`` aside files (quarantine forensics / pending-repair
-    sentinels) are reported too."""
+    sentinels) are reported too. Blob-tier stubs (``<slice>.blob``)
+    are walked as well: each fragment's blob objects verify against
+    the manifest crcs + reassembled footer digest — cold-tier files
+    are ordinary footered snapshots and take the normal lane."""
+    import json as _json
+
     from ..storage import scrub as scrub_mod
+    from ..tier import blob as blob_mod
     rc = 0
     n = corrupt = vintage = 0
     for path in args.paths:
         files = _fragment_files(path)
-        if not files:
+        stubs = _blob_stubs(path)
+        if not files and not stubs:
             print(f"{path}: no fragment files found", file=stdout)
         for f in files:
             n += 1
@@ -531,6 +580,35 @@ def _check_deep(args, stdout) -> int:
             if os.path.exists(f + ".corrupt"):
                 print(f"{f}.corrupt: quarantine forensics present"
                       f" (fragment pending repair)", file=stdout)
+        for s in stubs:
+            n += 1
+            try:
+                with open(s, "r", encoding="utf-8") as fh:
+                    stub = _json.load(fh)
+                prefix = stub["prefix"]
+            except (OSError, ValueError, KeyError) as e:
+                corrupt += 1
+                rc = 1
+                print(f"{s}: CORRUPT: unreadable blob stub: {e}",
+                      file=stdout)
+                continue
+            store = _blob_store_for(s)
+            if store is None:
+                # Stub without a reachable store (remote spec, moved
+                # dir): report presence, don't guess at a verdict.
+                print(f"{s}: blob stub ({stub.get('size', '?')}B at"
+                      f" {prefix}; no local blob store found —"
+                      f" skipped)", file=stdout)
+                continue
+            v = blob_mod.verify_fragment(store, prefix)
+            if v.get("corrupt"):
+                corrupt += 1
+                rc = 1
+                print(f"{s}: CORRUPT (blob {prefix}):"
+                      f" {v.get('error')}", file=stdout)
+            else:
+                print(f"{s}: ok (blob tier, {v.get('blocks', 0)}"
+                      f" blocks at {prefix})", file=stdout)
     print(f"checked {n} fragments: {corrupt} corrupt,"
           f" {vintage} without footers", file=stdout)
     return rc

@@ -565,6 +565,14 @@ class Executor:
             # claim a fast path — its bytes (or its fresh post-reset
             # replacement) cannot be trusted to answer.
             return False
+        tier = getattr(self.holder, "tier", None)
+        if tier is not None and any(
+                tier.slice_blocked(index, s) for s in slices):
+            # Tiered storage: a blob-tier fragment whose cold fetch
+            # keeps failing has NO local bytes — the slice must fail
+            # over (or degrade per the partial contract), same as a
+            # quarantine.
+            return False
         if (len(self.cluster.nodes) == 1
                 and self.cluster.resize is None):
             return True
@@ -1268,11 +1276,43 @@ class Executor:
                 return bsi.combine_sum(prev, v)
             return bsi.combine_min_max(prev, v, want_min=want_min)
 
-        local_fn = (self._sum_local_device_fn(index, frame_name, field,
-                                              child, opt)
-                    if name == "Sum" else None)
+        device_fn = (self._sum_local_device_fn(index, frame_name,
+                                               field, child, opt)
+                     if name == "Sum" else None)
+
+        def local_host_fn(batch_slices):
+            # Whole-owned-slice pushdown (the TopN exact-partial leg
+            # shape): the node leg answers ONE (sum,count) / min/max
+            # partial computed in a single batched plane fold
+            # (bsi.sum_count_many / min_max_many) instead of fanning
+            # per-slice map tasks and reducing their ValCounts — on a
+            # peer this is what the forwarded leg runs, so remote legs
+            # are one partial each end to end.
+            if device_fn is not None:
+                r = device_fn(batch_slices)
+                if r is not NotImplemented:
+                    return r
+            if (self.pod is not None and self.pod.is_coordinator
+                    and not opt.pod_local):
+                return NotImplemented  # pod fan-out is not a host leg
+            legs = []
+            for s in batch_slices:
+                frag = self.holder.fragment(index, frame_name,
+                                            field.view_name, s)
+                if frag is None:
+                    continue
+                filt = (self._bitmap_call_slice(index, child, s)
+                        if child is not None else None)
+                legs.append(
+                    (lambda plane, f=frag:
+                     f.row(self._bsi_plane_row(plane)), filt))
+            if name == "Sum":
+                return bsi.sum_count_many(field.min, field.max, legs)
+            return bsi.min_max_many(field.min, field.max, legs,
+                                    want_min=want_min)
+
         result = self._map_reduce(index, slices, c, opt, map_fn,
-                                  reduce_fn, local_fn=local_fn)
+                                  reduce_fn, local_fn=local_host_fn)
         return result or bsi.ValCount(0, 0)
 
     def _sum_local_device_fn(self, index: str, frame_name: str, field,
@@ -2969,6 +3009,13 @@ class Executor:
                     # cache's arrays are equivalent to get() (review
                     # finding: ranked frames returned stale counts).
                     return NotImplemented
+                if getattr(frag, "tier_state", "hot") != "hot":
+                    # TopN ranks through the count cache, which
+                    # demotion drops — a cold/blob fragment must fully
+                    # promote (rebuilding the rank cache) before its
+                    # arrays mean anything, same contract as the
+                    # per-slice fragment.top gate.
+                    frag.promote(trigger="read")
                 # Same lock the per-slice fragment.top path holds:
                 # cache recalculation and the positions walk race
                 # concurrent writers otherwise.
@@ -3560,6 +3607,12 @@ class Executor:
         q = getattr(self.holder, "quarantine", None)
         if q is not None and not len(q):
             q = None
+        # Tiered storage: same skip for slices whose blob-tier
+        # fragments cannot be fetched back (tier.manager blocked set)
+        # — no local bytes exist to serve them.
+        tier = getattr(self.holder, "tier", None)
+        if tier is not None and not tier._blocked_slices:
+            tier = None
         m: dict[int, tuple[Node, list[int]]] = {}
         # Placement ordering memo: PARTITION_N bounds the distinct
         # owner tuples, so a 256-slice query pays ≤16 order_nodes
@@ -3583,6 +3636,9 @@ class Executor:
                         # Tail sampling: a corruption-driven failover
                         # is keep-worthy (obs.sampler "corruption").
                         ctx.note_flag("corruption")
+                    continue
+                if (tier is not None and node.host == self.host
+                        and tier.slice_blocked(index, slice)):
                     continue
                 if any(n is node for n in nodes):
                     m.setdefault(id(node), (node, []))[1].append(slice)

@@ -32,6 +32,11 @@ class Holder:
         # quarantined fragments — the executor's read path consults it,
         # /debug/integrity lists it, and the repairer drains it.
         self.quarantine = QuarantineRegistry()
+        # Tiered storage (pilosa_tpu.tier): the TierManager when the
+        # [tier] config enables it (server.open wires it), else None.
+        # The executor consults tier_blocked alongside the quarantine
+        # registry when deciding whether to serve a slice locally.
+        self.tier = None
         self._mu = threading.RLock()
 
     # -- lifecycle
@@ -163,6 +168,14 @@ class Holder:
                     for view in frame.views.values():
                         for frag in view.fragments.values():
                             frag.flush_cache()
+
+    def tier_blocked(self, index: str, slice: int) -> bool:
+        """True when a blob-tier fragment of (index, slice) cannot be
+        fetched back from the blob store — reads must not be served
+        locally (the tier-side analogue of quarantine.slice_blocked;
+        the executor consults both)."""
+        tier = self.tier
+        return tier is not None and tier.slice_blocked(index, slice)
 
     def iter_fragments(self) -> list:
         """A point-in-time list of every open fragment — the scrub

@@ -79,9 +79,17 @@ class View:
         with self._mu:
             os.makedirs(self.fragments_path, exist_ok=True)
             for entry in sorted(os.listdir(self.fragments_path)):
-                if not entry.isdigit():
+                if entry.endswith(".blob") and entry[:-5].isdigit():
+                    # Blob-tier stub (pilosa_tpu.tier): the data file
+                    # left local disk, but the fragment must stay
+                    # discoverable — Fragment.open recognizes the
+                    # stub and opens in the blob state.
+                    entry = entry[:-5]
+                elif not entry.isdigit():
                     continue
                 slice = int(entry)
+                if slice in self.fragments:
+                    continue
                 frag = self._new_fragment(slice)
                 frag.open()
                 self.fragments[slice] = frag

@@ -266,6 +266,10 @@ class RuntimeCollector:
         obs_metrics.COMPILE_SECONDS.set_total(
             stats.get("compileSeconds", 0.0))
         obs_metrics.COMPILE_PROGRAMS.set(stats.get("programs", 0))
+        fair = mesh_mod.fair_dispatch_state()
+        if fair is not None:
+            stats = dict(stats)
+            stats["fairDispatch"] = fair
         return stats
 
     def _roaring_ops(self) -> dict:

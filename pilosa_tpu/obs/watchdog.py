@@ -27,6 +27,11 @@ Four stall detectors, each cheap enough to run every second:
   is in flight but has verified no fragment for ``scrub_stall_s``: a
   hung disk read or a wedged pacing sleep — the window where silent
   corruption detection is blind.
+- **tier_stall** — the tier working-set manager (tier.manager) has
+  demotion/eviction work pending but has completed no transition for
+  ``tier_stall_s``: a wedged snapshot barrier or a hung blob
+  transfer — the window where watermark pressure keeps building
+  and cold reads stop promoting.
 
 A trip increments ``pilosa_watchdog_trips_total{cause}``, force-keeps
 every in-flight trace (reason ``watchdog`` — the wedged query's spans
@@ -50,10 +55,12 @@ DEFAULT_GOSSIP_SILENCE_S = 60.0
 DEFAULT_QUEUE_STALL_S = 10.0
 DEFAULT_RESIZE_STALL_S = 60.0
 DEFAULT_SCRUB_STALL_S = 300.0
+DEFAULT_TIER_STALL_S = 120.0
 DEFAULT_RETRIP_S = 60.0
 
 CAUSES = ("wal_flusher", "stuck_query", "gossip_silence",
-          "admission_stall", "resize_stall", "scrub_stall")
+          "admission_stall", "resize_stall", "scrub_stall",
+          "tier_stall")
 
 
 class Watchdog:
@@ -63,6 +70,7 @@ class Watchdog:
                  = None,
                  resize_progress_fn: Optional[Callable] = None,
                  scrub_progress_fn: Optional[Callable] = None,
+                 tier_progress_fn: Optional[Callable] = None,
                  interval_s: float = DEFAULT_INTERVAL_S,
                  wal_stall_s: float = DEFAULT_WAL_STALL_S,
                  deadline_grace_s: float = DEFAULT_DEADLINE_GRACE_S,
@@ -70,6 +78,7 @@ class Watchdog:
                  queue_stall_s: float = DEFAULT_QUEUE_STALL_S,
                  resize_stall_s: float = DEFAULT_RESIZE_STALL_S,
                  scrub_stall_s: float = DEFAULT_SCRUB_STALL_S,
+                 tier_stall_s: float = DEFAULT_TIER_STALL_S,
                  retrip_s: float = DEFAULT_RETRIP_S, logger=None):
         from ..utils import logger as logger_mod
         self.registry = registry      # sched.QueryRegistry
@@ -84,6 +93,10 @@ class Watchdog:
         # () -> None | seconds_without_progress of an IN-FLIGHT scrub
         # pass (storage.scrub.Scrubber.stall_age).
         self.scrub_progress_fn = scrub_progress_fn
+        # () -> None | seconds_without_progress while the tier
+        # manager has pending demotion/eviction work
+        # (tier.manager.TierManager.stall_age).
+        self.tier_progress_fn = tier_progress_fn
         self.interval_s = max(0.02, float(interval_s))
         self.wal_stall_s = float(wal_stall_s)
         self.deadline_grace_s = float(deadline_grace_s)
@@ -91,6 +104,7 @@ class Watchdog:
         self.queue_stall_s = float(queue_stall_s)
         self.resize_stall_s = float(resize_stall_s)
         self.scrub_stall_s = float(scrub_stall_s)
+        self.tier_stall_s = float(tier_stall_s)
         self.retrip_s = float(retrip_s)
         self.logger = logger or logger_mod.NOP
         self.trips = 0
@@ -195,6 +209,18 @@ class Watchdog:
                     "scrub_stall",
                     f"scrub pass in flight, no fragment verified for"
                     f" {age:.1f}s"))
+        # Stalled tier working-set manager (tier.manager).
+        if (self.tier_progress_fn is not None
+                and self.tier_stall_s > 0):
+            try:
+                age = self.tier_progress_fn()
+            except Exception:  # noqa: BLE001
+                age = None
+            if age is not None and age > self.tier_stall_s:
+                out.append((
+                    "tier_stall",
+                    f"tier work pending, no transition completed for"
+                    f" {age:.1f}s"))
         return out
 
     # -- the trip --------------------------------------------------------------
@@ -246,4 +272,5 @@ class Watchdog:
                                "gossipSilenceS": self.gossip_silence_s,
                                "queueStallS": self.queue_stall_s,
                                "resizeStallS": self.resize_stall_s,
-                               "scrubStallS": self.scrub_stall_s}}
+                               "scrubStallS": self.scrub_stall_s,
+                               "tierStallS": self.tier_stall_s}}

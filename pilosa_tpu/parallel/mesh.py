@@ -31,6 +31,8 @@ pick per backend. Both forms share one compile-accounting wrapper
 from __future__ import annotations
 
 import functools
+import heapq
+import os
 import threading
 import time
 
@@ -59,6 +61,161 @@ def _dispatch_gate() -> None:
     sched_context.check_current()
     if _failpoints.ACTIVE is not None:
         _failpoints.ACTIVE.hit("mesh.dispatch")
+
+
+# -- per-tenant device-queue fairness ----------------------------------------
+# Admission (sched.admission) strides tenants at the HTTP front door,
+# but ONE admitted query fans out many device dispatches; below
+# admission every dispatch raced FIFO for the backend, so a wide
+# tenant's fan-out could monopolize the device queue against a quiet
+# tenant's single program. The FairDispatchQueue closes that gap: a
+# bounded slot pool at the dispatch boundary where, under contention,
+# waiters are admitted in stride order over their tenants' effective
+# admission weights (the same penalty-boxed weights sched.tenants
+# computes — one fairness currency at both levels). Uncontended cost
+# is one lock acquire; the queue is installed only when the server
+# runs with tenants (install_fair_dispatch), and PILOSA_MESH_FAIR=0
+# removes it entirely.
+
+class FairDispatchQueue:
+    """Stride-scheduled slot pool for device dispatches.
+
+    Each tenant carries a virtual ``pass``; enqueueing advances it by
+    ``1/weight`` and waiters wake lowest-pass-first, so over any
+    contended window tenants hold slots in proportion to their
+    weights regardless of how many dispatches each has queued. A new
+    (or long-idle) tenant starts at the global pass frontier — it
+    cannot bank credit, only compete fairly from now on."""
+
+    def __init__(self, slots: int, weight_fn=None):
+        self.slots = max(1, int(slots))
+        self.weight_fn = weight_fn
+        self._mu = threading.Lock()
+        self._in_flight = 0
+        # Heap entries are [pass, seq, Event, cancelled]; list order
+        # compares (pass, seq) — seq is unique, the Event never
+        # participates. ``cancelled`` marks a waiter that gave up
+        # (query killed while queued); release() skips it.
+        self._heap: list[list] = []
+        self._seq = 0
+        self._tenant_pass: dict[str, float] = {}
+        self._global_pass = 0.0
+        self._dispatches = 0
+        self._waits = 0
+
+    def _stride(self, tenant: str) -> float:
+        weight = 1.0
+        fn = self.weight_fn
+        if fn is not None:
+            try:
+                weight = float(fn(tenant))
+            except Exception:  # noqa: BLE001 - fairness is advisory
+                weight = 1.0
+        return 1.0 / max(weight, 1e-3)
+
+    def acquire(self, tenant: str) -> None:
+        with self._mu:
+            self._dispatches += 1
+            if self._in_flight < self.slots and not self._heap:
+                self._in_flight += 1
+                return
+            self._waits += 1
+            p = max(self._tenant_pass.get(tenant, 0.0),
+                    self._global_pass) + self._stride(tenant)
+            self._tenant_pass[tenant] = p
+            self._seq += 1
+            entry = [p, self._seq, threading.Event(), False]
+            heapq.heappush(self._heap, entry)
+        ev = entry[2]
+        while not ev.wait(0.05):
+            # Keep the query's cancellation/kill/deadline checks live
+            # while queued — a killed query must not occupy the queue.
+            try:
+                sched_context.check_current()
+            except BaseException:
+                with self._mu:
+                    if not ev.is_set():
+                        entry[3] = True
+                        raise
+                # Woken concurrently with the cancel: we own a slot —
+                # hand it on before propagating.
+                self.release()
+                raise
+
+    def release(self) -> None:
+        with self._mu:
+            while self._heap:
+                _p, _seq, ev, cancelled = heapq.heappop(self._heap)
+                if cancelled:
+                    continue
+                self._global_pass = _p
+                ev.set()  # slot transfers: _in_flight is unchanged
+                return
+            self._in_flight -= 1
+
+    def state(self) -> dict:
+        with self._mu:
+            return {"slots": self.slots,
+                    "inFlight": self._in_flight,
+                    "queued": sum(1 for e in self._heap if not e[3]),
+                    "dispatches": self._dispatches,
+                    "waits": self._waits}
+
+
+_FAIR: "FairDispatchQueue | None" = None
+_FAIR_DEPTH = threading.local()
+DEFAULT_FAIR_SLOTS = 8
+
+
+def install_fair_dispatch(weight_fn=None, slots: int = 0) -> None:
+    """Arm per-tenant dispatch fairness (server.open, once tenants
+    exist). ``weight_fn(tenant) -> float`` is typically
+    ``TenantRegistry.effective_weight``. PILOSA_MESH_FAIR=0 vetoes
+    (the escape hatch when a deployment wants raw FIFO dispatch);
+    PILOSA_MESH_FAIR_SLOTS overrides the slot count."""
+    global _FAIR
+    if os.environ.get("PILOSA_MESH_FAIR", "") == "0":
+        _FAIR = None
+        return
+    if not slots:
+        try:
+            slots = int(os.environ.get("PILOSA_MESH_FAIR_SLOTS", "")
+                        or DEFAULT_FAIR_SLOTS)
+        except ValueError:
+            slots = DEFAULT_FAIR_SLOTS
+    _FAIR = FairDispatchQueue(slots, weight_fn)
+
+
+def uninstall_fair_dispatch() -> None:
+    global _FAIR
+    _FAIR = None
+
+
+def fair_dispatch_state() -> "dict | None":
+    q = _FAIR
+    return q.state() if q is not None else None
+
+
+def _fair_dispatch(fn):
+    """Entry-point wrapper: hold one fair slot for the duration of the
+    dispatch call. Reentrant per thread (topn_topk_sharded's Pallas
+    path calls topn_exact_sharded — the outer slot covers both), and
+    a straight pass-through until install_fair_dispatch arms it."""
+    @functools.wraps(fn)
+    def gated(*args, **kwargs):
+        q = _FAIR
+        if q is None or getattr(_FAIR_DEPTH, "d", 0):
+            return fn(*args, **kwargs)
+        ctx = sched_context.current()
+        tenant = (getattr(ctx, "tenant", "") or "") if ctx else ""
+        q.acquire(tenant or "default")
+        _FAIR_DEPTH.d = 1
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _FAIR_DEPTH.d = 0
+            q.release()
+    return gated
 
 
 def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
@@ -366,6 +523,7 @@ def _densify_sharded_fn(mesh: Mesh, lead_shape: tuple, subs: int,
         out_specs=P(AXIS_SLICES), check_vma=False)))
 
 
+@_fair_dispatch
 def densify_sharded(mesh: Mesh, lanes: np.ndarray, vals: np.ndarray,
                     interpret: bool = False) -> jax.Array:
     """Upload bucketed sparse rows (ops.packed.bucket_prepared) and
@@ -518,6 +676,7 @@ def slice_chunk_bound(n_dev: int) -> int:
     return (1 << 15) - n_dev
 
 
+@_fair_dispatch
 def count_expr(mesh: Mesh, expr: tuple, leaves: np.ndarray) -> int:
     """Count the bitmap expression over slice-sharded leaf blocks.
 
@@ -597,6 +756,7 @@ def _count_exprs_sharded_fn(mesh: Mesh, exprs: tuple, n_leaves: int,
         check_vma=(mode is None))))
 
 
+@_fair_dispatch
 def count_exprs_sharded(mesh: Mesh, exprs: tuple,
                         leaf_arrays: list[jax.Array]) -> list[int]:
     """K expression counts in ONE compiled program over shared
@@ -637,6 +797,7 @@ def count_expr_sharded(mesh: Mesh, expr: tuple,
     return count_exprs_sharded(mesh, (expr,), leaf_arrays)[0]
 
 
+@_fair_dispatch
 def fused_tree_sharded(mesh: Mesh, count_exprs: tuple,
                        topn_items: list[tuple],
                        leaf_arrays: list[jax.Array],
@@ -777,6 +938,7 @@ def _topn_filtered_sharded_fn(mesh: Mesh, expr, n_leaves: int,
         out_specs=P(), check_vma=(mode is None))))
 
 
+@_fair_dispatch
 def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
                           leaf_arrays: list[jax.Array],
                           threshold: int = 1,
@@ -805,6 +967,7 @@ def topn_filtered_sharded(mesh: Mesh, expr, rows: jax.Array,
                *leaf_arrays))[:rows.shape[1]]
 
 
+@_fair_dispatch
 def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
                        leaf_arrays: list[jax.Array]) -> list[int]:
     """TopN exact counts over a DEVICE-resident candidate block
@@ -829,6 +992,7 @@ def topn_exact_sharded(mesh: Mesh, expr, rows: jax.Array,
         return hilo_combine(fn(rows, *leaf_arrays))[:rows.shape[1]]
 
 
+@_fair_dispatch
 def topn_topk_sharded(mesh: Mesh, expr, rows: jax.Array,
                       leaf_arrays: list[jax.Array],
                       k: int) -> tuple[list[int], list[int]]:
@@ -976,6 +1140,7 @@ def topn_exact_fn(mesh: Mesh, expr):
     return _topn_exact_fn_cached(mesh, expr, mode)
 
 
+@_fair_dispatch
 def materialize_expr_sharded(mesh: Mesh, expr,
                              leaf_arrays: list[jax.Array]) -> np.ndarray:
     """[S, W] dense words of the expression bitmap: one sharded device
@@ -995,6 +1160,7 @@ def materialize_expr_sharded(mesh: Mesh, expr,
         return np.asarray(fn(*leaf_arrays))
 
 
+@_fair_dispatch
 def bsi_range_sharded(mesh: Mesh, op: str, upred, depth: int,
                       plane_arrays: list[jax.Array]) -> np.ndarray:
     """[S, W] dense matched words of a BSI comparison: the whole
@@ -1029,6 +1195,7 @@ def bsi_range_sharded(mesh: Mesh, op: str, upred, depth: int,
 TOPN_BLOCK_BYTES = 256 << 20
 
 
+@_fair_dispatch
 def topn_exact(mesh: Mesh, expr, rows: np.ndarray,
                leaves: np.ndarray | None, threshold: int = 1,
                tanimoto: int = 0) -> list[int]:

@@ -221,7 +221,8 @@ class Handler:
                  accounting: bool = True, fault=None, sampler=None,
                  blackbox=None, watchdog=None, history=None,
                  sentinel=None, federator=None, tenants=None,
-                 tenant_slo=None, scrubber=None, repairer=None):
+                 tenant_slo=None, scrubber=None, repairer=None,
+                 tier=None):
         from ..utils import logger as logger_mod
         self.logger = logger or logger_mod.NOP
         self.holder = holder
@@ -279,6 +280,9 @@ class Handler:
         # quarantine registry alone.
         self.scrubber = scrubber
         self.repairer = repairer
+        # Tiered storage (pilosa_tpu.tier) behind /debug/tier; None
+        # (tiering off / bare handlers) serves a disabled stub.
+        self.tier = tier
         if federator is None:
             from ..obs.federate import Federator
             federator = Federator(host)
@@ -375,6 +379,7 @@ class Handler:
         r("GET", "/debug/integrity", self._handle_debug_integrity)
         r("POST", "/debug/integrity/scrub",
           self._handle_post_integrity_scrub)
+        r("GET", "/debug/tier", self._handle_debug_tier)
         r("GET", "/debug/vars", self._handle_expvar)
         r("GET", "/debug/metrics/history",
           self._handle_metrics_history)
@@ -1403,6 +1408,24 @@ class Handler:
             out["scrub"] = self.scrubber.state()
         if self.repairer is not None:
             out["repair"] = self.repairer.state()
+        return Response.json(out)
+
+    def _handle_debug_tier(self, req: Request) -> Response:
+        """Tiered-storage state (pilosa_tpu.tier): per-tier fragment
+        and byte counts, resident bytes vs budget/watermarks,
+        per-tenant residency, transition totals, blocked cold fetches,
+        and the blob store summary. ``?entries=1`` appends the
+        per-fragment ledger (optionally filtered ``&tier=cold``);
+        ``?pass=1`` runs one manager pass inline and includes its
+        summary (operator spot checks, chaos tests)."""
+        if self.tier is None:
+            return Response.json({"enabled": False})
+        out = self.tier.state()
+        if req.query.get("pass") == "1":
+            out["pass"] = self.tier.pass_once()
+        if req.query.get("entries") == "1":
+            out["entries"] = self.tier.entries(
+                req.query.get("tier", ""))[:1024]
         return Response.json(out)
 
     def _handle_post_integrity_scrub(self, req: Request) -> Response:

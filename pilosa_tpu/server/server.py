@@ -46,11 +46,12 @@ from ..sched import (AdmissionController, QueryRegistry, TenantRegistry,
                      Warmup, warmup_enabled)
 from ..utils import logger as logger_mod
 from ..storage.scrub import Scrubber
+from ..tier.manager import TierManager
 from ..utils.config import (BlackboxConfig, FaultConfig, HistoryConfig,
                             MetricsConfig, ProfileConfig, QueryConfig,
                             ScrubConfig, SentinelConfig, SLOConfig,
-                            TenantsConfig, TraceConfig, WatchdogConfig,
-                            parse_resolutions)
+                            TenantsConfig, TierConfig, TraceConfig,
+                            WatchdogConfig, parse_resolutions)
 from ..utils.stats import NOP, MultiStatsClient
 from .handler import Handler
 from .httpd import HTTPServer
@@ -85,7 +86,8 @@ class Server:
                  history_config: Optional[HistoryConfig] = None,
                  sentinel_config: Optional[SentinelConfig] = None,
                  tenants_config: Optional[TenantsConfig] = None,
-                 scrub_config: Optional[ScrubConfig] = None):
+                 scrub_config: Optional[ScrubConfig] = None,
+                 tier_config: Optional[TierConfig] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -211,6 +213,12 @@ class Server:
         self.scrub_config = scrub_config or ScrubConfig()
         self.scrubber: Optional[Scrubber] = None
         self.repairer: Optional[Repairer] = None
+        # Tiered storage (pilosa_tpu.tier; docs/STORAGE.md): the
+        # working-set manager serving indexes bigger than RAM — built
+        # in open() when [tier] enables it (the cold dir lives under
+        # the data dir by default).
+        self.tier_config = tier_config or TierConfig()
+        self.tier: Optional[TierManager] = None
         self.executor: Optional[Executor] = None
         self.handler: Optional[Handler] = None
         self.pod = None  # parallel.pod.Pod once open() joins a pod
@@ -421,6 +429,34 @@ class Server:
                 client_factory=self._client_factory, fault=self.fault,
                 rescan_s=self.scrub_config.repair_rescan,
                 logger=self.logger)
+        # Tiered storage (pilosa_tpu.tier; docs/STORAGE.md): the
+        # working-set manager — demotion/eviction/blob loops over the
+        # residency ledger, honoring per-tenant cache shares, with the
+        # prefetcher ranking cold fragments by the metric history's
+        # touch rates. Started at the end of open() with the other
+        # loops.
+        if self.tier_config.enabled:
+            self.tier = TierManager(
+                self.holder,
+                resident_budget=self.tier_config.resident_budget,
+                high_watermark=self.tier_config.high_watermark,
+                low_watermark=self.tier_config.low_watermark,
+                idle_s=self.tier_config.idle,
+                blob_idle_s=self.tier_config.blob_idle,
+                cold_dir=(self.tier_config.cold_dir
+                          or os.path.join(self.holder.path, "_tier")),
+                blob=self.tier_config.blob,
+                interval_s=self.tier_config.interval,
+                prefetch_interval_s=self.tier_config
+                .prefetch_interval,
+                pace_s=self.tier_config.pace,
+                tenants=self.tenants, history=self.history,
+                busy_fn=lambda: self.admission.in_flight() > 0,
+                logger=self.logger)
+            self.holder.tier = self.tier
+            # Fragments already opened above get their manager hook
+            # now (later opens are picked up by the sync pass).
+            self.tier.sync()
         # Stall watchdog (obs.watchdog): wedged WAL flusher, stuck
         # legs, gossip silence, non-draining admission queue. A trip
         # force-keeps in-flight traces and dumps the blackbox.
@@ -434,6 +470,9 @@ class Server:
                 scrub_progress_fn=(self.scrubber.stall_age
                                    if self.scrubber is not None
                                    else None),
+                tier_progress_fn=(self.tier.stall_age
+                                  if self.tier is not None
+                                  else None),
                 interval_s=self.watchdog_config.interval,
                 wal_stall_s=self.watchdog_config.wal_stall,
                 deadline_grace_s=self.watchdog_config.deadline_grace,
@@ -441,6 +480,7 @@ class Server:
                 queue_stall_s=self.watchdog_config.queue_stall,
                 resize_stall_s=self.watchdog_config.resize_stall,
                 scrub_stall_s=self.watchdog_config.scrub_stall,
+                tier_stall_s=self.watchdog_config.tier_stall,
                 retrip_s=self.watchdog_config.retrip,
                 logger=self.logger)
             self.watchdog.start()
@@ -482,7 +522,7 @@ class Server:
             history=self.history, sentinel=self.sentinel,
             federator=self.federator, tenants=self.tenants,
             tenant_slo=self.tenant_slo, scrubber=self.scrubber,
-            repairer=self.repairer)
+            repairer=self.repairer, tier=self.tier)
 
         self._httpd = HTTPServer(self.handler, bind_host, port,
                                  logger=self.logger,
@@ -554,6 +594,12 @@ class Server:
             self.scrubber.start()
         if self.repairer is not None:
             self.repairer.start()
+        if self.tier is not None:
+            self.tier.start()
+        # Device-queue fairness below admission: dispatch slots stride
+        # over the same penalty-boxed tenant weights admission uses
+        # (parallel.mesh.FairDispatchQueue; PILOSA_MESH_FAIR=0 vetoes).
+        mesh_mod.install_fair_dispatch(self.tenants.effective_weight)
 
     def close(self) -> None:
         self.logger.printf("server closing: %s", self.host)
@@ -570,6 +616,12 @@ class Server:
             self.repairer.stop()
         if self.scrubber is not None:
             self.scrubber.stop()
+        # Tier manager before the holder closes: a mid-pass demotion
+        # must not race fragment close (stop() joins the loops).
+        if self.tier is not None:
+            self.tier.stop()
+        from ..parallel import mesh as mesh_mod
+        mesh_mod.uninstall_fair_dispatch()
         if self.watchdog is not None:
             self.watchdog.stop()
         if self.blackbox is not None:
@@ -1234,6 +1286,10 @@ class Server:
         if self.repairer is not None:
             integrity_block["repair"] = self.repairer.state()
         out["integrity"] = integrity_block
+        # Tiered storage: residency counts, watermarks, blocked cold
+        # fetches — where did the working set live when it happened.
+        if self.tier is not None:
+            out["tier"] = self.tier.state()
         try:
             out["threads"] = thread_dump()[:20000]
         except Exception:  # noqa: BLE001 - interpreter-internal API

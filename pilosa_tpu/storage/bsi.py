@@ -256,6 +256,72 @@ def min_max(min_v: int, max_v: int, row: Callable[[int], object],
     return ValCount(value + min_v, b.count())
 
 
+def sum_count_many(min_v: int, max_v: int,
+                   legs: list) -> ValCount:
+    """One (sum, count) partial over a whole node leg's slices in one
+    pass — the batched form of per-slice ``sum_count`` + ``combine_sum``.
+    ``legs`` is ``[(row_fn, filter_or_None), ...]``, one entry per
+    owned slice. Slices with an empty (filtered) existence row drop
+    out before any value plane is read."""
+    live = []
+    count = 0
+    for row, filt in legs:
+        exists = row(EXISTS_PLANE)
+        n = exists.count() if filt is None \
+            else exists.intersection_count(filt)
+        if n:
+            count += n
+            live.append((row, filt))
+    if count == 0:
+        return ValCount(0, 0)
+    total = min_v * count
+    for i in range(bit_depth(min_v, max_v)):
+        for row, filt in live:
+            plane = row(i)
+            n = plane.count() if filt is None \
+                else plane.intersection_count(filt)
+            total += n << i
+    return ValCount(total, count)
+
+
+def min_max_many(min_v: int, max_v: int, legs: list,
+                 want_min: bool = True) -> ValCount:
+    """One min/max partial over a whole node leg's slices — the
+    MSB→LSB candidate walk of ``min_max`` run JOINTLY across slices,
+    which prunes harder than per-slice + combine: the moment ANY slice
+    still holds a candidate with the favorable bit, every slice whose
+    candidates all carry the unfavorable one is dropped outright and
+    pays nothing for the remaining planes."""
+    cands = []
+    for row, filt in legs:
+        b = row(EXISTS_PLANE)
+        if filt is not None:
+            b = b.intersect(filt)
+        if b.count():
+            cands.append((row, b))
+    if not cands:
+        return ValCount(0, 0)
+    value = 0
+    for i in reversed(range(bit_depth(min_v, max_v))):
+        nxt = []
+        for row, b in cands:
+            z = (b.difference(row(i)) if want_min
+                 else b.intersect(row(i)))
+            if z.count():
+                nxt.append((row, z))
+        if nxt:
+            # Some slice can still improve the extreme at this bit:
+            # the global extreme has the favorable value here, and
+            # only those slices stay in play.
+            cands = nxt
+            if not want_min:
+                value |= 1 << i
+        elif want_min:
+            value |= 1 << i  # every candidate everywhere has the bit
+    return ValCount(value + min_v,
+                    sum(b.count() for _row, b in cands))
+
+
 def combine_sum(a: ValCount, b: ValCount) -> ValCount:
     return ValCount(a.value + b.value, a.count + b.count)
 

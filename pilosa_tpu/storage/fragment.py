@@ -34,6 +34,7 @@ import mmap
 import os
 import threading
 import time
+import zlib
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -164,6 +165,21 @@ class Fragment:
         self.quarantine_reason = ""
         self._verify_pending = False
 
+        # Tiered storage (pilosa_tpu.tier): the TierManager hook (None
+        # for bare library fragments — one attr check on the read hot
+        # path keeps the gate free when tiering is off), the residency
+        # state ("hot" | "cold" | "blob"), and — while cold — the set
+        # of container-block indices not yet faulted in. Cold
+        # fragments hold their full checksummed file on local disk;
+        # reads fault exactly the blocks they touch, verifying each
+        # against the footer's per-block crc table (the block map).
+        # Blob fragments hold only a ``<path>.blob`` stub; the first
+        # gated read fetches + verifies the file back from the blob
+        # store and re-enters cold.
+        self.tier = None
+        self.tier_state = "hot"
+        self._cold_pending: Optional[set] = None
+
         self.storage: Optional[roaring.Bitmap] = None
         self.cache = None                       # rank/lru count cache
         self.row_cache = cache_mod.SimpleCache()
@@ -224,6 +240,26 @@ class Fragment:
             from . import native_ext
             native_ext.load()
             self.cache = cache_mod.new_cache(self.cache_type, self.cache_size)
+            stub = self.path + ".blob"
+            if os.path.exists(stub):
+                if os.path.exists(self.path):
+                    # Crash between blob fetch-replace and stub
+                    # removal: the DATA FILE WINS — it was verified
+                    # before the os.replace, while a re-fetch could
+                    # fail. Drop the stale stub and open normally.
+                    try:
+                        os.remove(stub)
+                    except OSError:
+                        pass
+                else:
+                    # Blob-tier fragment: no local bytes, no storage.
+                    # The first gated read fetches + verifies the file
+                    # back through the tier manager; ungated access
+                    # fails loudly (storage is None) — never a guess.
+                    self.tier_state = "blob"
+                    self.storage = None
+                    self._open = True
+                    return
             self._open_storage_quarantining(verify=True)
             if not self.quarantined and os.path.exists(
                     self.path + ".corrupt"):
@@ -440,6 +476,227 @@ class Fragment:
                 f"fragment {self.index}/{self.frame}/{self.view}/"
                 f"{self.slice}: {len(bad)} container blocks fail crc")
 
+    # -- tiered storage (pilosa_tpu.tier; docs/STORAGE.md) --------------------
+
+    def demote_cold(self) -> int:
+        """Demote this fragment to the cold tier: WAL barrier, fold
+        any op-log tail into a fresh checksummed snapshot, flush the
+        TopN cache sidecar, then reopen metadata-only — header parsed,
+        footer attached, NO container block read or verified. Returns
+        the cold file's byte size (0 = demotion didn't apply: already
+        cold, quarantined, torn WAL, or a footerless legacy file).
+        OSError (ENOSPC mid-snapshot) propagates — the old file stays
+        the record and the fragment stays hot."""
+        with self._snap_mu:
+            with self._mu:
+                if (not self._open or self.quarantined
+                        or self.tier_state != "hot"
+                        or self.storage is None):
+                    return 0
+                try:
+                    self.wal_barrier()
+                except wal_mod.WalError:
+                    return 0  # torn pending tail: not a clean point
+                if (self.storage.op_n > 0
+                        or self.storage.footer is None):
+                    # Fold the op-log (and footer vintage files) into
+                    # a clean footered snapshot — the cold format IS
+                    # the PR-15 snapshot format, nothing new on disk.
+                    self._snapshot_locked(reason="tier")
+                self.flush_cache()
+                self._close_storage()
+                self._open_storage_quarantining()
+                if self.quarantined:
+                    return 0
+                info = getattr(self.storage, "footer", None)
+                if info is None or info.offsets is None:
+                    return 0  # stay hot; nothing to fault against
+                # The per-block fault gate supersedes the whole-file
+                # first-read verify — each block's crc is checked as
+                # it faults in instead.
+                self._verify_pending = False
+                self._cold_pending = set(range(info.block_n))
+                self.tier_state = "cold"
+                # Drop every derived cache: they hold materialized row
+                # data whose residency the demotion exists to reclaim.
+                self._epoch += 1
+                self.row_cache.clear()
+                self.device.invalidate_all()
+                self.checksums.clear()
+                self._src_counts.clear()
+                self._cache_complete = False
+                self.cache = cache_mod.new_cache(self.cache_type,
+                                                 self.cache_size)
+                return os.path.getsize(self.path)
+
+    def tier_rechill(self) -> bool:
+        """Reset a cold fragment's fault set (watermark eviction of a
+        cold scan's residency): every block goes back to unfaulted and
+        re-verifies on its next touch. Cheap — no file I/O."""
+        with self._mu:
+            if self.tier_state != "cold" or self.storage is None:
+                return False
+            info = getattr(self.storage, "footer", None)
+            if info is None:
+                return False
+            self._cold_pending = set(range(info.block_n))
+            self.row_cache.clear()
+            self.device.invalidate_all()
+            self._positions = None
+            self._present_rows = None
+            return True
+
+    def promote(self, trigger: str = "prefetch") -> None:
+        """Fully promote to hot (prefetcher / operator action). Blob
+        fragments fetch first; cold fragments fault every remaining
+        block (each crc-verified) and re-rank the TopN cache."""
+        with self._mu:
+            if not self._open or self.tier_state == "hot":
+                return
+            if self.tier_state == "blob":
+                self._tier_fetch_locked()
+            self._tier_promote_locked(trigger)
+
+    def _tier_gate(self, row_id=None, row_ids=None, full=False,
+                   write=False) -> None:
+        """The read-path tier gate (caller holds _mu). Hot: stamp the
+        ledger and return. Blob: fetch the file back (ColdFetchError
+        on failure — the executor degrades, never guesses). Cold:
+        fault exactly the container blocks covering the touched
+        row(s); whole-fragment reads and writes promote fully."""
+        st = self.tier_state
+        if st != "hot":
+            if st == "blob":
+                self._tier_fetch_locked()
+            if full or (row_id is None and row_ids is None):
+                self._tier_promote_locked("write" if write
+                                          else "read")
+            else:
+                idxs = self._tier_blocks_for(
+                    [row_id] if row_ids is None else row_ids)
+                self._fault_blocks_locked(idxs)
+                if not self._cold_pending:
+                    self._tier_promote_locked("read")
+        if self.tier is not None:
+            self.tier.on_access(self)
+
+    def _tier_blocks_for(self, row_ids) -> list[int]:
+        """Pending container-block indices covering ``row_ids``. Block
+        i of the footer table is container i in file/key order, and a
+        row spans exactly SLICE_WIDTH/65536 consecutive container
+        keys — so the block map is two binary searches per row over
+        the sorted key array (no container is touched)."""
+        pending = self._cold_pending
+        if not pending:
+            return []
+        keys = self.storage._keys_np()
+        shift = (SLICE_WIDTH // 65536).bit_length() - 1
+        out: set = set()
+        for rid in row_ids:
+            rid = int(rid)
+            lo = int(np.searchsorted(keys, rid << shift, side="left"))
+            hi = int(np.searchsorted(keys, (rid + 1) << shift,
+                                     side="left"))
+            out.update(i for i in range(lo, hi) if i in pending)
+        return sorted(out)
+
+    def _fault_blocks_locked(self, idxs) -> None:
+        """Fault container blocks in: verify each block's bytes
+        against the footer's crc table, then mark it resident. A
+        mismatch quarantines (same contract as _verify_on_read) —
+        cold data re-verifies on the way back in, so bit rot that
+        happened while the fragment slept cannot reach a result."""
+        if not idxs:
+            return
+        t0 = time.perf_counter()
+        info = self.storage.footer
+        offs, sizes, crcs = info.offsets, info.sizes, info.crcs
+        if _fp.ACTIVE is not None:
+            # Corrupt mode flips real bits in the file; the PROT_READ
+            # MAP_SHARED mmap sees them, so the crc check below is the
+            # real detection path, not a simulation. The span confines
+            # flips to a block this fault will verify — detection is
+            # guaranteed, not a draw against the whole file.
+            first = idxs[0]
+            _fp.ACTIVE.hit("tier.fault", path=self.path,
+                           span=(int(offs[first]), int(sizes[first])))
+        mm = self._mmap
+        mv = memoryview(mm)
+        bad: list[int] = []
+        nbytes = 0
+        for i in idxs:
+            off, size = int(offs[i]), int(sizes[i])
+            if (zlib.crc32(mv[off:off + size]) & 0xFFFFFFFF) \
+                    != int(crcs[i]):
+                bad.append(i)
+            nbytes += size
+        del mv
+        obs_metrics.STORAGE_SCRUB_BLOCKS.labels("read").inc(len(idxs))
+        if bad:
+            obs_metrics.TIER_FAULTS.labels("corrupt").inc()
+            self._set_quarantined(
+                f"cold block fault crc mismatch (blocks {bad[:4]},"
+                f" {len(bad)} total)", site="read")
+            raise integrity_mod.CorruptionError(
+                f"fragment {self.index}/{self.frame}/{self.view}/"
+                f"{self.slice}: {len(bad)} cold blocks fail crc on"
+                f" fault-in")
+        self._cold_pending.difference_update(idxs)
+        obs_metrics.TIER_FAULTS.labels("ok").inc()
+        obs_metrics.TIER_FAULT_SECONDS.observe(
+            time.perf_counter() - t0)
+        if self.tier is not None:
+            self.tier.note_fault(self, nbytes)
+
+    def _tier_promote_locked(self, trigger: str) -> None:
+        """Finish promotion to hot (caller holds _mu): fault whatever
+        is still pending, then rebuild the TopN rank cache from the
+        sidecar — top() on a promoted fragment must rank exactly like
+        one that never left."""
+        pending = self._cold_pending
+        if pending:
+            self._fault_blocks_locked(sorted(pending))
+        self._cold_pending = None
+        self.tier_state = "hot"
+        self._open_cache()
+        obs_metrics.TIER_PROMOTIONS.labels(trigger).inc()
+        if self.tier is not None:
+            try:
+                size = os.path.getsize(self.path)
+            except OSError:
+                size = 0
+            self.tier.note_promoted(self, size, trigger)
+
+    def _tier_fetch_locked(self) -> None:
+        """Materialize a blob-tier fragment back onto local disk
+        (caller holds _mu): the manager fetches + verifies the
+        reassembled file, we reopen it metadata-only and land in the
+        cold tier (the read that triggered this then faults just the
+        blocks it needs). No manager/store → ColdFetchError."""
+        from ..tier.manager import ColdFetchError
+        if self.tier is None:
+            raise ColdFetchError(
+                f"fragment {self.index}/{self.frame}/{self.view}/"
+                f"{self.slice}: blob-tier but no tier manager")
+        self.tier.fetch_blob(self)
+        self._open_storage_quarantining()
+        if self.quarantined:
+            raise integrity_mod.CorruptionError(
+                f"fragment {self.index}/{self.frame}/{self.view}/"
+                f"{self.slice}: fetched blob data failed"
+                f" verification")
+        info = getattr(self.storage, "footer", None)
+        self._verify_pending = False
+        self.tier_state = "cold"
+        self._cold_pending = (set(range(info.block_n))
+                              if info is not None
+                              and info.offsets is not None else set())
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            size = 0
+        self.tier.note_fetched(self, size)
+
     def verify_on_disk(self) -> dict:
         """Re-read the data FILE and verify footer + blocks + WAL tail
         — the scrubber's per-fragment pass (storage.scrub). Opens its
@@ -583,6 +840,8 @@ class Fragment:
         column ids (reference fragment.go:338-367)."""
         with self._mu:
             self._verify_on_read()
+            if self.tier is not None:
+                self._tier_gate(row_id=row_id)
             if check_cache:
                 cached = self.row_cache.fetch(row_id)
                 if cached is not None:
@@ -610,6 +869,8 @@ class Fragment:
         from ..ops.packed import pack_storage_row
         with self._mu:
             self._verify_on_read()
+            if self.tier is not None:
+                self._tier_gate(row_id=row_id)
             if cached:
                 out[:] = self.device.host_row_words(self.storage, row_id)
             else:
@@ -642,6 +903,10 @@ class Fragment:
         min_col = self.slice * SLICE_WIDTH
         if not (min_col <= column_id < min_col + SLICE_WIDTH):
             raise ValueError("column out of bounds")
+        if self.tier is not None and self.tier_state != "hot":
+            # Writes promote fully: the rank cache must cover every
+            # row before cache.add maintains it incrementally.
+            self._tier_gate(full=True, write=True)
         pos = row_id * SLICE_WIDTH + (column_id - min_col)
         storage = self.storage
         changed = storage.add(pos) if set else storage.remove(pos)
@@ -705,6 +970,8 @@ class Fragment:
                                 set: bool) -> np.ndarray:
         row_shift = np.uint64(SLICE_WIDTH.bit_length() - 1)
         with self._mu:
+            if self.tier is not None and self.tier_state != "hot":
+                self._tier_gate(full=True, write=True)
             changed = self.storage.apply_batch(positions, set=set,
                                                wal=True)
             if not len(changed):
@@ -1029,6 +1296,8 @@ class Fragment:
         # breaking silently (ADVICE r5 #3) — the lock is noise next to
         # the import itself.
         with self._mu:
+            if self.tier is not None and self.tier_state != "hot":
+                self._tier_gate(full=True, write=True)
             small = (len(positions) * 16 < len(self.storage.keys)
                      and self.storage.op_writer is not None)
         if small:
@@ -1196,6 +1465,8 @@ class Fragment:
         from ..ops import packed
         with self._mu:
             self._verify_on_read()
+            if self.tier is not None:
+                self._tier_gate(row_id=row_id)
             return packed.sparse_row_words(self.storage, row_id)
 
     def _cached_total_bits(self) -> int:
@@ -1426,6 +1697,8 @@ class Fragment:
             return np.empty(0, dtype=np.uint64)
         with self._mu:
             self._verify_on_read()
+            if self.tier is not None:
+                self._tier_gate(row_ids=row_ids)
             w = np.uint64(SLICE_WIDTH)
             ids = np.unique(np.asarray(row_ids, dtype=np.uint64))
             # Gather ONLY the target rows' container key spans (each
@@ -1466,6 +1739,12 @@ class Fragment:
         opt = opt or TopOptions()
         with self._mu:
             self._verify_on_read()
+            if self.tier is not None:
+                # TopN ranks through the count cache, which demotion
+                # flushed — a block-granular fault can't rebuild it,
+                # so top() promotes fully (rank correctness over
+                # laziness).
+                self._tier_gate(full=True)
             # Array fast path for the plain TopN(frame, n) shape — no
             # source bitmap, no attribute filter, no tanimoto: the
             # answer is the first n rank-cache entries with count ≥

@@ -1,0 +1,32 @@
+"""Tiered storage: the working-set manager (docs/STORAGE.md).
+
+The index is allowed to be much bigger than RAM: the tier subsystem
+decides *what lives where* and moves it safely between three
+residency tiers —
+
+- **hot** — the fragment is fully open: mmap-resident storage, TopN
+  cache ranked, device rows eligible for HBM residency.
+- **cold** — the fragment was demoted: WAL barriered, op-log folded
+  into a fresh checksummed snapshot, caches flushed, and the file
+  reopened metadata-only. Container blocks fault back in on first
+  read, each verified against the PR-15 footer's per-block crc table
+  (the block map), so queries against cold fragments transparently
+  promote exactly what they touch.
+- **blob** — the cold file itself left local disk through the
+  pluggable blob store (tier.blob; the local-dir backend stands in
+  for object storage), pushed block-diff-style so a re-push after a
+  small change moves only the changed blocks. A ``<path>.blob`` stub
+  keeps the fragment discoverable across restarts; the first read
+  fetches, verifies the reassembled footer, and re-enters cold.
+
+The :class:`~pilosa_tpu.tier.ledger.ResidencyLedger` tracks every
+fragment's tier, byte footprint, and last-touching tenant; the
+:class:`~pilosa_tpu.tier.manager.TierManager` runs the demotion /
+eviction / blob / prefetch loops against it, honoring the PR-14
+per-tenant cache-share discipline so one tenant's cold scan can
+never flush another tenant's working set.
+"""
+
+from .blob import BlobStore, LocalDirBlobStore, open_blob_store  # noqa: F401
+from .ledger import ResidencyLedger  # noqa: F401
+from .manager import ColdFetchError, TierManager  # noqa: F401

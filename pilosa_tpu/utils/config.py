@@ -400,8 +400,10 @@ class WatchdogConfig:
     ``queue_stall`` the no-grant-while-queued bound; ``resize_stall``
     the no-progress bound on an elastic resize this node coordinates;
     ``scrub_stall`` the no-progress bound on an in-flight storage
-    scrub pass (storage.scrub); ``retrip`` rate-limits repeat trips
-    per cause (0 on any threshold disables that detector)."""
+    scrub pass (storage.scrub); ``tier_stall`` the no-progress bound
+    while the tier working-set manager has pending work
+    (tier.manager); ``retrip`` rate-limits repeat trips per cause
+    (0 on any threshold disables that detector)."""
     enabled: bool = True
     interval: float = 1.0
     wal_stall: float = 5.0
@@ -410,6 +412,7 @@ class WatchdogConfig:
     queue_stall: float = 10.0
     resize_stall: float = 60.0
     scrub_stall: float = 300.0
+    tier_stall: float = 120.0
     retrip: float = 60.0
 
 
@@ -426,6 +429,36 @@ class ScrubConfig:
     pace: float = 0.01
     repair: bool = True
     repair_rescan: float = 15.0
+
+
+@dataclass
+class TierConfig:
+    """[tier] section (tier.manager): the tiered-storage working-set
+    manager. ``resident_budget`` is the byte budget for the resident
+    (hot + faulted-cold) set — 0 disables watermark eviction;
+    ``high_watermark``/``low_watermark`` are the fractions of that
+    budget where eviction starts and stops; ``idle`` the no-touch age
+    before an open fragment becomes a demotion candidate;
+    ``blob_idle`` the additional cold age before a demoted fragment
+    is pushed off local disk into the blob store; ``cold_dir`` roots
+    the blob staging area and the local-dir blob backend (defaults to
+    ``<data-dir>/_tier``); ``blob`` selects the blob backend
+    (``""`` = no blob tier, ``dir`` = the local-dir backend standing
+    in for object storage); ``interval`` paces the manager loop;
+    ``prefetch_interval`` the history-driven prefetcher cadence
+    (0 = off); ``pace`` the sleep between per-fragment transitions
+    within one pass (serving traffic owns the disk)."""
+    enabled: bool = False
+    resident_budget: int = 0
+    high_watermark: float = 0.9
+    low_watermark: float = 0.7
+    idle: float = 300.0
+    blob_idle: float = 3600.0
+    cold_dir: str = ""
+    blob: str = ""
+    interval: float = 10.0
+    prefetch_interval: float = 0.0
+    pace: float = 0.01
 
 
 def _parse_bool(v) -> bool:
@@ -448,6 +481,7 @@ class Config:
     blackbox: BlackboxConfig = field(default_factory=BlackboxConfig)
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     scrub: ScrubConfig = field(default_factory=ScrubConfig)
+    tier: TierConfig = field(default_factory=TierConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
@@ -565,6 +599,7 @@ gossip-silence = "{dur(self.watchdog.gossip_silence)}"
 queue-stall = "{dur(self.watchdog.queue_stall)}"
 resize-stall = "{dur(self.watchdog.resize_stall)}"
 scrub-stall = "{dur(self.watchdog.scrub_stall)}"
+tier-stall = "{dur(self.watchdog.tier_stall)}"
 retrip = "{dur(self.watchdog.retrip)}"
 
 [scrub]
@@ -573,6 +608,19 @@ interval = "{dur(self.scrub.interval)}"
 pace = "{dur(self.scrub.pace)}"
 repair = {str(self.scrub.repair).lower()}
 repair-rescan = "{dur(self.scrub.repair_rescan)}"
+
+[tier]
+enabled = {str(self.tier.enabled).lower()}
+resident-budget = {self.tier.resident_budget}
+high-watermark = {self.tier.high_watermark}
+low-watermark = {self.tier.low_watermark}
+idle = "{dur(self.tier.idle)}"
+blob-idle = "{dur(self.tier.blob_idle)}"
+cold-dir = "{self.tier.cold_dir}"
+blob = "{self.tier.blob}"
+interval = "{dur(self.tier.interval)}"
+prefetch-interval = "{dur(self.tier.prefetch_interval)}"
+pace = "{dur(self.tier.pace)}"
 
 [profile]
 continuous = {str(self.profile.continuous).lower()}
@@ -741,6 +789,7 @@ def load(path: str = "", env: dict | None = None) -> Config:
                           ("queue-stall", "queue_stall"),
                           ("resize-stall", "resize_stall"),
                           ("scrub-stall", "scrub_stall"),
+                          ("tier-stall", "tier_stall"),
                           ("retrip", "retrip")):
             if key in wd:
                 setattr(cfg.watchdog, attr, parse_duration(wd[key]))
@@ -755,6 +804,26 @@ def load(path: str = "", env: dict | None = None) -> Config:
             cfg.scrub.repair = _parse_bool(sc["repair"])
         if "repair-rescan" in sc:
             cfg.scrub.repair_rescan = parse_duration(sc["repair-rescan"])
+        ti = data.get("tier", {})
+        if "enabled" in ti:
+            cfg.tier.enabled = _parse_bool(ti["enabled"])
+        if "resident-budget" in ti:
+            cfg.tier.resident_budget = int(ti["resident-budget"])
+        if "high-watermark" in ti:
+            cfg.tier.high_watermark = float(ti["high-watermark"])
+        if "low-watermark" in ti:
+            cfg.tier.low_watermark = float(ti["low-watermark"])
+        for key, attr in (("idle", "idle"),
+                          ("blob-idle", "blob_idle"),
+                          ("interval", "interval"),
+                          ("prefetch-interval", "prefetch_interval"),
+                          ("pace", "pace")):
+            if key in ti:
+                setattr(cfg.tier, attr, parse_duration(ti[key]))
+        if "cold-dir" in ti:
+            cfg.tier.cold_dir = str(ti["cold-dir"])
+        if "blob" in ti:
+            cfg.tier.blob = str(ti["blob"])
         p = data.get("profile", {})
         if "continuous" in p:
             cfg.profile.continuous = _parse_bool(p["continuous"])
@@ -953,6 +1022,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
                              "resize_stall"),
                             ("PILOSA_WATCHDOG_SCRUB_STALL",
                              "scrub_stall"),
+                            ("PILOSA_WATCHDOG_TIER_STALL",
+                             "tier_stall"),
                             ("PILOSA_WATCHDOG_RETRIP", "retrip")):
         if env.get(env_key_):
             setattr(cfg.watchdog, attr_, parse_duration(env[env_key_]))
@@ -967,6 +1038,26 @@ def load(path: str = "", env: dict | None = None) -> Config:
     if env.get("PILOSA_SCRUB_REPAIR_RESCAN"):
         cfg.scrub.repair_rescan = parse_duration(
             env["PILOSA_SCRUB_REPAIR_RESCAN"])
+    if env.get("PILOSA_TIER_ENABLED"):
+        cfg.tier.enabled = _parse_bool(env["PILOSA_TIER_ENABLED"])
+    if env.get("PILOSA_TIER_RESIDENT_BUDGET"):
+        cfg.tier.resident_budget = int(env["PILOSA_TIER_RESIDENT_BUDGET"])
+    if env.get("PILOSA_TIER_HIGH_WATERMARK"):
+        cfg.tier.high_watermark = float(env["PILOSA_TIER_HIGH_WATERMARK"])
+    if env.get("PILOSA_TIER_LOW_WATERMARK"):
+        cfg.tier.low_watermark = float(env["PILOSA_TIER_LOW_WATERMARK"])
+    for env_key_, attr_ in (("PILOSA_TIER_IDLE", "idle"),
+                            ("PILOSA_TIER_BLOB_IDLE", "blob_idle"),
+                            ("PILOSA_TIER_INTERVAL", "interval"),
+                            ("PILOSA_TIER_PREFETCH_INTERVAL",
+                             "prefetch_interval"),
+                            ("PILOSA_TIER_PACE", "pace")):
+        if env.get(env_key_):
+            setattr(cfg.tier, attr_, parse_duration(env[env_key_]))
+    if env.get("PILOSA_TIER_COLD_DIR"):
+        cfg.tier.cold_dir = env["PILOSA_TIER_COLD_DIR"]
+    if env.get("PILOSA_TIER_BLOB"):
+        cfg.tier.blob = env["PILOSA_TIER_BLOB"]
     if env.get("PILOSA_PLUGINS_PATH"):
         cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
     if env.get("PILOSA_FAULT_ENABLED"):

@@ -70,3 +70,8 @@ def pytest_configure(config):
                    "footer/scrub/quarantine/repair units run tier-1,"
                    " the real 3-node bit-flip chaos legs are"
                    " additionally `slow`")
+    config.addinivalue_line(
+        "markers", "tier: tiered-storage tests (ISSUE 16) — "
+                   "demotion/faulting/blob/eviction/prefetch units and"
+                   " fast failpoint legs run tier-1, the SIGKILL crash"
+                   " legs and soaks are additionally `slow`")
