@@ -80,6 +80,7 @@ _CANONICAL_ARTIFACTS = {
     "latency_under_load": "LATENCY.json",
     "tenant_isolation": "TENANTS.json",
     "tiered": "TIERED.json",
+    "planner": "PLANNER.json",
 }
 
 
@@ -221,6 +222,10 @@ def write_manifest(partial: bool = False) -> None:
     # index 10× over the resident budget (bulk in the blob tier) vs
     # all-resident, zero wrong answers — ISSUE 16's acceptance table.
     out["tiered"] = _TIERED or prior_doc.get("tiered", {})
+    # Cost-based planner A/B (config_planner): skewed multi-operand
+    # speedup legs + the planner+plan-recording overhead guard +
+    # the costmodel-constants fold-back — ISSUE 18's acceptance table.
+    out["planner"] = _PLANNER or prior_doc.get("planner", {})
     measured = _roofline_measured() or prior_doc.get(
         "roofline_measured_constants")
     if measured:
@@ -287,6 +292,15 @@ _TENANT_ISOLATION: dict = {}
 # TIERED.json (ISSUE 16: hot-working-set p99 ≤ 1.2× all-resident
 # while the index is ≥ 10× the resident budget, zero wrong answers).
 _TIERED: dict = {}
+
+# Cost-based planner A/B captured by config_planner() — folded into
+# MANIFEST.json's planner section and written to PLANNER.json
+# (ISSUE 18): planned-vs-unplanned p50 on the skewed multi-operand
+# workload (short-circuit, reorder, cross-query CSE legs; ≥3× target)
+# plus the planner+plan-recording overhead guard on the production
+# default workload (≤1.02 target), and the costmodel-constants
+# fold-back record.
+_PLANNER: dict = {}
 
 
 # Fresh-process measurement: each slice config restarts python, arms
@@ -770,6 +784,215 @@ def config_obs_overhead() -> None:
              target=1.02)
         sampler.disk.close()
         ex.close()
+        holder.close()
+
+
+def config_planner() -> None:
+    """Cost-based planner A/B (ISSUE 18), interleaved alternating
+    groups on ONE holder (shared fragment caches keep the comparison
+    fair — the PR-3 guard pattern):
+
+    - the SKEWED MULTI-OPERAND workload the planner exists for —
+      short-circuit (a 3-operand intersect containing an empty row:
+      unplanned pays the huge∩huge intermediate, planned proves 0
+      without touching a fragment), reorder (tiny operand folded
+      first vs the written huge-first order), and cross-query CSE
+      (a repeated interior union under a varying outer leaf, served
+      from the generation-token-keyed subresult cache) —
+      acceptance: unplanned/planned p50 ≥ 3×;
+    - the production-default workload the planner can only lose on
+      (single-row counts through the full handler path, plan
+      recording + the fingerprint store live) —
+      acceptance: on/off p50 ratio ≤ 1.02;
+    - the costmodel fold-back record: the committed defaults before
+      and after PR 18, plus this rig's persisted calibration.
+    """
+    import io
+    import tempfile
+
+    import numpy as np
+
+    from pilosa_tpu import SLICE_WIDTH
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.parallel import costmodel
+    from pilosa_tpu.server.handler import Handler
+
+    def call(app, method, path, body=b""):
+        environ = {"REQUEST_METHOD": method, "PATH_INFO": path,
+                   "QUERY_STRING": "",
+                   "CONTENT_LENGTH": str(len(body)),
+                   "wsgi.input": io.BytesIO(body)}
+        out = {}
+
+        def start_response(status, hs):
+            out["status"] = int(status.split()[0])
+
+        list(app(environ, start_response))
+        return out["status"]
+
+    def p50(samples):
+        return sorted(samples)[len(samples) // 2]
+
+    with tempfile.TemporaryDirectory() as d:
+        holder = Holder(os.path.join(d, "data"))
+        holder.open()
+        frame = holder.create_index_if_not_exists("i") \
+            .create_frame_if_not_exists("f")
+        rng = np.random.default_rng(18)
+        n_cols = 4 * SLICE_WIDTH
+        # The skew the planner exploits: two huge rows (0, 1), a band
+        # of medium rows for the shared union, tiny rows, and row 40
+        # empty — rank caches make all of this estimable.
+        huge = max(60_000, int(150_000 * SCALE))
+        for row in (0, 1):
+            cols = rng.choice(n_cols, size=huge, replace=False)
+            frame.import_bits(np.full(huge, row, np.uint64),
+                              cols.astype(np.uint64))
+        for row in range(2, 32):
+            cols = rng.choice(n_cols, size=2_000, replace=False)
+            frame.import_bits(np.full(2_000, row, np.uint64),
+                              cols.astype(np.uint64))
+        for row in range(32, 36):
+            cols = rng.choice(n_cols, size=50, replace=False)
+            frame.import_bits(np.full(50, row, np.uint64),
+                              cols.astype(np.uint64))
+
+        planned = Executor(holder, host="local")
+        unplanned = Executor(holder, host="local")
+        unplanned.planner_enabled = False
+
+        union = ", ".join(f"Bitmap(rowID={r}, frame=f)"
+                          for r in range(2, 32))
+        legs = {
+            # Written worst-first: empty row LAST, huge rows first.
+            "short_circuit":
+                lambda i: ("Count(Intersect(Bitmap(rowID=0, frame=f),"
+                           " Bitmap(rowID=1, frame=f),"
+                           " Bitmap(rowID=40, frame=f)))"),
+            "reorder":
+                lambda i: (f"Count(Intersect(Bitmap(rowID=0, frame=f),"
+                           f" Bitmap(rowID=1, frame=f),"
+                           f" Bitmap(rowID={32 + i % 4}, frame=f)))"),
+            "cse":
+                lambda i: (f"Count(Intersect(Union({union}),"
+                           f" Bitmap(rowID={2 + i % 30}, frame=f)))"),
+        }
+
+        def run_group(ex, leg_fn, samples, n, base):
+            for i in range(n):
+                # Both modes clear the whole-result cache identically:
+                # it would collapse repeats for both sides and measure
+                # nothing (the subresult cache under test is interior-
+                # node, token-keyed — it survives this clear).
+                ex._bitmap_results.clear()
+                q = leg_fn(base + i)
+                t0 = time.perf_counter()
+                ex.execute("i", q)
+                samples.append(time.perf_counter() - t0)
+
+        rounds = max(4, int(8 * SCALE))
+        group_n = 6
+        leg_results: dict = {}
+        workload_planned: list = []
+        workload_unplanned: list = []
+        for leg, leg_fn in legs.items():
+            a: list = []
+            b: list = []
+            # Warm both paths once (fragment row caches, rank caches,
+            # and the CSE second-sighting threshold) outside the
+            # measured groups.
+            run_group(planned, leg_fn, [], 3, 0)
+            run_group(unplanned, leg_fn, [], 3, 0)
+            for r in range(rounds):
+                run_group(unplanned, leg_fn, b, group_n, r * group_n)
+                run_group(planned, leg_fn, a, group_n, r * group_n)
+            leg_results[leg] = {
+                "planned_p50_ms": round(p50(a) * 1e3, 4),
+                "unplanned_p50_ms": round(p50(b) * 1e3, 4),
+                "speedup": round(p50(b) / max(p50(a), 1e-9), 2),
+            }
+            workload_planned.extend(a)
+            workload_unplanned.extend(b)
+            emit(f"planner_{leg}_speedup",
+                 leg_results[leg]["speedup"], "x_unplanned_vs_planned",
+                 planned_p50_ms=leg_results[leg]["planned_p50_ms"],
+                 unplanned_p50_ms=leg_results[leg]["unplanned_p50_ms"])
+        skew_speedup = (p50(workload_unplanned)
+                        / max(p50(workload_planned), 1e-9))
+        emit("planner_skewed_workload_speedup", skew_speedup,
+             "x_unplanned_vs_planned", target=3.0)
+
+        # Overhead guard: the handler path (plan recording, the
+        # fingerprint store, ctx stitching all live) on single-row
+        # counts the planner cannot improve.
+        handler = Handler(holder, planned, host="local")
+        simple = [f"Count(Bitmap(rowID={r}, frame=f))".encode()
+                  for r in range(2, 32)]
+
+        def run_simple(samples, n=40):
+            for i in range(n):
+                planned._bitmap_results.clear()
+                t0 = time.perf_counter()
+                status = call(handler, "POST", "/index/i/query",
+                              simple[i % len(simple)])
+                samples.append(time.perf_counter() - t0)
+                assert status == 200, status
+
+        run_simple([], 20)  # warm
+        on_s: list = []
+        off_s: list = []
+        for _ in range(rounds):
+            planned.planner_enabled = False
+            run_simple(off_s)
+            planned.planner_enabled = True
+            run_simple(on_s)
+        overhead = p50(on_s) / max(p50(off_s), 1e-9)
+        emit("planner_overhead_ratio", overhead, "x_on_vs_off",
+             target=1.02, on_p50_ms=round(p50(on_s) * 1e3, 4),
+             off_p50_ms=round(p50(off_s) * 1e3, 4))
+
+        snap = planned.planner.snapshot()
+        cal = costmodel.default_calibration()
+        table = {
+            "legs": leg_results,
+            "skewed_workload_speedup": round(skew_speedup, 2),
+            "target_speedup": 3.0,
+            "overhead": {
+                "on_p50_ms": round(p50(on_s) * 1e3, 4),
+                "off_p50_ms": round(p50(off_s) * 1e3, 4),
+                "ratio": round(overhead, 4),
+                "target_ratio": 1.02,
+                "samples_per_mode": len(on_s),
+            },
+            "planner_snapshot": snap,
+            "constants": {
+                # PR 18 folded measured medians back into the
+                # committed Calibration defaults (the old hand-picked
+                # upload/pack numbers over-estimated pack rate ~16x).
+                "before": {"upload_bps": 1.0e9, "pack_bps": 2.0e9},
+                "after": {
+                    "sync_s": costmodel.DEFAULT_SYNC_S,
+                    "host_bps": costmodel.DEFAULT_HOST_BPS,
+                    "upload_bps": costmodel.DEFAULT_UPLOAD_BPS,
+                    "pack_bps": costmodel.DEFAULT_PACK_BPS,
+                },
+                "this_rig": {
+                    "sync_s": cal.sync_s, "host_bps": cal.host_bps,
+                    "upload_bps": cal.upload_bps,
+                    "pack_bps": cal.pack_bps,
+                },
+            },
+            "rounds": rounds, "group_n": group_n,
+            "device": USE_DEVICE,
+        }
+        _PLANNER.update(table)
+        with open(os.path.join(os.path.dirname(
+                os.path.abspath(__file__)), "PLANNER.json"),
+                "w") as f:
+            json.dump(table, f, indent=1)
+        planned.close()
+        unplanned.close()
         holder.close()
 
 
@@ -3072,6 +3295,7 @@ def main(argv: Optional[list] = None) -> None:
                config_obs_overhead,
                config_obs_history,
                config_scrub_overhead,
+               config_planner,
                config_query_cost,
                config_container_mix,
                config_compile_stability,

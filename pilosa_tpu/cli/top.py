@@ -233,6 +233,35 @@ def render(cur: Snapshot, prev: Optional[Snapshot],
             + f"{_fmt_s(_quantile(lb, 0.99)):>10}")
     lines.append("")
 
+    # Planner panel: is the cost-based planner helping — CSE cache hit
+    # rate, short-circuits per second, and the estimator's tail error
+    # (misestimation ratio p99; ~1.0 means estimates track actuals).
+    cse_hit = _rate(cur, prev,
+                    "pilosa_planner_subresult_cache_events_total",
+                    event="hit")
+    cse_miss = _rate(cur, prev,
+                     "pilosa_planner_subresult_cache_events_total",
+                     event="miss")
+    sc = _rate(cur, prev, "pilosa_planner_decisions_total",
+               outcome="short_circuit")
+    mb, _ms, _mc = _delta_hist(cur, prev,
+                               "pilosa_planner_misestimation_ratio")
+    mis_p99 = _quantile(mb, 0.99)
+    if any(v is not None for v in (cse_hit, cse_miss, sc, mis_p99)):
+        row = "planner "
+        if cse_hit is not None and cse_miss is not None \
+                and cse_hit + cse_miss > 0:
+            pct = 100.0 * cse_hit / (cse_hit + cse_miss)
+            row += f"  cse hit {pct:5.1f}%"
+        else:
+            row += "  cse hit     -"
+        row += (f"   short-circuit {sc:6.1f}/s" if sc is not None
+                else "   short-circuit     -")
+        row += (f"   misest p99 {mis_p99:6.2f}x" if mis_p99 is not None
+                else "   misest p99     -")
+        lines.append(row)
+        lines.append("")
+
     # p99 sparkline from the fleet history (mean across nodes/lanes
     # per tick).
     series = [s for s in (cur.history.get("series") or [])

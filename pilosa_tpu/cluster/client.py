@@ -26,6 +26,7 @@ from ..errors import (FragmentNotFoundError, PilosaError,
 from ..fault import failpoints as _fp
 from ..obs.accounting import COST_HEADER
 from ..obs.trace import SPANS_HEADER, TRACE_HEADER
+from ..plan import record as plan_record
 from ..pql import parser as pql
 from ..proto import internal_pb2 as pb
 from ..sched import context as sched_context
@@ -409,6 +410,7 @@ class Client:
         ctx = sched_context.current()
         trace = getattr(ctx, "trace", None) if ctx is not None else None
         cost = getattr(ctx, "cost", None) if ctx is not None else None
+        plan = getattr(ctx, "plan", None) if ctx is not None else None
         # Tenant principal (sched.tenants, the X-Pilosa-Deadline
         # pattern): the remote leg schedules its device work, accounts
         # its costs, and enforces cost ceilings under the SAME tenant
@@ -420,7 +422,7 @@ class Client:
         headers_out: Optional[list] = None
         if trace is not None:
             headers[TRACE_HEADER] = "1"
-        if (trace is not None or cost is not None
+        if (trace is not None or cost is not None or plan is not None
                 or self.gens is not None or gens_out is not None):
             headers_out = []
         target = _host_of(node) if node is not None else self.host
@@ -438,6 +440,9 @@ class Client:
                     trace.add_remote_json(hv)
                 elif cost is not None and lk == COST_HEADER.lower():
                     cost.add_remote_json(hv)
+                elif (plan is not None
+                      and lk == plan_record.PLAN_HEADER.lower()):
+                    plan.add_remote_json(hv)
                 elif lk == gens_mod.GENERATIONS_HEADER.lower():
                     if gens_out is not None:
                         gens_out.append((target, hv))

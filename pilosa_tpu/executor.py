@@ -41,6 +41,9 @@ from .errors import (TIME_FORMAT, FrameNotFoundError, IndexNotFoundError,
 from .obs import accounting as obs_accounting
 from .obs import metrics as obs_metrics
 from .obs import trace as obs_trace
+from .plan import planner as plan_planner
+from .plan import record as plan_record
+from .plan import store as plan_store
 from .sched import context as sched_context
 from . import SLICE_WIDTH
 from .models.view import VIEW_INVERSE, VIEW_STANDARD
@@ -55,6 +58,21 @@ from .utils import timequantum as tq
 
 # Frame used when a call does not specify one (executor.go:35).
 DEFAULT_FRAME = "general"
+
+
+def _attach_plan_nodes(call: Call, node) -> None:
+    """Pair the planner's PlanNode tree onto the cloned Call tree via a
+    ``_plan_node`` attribute — the per-slice hooks run on mapper-pool
+    threads where no request context is visible, so the hint has to
+    travel with the call itself. The planner clones calls 1:1 with the
+    nodes it emits (drops happen to both sides together), so a zip is
+    exact; a length mismatch would mean a planner bug and we stop
+    attaching rather than mis-pair hints."""
+    call._plan_node = node
+    if len(call.children) != len(node.children):
+        return
+    for ch_call, ch_node in zip(call.children, node.children):
+        _attach_plan_nodes(ch_call, ch_node)
 
 # Lowest count used in a TopN when no threshold is given (executor.go:39).
 MIN_THRESHOLD = 1
@@ -268,6 +286,18 @@ class Executor:
         # _open flag — a deleted or recreated frame closes its
         # fragments, which forces re-resolution.
         self._wfast_frag: dict[tuple, tuple] = {}
+        # Cost-based planner (pilosa_tpu.plan): consulted once per
+        # read query before the cluster-cache key — reorder,
+        # short-circuit, CSE via the token-keyed subresult cache, and
+        # per-subtree placement. The per-executor flag plus the module
+        # switch (plan.record.set_enabled / PILOSA_TPU_PLANNER=0)
+        # restore the unplanned dispatcher for A/B measurement.
+        self.planner = plan_planner.Planner(holder,
+                                            margin=self._cost_margin)
+        self.planner_enabled = True
+        # Per-fingerprint plan store behind GET /debug/plans (the
+        # handler records finished coordinator queries into it).
+        self.plan_store = plan_store.PlanStore()
 
     def _pool(self, tier: str) -> ThreadPoolExecutor:
         with self._pools_mu:
@@ -463,6 +493,15 @@ class Executor:
         if _has_only_set_row_attrs(query.calls):
             return self._execute_bulk_set_row_attrs(index, query.calls, opt)
 
+        # Cost-based planning (pilosa_tpu.plan): read queries are
+        # rewritten BEFORE the cluster-cache key is computed, so the
+        # cache keys the planned canonical form. Planning failure is
+        # never a query failure — the original tree executes.
+        plan_rec = None
+        if needs and slices:
+            query, plan_rec = self._maybe_plan(index, query, slices,
+                                               opt)
+
         # Coordinator hot-query result cache (cluster.generations):
         # repeated read queries over a distributed slice set serve at
         # ~RTT floor — one /generations token probe per involved peer
@@ -512,8 +551,16 @@ class Executor:
                     raise FrameNotFoundError(frame_name)
                 if call.is_inverse(frame.row_label, column_label):
                     call_slices = inverse_slices
-            results.append(self._execute_call(index, call, call_slices,
-                                              opt))
+            analyze_call = (plan_rec is not None
+                            and (plan_rec.sample or plan_rec.analyze))
+            if analyze_call:
+                t_call = time.perf_counter()
+            r = self._execute_call(index, call, call_slices, opt)
+            if analyze_call:
+                self._plan_record_actual(call, r,
+                                         time.perf_counter() - t_call,
+                                         plan_rec)
+            results.append(r)
             i += 1
         if cluster_key is not None:
             self._cluster_cache_store(cluster_key, index, slices,
@@ -542,6 +589,80 @@ class Executor:
         if c.name == "SetFieldValue":
             return self._execute_set_field_value(index, c, opt)
         return self._execute_bitmap_call(index, c, slices, opt)
+
+    # -- cost-based planning (pilosa_tpu.plan) -------------------------------
+
+    def _maybe_plan(self, index: str, query: Query, slices: list[int],
+                    opt: ExecOptions):
+        """Plan a read query: returns (query', PlanRecord) — the
+        planned clone when planning applies, the original (query,
+        None) otherwise. The plan tree rides each cloned Call as
+        ``_plan_node`` (the per-slice hooks read it without a context
+        lookup) and the record attaches to ``ctx.plan`` for the
+        observability plane."""
+        if (self.planner is None or not self.planner_enabled
+                or not plan_record.enabled()):
+            return query, None
+        if query.write_calls():
+            return query, None
+        try:
+            all_local = self._owns_all_slices(index, slices)
+        except Exception:  # noqa: BLE001 - locality is advisory here
+            all_local = False
+        try:
+            planned, rec = self.planner.plan_query_cached(
+                index, query.calls, slices, all_local=all_local,
+                node=self.host)
+        except Exception:  # noqa: BLE001 - planning never fails a query
+            return query, None
+        for call, node in zip(planned, rec.roots):
+            # Memo hits return calls already carrying their plan node.
+            if getattr(call, "_plan_node", None) is not node:
+                _attach_plan_nodes(call, node)
+        ctx = opt.ctx
+        if ctx is not None:
+            rec.analyze = bool(getattr(ctx, "profile", False))
+            ctx.plan = rec
+        return Query(planned), rec
+
+    def _plan_record_actual(self, call: Call, result, elapsed_s: float,
+                            rec: plan_record.PlanRecord) -> None:
+        """ANALYZE half: stamp per-call wall time always, and actual
+        cardinality where it is free (Count results) or requested
+        (?profile=1 pays one count() walk of the result)."""
+        node = getattr(call, "_plan_node", None)
+        if node is None:
+            return
+        node.actual_s = elapsed_s
+        try:
+            if isinstance(result, int) and not isinstance(result, bool):
+                plan_planner._observe_misestimate(node, result)
+            elif rec.analyze and hasattr(result, "count"):
+                plan_planner._observe_misestimate(node, result.count())
+        except Exception:  # noqa: BLE001 - observability only
+            pass
+
+    def explain(self, index: str, query,
+                slices: Optional[list[int]] = None) -> dict:
+        """EXPLAIN-only (?plan=1): plan the query without executing
+        and return the plan tree."""
+        if isinstance(query, str):
+            query = parse_pql(query)
+        if not isinstance(query, Query):
+            raise QueryRequiredError("query required")
+        if query.write_calls():
+            raise PilosaError("cannot EXPLAIN a write query")
+        if not slices:
+            idx = self.holder.index(index)
+            if idx is None:
+                raise IndexNotFoundError(index)
+            slices = list(range(idx.max_slice() + 1))
+        try:
+            all_local = self._owns_all_slices(index, slices)
+        except Exception:  # noqa: BLE001
+            all_local = False
+        return self.planner.explain(index, query.calls, slices,
+                                    all_local=all_local)
 
     def _owns_all_slices(self, index: str, slices: list[int]) -> bool:
         """True when THIS node holds a replica of every slice the query
@@ -979,6 +1100,10 @@ class Executor:
 
     def _execute_bitmap_call(self, index: str, c: Call, slices: list[int],
                              opt: ExecOptions) -> Bitmap:
+        pnode = getattr(c, "_plan_node", None)
+        if pnode is not None and pnode.short_circuit:
+            obs_metrics.PLANNER_DECISIONS.labels("short_circuit_hit").inc()
+            return Bitmap()
         compiled: list = []
         key = self._bitmap_result_key(index, c, slices, compiled)
         if key is not None:
@@ -1031,6 +1156,38 @@ class Executor:
                 bm.attrs = frame.row_attr_store.attrs(row_id)
 
     def _bitmap_call_slice(self, index: str, c: Call, slice: int) -> Bitmap:
+        # Plan consult (when the call was planned): proven-empty
+        # subtrees return without touching storage, and CSE-marked
+        # interior nodes go through the generation-token-keyed
+        # subresult cache. The cache key embeds the (uid, generation)
+        # token of every fragment the subtree reads, so a write
+        # between queries changes the key — stale entries are never
+        # served, they just age out of the LRU.
+        node = getattr(c, "_plan_node", None)
+        if node is not None:
+            if node.short_circuit:
+                return Bitmap()
+            if node.cache_lookup and self.planner is not None:
+                try:
+                    key = self.planner.subresult_key(index, node, slice)
+                except Exception:  # noqa: BLE001 - cache is best-effort
+                    key = None
+                if key is not None:
+                    hit = self.planner.subresults.get(key)
+                    if hit is not None:
+                        return self._share_result(hit)
+                    r = self._bitmap_slice_dispatch(index, c, slice)
+                    if node.cache_store:
+                        try:
+                            self.planner.subresults.put(
+                                key, self._share_result(r), r.count())
+                        except Exception:  # noqa: BLE001
+                            pass
+                    return r
+        return self._bitmap_slice_dispatch(index, c, slice)
+
+    def _bitmap_slice_dispatch(self, index: str, c: Call,
+                               slice: int) -> Bitmap:
         # executor.go:253-268
         if c.name == "Bitmap":
             return self._bitmap_slice(index, c, slice)
@@ -1459,6 +1616,10 @@ class Executor:
             raise PilosaError("Count() requires an input bitmap")
         if len(c.children) > 1:
             raise PilosaError("Count() only accepts a single bitmap input")
+        pnode = getattr(c, "_plan_node", None)
+        if pnode is not None and pnode.short_circuit:
+            obs_metrics.PLANNER_DECISIONS.labels("short_circuit_hit").inc()
+            return 0
 
         # Count(Intersect(A, B)) host legs count WITHOUT materializing
         # the intersection — the reference's IntersectionCount shortcut
@@ -1869,6 +2030,9 @@ class Executor:
         if (not self.use_mesh or self.pod is not None
                 or self._mesh_backoff_active()):
             return None  # pod host legs own pod materialization
+        pnode = getattr(c, "_plan_node", None)
+        if pnode is not None and pnode.placement == "host":
+            return None  # planner priced the subtree cheaper on host
         if c.name == "Range" and c.condition_arg() is not None:
             return self._field_range_local_device_fn(index, c)
         if c.name not in ("Union", "Intersect", "Difference"):
@@ -1989,6 +2153,9 @@ class Executor:
             return None
         if self.pod is None and self._mesh_backoff_active():
             return None
+        pnode = getattr(child, "_plan_node", None)
+        if pnode is not None and pnode.placement == "host":
+            return None  # planner priced the subtree cheaper on host
         leaves: list[tuple] = []
         expr = self._compile_device_expr(index, child, leaves)
         if expr is None:
@@ -2075,6 +2242,10 @@ class Executor:
             except Exception:  # noqa: BLE001 - never fail a query on this
                 self._cost_model_enabled = False
                 return True
+            # Share the measured constants with the planner so its
+            # host/device placement prices match the executor's veto.
+            if self.planner is not None:
+                self.planner.calibration = self.cost_model.cal
         from .ops.packed import WORDS_PER_SLICE
         row_bytes = n_slices * WORDS_PER_SLICE * 4
         host_bytes = (host_rows * row_bytes if host_rows is not None

@@ -875,6 +875,32 @@ SENTINEL_CHECKS = _DEFAULT.counter(
     "pilosa_sentinel_checks_total",
     "Regression-sentinel evaluation passes (every rule, every pass)")
 
+# -- query planner (pilosa_tpu/plan; docs/OBSERVABILITY.md EXPLAIN) -----------
+PLANNER_DECISIONS = _DEFAULT.counter(
+    "pilosa_planner_decisions_total",
+    "Planner decisions taken, by outcome (planned / reordered /"
+    " short_circuit / cse / placement) — every read query lands at"
+    " least one 'planned'",
+    labels=("outcome",))
+PLANNER_MISESTIMATE = _DEFAULT.histogram(
+    "pilosa_planner_misestimation_ratio",
+    "Actual/estimated cardinality ratio per measured plan node"
+    " ((actual+1)/(est+1)): 1.0 = perfect, the sentinel's"
+    " planner_misestimate rule fires on a sustained p99 drift",
+    buckets=(0.015625, 0.03125, 0.0625, 0.125, 0.25, 0.5, 1.0, 2.0,
+             4.0, 8.0, 16.0, 32.0, 64.0))
+PLANNER_SUBRESULT_EVENTS = _DEFAULT.counter(
+    "pilosa_planner_subresult_cache_events_total",
+    "Generation-token-keyed interior-node subresult cache events"
+    " (hit / miss / store / evict) — the cross-query CSE plane",
+    labels=("event",))
+PLANNER_PLAN_SECONDS = _DEFAULT.histogram(
+    "pilosa_planner_plan_seconds",
+    "Wall seconds spent planning one read query (estimation +"
+    " rewrite) — the overhead-guard numerator, before execution",
+    buckets=(0.00001, 0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+             0.5, 1.0))
+
 
 # -- legacy StatsClient bridge ------------------------------------------------
 

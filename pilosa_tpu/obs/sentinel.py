@@ -80,6 +80,16 @@ DEFAULT_WATCHES = (
 DEFAULT_TENANT_BURN_FAMILY = "pilosa_tenant_slo_burn_rate_ratio"
 DEFAULT_TENANT_BURN_THRESHOLD = 10.0
 
+# Planner misestimation rule (absolute): the planner's per-node
+# (actual+1)/(est+1) ratio distribution. A p99 sustained past this
+# means the cardinality estimator is off by ~an order of magnitude on
+# the tail — plans reorder/place on numbers that are wrong, so the
+# finding points at the estimator (stale rank caches, skew past the
+# sampler) before users notice the slow plans it picks.
+DEFAULT_PLANNER_MISEST_FAMILY = \
+    "pilosa_planner_misestimation_ratio:p99"
+DEFAULT_PLANNER_MISEST_THRESHOLD = 8.0
+
 # Manifest envelope rules: (manifest metrics key, live series name,
 # unit scale manifest→seconds). Only the committed keys that map
 # cleanly onto a live series ride the default catalogue; a missing
@@ -132,7 +142,9 @@ class Sentinel:
                  manifest_tolerance: float = DEFAULT_MANIFEST_TOLERANCE,
                  watches=DEFAULT_WATCHES,
                  tenant_burn_threshold: float
-                 = DEFAULT_TENANT_BURN_THRESHOLD, logger=None):
+                 = DEFAULT_TENANT_BURN_THRESHOLD,
+                 planner_misest_threshold: float
+                 = DEFAULT_PLANNER_MISEST_THRESHOLD, logger=None):
         from ..utils import logger as logger_mod
         self.history = history
         self.registry = registry    # sched.QueryRegistry
@@ -150,6 +162,7 @@ class Sentinel:
         self.manifest_tolerance = float(manifest_tolerance)
         self.watches = tuple(watches)
         self.tenant_burn_threshold = float(tenant_burn_threshold)
+        self.planner_misest_threshold = float(planner_misest_threshold)
         self.logger = logger or logger_mod.NOP
         self.findings: list[dict] = []   # newest last, bounded
         self.checks = 0
@@ -292,6 +305,28 @@ class Sentinel:
                         "direction": "up",
                         "recentMedian": round(rm, 4),
                         "threshold": self.tenant_burn_threshold,
+                        "windowS": self.window_s})
+        # Planner misestimation rule: absolute threshold over the
+        # misestimation-ratio p99 series (plan.planner observes
+        # (actual+1)/(est+1) per node as actuals land).
+        if self.planner_misest_threshold > 0:
+            for key in hist.keys():
+                name, labels = split_key(key)
+                if name != DEFAULT_PLANNER_MISEST_FAMILY:
+                    continue
+                recent = hist.window_values(
+                    key, now - self.window_s, now + 1.0)
+                if len(recent) < self.min_points:
+                    continue
+                rm = _median(recent)
+                if rm > self.planner_misest_threshold:
+                    out.append({
+                        "rule": "planner_misestimate",
+                        "metric": DEFAULT_PLANNER_MISEST_FAMILY,
+                        "series": key, "labels": labels,
+                        "direction": "up",
+                        "recentMedian": round(rm, 4),
+                        "threshold": self.planner_misest_threshold,
                         "windowS": self.window_s})
         # Manifest envelope rules.
         metrics = self._manifest_metrics()

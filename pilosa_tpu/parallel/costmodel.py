@@ -43,13 +43,25 @@ import numpy as np
 # startup probe. ~400 GB/s is v5e-class effective rate.
 DEVICE_BPS = 4.0e11
 
+# Committed default constants — the MEASURED medians from the planner
+# calibration pass (benchmarks/suite.py config_planner, MANIFEST
+# ``planner.constants``), used before this machine's own calibration
+# exists (planner placement pricing at cold start, tests). The startup
+# probe + the drift loop supersede them at first mesh use. The earlier
+# hand-picked defaults (upload 1.0e9, pack 2.0e9) over-estimated the
+# roaring→dense pack rate ~16×, making cold uploads look cheap.
+DEFAULT_SYNC_S = 1.5e-5      # direct-attached dispatch+fetch floor
+DEFAULT_HOST_BPS = 9.2e9     # roaring intersection-count rate
+DEFAULT_UPLOAD_BPS = 1.7e9   # host→device transfer rate
+DEFAULT_PACK_BPS = 1.3e8     # host-side roaring→dense pack rate
+
 
 @dataclass
 class Calibration:
     sync_s: float       # one dispatch + fetch round trip, seconds
     host_bps: float     # roaring count throughput, bytes/second
-    upload_bps: float = 1.0e9   # host→device transfer rate (measured)
-    pack_bps: float = 2.0e9     # host-side roaring→dense pack rate
+    upload_bps: float = DEFAULT_UPLOAD_BPS  # host→device transfer rate
+    pack_bps: float = DEFAULT_PACK_BPS  # roaring→dense pack rate
     # Drift-correction multipliers, adjusted by the feedback loop when
     # predicted and observed leg costs diverge (CostModel.record).
     host_scale: float = 1.0
@@ -96,8 +108,9 @@ class Calibration:
     def from_dict(cls, d: dict) -> "Calibration":
         return cls(sync_s=float(d["sync_s"]),
                    host_bps=float(d["host_bps"]),
-                   upload_bps=float(d.get("upload_bps", 1.0e9)),
-                   pack_bps=float(d.get("pack_bps", 2.0e9)),
+                   upload_bps=float(d.get("upload_bps",
+                                          DEFAULT_UPLOAD_BPS)),
+                   pack_bps=float(d.get("pack_bps", DEFAULT_PACK_BPS)),
                    host_scale=float(d.get("host_scale", 1.0)),
                    device_scale=float(d.get("device_scale", 1.0)),
                    stream_scale=float(d.get("stream_scale", 1.0)))
@@ -323,6 +336,26 @@ def _load_calibration(key: str) -> Calibration | None:
             return Calibration.from_dict(json.load(f))
     except (OSError, ValueError, KeyError):
         return None
+
+
+def default_calibration() -> Calibration:
+    """Best available constants WITHOUT touching a mesh: this
+    machine's persisted calibration when one exists (whatever platform
+    it was measured on — the host-side rates carry across and the sync
+    floor is in the right decade), the committed measured defaults
+    otherwise. Used to prime the planner's placement pricing before
+    the first device query calibrates for real (sched.warmup)."""
+    import glob
+    import platform as platform_mod
+    try:
+        pattern = _cal_path(f"{platform_mod.node()}-*")
+        for path in sorted(glob.glob(pattern)):
+            with open(path) as f:
+                return Calibration.from_dict(json.load(f))
+    except (OSError, ValueError, KeyError):
+        pass
+    return Calibration(sync_s=DEFAULT_SYNC_S,
+                       host_bps=DEFAULT_HOST_BPS)
 
 
 def get_model(mesh, margin: float = 0.5) -> CostModel:

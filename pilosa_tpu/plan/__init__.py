@@ -1,0 +1,18 @@
+"""Cost-based query planning + the EXPLAIN/ANALYZE observability plane.
+
+- ``planner`` — the planner itself: cardinality estimation, operand
+  reordering, short-circuiting, CSE via the generation-token-keyed
+  subresult cache, per-subtree placement.
+- ``record`` — plan trees, the per-query ``ctx.plan`` record, the
+  X-Pilosa-Plan stitching wire, the normalized fingerprint.
+- ``store`` — the bounded per-fingerprint store behind /debug/plans.
+"""
+
+from .planner import Planner, SubresultCache
+from .record import (PLAN_HEADER, PlanNode, PlanRecord, enabled,
+                     fingerprint_calls, set_enabled)
+from .store import PlanStore
+
+__all__ = ["Planner", "SubresultCache", "PlanNode", "PlanRecord",
+           "PlanStore", "PLAN_HEADER", "enabled", "set_enabled",
+           "fingerprint_calls"]

@@ -115,6 +115,13 @@ class QueryContext:
         # these so /debug/queries/slow points at the persisted trace.
         self.trace_kept = False
         self.keep_reason = ""
+        # Query-plan attachment (plan.record.PlanRecord), bound by the
+        # executor when the planner handles this query. Same contract
+        # as trace/cost: None means the planner sat this one out.
+        # ``profile`` is the ?profile=1 flag — it asks the executor to
+        # pay for exact per-node actual cardinalities (ANALYZE).
+        self.plan = None
+        self.profile = False
 
     def note_flag(self, name: str) -> None:
         """Record a fault-event flag for the tail sampler (no-op
@@ -221,6 +228,14 @@ class QueryContext:
             # The accounting roll-up rides /debug/queries and the slow
             # log (obs.accounting.QueryCost.summary — totals only).
             out["cost"] = self.cost.summary()
+        if self.plan is not None:
+            # Cross-link only (the traceKept pattern): the fingerprint
+            # keys into /debug/plans for the full tree; the decision
+            # roll-up makes the slow log self-describing.
+            out["planFingerprint"] = self.plan.fingerprint
+            decisions = self.plan.decision_summary()
+            if decisions:
+                out["planDecisions"] = decisions
         return out
 
 

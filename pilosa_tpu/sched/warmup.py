@@ -100,11 +100,27 @@ class Warmup:
             pass
         return n
 
+    def _prime_planner(self) -> None:
+        """Hand the planner its cost constants before the first query:
+        the persisted per-machine calibration when one exists, the
+        committed defaults otherwise. Without this the planner's
+        placement decisions sit out until the first _device_pays call
+        builds the calibrated model."""
+        planner = getattr(self.executor, "planner", None)
+        if planner is None or planner.calibration is not None:
+            return
+        try:
+            from ..parallel import costmodel
+            planner.calibration = costmodel.default_calibration()
+        except Exception:  # noqa: BLE001 - placement hints are optional
+            pass
+
     # -- worker --------------------------------------------------------------
 
     def _run(self) -> None:
         t0 = time.monotonic()
         self.state = "running"
+        self._prime_planner()
         try:
             mesh = self.executor._mesh_or_none()
             if mesh is None:

@@ -36,6 +36,7 @@ from ..obs import accounting as obs_accounting
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
 from ..obs import trace as obs_trace
+from ..plan import record as plan_record
 from ..sched import (KILL_POLICY, KILLED_BY_HEADER, LANE_ADMIN, LANE_READ,
                      LANE_WRITE, AdmissionFullError, QueryContext,
                      QueryRegistry)
@@ -384,6 +385,7 @@ class Handler:
         r("GET", "/debug/metrics/history",
           self._handle_metrics_history)
         r("GET", "/debug/cluster", self._handle_debug_cluster)
+        r("GET", "/debug/plans", self._handle_debug_plans)
         r("GET", "/debug/sentinel", self._handle_debug_sentinel)
         r("GET", "/metrics", self._handle_metrics)
         r("GET", "/metrics/cluster", self._handle_metrics_cluster)
@@ -1272,6 +1274,26 @@ class Handler:
              "series": series},
             headers=headers)
 
+    def _handle_debug_plans(self, req: Request) -> Response:
+        """The bounded per-fingerprint plan store (plan.store): hit
+        counts, latency p50/p99, est-vs-actual drift, and the last
+        observed plan per normalized query shape; ``?limit=N`` bounds
+        the listing (hottest fingerprints first). The planner's own
+        state (decision totals, subresult cache) rides along."""
+        try:
+            limit = max(1, int(req.query.get("limit", "64")))
+        except ValueError:
+            raise HTTPError(400, "invalid limit")
+        out = {"enabled": plan_record.enabled()}
+        ex = self.executor
+        store = getattr(ex, "plan_store", None)
+        if store is not None:
+            out.update(store.snapshot(limit=limit))
+        planner = getattr(ex, "planner", None)
+        if planner is not None:
+            out["planner"] = planner.snapshot()
+        return Response.json(out)
+
     def _handle_debug_sentinel(self, req: Request) -> Response:
         """The regression sentinel's state: recent findings, active
         conditions, and the rule thresholds (obs.sentinel)."""
@@ -1536,6 +1558,17 @@ class Handler:
             return error_resp(400, str(e))
         parse_s = time_mod.perf_counter() - parse_t0
 
+        if req.query.get("plan") == "1" and not remote:
+            # EXPLAIN-only: plan the query without executing. The
+            # response mirrors ?profile=1's plan block with empty
+            # results — estimates and decisions but no actuals.
+            try:
+                tree = self.executor.explain(index_name, query,
+                                             slices or None)
+            except PilosaError as e:
+                return error_resp(400, str(e))
+            return Response.json({"results": [], "plan": tree})
+
         # Lifecycle: classify the lane, build the QueryContext (remote
         # legs inherit the coordinator's id + remaining budget via
         # headers), admit, register for /debug/queries visibility.
@@ -1555,6 +1588,10 @@ class Handler:
             id=self.environ_header(req, "HTTP_X_PILOSA_QUERY_ID") or None,
             remote=remote, node=self.host, tenant=tenant)
         ctx.stages["parse"] = parse_s
+        # ?profile=1 asks for EXPLAIN ANALYZE: the executor fills in
+        # exact per-node actual cardinalities (it pays one count()
+        # walk per planned call) on top of the always-on wall times.
+        ctx.profile = req.query.get("profile") == "1"
         # Resource accounting (obs.accounting): every query gets a cost
         # ledger — container ops by kind, device bytes, compile ms, RPC
         # bytes — unless accounting is switched off. Remote legs keep
@@ -1614,6 +1651,11 @@ class Handler:
                 if remote:
                     hs.append((obs_accounting.COST_HEADER,
                                ctx.cost.wire_json(dict(ctx.stages))))
+            if ctx.plan is not None and remote:
+                # Remote legs piggyback their plan for the
+                # coordinator to stitch (the cost-tree contract).
+                hs.append((plan_record.PLAN_HEADER,
+                           ctx.plan.wire_json()))
             return hs
         # Register BEFORE admission so queued queries are visible at
         # /debug/queries and cancellable while they wait (a DELETE or
@@ -1740,6 +1782,17 @@ class Handler:
                     # this query carries its resource ledger.
                     trace.add_span("query_cost", ctx.started_wall, 0.0,
                                    tags=ctx.cost.summary())
+                if ctx.plan is not None and (ctx.plan.sample
+                                             or ctx.plan.analyze):
+                    # Kept traces carry the plan fingerprint and the
+                    # decision summary — a slow trace names the plan
+                    # that produced it (/debug/plans has the tree).
+                    # Sampled out on most plan-memo hits (the ≤2%
+                    # overhead budget); fresh plans always carry it.
+                    tags = {"fingerprint": ctx.plan.fingerprint}
+                    tags.update(ctx.plan.decision_summary())
+                    trace.add_span("query_plan", ctx.started_wall,
+                                   0.0, tags=tags)
                 reason = None
                 if self.sampler is not None:
                     partial = bool(exec_opt is not None
@@ -1777,6 +1830,26 @@ class Handler:
                         reason = trace.keep_reason or reason
                 ctx.trace_kept = reason is not None
                 ctx.keep_reason = reason or ""
+            if (ctx.plan is not None and not remote
+                    and (ctx.plan.sample or ctx.plan.analyze)):
+                # Per-fingerprint aggregation behind /debug/plans —
+                # coordinator-only so a fleet of remote legs does not
+                # multiply one query into N rows. Fresh plans and a
+                # 1-in-16 slice of memo hits record (an unbiased
+                # duration reservoir); the rest skip the bookkeeping.
+                est = actual = None
+                for root in ctx.plan.roots:
+                    if (root.est_rows is not None
+                            and root.actual_rows is not None):
+                        est, actual = root.est_rows, root.actual_rows
+                        break
+                try:
+                    self.executor.plan_store.record(
+                        ctx.plan.fingerprint, ctx.plan.to_tree,
+                        ctx.elapsed(), pql=query_str,
+                        est_rows=est, actual_rows=actual)
+                except Exception:  # noqa: BLE001 - observability only
+                    pass
             self.registry.finish(ctx, error=err)
             # Latency histogram + outcome counter, labeled by call
             # type / lane / status (obs.metrics) — recorded for every
@@ -1854,6 +1927,11 @@ class Handler:
                 # per-stage cost tree rides inline with the results
                 # (remote legs' ledgers arrived as stitched children).
                 payload["profile"] = ctx.cost.to_tree(dict(ctx.stages))
+            if req.query.get("profile") == "1" and ctx.plan is not None:
+                # The chosen plan with per-node est-vs-actual rows and
+                # wall time, remote legs stitched in from
+                # X-Pilosa-Plan headers.
+                payload["plan"] = ctx.plan.to_tree()
             return Response.json(payload, headers=qid_hdr)
 
     # -- attr diff (anti-entropy) --------------------------------------------

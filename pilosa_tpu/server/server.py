@@ -1266,6 +1266,12 @@ class Server:
                                            "device_fallbacks", 0),
                 "costModelVetoes": getattr(self.executor,
                                            "cost_vetoes", 0)}
+            planner = getattr(self.executor, "planner", None)
+            if planner is not None:
+                # Decision totals + subresult-cache occupancy: "was
+                # the planner rewriting when it went wrong" is a
+                # first-hour retro question.
+                out["planner"] = planner.snapshot()
         if self.watchdog is not None:
             out["watchdog"] = self.watchdog.snapshot()
         # Elastic resize state: phase, movement progress, epoch — the

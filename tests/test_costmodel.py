@@ -55,8 +55,11 @@ class TestDecision:
 
     def test_cold_upload_cheap_on_direct_attach(self):
         # Direct-attached: 20 GB/s transfers make the same cold block a
-        # device win again.
-        cal = Calibration(sync_s=0.001, host_bps=1.0e9, upload_bps=2.0e10)
+        # device win again. pack_bps is pinned — this hypothetical rig
+        # packs at memory speed; the shipped default is the measured
+        # (much slower) CPU-rig rate and isn't under test here.
+        cal = Calibration(sync_s=0.001, host_bps=1.0e9,
+                          upload_bps=2.0e10, pack_bps=2.0e9)
         bytes_ = block_bytes(1000, 10)
         assert CostModel(cal).device_pays(bytes_, cold_bytes=bytes_)
 

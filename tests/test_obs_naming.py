@@ -227,6 +227,33 @@ class TestRouteTableDocumented:
         assert fams["pilosa_watchdog_trips_total"].labelnames == (
             "cause",)
 
+    def test_planner_metrics_registered(self):
+        """ISSUE 18: the pilosa_planner_* families behind the planner
+        observability plane exist in the default registry (and so
+        passed the naming gate at import) with the documented label
+        sets."""
+        fams = obs_metrics.default_registry().families()
+        for name in ("pilosa_planner_decisions_total",
+                     "pilosa_planner_misestimation_ratio",
+                     "pilosa_planner_subresult_cache_events_total",
+                     "pilosa_planner_plan_seconds"):
+            assert name in fams, name
+        assert fams["pilosa_planner_decisions_total"].labelnames == (
+            "outcome",)
+        assert fams[
+            "pilosa_planner_subresult_cache_events_total"
+        ].labelnames == ("event",)
+        assert fams["pilosa_planner_misestimation_ratio"].type == \
+            "histogram"
+        assert fams["pilosa_planner_plan_seconds"].type == "histogram"
+
+    def test_planner_debug_route_registered(self):
+        """GET /debug/plans is wired (the README sweep above enforces
+        documentation)."""
+        handler = Handler(None, None)
+        assert any(pattern == "/debug/plans"
+                   for _m, _r, _f, _l, pattern in handler._routes)
+
     def test_fault_metrics_registered(self):
         """The fault-layer metric families promised by
         docs/FAULT_TOLERANCE.md exist in the default registry (and so
