@@ -346,6 +346,30 @@ def main() -> None:
                     "slices_moved": rz.get("slices_moved"),
                     "zero_wrong_answers": rz.get(
                         "zero_wrong_answers")}
+            # Recorded-traffic replay (suite.config_replay →
+            # REPLAY.json): offered-vs-achieved open-loop QPS of the
+            # scaled captured workload, the self-shadow digest
+            # verdict, and the capture-plane overhead guard — ISSUE
+            # 19's acceptance numbers on the line of record.
+            rp = manifest.get("replay") or {}
+            if rp.get("offered_qps") is not None:
+                shadow = rp.get("shadow") or {}
+                line["replay"] = {
+                    "offered_qps": rp["offered_qps"],
+                    "achieved_qps": rp.get("achieved_qps"),
+                    "shed": rp.get("shed"),
+                    "shadow_self_mismatches": (shadow.get("self")
+                                               or {}).get("mismatches"),
+                    "seeded_fault_detected": (
+                        shadow.get("seeded_fault") or {}).get(
+                            "detected")}
+            co = manifest.get("capture_overhead") or {}
+            if co.get("ratio") is not None:
+                line["capture_overhead"] = {
+                    "ratio": co["ratio"],
+                    "on_p50_ms": co.get("on_p50_ms"),
+                    "off_p50_ms": co.get("off_p50_ms"),
+                    "target_ratio": co.get("target_ratio")}
         except (OSError, ValueError, KeyError):
             pass
         # Serving-quality artifact (sched subsystem): open-loop

@@ -1,0 +1,329 @@
+"""Recorded-traffic replay artifact: capture -> replay -> shadow.
+
+The ROADMAP item-4 sustained-QPS artifact, recorded from real traffic
+shape instead of synthetic arrivals (docs/OBSERVABILITY.md):
+
+1. start an in-process server with ``[capture] mode = "full"`` and
+   drive a mixed read/write workload through HTTP — SetBit /
+   SetFieldValue writes, Bitmap / Count / fused Union-Intersect-
+   Difference trees, TopN, BSI Range reads — so every request lands
+   in the capture ring with its arrival gaps, lane, and digest;
+2. export the stream from /debug/capture/records, tile it to the
+   target length, and re-issue it with the multi-process open-loop
+   driver (pilosa_tpu.obs.replay) compressed to >= 20K QPS offered,
+   recording per-lane p50/p99, shed rates, and achieved-vs-offered
+   QPS honestly (this container's host ceiling decides achieved);
+3. shadow-diff proof: replay the same stream against two identically
+   seeded servers (writes to both in order, read digests compared) —
+   zero mismatches self-vs-self — then flip ONE bit on the candidate
+   side and show the diff catches it, naming the plan fingerprint;
+4. capture-overhead A/B: interleaved on(sampled default)/off groups,
+   p50 ratio target <= 1.02, plus the nop-path proof when disabled.
+
+Writes benchmarks/REPLAY.json and folds MANIFEST ``replay`` +
+``capture_overhead`` sections. Run directly or via
+``benchmarks/suite.py config_replay``.
+
+Env knobs: PILOSA_REPLAY_TARGET_QPS (offered target, default 21000),
+PILOSA_REPLAY_CAPTURE_N (captured query count, default 3000),
+PILOSA_REPLAY_PROCESSES (driver processes, default 4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_DIR))
+
+# The artifact measures the serving/capture/replay planes, not the
+# device: keep the serving path deterministic and CPU-local.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PILOSA_TPU_MESH"] = "0"
+os.environ["PILOSA_TPU_WARMUP"] = "0"
+
+TARGET_QPS = float(os.environ.get("PILOSA_REPLAY_TARGET_QPS", "21000"))
+CAPTURE_N = int(os.environ.get("PILOSA_REPLAY_CAPTURE_N", "3000"))
+PROCESSES = int(os.environ.get("PILOSA_REPLAY_PROCESSES", "4"))
+
+
+def _post(host: str, path: str, body: bytes = b"",
+          timeout: float = 30.0):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, r.read()
+
+
+def _start_server(tmp_dir: str, mode: str = "full"):
+    from pilosa_tpu.server.server import Server
+    from pilosa_tpu.utils.config import CaptureConfig, QueryConfig
+
+    server = Server(
+        tmp_dir, host="127.0.0.1:0",
+        anti_entropy_interval=0, polling_interval=0,
+        query_config=QueryConfig(concurrency=8, queue_depth=64),
+        capture_config=CaptureConfig(mode=mode))
+    server.open()
+    _post(server.host, "/index/i", b"{}")
+    _post(server.host, "/index/i/frame/f", json.dumps(
+        {"options": {"fields": [
+            {"name": "v", "min": 0, "max": 1000}
+        ]}}).encode())
+    return server
+
+
+def _drive_workload(host: str, n: int) -> None:
+    """The captured mixed stream: ~1/8 writes, reads spanning single
+    bitmaps, fused trees, TopN, and BSI Range — the query shapes whose
+    digests the shadow diff must canonicalize."""
+    import random
+    rng = random.Random(19)
+    fused = ('Count(Intersect(Union(Bitmap(rowID=1, frame="f"),'
+             ' Bitmap(rowID=2, frame="f")),'
+             ' Difference(Bitmap(rowID=3, frame="f"),'
+             ' Bitmap(rowID=4, frame="f"))))')
+    for i in range(n):
+        r = i % 8
+        if r == 0:
+            q = (f'SetBit(rowID={rng.randrange(16)}, frame="f",'
+                 f' columnID={rng.randrange(65536)})')
+        elif r == 1:
+            q = (f'SetFieldValue(frame="f",'
+                 f' columnID={rng.randrange(4096)},'
+                 f' v={rng.randrange(1000)})')
+        elif r in (2, 3):
+            q = f'Bitmap(rowID={rng.randrange(16)}, frame="f")'
+        elif r == 4:
+            q = fused
+        elif r == 5:
+            q = 'TopN(frame="f", n=5)'
+        elif r == 6:
+            q = f'Range(frame="f", v > {rng.randrange(500)})'
+        else:
+            q = (f'Count(Union(Bitmap(rowID={rng.randrange(8)},'
+                 f' frame="f"), Bitmap(rowID={rng.randrange(8, 16)},'
+                 f' frame="f")))')
+        _post(host, "/index/i/query", q.encode())
+
+
+def _tile(records: list[dict], copies: int) -> list[dict]:
+    """Concatenate ``copies`` shifted repetitions of the stream — the
+    'scaled captured workload': same shape and mix, longer run."""
+    if copies <= 1 or not records:
+        return records
+    span = (records[-1]["t"] - records[0]["t"]) or 1e-3
+    out: list[dict] = []
+    for c in range(copies):
+        for rec in records:
+            r = dict(rec)
+            r["t"] = r["t"] + c * span
+            if "mono" in r:
+                r["mono"] = r["mono"] + c * span
+            out.append(r)
+    return out
+
+
+def run_replay():
+    """Capture a live mixed workload, then re-drive it multi-process
+    at >= TARGET_QPS offered."""
+    from pilosa_tpu.obs import replay as obs_replay
+
+    with tempfile.TemporaryDirectory() as tmp:
+        server = _start_server(tmp, mode="full")
+        try:
+            t0 = time.perf_counter()
+            _drive_workload(server.host, CAPTURE_N)
+            capture_s = time.perf_counter() - t0
+            records = obs_replay.fetch_records(server.host,
+                                               limit=10000)
+            # Scale: tile the stream so the compressed schedule holds
+            # the offered target for ~1s+, then compress the recorded
+            # gaps to hit TARGET_QPS offered.
+            n_q = sum(1 for r in records if r["kind"] == "query")
+            span = max(1e-3, records[-1]["t"] - records[0]["t"])
+            copies = max(1, int(round(TARGET_QPS * 1.0
+                                      / max(n_q, 1))))
+            tiled = _tile(records, copies)
+            rate = (TARGET_QPS * (span * copies)
+                    / max(n_q * copies, 1))
+            summary = obs_replay.replay(
+                tiled, server.host, rate=rate,
+                processes=PROCESSES, senders=48)
+            summary["captured_records"] = len(records)
+            summary["capture_wall_s"] = round(capture_s, 3)
+            summary["tiled_copies"] = copies
+            summary["target_offered_qps"] = TARGET_QPS
+            return summary, records
+        finally:
+            server.close()
+
+
+def run_shadow(records: list[dict]) -> dict:
+    """Self-shadow proof + seeded-fault detection over the captured
+    stream, against two identically seeded (empty) servers: the
+    shadow write phase replays the captured writes to both in order,
+    so read digests must agree bit-for-bit; then one flipped bit on
+    the candidate must surface as a mismatch naming the plan
+    fingerprint."""
+    from pilosa_tpu.obs import replay as obs_replay
+
+    with tempfile.TemporaryDirectory() as tb, \
+            tempfile.TemporaryDirectory() as tc:
+        base = _start_server(tb, mode="off")
+        cand = _start_server(tc, mode="off")
+        try:
+            self_diff = obs_replay.shadow(records, base.host,
+                                          cand.host, senders=16)
+            # Seeded fault: ONE bit flipped on the candidate only.
+            _post(cand.host, "/index/i/query",
+                  b'SetBit(rowID=1, frame="f", columnID=31337)')
+            fault_diff = obs_replay.shadow(
+                [r for r in records if r.get("lane") == "read"],
+                base.host, cand.host, senders=16)
+        finally:
+            base.close()
+            cand.close()
+    return {
+        "self": {k: v for k, v in self_diff.items() if k != "dumps"},
+        "self_zero_mismatches": self_diff["mismatches"] == 0,
+        "seeded_fault": {
+            "fault": "SetBit(rowID=1, columnID=31337) on candidate"
+                     " only",
+            "mismatches": fault_diff["mismatches"],
+            "detected": fault_diff["mismatches"] > 0,
+            "first_dumps": [
+                {k: d.get(k) for k in ("pql", "plan",
+                                       "baselineDigest",
+                                       "candidateDigest")}
+                for d in fault_diff["dumps"][:3]],
+        },
+    }
+
+
+def run_overhead() -> dict:
+    """Interleaved capture on/off A/B at the sampled default, through
+    the full HTTP stack (the config_obs_overhead discipline: small
+    alternating groups so shared-VM noise lands on both modes), plus
+    the nop-path proof: mode=off never touches the ring."""
+    from pilosa_tpu.obs.capture import CaptureStore
+
+    with tempfile.TemporaryDirectory() as tmp:
+        server = _start_server(tmp, mode="sampled")
+        cap = server.capture
+        try:
+            q = b'Count(Bitmap(rowID=1, frame="f"))'
+            _post(server.host, "/index/i/query", q)  # warm
+
+            def run_group(samples, n=60):
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    _post(server.host, "/index/i/query", q)
+                    samples.append(time.perf_counter() - t0)
+
+            on: list = []
+            off: list = []
+            warm: list = []
+            run_group(warm, 40)
+            # Per-query interleave, pair order alternated: both
+            # populations sample the SAME instants of shared-VM load,
+            # so the p50 ratio isolates the capture cost itself
+            # instead of whatever the neighbor VM was doing during
+            # one mode's block.
+            for i in range(1200):
+                legs = [("off", off), ("sampled", on)]
+                if i % 2:
+                    legs.reverse()
+                for mode, sink in legs:
+                    cap.mode = mode
+                    run_group(sink, 1)
+            cap.mode = "off"
+            written_before = cap.ring.written
+            run_group([], 50)
+            nop_appends = cap.ring.written - written_before
+        finally:
+            server.close()
+    on.sort()
+    off.sort()
+    on_p50 = on[len(on) // 2]
+    off_p50 = off[len(off) // 2]
+    return {
+        "on_p50_ms": round(on_p50 * 1e3, 4),
+        "off_p50_ms": round(off_p50 * 1e3, 4),
+        "ratio": round(on_p50 / off_p50, 4),
+        "target_ratio": 1.02,
+        "mode": "sampled (default, 1-in-16 reads, every write)",
+        "samples_per_mode": len(on),
+        "nop_path": {"disabled_appends": nop_appends,
+                     "proven": nop_appends == 0},
+    }
+
+
+def _fold_into_manifest(doc: dict) -> None:
+    path = os.path.join(_DIR, "MANIFEST.json")
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        manifest = {"canonical_artifacts": {}, "metrics": {}}
+    manifest.setdefault("canonical_artifacts", {})[
+        "replay"] = "REPLAY.json"
+    manifest["replay"] = doc["replay"]
+    manifest["capture_overhead"] = doc["capture_overhead"]
+    metrics = manifest.setdefault("metrics", {})
+    metrics["replay_offered_qps"] = {
+        "value": doc["replay"]["offered_qps"], "unit": "qps"}
+    metrics["replay_achieved_qps"] = {
+        "value": doc["replay"]["achieved_qps"], "unit": "qps"}
+    metrics["capture_overhead_ratio"] = {
+        "value": doc["capture_overhead"]["ratio"],
+        "unit": "x_on_vs_off", "target": 1.02}
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1)
+
+
+def run() -> dict:
+    replay_summary, records = run_replay()
+    shadow_summary = run_shadow(records)
+    overhead = run_overhead()
+    out = {
+        "written_by": "benchmarks/replay.py",
+        "note": "Recorded-traffic open-loop replay"
+                " (docs/OBSERVABILITY.md): a captured mixed"
+                " read/write stream re-driven multi-process with"
+                " recorded arrival gaps compressed to the offered"
+                " target; latency counts from the scheduled send"
+                " time, so overload shows up as p99, and shed counts"
+                " 429/402/507 answers. achieved_qps is this host's"
+                " honest ceiling for the python serving stack.",
+        "replay": replay_summary,
+        "shadow": shadow_summary,
+        "capture_overhead": overhead,
+    }
+    with open(os.path.join(_DIR, "REPLAY.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    _fold_into_manifest(out)
+    return out
+
+
+def main() -> None:
+    out = run()
+    print(json.dumps({
+        "metric": "replay",
+        "offered_qps": out["replay"]["offered_qps"],
+        "achieved_qps": out["replay"]["achieved_qps"],
+        "shadow_self_mismatches":
+            out["shadow"]["self"]["mismatches"],
+        "seeded_fault_detected":
+            out["shadow"]["seeded_fault"]["detected"],
+        "capture_overhead_ratio": out["capture_overhead"]["ratio"],
+    }))
+
+
+if __name__ == "__main__":
+    main()

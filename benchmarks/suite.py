@@ -81,6 +81,7 @@ _CANONICAL_ARTIFACTS = {
     "tenant_isolation": "TENANTS.json",
     "tiered": "TIERED.json",
     "planner": "PLANNER.json",
+    "replay": "REPLAY.json",
 }
 
 
@@ -226,6 +227,14 @@ def write_manifest(partial: bool = False) -> None:
     # speedup legs + the planner+plan-recording overhead guard +
     # the costmodel-constants fold-back — ISSUE 18's acceptance table.
     out["planner"] = _PLANNER or prior_doc.get("planner", {})
+    # Recorded-traffic replay (config_replay -> benchmarks/replay.py):
+    # the open-loop sustained-QPS artifact re-driven from a captured
+    # stream, the self-shadow/seeded-fault proof, and the capture
+    # on/off overhead guard — ISSUE 19's acceptance table.
+    out["replay"] = _REPLAY or prior_doc.get("replay", {})
+    out["capture_overhead"] = (_CAPTURE_OVERHEAD
+                               or prior_doc.get("capture_overhead",
+                                                {}))
     measured = _roofline_measured() or prior_doc.get(
         "roofline_measured_constants")
     if measured:
@@ -301,6 +310,15 @@ _TIERED: dict = {}
 # default workload (≤1.02 target), and the costmodel-constants
 # fold-back record.
 _PLANNER: dict = {}
+
+# Recorded-traffic replay summary captured by config_replay() (which
+# shells out to benchmarks/replay.py) — folded into MANIFEST.json's
+# replay + capture_overhead sections and written to REPLAY.json
+# (ISSUE 19): offered/achieved QPS with per-lane p99s + shed rates,
+# the self-shadow zero-mismatch proof, the seeded-fault detection,
+# and the capture on/off p50 ratio (≤1.02 target).
+_REPLAY: dict = {}
+_CAPTURE_OVERHEAD: dict = {}
 
 
 # Fresh-process measurement: each slice config restarts python, arms
@@ -785,6 +803,38 @@ def config_obs_overhead() -> None:
         sampler.disk.close()
         ex.close()
         holder.close()
+
+
+def config_replay() -> None:
+    """Recorded-traffic replay artifact (ISSUE 19): shells out to
+    benchmarks/replay.py in a fresh interpreter (its multi-process
+    open-loop driver forks; a clean process keeps that away from this
+    pass's jax state) and folds REPLAY.json into the manifest's
+    line of record."""
+    import subprocess
+
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "replay.py")
+    proc = subprocess.run([sys.executable, script],
+                          capture_output=True, text=True,
+                          timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"replay.py failed rc={proc.returncode}:"
+            f" {proc.stderr[-400:]}")
+    with open(os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "REPLAY.json")) as f:
+        doc = json.load(f)
+    _REPLAY.update(doc["replay"])
+    _REPLAY["shadow"] = doc["shadow"]
+    _CAPTURE_OVERHEAD.update(doc["capture_overhead"])
+    emit("replay_offered_qps", doc["replay"]["offered_qps"], "qps",
+         target=20000)
+    emit("replay_achieved_qps", doc["replay"]["achieved_qps"], "qps")
+    emit("replay_shadow_mismatches",
+         doc["shadow"]["self"]["mismatches"], "count", target=0)
+    emit("capture_overhead_ratio", doc["capture_overhead"]["ratio"],
+         "x_on_vs_off", target=1.02)
 
 
 def config_planner() -> None:
@@ -3296,6 +3346,7 @@ def main(argv: Optional[list] = None) -> None:
                config_obs_history,
                config_scrub_overhead,
                config_planner,
+               config_replay,
                config_query_cost,
                config_container_mix,
                config_compile_stability,

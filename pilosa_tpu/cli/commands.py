@@ -327,7 +327,8 @@ def cmd_server(args, stdout, stderr) -> int:
                     sentinel_config=cfg.sentinel,
                     tenants_config=cfg.tenants,
                     scrub_config=cfg.scrub,
-                    tier_config=cfg.tier)
+                    tier_config=cfg.tier,
+                    capture_config=cfg.capture)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -713,6 +714,48 @@ def cmd_bench(args, stdout, stderr) -> int:
     return 0
 
 
+def cmd_replay(args, stdout, stderr) -> int:
+    """Re-issue a captured workload (docs/OBSERVABILITY.md): records
+    come from a file (--records) or a live cluster-merged export
+    (--from / --host), replay preserves arrival gaps scaled by
+    --rate xN, and --shadow BASELINE CANDIDATE switches to the
+    digest-comparing differential mode."""
+    import json as json_mod
+
+    from ..obs import replay as obs_replay
+
+    if args.records:
+        records = obs_replay.load_records(args.records)
+    else:
+        source = args.from_host or args.host
+        records = obs_replay.fetch_records(source, cluster=True)
+    if not records:
+        print("no capture records to replay", file=stderr)
+        return 1
+    rate = args.rate.lstrip("xX") or "1"
+    try:
+        rate = float(rate)
+    except ValueError:
+        print(f"invalid --rate: {args.rate!r}", file=stderr)
+        return 1
+    if args.shadow:
+        out = obs_replay.shadow(records, args.shadow[0],
+                                args.shadow[1],
+                                senders=args.senders)
+    else:
+        out = obs_replay.replay(records, args.host, rate=rate,
+                                processes=args.processes,
+                                senders=args.senders)
+    body = json_mod.dumps(out, indent=1)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(body + "\n")
+    print(body, file=stdout)
+    if args.shadow and out["mismatches"]:
+        return 1
+    return 0
+
+
 def cmd_config(args, stdout, stderr) -> int:
     from ..utils.config import Config
     stdout.write(Config().to_toml())
@@ -1036,6 +1079,35 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--wait", action="store_true",
                    help="poll until the resize settles")
     c.set_defaults(fn=cmd_resize)
+
+    c = sub.add_parser(
+        "replay", help="re-issue a captured workload against a"
+                       " cluster (docs/OBSERVABILITY.md)")
+    c.add_argument("--host", default="localhost:10101",
+                   help="replay target (also the default capture"
+                        " export source)")
+    c.add_argument("--records", default="",
+                   help="records file (JSONL or a saved"
+                        " /debug/capture/records response); default:"
+                        " export live from --from")
+    c.add_argument("--from", dest="from_host", default="",
+                   help="export the capture stream from this node"
+                        " (cluster-merged) instead of a file;"
+                        " defaults to --host")
+    c.add_argument("--rate", default="x1", metavar="xN",
+                   help="arrival-gap compression (x1 = recorded rate,"
+                        " x10 = 10x faster)")
+    c.add_argument("--processes", type=int, default=1,
+                   help="driver processes (open-loop shards)")
+    c.add_argument("--senders", type=int, default=32,
+                   help="sender threads per process")
+    c.add_argument("--shadow", nargs=2,
+                   metavar=("BASELINE", "CANDIDATE"),
+                   help="differential replay: writes to both in"
+                        " order, reads compared by result digest")
+    c.add_argument("--out", default="",
+                   help="write the summary JSON here as well")
+    c.set_defaults(fn=cmd_replay)
 
     c = sub.add_parser("config", help="print default configuration")
     c.set_defaults(fn=cmd_config)

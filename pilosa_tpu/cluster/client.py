@@ -501,6 +501,19 @@ class Client:
                                deadline_s=deadline_s)
         return json.loads(self._ok(status, raw, "metrics history"))
 
+    def capture_records(self, since: int = 0, limit: int = 500,
+                        host: Optional[str] = None,
+                        deadline_s: Optional[float] = None) -> dict:
+        """GET /debug/capture/records: one peer's local capture page
+        (obs.capture) — the scope=cluster federation leg, and the
+        replay driver's export transport."""
+        from urllib.parse import urlencode
+        path = ("/debug/capture/records?"
+                + urlencode({"since": since, "limit": limit}))
+        status, raw = self._do("GET", path, host=host,
+                               deadline_s=deadline_s)
+        return json.loads(self._ok(status, raw, "capture records"))
+
     def cancel_query(self, query_id: str,
                      host: Optional[str] = None) -> dict:
         """DELETE /debug/queries/{id}: cancel a query on this node;

@@ -901,6 +901,21 @@ PLANNER_PLAN_SECONDS = _DEFAULT.histogram(
     buckets=(0.00001, 0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
              0.5, 1.0))
 
+# -- workload capture (obs.capture; docs/OBSERVABILITY.md) --------------------
+CAPTURE_RECORDS = _DEFAULT.counter(
+    "pilosa_capture_records_total",
+    "Workload-capture records appended to the on-disk capture ring,"
+    " by kind (query / import)",
+    labels=("kind",))
+CAPTURE_DROPPED = _DEFAULT.counter(
+    "pilosa_capture_dropped_total",
+    "Capture records lost, by reason (io = the ring append failed)",
+    labels=("reason",))
+CAPTURE_BYTES = _DEFAULT.counter(
+    "pilosa_capture_bytes_total",
+    "Framed record bytes appended to the capture ring, by kind",
+    labels=("kind",))
+
 
 # -- legacy StatsClient bridge ------------------------------------------------
 

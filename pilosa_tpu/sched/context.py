@@ -122,6 +122,12 @@ class QueryContext:
         # pay for exact per-node actual cardinalities (ANALYZE).
         self.plan = None
         self.profile = False
+        # Workload-capture cross-links (obs.capture), filled by the
+        # serving layer at query end: the canonical result digest
+        # (the X-Pilosa-Result-Digest value) and the capture-record id
+        # — a slow-log line names the exact replayable record.
+        self.result_digest = ""
+        self.capture_id = 0
 
     def note_flag(self, name: str) -> None:
         """Record a fault-event flag for the tail sampler (no-op
@@ -236,6 +242,13 @@ class QueryContext:
             decisions = self.plan.decision_summary()
             if decisions:
                 out["planDecisions"] = decisions
+        if self.result_digest:
+            # Replay cross-link (obs.capture): the digest is the
+            # shadow-diff comparison key; captureId names the record
+            # in /debug/capture/records that re-issues this query.
+            out["resultDigest"] = self.result_digest
+        if self.capture_id:
+            out["captureId"] = self.capture_id
         return out
 
 

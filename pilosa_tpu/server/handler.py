@@ -33,6 +33,7 @@ from ..errors import (FrameExistsError, IndexExistsError, PilosaError,
                       validate_label)
 from ..fault import diskfull as fault_diskfull
 from ..obs import accounting as obs_accounting
+from ..obs import capture as obs_capture
 from ..obs import metrics as obs_metrics
 from ..obs import profile as obs_profile
 from ..obs import trace as obs_trace
@@ -223,7 +224,7 @@ class Handler:
                  blackbox=None, watchdog=None, history=None,
                  sentinel=None, federator=None, tenants=None,
                  tenant_slo=None, scrubber=None, repairer=None,
-                 tier=None):
+                 tier=None, capture=None):
         from ..utils import logger as logger_mod
         self.logger = logger or logger_mod.NOP
         self.holder = holder
@@ -284,6 +285,11 @@ class Handler:
         # Tiered storage (pilosa_tpu.tier) behind /debug/tier; None
         # (tiering off / bare handlers) serves a disabled stub.
         self.tier = tier
+        # Workload capture (obs.capture.CaptureStore) behind
+        # /debug/capture*; None (bare handlers) serves a disabled
+        # status and captures nothing — the query path pays one
+        # ``is not None`` check.
+        self.capture = capture
         if federator is None:
             from ..obs.federate import Federator
             federator = Federator(host)
@@ -381,6 +387,9 @@ class Handler:
         r("POST", "/debug/integrity/scrub",
           self._handle_post_integrity_scrub)
         r("GET", "/debug/tier", self._handle_debug_tier)
+        r("GET", "/debug/capture", self._handle_debug_capture)
+        r("GET", "/debug/capture/records",
+          self._handle_debug_capture_records)
         r("GET", "/debug/vars", self._handle_expvar)
         r("GET", "/debug/metrics/history",
           self._handle_metrics_history)
@@ -597,20 +606,12 @@ class Handler:
 
     def _handle_pprof_heap(self, req: Request) -> Response:
         """Read-only heap report. Arming/disarming tracemalloc mutates
-        interpreter-wide state, so it moved to POST; the pre-existing
-        ``?off=1`` GET form still works as a DEPRECATED shim (scripts
-        in the wild), flagged in its output."""
-        from ..utils.profiling import heap_report, heap_stop
+        interpreter-wide state, so it lives on POST ?op=start|stop."""
+        from ..utils.profiling import heap_report
         try:
             top_n = int(req.query.get("n", "30"))
         except ValueError:
             raise HTTPError(400, "invalid n")
-        if req.query.get("off") == "1":
-            body = ("DEPRECATED: GET ?off=1 mutates profiling state;"
-                    " use POST /debug/pprof/heap?op=stop.\n"
-                    + heap_stop())
-            return Response(200, body.encode(),
-                            "text/plain; charset=utf-8")
         return Response(200,
                         heap_report(max(1, min(top_n, 500))).encode(),
                         "text/plain; charset=utf-8")
@@ -1274,6 +1275,57 @@ class Handler:
              "series": series},
             headers=headers)
 
+    def _handle_debug_capture(self, req: Request) -> Response:
+        """Workload-capture status (obs.capture): mode, sampling,
+        redaction policy, cursor, and the ring's byte accounting. A
+        handler without a capture store answers disabled."""
+        cap = self.capture
+        if cap is None:
+            return Response.json({"enabled": False, "mode": "off"})
+        out = cap.status()
+        out["enabled"] = cap.enabled
+        return Response.json(out)
+
+    def _handle_debug_capture_records(self, req: Request) -> Response:
+        """Paged capture export: ``?since=<seq>`` (exclusive cursor)
+        + ``?limit=`` pages the local ring oldest-first; the next
+        page's cursor is the returned ``next``. ``?scope=cluster``
+        fans out to every node and merges the streams by arrival
+        wall-clock (obs.capture.merge_streams) — the merged form
+        benchmarks/replay.py re-issues."""
+        try:
+            since = int(req.query.get("since", "0"))
+            limit = int(req.query.get("limit", "500"))
+        except ValueError:
+            raise HTTPError(400, "invalid since/limit")
+
+        def local() -> dict:
+            cap = self.capture
+            recs = cap.export(since=since, limit=limit) \
+                if cap is not None else []
+            return {"node": self.host, "records": recs,
+                    "next": recs[-1]["seq"] if recs else since}
+
+        if req.query.get("scope") != "cluster":
+            return Response.json(local())
+        fed = self.federator
+
+        def fetch(host: str) -> dict:
+            client = fed.client_for(host)
+            return client.capture_records(
+                since=since, limit=limit, host=host,
+                deadline_s=fed.peer_timeout_s)
+
+        results, missing = fed.fan_out(fetch, local)
+        headers: list = []
+        self._partial_or_503(req, missing, headers)
+        merged = obs_capture.merge_streams(
+            [r.get("records") or [] for r in results.values()])
+        return Response.json(
+            {"scope": "cluster", "records": merged,
+             "nodes": sorted(results), "missing": missing},
+            headers=headers)
+
     def _handle_debug_plans(self, req: Request) -> Response:
         """The bounded per-fingerprint plan store (plan.store): hit
         counts, latency p50/p99, est-vs-actual drift, and the last
@@ -1656,6 +1708,12 @@ class Handler:
                 # coordinator to stitch (the cost-tree contract).
                 hs.append((plan_record.PLAN_HEADER,
                            ctx.plan.wire_json()))
+            if ctx.result_digest:
+                # The canonical result digest (obs.capture): set at
+                # query end on success, so error responses (digest
+                # would be meaningless) skip the header.
+                hs.append((obs_capture.DIGEST_HEADER,
+                           ctx.result_digest))
             return hs
         # Register BEFORE admission so queued queries are visible at
         # /debug/queries and cancellable while they wait (a DELETE or
@@ -1850,6 +1908,36 @@ class Handler:
                         est_rows=est, actual_rows=actual)
                 except Exception:  # noqa: BLE001 - observability only
                     pass
+            # Canonical result digest (obs.capture): the value of
+            # X-Pilosa-Result-Digest and the shadow-diff comparison
+            # key. Coordinator-only (a remote leg's partial results
+            # are not a client-visible answer) and success-only.
+            if err is None and not remote:
+                try:
+                    ctx.result_digest = obs_capture.result_digest(
+                        [codec.result_to_json(r) for r in results])
+                except Exception:  # noqa: BLE001 - observability only
+                    pass
+            # Workload capture (obs.capture): append the replayable
+            # record BEFORE registry.finish so the slow-log entry
+            # cross-links the capture id. Disabled mode costs one
+            # attribute read (the nop path the overhead guard proves).
+            cap = self.capture
+            if (cap is not None and cap.enabled and not remote
+                    and cap.should_capture(ctx.lane)):
+                opts = {}
+                if req.query.get("timeout"):
+                    opts["timeout"] = req.query["timeout"]
+                if req.query.get("partial") == "1":
+                    opts["partial"] = True
+                ctx.capture_id = cap.add(
+                    "query", query_str, index_name, ctx.tenant,
+                    ctx.lane, ctx.id, status, ctx.elapsed(),
+                    digest=ctx.result_digest,
+                    plan=(ctx.plan.fingerprint
+                          if ctx.plan is not None else ""),
+                    opts=opts or None,
+                    wall=ctx.started_wall, mono=ctx.started)
             self.registry.finish(ctx, error=err)
             # Latency histogram + outcome counter, labeled by call
             # type / lane / status (obs.metrics) — recorded for every
@@ -2100,6 +2188,18 @@ class Handler:
         # them all, coalesced with concurrent imports' barriers.
         storage_wal.barrier_all()
         obs_metrics.IMPORT_BITS.labels("bits").inc(n_bits)
+        # Workload capture (obs.capture): the import ack is a state
+        # mutation replay must reproduce, so writes record in every
+        # non-off mode (should_capture never samples the write lane).
+        cap = self.capture
+        if cap is not None and cap.enabled \
+                and cap.should_capture(LANE_WRITE):
+            tenant = (self.environ_header(req, "HTTP_X_PILOSA_TENANT")
+                      or index_name)
+            cap.add("import", "", index_name, tenant, LANE_WRITE, "",
+                    200, decode_s + apply_s,
+                    bits=n_bits, slice=int(slice),
+                    frame=frame_name)
         # Cost fields ride the response: decode vs apply wall time and
         # the wire/bit volumes (the snapshot leg, when one triggers,
         # lands in the same histogram from the fragment).

@@ -47,11 +47,12 @@ from ..sched import (AdmissionController, QueryRegistry, TenantRegistry,
 from ..utils import logger as logger_mod
 from ..storage.scrub import Scrubber
 from ..tier.manager import TierManager
-from ..utils.config import (BlackboxConfig, FaultConfig, HistoryConfig,
-                            MetricsConfig, ProfileConfig, QueryConfig,
-                            ScrubConfig, SentinelConfig, SLOConfig,
-                            TenantsConfig, TierConfig, TraceConfig,
-                            WatchdogConfig, parse_resolutions)
+from ..utils.config import (BlackboxConfig, CaptureConfig, FaultConfig,
+                            HistoryConfig, MetricsConfig, ProfileConfig,
+                            QueryConfig, ScrubConfig, SentinelConfig,
+                            SLOConfig, TenantsConfig, TierConfig,
+                            TraceConfig, WatchdogConfig,
+                            parse_resolutions)
 from ..utils.stats import NOP, MultiStatsClient
 from .handler import Handler
 from .httpd import HTTPServer
@@ -87,7 +88,8 @@ class Server:
                  sentinel_config: Optional[SentinelConfig] = None,
                  tenants_config: Optional[TenantsConfig] = None,
                  scrub_config: Optional[ScrubConfig] = None,
-                 tier_config: Optional[TierConfig] = None):
+                 tier_config: Optional[TierConfig] = None,
+                 capture_config: Optional[CaptureConfig] = None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -219,6 +221,11 @@ class Server:
         # the data dir by default).
         self.tier_config = tier_config or TierConfig()
         self.tier: Optional[TierManager] = None
+        # Workload capture (obs.capture; docs/OBSERVABILITY.md): the
+        # recorded-traffic ring behind /debug/capture* — built in
+        # open() (the segment ring lives under the data dir).
+        self.capture_config = capture_config or CaptureConfig()
+        self.capture = None
         self.executor: Optional[Executor] = None
         self.handler: Optional[Handler] = None
         self.pod = None  # parallel.pod.Pod once open() joins a pod
@@ -505,6 +512,22 @@ class Server:
                 .manifest_tolerance,
                 logger=self.logger)
             self.sentinel.start()
+        # Workload capture (obs.capture): the replayable traffic
+        # record behind /debug/capture* — mode "off" still builds the
+        # store (a live SIGHUP/env flip can arm it later via config
+        # reload patterns) but the handler's enabled check makes the
+        # per-request cost one attribute read.
+        from ..obs.capture import CaptureStore
+        self.capture = CaptureStore(
+            os.path.join(self.holder.path, "capture"),
+            mode=self.capture_config.mode,
+            sample_n=self.capture_config.sample_n,
+            segment_bytes=self.capture_config.segment_bytes,
+            max_segments=self.capture_config.segments,
+            redact_tenants={t.strip() for t in
+                            self.capture_config.redact.split(",")
+                            if t.strip()},
+            node=self.host)
         self.handler = Handler(
             self.holder, self.executor, cluster=self.cluster,
             host=self.host, broadcaster=self.broadcaster,
@@ -522,7 +545,8 @@ class Server:
             history=self.history, sentinel=self.sentinel,
             federator=self.federator, tenants=self.tenants,
             tenant_slo=self.tenant_slo, scrubber=self.scrubber,
-            repairer=self.repairer, tier=self.tier)
+            repairer=self.repairer, tier=self.tier,
+            capture=self.capture)
 
         self._httpd = HTTPServer(self.handler, bind_host, port,
                                  logger=self.logger,
@@ -548,6 +572,10 @@ class Server:
                 self.repairer.host = new_host
             if self.federator is not None:
                 self.federator.host = new_host
+            if self.capture is not None:
+                # Capture records name the serving node; merged
+                # multi-node exports disambiguate on it.
+                self.capture.node = new_host
             if self.fault is not None:
                 # The self-identity every fault consult skips.
                 self.fault.node = new_host
@@ -628,6 +656,8 @@ class Server:
             self.blackbox.stop()
         if self.sampler is not None and self.sampler.disk is not None:
             self.sampler.disk.close()
+        if self.capture is not None:
+            self.capture.close()
         # Collector before history: a mid-tick sample() racing the
         # close would reopen a fresh disk segment after it (stop()
         # joins the collector thread).

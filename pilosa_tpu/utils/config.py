@@ -461,6 +461,24 @@ class TierConfig:
     pace: float = 0.01
 
 
+@dataclass
+class CaptureConfig:
+    """[capture] section (obs.capture): the workload-capture plane —
+    every served query/import appends a replayable record to an
+    on-disk segment ring under ``<data>/capture/``. ``mode`` is
+    ``off`` | ``sampled`` | ``full``: off is a nop-cost path, sampled
+    (the default) records every write/import plus 1-in-``sample-n``
+    reads, full records everything. ``segment-bytes`` × ``segments``
+    bound the ring (the byte budget). ``redact`` is a comma-separated
+    tenant list ("*" = all) whose PQL string/numeric literals are
+    replaced with ``?`` before recording."""
+    mode: str = "sampled"
+    sample_n: int = 16
+    segment_bytes: int = 1 << 20
+    segments: int = 8
+    redact: str = ""
+
+
 def _parse_bool(v) -> bool:
     if isinstance(v, bool):
         return v
@@ -482,6 +500,7 @@ class Config:
     watchdog: WatchdogConfig = field(default_factory=WatchdogConfig)
     scrub: ScrubConfig = field(default_factory=ScrubConfig)
     tier: TierConfig = field(default_factory=TierConfig)
+    capture: CaptureConfig = field(default_factory=CaptureConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
@@ -621,6 +640,13 @@ blob = "{self.tier.blob}"
 interval = "{dur(self.tier.interval)}"
 prefetch-interval = "{dur(self.tier.prefetch_interval)}"
 pace = "{dur(self.tier.pace)}"
+
+[capture]
+mode = "{self.capture.mode}"
+sample-n = {self.capture.sample_n}
+segment-bytes = {self.capture.segment_bytes}
+segments = {self.capture.segments}
+redact = "{self.capture.redact}"
 
 [profile]
 continuous = {str(self.profile.continuous).lower()}
@@ -824,6 +850,17 @@ def load(path: str = "", env: dict | None = None) -> Config:
             cfg.tier.cold_dir = str(ti["cold-dir"])
         if "blob" in ti:
             cfg.tier.blob = str(ti["blob"])
+        cp = data.get("capture", {})
+        if "mode" in cp:
+            cfg.capture.mode = str(cp["mode"])
+        if "sample-n" in cp:
+            cfg.capture.sample_n = int(cp["sample-n"])
+        if "segment-bytes" in cp:
+            cfg.capture.segment_bytes = int(cp["segment-bytes"])
+        if "segments" in cp:
+            cfg.capture.segments = int(cp["segments"])
+        if "redact" in cp:
+            cfg.capture.redact = str(cp["redact"])
         p = data.get("profile", {})
         if "continuous" in p:
             cfg.profile.continuous = _parse_bool(p["continuous"])
@@ -1058,6 +1095,17 @@ def load(path: str = "", env: dict | None = None) -> Config:
         cfg.tier.cold_dir = env["PILOSA_TIER_COLD_DIR"]
     if env.get("PILOSA_TIER_BLOB"):
         cfg.tier.blob = env["PILOSA_TIER_BLOB"]
+    if env.get("PILOSA_CAPTURE_MODE"):
+        cfg.capture.mode = env["PILOSA_CAPTURE_MODE"]
+    if env.get("PILOSA_CAPTURE_SAMPLE_N"):
+        cfg.capture.sample_n = int(env["PILOSA_CAPTURE_SAMPLE_N"])
+    if env.get("PILOSA_CAPTURE_SEGMENT_BYTES"):
+        cfg.capture.segment_bytes = int(
+            env["PILOSA_CAPTURE_SEGMENT_BYTES"])
+    if env.get("PILOSA_CAPTURE_SEGMENTS"):
+        cfg.capture.segments = int(env["PILOSA_CAPTURE_SEGMENTS"])
+    if env.get("PILOSA_CAPTURE_REDACT"):
+        cfg.capture.redact = env["PILOSA_CAPTURE_REDACT"]
     if env.get("PILOSA_PLUGINS_PATH"):
         cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
     if env.get("PILOSA_FAULT_ENABLED"):

@@ -75,3 +75,8 @@ def pytest_configure(config):
                    "demotion/faulting/blob/eviction/prefetch units and"
                    " fast failpoint legs run tier-1, the SIGKILL crash"
                    " legs and soaks are additionally `slow`")
+    config.addinivalue_line(
+        "markers", "replay: workload capture/replay/shadow tests"
+                   " (ISSUE 19) — digest/redaction/ring/export units"
+                   " run tier-1, the real 2-node merged-export replay"
+                   " leg is additionally `slow`")

@@ -1,0 +1,731 @@
+"""Workload capture / replay / shadow tests (ISSUE 19): the canonical
+result digest (incl. TopN tie-breaking), PQL redaction, sampling modes,
+ring round-trip + torn-tail reopen, paged export, stream merging and
+gap-preserving schedules, the handler integration (digest header, slow
+log cross-links, /debug/capture routes), the shadow diff catching a
+deliberately corrupted candidate over real HTTP, and — additionally
+``slow`` — a real 2-node cluster leg with merged export + replay +
+zero-self-mismatch shadow."""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)
+
+from pilosa_tpu.executor import Executor  # noqa: E402
+from pilosa_tpu.models.holder import Holder  # noqa: E402
+from pilosa_tpu.obs import capture as obs_capture  # noqa: E402
+from pilosa_tpu.obs import replay as obs_replay  # noqa: E402
+from pilosa_tpu.obs.capture import CaptureStore  # noqa: E402
+from pilosa_tpu.proto import internal_pb2 as pb  # noqa: E402
+from pilosa_tpu.sched.registry import QueryRegistry  # noqa: E402
+from pilosa_tpu.server.handler import Handler  # noqa: E402
+
+from test_handler import call  # noqa: E402
+
+pytestmark = pytest.mark.replay
+
+
+# -- digest canonicalization --------------------------------------------------
+
+
+class TestResultDigest:
+    def test_topn_equal_counts_tie_broken_by_id(self):
+        """Two servers may order equal-count TopN pairs differently —
+        the canonical digest must not care."""
+        a = [[{"id": 7, "count": 3}, {"id": 2, "count": 3},
+              {"id": 9, "count": 5}]]
+        b = [[{"id": 9, "count": 5}, {"id": 2, "count": 3},
+              {"id": 7, "count": 3}]]
+        assert obs_capture.result_digest(a) \
+            == obs_capture.result_digest(b)
+        norm = obs_capture.normalize_result(a[0])
+        assert [(e["count"], e["id"]) for e in norm] \
+            == [(5, 9), (3, 2), (3, 7)]  # count desc, id asc on ties
+
+    def test_distinct_results_distinct_digests(self):
+        d1 = obs_capture.result_digest([{"bits": [1, 2, 3]}])
+        d2 = obs_capture.result_digest([{"bits": [1, 2, 4]}])
+        assert d1 != d2
+        assert len(d1) == 16 and int(d1, 16) >= 0  # 64-bit hex
+
+    def test_dict_key_order_irrelevant(self):
+        d1 = obs_capture.result_digest([{"attrs": {}, "bits": [3]}])
+        d2 = obs_capture.result_digest([{"bits": [3], "attrs": {}}])
+        assert d1 == d2
+
+    def test_pair_lists_normalized_inside_containers(self):
+        a = [{"topn": [{"id": 1, "count": 2}, {"id": 0, "count": 2}]}]
+        b = [{"topn": [{"id": 0, "count": 2}, {"id": 1, "count": 2}]}]
+        assert obs_capture.result_digest(a) \
+            == obs_capture.result_digest(b)
+
+    def test_scalars_pass_through(self):
+        assert obs_capture.result_digest([True, 42]) \
+            != obs_capture.result_digest([True, 43])
+
+
+# -- redaction ----------------------------------------------------------------
+
+
+class TestRedaction:
+    def test_string_and_numeric_literals_replaced(self):
+        pql = 'SetBit(rowID=1, frame="secret-frame", columnID=314159)'
+        red = obs_capture.redact_pql(pql)
+        assert "secret-frame" not in red and "314159" not in red
+        assert red == 'SetBit(rowID=?, frame="?", columnID=?)'
+
+    def test_digits_inside_strings_redact_with_the_string(self):
+        assert obs_capture.redact_pql('Bitmap(frame="f2024")') \
+            == 'Bitmap(frame="?")'
+
+    def test_call_shape_survives(self):
+        red = obs_capture.redact_pql(
+            'TopN(frame="f", n=5, field="x")')
+        assert red.startswith("TopN(") and "n=?" in red
+
+    def test_redacts_per_tenant_and_wildcard(self, tmp_path):
+        s = CaptureStore(str(tmp_path / "c"), mode="full",
+                         redact_tenants={"acme"})
+        try:
+            assert s.redacts("acme") and not s.redacts("other")
+        finally:
+            s.close()
+        s = CaptureStore(str(tmp_path / "c2"), mode="full",
+                         redact_tenants={"*"})
+        try:
+            assert s.redacts("anyone")
+        finally:
+            s.close()
+
+    def test_add_applies_redaction_for_listed_tenant(self, tmp_path):
+        s = CaptureStore(str(tmp_path / "c"), mode="full",
+                         redact_tenants={"acme"})
+        try:
+            s.add("query", 'Bitmap(frame="f", rowID=7)', "i", "acme",
+                  "read", "q1", 200, 0.001)
+            s.add("query", 'Bitmap(frame="f", rowID=7)', "i", "open",
+                  "read", "q2", 200, 0.001)
+            recs = s.export()
+            assert recs[0]["pql"] == 'Bitmap(frame="?", rowID=?)'
+            assert recs[1]["pql"] == 'Bitmap(frame="f", rowID=7)'
+        finally:
+            s.close()
+
+
+# -- sampling modes -----------------------------------------------------------
+
+
+class TestSampling:
+    def test_off_is_disabled(self, tmp_path):
+        s = CaptureStore(str(tmp_path / "c"), mode="off")
+        try:
+            assert not s.enabled
+            assert not s.should_capture("write")
+            assert not s.should_capture("read")
+        finally:
+            s.close()
+
+    def test_sampled_records_every_write_and_one_in_n_reads(
+            self, tmp_path):
+        s = CaptureStore(str(tmp_path / "c"), mode="sampled",
+                         sample_n=4)
+        try:
+            assert s.enabled
+            assert all(s.should_capture("write") for _ in range(10))
+            assert all(s.should_capture("admin") for _ in range(3))
+            kept = sum(s.should_capture("read") for _ in range(16))
+            assert kept == 4  # deterministic 1-in-4
+        finally:
+            s.close()
+
+    def test_sample_n_one_keeps_every_read(self, tmp_path):
+        s = CaptureStore(str(tmp_path / "c"), mode="sampled",
+                         sample_n=1)
+        try:
+            assert all(s.should_capture("read") for _ in range(5))
+        finally:
+            s.close()
+
+    def test_full_keeps_everything(self, tmp_path):
+        s = CaptureStore(str(tmp_path / "c"), mode="full",
+                         sample_n=1000)
+        try:
+            assert all(s.should_capture("read") for _ in range(5))
+        finally:
+            s.close()
+
+    def test_invalid_mode_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            CaptureStore(str(tmp_path / "c"), mode="everything")
+
+
+# -- ring round-trip + torn tail ----------------------------------------------
+
+
+class TestRoundTrip:
+    def test_wire_format_and_monotonic_seq(self, tmp_path):
+        s = CaptureStore(str(tmp_path / "c"), mode="full", node="n1")
+        try:
+            cid = s.add("query", 'Bitmap(frame="f", rowID=1)', "i",
+                        "t1", "read", "qid-1", 200, 0.0123,
+                        digest="ab" * 8, plan="deadbeefcafe",
+                        opts={"timeout": "5s", "partial": True})
+            assert cid == 1
+            s.add("import", "", "i", "i", "write", "", 200, 0.002,
+                  bits=64, slice=3, frame="f")
+            recs = s.export()
+        finally:
+            s.close()
+        assert [r["seq"] for r in recs] == [1, 2]
+        q = recs[0]
+        for key in ("seq", "t", "mono", "kind", "pql", "index",
+                    "tenant", "lane", "qid", "plan", "status", "latS",
+                    "digest", "node"):
+            assert key in q, key
+        assert q["kind"] == "query" and q["node"] == "n1"
+        assert q["digest"] == "ab" * 8
+        assert q["opts"] == {"timeout": "5s", "partial": True}
+        imp = recs[1]
+        assert imp["kind"] == "import"
+        assert (imp["bits"], imp["slice"], imp["frame"]) == (64, 3, "f")
+
+    def test_reopen_resumes_seq(self, tmp_path):
+        d = str(tmp_path / "c")
+        s = CaptureStore(d, mode="full")
+        for i in range(5):
+            s.add("query", "Count()", "i", "i", "read", f"q{i}",
+                  200, 0.001)
+        s.close()
+        s = CaptureStore(d, mode="full")
+        try:
+            cid = s.add("query", "Count()", "i", "i", "read", "q5",
+                        200, 0.001)
+            assert cid == 6  # cursor resumed past the survivors
+        finally:
+            s.close()
+
+    def test_torn_tail_skipped_and_seq_stays_monotonic(self, tmp_path):
+        """A crash mid-append leaves a torn last line; reopen must
+        serve every intact record and keep the cursor monotonic."""
+        d = str(tmp_path / "c")
+        s = CaptureStore(d, mode="full")
+        for i in range(8):
+            s.add("query", f"Count(Bitmap(rowID={i}))", "i", "i",
+                  "read", f"q{i}", 200, 0.001)
+        s.close()
+        segs = sorted(f for f in os.listdir(d) if f.endswith(".jsonl"))
+        assert segs
+        tail = os.path.join(d, segs[-1])
+        with open(tail, "rb") as f:
+            raw = f.read()
+        with open(tail, "wb") as f:
+            f.write(raw[:-7])  # tear the last frame mid-line
+        s = CaptureStore(d, mode="full")
+        try:
+            recs = s.export()
+            seqs = [r["seq"] for r in recs]
+            assert seqs == sorted(seqs)
+            assert 7 <= len(recs) < 8  # the torn record is gone
+            cid = s.add("query", "Count()", "i", "i", "read", "q8",
+                        200, 0.001)
+            assert cid > max(seqs)
+        finally:
+            s.close()
+
+
+class TestPagedExport:
+    @pytest.fixture
+    def store(self, tmp_path):
+        s = CaptureStore(str(tmp_path / "c"), mode="full")
+        for i in range(10):
+            s.add("query", f"q{i}", "i", "i", "read", f"id{i}",
+                  200, 0.001)
+        yield s
+        s.close()
+
+    def test_since_limit_pages_oldest_first(self, store):
+        page = store.export(since=0, limit=3)
+        assert [r["seq"] for r in page] == [1, 2, 3]
+        nxt = store.export(since=page[-1]["seq"], limit=100)
+        assert [r["seq"] for r in nxt] == [4, 5, 6, 7, 8, 9, 10]
+
+    def test_since_past_end_empty(self, store):
+        assert store.export(since=10) == []
+
+    def test_limit_clamped(self, store):
+        assert len(store.export(limit=0)) == 1  # floor 1
+        assert len(store.export(limit=10**9)) == 10  # ceiling holds
+
+    def test_status_shape(self, store):
+        st = store.status()
+        assert st["mode"] == "full" and st["seq"] == 10
+        assert st["budgetBytes"] == (st["ring"]["segmentBytes"]
+                                     * st["ring"]["maxSegments"])
+
+
+# -- merging + gap-preserving schedules ---------------------------------------
+
+
+class TestMergeAndSchedule:
+    def test_merge_streams_orders_by_wall_then_node_seq(self):
+        a = [{"seq": 1, "t": 10.0, "node": "a"},
+             {"seq": 2, "t": 30.0, "node": "a"}]
+        b = [{"seq": 1, "t": 20.0, "node": "b"},
+             {"seq": 2, "t": 10.0, "node": "b"}]
+        merged = obs_capture.merge_streams([a, b])
+        assert [(r["node"], r["seq"]) for r in merged] \
+            == [("a", 1), ("b", 2), ("b", 1), ("a", 2)]
+
+    def test_single_node_offsets_use_monotonic_stamps(self):
+        recs = [{"node": "a", "t": 100.0, "mono": 5.0},
+                {"node": "a", "t": 100.1, "mono": 5.25},
+                {"node": "a", "t": 999.0, "mono": 5.35}]  # wall step
+        offs = obs_capture.arrival_offsets(recs)
+        assert offs == [0.0, pytest.approx(0.25), pytest.approx(0.35)]
+
+    def test_merged_streams_fall_back_to_wall_clock(self):
+        recs = [{"node": "a", "t": 100.0, "mono": 5.0},
+                {"node": "b", "t": 100.5, "mono": 900.0}]
+        offs = obs_capture.arrival_offsets(recs)
+        assert offs == [0.0, pytest.approx(0.5)]
+
+    def test_offsets_never_negative(self):
+        recs = [{"node": "a", "t": 100.0, "mono": 5.0},
+                {"node": "a", "t": 99.0, "mono": 4.0}]
+        assert obs_capture.arrival_offsets(recs)[1] == 0.0
+
+    def test_schedule_rate_compresses_gaps(self):
+        recs = [{"node": "a", "t": 0.0, "mono": 0.0},
+                {"node": "a", "t": 1.0, "mono": 1.0}]
+        assert obs_replay.schedule(recs, rate=4.0)[1] \
+            == pytest.approx(0.25)
+
+    def test_replay_shard_preserves_inter_arrival_gaps(self):
+        """The open-loop unit: three records 0.12 s apart against a
+        dead endpoint (connection refused is instant) must still take
+        the full recorded span — sends fire at their offsets, not
+        back-to-back."""
+        recs = [{"kind": "query", "lane": "read", "index": "i",
+                 "pql": "Count()", "node": "a", "t": float(i),
+                 "mono": 0.12 * i} for i in range(3)]
+        offs = obs_replay.schedule(recs, rate=1.0)
+        t0 = time.perf_counter()
+        outcomes = obs_replay._replay_shard(
+            (recs, offs, "127.0.0.1:9", time.time(), 2))
+        elapsed = time.perf_counter() - t0
+        assert elapsed >= 0.24  # the recorded span, not instant
+        assert len(outcomes) == 3
+        assert all(o["status"] == 0 for o in outcomes)  # refused
+
+
+# -- replay units -------------------------------------------------------------
+
+
+class TestReplayUnits:
+    def test_load_records_jsonl_and_response_doc(self, tmp_path):
+        recs = [{"seq": 1, "kind": "query"}, {"seq": 2, "kind": "query"}]
+        p1 = tmp_path / "r.jsonl"
+        p1.write_text("".join(json.dumps(r) + "\n" for r in recs))
+        assert obs_replay.load_records(str(p1)) == recs
+        p2 = tmp_path / "r.json"
+        p2.write_text(json.dumps({"scope": "cluster", "records": recs}))
+        assert obs_replay.load_records(str(p2)) == recs
+        p3 = tmp_path / "empty.jsonl"
+        p3.write_text("")
+        assert obs_replay.load_records(str(p3)) == []
+
+    def test_summarize_lanes_shed_and_percentiles(self):
+        outcomes = (
+            [{"lane": "read", "status": 200, "latS": 0.01}] * 98
+            + [{"lane": "read", "status": 429, "latS": 0.0}] * 2
+            + [{"lane": "write", "status": 200, "latS": 0.02}] * 9
+            + [{"lane": "write", "status": 500, "latS": 0.0}]
+            + [{"lane": "write", "status": -1, "latS": 0.0}])
+        s = obs_replay._summarize(outcomes, offered_qps=111.0,
+                                  wall_s=1.0)
+        assert s["offered"] == 110 and s["skipped_imports"] == 1
+        assert s["completed"] == 107 and s["shed"] == 2
+        assert s["errors"] == 1
+        r = s["lanes"]["read"]
+        assert r["sent"] == 100 and r["shed_rate"] == 0.02
+        assert r["p50_ms"] == 10.0 and r["p99_ms"] == 10.0
+        assert s["lanes"]["write"]["errors"] == 1
+        assert s["achieved_qps"] == 107.0
+
+    def test_empty_replay_summary(self):
+        s = obs_replay.replay([], "127.0.0.1:9")
+        assert s["offered"] == 0 and s["completed"] == 0
+
+    def test_cli_replay_parser(self):
+        from pilosa_tpu.cli.commands import build_parser
+        args = build_parser().parse_args(
+            ["replay", "--records", "r.jsonl", "--rate", "x4",
+             "--processes", "2", "--senders", "8",
+             "--shadow", "127.0.0.1:1", "127.0.0.1:2",
+             "--out", "out.json"])
+        assert args.records == "r.jsonl" and args.rate == "x4"
+        assert args.processes == 2 and args.senders == 8
+        assert args.shadow == ["127.0.0.1:1", "127.0.0.1:2"]
+        args = build_parser().parse_args(
+            ["replay", "--from", "127.0.0.1:10101"])
+        assert args.from_host == "127.0.0.1:10101"
+        assert args.rate == "x1" and args.processes == 1
+
+
+# -- handler integration ------------------------------------------------------
+
+
+@pytest.fixture
+def captured_handler(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    cap = CaptureStore(str(tmp_path / "capture"), mode="full",
+                       node="local")
+    handler = Handler(
+        h, Executor(h, host="local"), host="local", capture=cap,
+        registry=QueryRegistry(slow_threshold_s=1e-9))
+    yield handler, cap
+    cap.close()
+    h.close()
+
+
+class TestHandlerIntegration:
+    def _setup_index(self, handler):
+        call(handler, "POST", "/index/i", b"{}")
+        call(handler, "POST", "/index/i/frame/f", b"{}")
+
+    def test_digest_header_and_capture_record(self, captured_handler):
+        handler, cap = captured_handler
+        self._setup_index(handler)
+        st, hd, body = call(
+            handler, "POST", "/index/i/query?timeout=5s",
+            b'SetBit(frame="f", rowID=1, columnID=3)')
+        assert st == 200
+        st, hd, body = call(handler, "POST", "/index/i/query",
+                            b'Bitmap(frame="f", rowID=1)')
+        assert st == 200
+        digest = hd[obs_capture.DIGEST_HEADER]
+        # The header IS the canonical digest of the response body.
+        assert digest == obs_capture.result_digest(
+            json.loads(body)["results"])
+        st, _, body = call(handler, "GET",
+                           "/debug/capture/records?since=0&limit=10")
+        assert st == 200
+        recs = json.loads(body)["records"]
+        assert [r["kind"] for r in recs] == ["query", "query"]
+        assert recs[0]["lane"] == "write"
+        assert recs[0]["opts"] == {"timeout": "5s"}
+        assert recs[1]["digest"] == digest
+        assert recs[1]["qid"]  # the X-Pilosa-Query-Id rode along
+        # Planner on by default: the plan fingerprint rides the read.
+        assert len(recs[1]["plan"]) == 12
+
+    def test_slow_log_cross_links_digest_and_capture_id(
+            self, captured_handler):
+        handler, cap = captured_handler
+        self._setup_index(handler)
+        call(handler, "POST", "/index/i/query",
+             b'SetBit(frame="f", rowID=1, columnID=3)')
+        st, hd, _ = call(handler, "POST", "/index/i/query",
+                         b'Bitmap(frame="f", rowID=1)')
+        st, _, body = call(handler, "GET", "/debug/queries/slow")
+        assert st == 200
+        entry = json.loads(body)["slow"][-1]
+        assert entry["resultDigest"] == hd[obs_capture.DIGEST_HEADER]
+        assert entry["captureId"] == 2
+
+    def test_no_digest_header_on_errors(self, captured_handler):
+        handler, cap = captured_handler
+        self._setup_index(handler)
+        st, hd, _ = call(handler, "POST", "/index/i/query",
+                         b"Bitmap(nope")
+        assert st == 400
+        assert obs_capture.DIGEST_HEADER not in hd
+
+    def test_import_ack_captured(self, captured_handler):
+        handler, cap = captured_handler
+        self._setup_index(handler)
+        req = pb.ImportRequest(Index="i", Frame="f", Slice=0,
+                               RowIDs=[1, 1, 2], ColumnIDs=[3, 4, 5])
+        st, _, _ = call(handler, "POST", "/import",
+                        req.SerializeToString(),
+                        content_type="application/x-protobuf",
+                        accept="application/x-protobuf")
+        assert st == 200
+        recs = cap.export()
+        imp = [r for r in recs if r["kind"] == "import"]
+        assert len(imp) == 1
+        assert imp[0]["bits"] == 3 and imp[0]["lane"] == "write"
+        assert imp[0]["frame"] == "f" and imp[0]["slice"] == 0
+
+    def test_capture_status_route(self, captured_handler):
+        handler, cap = captured_handler
+        self._setup_index(handler)
+        call(handler, "POST", "/index/i/query",
+             b'SetBit(frame="f", rowID=1, columnID=3)')
+        st, _, body = call(handler, "GET", "/debug/capture")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["enabled"] is True and doc["mode"] == "full"
+        assert doc["seq"] == 1 and doc["ring"]["written"] == 1
+
+    def test_records_route_validates_params(self, captured_handler):
+        handler, cap = captured_handler
+        st, _, _ = call(handler, "GET",
+                        "/debug/capture/records?since=nope")
+        assert st == 400
+        st, _, body = call(handler, "GET", "/debug/capture/records")
+        assert st == 200
+        doc = json.loads(body)
+        assert doc["records"] == [] and doc["next"] == 0
+
+    def test_capture_none_routes_still_answer(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        try:
+            handler = Handler(h, Executor(h, host="local"),
+                              host="local")
+            st, _, body = call(handler, "GET", "/debug/capture")
+            assert st == 200
+            assert json.loads(body) == {"enabled": False,
+                                        "mode": "off"}
+            st, hd, _ = call(handler, "GET", "/version")
+            assert st == 200
+        finally:
+            h.close()
+
+    def test_off_mode_writes_nothing(self, tmp_path):
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        cap = CaptureStore(str(tmp_path / "capture"), mode="off")
+        try:
+            handler = Handler(h, Executor(h, host="local"),
+                              host="local", capture=cap)
+            call(handler, "POST", "/index/i", b"{}")
+            call(handler, "POST", "/index/i/frame/f", b"{}")
+            st, hd, _ = call(
+                handler, "POST", "/index/i/query",
+                b'SetBit(frame="f", rowID=1, columnID=3)')
+            assert st == 200
+            # The digest header still rides (it is not a capture
+            # feature); the ring stays untouched.
+            assert obs_capture.DIGEST_HEADER in hd
+            assert cap.ring.written == 0 and cap.export() == []
+        finally:
+            cap.close()
+            h.close()
+
+
+# -- shadow diff over real HTTP -----------------------------------------------
+
+
+def _start_server(tmp_path, name):
+    from pilosa_tpu.server.server import Server
+    s = Server(str(tmp_path / name), host="127.0.0.1:0",
+               anti_entropy_interval=0, polling_interval=0)
+    s.open()
+    return s
+
+
+def _post(host, path, body=b""):
+    import urllib.request
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return r.status, r.read()
+
+
+class TestShadowDiff:
+    def test_self_shadow_clean_then_corrupted_candidate_caught(
+            self, tmp_path):
+        """Identical write streams to both endpoints → zero
+        mismatches; then one extra bit seeded into the candidate only
+        is caught with digests + full result dumps."""
+        sa = _start_server(tmp_path, "a")
+        sb = _start_server(tmp_path, "b")
+        try:
+            for host in (sa.host, sb.host):
+                _post(host, "/index/i", b"{}")
+                _post(host, "/index/i/frame/f", b"{}")
+            writes = [
+                {"seq": i + 1, "kind": "query", "lane": "write",
+                 "index": "i", "tenant": "i", "node": "cap",
+                 "t": float(i), "mono": float(i),
+                 "pql": f'SetBit(frame="f", rowID=1, columnID={c})'}
+                for i, c in enumerate((3, 5, 900))]
+            reads = [
+                {"seq": 10, "kind": "query", "lane": "read",
+                 "index": "i", "tenant": "i", "node": "cap",
+                 "t": 10.0, "mono": 10.0, "plan": "",
+                 "pql": 'Bitmap(frame="f", rowID=1)'},
+                {"seq": 11, "kind": "query", "lane": "read",
+                 "index": "i", "tenant": "i", "node": "cap",
+                 "t": 11.0, "mono": 11.0, "plan": "",
+                 "pql": 'Count(Bitmap(frame="f", rowID=1))'},
+            ]
+            clean = obs_replay.shadow(writes + reads, sa.host, sb.host,
+                                      senders=2)
+            assert clean["writes_replayed"] == 3
+            assert clean["reads_compared"] == 2
+            assert clean["mismatches"] == 0
+            assert clean["mismatch_rate"] == 0.0
+
+            # Seed the divergence: one bit only the candidate has.
+            _post(sb.host, "/index/i/query",
+                  b'SetBit(frame="f", rowID=1, columnID=31337)')
+            diff = obs_replay.shadow(reads, sa.host, sb.host,
+                                     senders=2)
+            assert diff["mismatches"] == 2
+            assert diff["mismatch_rate"] == 1.0
+            assert len(diff["dumps"]) == 2
+            for dump in diff["dumps"]:
+                assert (dump["baselineDigest"]
+                        != dump["candidateDigest"])
+                assert "plan" in dump
+                assert "31337" not in json.dumps(
+                    dump["baselineResults"])
+            # Dump completion order is nondeterministic with
+            # concurrent senders; the seeded bit shows up in the
+            # Bitmap dump, whichever slot it landed in.
+            assert any(
+                "31337" in json.dumps(d["candidateResults"])
+                for d in diff["dumps"])
+        finally:
+            sb.close()
+            sa.close()
+
+    def test_replay_against_live_server(self, tmp_path):
+        """Inline (fork-free) replay of a captured stream against a
+        real server: every query completes, per-lane stats populate."""
+        s = _start_server(tmp_path, "r")
+        try:
+            _post(s.host, "/index/i", b"{}")
+            _post(s.host, "/index/i/frame/f", b"{}")
+            recs = []
+            for i in range(6):
+                lane = "write" if i % 2 == 0 else "read"
+                pql = (f'SetBit(frame="f", rowID=1, columnID={i})'
+                       if lane == "write"
+                       else 'Bitmap(frame="f", rowID=1)')
+                recs.append({"seq": i + 1, "kind": "query",
+                             "lane": lane, "index": "i", "tenant": "i",
+                             "node": "cap", "t": float(i) * 0.01,
+                             "mono": float(i) * 0.01, "pql": pql})
+            out = obs_replay.replay(recs, s.host, rate=10.0,
+                                    processes=1, senders=4)
+            assert out["offered"] == 6 and out["completed"] == 6
+            assert out["errors"] == 0
+            assert set(out["lanes"]) == {"read", "write"}
+            assert out["lanes"]["read"]["p99_ms"] > 0
+        finally:
+            s.close()
+
+
+# -- the real 2-node leg (slow) -----------------------------------------------
+
+
+@pytest.mark.slow
+class TestTwoNodeCaptureLeg:
+    def test_cluster_capture_merge_replay_and_self_shadow(
+            self, tmp_path):
+        """Full-capture 2-node gossip cluster: traffic served by each
+        node lands in that node's ring, ``?scope=cluster`` merges both
+        exports in arrival order, the merged stream replays cleanly
+        against the cluster, and a shadow between the two members of
+        the SAME cluster shows zero mismatches."""
+        import signal
+        import subprocess
+
+        from podenv import cpu_env, free_port, wait_up
+
+        pa, pb = free_port(), free_port()
+        ga, gb = free_port(), free_port()
+        hosts = f"127.0.0.1:{pa},127.0.0.1:{pb}"
+        procs, logs = [], []
+
+        def spawn(name, port, internal, seed=""):
+            d = tmp_path / name
+            d.mkdir(exist_ok=True)
+            env = cpu_env()
+            env["PILOSA_TPU_MESH"] = "0"
+            env["PILOSA_TPU_WARMUP"] = "0"
+            env["PILOSA_CAPTURE_MODE"] = "full"
+            env["PILOSA_SENTINEL_ENABLED"] = "0"
+            log = open(tmp_path / f"{name}.log", "a")
+            logs.append(log)
+            argv = [sys.executable, "-m", "pilosa_tpu.cli", "server",
+                    "-d", str(d), "-b", f"127.0.0.1:{port}",
+                    "--cluster.type", "gossip",
+                    "--cluster.hosts", hosts,
+                    "--cluster.replicas", "1",
+                    "--cluster.internal-port", str(internal),
+                    "--anti-entropy.interval", "300s"]
+            if seed:
+                argv += ["--cluster.gossip-seed", seed]
+            p = subprocess.Popen(argv, env=env, stdout=log, stderr=log,
+                                 cwd=os.path.dirname(_HERE))
+            procs.append(p)
+            wait_up(f"127.0.0.1:{port}")
+            return f"127.0.0.1:{port}"
+
+        try:
+            host_a = spawn("a", pa, ga)
+            host_b = spawn("b", pb, gb, seed=f"127.0.0.1:{ga}")
+            _post(host_a, "/index/cap", b"{}")
+            _post(host_a, "/index/cap/frame/f", b"{}")
+            # Traffic on BOTH nodes: each captures what it served.
+            for i, host in enumerate([host_a, host_b] * 4):
+                _post(host, "/index/cap/query",
+                      f'SetBit(frame="f", rowID=1, columnID={i})'
+                      .encode())
+            for host in (host_a, host_b):
+                for _ in range(3):
+                    _post(host, "/index/cap/query",
+                          b'Bitmap(frame="f", rowID=1)')
+
+            # Per-node rings hold only what each node served.
+            own_a = obs_replay.fetch_records(host_a)
+            own_b = obs_replay.fetch_records(host_b)
+            assert len(own_a) == 7 and len(own_b) == 7
+            assert {r["node"] for r in own_a} == {host_a}
+
+            # The merged cluster export sees both nodes, in arrival
+            # order, and matches a manual merge of the two streams.
+            merged = obs_replay.fetch_records(host_a, cluster=True)
+            assert len(merged) == 14
+            assert {r["node"] for r in merged} == {host_a, host_b}
+            ts = [r["t"] for r in merged]
+            assert ts == sorted(ts)
+            assert merged == obs_capture.merge_streams([own_a, own_b])
+
+            # The merged stream replays cleanly against the cluster.
+            out = obs_replay.replay(merged, host_a, rate=50.0,
+                                    processes=1, senders=8)
+            assert out["completed"] == 14 and out["errors"] == 0
+
+            # Two members of one cluster must agree on every read:
+            # zero self-mismatches (writes are replayed into the same
+            # cluster twice — SetBit is idempotent).
+            shadow = obs_replay.shadow(merged, host_a, host_b,
+                                       senders=4)
+            assert shadow["reads_compared"] == 6
+            assert shadow["mismatches"] == 0
+        finally:
+            for p in procs:
+                try:
+                    p.send_signal(signal.SIGINT)
+                except OSError:
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+            for log in logs:
+                log.close()

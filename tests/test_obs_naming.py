@@ -254,6 +254,33 @@ class TestRouteTableDocumented:
         assert any(pattern == "/debug/plans"
                    for _m, _r, _f, _l, pattern in handler._routes)
 
+    def test_capture_routes_metrics_and_config_swept(self):
+        """ISSUE 19: the workload-capture surface — both /debug/capture
+        routes are registered (the README sweep above enforces their
+        documentation), the pilosa_capture_* families exist with the
+        documented labels (and so passed the naming gate at import),
+        and every [capture] config key round-trips through to_toml."""
+        handler = Handler(None, None)
+        patterns = {p for _m, _r, _f, _l, p in handler._routes}
+        assert "/debug/capture" in patterns
+        assert "/debug/capture/records" in patterns
+        fams = obs_metrics.default_registry().families()
+        for name in ("pilosa_capture_records_total",
+                     "pilosa_capture_dropped_total",
+                     "pilosa_capture_bytes_total"):
+            assert name in fams, name
+            assert fams[name].type == "counter", name
+        assert fams["pilosa_capture_records_total"].labelnames == (
+            "kind",)
+        assert fams["pilosa_capture_dropped_total"].labelnames == (
+            "reason",)
+        from pilosa_tpu.utils.config import Config
+        toml = Config().to_toml()
+        assert "[capture]" in toml
+        for key in ("mode", "sample-n", "segment-bytes", "segments",
+                    "redact"):
+            assert f"\n{key} = " in toml.split("[capture]")[1], key
+
     def test_fault_metrics_registered(self):
         """The fault-layer metric families promised by
         docs/FAULT_TOLERANCE.md exist in the default registry (and so

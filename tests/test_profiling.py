@@ -84,8 +84,7 @@ def test_pprof_http_endpoints(tmp_path):
         assert status == 200 and b"MainThread" in body
         # Heap: GET is READ-ONLY (a monitoring scrape must not toggle
         # interpreter-wide allocation tracing); POST ?op=start|stop
-        # arm/disarm. The old GET ?off=1 form survives as a
-        # deprecation shim.
+        # arm/disarm.
         import tracemalloc
         status, _, body = call(handler, "GET", "/debug/pprof/heap")
         assert status == 200 and b"not tracing" in body
@@ -106,11 +105,13 @@ def test_pprof_http_endpoints(tmp_path):
         status, _, body = call(handler, "POST",
                                "/debug/pprof/heap?op=nope")
         assert status == 400
-        # Deprecation shim: the old GET ?off=1 still disarms, loudly.
+        # The old mutating GET ?off=1 shim is gone: GET ignores the
+        # param and never disarms tracing.
         call(handler, "POST", "/debug/pprof/heap?op=start")
         status, _, body = call(handler, "GET",
                                "/debug/pprof/heap?off=1")
-        assert status == 200 and b"DEPRECATED" in body
-        assert not tracemalloc.is_tracing()
+        assert status == 200 and b"DEPRECATED" not in body
+        assert tracemalloc.is_tracing()
+        call(handler, "POST", "/debug/pprof/heap?op=stop")
     finally:
         h.close()
