@@ -370,6 +370,20 @@ def main() -> None:
                     "on_p50_ms": co.get("on_p50_ms"),
                     "off_p50_ms": co.get("off_p50_ms"),
                     "target_ratio": co.get("target_ratio")}
+            # Disaster recovery (suite.config_backup): the
+            # backup-while-serving p50 overhead (continuous
+            # coordinator passes vs off, interleaved; ISSUE 20's
+            # ≤5% bound) and the digest-verified restore wall time
+            # into a fresh node, on the line of record.
+            bk = manifest.get("backup") or {}
+            if bk.get("ratio") is not None:
+                line["backup"] = {
+                    "ratio": bk["ratio"],
+                    "on_p50_ms": bk.get("on_p50_ms"),
+                    "off_p50_ms": bk.get("off_p50_ms"),
+                    "restore_wall_s": bk.get("restore_wall_s"),
+                    "restore_fragments": bk.get("restore_fragments"),
+                    "target_ratio": bk.get("target_ratio")}
         except (OSError, ValueError, KeyError):
             pass
         # Serving-quality artifact (sched subsystem): open-loop

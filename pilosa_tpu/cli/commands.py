@@ -328,7 +328,8 @@ def cmd_server(args, stdout, stderr) -> int:
                     tenants_config=cfg.tenants,
                     scrub_config=cfg.scrub,
                     tier_config=cfg.tier,
-                    capture_config=cfg.capture)
+                    capture_config=cfg.capture,
+                    backup_config=cfg.backup)
     if gossip_set is not None:
         server.broadcaster = gossip_set
     server.open()
@@ -433,7 +434,79 @@ def cmd_export(args, stdout, stderr) -> int:
     return 0
 
 
+def _open_cli_archive(spec: str):
+    """The archive store behind --archive for offline CLI modes (gc,
+    list, restore, check): an explicit ``dir:<path>`` — the CLI has no
+    data dir to root a bare ``dir`` under."""
+    from ..backup import archive as backup_archive
+    if spec == "dir":
+        raise PilosaError(
+            "--archive needs an explicit path (dir:/path/to/archive)")
+    store = backup_archive.open_archive(spec, "")
+    if store is None:
+        raise PilosaError("--archive required for this mode")
+    return store
+
+
 def cmd_backup(args, stdout, stderr) -> int:
+    """Three faces (docs/DISASTER_RECOVERY.md): the legacy frame-view
+    tar dump (-i/-f/-o), the cluster-archive backup driven through the
+    coordinator (--mode [--wait]), and offline archive maintenance
+    against --archive (--list, --gc [--dry-run] [--keep N]
+    [--sweep-orphans])."""
+    import json as json_mod
+    import urllib.request
+
+    if getattr(args, "list", False) or getattr(args, "gc", False):
+        from ..backup import archive as backup_archive
+        from ..backup import retention as retention_mod
+        store = _open_cli_archive(args.archive)
+        if args.gc:
+            plan = retention_mod.run_gc(
+                store, keep_fulls=args.keep, dry_run=args.dry_run,
+                sweep_orphans=args.sweep_orphans)
+            print(json_mod.dumps(plan, indent=1), file=stdout)
+            return 0
+        for m in backup_archive.list_backups(store):
+            print(f"{m['id']}  {m.get('kind', '?'):11s}"
+                  f"  t={m.get('t', 0.0):.3f}"
+                  f"  fragments={len(m.get('fragments', []))}"
+                  f"  parent={m.get('parent') or '-'}", file=stdout)
+        return 0
+
+    if getattr(args, "mode", ""):
+        # Cluster-archive backup: POST /backup on any member; it
+        # coordinates against its configured [backup] archive.
+        req = urllib.request.Request(
+            f"http://{args.host}/backup",
+            data=json_mod.dumps({"kind": args.mode}).encode(),
+            method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            status = json_mod.loads(r.read())
+        print(json_mod.dumps(status, indent=1), file=stdout)
+        if not args.wait:
+            return 0
+        deadline = time.time() + 1800
+        while time.time() < deadline:
+            time.sleep(0.5)
+            with urllib.request.urlopen(
+                    f"http://{args.host}/backup", timeout=10) as r:
+                op = json_mod.loads(r.read()).get("op") or {}
+            if op.get("phase") == "done":
+                print(json_mod.dumps(op, indent=1), file=stdout)
+                return 0
+            if op.get("phase") == "failed":
+                print(json_mod.dumps(op, indent=1), file=stdout)
+                return 1
+        print("backup: timed out waiting", file=stderr)
+        return 1
+
+    if not (args.index and args.frame and args.output):
+        print("backup: either --mode (cluster archive backup),"
+              " --archive with --list/--gc, or -i/-f/-o (frame-view"
+              " tar)", file=stderr)
+        return 1
     from ..cluster.client import Client
     client = Client(args.host)
     with open(args.output, "wb") as f:
@@ -442,6 +515,38 @@ def cmd_backup(args, stdout, stderr) -> int:
 
 
 def cmd_restore(args, stdout, stderr) -> int:
+    """Two faces (docs/DISASTER_RECOVERY.md): the legacy frame-view
+    tar restore (-i/-f INPUT), and the archive restore (--archive
+    [--id ID] [--to-timestamp T] [--verify RECORDS]) that rebuilds a
+    cluster of any size with digest-verified admission and optional
+    workload-replay verification."""
+    import json as json_mod
+
+    if getattr(args, "archive", ""):
+        from ..backup import restore as restore_mod
+        from ..backup import verify as verify_mod
+        from ..utils import logger as logger_mod
+        store = _open_cli_archive(args.archive)
+        summary = restore_mod.run_restore(
+            args.host, store, backup_id=args.id or None,
+            to_timestamp=args.to_timestamp,
+            logger=logger_mod.Logger(stderr))
+        if args.verify:
+            from ..obs import replay as obs_replay
+            records = obs_replay.load_records(args.verify)
+            summary["verify"] = verify_mod.verify_restore(
+                args.host, records,
+                logger=logger_mod.Logger(stderr))
+        print(json_mod.dumps(summary, indent=1), file=stdout)
+        if args.verify and (summary["verify"]["mismatches"]
+                            or not summary["verify"]["compared"]):
+            return 1
+        return 0
+
+    if not (args.index and args.frame and args.input):
+        print("restore: either --archive (archive restore) or"
+              " -i/-f INPUT (frame-view tar)", file=stderr)
+        return 1
     from ..cluster.client import Client
     client = Client(args.host)
     with open(args.input, "rb") as f:
@@ -615,16 +720,66 @@ def _check_deep(args, stdout) -> int:
     return rc
 
 
+def _check_deep_archive(args, stdout) -> int:
+    """``check --deep --archive``: the offline-archive face of the
+    deep check (docs/DISASTER_RECOVERY.md). Walks every committed
+    backup manifest, re-fetches and re-crcs every referenced pool
+    object plus the reassembled body digest and footer, and re-crcs
+    every archived WAL segment — same verdict-line format as the
+    data-dir walk, nonzero exit on ANY corruption."""
+    from ..backup import archive as backup_archive
+    store = _open_cli_archive(args.archive)
+    rc = 0
+    n = corrupt = 0
+    backups = backup_archive.list_backups(store)
+    if not backups:
+        print(f"{args.archive}: no committed backups found",
+              file=stdout)
+    for manifest in backups:
+        for name, v in backup_archive.verify_backup(store, manifest):
+            n += 1
+            if v.get("corrupt"):
+                corrupt += 1
+                rc = 1
+                print(f"{name}: CORRUPT: {v.get('error')}",
+                      file=stdout)
+            else:
+                print(f"{name}: ok ({v.get('coverage')} coverage,"
+                      f" {v.get('blocks', 0)} blocks,"
+                      f" {v.get('bytes', 0)} bytes)", file=stdout)
+    wal_n = 0
+    for key, v in backup_archive.verify_wal(store):
+        n += 1
+        wal_n += 1
+        if v.get("corrupt"):
+            corrupt += 1
+            rc = 1
+            print(f"{key}: CORRUPT: {v.get('error')}", file=stdout)
+        else:
+            print(f"{key}: ok ({v.get('batches', 0)} batches)",
+                  file=stdout)
+    print(f"checked {len(backups)} backups + {wal_n} wal segments"
+          f" ({n} objects): {corrupt} corrupt", file=stdout)
+    return rc
+
+
 def cmd_check(args, stdout, stderr) -> int:
     # Offline consistency check of fragment files (ctl/check.go:46-113).
     # Bitmap.check() validates every container kind, including the run
     # invariants: buffer length vs numRuns, sorted, non-overlapping,
     # non-adjacent intervals, Σ lengths == cardinality.
     # --deep instead runs the offline storage scrub (footer + WAL
-    # checksums) and accepts whole data DIRS.
+    # checksums) and accepts whole data DIRS; with --archive it walks
+    # an offline backup archive instead.
     from ..proto import internal_pb2 as pb
     if getattr(args, "deep", False):
+        if getattr(args, "archive", ""):
+            return _check_deep_archive(args, stdout)
         return _check_deep(args, stdout)
+    if not args.paths:
+        print("check: paths required (or --deep --archive)",
+              file=stderr)
+        return 1
     rc = 0
     for path in args.paths:
         if path.endswith(".cache"):
@@ -1014,27 +1169,69 @@ def build_parser() -> argparse.ArgumentParser:
     c = client_cmd("export", "export frame as CSV", cmd_export)
     c.add_argument("--view", default="standard")
 
-    c = client_cmd("backup", "backup a frame view to a tar file",
-                   cmd_backup)
+    c = client_cmd("backup", "cluster backup into the archive, or a"
+                             " frame-view tar dump", cmd_backup,
+                   index=False, frame=False)
     c.add_argument("--view", default="standard")
-    c.add_argument("-o", "--output", required=True)
+    c.add_argument("-o", "--output", default="",
+                   help="frame-view tar mode: output file")
+    c.add_argument("--mode", default="", choices=["full", "incremental"],
+                   help="take a cluster backup of this kind into the"
+                        " server's configured [backup] archive")
+    c.add_argument("--wait", action="store_true",
+                   help="with --mode: poll until the backup settles")
+    c.add_argument("--archive", default="",
+                   help="offline archive spec (dir:/path) for"
+                        " --list/--gc")
+    c.add_argument("--list", action="store_true",
+                   help="list committed backups in --archive")
+    c.add_argument("--gc", action="store_true",
+                   help="run archive retention GC against --archive")
+    c.add_argument("--keep", type=int, default=2, metavar="N",
+                   help="GC: full backups to keep (default 2, min 1)")
+    c.add_argument("--dry-run", action="store_true",
+                   help="GC: print the plan, delete nothing")
+    c.add_argument("--sweep-orphans", action="store_true",
+                   help="GC: also delete pool objects no committed"
+                        " manifest references (NOT safe while a"
+                        " backup is in flight)")
 
-    c = client_cmd("restore", "restore a frame view from a tar file",
-                   cmd_restore)
+    c = client_cmd("restore", "restore from the backup archive, or a"
+                              " frame-view tar", cmd_restore,
+                   index=False, frame=False)
     c.add_argument("--view", default="standard")
-    c.add_argument("input")
+    c.add_argument("input", nargs="?", default="",
+                   help="frame-view tar mode: input file")
+    c.add_argument("--archive", default="",
+                   help="archive spec (dir:/path): restore the"
+                        " cluster at --host from it")
+    c.add_argument("--id", default="",
+                   help="restore this backup id (default: newest"
+                        " usable)")
+    c.add_argument("--to-timestamp", dest="to_timestamp",
+                   type=float, default=None, metavar="EPOCH",
+                   help="point-in-time cut: replay archived WAL only"
+                        " up to this unix timestamp")
+    c.add_argument("--verify", default="",
+                   help="after restoring, replay this captured-"
+                        "workload records file and compare result"
+                        " digests (nonzero exit on any mismatch)")
 
     c = sub.add_parser("sort", help="sort CSV by fragment position")
     c.add_argument("path")
     c.set_defaults(fn=cmd_sort)
 
     c = sub.add_parser("check", help="consistency-check fragment files")
-    c.add_argument("paths", nargs="+")
+    c.add_argument("paths", nargs="*")
     c.add_argument("--deep", action="store_true",
                    help="offline storage scrub: verify snapshot"
                         " footers (block crc32s + body digest) and"
                         " WAL-tail checksums; accepts data DIRS;"
                         " nonzero exit on corruption")
+    c.add_argument("--archive", default="",
+                   help="with --deep: walk an offline backup archive"
+                        " (dir:/path) instead — re-crc every object"
+                        " of every committed backup + WAL segment")
     c.set_defaults(fn=cmd_check)
 
     c = sub.add_parser("inspect", help="dump container stats of a file")

@@ -1037,21 +1037,26 @@ class Client:
     # roll to a temp file, so a 128 MB+ slice never sits in RAM whole.
     _SPOOL_MAX = 1 << 24
 
-    def backup_slice(self, index: str, frame: str, view: str, slice: int):
+    def backup_slice(self, index: str, frame: str, view: str, slice: int,
+                     snapshot: bool = False):
         """One slice's fragment tar as a seekable bounded spool (the
         caller closes it); None if the slice doesn't exist yet
         (client.go:541-580). The body downloads inside the per-owner
-        loop so a node dying mid-transfer fails over to a replica."""
+        loop so a node dying mid-transfer fails over to a replica.
+        ``snapshot=True`` asks the owner to fold its WAL into a fresh
+        footered snapshot first (the backup coordinator's barrier)."""
         import shutil
         import tempfile
         nodes = self.fragment_nodes(index, slice)
         random.shuffle(nodes)
+        snap = "&snapshot=1" if snapshot else ""
         last_err: Optional[Exception] = None
         for node in nodes:
             try:
                 rd = self._do_stream(
                     f"/fragment/data?index={index}&frame={frame}"
-                    f"&view={view}&slice={slice}", host=node["host"])
+                    f"&view={view}&slice={slice}{snap}",
+                    host=node["host"])
             except ClientError as e:
                 last_err = e
                 continue

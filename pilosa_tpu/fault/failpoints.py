@@ -41,6 +41,19 @@ Sites (each exercised by at least one test):
                     failure and torn-promotion deterministically
                     injectable; partition mode scopes by direction
                     (``push`` / ``fetch``)
+``backup.push``     backup/archive object puts (fragment blocks, WAL
+                    segments, manifests) — fires AFTER the store
+                    write, so error mode models a crash with the
+                    object durable (resume must skip it), torn mode
+                    replaces the object with a prefix (a torn archive
+                    object restore admission must catch), corrupt
+                    flips real bits of the stored object; partition
+                    mode scopes by object key
+``restore.fetch``   backup/archive object gets during restore /
+                    verify — error makes a fetch fail, corrupt flips
+                    stored bits BEFORE the read so digest-verified
+                    admission (the PR-15 contract) must reject them,
+                    torn raises mid-transfer; partition scopes by key
 ==================  =========================================================
 
 Spec grammar (one string per site)::
@@ -95,7 +108,8 @@ ACTIVE: Optional["Failpoints"] = None
 
 SITES = ("rpc.send", "rpc.recv", "wal.append", "snapshot.write",
          "gossip.deliver", "mesh.dispatch", "ring.write",
-         "resize.stream", "storage.read", "tier.fault", "tier.fetch")
+         "resize.stream", "storage.read", "tier.fault", "tier.fetch",
+         "backup.push", "restore.fetch")
 
 
 def env_key(site: str) -> str:

@@ -45,11 +45,12 @@ from .diskring import SegmentRing
 from .trace import Span, Trace
 
 # Keep reasons, in decision order (the first matching wins).
-# ``watchdog`` and ``anomaly`` are force-keeps claimed mid-flight (a
-# stall trip / a sentinel finding), not end-of-query decisions.
+# ``watchdog``, ``anomaly``, and ``backup`` are force-keeps claimed
+# mid-flight (a stall trip / a sentinel finding / a backup-window
+# error), not end-of-query decisions.
 REASONS = ("deadline", "cancelled", "error", "shed", "partial",
            "corruption", "breaker", "failpoint", "slow", "head",
-           "requested", "watchdog", "anomaly")
+           "requested", "watchdog", "anomaly", "backup")
 
 DEFAULT_HEAD_N = 1000
 DEFAULT_SLOW_FLOOR_S = 0.1

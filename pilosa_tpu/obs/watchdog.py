@@ -32,6 +32,11 @@ Four stall detectors, each cheap enough to run every second:
   ``tier_stall_s``: a wedged snapshot barrier or a hung blob
   transfer — the window where watermark pressure keeps building
   and cold reads stop promoting.
+- **backup_stall** — the backup plane (pilosa_tpu.backup) has work in
+  flight — a coordinated backup pushing fragments, or the continuous
+  WAL archiver with pending segments — but has completed nothing for
+  ``backup_stall_s``: a hung archive store or a wedged source fetch,
+  the window where the recovery point silently stops advancing.
 
 A trip increments ``pilosa_watchdog_trips_total{cause}``, force-keeps
 every in-flight trace (reason ``watchdog`` — the wedged query's spans
@@ -56,11 +61,12 @@ DEFAULT_QUEUE_STALL_S = 10.0
 DEFAULT_RESIZE_STALL_S = 60.0
 DEFAULT_SCRUB_STALL_S = 300.0
 DEFAULT_TIER_STALL_S = 120.0
+DEFAULT_BACKUP_STALL_S = 120.0
 DEFAULT_RETRIP_S = 60.0
 
 CAUSES = ("wal_flusher", "stuck_query", "gossip_silence",
           "admission_stall", "resize_stall", "scrub_stall",
-          "tier_stall")
+          "tier_stall", "backup_stall")
 
 
 class Watchdog:
@@ -71,6 +77,7 @@ class Watchdog:
                  resize_progress_fn: Optional[Callable] = None,
                  scrub_progress_fn: Optional[Callable] = None,
                  tier_progress_fn: Optional[Callable] = None,
+                 backup_progress_fn: Optional[Callable] = None,
                  interval_s: float = DEFAULT_INTERVAL_S,
                  wal_stall_s: float = DEFAULT_WAL_STALL_S,
                  deadline_grace_s: float = DEFAULT_DEADLINE_GRACE_S,
@@ -79,6 +86,7 @@ class Watchdog:
                  resize_stall_s: float = DEFAULT_RESIZE_STALL_S,
                  scrub_stall_s: float = DEFAULT_SCRUB_STALL_S,
                  tier_stall_s: float = DEFAULT_TIER_STALL_S,
+                 backup_stall_s: float = DEFAULT_BACKUP_STALL_S,
                  retrip_s: float = DEFAULT_RETRIP_S, logger=None):
         from ..utils import logger as logger_mod
         self.registry = registry      # sched.QueryRegistry
@@ -97,6 +105,9 @@ class Watchdog:
         # manager has pending demotion/eviction work
         # (tier.manager.TierManager.stall_age).
         self.tier_progress_fn = tier_progress_fn
+        # () -> None | seconds_without_progress while the backup plane
+        # has in-flight work (server.BackupManager.stall_age).
+        self.backup_progress_fn = backup_progress_fn
         self.interval_s = max(0.02, float(interval_s))
         self.wal_stall_s = float(wal_stall_s)
         self.deadline_grace_s = float(deadline_grace_s)
@@ -105,6 +116,7 @@ class Watchdog:
         self.resize_stall_s = float(resize_stall_s)
         self.scrub_stall_s = float(scrub_stall_s)
         self.tier_stall_s = float(tier_stall_s)
+        self.backup_stall_s = float(backup_stall_s)
         self.retrip_s = float(retrip_s)
         self.logger = logger or logger_mod.NOP
         self.trips = 0
@@ -221,6 +233,18 @@ class Watchdog:
                     "tier_stall",
                     f"tier work pending, no transition completed for"
                     f" {age:.1f}s"))
+        # Stalled backup plane (pilosa_tpu.backup).
+        if (self.backup_progress_fn is not None
+                and self.backup_stall_s > 0):
+            try:
+                age = self.backup_progress_fn()
+            except Exception:  # noqa: BLE001
+                age = None
+            if age is not None and age > self.backup_stall_s:
+                out.append((
+                    "backup_stall",
+                    f"backup work in flight, no progress for"
+                    f" {age:.1f}s"))
         return out
 
     # -- the trip --------------------------------------------------------------
@@ -273,4 +297,5 @@ class Watchdog:
                                "queueStallS": self.queue_stall_s,
                                "resizeStallS": self.resize_stall_s,
                                "scrubStallS": self.scrub_stall_s,
-                               "tierStallS": self.tier_stall_s}}
+                               "tierStallS": self.tier_stall_s,
+                               "backupStallS": self.backup_stall_s}}

@@ -89,7 +89,8 @@ class Server:
                  tenants_config: Optional[TenantsConfig] = None,
                  scrub_config: Optional[ScrubConfig] = None,
                  tier_config: Optional[TierConfig] = None,
-                 capture_config: Optional[CaptureConfig] = None):
+                 capture_config: Optional[CaptureConfig] = None,
+                 backup_config=None):
         self.data_dir = data_dir
         self.host = host
         self.logger = logger
@@ -226,6 +227,18 @@ class Server:
         # open() (the segment ring lives under the data dir).
         self.capture_config = capture_config or CaptureConfig()
         self.capture = None
+        # Disaster recovery (pilosa_tpu.backup;
+        # docs/DISASTER_RECOVERY.md): the archive store, this node's
+        # continuous WAL-segment archiver, and the in-flight backup
+        # coordinator op (None unless THIS node is driving one) —
+        # built in open() when [backup] names an archive.
+        from ..utils.config import BackupConfig
+        self.backup_config = backup_config or BackupConfig()
+        self.backup_store = None
+        self.wal_archiver = None
+        self.backup_op = None
+        self._backup_mu = threading.Lock()
+        self._last_backup: Optional[dict] = None
         self.executor: Optional[Executor] = None
         self.handler: Optional[Handler] = None
         self.pod = None  # parallel.pod.Pod once open() joins a pod
@@ -464,6 +477,21 @@ class Server:
             # Fragments already opened above get their manager hook
             # now (later opens are picked up by the sync pass).
             self.tier.sync()
+        # Disaster recovery (pilosa_tpu.backup;
+        # docs/DISASTER_RECOVERY.md): the archive store + this node's
+        # continuous WAL-segment archiver. The archiver's sink hooks
+        # the group-commit WAL before any traffic arrives, so the
+        # PITR record starts at boot, not at the first backup.
+        if self.backup_config.archive:
+            from ..backup import archive as backup_archive
+            from ..backup.walarchive import WalArchiver
+            self.backup_store = backup_archive.open_archive(
+                self.backup_config.archive, self.holder.path)
+            self.wal_archiver = WalArchiver(
+                self.backup_store, self.holder.path, self.host,
+                interval_s=self.backup_config.wal_interval,
+                logger=self.logger)
+            self.wal_archiver.start()
         # Stall watchdog (obs.watchdog): wedged WAL flusher, stuck
         # legs, gossip silence, non-draining admission queue. A trip
         # force-keeps in-flight traces and dumps the blackbox.
@@ -474,6 +502,7 @@ class Server:
                 blackbox=self.blackbox,
                 gossip_age_fn=self._gossip_age,
                 resize_progress_fn=self._resize_progress,
+                backup_progress_fn=self._backup_progress,
                 scrub_progress_fn=(self.scrubber.stall_age
                                    if self.scrubber is not None
                                    else None),
@@ -488,6 +517,7 @@ class Server:
                 resize_stall_s=self.watchdog_config.resize_stall,
                 scrub_stall_s=self.watchdog_config.scrub_stall,
                 tier_stall_s=self.watchdog_config.tier_stall,
+                backup_stall_s=self.watchdog_config.backup_stall,
                 retrip_s=self.watchdog_config.retrip,
                 logger=self.logger)
             self.watchdog.start()
@@ -576,6 +606,11 @@ class Server:
                 # Capture records name the serving node; merged
                 # multi-node exports disambiguate on it.
                 self.capture.node = new_host
+            if self.wal_archiver is not None:
+                # WAL segments are keyed by the serving identity;
+                # the seq counter is lazy, so no segment has been
+                # written under the provisional name yet.
+                self.wal_archiver.node = new_host
             if self.fault is not None:
                 # The self-identity every fault consult skips.
                 self.fault.node = new_host
@@ -606,6 +641,16 @@ class Server:
         _rj = resize_mod.ResizeJournal.for_data_dir(self.holder.path)
         if _rj.load() and _rj.in_flight():
             self._spawn(self._recover_resize, "resize-recover")
+        # Backup journal recovery: an in-flight backup whose
+        # coordinator (us) was killed resumes under the same id —
+        # journaled fragments and pool-resident objects are skipped,
+        # so recovery converges instead of re-shipping.
+        if self.backup_store is not None:
+            from ..backup import coordinator as backup_coord
+            _bj = backup_coord.BackupJournal.for_data_dir(
+                self.holder.path)
+            if _bj.load() and _bj.in_flight():
+                self._spawn(self._recover_backup, "backup-recover")
         if self.runtime is not None:
             self.runtime.start()
         if self.profile_config.continuous:
@@ -636,6 +681,15 @@ class Server:
             # Cooperative stop; an in-flight journal is recovered (or
             # aborted) on the next open.
             self.resize_op.cancel()
+        if self.backup_op is not None:
+            # Cooperative stop; the journal stays in flight so the
+            # next open resumes the backup under the same id.
+            self.backup_op.cancel()
+        if self.wal_archiver is not None:
+            # Before the holder closes: the final flush ships every
+            # buffered batch, so an orderly shutdown loses no PITR
+            # coverage.
+            self.wal_archiver.close()
         if self.sentinel is not None:
             self.sentinel.stop()
         # Scrub/repair before the holder closes: a mid-pass verify or
@@ -947,6 +1001,81 @@ class Server:
             return None
         import time as time_mod
         return op.phase, time_mod.monotonic() - op.last_progress
+
+    # -- cluster backup (pilosa_tpu.backup; docs/DISASTER_RECOVERY.md) --------
+
+    def start_backup(self, kind: str = "full"):
+        """Begin a cluster backup into the configured archive with
+        THIS node as coordinator; returns the BackupCoordinator
+        (already running on a background thread). One at a time per
+        node — the journal is single-writer."""
+        from ..backup import coordinator as backup_coord
+        if self.backup_store is None:
+            raise PilosaError("no backup archive configured"
+                              " ([backup] archive)")
+        with self._backup_mu:
+            op = self.backup_op
+            if op is not None and not (
+                    op.phase in (backup_coord.PHASE_DONE,
+                                 backup_coord.PHASE_FAILED)
+                    or op.finished_at):
+                raise PilosaError(
+                    f"backup {op.id} already in flight"
+                    f" (phase {op.phase})")
+            # An in-flight journal belongs to a backup still being
+            # recovered (recovery registers itself as backup_op only
+            # once it runs) — refuse rather than interleave two
+            # coordinators into one journal.
+            _bj = backup_coord.BackupJournal.for_data_dir(
+                self.holder.path)
+            if _bj.load() and _bj.in_flight() and (
+                    op is None or _bj.state.get("id") != op.id):
+                raise PilosaError(
+                    f"backup {_bj.state.get('id')} still recovering"
+                    f" (journal phase {_bj.state.get('phase')})")
+            coord = backup_coord.BackupCoordinator(
+                self, self.backup_store, kind=kind,
+                logger=self.logger)
+            self.backup_op = coord
+        self._spawn(coord.run, f"backup-{coord.id}")
+        return coord
+
+    def abort_backup(self) -> Optional[dict]:
+        """Operator abort: cooperatively stop the in-flight backup
+        this node coordinates. The journal stays in flight, so the
+        next open (or a later POST) resumes it instead of discarding
+        the objects already pushed."""
+        from ..backup import coordinator as backup_coord
+        op = self.backup_op
+        if op is None or op.phase in (backup_coord.PHASE_DONE,
+                                      backup_coord.PHASE_FAILED) \
+                or op.finished_at:
+            return None
+        op.cancel()
+        return op.status()
+
+    def _recover_backup(self) -> None:
+        try:
+            from ..backup import coordinator as backup_coord
+            status = backup_coord.recover(self, logger=self.logger)
+            if status is not None:
+                self.logger.printf("backup recovery finished: %s",
+                                   status.get("phase"))
+        except Exception as e:  # noqa: BLE001 - recovery best-effort
+            self.logger.printf("backup recovery failed: %s", e)
+
+    def _backup_progress(self):
+        """Watchdog hook (obs.watchdog cause ``backup_stall``):
+        seconds-without-progress while this node coordinates an active
+        backup, else None."""
+        from ..backup import coordinator as backup_coord
+        op = self.backup_op
+        if op is None or op.phase in (backup_coord.PHASE_IDLE,
+                                      backup_coord.PHASE_DONE,
+                                      backup_coord.PHASE_FAILED):
+            return None
+        import time as time_mod
+        return time_mod.monotonic() - op.last_progress
 
     def _epoch_path(self) -> str:
         return os.path.join(self.holder.path, "epoch.json")
@@ -1326,6 +1455,16 @@ class Server:
         # fetches — where did the working set live when it happened.
         if self.tier is not None:
             out["tier"] = self.tier.state()
+        # Disaster recovery: the in-flight backup op + this node's
+        # WAL-archiver lag — "was a backup running, and how much PITR
+        # coverage was buffered" is the post-crash retro question.
+        if self.backup_store is not None:
+            backup_block: dict = {"configured": True}
+            if self.backup_op is not None:
+                backup_block["op"] = self.backup_op.status()
+            if self.wal_archiver is not None:
+                backup_block["walArchiver"] = self.wal_archiver.state()
+            out["backup"] = backup_block
         try:
             out["threads"] = thread_dump()[:20000]
         except Exception:  # noqa: BLE001 - interpreter-internal API

@@ -182,6 +182,7 @@ class Fragment:
 
         self.storage: Optional[roaring.Bitmap] = None
         self.cache = None                       # rank/lru count cache
+        self._cache_flushed = None              # last flush_cache blob
         self.row_cache = cache_mod.SimpleCache()
         self.device = DeviceRowCache()
         self.checksums: dict[int, bytes] = {}
@@ -2249,6 +2250,7 @@ class Fragment:
                         shutil.copyfileobj(src, f)
                     self.cache = cache_mod.new_cache(self.cache_type,
                                                      self.cache_size)
+                    self._cache_flushed = None  # sidecar replaced
                     self._open_cache()
                 else:
                     raise PilosaError(f"invalid fragment archive file:"
@@ -2258,14 +2260,20 @@ class Fragment:
 
     def flush_cache(self) -> None:
         """Persist cache ids to the .cache protobuf sidecar
-        (reference fragment.go:1067-1093)."""
+        (reference fragment.go:1067-1093). Skips the write when the
+        serialized blob matches the last flush — the sidecar is
+        already those bytes, and repeated backup/stream passes must
+        not pay (or hold ``_mu`` across) an fsync per fragment."""
         with self._mu:
             if self.cache is None:
                 return
             blob = pb.Cache(IDs=self.cache.ids()).SerializeToString()
+            if blob == self._cache_flushed:
+                return
             tmp = self.cache_path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(blob)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self.cache_path)
+            self._cache_flushed = blob

@@ -265,6 +265,10 @@ class GroupCommitWal:
                 # Successful durable write: the cheapest possible
                 # recovery signal when the node was write-unready.
                 _diskfull.note_write_ok()
+                if _archive_sinks:
+                    # Still the leader here, so per-WAL sink order is
+                    # commit order (the PITR replay contract).
+                    _archive_notify(file, batch)
         el = time.perf_counter() - ft0
         with self._mu:
             self._leader = False
@@ -358,6 +362,49 @@ def _note_wait(seconds: float) -> None:
     cost = _accounting.current_cost()
     if cost is not None:
         cost.note_wal_wait(seconds)
+
+
+# -- archive sinks (pilosa_tpu.backup WAL-segment archiving) ------------------
+# A server with continuous WAL archiving registers a sink keyed by its
+# data-dir root; every successfully committed batch whose file lives
+# under that root is handed to the sink (file path + batch bytes) while
+# the committing leader still holds leadership — per-WAL batch order is
+# exactly commit order, which the point-in-time replay contract needs.
+# Process-global because the WAL layer is: multiple servers in one
+# process (the test suite) each claim only their own subtree.
+
+_archive_mu = threading.Lock()
+_archive_sinks: dict = {}
+
+
+def register_archive_sink(root: str, fn) -> None:
+    """Route committed batches of WALs under ``root`` (a data dir) to
+    ``fn(file_path, batch_bytes)``. The sink must be fast and must not
+    raise into the commit path (errors are swallowed here — archiving
+    is asynchronous durability, never a write-ack dependency)."""
+    with _archive_mu:
+        _archive_sinks[os.path.abspath(root)] = fn
+
+
+def deregister_archive_sink(root: str) -> None:
+    with _archive_mu:
+        _archive_sinks.pop(os.path.abspath(root), None)
+
+
+def _archive_notify(file, batch: bytes) -> None:
+    name = getattr(file, "name", None)
+    if not isinstance(name, str):
+        return
+    name = os.path.abspath(name)
+    with _archive_mu:
+        sinks = list(_archive_sinks.items())
+    for root, fn in sinks:
+        if name.startswith(root + os.sep):
+            try:
+                fn(name, batch)
+            except Exception:  # noqa: BLE001 - archiving never fails a commit
+                pass
+            return
 
 
 def _register_dirty(wal: GroupCommitWal) -> None:

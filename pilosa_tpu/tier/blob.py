@@ -1,4 +1,4 @@
-"""Pluggable blob store for the truly-cold tier.
+"""Pluggable blob store for the truly-cold tier and the backup archive.
 
 The store holds demoted fragment snapshots decomposed the same way
 the resize FragmentStreamer moves them: per container block, keyed by
@@ -7,10 +7,13 @@ content. A fragment's blob layout::
     <prefix>/manifest.json   {"bodyLen", "footerLen", "blockN",
                               "crcs": [u32...], "head": "head-<crc>",
                               "blocks": ["blk-<i>-<crc>", ...],
-                              "tail": "tail-<crc>", "size"}
+                              "tail": "tail-<hash>", "size"}
     <prefix>/head-<crc32>    header region [0, offsets[0])
     <prefix>/blk-<i>-<crc32> container block i's bytes
-    <prefix>/tail-<crc32>    footer bytes [bodyLen, bodyLen+footerLen)
+    <prefix>/tail-<hash>     footer bytes [bodyLen, bodyLen+footerLen)
+                             (hash-named, not crc: a footer ends with
+                             its own crc32, so crc32(tail) is the same
+                             constant for every valid footer)
 
 Pushes are block-diffs: a block object whose name (index + crc32,
 straight from the PR-15 footer table) already exists is skipped, so
@@ -20,6 +23,14 @@ a store instead of a peer. Objects are content-named and writes are
 tmp+rename, so a crashed push never leaves a readable-but-wrong
 object; the manifest lands last and is the commit point.
 
+The object-pool helpers (``build_manifest`` / ``push_objects`` /
+``fetch_objects`` / ``verify_objects``) take the manifest explicitly,
+so a consumer that stores manifests elsewhere — the backup archive
+keeps them inside a whole-backup manifest, letting every backup share
+one content-addressed pool — reuses the exact push/verify machinery
+the tier uses. ``push_fragment`` / ``fetch_fragment`` /
+``verify_fragment`` keep the tier's per-prefix-manifest layout.
+
 :class:`LocalDirBlobStore` stands in for object storage (one file per
 object under a root dir). Any object store with put/get/delete/exists
 semantics slots in behind :class:`BlobStore`.
@@ -27,6 +38,7 @@ semantics slots in behind :class:`BlobStore`.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import tempfile
@@ -58,11 +70,32 @@ class BlobStore:
         return {"kind": type(self).__name__}
 
 
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a just-renamed entry survives a host
+    crash. Best-effort on platforms whose dirs refuse O_RDONLY opens
+    or fsync (the rename itself is still atomic)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 class LocalDirBlobStore(BlobStore):
     """One file per object under ``root`` — the local-dir backend
     standing in for object storage. Keys use ``/`` separators and map
     to subdirectories; writes are tmp+rename within the root so a
-    concurrent reader never sees a torn object."""
+    concurrent reader never sees a torn object, and both the object
+    bytes and the parent directory entry are fsynced before ``put``
+    returns — the archive consistency contract requires that a
+    visible object is a READABLE object even across a host crash
+    (without the directory fsync, the rename itself can be lost while
+    a dependent manifest written later survives)."""
 
     def __init__(self, root: str):
         self.root = root
@@ -75,15 +108,16 @@ class LocalDirBlobStore(BlobStore):
 
     def put(self, key: str, data: bytes) -> None:
         path = self._path(key)
-        os.makedirs(os.path.dirname(path) or self.root, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
-                                   prefix=".put-")
+        parent = os.path.dirname(path) or self.root
+        os.makedirs(parent, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=parent, prefix=".put-")
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(data)
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, path)
+            _fsync_dir(parent)
         except BaseException:
             try:
                 os.remove(tmp)
@@ -140,6 +174,121 @@ def fragment_prefix(index: str, frame: str, view: str, slice: int
     return f"{index}/{frame}/{view}/{slice}"
 
 
+# -- shared object-pool machinery ---------------------------------------------
+
+
+def build_manifest(buf: bytes,
+                   info: integrity_mod.FooterInfo) -> dict:
+    """The per-fragment object manifest for a verified cold snapshot
+    (body + footer, no op records): content-derived object names plus
+    the footer's block geometry. Pure — no store I/O."""
+    offs = info.offsets
+    sizes = info.sizes
+    head_end = int(offs[0]) if info.block_n else info.body_len
+    head = bytes(buf[:head_end])
+    tail = bytes(buf[info.body_len:info.body_len + info.size])
+    return {"bodyLen": info.body_len, "footerLen": info.size,
+            "blockN": info.block_n,
+            "crcs": [int(c) for c in info.crcs],
+            "offsets": [int(o) for o in offs],
+            "sizes": [int(s) for s in sizes],
+            "head": f"head-{zlib.crc32(head) & 0xFFFFFFFF:08x}",
+            "blocks": [f"blk-{i}-{int(info.crcs[i]):08x}"
+                       for i in range(info.block_n)],
+            # NOT crc-named: the footer ends with its own crc32, and
+            # crc32(data || crc32(data)) is the constant residue
+            # 0x2144DF1C for EVERY valid footer — crc-naming (even
+            # seeded or prefixed: CRC is affine, equal-length tails
+            # shift identically) would alias every tail in a shared
+            # pool to one object.
+            "tail": f"tail-{hashlib.blake2b(tail, digest_size=4).hexdigest()}",
+            "size": info.body_len + info.size}
+
+
+def push_objects(store: BlobStore, prefix: str, buf: bytes,
+                 manifest: dict, put=None) -> tuple[int, int]:
+    """Push the head/block/tail objects ``manifest`` names under
+    ``prefix``, skipping objects the store already holds — the
+    block-diff push. Does NOT write a manifest (the caller owns the
+    commit point). ``put`` overrides the store write (fault-injection
+    wrappers). Returns (objects_pushed, bytes_pushed)."""
+    put = put or (lambda key, data: store.put(key, data))
+    offs = manifest["offsets"]
+    sizes = manifest["sizes"]
+    body_len = int(manifest["bodyLen"])
+    block_n = int(manifest["blockN"])
+    head_end = int(offs[0]) if block_n else body_len
+    pushed = nbytes = 0
+    parts = [(manifest["head"], bytes(buf[:head_end]))]
+    for i in range(block_n):
+        off, size = int(offs[i]), int(sizes[i])
+        parts.append((manifest["blocks"][i],
+                      bytes(buf[off:off + size])))
+    parts.append((manifest["tail"],
+                  bytes(buf[body_len:body_len
+                            + int(manifest["footerLen"])])))
+    for name, data in parts:
+        key = f"{prefix}/{name}"
+        if store.exists(key):
+            continue
+        put(key, data)
+        pushed, nbytes = pushed + 1, nbytes + len(data)
+    return pushed, nbytes
+
+
+def fetch_objects(store: BlobStore, prefix: str, manifest: dict,
+                  get=None) -> bytes:
+    """Reassemble a fragment file from the objects ``manifest`` names.
+    Raises CorruptionError when any object's bytes contradict the
+    manifest's recorded crcs or sizes — the caller discards and
+    retries/blocks, never admits bad bytes. ``get`` overrides the
+    store read (fault-injection wrappers)."""
+    get = get or (lambda key: store.get(key))
+    parts = [get(f"{prefix}/{manifest['head']}")]
+    for i, name in enumerate(manifest["blocks"]):
+        data = get(f"{prefix}/{name}")
+        want = int(manifest["crcs"][i])
+        if (zlib.crc32(data) & 0xFFFFFFFF) != want:
+            raise integrity_mod.CorruptionError(
+                f"blob fragment {prefix}: block {i} crc mismatch")
+        parts.append(data)
+    parts.append(get(f"{prefix}/{manifest['tail']}"))
+    buf = b"".join(parts)
+    if len(buf) != int(manifest["size"]):
+        raise integrity_mod.CorruptionError(
+            f"blob fragment {prefix}: reassembled {len(buf)}B,"
+            f" manifest says {manifest['size']}B")
+    return buf
+
+
+def verify_objects(store: BlobStore, prefix: str,
+                   manifest: dict) -> dict:
+    """Scrub one fragment's objects: every object's bytes against the
+    manifest crcs (block objects) and the reassembled body against
+    the footer digest. Verdict dict in the scrub_file shape."""
+    try:
+        buf = fetch_objects(store, prefix, manifest)
+    except integrity_mod.CorruptionError as e:
+        return {"corrupt": True, "error": str(e), "coverage": "full"}
+    except OSError as e:
+        return {"corrupt": True, "error": f"missing object: {e}",
+                "coverage": "none"}
+    try:
+        info = integrity_mod.parse_footer(buf, int(manifest["bodyLen"]))
+        if info is None:
+            return {"corrupt": True, "error": "no footer",
+                    "coverage": "none"}
+        integrity_mod.verify_body(buf, info)
+    except ValueError as e:
+        return {"corrupt": True, "error": str(e), "coverage": "full"}
+    return {"corrupt": False, "coverage": "full",
+            "blocks": int(manifest["blockN"]),
+            "bytes": len(buf)}
+
+
+# -- the tier's per-prefix-manifest layout ------------------------------------
+
+
 def push_fragment(store: BlobStore, prefix: str, buf: bytes,
                   info: integrity_mod.FooterInfo) -> tuple[int, int]:
     """Decompose a verified cold snapshot (body + footer, no op
@@ -147,38 +296,8 @@ def push_fragment(store: BlobStore, prefix: str, buf: bytes,
     blocks the store already holds — the block-diff push. Returns
     (objects_pushed, bytes_pushed). The manifest write is the commit
     point and always lands last."""
-    offs = info.offsets
-    sizes = info.sizes
-    head_end = int(offs[0]) if info.block_n else info.body_len
-    head = bytes(buf[:head_end])
-    head_key = f"{prefix}/head-{zlib.crc32(head) & 0xFFFFFFFF:08x}"
-    tail = bytes(buf[info.body_len:info.body_len + info.size])
-    tail_key = f"{prefix}/tail-{zlib.crc32(tail) & 0xFFFFFFFF:08x}"
-    pushed = nbytes = 0
-    if not store.exists(head_key):
-        store.put(head_key, head)
-        pushed, nbytes = pushed + 1, nbytes + len(head)
-    block_keys = []
-    for i in range(info.block_n):
-        off, size = int(offs[i]), int(sizes[i])
-        key = f"{prefix}/blk-{i}-{int(info.crcs[i]):08x}"
-        block_keys.append(key)
-        if store.exists(key):
-            continue
-        store.put(key, bytes(buf[off:off + size]))
-        pushed, nbytes = pushed + 1, nbytes + size
-    if not store.exists(tail_key):
-        store.put(tail_key, tail)
-        pushed, nbytes = pushed + 1, nbytes + len(tail)
-    manifest = {"bodyLen": info.body_len, "footerLen": info.size,
-                "blockN": info.block_n,
-                "crcs": [int(c) for c in info.crcs],
-                "offsets": [int(o) for o in offs],
-                "sizes": [int(s) for s in sizes],
-                "head": head_key.rsplit("/", 1)[1],
-                "blocks": [k.rsplit("/", 1)[1] for k in block_keys],
-                "tail": tail_key.rsplit("/", 1)[1],
-                "size": info.body_len + info.size}
+    manifest = build_manifest(buf, info)
+    pushed, nbytes = push_objects(store, prefix, buf, manifest)
     store.put(f"{prefix}/manifest.json",
               json.dumps(manifest).encode())
     return pushed, nbytes
@@ -200,21 +319,7 @@ def fetch_fragment(store: BlobStore, prefix: str) -> bytes:
     if manifest is None:
         raise integrity_mod.CorruptionError(
             f"blob fragment {prefix}: no manifest")
-    parts = [store.get(f"{prefix}/{manifest['head']}")]
-    for i, name in enumerate(manifest["blocks"]):
-        data = store.get(f"{prefix}/{name}")
-        want = int(manifest["crcs"][i])
-        if (zlib.crc32(data) & 0xFFFFFFFF) != want:
-            raise integrity_mod.CorruptionError(
-                f"blob fragment {prefix}: block {i} crc mismatch")
-        parts.append(data)
-    parts.append(store.get(f"{prefix}/{manifest['tail']}"))
-    buf = b"".join(parts)
-    if len(buf) != int(manifest["size"]):
-        raise integrity_mod.CorruptionError(
-            f"blob fragment {prefix}: reassembled {len(buf)}B,"
-            f" manifest says {manifest['size']}B")
-    return buf
+    return fetch_objects(store, prefix, manifest)
 
 
 def delete_fragment(store: BlobStore, prefix: str) -> int:
@@ -236,21 +341,4 @@ def verify_fragment(store: BlobStore, prefix: str) -> dict:
     if manifest is None:
         return {"corrupt": True, "error": "no manifest",
                 "coverage": "none"}
-    try:
-        buf = fetch_fragment(store, prefix)
-    except integrity_mod.CorruptionError as e:
-        return {"corrupt": True, "error": str(e), "coverage": "full"}
-    except OSError as e:
-        return {"corrupt": True, "error": f"missing object: {e}",
-                "coverage": "none"}
-    try:
-        info = integrity_mod.parse_footer(buf, int(manifest["bodyLen"]))
-        if info is None:
-            return {"corrupt": True, "error": "no footer",
-                    "coverage": "none"}
-        integrity_mod.verify_body(buf, info)
-    except ValueError as e:
-        return {"corrupt": True, "error": str(e), "coverage": "full"}
-    return {"corrupt": False, "coverage": "full",
-            "blocks": int(manifest["blockN"]),
-            "bytes": len(buf)}
+    return verify_objects(store, prefix, manifest)

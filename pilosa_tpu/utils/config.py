@@ -402,7 +402,9 @@ class WatchdogConfig:
     ``scrub_stall`` the no-progress bound on an in-flight storage
     scrub pass (storage.scrub); ``tier_stall`` the no-progress bound
     while the tier working-set manager has pending work
-    (tier.manager); ``retrip`` rate-limits repeat trips per cause
+    (tier.manager); ``backup_stall`` the no-progress bound on an
+    in-flight cluster backup this node coordinates (backup
+    coordinator); ``retrip`` rate-limits repeat trips per cause
     (0 on any threshold disables that detector)."""
     enabled: bool = True
     interval: float = 1.0
@@ -413,6 +415,7 @@ class WatchdogConfig:
     resize_stall: float = 60.0
     scrub_stall: float = 300.0
     tier_stall: float = 120.0
+    backup_stall: float = 120.0
     retrip: float = 60.0
 
 
@@ -479,6 +482,23 @@ class CaptureConfig:
     redact: str = ""
 
 
+@dataclass
+class BackupConfig:
+    """[backup] section (backup package): the disaster-recovery
+    archive. ``archive`` selects the archive blob backend (same spec
+    grammar as ``tier.blob``: ``""`` = no archive, ``dir:<path>`` =
+    the local-dir backend standing in for object storage; bare
+    ``dir`` roots it at ``<data-dir>/_archive``); ``wal_interval``
+    paces the continuous WAL-segment archiver flush (the
+    point-in-time-recovery granularity is bounded by it);
+    ``keep_fulls`` is the retention floor — GC keeps the newest N
+    full backups plus every incremental and WAL segment any of them
+    depend on."""
+    archive: str = ""
+    wal_interval: float = 2.0
+    keep_fulls: int = 2
+
+
 def _parse_bool(v) -> bool:
     if isinstance(v, bool):
         return v
@@ -501,6 +521,7 @@ class Config:
     scrub: ScrubConfig = field(default_factory=ScrubConfig)
     tier: TierConfig = field(default_factory=TierConfig)
     capture: CaptureConfig = field(default_factory=CaptureConfig)
+    backup: BackupConfig = field(default_factory=BackupConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     slo: SLOConfig = field(default_factory=SLOConfig)
     fault: FaultConfig = field(default_factory=FaultConfig)
@@ -619,6 +640,7 @@ queue-stall = "{dur(self.watchdog.queue_stall)}"
 resize-stall = "{dur(self.watchdog.resize_stall)}"
 scrub-stall = "{dur(self.watchdog.scrub_stall)}"
 tier-stall = "{dur(self.watchdog.tier_stall)}"
+backup-stall = "{dur(self.watchdog.backup_stall)}"
 retrip = "{dur(self.watchdog.retrip)}"
 
 [scrub]
@@ -647,6 +669,11 @@ sample-n = {self.capture.sample_n}
 segment-bytes = {self.capture.segment_bytes}
 segments = {self.capture.segments}
 redact = "{self.capture.redact}"
+
+[backup]
+archive = "{self.backup.archive}"
+wal-interval = "{dur(self.backup.wal_interval)}"
+keep-fulls = {self.backup.keep_fulls}
 
 [profile]
 continuous = {str(self.profile.continuous).lower()}
@@ -816,6 +843,7 @@ def load(path: str = "", env: dict | None = None) -> Config:
                           ("resize-stall", "resize_stall"),
                           ("scrub-stall", "scrub_stall"),
                           ("tier-stall", "tier_stall"),
+                          ("backup-stall", "backup_stall"),
                           ("retrip", "retrip")):
             if key in wd:
                 setattr(cfg.watchdog, attr, parse_duration(wd[key]))
@@ -861,6 +889,13 @@ def load(path: str = "", env: dict | None = None) -> Config:
             cfg.capture.segments = int(cp["segments"])
         if "redact" in cp:
             cfg.capture.redact = str(cp["redact"])
+        bu = data.get("backup", {})
+        if "archive" in bu:
+            cfg.backup.archive = str(bu["archive"])
+        if "wal-interval" in bu:
+            cfg.backup.wal_interval = parse_duration(bu["wal-interval"])
+        if "keep-fulls" in bu:
+            cfg.backup.keep_fulls = int(bu["keep-fulls"])
         p = data.get("profile", {})
         if "continuous" in p:
             cfg.profile.continuous = _parse_bool(p["continuous"])
@@ -1061,6 +1096,8 @@ def load(path: str = "", env: dict | None = None) -> Config:
                              "scrub_stall"),
                             ("PILOSA_WATCHDOG_TIER_STALL",
                              "tier_stall"),
+                            ("PILOSA_WATCHDOG_BACKUP_STALL",
+                             "backup_stall"),
                             ("PILOSA_WATCHDOG_RETRIP", "retrip")):
         if env.get(env_key_):
             setattr(cfg.watchdog, attr_, parse_duration(env[env_key_]))
@@ -1106,6 +1143,13 @@ def load(path: str = "", env: dict | None = None) -> Config:
         cfg.capture.segments = int(env["PILOSA_CAPTURE_SEGMENTS"])
     if env.get("PILOSA_CAPTURE_REDACT"):
         cfg.capture.redact = env["PILOSA_CAPTURE_REDACT"]
+    if env.get("PILOSA_BACKUP_ARCHIVE"):
+        cfg.backup.archive = env["PILOSA_BACKUP_ARCHIVE"]
+    if env.get("PILOSA_BACKUP_WAL_INTERVAL"):
+        cfg.backup.wal_interval = parse_duration(
+            env["PILOSA_BACKUP_WAL_INTERVAL"])
+    if env.get("PILOSA_BACKUP_KEEP_FULLS"):
+        cfg.backup.keep_fulls = int(env["PILOSA_BACKUP_KEEP_FULLS"])
     if env.get("PILOSA_PLUGINS_PATH"):
         cfg.plugins_path = env["PILOSA_PLUGINS_PATH"]
     if env.get("PILOSA_FAULT_ENABLED"):

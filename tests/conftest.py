@@ -80,3 +80,9 @@ def pytest_configure(config):
                    " (ISSUE 19) — digest/redaction/ring/export units"
                    " run tier-1, the real 2-node merged-export replay"
                    " leg is additionally `slow`")
+    config.addinivalue_line(
+        "markers", "backup: disaster-recovery tests (ISSUE 20) — "
+                   "archive/journal/retention/walarchive units and"
+                   " the in-process backup→destroy→restore legs run"
+                   " tier-1, the SIGKILL coordinator-crash legs are"
+                   " additionally `slow`")

@@ -1,0 +1,830 @@
+"""Disaster-recovery tests (ISSUE 20): archive object pool, WAL
+segments, the journaled coordinator, retention/GC, the WAL archiver,
+and the full backup → destroy-every-data-dir → restore → verified
+point-in-time legs.
+
+The e2e class is the acceptance test: a 2-node cluster is backed up
+while serving, post-backup writes travel via the WAL archive, every
+data dir is destroyed, and a DIFFERENT-size (1-node) cluster restored
+from the archive serves digest-identical answers (the PR-19 replay
+contract); ``--to-timestamp`` provably excludes the post-cut write.
+"""
+
+import io
+import json
+import os
+import shutil
+import time
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import SLICE_WIDTH
+from pilosa_tpu.backup import archive as archive_mod
+from pilosa_tpu.backup import coordinator as coord_mod
+from pilosa_tpu.backup import restore as restore_mod
+from pilosa_tpu.backup import retention as retention_mod
+from pilosa_tpu.backup import verify as verify_mod
+from pilosa_tpu.backup.walarchive import WalArchiver
+from pilosa_tpu.cli.commands import main as cli_main
+from pilosa_tpu.cluster.client import Client
+from pilosa_tpu.cluster.topology import Node
+from pilosa_tpu.fault import failpoints
+from pilosa_tpu.obs import replay as replay_mod
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.storage import integrity as integrity_mod
+from pilosa_tpu.storage import roaring
+from pilosa_tpu.tier import blob as blob_mod
+from pilosa_tpu.utils.config import BackupConfig
+
+pytestmark = pytest.mark.backup
+
+
+def _footered(b: "roaring.Bitmap") -> bytes:
+    buf = io.BytesIO()
+    b.write_to(buf, footer=True)
+    return buf.getvalue()
+
+
+def _bitmap(values) -> "roaring.Bitmap":
+    b = roaring.Bitmap()
+    b.add_many(np.asarray(sorted(values), dtype=np.uint64))
+    return b
+
+
+def _store(tmp_path, name="archive"):
+    return blob_mod.LocalDirBlobStore(str(tmp_path / name))
+
+
+def _fake_backup(store, bid, kind, t, parent=None, rows=(1,),
+                 wal_start=None, index="i", frame="f", slice=0):
+    """A committed backup manifest whose single fragment really lives
+    in the store's object pool — enough for retention/CLI tests."""
+    body = _footered(_bitmap(rows))
+    prefix = archive_mod.fragment_prefix(index, frame, "standard",
+                                         slice)
+    fm, digest, _pushed, _nbytes = archive_mod.push_fragment_bytes(
+        store, prefix, body)
+    manifest = {
+        "version": archive_mod.MANIFEST_VERSION, "id": bid,
+        "kind": kind, "parent": parent, "t": t, "coordinator": "n0",
+        "epoch": 0, "hosts": ["n0"], "schema": [],
+        "maxSlices": {index: slice},
+        "walStart": dict(wal_start or {}),
+        "fragments": [{"index": index, "frame": frame,
+                       "view": "standard", "slice": slice,
+                       "prefix": prefix, "bodyDigest": digest,
+                       "manifest": fm}],
+    }
+    archive_mod.write_backup_manifest(store, manifest)
+    return manifest
+
+
+# -- archive object pool ------------------------------------------------------
+
+
+class TestArchiveObjects:
+    def test_fragment_roundtrip(self, tmp_path):
+        store = _store(tmp_path)
+        body = _footered(_bitmap(range(0, 5000, 3)))
+        prefix = archive_mod.fragment_prefix("i", "f", "standard", 0)
+        fm, digest, pushed, nbytes = archive_mod.push_fragment_bytes(
+            store, prefix, body)
+        assert pushed == 2 + int(fm["blockN"])
+        assert nbytes == len(body)
+        back = archive_mod.fetch_fragment_bytes(store, prefix, fm,
+                                                digest)
+        assert bytes(back) == body
+
+    def test_push_skips_pool_resident_objects(self, tmp_path):
+        store = _store(tmp_path)
+        body = _footered(_bitmap(range(0, 5000, 3)))
+        prefix = archive_mod.fragment_prefix("i", "f", "standard", 0)
+        archive_mod.push_fragment_bytes(store, prefix, body)
+        _fm, _d, pushed, nbytes = archive_mod.push_fragment_bytes(
+            store, prefix, body)
+        assert pushed == 0 and nbytes == 0
+
+    def test_incremental_ships_only_changed_blocks(self, tmp_path):
+        store = _store(tmp_path)
+        vals = set(range(0, 200000, 7))
+        prefix = archive_mod.fragment_prefix("i", "f", "standard", 0)
+        fm1, _d, full_pushed, _n = archive_mod.push_fragment_bytes(
+            store, prefix, _footered(_bitmap(vals)))
+        assert int(fm1["blockN"]) > 1, "need a multi-block body"
+        vals.add(3)  # dirty one block
+        _fm2, _d2, delta_pushed, _n2 = \
+            archive_mod.push_fragment_bytes(store, prefix,
+                                            _footered(_bitmap(vals)))
+        assert 0 < delta_pushed < full_pushed
+
+    def test_tail_objects_are_content_distinct(self, tmp_path):
+        """Regression: a footer ends with its own crc32, and
+        crc32(data || crc32(data)) is the constant CRC residue — a
+        crc-named tail aliased EVERY fragment's footer to one pool
+        object, so a shared pool served stale footers."""
+        store = _store(tmp_path)
+        prefix = archive_mod.fragment_prefix("i", "f", "standard", 0)
+        fm1, d1, _p, _n = archive_mod.push_fragment_bytes(
+            store, prefix, _footered(_bitmap([1, 2])))
+        fm2, d2, _p2, _n2 = archive_mod.push_fragment_bytes(
+            store, prefix, _footered(_bitmap([3, 4])))
+        assert fm1["tail"] != fm2["tail"]
+        for fm, d in ((fm1, d1), (fm2, d2)):
+            archive_mod.fetch_fragment_bytes(store, prefix, fm, d)
+
+    def test_digest_mismatch_rejected(self, tmp_path):
+        store = _store(tmp_path)
+        body = _footered(_bitmap([1, 2, 3]))
+        prefix = archive_mod.fragment_prefix("i", "f", "standard", 0)
+        fm, _digest, _p, _n = archive_mod.push_fragment_bytes(
+            store, prefix, body)
+        with pytest.raises(integrity_mod.CorruptionError):
+            archive_mod.fetch_fragment_bytes(store, prefix, fm,
+                                             "0" * 32)
+
+    def test_corrupt_stored_object_detected(self, tmp_path):
+        store = _store(tmp_path)
+        body = _footered(_bitmap(range(0, 5000, 3)))
+        prefix = archive_mod.fragment_prefix("i", "f", "standard", 0)
+        fm, digest, _p, _n = archive_mod.push_fragment_bytes(
+            store, prefix, body)
+        key = sorted(store.list(prefix + "/"))[0]
+        path = store._path(key)
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(raw)
+        with pytest.raises(integrity_mod.CorruptionError):
+            archive_mod.fetch_fragment_bytes(store, prefix, fm,
+                                             digest)
+
+    def test_torn_stored_object_detected(self, tmp_path):
+        store = _store(tmp_path)
+        body = _footered(_bitmap(range(0, 5000, 3)))
+        prefix = archive_mod.fragment_prefix("i", "f", "standard", 0)
+        fm, digest, _p, _n = archive_mod.push_fragment_bytes(
+            store, prefix, body)
+        key = sorted(store.list(prefix + "/"))[0]
+        path = store._path(key)
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(raw[:max(1, len(raw) // 2)])
+        with pytest.raises(integrity_mod.CorruptionError):
+            archive_mod.fetch_fragment_bytes(store, prefix, fm,
+                                             digest)
+
+    def test_unfootered_body_never_enters_archive(self, tmp_path):
+        store = _store(tmp_path)
+        buf = io.BytesIO()
+        _bitmap([1, 2]).write_to(buf, footer=False)
+        with pytest.raises(integrity_mod.CorruptionError):
+            archive_mod.push_fragment_bytes(
+                store, archive_mod.fragment_prefix("i", "f",
+                                                   "standard", 0),
+                buf.getvalue())
+
+
+# -- WAL segments -------------------------------------------------------------
+
+
+class TestWalSegments:
+    def test_roundtrip(self, tmp_path):
+        store = _store(tmp_path)
+        batches = [{"frag": "i/f/standard/0", "t": 12.5,
+                    "ops": b"\x01" * 26},
+                   {"frag": "i/f/standard/1", "t": 13.0,
+                    "ops": b"\x02" * 13}]
+        body = archive_mod.encode_wal_segment("127.0.0.1:1", 0,
+                                              batches)
+        key = archive_mod.wal_segment_key("127.0.0.1:1", 0, body)
+        store.put(key, body)
+        seg = archive_mod.read_wal_segment(store, key)
+        assert seg["seq"] == 0
+        assert [b["frag"] for b in seg["batches"]] == \
+            ["i/f/standard/0", "i/f/standard/1"]
+        assert seg["batches"][0]["ops"] == b"\x01" * 26
+
+    def test_crc_tamper_detected(self, tmp_path):
+        store = _store(tmp_path)
+        body = archive_mod.encode_wal_segment(
+            "n1", 3, [{"frag": "i/f/standard/0", "t": 1.0,
+                       "ops": b"x" * 13}])
+        key = archive_mod.wal_segment_key("n1", 3, body)
+        store.put(key, body + b" ")
+        with pytest.raises(integrity_mod.CorruptionError):
+            archive_mod.read_wal_segment(store, key)
+
+    def test_list_order_and_next_seq(self, tmp_path):
+        store = _store(tmp_path)
+        for node, seq in (("b", 1), ("a", 2), ("a", 0), ("b", 0)):
+            body = archive_mod.encode_wal_segment(node, seq, [])
+            store.put(archive_mod.wal_segment_key(node, seq, body),
+                      body)
+        store.put("wal/a/garbage", b"nope")  # unparseable: ignored
+        segs = [(n, s) for _k, n, s in
+                archive_mod.list_wal_segments(store)]
+        assert segs == [("a", 0), ("a", 2), ("b", 0), ("b", 1)]
+        assert archive_mod.next_wal_seq(store, "a") == 3
+        assert archive_mod.next_wal_seq(store, "c") == 0
+
+    def test_sanitized_node_names(self):
+        key = archive_mod.wal_segment_key("127.0.0.1:10101", 0, b"")
+        assert ":" not in key.split("/", 1)[1]
+        assert archive_mod.parse_wal_key(key) is not None
+        assert archive_mod.parse_wal_key("wal/n/short") is None
+        assert archive_mod.parse_wal_key("data/i/f/s/0/head-0") is None
+
+
+# -- the crash journal --------------------------------------------------------
+
+
+class TestBackupJournal:
+    def test_write_load_clear(self, tmp_path):
+        j = coord_mod.BackupJournal.for_data_dir(str(tmp_path))
+        assert j.load() is None and not j.in_flight()
+        j.write(phase=coord_mod.PHASE_SNAPSHOT, id="abc",
+                kind="full")
+        j2 = coord_mod.BackupJournal.for_data_dir(str(tmp_path))
+        state = j2.load()
+        assert state["id"] == "abc" and j2.in_flight()
+        j2.write(phase=coord_mod.PHASE_DONE)
+        assert not j2.in_flight()
+        j2.clear()
+        assert coord_mod.BackupJournal.for_data_dir(
+            str(tmp_path)).load() is None
+
+    def test_version_mismatch_ignored(self, tmp_path):
+        path = os.path.join(str(tmp_path), coord_mod.JOURNAL_FILE)
+        with open(path, "w") as f:
+            json.dump({"version": 99, "phase": "snapshot"}, f)
+        assert coord_mod.BackupJournal(path).load() is None
+
+
+# -- retention + GC -----------------------------------------------------------
+
+
+class TestRetention:
+    def _wal(self, store, node, seq):
+        body = archive_mod.encode_wal_segment(node, seq, [])
+        key = archive_mod.wal_segment_key(node, seq, body)
+        store.put(key, body)
+        return key
+
+    def test_plan_keeps_last_n_fulls_and_wal_floor(self, tmp_path):
+        store = _store(tmp_path)
+        _fake_backup(store, "f1", "full", 100.0, rows=(1, 2),
+                     wal_start={"n": 0})
+        _fake_backup(store, "i1", "incremental", 150.0, parent="f1",
+                     rows=(1, 2, 3), wal_start={"n": 2})
+        _fake_backup(store, "f2", "full", 200.0, rows=(4,),
+                     wal_start={"n": 5})
+        _fake_backup(store, "f3", "full", 300.0, rows=(5,),
+                     wal_start={"n": 7})
+        keys = [self._wal(store, "n", seq) for seq in range(9)]
+        plan = retention_mod.plan_gc(store, keep_fulls=2)
+        assert plan["kept"] == ["f2", "f3"]
+        assert plan["newestFull"] == "f3"
+        assert sorted(plan["dropBackups"]) == ["f1", "i1"]
+        # WAL floor = min walStart across kept (5): seqs 0..4 drop.
+        assert plan["dropWalSegments"] == sorted(keys[:5])
+
+    def test_shared_pool_objects_survive_a_drop(self, tmp_path):
+        store = _store(tmp_path)
+        _fake_backup(store, "a", "full", 100.0, rows=(9,))
+        _fake_backup(store, "b", "full", 200.0, rows=(9,))
+        plan = retention_mod.plan_gc(store, keep_fulls=1)
+        assert plan["dropBackups"] == ["a"]
+        assert plan["dropObjects"] == []  # pool shared with "b"
+
+    def test_incremental_chain_keeps_ancestors(self, tmp_path):
+        store = _store(tmp_path)
+        _fake_backup(store, "f1", "full", 100.0, rows=(1,))
+        _fake_backup(store, "i1", "incremental", 150.0, parent="f1",
+                     rows=(2,))
+        _fake_backup(store, "f2", "full", 200.0, rows=(3,))
+        _fake_backup(store, "i2", "incremental", 250.0, parent="i1",
+                     rows=(4,))
+        plan = retention_mod.plan_gc(store, keep_fulls=1)
+        # i2 rides the window; its parent chain (i1 -> f1) must
+        # survive even though both predate the kept full.
+        assert set(plan["kept"]) == {"f1", "i1", "f2", "i2"}
+        assert plan["dropBackups"] == []
+
+    def test_orphan_sweep_is_opt_in_and_dry_run_deletes_nothing(
+            self, tmp_path):
+        store = _store(tmp_path)
+        _fake_backup(store, "f1", "full", 100.0, rows=(1,))
+        stray = "data/i/f/standard/0/stray-deadbeef"
+        store.put(stray, b"debris")
+        plan = retention_mod.plan_gc(store, keep_fulls=1)
+        assert stray in plan["orphanObjects"]
+        out = retention_mod.run_gc(store, keep_fulls=1, dry_run=True,
+                                   sweep_orphans=True)
+        assert out["deleted"] == 0 and store.exists(stray)
+        out = retention_mod.run_gc(store, keep_fulls=1)
+        assert not out["orphanObjects"] and store.exists(stray)
+        out = retention_mod.run_gc(store, keep_fulls=1,
+                                   sweep_orphans=True)
+        assert stray in out["orphanObjects"]
+        assert not store.exists(stray)
+
+    def test_gc_drops_old_full_but_archive_stays_restorable(
+            self, tmp_path):
+        store = _store(tmp_path)
+        _fake_backup(store, "f1", "full", 100.0, rows=(1, 2))
+        keep = _fake_backup(store, "f2", "full", 200.0, rows=(3, 4))
+        out = retention_mod.run_gc(store, keep_fulls=1)
+        assert out["dropBackups"] == ["f1"]
+        assert archive_mod.read_backup(store, "f1") is None
+        for name, verdict in archive_mod.verify_backup(store, keep):
+            assert not verdict["corrupt"], (name, verdict)
+
+    def test_run_gc_refuses_to_break_newest_chain(self, tmp_path,
+                                                  monkeypatch):
+        store = _store(tmp_path)
+        m = _fake_backup(store, "f1", "full", 100.0, rows=(1,))
+        evil = retention_mod.plan_gc(store, 1)
+        evil["dropObjects"] = sorted(
+            archive_mod.manifest_object_keys(m))
+        monkeypatch.setattr(retention_mod, "plan_gc",
+                            lambda *a, **k: dict(evil))
+        with pytest.raises(retention_mod.GCError):
+            retention_mod.run_gc(store, 1)
+        assert archive_mod.read_backup(store, "f1") is not None
+        for key in evil["dropObjects"]:
+            assert store.exists(key)
+
+    def test_run_gc_refuses_wal_the_newest_full_replays(
+            self, tmp_path, monkeypatch):
+        store = _store(tmp_path)
+        _fake_backup(store, "f1", "full", 100.0, rows=(1,),
+                     wal_start={"n": 3})
+        key = self._wal(store, "n", 5)  # >= floor: still replayed
+        evil = retention_mod.plan_gc(store, 1)
+        evil["dropWalSegments"] = [key]
+        monkeypatch.setattr(retention_mod, "plan_gc",
+                            lambda *a, **k: dict(evil))
+        with pytest.raises(retention_mod.GCError):
+            retention_mod.run_gc(store, 1)
+        assert store.exists(key)
+
+
+# -- the WAL archiver ---------------------------------------------------------
+
+
+class _FlakyStore:
+    """Delegating store whose next ``fail`` puts raise OSError."""
+
+    def __init__(self, inner, fail=0):
+        self.inner = inner
+        self.fail = fail
+
+    def put(self, key, data):
+        if self.fail > 0:
+            self.fail -= 1
+            raise OSError("injected archive outage")
+        self.inner.put(key, data)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+class TestWalArchiver:
+    def _frag_path(self, root, slice=0):
+        return os.path.join(str(root), "i", "f", "views", "standard",
+                            "fragments", str(slice))
+
+    def test_frag_key_mapping(self, tmp_path):
+        a = WalArchiver(_store(tmp_path), str(tmp_path), "n1")
+        assert a._frag_key(self._frag_path(tmp_path, 7)) == \
+            "i/f/standard/7"
+        assert a._frag_key(os.path.join(str(tmp_path), "i", "f",
+                                        "somewhere")) is None
+        assert a._frag_key(os.path.join(str(tmp_path),
+                                        "backup.json")) is None
+
+    def test_buffer_flush_and_replayable_order(self, tmp_path):
+        store = _store(tmp_path)
+        a = WalArchiver(store, str(tmp_path), "127.0.0.1:7")
+        path = self._frag_path(tmp_path)
+        a._on_batch(path, b"\x01" * 13)
+        a._on_batch(path, b"\x02" * 26)
+        a._on_batch(os.path.join(str(tmp_path), "junk"), b"zz")
+        assert a.flush() == 2
+        assert a.flush() == 0  # drained
+        segs = archive_mod.list_wal_segments(store)
+        assert len(segs) == 1
+        seg = archive_mod.read_wal_segment(store, segs[0][0])
+        assert [b["ops"] for b in seg["batches"]] == \
+            [b"\x01" * 13, b"\x02" * 26]
+
+    def test_store_outage_requeues_in_commit_order(self, tmp_path):
+        store = _store(tmp_path)
+        flaky = _FlakyStore(store, fail=1)
+        a = WalArchiver(flaky, str(tmp_path), "n1")
+        path = self._frag_path(tmp_path)
+        a._on_batch(path, b"\x01" * 13)
+        a._on_batch(path, b"\x02" * 13)
+        with pytest.raises(OSError):
+            a.flush()
+        assert a.errors == 1
+        a._on_batch(path, b"\x03" * 13)
+        assert a.flush() == 3
+        seg = archive_mod.read_wal_segment(
+            store, archive_mod.list_wal_segments(store)[0][0])
+        assert [b["ops"][:1] for b in seg["batches"]] == \
+            [b"\x01", b"\x02", b"\x03"]
+
+    def test_seq_resumes_from_store(self, tmp_path):
+        store = _store(tmp_path)
+        for seq in (0, 1):
+            body = archive_mod.encode_wal_segment("n1", seq, [])
+            store.put(archive_mod.wal_segment_key("n1", seq, body),
+                      body)
+        a = WalArchiver(store, str(tmp_path), "n1")
+        a._on_batch(self._frag_path(tmp_path), b"\x01" * 13)
+        a.flush()
+        assert archive_mod.next_wal_seq(store, "n1") == 3
+
+
+# -- live-cluster legs --------------------------------------------------------
+
+
+def _post(host, path, body=b""):
+    req = urllib.request.Request(f"http://{host}{path}", data=body,
+                                 method="POST")
+    with urllib.request.urlopen(req, timeout=15) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def _get(host, path):
+    with urllib.request.urlopen(f"http://{host}{path}",
+                                timeout=15) as r:
+        return json.loads(r.read())
+
+
+def _query(host, index, q):
+    return _post(host, f"/index/{index}/query", q.encode())["results"]
+
+
+def _wait_backup(host, timeout_s=90.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        op = _get(host, "/backup")["op"]
+        if op and op["phase"] in (coord_mod.PHASE_DONE,
+                                  coord_mod.PHASE_FAILED):
+            return op
+        time.sleep(0.05)
+    raise AssertionError("backup did not finish in time")
+
+
+@pytest.fixture
+def env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_MESH", "0")
+    ns = SimpleNamespace(tmp=tmp_path, servers=[])
+
+    def make(name, backup=None):
+        s = Server(str(tmp_path / name), host="127.0.0.1:0",
+                   anti_entropy_interval=0, polling_interval=0,
+                   backup_config=backup)
+        s.open()
+        ns.servers.append(s)
+        return s
+
+    ns.make = make
+    yield ns
+    failpoints.disarm_all()
+    for s in ns.servers:
+        try:
+            s.close()
+        except Exception:  # noqa: BLE001 - already closed mid-test
+            pass
+
+
+def _setup_index(hosts, index="bk", frame="f"):
+    for h in hosts:
+        _post(h, f"/index/{index}")
+        _post(h, f"/index/{index}/frame/{frame}")
+
+
+class TestBackupRestoreE2E:
+    """Full disaster: consistent backup under live writes, incremental
+    on top, every data dir destroyed, restore into a different-size
+    cluster, workload-replay digest verification, exact PITR cut."""
+
+    def test_backup_destroy_restore_pitr_verified(self, env):
+        arch = str(env.tmp / "archive")
+        bc = BackupConfig(archive=f"dir:{arch}", wal_interval=60.0)
+        s1 = env.make("n1", backup=bc)
+        s2 = env.make("n2", backup=bc)
+        for s in (s1, s2):
+            s.cluster.nodes = [Node(s1.host), Node(s2.host)]
+        _setup_index((s1.host, s2.host))
+        rng = np.random.default_rng(7)
+        n_bits = 1200
+        rows = rng.integers(0, 6, n_bits).astype(np.uint64)
+        cols = rng.choice(3 * SLICE_WIDTH, size=n_bits,
+                          replace=False).astype(np.uint64)
+        Client(s1.host).import_arrays("bk", "f", rows, cols)
+        for s in (s1, s2):
+            s.holder.index("bk").set_remote_max_slice(2)
+        model = {}
+        for r, c in zip(rows.tolist(), cols.tolist()):
+            model.setdefault(int(r), set()).add(int(c))
+
+        out = _post(s1.host, "/backup",
+                    json.dumps({"kind": "full"}).encode())
+        assert out["op"]["kind"] == "full"
+        full_op = _wait_backup(s1.host)
+        assert full_op["phase"] == coord_mod.PHASE_DONE, full_op
+        assert full_op["fragments"] > 0
+
+        # Post-backup writes: only the WAL archive can carry these.
+        _query(s1.host, "bk", 'SetBit(frame="f", rowID=50,'
+                              ' columnID=123)')
+        _query(s2.host, "bk", 'SetBit(frame="f", rowID=50,'
+                              ' columnID=456)')
+        model[50] = {123, 456}
+        for s in (s1, s2):
+            s.wal_archiver.flush()
+        time.sleep(0.02)
+        cut = time.time()
+        time.sleep(0.02)
+        _query(s1.host, "bk", 'SetBit(frame="f", rowID=51,'
+                              ' columnID=789)')
+        model[51] = {789}
+        for s in (s1, s2):
+            s.wal_archiver.flush()
+
+        # An incremental rides the shared pool: far fewer objects.
+        _post(s1.host, "/backup",
+              json.dumps({"kind": "incremental"}).encode())
+        incr_op = _wait_backup(s1.host)
+        assert incr_op["phase"] == coord_mod.PHASE_DONE, incr_op
+        assert incr_op["objectsPushed"] < full_op["objectsPushed"]
+        dbg = _get(s1.host, "/debug/backup")
+        assert [b["kind"] for b in dbg["backups"]] == \
+            ["full", "incremental"]
+        assert dbg["backups"][1]["parent"] == full_op["id"]
+        assert dbg["walSegments"], "no WAL segments archived"
+
+        # Capture the workload verdicts on the SOURCE cluster.
+        records = []
+        for row in sorted(model):
+            rec = {"index": "bk",
+                   "pql": f'Bitmap(frame="f", rowID={row})'}
+            got = replay_mod._issue(s1.host, rec)
+            assert got["status"] == 200 and got["digest"]
+            rec.update(status=200, digest=got["digest"])
+            records.append(rec)
+        records.append({"index": "bk",
+                        "pql": 'SetBit(frame="f", rowID=1,'
+                               ' columnID=1)'})  # write: never replayed
+        recpath = str(env.tmp / "records.json")
+        with open(recpath, "w") as f:
+            json.dump({"records": records}, f)
+
+        # Destroy EVERY data dir.
+        for s in (s1, s2):
+            s.close()
+        env.servers.clear()
+        shutil.rmtree(str(env.tmp / "n1"))
+        shutil.rmtree(str(env.tmp / "n2"))
+
+        # Restore into a DIFFERENT-size (1-node) cluster via the CLI,
+        # with workload-replay verification: zero digest mismatches.
+        r1 = env.make("r1")
+        out1, err1 = io.StringIO(), io.StringIO()
+        rc = cli_main(["restore", "--host", r1.host,
+                       "--archive", f"dir:{arch}",
+                       "--verify", recpath], out1, err1)
+        assert rc == 0, (out1.getvalue(), err1.getvalue())
+        summary = json.loads(out1.getvalue())
+        assert summary["verify"]["compared"] == len(model)
+        assert summary["verify"]["mismatches"] == 0
+        assert summary["verify"]["skipped"] == 1  # the write record
+        for row, want in model.items():
+            got = _query(r1.host, "bk",
+                         f'Count(Bitmap(frame="f", rowID={row}))')[0]
+            assert got == len(want), (row, got, len(want))
+
+        r1.close()
+        env.servers.clear()
+        shutil.rmtree(str(env.tmp / "r1"))
+
+        # PITR to the cut: the post-cut write provably excluded, and
+        # the verifier SEES the drift (row 51's digest mismatches).
+        r2 = env.make("r2")
+        store = archive_mod.open_archive(f"dir:{arch}", "")
+        summary = restore_mod.run_restore(r2.host, store,
+                                          to_timestamp=cut)
+        assert summary["id"] == full_op["id"]  # incremental post-cut
+        assert _query(r2.host, "bk",
+                      'Count(Bitmap(frame="f", rowID=50))')[0] == 2
+        assert _query(r2.host, "bk",
+                      'Count(Bitmap(frame="f", rowID=51))')[0] == 0
+        verdict = verify_mod.verify_restore(r2.host, records)
+        assert verdict["mismatches"] >= 1
+
+
+class TestCrashResume:
+    """A coordinator killed mid-push resumes idempotently under the
+    same backup id (the journal + pool exists-check contract)."""
+
+    def _seed(self, env, name, archive_spec=None):
+        bc = BackupConfig(archive=archive_spec) if archive_spec \
+            else None
+        s = env.make(name, backup=bc)
+        _setup_index((s.host,))
+        rng = np.random.default_rng(3)
+        rows = rng.integers(0, 4, 400).astype(np.uint64)
+        cols = rng.choice(2 * SLICE_WIDTH, size=400,
+                          replace=False).astype(np.uint64)
+        Client(s.host).import_arrays("bk", "f", rows, cols)
+        s.holder.index("bk").set_remote_max_slice(1)
+        return s
+
+    def test_failed_push_resumes_same_id(self, env):
+        arch = str(env.tmp / "archive")
+        s = self._seed(env, "n1", archive_spec=f"dir:{arch}")
+        store = s.backup_store
+        # Drain the WAL archiver first: its (retried, error-tolerant)
+        # segment push must not consume the one-shot injection meant
+        # for the coordinator's first data object.
+        s.wal_archiver.flush()
+        coord = coord_mod.BackupCoordinator(s, store, kind="full")
+        with failpoints.injected("backup.push", "error*1"):
+            # The failpoint fires AFTER the store write: the crash
+            # leaves the first object durable but unjournaled.
+            with pytest.raises(OSError):
+                coord._run()
+        journal = coord_mod.BackupJournal.for_data_dir(s.holder.path)
+        assert journal.load() is not None and journal.in_flight()
+        assert archive_mod.read_backup(store, coord.id) is None
+
+        out = coord_mod.recover(s)
+        assert out is not None and out["id"] == coord.id
+        assert out["phase"] == coord_mod.PHASE_DONE, out
+        manifest = archive_mod.read_backup(store, coord.id)
+        assert manifest is not None
+        total = len(archive_mod.manifest_object_keys(manifest))
+        # The durable object from the crashed attempt was skipped.
+        assert out["objectsPushed"] < total
+        for name, verdict in archive_mod.verify_backup(store,
+                                                       manifest):
+            assert not verdict["corrupt"], (name, verdict)
+
+    def test_journaled_fragments_reused_on_recover(self, env):
+        arch = str(env.tmp / "archive")
+        s = self._seed(env, "n1", archive_spec=f"dir:{arch}")
+        store = s.backup_store
+        first = coord_mod.BackupCoordinator(s, store, kind="full")
+        first._run()
+        m1 = archive_mod.read_backup(store, first.id)
+        frag = m1["fragments"][0]
+        key = (f"{frag['index']}/{frag['frame']}/{frag['view']}"
+               f"/{frag['slice']}")
+        # Simulate a crash that had journaled exactly one fragment.
+        journal = coord_mod.BackupJournal.for_data_dir(s.holder.path)
+        journal.write(phase=coord_mod.PHASE_SNAPSHOT, id="resume01",
+                      kind="full", coordinator=s.host,
+                      startedAt=time.time(),
+                      walStart=m1.get("walStart") or {}, parent=None,
+                      fragments={key: frag})
+        out = coord_mod.recover(s)
+        assert out["id"] == "resume01"
+        assert out["phase"] == coord_mod.PHASE_DONE, out
+        assert out["fragmentsSkipped"] >= 1
+        m2 = archive_mod.read_backup(store, "resume01")
+        assert frag in m2["fragments"]
+
+    def test_recover_noop_without_in_flight_journal(self, env):
+        arch = str(env.tmp / "archive")
+        s = self._seed(env, "n1", archive_spec=f"dir:{arch}")
+        assert coord_mod.recover(s) is None
+
+
+class TestRestoreAdmission:
+    """Torn/corrupt archive objects are detected at restore admission
+    and never served (the PR-15 contract, extended offline)."""
+
+    def _backed_up_store(self, env, rows=(1, 2, 3)):
+        """A closed-and-destroyed 1-node cluster's archive, plus the
+        row -> column model it held."""
+        s = env.make("src")
+        _setup_index((s.host,))
+        for r in rows:
+            _query(s.host, "bk",
+                   f'SetBit(frame="f", rowID={r}, columnID={r})')
+        store = archive_mod.open_archive(
+            f"dir:{env.tmp / 'archive'}", "")
+        coord = coord_mod.BackupCoordinator(s, store, kind="full")
+        coord._run()
+        assert coord.phase == coord_mod.PHASE_DONE
+        s.close()
+        env.servers.remove(s)
+        shutil.rmtree(str(env.tmp / "src"))
+        return store
+
+    def test_corrupt_object_rejected_never_served(self, env):
+        store = self._backed_up_store(env)
+        key = sorted(store.list("data/"))[0]
+        path = store._path(key)
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0x40
+        with open(path, "wb") as f:
+            f.write(raw)
+        target = env.make("dst")
+        with pytest.raises(restore_mod.RestoreError) as ei:
+            restore_mod.run_restore(target.host, store)
+        assert "NOT admitted" in str(ei.value)
+        # Schema came back but the rotten fragment never did: the
+        # restored cluster serves nothing rather than wrong bits.
+        assert _query(target.host, "bk",
+                      'Count(Bitmap(frame="f", rowID=1))')[0] == 0
+
+    def test_fetch_failpoint_corrupt_rejected(self, env):
+        store = self._backed_up_store(env)
+        target = env.make("dst")
+        with failpoints.injected("restore.fetch", "corrupt*1"):
+            with pytest.raises(restore_mod.RestoreError):
+                restore_mod.run_restore(target.host, store)
+
+    def test_fetch_failpoint_error_surfaces(self, env):
+        store = self._backed_up_store(env)
+        target = env.make("dst")
+        with failpoints.injected("restore.fetch", "error*1"):
+            with pytest.raises(restore_mod.RestoreError):
+                restore_mod.run_restore(target.host, store)
+
+    def test_torn_object_rejected(self, env):
+        store = self._backed_up_store(env)
+        key = sorted(store.list("data/"))[-1]
+        path = store._path(key)
+        raw = open(path, "rb").read()
+        with open(path, "wb") as f:
+            f.write(raw[:max(1, len(raw) // 3)])
+        target = env.make("dst")
+        with pytest.raises(restore_mod.RestoreError):
+            restore_mod.run_restore(target.host, store)
+
+
+# -- the CLI surface ----------------------------------------------------------
+
+
+class TestBackupCLI:
+    def test_list_gc_and_check_deep(self, tmp_path):
+        arch = str(tmp_path / "archive")
+        store = blob_mod.LocalDirBlobStore(arch)
+        _fake_backup(store, "f1", "full", 100.0, rows=(1, 2))
+        _fake_backup(store, "f2", "full", 200.0, rows=(3, 4))
+        body = archive_mod.encode_wal_segment(
+            "n1", 0, [{"frag": "i/f/standard/0", "t": 1.0,
+                       "ops": b"x" * 13}])
+        store.put(archive_mod.wal_segment_key("n1", 0, body), body)
+
+        out = io.StringIO()
+        rc = cli_main(["backup", "--archive", f"dir:{arch}",
+                       "--list"], out, io.StringIO())
+        assert rc == 0
+        assert "f1" in out.getvalue() and "f2" in out.getvalue()
+
+        out = io.StringIO()
+        rc = cli_main(["backup", "--archive", f"dir:{arch}", "--gc",
+                       "--keep", "1", "--dry-run"], out,
+                      io.StringIO())
+        assert rc == 0
+        plan = json.loads(out.getvalue())
+        assert plan["dryRun"] and plan["dropBackups"] == ["f1"]
+        assert archive_mod.read_backup(store, "f1") is not None
+
+        out = io.StringIO()
+        rc = cli_main(["check", "--deep", "--archive",
+                       f"dir:{arch}"], out, io.StringIO())
+        assert rc == 0, out.getvalue()
+        assert "0 corrupt" in out.getvalue()
+
+        # Rot one pool object: same walk must fail with rc 1.
+        key = sorted(store.list("data/"))[0]
+        path = store._path(key)
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0x01
+        with open(path, "wb") as f:
+            f.write(raw)
+        out = io.StringIO()
+        rc = cli_main(["check", "--deep", "--archive",
+                       f"dir:{arch}"], out, io.StringIO())
+        assert rc == 1
+        assert "CORRUPT" in out.getvalue()
+
+    def test_archive_flags_require_explicit_path(self, tmp_path):
+        err = io.StringIO()
+        rc = cli_main(["backup", "--archive", "dir", "--list"],
+                      io.StringIO(), err)
+        assert rc == 1
+        rc = cli_main(["restore", "--archive", "dir", "--host",
+                       "localhost:1"], io.StringIO(), err)
+        assert rc == 1

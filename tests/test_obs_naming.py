@@ -281,6 +281,56 @@ class TestRouteTableDocumented:
                     "redact"):
             assert f"\n{key} = " in toml.split("[capture]")[1], key
 
+    def test_backup_routes_metrics_and_config_swept(self):
+        """ISSUE 20: the disaster-recovery surface — the /backup
+        control route and /debug/backup are registered and documented
+        in the README, the pilosa_backup_* families exist with the
+        documented labels (and so passed the naming gate at import),
+        the watchdog grew the backup_stall cause, the failpoint
+        registry grew the backup.push / restore.fetch sites, the
+        tail sampler knows the ``backup`` keep-reason, and every
+        [backup] config key round-trips through to_toml."""
+        handler = Handler(None, None)
+        patterns = {p for _m, _r, _f, _l, p in handler._routes}
+        assert "/backup" in patterns
+        assert "/debug/backup" in patterns
+        with open(_README) as f:
+            readme = f.read()
+        for surface in ("/backup", "/debug/backup",
+                        "--to-timestamp", "--sweep-orphans",
+                        "check --deep --archive"):
+            assert surface in readme, (
+                f"backup surface {surface!r} undocumented in README")
+        fams = obs_metrics.default_registry().families()
+        for name in ("pilosa_backup_objects_total",
+                     "pilosa_backup_bytes_total",
+                     "pilosa_backup_fragments_total",
+                     "pilosa_backup_wal_records_total",
+                     "pilosa_backup_wal_segments_total",
+                     "pilosa_backup_errors_total"):
+            assert name in fams, name
+            assert fams[name].type == "counter", name
+        assert fams["pilosa_backup_state_info"].type == "gauge"
+        assert fams["pilosa_backup_state_info"].labelnames == (
+            "phase",)
+        assert fams["pilosa_backup_objects_total"].labelnames == (
+            "outcome",)
+        assert fams["pilosa_backup_bytes_total"].labelnames == (
+            "direction",)
+        from pilosa_tpu.obs.watchdog import CAUSES
+        assert "backup_stall" in CAUSES
+        from pilosa_tpu.fault.failpoints import SITES
+        assert "backup.push" in SITES
+        assert "restore.fetch" in SITES
+        from pilosa_tpu.obs.sampler import REASONS
+        assert "backup" in REASONS
+        from pilosa_tpu.utils.config import Config
+        toml = Config().to_toml()
+        assert "[backup]" in toml
+        for key in ("archive", "wal-interval", "keep-fulls"):
+            assert f"\n{key} = " in toml.split("[backup]")[1], key
+        assert "backup-stall" in toml.split("[watchdog]")[1]
+
     def test_fault_metrics_registered(self):
         """The fault-layer metric families promised by
         docs/FAULT_TOLERANCE.md exist in the default registry (and so
